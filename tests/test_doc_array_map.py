@@ -1,0 +1,139 @@
+"""Array / Map / nested type semantics.
+
+Model: reference types/array.rs:653-940 and types/map.rs:640-1112 tests.
+"""
+
+from ytpu.core import Doc
+from ytpu.types import ArrayPrelim, MapPrelim, TextPrelim
+
+
+def exchange(a: Doc, b: Doc) -> None:
+    ua = a.encode_state_as_update_v1(b.state_vector())
+    ub = b.encode_state_as_update_v1(a.state_vector())
+    b.apply_update_v1(ua)
+    a.apply_update_v1(ub)
+
+
+def test_array_insert_get():
+    d = Doc(client_id=1)
+    arr = d.get_array("a")
+    with d.transact() as txn:
+        arr.insert_range(txn, 0, [1, 2, "three", True, None])
+    assert arr.to_list() == [1, 2, "three", True, None]
+    assert arr.get(2) == "three"
+    assert len(arr) == 5
+
+
+def test_array_remove():
+    d = Doc(client_id=1)
+    arr = d.get_array("a")
+    with d.transact() as txn:
+        arr.insert_range(txn, 0, list(range(10)))
+    with d.transact() as txn:
+        arr.remove_range(txn, 2, 5)
+    assert arr.to_list() == [0, 1, 7, 8, 9]
+
+
+def test_array_concurrent_converge():
+    a, b = Doc(client_id=1), Doc(client_id=2)
+    aa, ab = a.get_array("a"), b.get_array("a")
+    with a.transact() as txn:
+        aa.insert_range(txn, 0, [0, 0, 0])
+    exchange(a, b)
+    with a.transact() as txn:
+        aa.insert(txn, 1, "a")
+    with b.transact() as txn:
+        ab.insert(txn, 1, "b")
+        ab.remove(txn, 0)
+    exchange(a, b)
+    assert aa.to_list() == ab.to_list()
+
+
+def test_map_set_get_remove():
+    d = Doc(client_id=1)
+    m = d.get_map("m")
+    with d.transact() as txn:
+        m.insert(txn, "k1", "v1")
+        m.insert(txn, "k2", 42)
+    assert m.get("k1") == "v1"
+    assert m.get("k2") == 42
+    with d.transact() as txn:
+        m.insert(txn, "k1", "v1b")  # overwrite
+        m.remove(txn, "k2")
+    assert m.get("k1") == "v1b"
+    assert m.get("k2") is None
+    assert m.to_json() == {"k1": "v1b"}
+
+
+def test_map_concurrent_higher_actor_wins():
+    """Conflict rule: for concurrent map writes the higher client id wins
+    (reference: lib.rs:427-430)."""
+    a, b = Doc(client_id=1), Doc(client_id=2)
+    ma, mb = a.get_map("m"), b.get_map("m")
+    with a.transact() as txn:
+        ma.insert(txn, "k", "from_a")
+    with b.transact() as txn:
+        mb.insert(txn, "k", "from_b")
+    exchange(a, b)
+    assert ma.get("k") == mb.get("k") == "from_b"
+
+
+def test_map_sequential_last_writer_wins():
+    a, b = Doc(client_id=5), Doc(client_id=2)
+    ma, mb = a.get_map("m"), b.get_map("m")
+    with a.transact() as txn:
+        ma.insert(txn, "k", "first")
+    exchange(a, b)
+    with b.transact() as txn:
+        mb.insert(txn, "k", "second")  # causally after: must win despite lower id
+    exchange(a, b)
+    assert ma.get("k") == mb.get("k") == "second"
+
+
+def test_nested_array_in_map():
+    d = Doc(client_id=1)
+    m = d.get_map("m")
+    with d.transact() as txn:
+        m.insert(txn, "list", ArrayPrelim([1, 2, 3]))
+    nested = m.get("list")
+    assert nested.to_list() == [1, 2, 3]
+    assert d.to_json() == {"m": {"list": [1, 2, 3]}}
+
+
+def test_nested_types_sync():
+    a, b = Doc(client_id=1), Doc(client_id=2)
+    ma = a.get_map("m")
+    with a.transact() as txn:
+        ma.insert(txn, "txt", TextPrelim("hello"))
+        ma.insert(txn, "cfg", MapPrelim({"x": 1}))
+    exchange(a, b)
+    mb = b.get_map("m")
+    assert mb.get("txt").get_string() == "hello"
+    assert mb.get("cfg").to_json() == {"x": 1}
+    # mutate nested type on b, sync back
+    with b.transact() as txn:
+        mb.get("txt").insert(txn, 5, " world")
+    exchange(a, b)
+    assert ma.get("txt").get_string() == "hello world"
+
+
+def test_deep_nesting_delete():
+    d = Doc(client_id=1)
+    m = d.get_map("root")
+    with d.transact() as txn:
+        m.insert(txn, "a", MapPrelim({"b": ArrayPrelim([TextPrelim("deep")])}))
+    inner = m.get("a").get("b").get(0)
+    assert inner.get_string() == "deep"
+    with d.transact() as txn:
+        m.remove(txn, "a")
+    assert m.get("a") is None
+    assert d.to_json() == {"root": {}}
+
+
+def test_binary_payload():
+    a, b = Doc(client_id=1), Doc(client_id=2)
+    arr = a.get_array("a")
+    with a.transact() as txn:
+        arr.push_back(txn, b"\x01\x02\xff")
+    exchange(a, b)
+    assert b.get_array("a").to_list() == [b"\x01\x02\xff"]

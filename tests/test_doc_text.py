@@ -1,0 +1,183 @@
+"""Single-doc and two-peer Text semantics.
+
+Model: reference yrs/src/types/text.rs test module + update exchange tests.
+"""
+
+import pytest
+
+from ytpu.core import Doc, StateVector, Update
+
+
+def exchange(a: Doc, b: Doc) -> None:
+    """One full bidirectional sync (model: test_utils.rs:17 exchange_updates)."""
+    ua = a.encode_state_as_update_v1(b.state_vector())
+    ub = b.encode_state_as_update_v1(a.state_vector())
+    b.apply_update_v1(ua)
+    a.apply_update_v1(ub)
+
+
+def test_insert_and_get_string():
+    d = Doc(client_id=1)
+    txt = d.get_text("t")
+    with d.transact() as txn:
+        txt.insert(txn, 0, "hello")
+        txt.insert(txn, 5, " world")
+    assert txt.get_string() == "hello world"
+    assert len(txt) == 11
+
+
+def test_insert_middle_splits_block():
+    d = Doc(client_id=1)
+    txt = d.get_text("t")
+    with d.transact() as txn:
+        txt.insert(txn, 0, "helloworld")
+    with d.transact() as txn:
+        txt.insert(txn, 5, ", ")
+    assert txt.get_string() == "hello, world"
+
+
+def test_remove_range():
+    d = Doc(client_id=1)
+    txt = d.get_text("t")
+    with d.transact() as txn:
+        txt.insert(txn, 0, "hello cruel world")
+    with d.transact() as txn:
+        txt.remove_range(txn, 5, 6)
+    assert txt.get_string() == "hello world"
+    assert len(txt) == 11
+
+
+def test_utf16_astral_lengths():
+    d = Doc(client_id=1)
+    txt = d.get_text("t")
+    with d.transact() as txn:
+        txt.insert(txn, 0, "a😀b")  # 😀 is 2 UTF-16 units
+    assert len(txt) == 4
+    with d.transact() as txn:
+        txt.insert(txn, 4, "!")
+    assert txt.get_string() == "a😀b!"
+
+
+def test_two_peer_convergence_simple():
+    a, b = Doc(client_id=1), Doc(client_id=2)
+    ta, tb = a.get_text("t"), b.get_text("t")
+    with a.transact() as txn:
+        ta.insert(txn, 0, "abc")
+    exchange(a, b)
+    assert tb.get_string() == "abc"
+    with b.transact() as txn:
+        tb.insert(txn, 3, "def")
+    exchange(a, b)
+    assert ta.get_string() == "abcdef"
+    assert tb.get_string() == "abcdef"
+
+
+def test_concurrent_inserts_converge():
+    a, b = Doc(client_id=1), Doc(client_id=2)
+    ta, tb = a.get_text("t"), b.get_text("t")
+    with a.transact() as txn:
+        ta.insert(txn, 0, "base")
+    exchange(a, b)
+    # concurrent edits at the same position
+    with a.transact() as txn:
+        ta.insert(txn, 4, "A")
+    with b.transact() as txn:
+        tb.insert(txn, 4, "B")
+    exchange(a, b)
+    s1, s2 = ta.get_string(), tb.get_string()
+    assert s1 == s2
+    assert sorted(s1[4:]) == ["A", "B"]
+    # YATA ties break toward the lower client id being left
+    assert s1 == "baseAB"
+
+
+def test_concurrent_insert_delete_converge():
+    a, b = Doc(client_id=1), Doc(client_id=2)
+    ta, tb = a.get_text("t"), b.get_text("t")
+    with a.transact() as txn:
+        ta.insert(txn, 0, "hello world")
+    exchange(a, b)
+    with a.transact() as txn:
+        ta.remove_range(txn, 0, 6)  # "world"
+    with b.transact() as txn:
+        tb.insert(txn, 11, "!")
+    exchange(a, b)
+    assert ta.get_string() == tb.get_string() == "world!"
+
+
+def test_three_way_convergence():
+    docs = [Doc(client_id=i + 1) for i in range(3)]
+    texts = [d.get_text("t") for d in docs]
+    for i, (d, t) in enumerate(zip(docs, texts)):
+        with d.transact() as txn:
+            t.insert(txn, 0, f"p{i}:")
+    # all-pairs gossip, twice for transitivity
+    for _ in range(2):
+        for i in range(3):
+            for j in range(3):
+                if i != j:
+                    u = docs[i].encode_state_as_update_v1(docs[j].state_vector())
+                    docs[j].apply_update_v1(u)
+    strings = [t.get_string() for t in texts]
+    assert strings[0] == strings[1] == strings[2]
+    assert sorted(strings[0].split(":")[:-1] + [""]) is not None  # sanity
+
+
+def test_update_roundtrip_through_fresh_doc():
+    a = Doc(client_id=1)
+    ta = a.get_text("t")
+    with a.transact() as txn:
+        ta.insert(txn, 0, "persistent state")
+    full = a.encode_state_as_update_v1()
+    b = Doc(client_id=2)
+    b.apply_update_v1(full)
+    assert b.get_text("t").get_string() == "persistent state"
+
+
+def test_out_of_order_updates_go_pending():
+    a = Doc(client_id=1)
+    ta = a.get_text("t")
+    updates = []
+    a.observe_update_v1(lambda payload, origin, txn: updates.append(payload))
+    with a.transact() as txn:
+        ta.insert(txn, 0, "first")
+    with a.transact() as txn:
+        ta.insert(txn, 5, "second")
+    assert len(updates) == 2
+    b = Doc(client_id=2)
+    # apply out of order: the second update must stash as pending
+    b.apply_update_v1(updates[1])
+    assert b.get_text("t").get_string() == ""
+    assert b.store.pending is not None
+    b.apply_update_v1(updates[0])
+    assert b.get_text("t").get_string() == "firstsecond"
+    assert b.store.pending is None
+
+
+def test_pending_updates_survive_full_state_encode():
+    a = Doc(client_id=1)
+    ta = a.get_text("t")
+    updates = []
+    a.observe_update_v1(lambda payload, origin, txn: updates.append(payload))
+    with a.transact() as txn:
+        ta.insert(txn, 0, "x")
+    with a.transact() as txn:
+        ta.insert(txn, 1, "y")
+    b = Doc(client_id=2)
+    b.apply_update_v1(updates[1])  # pending
+    c = Doc(client_id=3)
+    c.apply_update_v1(b.encode_state_as_update_v1())
+    c.apply_update_v1(updates[0])
+    assert c.get_text("t").get_string() == "xy"
+
+
+def test_deletes_propagate():
+    a, b = Doc(client_id=1), Doc(client_id=2)
+    ta, tb = a.get_text("t"), b.get_text("t")
+    with a.transact() as txn:
+        ta.insert(txn, 0, "abcdef")
+    exchange(a, b)
+    with a.transact() as txn:
+        ta.remove_range(txn, 1, 3)
+    exchange(a, b)
+    assert ta.get_string() == tb.get_string() == "aef"

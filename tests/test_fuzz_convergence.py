@@ -1,0 +1,115 @@
+"""Seeded convergence fuzzing over the fake peer network.
+
+Model: reference fuzz tests built on run_scenario (e.g. types/map.rs:1063-1110,
+array/text equivalents) — N peers, random ops, random partial delivery,
+then a convergence assertion.
+"""
+
+import random
+import string
+
+import pytest
+
+from ytpu.testing import run_scenario
+from ytpu.types import ArrayPrelim, MapPrelim, TextPrelim
+
+
+def _rand_word(rng: random.Random) -> str:
+    return "".join(rng.choice(string.ascii_lowercase) for _ in range(rng.randint(1, 6)))
+
+
+# --- text mutators ---
+
+
+def text_insert(doc, rng):
+    txt = doc.get_text("text")
+    pos = rng.randint(0, len(txt))
+    with doc.transact() as txn:
+        txt.insert(txn, pos, _rand_word(rng))
+
+
+def text_delete(doc, rng):
+    txt = doc.get_text("text")
+    n = len(txt)
+    if n == 0:
+        return
+    pos = rng.randint(0, n - 1)
+    length = min(rng.randint(1, 5), n - pos)
+    with doc.transact() as txn:
+        txt.remove_range(txn, pos, length)
+
+
+# --- array mutators ---
+
+
+def array_insert(doc, rng):
+    arr = doc.get_array("array")
+    pos = rng.randint(0, len(arr))
+    with doc.transact() as txn:
+        arr.insert_range(txn, pos, [rng.randint(0, 100) for _ in range(rng.randint(1, 3))])
+
+
+def array_delete(doc, rng):
+    arr = doc.get_array("array")
+    n = len(arr)
+    if n == 0:
+        return
+    pos = rng.randint(0, n - 1)
+    with doc.transact() as txn:
+        arr.remove_range(txn, pos, min(rng.randint(1, 2), n - pos))
+
+
+# --- map mutators ---
+
+KEYS = ["a", "b", "c", "d", "e"]
+
+
+def map_set(doc, rng):
+    m = doc.get_map("map")
+    with doc.transact() as txn:
+        m.insert(txn, rng.choice(KEYS), _rand_word(rng))
+
+
+def map_set_nested(doc, rng):
+    m = doc.get_map("map")
+    kind = rng.randint(0, 2)
+    with doc.transact() as txn:
+        if kind == 0:
+            m.insert(txn, rng.choice(KEYS), MapPrelim({"n": rng.randint(0, 9)}))
+        elif kind == 1:
+            m.insert(txn, rng.choice(KEYS), ArrayPrelim([1, 2]))
+        else:
+            m.insert(txn, rng.choice(KEYS), TextPrelim(_rand_word(rng)))
+
+
+def map_delete(doc, rng):
+    m = doc.get_map("map")
+    with doc.transact() as txn:
+        m.remove(txn, rng.choice(KEYS))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_text(seed):
+    run_scenario(seed, [text_insert, text_insert, text_delete], n_peers=3, n_iterations=120)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_array(seed):
+    run_scenario(seed + 100, [array_insert, array_delete], n_peers=3, n_iterations=120)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_map(seed):
+    run_scenario(
+        seed + 200, [map_set, map_set_nested, map_delete], n_peers=3, n_iterations=120
+    )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_mixed_5_peers(seed):
+    run_scenario(
+        seed + 300,
+        [text_insert, text_delete, array_insert, array_delete, map_set, map_set_nested],
+        n_peers=5,
+        n_iterations=200,
+    )

@@ -1,0 +1,116 @@
+"""lib0 encoding round-trips (model: proptest round-trips at
+reference encoding/mod.rs:33-42 and any.rs tests)."""
+
+import random
+
+import pytest
+
+from ytpu.encoding.lib0 import Cursor, Undefined, Writer, read_any, write_any
+
+
+def roundtrip_uint(v):
+    w = Writer()
+    w.write_var_uint(v)
+    return Cursor(w.to_bytes()).read_var_uint()
+
+
+def roundtrip_int(v):
+    w = Writer()
+    w.write_var_int(v)
+    return Cursor(w.to_bytes()).read_var_int()
+
+
+def test_var_uint_roundtrip():
+    for v in [0, 1, 127, 128, 129, 16383, 16384, 2**31, 2**53, 2**64 - 1]:
+        assert roundtrip_uint(v) == v
+    rng = random.Random(42)
+    for _ in range(1000):
+        v = rng.getrandbits(rng.randint(1, 64))
+        assert roundtrip_uint(v) == v
+
+
+def test_var_uint_wire_bytes():
+    # 7-bit little-endian groups with continuation bit
+    w = Writer()
+    w.write_var_uint(0x80)
+    assert w.to_bytes() == bytes([0x80, 0x01])
+    w = Writer()
+    w.write_var_uint(300)
+    assert w.to_bytes() == bytes([0xAC, 0x02])
+
+
+def test_var_int_roundtrip():
+    for v in [0, -1, 1, 63, -63, 64, -64, 2**31, -(2**31), 2**53 - 1, -(2**53 - 1)]:
+        assert roundtrip_int(v) == v
+    rng = random.Random(7)
+    for _ in range(1000):
+        v = rng.getrandbits(rng.randint(1, 53)) * rng.choice([1, -1])
+        assert roundtrip_int(v) == v
+
+
+def test_var_int_sign_bit():
+    # -1 encodes sign in bit 0x40 of the first byte
+    w = Writer()
+    w.write_var_int(-1)
+    assert w.to_bytes() == bytes([0x41])
+    w = Writer()
+    w.write_var_int(1)
+    assert w.to_bytes() == bytes([0x01])
+
+
+def test_string_roundtrip():
+    for s in ["", "hello", "héllo wörld", "日本語", "🌍🚀", "a" * 1000]:
+        w = Writer()
+        w.write_string(s)
+        assert Cursor(w.to_bytes()).read_string() == s
+
+
+def test_any_roundtrip():
+    samples = [
+        None,
+        Undefined,
+        True,
+        False,
+        0,
+        1,
+        -1,
+        2**53 - 1,
+        -(2**53 - 1),
+        2**60,  # bigint territory
+        0.5,
+        -3.25,
+        1e300,
+        "text",
+        b"\x00\x01\x02",
+        [1, "two", None, [3.5]],
+        {"a": 1, "b": [True, {"c": None}]},
+    ]
+    for v in samples:
+        w = Writer()
+        write_any(w, v)
+        cur = Cursor(w.to_bytes())
+        out = read_any(cur)
+        assert out == v or (v is Undefined and out is Undefined), (v, out)
+        assert not cur.has_content()
+
+
+def test_any_integer_float_tags():
+    # ints in safe range use tag 125; float 3.0 collapses to integer (JS semantics)
+    w = Writer()
+    write_any(w, 3.0)
+    assert w.to_bytes()[0] == 125
+    w = Writer()
+    write_any(w, 3.5)
+    assert w.to_bytes()[0] == 124  # exactly representable in f32
+    w = Writer()
+    write_any(w, 1.1)
+    assert w.to_bytes()[0] == 123  # needs f64
+
+
+def test_truncated_input_raises():
+    from ytpu.encoding.lib0 import EncodingError
+
+    with pytest.raises(EncodingError):
+        Cursor(b"\x80").read_var_uint()
+    with pytest.raises(EncodingError):
+        Cursor(b"\x05abc").read_string()

@@ -1,0 +1,65 @@
+"""StateVector / IdSet / DeleteSet semantics (model: reference
+state_vector.rs + id_set.rs unit tests)."""
+
+from ytpu.core import ID, DeleteSet, IdSet, StateVector
+
+
+def test_state_vector_merge_and_contains():
+    a = StateVector({1: 5, 2: 3})
+    b = StateVector({1: 2, 3: 7})
+    a.merge(b)
+    assert a.get(1) == 5 and a.get(2) == 3 and a.get(3) == 7
+    # contains means "can apply a block starting at this clock"
+    assert a.contains(ID(1, 5))
+    assert a.contains(ID(1, 0))
+    assert not a.contains(ID(1, 6))
+    assert a.contains(ID(99, 0))
+
+
+def test_state_vector_wire_roundtrip():
+    sv = StateVector({10: 100, 2: 7, 55: 1})
+    data = sv.encode_v1()
+    assert StateVector.decode_v1(data) == sv
+    # zero-clock entries are dropped on the wire
+    sv2 = StateVector({1: 0, 2: 5})
+    assert StateVector.decode_v1(sv2.encode_v1()) == StateVector({2: 5})
+
+
+def test_id_set_squash_and_contains():
+    s = IdSet()
+    s.insert(ID(1, 0), 3)
+    s.insert(ID(1, 5), 2)
+    s.insert(ID(1, 3), 2)  # bridges the hole
+    s.squash()
+    assert s.clients[1] == [(0, 7)]
+    assert s.contains(ID(1, 6))
+    assert not s.contains(ID(1, 7))
+
+
+def test_id_set_invert():
+    s = IdSet()
+    s.insert(ID(1, 2), 3)  # [2..5)
+    s.insert(ID(1, 8), 1)  # [8..9)
+    inv = s.invert()
+    assert inv.clients[1] == [(0, 2), (5, 8)]
+
+
+def test_delete_set_wire_roundtrip():
+    ds = DeleteSet()
+    ds.insert(ID(7, 0), 4)
+    ds.insert(ID(7, 10), 5)
+    ds.insert(ID(3, 2), 1)
+    data = ds.encode_v1()
+    out = DeleteSet.decode_v1(data)
+    assert out == ds
+
+
+def test_delete_set_merge():
+    a = DeleteSet()
+    a.insert(ID(1, 0), 5)
+    b = DeleteSet()
+    b.insert(ID(1, 5), 5)
+    b.insert(ID(2, 0), 1)
+    a.merge(b)
+    assert a.clients[1] == [(0, 10)]
+    assert a.clients[2] == [(0, 1)]

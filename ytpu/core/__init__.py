@@ -1,0 +1,47 @@
+"""Host CRDT core: the per-document semantic oracle.
+
+Layer map (mirrors SURVEY.md §1): ids/state_vector/id_set (L2), block/
+block_store (L3), store/transaction/doc/update (L4), with the shared types
+in `ytpu.types` (L5) on top.
+"""
+
+from .block import GCRange, Item, SkipRange
+from .block_store import BlockStore, ClientBlockList
+from .branch import Branch
+from .doc import Doc, Options
+from .id_set import DeleteSet, IdSet
+from .ids import ID, ClientID
+from .state_vector import Snapshot, StateVector
+from .transaction import Transaction
+from .update import (
+    PendingUpdate,
+    Update,
+    decode_update_v1,
+    diff_updates_v1,
+    encode_state_vector_from_update_v1,
+    merge_updates_v1,
+)
+
+__all__ = [
+    "ID",
+    "ClientID",
+    "StateVector",
+    "Snapshot",
+    "IdSet",
+    "DeleteSet",
+    "Item",
+    "GCRange",
+    "SkipRange",
+    "BlockStore",
+    "ClientBlockList",
+    "Branch",
+    "Doc",
+    "Options",
+    "Transaction",
+    "Update",
+    "PendingUpdate",
+    "decode_update_v1",
+    "merge_updates_v1",
+    "encode_state_vector_from_update_v1",
+    "diff_updates_v1",
+]

@@ -1,0 +1,156 @@
+"""Branch — the universal shared-type node.
+
+Behavioral parity target: /root/reference/yrs/src/branch.rs:173-215 and
+`TypeRef` in /root/reference/yrs/src/types/mod.rs:36-199. Every shared type
+(Text, Array, Map, XmlElement, …) is a projection over a `Branch`: a sequence
+component (`start` linked chain) plus a map component (`map` per-key chains),
+tagged with a runtime `type_ref`.
+
+Device mapping: the batched engine keeps a branch table per doc — columns
+(type_ref, start_idx, item_idx, block_len, content_len) plus a host dict for
+root names and map keys (`ytpu.models.batch_doc`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, TYPE_CHECKING
+
+from ytpu.encoding.lib0 import Cursor, Writer
+
+from .ids import ID
+from .moving import ASSOC_AFTER, ASSOC_BEFORE, StickyIndex
+
+if TYPE_CHECKING:
+    from .block import Item
+
+__all__ = [
+    "TYPE_ARRAY",
+    "TYPE_MAP",
+    "TYPE_TEXT",
+    "TYPE_XML_ELEMENT",
+    "TYPE_XML_FRAGMENT",
+    "TYPE_XML_HOOK",
+    "TYPE_XML_TEXT",
+    "TYPE_WEAK",
+    "TYPE_DOC",
+    "TYPE_UNDEFINED",
+    "Branch",
+    "LinkSource",
+]
+
+# Wire tags; parity: types/mod.rs:36-64.
+TYPE_ARRAY = 0
+TYPE_MAP = 1
+TYPE_TEXT = 2
+TYPE_XML_ELEMENT = 3
+TYPE_XML_FRAGMENT = 4
+TYPE_XML_HOOK = 5
+TYPE_XML_TEXT = 6
+TYPE_WEAK = 7
+TYPE_DOC = 9
+TYPE_UNDEFINED = 15
+
+
+class LinkSource:
+    """Quoted range backing a WeakRef (reference: types/weak.rs:487)."""
+
+    __slots__ = ("quote_start", "quote_end", "first_item")
+
+    def __init__(self, quote_start: StickyIndex, quote_end: StickyIndex):
+        self.quote_start = quote_start
+        self.quote_end = quote_end
+        self.first_item = None
+
+    def is_single(self) -> bool:
+        return self.quote_start.id == self.quote_end.id
+
+
+class Branch:
+    __slots__ = (
+        "item",
+        "name",
+        "type_ref",
+        "type_name",
+        "link_source",
+        "start",
+        "map",
+        "block_len",
+        "content_len",
+        "observers",
+        "deep_observers",
+        "store",
+    )
+
+    def __init__(
+        self,
+        type_ref: int,
+        type_name: Optional[str] = None,
+        link_source: Optional[LinkSource] = None,
+    ):
+        self.item: Optional["Item"] = None  # integration anchor (None for roots)
+        self.name: Optional[str] = None  # root-type name
+        self.type_ref = type_ref
+        self.type_name = type_name  # XmlElement tag / XmlHook key
+        self.link_source = link_source
+        self.start: Optional["Item"] = None
+        self.map: Dict[str, "Item"] = {}
+        self.block_len = 0  # total clock length of alive sequence items
+        self.content_len = 0  # user-visible length
+        self.observers: List = []
+        self.deep_observers: List = []
+        self.store = None  # back-ref set when registered
+
+    def is_deleted(self) -> bool:
+        return self.item is not None and self.item.deleted
+
+    # --- wire ---
+
+    def encode_type_ref(self, w: Writer) -> None:
+        """Parity: types/mod.rs:118-158 (v1 writes the tag as a single byte)."""
+        w.write_u8(self.type_ref)
+        if self.type_ref in (TYPE_XML_ELEMENT, TYPE_XML_HOOK):
+            w.write_string(self.type_name or "")
+        elif self.type_ref == TYPE_WEAK:
+            src = self.link_source
+            info = 0 if src.is_single() else 1
+            if src.quote_start.assoc == ASSOC_AFTER:
+                info |= 2
+            if src.quote_end.assoc == ASSOC_AFTER:
+                info |= 4
+            w.write_u8(info)
+            w.write_var_uint(src.quote_start.id.client)
+            w.write_var_uint(src.quote_start.id.clock)
+            if not src.is_single():
+                w.write_var_uint(src.quote_end.id.client)
+                w.write_var_uint(src.quote_end.id.clock)
+
+    @classmethod
+    def decode_type_ref(cls, cur: Cursor) -> "Branch":
+        tag = cur.read_u8()
+        if tag in (TYPE_XML_ELEMENT, TYPE_XML_HOOK):
+            return cls(tag, type_name=cur.read_string())
+        if tag == TYPE_WEAK:
+            flags = cur.read_u8()
+            single = flags & 1 == 0
+            start_assoc = ASSOC_AFTER if flags & 2 else ASSOC_BEFORE
+            end_assoc = ASSOC_AFTER if flags & 4 else ASSOC_BEFORE
+            start_id = ID(cur.read_var_uint(), cur.read_var_uint())
+            end_id = start_id if single else ID(cur.read_var_uint(), cur.read_var_uint())
+            src = LinkSource(
+                StickyIndex.from_id(start_id, start_assoc),
+                StickyIndex.from_id(end_id, end_assoc),
+            )
+            return cls(tag, link_source=src)
+        return cls(tag)
+
+    # --- traversal helpers used by the shared types ---
+
+    def first(self) -> Optional["Item"]:
+        item = self.start
+        while item is not None and item.deleted:
+            item = item.right
+        return item
+
+    def __repr__(self) -> str:
+        tag = self.name or (f"@{self.item.id}" if self.item else "?")
+        return f"Branch[{self.type_ref}]({tag})"

@@ -1,0 +1,165 @@
+"""Clock-range sets: IdSet / DeleteSet.
+
+Behavioral parity target: /root/reference/yrs/src/id_set.rs (IdRange :36-248,
+IdSet :324-439, DeleteSet :440-652). An IdSet maps each client to a set of
+half-open clock ranges ``[start, end)``; a DeleteSet is the IdSet of tombstoned
+blocks carried by every update and snapshot.
+
+Representation here: ``client -> list[(start, end)]`` kept squash-lazy like
+the reference (ranges are sorted+merged on demand). On device, a batch of
+delete sets becomes a ragged ``[n_docs, n_ranges, 3]`` (client, start, end)
+tensor; interval membership is a searchsorted over the flattened ranges
+(see `ytpu.ops.delete_set`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ytpu.encoding.lib0 import Cursor, Writer
+
+from .ids import ID, ClientID
+
+__all__ = ["IdSet", "DeleteSet"]
+
+Range = Tuple[int, int]  # half-open [start, end)
+
+
+def _squash_ranges(ranges: List[Range]) -> List[Range]:
+    """Sort and merge overlapping/adjacent ranges."""
+    if len(ranges) <= 1:
+        return ranges
+    ranges = sorted(ranges)
+    out = [ranges[0]]
+    for start, end in ranges[1:]:
+        last_start, last_end = out[-1]
+        if start <= last_end:  # overlap or adjacency joins
+            if end > last_end:
+                out[-1] = (last_start, end)
+        else:
+            out.append((start, end))
+    return out
+
+
+class IdSet:
+    __slots__ = ("clients",)
+
+    def __init__(self, clients: Optional[Dict[ClientID, List[Range]]] = None):
+        self.clients: Dict[ClientID, List[Range]] = clients if clients is not None else {}
+
+    def is_empty(self) -> bool:
+        return all(not rs for rs in self.clients.values())
+
+    def insert(self, id_: ID, length: int) -> None:
+        if length <= 0:
+            return
+        self.clients.setdefault(id_.client, []).append((id_.clock, id_.clock + length))
+
+    def insert_range(self, client: ClientID, start: int, end: int) -> None:
+        if end > start:
+            self.clients.setdefault(client, []).append((start, end))
+
+    def squash(self) -> None:
+        for client in list(self.clients):
+            rs = _squash_ranges(self.clients[client])
+            if rs:
+                self.clients[client] = rs
+            else:
+                del self.clients[client]
+
+    def contains(self, id_: ID) -> bool:
+        rs = self.clients.get(id_.client)
+        if not rs:
+            return False
+        return any(start <= id_.clock < end for start, end in rs)
+
+    def ranges(self, client: ClientID) -> List[Range]:
+        return _squash_ranges(self.clients.get(client, []))
+
+    def merge(self, other: "IdSet") -> None:
+        for client, rs in other.clients.items():
+            self.clients.setdefault(client, []).extend(rs)
+        self.squash()
+
+    def invert(self) -> "IdSet":
+        """Ranges *not* covered, from clock 0 up to each client's max covered clock."""
+        out = IdSet()
+        for client, rs in self.clients.items():
+            rs = _squash_ranges(rs)
+            prev = 0
+            holes: List[Range] = []
+            for start, end in rs:
+                if start > prev:
+                    holes.append((prev, start))
+                prev = end
+            if holes:
+                out.clients[client] = holes
+        return out
+
+    def copy(self) -> "IdSet":
+        return IdSet({c: list(rs) for c, rs in self.clients.items()})
+
+    def __iter__(self) -> Iterator[Tuple[ClientID, List[Range]]]:
+        return iter(self.clients.items())
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, IdSet):
+            return NotImplemented
+        a = {c: _squash_ranges(rs) for c, rs in self.clients.items() if rs}
+        b = {c: _squash_ranges(rs) for c, rs in other.clients.items() if rs}
+        return a == b
+
+    def __repr__(self) -> str:
+        parts = []
+        for client, rs in sorted(self.clients.items()):
+            rr = ",".join(f"[{s}..{e})" for s, e in _squash_ranges(rs))
+            parts.append(f"{client}:{rr}")
+        return f"{type(self).__name__}({'; '.join(parts)})"
+
+    # --- wire format (v1): clients count, then per client: id, range count,
+    # (clock, len) pairs ---
+
+    def encode(self, w: Optional[Writer] = None) -> Writer:
+        w = w or Writer()
+        entries = [(c, _squash_ranges(rs)) for c, rs in self.clients.items() if rs]
+        entries.sort(key=lambda e: -e[0])
+        w.write_var_uint(len(entries))
+        for client, rs in entries:
+            w.write_var_uint(client)
+            w.write_var_uint(len(rs))
+            for start, end in rs:
+                w.write_var_uint(start)
+                w.write_var_uint(end - start)
+        return w
+
+    def encode_v1(self) -> bytes:
+        return self.encode().to_bytes()
+
+    @classmethod
+    def decode(cls, cur: Cursor) -> "IdSet":
+        n_clients = cur.read_var_uint()
+        out = cls()
+        for _ in range(n_clients):
+            client = cur.read_var_uint()
+            n_ranges = cur.read_var_uint()
+            rs = out.clients.setdefault(client, [])
+            for _ in range(n_ranges):
+                clock = cur.read_var_uint()
+                length = cur.read_var_uint()
+                if length:
+                    rs.append((clock, clock + length))
+        return out
+
+    @classmethod
+    def decode_v1(cls, data: bytes) -> "IdSet":
+        return cls.decode(Cursor(data))
+
+
+class DeleteSet(IdSet):
+    """IdSet of deleted block ranges (reference: id_set.rs:440)."""
+
+    __slots__ = ()
+
+    @classmethod
+    def from_id_set(cls, ids: IdSet) -> "DeleteSet":
+        return cls({c: list(rs) for c, rs in ids.clients.items()})

@@ -1,0 +1,25 @@
+"""Block identifiers.
+
+Semantics follow the reference model (/root/reference/yrs/src/block.rs:75-93):
+a block is addressed by a Lamport-style ``(client, clock)`` pair; a block of
+length ``len`` covers clocks ``clock .. clock+len-1``.
+
+In the device path these become two i32/i64 columns of the block tensor
+(`ytpu.models.batch_doc`); here they are a tiny value type for the host engine.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+__all__ = ["ID", "ClientID"]
+
+ClientID = int
+
+
+class ID(NamedTuple):
+    client: int
+    clock: int
+
+    def __repr__(self) -> str:
+        return f"<{self.client}#{self.clock}>"

@@ -1,0 +1,125 @@
+"""Move ranges and sticky indices.
+
+Behavioral parity target: /root/reference/yrs/src/moving.rs (Move :16,
+StickyIndex :403, Assoc :723). Round-1 scope: full wire format + data model;
+`Move.integrate_block` / move-aware iteration land with the move/undo service
+layer. Sticky indices resolve through `ytpu.core.store.DocStore`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ytpu.encoding.lib0 import Cursor, Writer
+
+from .ids import ID
+
+__all__ = ["ASSOC_BEFORE", "ASSOC_AFTER", "StickyIndex", "Move"]
+
+ASSOC_BEFORE = -1
+ASSOC_AFTER = 0
+
+
+class StickyIndex:
+    """A position that sticks to its neighborhood across concurrent edits.
+
+    Scope is either an item ID (relative), or a root-type name / branch id
+    (start or end of a sequence).
+    """
+
+    __slots__ = ("id", "name", "branch_id", "assoc")
+
+    def __init__(
+        self,
+        id_: Optional[ID] = None,
+        name: Optional[str] = None,
+        branch_id: Optional[ID] = None,
+        assoc: int = ASSOC_AFTER,
+    ):
+        self.id = id_
+        self.name = name
+        self.branch_id = branch_id
+        self.assoc = assoc
+
+    @classmethod
+    def from_id(cls, id_: ID, assoc: int) -> "StickyIndex":
+        return cls(id_=id_, assoc=assoc)
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, StickyIndex):
+            return NotImplemented
+        return (
+            self.id == other.id
+            and self.name == other.name
+            and self.branch_id == other.branch_id
+            and self.assoc == other.assoc
+        )
+
+    def __repr__(self) -> str:
+        where = self.id or self.name or self.branch_id
+        arrow = "<" if self.assoc == ASSOC_BEFORE else ">"
+        return f"Sticky({where}{arrow})"
+
+
+class Move:
+    """A moved range ``[start, end]`` with a conflict-resolution priority."""
+
+    __slots__ = ("start", "end", "priority", "overrides", "origin")
+
+    def __init__(self, start: StickyIndex, end: StickyIndex, priority: int):
+        self.start = start
+        self.end = end
+        self.priority = priority
+        # runtime state (set during integration):
+        self.overrides = None  # set[Item] of moves this one shadows
+        self.origin = None  # previous `moved` markers
+
+    def is_collapsed(self) -> bool:
+        return self.start.id == self.end.id
+
+    def copy(self) -> "Move":
+        return Move(self.start, self.end, self.priority)
+
+    def encode(self, w: Writer) -> None:
+        collapsed = self.is_collapsed()
+        flags = 0
+        if collapsed:
+            flags |= 0b001
+        if self.start.assoc == ASSOC_AFTER:
+            flags |= 0b010
+        if self.end.assoc == ASSOC_AFTER:
+            flags |= 0b100
+        flags |= self.priority << 6
+        w.write_var_uint(flags)
+        w.write_var_uint(self.start.id.client)
+        w.write_var_uint(self.start.id.clock)
+        if not collapsed:
+            w.write_var_uint(self.end.id.client)
+            w.write_var_uint(self.end.id.clock)
+
+    @classmethod
+    def decode(cls, cur: Cursor) -> "Move":
+        flags = cur.read_var_uint()
+        collapsed = flags & 0b001 != 0
+        start_assoc = ASSOC_AFTER if flags & 0b010 else ASSOC_BEFORE
+        end_assoc = ASSOC_AFTER if flags & 0b100 else ASSOC_BEFORE
+        priority = flags >> 6
+        start_id = ID(cur.read_var_uint(), cur.read_var_uint())
+        end_id = start_id if collapsed else ID(cur.read_var_uint(), cur.read_var_uint())
+        return cls(
+            StickyIndex.from_id(start_id, start_assoc),
+            StickyIndex.from_id(end_id, end_assoc),
+            priority,
+        )
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Move):
+            return NotImplemented
+        return (
+            self.start == other.start
+            and self.end == other.end
+            and self.priority == other.priority
+        )
+
+    def __repr__(self) -> str:
+        return f"Move({self.start}..{self.end}, prio={self.priority})"

@@ -1,0 +1,146 @@
+"""State vectors — per-client version clocks used for delta sync.
+
+Behavioral parity target: /root/reference/yrs/src/state_vector.rs:19-154.
+A state vector maps ``client -> next expected clock`` (i.e. number of
+operations observed from that client). Diff sync sends a state vector
+(SyncStep1) and receives blocks above those clocks (SyncStep2).
+
+TPU mapping: a batch of state vectors is a dense ``[n_docs, n_clients]`` i32
+tensor over a client dictionary; merge = elementwise max, comparison =
+elementwise less-than (see `ytpu.ops.state_vector`). This host class is the
+ragged boundary representation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional, Tuple
+
+from ytpu.encoding.lib0 import Cursor, Writer
+
+from .ids import ID, ClientID
+
+__all__ = ["StateVector", "Snapshot"]
+
+
+class StateVector:
+    __slots__ = ("clocks",)
+
+    def __init__(self, clocks: Optional[Dict[ClientID, int]] = None):
+        self.clocks: Dict[ClientID, int] = dict(clocks) if clocks else {}
+
+    def get(self, client: ClientID) -> int:
+        return self.clocks.get(client, 0)
+
+    def set_min(self, client: ClientID, clock: int) -> None:
+        if client in self.clocks:
+            self.clocks[client] = min(self.clocks[client], clock)
+        else:
+            self.clocks[client] = clock
+
+    def set_max(self, client: ClientID, clock: int) -> None:
+        if clock > self.clocks.get(client, 0):
+            self.clocks[client] = clock
+
+    def inc_by(self, client: ClientID, delta: int) -> None:
+        if delta:
+            self.clocks[client] = self.clocks.get(client, 0) + delta
+
+    def contains(self, id_: ID) -> bool:
+        """True if a block starting at `id_` can be applied without a gap
+        (parity: state_vector.rs — `id.clock <= get(client)`)."""
+        return id_.clock <= self.get(id_.client)
+
+    def contains_all(self, other: "StateVector") -> bool:
+        return all(self.get(c) >= k for c, k in other.clocks.items())
+
+    def merge(self, other: "StateVector") -> None:
+        for client, clock in other.clocks.items():
+            self.set_max(client, clock)
+
+    def copy(self) -> "StateVector":
+        return StateVector(self.clocks)
+
+    def __iter__(self) -> Iterator[Tuple[ClientID, int]]:
+        return iter(self.clocks.items())
+
+    def __len__(self) -> int:
+        return len(self.clocks)
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, StateVector):
+            return NotImplemented
+        a = {c: k for c, k in self.clocks.items() if k}
+        b = {c: k for c, k in other.clocks.items() if k}
+        return a == b
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{c}:{k}" for c, k in sorted(self.clocks.items()))
+        return f"StateVector({{{inner}}})"
+
+    # --- wire format (v1) ---
+
+    def encode(self, w: Optional[Writer] = None) -> Writer:
+        w = w or Writer()
+        entries = [(c, k) for c, k in self.clocks.items() if k > 0]
+        # Deterministic order: higher clients first, mirroring update encoding
+        # conventions (reference sorts updates by descending client id).
+        entries.sort(key=lambda e: -e[0])
+        w.write_var_uint(len(entries))
+        for client, clock in entries:
+            w.write_var_uint(client)
+            w.write_var_uint(clock)
+        return w
+
+    def encode_v1(self) -> bytes:
+        return self.encode().to_bytes()
+
+    @classmethod
+    def decode(cls, cur: Cursor) -> "StateVector":
+        n = cur.read_var_uint()
+        clocks: Dict[ClientID, int] = {}
+        for _ in range(n):
+            client = cur.read_var_uint()
+            clock = cur.read_var_uint()
+            if clock:
+                clocks[client] = max(clocks.get(client, 0), clock)
+        return cls(clocks)
+
+    @classmethod
+    def decode_v1(cls, data: bytes) -> "StateVector":
+        return cls.decode(Cursor(data))
+
+
+class Snapshot:
+    """A point-in-time document version: state vector + accumulated deletions.
+
+    Parity: /root/reference/yrs/src/state_vector.rs:135-154.
+    """
+
+    __slots__ = ("state_vector", "delete_set")
+
+    def __init__(self, state_vector: StateVector, delete_set) -> None:
+        self.state_vector = state_vector
+        self.delete_set = delete_set
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Snapshot):
+            return NotImplemented
+        return (
+            self.state_vector == other.state_vector
+            and self.delete_set == other.delete_set
+        )
+
+    def encode_v1(self) -> bytes:
+        w = Writer()
+        self.delete_set.encode(w)
+        self.state_vector.encode(w)
+        return w.to_bytes()
+
+    @classmethod
+    def decode_v1(cls, data: bytes) -> "Snapshot":
+        from .id_set import DeleteSet
+
+        cur = Cursor(data)
+        ds = DeleteSet.decode(cur)
+        sv = StateVector.decode(cur)
+        return cls(sv, ds)

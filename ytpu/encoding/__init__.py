@@ -1,0 +1,23 @@
+"""lib0-compatible binary encoding (v1; v2 columnar codec in `v2`)."""
+
+from .lib0 import (
+    Cursor,
+    EncodingError,
+    Undefined,
+    Writer,
+    any_from_json,
+    any_to_json,
+    read_any,
+    write_any,
+)
+
+__all__ = [
+    "Cursor",
+    "Writer",
+    "Undefined",
+    "EncodingError",
+    "read_any",
+    "write_any",
+    "any_to_json",
+    "any_from_json",
+]

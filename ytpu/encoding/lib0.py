@@ -1,0 +1,343 @@
+"""lib0 binary encoding primitives (v1 wire compatibility layer).
+
+This module implements the lib0 encoding conventions used by the Yjs ecosystem
+so that ytpu documents are wire-compatible with Yjs/Yrs peers:
+
+- unsigned varints: little-endian 7-bit groups, 0x80 continuation
+  (reference behavior: /root/reference/yrs/src/encoding/varint.rs:194-260)
+- signed varints: first byte carries 6 payload bits + sign bit 0x40
+  (reference behavior: varint.rs:204-281)
+- strings: varUint byte-length prefix + UTF-8 payload
+- buffers: varUint length prefix + raw bytes
+- floats/ints: big-endian fixed width (reference: encoding/read.rs:141-171)
+- `Any` values: descending type-tag bytes 127..116
+  (reference: /root/reference/yrs/src/any.rs:37-183)
+
+The implementation is written from the wire-format description, tpu-first:
+the same byte layout is what the device-side decoder kernels in
+`ytpu.ops.decode` parse out of raw u8 buffers in HBM.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import struct
+from typing import Any as PyAny
+
+__all__ = [
+    "Cursor",
+    "Writer",
+    "Undefined",
+    "EncodingError",
+    "read_any",
+    "write_any",
+    "any_to_json",
+    "any_from_json",
+]
+
+F64_MAX_SAFE_INTEGER = 2**53 - 1
+F64_MIN_SAFE_INTEGER = -F64_MAX_SAFE_INTEGER
+
+
+class EncodingError(Exception):
+    """Raised on malformed lib0 input (truncated buffer, bad varint, bad tag)."""
+
+
+class _UndefinedType:
+    """JS `undefined` sentinel (distinct from None which maps to JS null)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "Undefined"
+
+    def __bool__(self) -> bool:
+        return False
+
+
+Undefined = _UndefinedType()
+
+
+class Cursor:
+    """Read cursor over an immutable byte buffer."""
+
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def has_content(self) -> bool:
+        return self.pos < len(self.buf)
+
+    def read_u8(self) -> int:
+        try:
+            b = self.buf[self.pos]
+        except IndexError:
+            raise EncodingError("end of buffer") from None
+        self.pos += 1
+        return b
+
+    def read_exact(self, n: int) -> bytes:
+        end = self.pos + n
+        if end > len(self.buf):
+            raise EncodingError("end of buffer")
+        out = self.buf[self.pos : end]
+        self.pos = end
+        return out
+
+    def read_var_uint(self) -> int:
+        num = 0
+        shift = 0
+        while True:
+            b = self.read_u8()
+            num |= (b & 0x7F) << shift
+            shift += 7
+            if b < 0x80:
+                return num
+            if shift > 70:
+                raise EncodingError("varint too long")
+
+    def read_var_int(self) -> int:
+        """Signed varint: 6 payload bits + sign in the first byte."""
+        b = self.read_u8()
+        num = b & 0x3F
+        negative = (b & 0x40) != 0
+        if (b & 0x80) == 0:
+            return -num if negative else num
+        shift = 6
+        while True:
+            b = self.read_u8()
+            num |= (b & 0x7F) << shift
+            shift += 7
+            if b < 0x80:
+                return -num if negative else num
+            if shift > 70:
+                raise EncodingError("varint too long")
+
+    def read_var_int_signed(self) -> tuple[int, bool]:
+        """Like read_var_int but also reports the raw sign bit (distinguishes -0)."""
+        b = self.read_u8()
+        num = b & 0x3F
+        negative = (b & 0x40) != 0
+        if (b & 0x80) == 0:
+            return (-num if negative else num), negative
+        shift = 6
+        while True:
+            b = self.read_u8()
+            num |= (b & 0x7F) << shift
+            shift += 7
+            if b < 0x80:
+                return (-num if negative else num), negative
+            if shift > 70:
+                raise EncodingError("varint too long")
+
+    def read_buf(self) -> bytes:
+        n = self.read_var_uint()
+        return self.read_exact(n)
+
+    def read_string(self) -> str:
+        return self.read_buf().decode("utf-8", errors="surrogatepass")
+
+    def read_f32(self) -> float:
+        return struct.unpack(">f", self.read_exact(4))[0]
+
+    def read_f64(self) -> float:
+        return struct.unpack(">d", self.read_exact(8))[0]
+
+    def read_i64(self) -> int:
+        return struct.unpack(">q", self.read_exact(8))[0]
+
+    def read_u64(self) -> int:
+        return struct.unpack(">Q", self.read_exact(8))[0]
+
+    def read_to_end(self) -> bytes:
+        out = self.buf[self.pos :]
+        self.pos = len(self.buf)
+        return out
+
+
+class Writer:
+    """Append-only byte writer."""
+
+    __slots__ = ("buf",)
+
+    def __init__(self):
+        self.buf = bytearray()
+
+    def to_bytes(self) -> bytes:
+        return bytes(self.buf)
+
+    def __len__(self) -> int:
+        return len(self.buf)
+
+    def write_u8(self, value: int) -> None:
+        self.buf.append(value & 0xFF)
+
+    def write_raw(self, data: bytes) -> None:
+        self.buf.extend(data)
+
+    def write_var_uint(self, value: int) -> None:
+        if value < 0:
+            raise ValueError(f"negative value for var_uint: {value}")
+        while value >= 0x80:
+            self.buf.append(0x80 | (value & 0x7F))
+            value >>= 7
+        self.buf.append(value)
+
+    def write_var_int(self, value: int, force_negative: bool = False) -> None:
+        negative = value < 0 or force_negative
+        if value < 0:
+            value = -value
+        first = (0x3F & value) | (0x40 if negative else 0)
+        value >>= 6
+        if value > 0:
+            first |= 0x80
+        self.buf.append(first)
+        while value > 0:
+            b = value & 0x7F
+            value >>= 7
+            if value > 0:
+                b |= 0x80
+            self.buf.append(b)
+
+    def write_buf(self, data: bytes) -> None:
+        self.write_var_uint(len(data))
+        self.buf.extend(data)
+
+    def write_string(self, s: str) -> None:
+        self.write_buf(s.encode("utf-8", errors="surrogatepass"))
+
+    def write_f32(self, value: float) -> None:
+        self.buf.extend(struct.pack(">f", value))
+
+    def write_f64(self, value: float) -> None:
+        self.buf.extend(struct.pack(">d", value))
+
+    def write_i64(self, value: int) -> None:
+        self.buf.extend(struct.pack(">q", value))
+
+    def write_u64(self, value: int) -> None:
+        self.buf.extend(struct.pack(">Q", value))
+
+
+# --- Any (JSON-superset scalar) ------------------------------------------------
+# Type tags descend from 127 (reference: any.rs:93-116).
+
+_TAG_UNDEFINED = 127
+_TAG_NULL = 126
+_TAG_INTEGER = 125
+_TAG_FLOAT32 = 124
+_TAG_FLOAT64 = 123
+_TAG_BIGINT = 122
+_TAG_FALSE = 121
+_TAG_TRUE = 120
+_TAG_STRING = 119
+_TAG_MAP = 118
+_TAG_ARRAY = 117
+_TAG_BUFFER = 116
+
+
+def read_any(cur: Cursor) -> PyAny:
+    tag = cur.read_u8()
+    if tag == _TAG_UNDEFINED:
+        return Undefined
+    if tag == _TAG_NULL:
+        return None
+    if tag == _TAG_INTEGER:
+        return cur.read_var_int()
+    if tag == _TAG_FLOAT32:
+        return cur.read_f32()
+    if tag == _TAG_FLOAT64:
+        return cur.read_f64()
+    if tag == _TAG_BIGINT:
+        return cur.read_i64()
+    if tag == _TAG_FALSE:
+        return False
+    if tag == _TAG_TRUE:
+        return True
+    if tag == _TAG_STRING:
+        return cur.read_string()
+    if tag == _TAG_MAP:
+        n = cur.read_var_uint()
+        out = {}
+        for _ in range(n):
+            key = cur.read_string()
+            out[key] = read_any(cur)
+        return out
+    if tag == _TAG_ARRAY:
+        n = cur.read_var_uint()
+        return [read_any(cur) for _ in range(n)]
+    if tag == _TAG_BUFFER:
+        return cur.read_buf()
+    raise EncodingError(f"unexpected Any tag {tag}")
+
+
+def write_any(w: Writer, value: PyAny) -> None:
+    if value is Undefined:
+        w.write_u8(_TAG_UNDEFINED)
+    elif value is None:
+        w.write_u8(_TAG_NULL)
+    elif value is True:
+        w.write_u8(_TAG_TRUE)
+    elif value is False:
+        w.write_u8(_TAG_FALSE)
+    elif isinstance(value, str):
+        w.write_u8(_TAG_STRING)
+        w.write_string(value)
+    elif isinstance(value, int):
+        if F64_MIN_SAFE_INTEGER <= value <= F64_MAX_SAFE_INTEGER:
+            w.write_u8(_TAG_INTEGER)
+            w.write_var_int(value)
+        else:
+            w.write_u8(_TAG_BIGINT)
+            w.write_i64(value)
+    elif isinstance(value, float):
+        if value.is_integer() and F64_MIN_SAFE_INTEGER <= value <= F64_MAX_SAFE_INTEGER:
+            w.write_u8(_TAG_INTEGER)
+            w.write_var_int(int(value))
+        elif (
+            not math.isnan(value)
+            and abs(value) <= 3.4028234663852886e38
+            and struct.unpack(">f", struct.pack(">f", value))[0] == value
+        ):
+            w.write_u8(_TAG_FLOAT32)
+            w.write_f32(value)
+        else:
+            w.write_u8(_TAG_FLOAT64)
+            w.write_f64(value)
+    elif isinstance(value, (bytes, bytearray, memoryview)):
+        w.write_u8(_TAG_BUFFER)
+        w.write_buf(bytes(value))
+    elif isinstance(value, dict):
+        w.write_u8(_TAG_MAP)
+        w.write_var_uint(len(value))
+        for key, item in value.items():
+            w.write_string(str(key))
+            write_any(w, item)
+    elif isinstance(value, (list, tuple)):
+        w.write_u8(_TAG_ARRAY)
+        w.write_var_uint(len(value))
+        for item in value:
+            write_any(w, item)
+    else:
+        raise TypeError(f"cannot encode {type(value)!r} as Any")
+
+
+def any_to_json(value: PyAny) -> str:
+    """JSON string form used by the v1 codec for Embed/Format payloads."""
+    if value is Undefined:
+        return "undefined"
+    return json.dumps(value, separators=(",", ":"), ensure_ascii=False)
+
+
+def any_from_json(src: str) -> PyAny:
+    if src == "undefined" or src == "":
+        return Undefined
+    return json.loads(src)

@@ -1,0 +1,65 @@
+"""Shared types over a `Branch` (Text, Array, Map, Xml…).
+
+Parity target: /root/reference/yrs/src/types/ — every shared type is a
+projection over the universal branch node (reference: lib.rs:433-437).
+"""
+
+from __future__ import annotations
+
+from ytpu.core.branch import (
+    Branch,
+    TYPE_ARRAY,
+    TYPE_MAP,
+    TYPE_TEXT,
+    TYPE_XML_ELEMENT,
+    TYPE_XML_FRAGMENT,
+    TYPE_XML_HOOK,
+    TYPE_XML_TEXT,
+)
+
+from .array import Array
+from .map import Map
+from .shared import (
+    ArrayPrelim,
+    MapPrelim,
+    Prelim,
+    SharedType,
+    TextPrelim,
+    XmlElementPrelim,
+    XmlTextPrelim,
+)
+from .text import Diff, Text
+from .xml import XmlElement, XmlFragment, XmlText
+
+__all__ = [
+    "Array",
+    "Map",
+    "Text",
+    "Diff",
+    "XmlElement",
+    "XmlFragment",
+    "XmlText",
+    "SharedType",
+    "Prelim",
+    "TextPrelim",
+    "ArrayPrelim",
+    "MapPrelim",
+    "XmlElementPrelim",
+    "XmlTextPrelim",
+    "wrap_branch",
+]
+
+_WRAPPERS = {
+    TYPE_ARRAY: Array,
+    TYPE_MAP: Map,
+    TYPE_TEXT: Text,
+    TYPE_XML_ELEMENT: XmlElement,
+    TYPE_XML_FRAGMENT: XmlFragment,
+    TYPE_XML_TEXT: XmlText,
+}
+
+
+def wrap_branch(branch: Branch) -> SharedType:
+    """Wrap a branch in its user-facing shared type (by runtime type tag)."""
+    cls = _WRAPPERS.get(branch.type_ref, Array)
+    return cls(branch)

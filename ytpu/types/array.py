@@ -1,0 +1,119 @@
+"""Array — an ordered sequence of values.
+
+Behavioral parity target: /root/reference/yrs/src/types/array.rs (`Array`
+trait :171 — insert/push/remove :245-343, iteration :424, to_json).
+Uses the same sequence kernel as Text; payloads are `Any` values, nested
+shared types, binaries, or sub-documents.
+"""
+
+from __future__ import annotations
+
+from typing import Any as PyAny, Iterator, List, Optional
+
+from ytpu.core.branch import TYPE_ARRAY
+from ytpu.core.content import ContentAny
+from ytpu.core.transaction import Transaction
+
+from .shared import Prelim, SharedType, find_position, out_value, to_content
+
+__all__ = ["Array"]
+
+
+class Array(SharedType):
+    type_ref = TYPE_ARRAY
+    __slots__ = ()
+
+    def __len__(self) -> int:
+        return self.branch.content_len
+
+    # --- writes ----------------------------------------------------------------
+
+    def insert(self, txn: Transaction, index: int, value: PyAny) -> None:
+        self.insert_range(txn, index, [value])
+
+    def insert_range(self, txn: Transaction, index: int, values: List[PyAny]) -> None:
+        """Parity: types/array.rs:245 (consecutive primitives batch into one
+        ContentAny block)."""
+        pos = find_position(self.branch, txn, index)
+        if pos is None:
+            raise IndexError(index)
+        batch: List[PyAny] = []
+
+        def flush_batch():
+            if batch:
+                item = txn.create_item(pos, ContentAny(list(batch)), None)
+                pos.left = item
+                batch.clear()
+
+        for value in values:
+            if isinstance(value, Prelim) or isinstance(value, (bytes, bytearray)) or (
+                hasattr(value, "store") and hasattr(value, "guid")
+            ):
+                flush_batch()
+                content, prelim = to_content(value)
+                item = txn.create_item(pos, content, None)
+                pos.left = item
+                if prelim is not None:
+                    prelim.fill(txn, item.content.branch)
+            else:
+                batch.append(value)
+        flush_batch()
+
+    def push_back(self, txn: Transaction, value: PyAny) -> None:
+        self.insert(txn, len(self), value)
+
+    def push_front(self, txn: Transaction, value: PyAny) -> None:
+        self.insert(txn, 0, value)
+
+    def remove(self, txn: Transaction, index: int) -> None:
+        self.remove_range(txn, index, 1)
+
+    def remove_range(self, txn: Transaction, index: int, length: int) -> None:
+        pos = find_position(self.branch, txn, index)
+        if pos is None:
+            raise IndexError(index)
+        remaining = length
+        right = pos.right
+        store = txn.store
+        while right is not None and remaining > 0:
+            if not right.deleted and right.countable:
+                if remaining < right.len:
+                    store.blocks.split_at(right, remaining)
+                remaining -= min(remaining, right.len)
+                txn.delete(right)
+            right = right.right
+        if remaining > 0:
+            raise IndexError(f"remove_range past end of array ({remaining} left)")
+
+    # --- reads -----------------------------------------------------------------
+
+    def get(self, index: int) -> Optional[PyAny]:
+        item = self.branch.start
+        remaining = index
+        while item is not None:
+            if not item.deleted and item.countable:
+                if remaining < item.len:
+                    return out_value(item, remaining)
+                remaining -= item.len
+            item = item.right
+        return None
+
+    def __iter__(self) -> Iterator[PyAny]:
+        item = self.branch.start
+        while item is not None:
+            if not item.deleted and item.countable:
+                for i in range(item.len):
+                    yield out_value(item, i)
+            item = item.right
+
+    def to_list(self) -> List[PyAny]:
+        return list(self)
+
+    def to_json(self) -> List[PyAny]:
+        out = []
+        for v in self:
+            if isinstance(v, SharedType):
+                out.append(v.to_json())
+            else:
+                out.append(v)
+        return out

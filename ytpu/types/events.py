@@ -1,0 +1,241 @@
+"""Type events: per-branch observer dispatch at commit time.
+
+Behavioral parity target: the event layer in
+/root/reference/yrs/src/types/mod.rs:727-1183 (Event/Change/Delta/EntryChange)
+and the firing order contract documented at lib.rs:501-519: (1) per-type
+observers, (2) deep observers bubbling to parents, then the transaction-level
+events (handled in `ytpu.core.transaction.Transaction.commit`).
+
+Deltas are computed lazily from the block chains, mirroring
+types/text.rs:1213-1305 / array's Change reconstruction.
+"""
+
+from __future__ import annotations
+
+from typing import Any as PyAny, Dict, List, Optional, Set, Tuple
+
+from ytpu.core.block import Item
+from ytpu.core.branch import Branch
+from ytpu.core.content import ContentFormat, ContentString
+
+__all__ = ["Event", "Change", "EntryChange", "fire_type_events"]
+
+
+class Change:
+    """A sequence delta segment: ('insert', values) / ('delete', n) / ('retain', n)."""
+
+    __slots__ = ("kind", "values", "len")
+
+    def __init__(self, kind: str, values: Optional[List[PyAny]] = None, length: int = 0):
+        self.kind = kind
+        self.values = values
+        self.len = length
+
+    @classmethod
+    def insert(cls, values: List[PyAny]) -> "Change":
+        return cls("insert", values, len(values))
+
+    @classmethod
+    def delete(cls, n: int) -> "Change":
+        return cls("delete", None, n)
+
+    @classmethod
+    def retain(cls, n: int) -> "Change":
+        return cls("retain", None, n)
+
+    def __repr__(self) -> str:
+        if self.kind == "insert":
+            return f"Insert({self.values!r})"
+        return f"{self.kind.capitalize()}({self.len})"
+
+    def __eq__(self, other):
+        if not isinstance(other, Change):
+            return NotImplemented
+        return self.kind == other.kind and self.len == other.len and self.values == other.values
+
+
+class EntryChange:
+    """A map delta: action is 'add' | 'update' | 'remove'."""
+
+    __slots__ = ("action", "old_value", "new_value")
+
+    def __init__(self, action: str, old_value: PyAny = None, new_value: PyAny = None):
+        self.action = action
+        self.old_value = old_value
+        self.new_value = new_value
+
+    def __repr__(self) -> str:
+        return f"EntryChange({self.action}, {self.old_value!r} -> {self.new_value!r})"
+
+
+class Event:
+    """Fired for every branch changed inside a committed transaction."""
+
+    __slots__ = ("target", "current_target", "keys_changed", "txn", "_delta", "_keys")
+
+    def __init__(self, target: Branch, keys_changed: Set[Optional[str]], txn):
+        self.target = target
+        self.current_target = target
+        self.keys_changed = keys_changed
+        self.txn = txn
+        self._delta = None
+        self._keys = None
+
+    # --- path from root (parity: branch.rs:504) --------------------------------
+
+    def path(self) -> List[PyAny]:
+        path: List[PyAny] = []
+        branch = self.target
+        current = self.current_target
+        while branch is not current and branch.item is not None:
+            item = branch.item
+            if item.parent_sub is not None:
+                path.append(item.parent_sub)
+            else:
+                parent = item.parent
+                if isinstance(parent, Branch):
+                    index = 0
+                    node = parent.start
+                    while node is not None and node is not item:
+                        if not node.deleted and node.countable:
+                            index += node.len
+                        node = node.right
+                    path.append(index)
+            branch = item.parent if isinstance(item.parent, Branch) else None
+            if branch is None:
+                break
+        path.reverse()
+        return path
+
+    # --- sequence delta --------------------------------------------------------
+
+    def delta(self) -> List[Change]:
+        """Reconstruct insert/delete/retain runs for the sequence component."""
+        if self._delta is None:
+            from ytpu.types.shared import out_value
+
+            txn = self.txn
+            before = txn.before_state
+            changes: List[Change] = []
+            retain = 0
+            item = self.target.start
+            while item is not None:
+                known_before = item.id.clock < before.get(item.id.client)
+                deleted_now = item.deleted
+                deleted_in_txn = txn.delete_set.contains(item.id)
+                if not known_before and not deleted_now:
+                    # fresh insert that survived
+                    if item.countable:
+                        if retain:
+                            changes.append(Change.retain(retain))
+                            retain = 0
+                        values = [out_value(item, i) for i in range(item.len)]
+                        if changes and changes[-1].kind == "insert":
+                            changes[-1].values.extend(values)
+                            changes[-1].len += len(values)
+                        else:
+                            changes.append(Change.insert(values))
+                elif known_before and deleted_in_txn and deleted_now:
+                    if item.countable:
+                        if retain:
+                            changes.append(Change.retain(retain))
+                            retain = 0
+                        if changes and changes[-1].kind == "delete":
+                            changes[-1].len += item.len
+                        else:
+                            changes.append(Change.delete(item.len))
+                elif not deleted_now and item.countable:
+                    retain += item.len
+                item = item.right
+            self._delta = changes
+        return self._delta
+
+    # --- map delta -------------------------------------------------------------
+
+    def keys(self) -> Dict[str, EntryChange]:
+        """Per-key changes of the map component."""
+        if self._keys is None:
+            from ytpu.types.shared import out_value
+
+            txn = self.txn
+            before = txn.before_state
+            out: Dict[str, EntryChange] = {}
+            for key in self.keys_changed:
+                if key is None:
+                    continue
+                item = self.target.map.get(key)
+                if item is None:
+                    continue
+                known_before = item.id.clock < before.get(item.id.client)
+                if not known_before:
+                    # new live entry; find the previous live value underneath
+                    old = None
+                    node = item.left
+                    while node is not None:
+                        if node.id.clock < before.get(node.id.client) and not (
+                            txn.delete_set.contains(node.id) and not node.deleted
+                        ):
+                            if not node.deleted or txn.delete_set.contains(node.id):
+                                old = out_value(node)
+                                break
+                        node = node.left
+                    if item.deleted:
+                        if old is not None:
+                            out[key] = EntryChange("remove", old_value=old)
+                    elif old is None:
+                        out[key] = EntryChange("add", new_value=out_value(item))
+                    else:
+                        out[key] = EntryChange(
+                            "update", old_value=old, new_value=out_value(item)
+                        )
+                elif item.deleted and txn.delete_set.contains(item.id):
+                    out[key] = EntryChange("remove", old_value=out_value(item))
+            self._keys = out
+        return self._keys
+
+
+def fire_type_events(txn) -> None:
+    """Steps 2-3 of the commit pipeline (parity: transaction.rs:839-877)."""
+    events: List[Tuple[Branch, Event]] = []
+    for branch, keys in txn.changed.items():
+        if branch.observers or _has_deep_parent(branch):
+            events.append((branch, Event(branch, keys, txn)))
+
+    # 2. direct observers
+    for branch, event in events:
+        for cb in list(branch.observers):
+            cb(txn, event)
+
+    # 3. deep observers: bubble each event up the parent chain
+    deep: Dict[int, Tuple[Branch, List[Event]]] = {}
+    for branch, event in events:
+        node = branch
+        while node is not None:
+            if node.deep_observers:
+                entry = deep.setdefault(id(node), (node, []))
+                entry[1].append(event)
+            node = (
+                node.item.parent
+                if node.item is not None and isinstance(node.item.parent, Branch)
+                else None
+            )
+    for node, evts in deep.values():
+        # top-level events first: sort by path length
+        evts.sort(key=lambda e: len(e.path()))
+        for e in evts:
+            e.current_target = node
+        for cb in list(node.deep_observers):
+            cb(txn, evts)
+
+
+def _has_deep_parent(branch: Branch) -> bool:
+    node = branch
+    while node is not None:
+        if node.deep_observers:
+            return True
+        node = (
+            node.item.parent
+            if node.item is not None and isinstance(node.item.parent, Branch)
+            else None
+        )
+    return False

@@ -1,0 +1,219 @@
+"""Shared-type base machinery: branch projections, prelims, find_position.
+
+Behavioral parity targets: /root/reference/yrs/src/branch.rs:335-503
+(insert_at/remove_at/get_at), the `Prelim` system (block.rs:2091-2136), and
+`Text::find_position` (types/text.rs:734).
+
+`find_position` here walks the item chain like the reference; the device
+engine replaces this with a prefix-sum over countable lengths
+(`ytpu.ops.sequence.position_lookup`) — the host form stays the oracle.
+"""
+
+from __future__ import annotations
+
+from typing import Any as PyAny, List, Optional, Tuple
+
+from ytpu.core.block import Item
+from ytpu.core.branch import (
+    Branch,
+    TYPE_ARRAY,
+    TYPE_MAP,
+    TYPE_TEXT,
+    TYPE_XML_ELEMENT,
+    TYPE_XML_FRAGMENT,
+    TYPE_XML_TEXT,
+)
+from ytpu.core.content import (
+    Content,
+    ContentAny,
+    ContentBinary,
+    ContentDoc,
+    ContentEmbed,
+    ContentFormat,
+    ContentString,
+    ContentType,
+)
+from ytpu.core.transaction import ItemPosition, Transaction
+
+__all__ = [
+    "SharedType",
+    "Prelim",
+    "TextPrelim",
+    "ArrayPrelim",
+    "MapPrelim",
+    "XmlTextPrelim",
+    "XmlElementPrelim",
+    "find_position",
+    "out_value",
+    "to_content",
+]
+
+
+class SharedType:
+    """Base for Text/Array/Map/Xml — a view over a `Branch`."""
+
+    type_ref: int = -1
+    __slots__ = ("branch",)
+
+    def __init__(self, branch: Branch):
+        self.branch = branch
+
+    def observe(self, cb) -> callable:
+        self.branch.observers.append(cb)
+        return lambda: self.branch.observers.remove(cb)
+
+    def observe_deep(self, cb) -> callable:
+        self.branch.deep_observers.append(cb)
+        return lambda: self.branch.deep_observers.remove(cb)
+
+    def is_deleted(self) -> bool:
+        return self.branch.is_deleted()
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, SharedType):
+            return self.branch is other.branch
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return id(self.branch)
+
+
+class Prelim:
+    """A value that materializes into a nested shared type on insertion."""
+
+    type_ref: int = -1
+
+    def make_branch(self) -> Branch:
+        return Branch(self.type_ref)
+
+    def fill(self, txn: Transaction, branch: Branch) -> None:
+        """Populate the freshly integrated branch with initial content."""
+
+
+class TextPrelim(Prelim):
+    type_ref = TYPE_TEXT
+
+    def __init__(self, text: str = ""):
+        self.text = text
+
+    def fill(self, txn: Transaction, branch: Branch) -> None:
+        if self.text:
+            from .text import Text
+
+            Text(branch).insert(txn, 0, self.text)
+
+
+class ArrayPrelim(Prelim):
+    type_ref = TYPE_ARRAY
+
+    def __init__(self, items: Optional[List[PyAny]] = None):
+        self.items = list(items) if items else []
+
+    def fill(self, txn: Transaction, branch: Branch) -> None:
+        if self.items:
+            from .array import Array
+
+            Array(branch).insert_range(txn, 0, self.items)
+
+
+class MapPrelim(Prelim):
+    type_ref = TYPE_MAP
+
+    def __init__(self, entries: Optional[dict] = None):
+        self.entries = dict(entries) if entries else {}
+
+    def fill(self, txn: Transaction, branch: Branch) -> None:
+        if self.entries:
+            from .map import Map
+
+            m = Map(branch)
+            for key, value in self.entries.items():
+                m.insert(txn, key, value)
+
+
+class XmlTextPrelim(TextPrelim):
+    type_ref = TYPE_XML_TEXT
+
+
+class XmlElementPrelim(Prelim):
+    type_ref = TYPE_XML_ELEMENT
+
+    def __init__(self, tag: str, attributes: Optional[dict] = None, children=()):
+        self.tag = tag
+        self.attributes = dict(attributes) if attributes else {}
+        self.children = list(children)
+
+    def make_branch(self) -> Branch:
+        return Branch(self.type_ref, type_name=self.tag)
+
+    def fill(self, txn: Transaction, branch: Branch) -> None:
+        from .xml import XmlElement
+
+        el = XmlElement(branch)
+        for key, value in self.attributes.items():
+            el.insert_attribute(txn, key, value)
+        if self.children:
+            el.insert_range(txn, 0, self.children)
+
+
+def to_content(value: PyAny) -> Tuple[Content, Optional[Prelim]]:
+    """Convert a user value into item content (parity: Prelim::into_content)."""
+    if isinstance(value, Prelim):
+        branch = value.make_branch()
+        return ContentType(branch), value
+    if isinstance(value, SharedType):
+        raise TypeError("cannot re-insert an already integrated shared type")
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return ContentBinary(bytes(value)), None
+    from ytpu.core.doc import Doc
+
+    if isinstance(value, Doc):
+        return ContentDoc(value), None
+    return ContentAny([value]), None
+
+
+def out_value(item: Item, index: int = -1) -> PyAny:
+    """User-facing value of one element of an item (parity: block.rs:1650-1706)."""
+    content = item.content
+    if isinstance(content, ContentType):
+        from . import wrap_branch
+
+        return wrap_branch(content.branch)
+    if isinstance(content, ContentDoc):
+        return content.doc
+    vals = content.values()
+    if not vals:
+        return None
+    return vals[index]
+
+
+def find_position(
+    branch: Branch,
+    txn: Transaction,
+    index: int,
+    track_attrs: bool = False,
+) -> Optional[ItemPosition]:
+    """Walk the sequence to the `index`-th visible element, splitting blocks
+    as needed. Parity: types/text.rs:734 (linear scan; device path uses a
+    prefix-sum lookup instead)."""
+    left: Optional[Item] = None
+    right: Optional[Item] = branch.start
+    attrs = {} if track_attrs else None
+    remaining = index
+    store = txn.store
+    while right is not None and remaining > 0:
+        if not right.deleted:
+            if right.countable:
+                if remaining < right.len:
+                    store.blocks.split_at(right, remaining)
+                remaining -= right.len
+            elif attrs is not None and isinstance(right.content, ContentFormat):
+                if right.content.value is None:
+                    attrs.pop(right.content.key, None)
+                else:
+                    attrs[right.content.key] = right.content.value
+        left = right
+        right = right.right
+    if remaining > 0:
+        return None  # index out of bounds
+    return ItemPosition(branch, left, right, index, attrs)

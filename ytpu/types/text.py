@@ -1,0 +1,219 @@
+"""Text — collaborative rich text.
+
+Behavioral parity target: /root/reference/yrs/src/types/text.rs (`Text` trait
+:158 — insert :212, insert_with_attributes :275, format :353-452,
+remove_range, push; `find_position` :734; diff :534).
+
+Indices are measured in UTF-16 code units (the Yjs clock unit) — the same
+unit the batched device engine uses for its prefix-sum position lookups.
+"""
+
+from __future__ import annotations
+
+from typing import Any as PyAny, Dict, List, Optional
+
+from ytpu.core.block import Item
+from ytpu.core.branch import TYPE_TEXT
+from ytpu.core.content import (
+    ContentEmbed,
+    ContentFormat,
+    ContentString,
+    ContentType,
+)
+from ytpu.core.transaction import ItemPosition, Transaction
+
+from .shared import SharedType, find_position, to_content
+
+__all__ = ["Text", "Diff"]
+
+
+class Diff:
+    """One run of a text diff: a value plus its formatting attributes."""
+
+    __slots__ = ("insert", "attributes")
+
+    def __init__(self, insert: PyAny, attributes: Optional[Dict[str, PyAny]] = None):
+        self.insert = insert
+        self.attributes = attributes
+
+    def __eq__(self, other):
+        if not isinstance(other, Diff):
+            return NotImplemented
+        return self.insert == other.insert and (self.attributes or None) == (
+            other.attributes or None
+        )
+
+    def __repr__(self):
+        if self.attributes:
+            return f"Diff({self.insert!r}, {self.attributes!r})"
+        return f"Diff({self.insert!r})"
+
+
+class Text(SharedType):
+    type_ref = TYPE_TEXT
+    __slots__ = ()
+
+    def __len__(self) -> int:
+        return self.branch.content_len
+
+    # --- reads -----------------------------------------------------------------
+
+    def get_string(self) -> str:
+        """Concatenation of all alive string chunks (parity: GetString)."""
+        out: List[str] = []
+        item = self.branch.start
+        while item is not None:
+            if not item.deleted and isinstance(item.content, ContentString):
+                out.append(item.content.text)
+            item = item.right
+        return "".join(out)
+
+    def diff(self) -> List[Diff]:
+        """Current content as runs annotated with formatting attributes."""
+        runs: List[Diff] = []
+        attrs: Dict[str, PyAny] = {}
+        item = self.branch.start
+        buf: List[str] = []
+
+        def flush():
+            if buf:
+                runs.append(Diff("".join(buf), dict(attrs) if attrs else None))
+                buf.clear()
+
+        while item is not None:
+            if not item.deleted:
+                content = item.content
+                if isinstance(content, ContentString):
+                    buf.append(content.text)
+                elif isinstance(content, ContentFormat):
+                    flush()
+                    if content.value is None:
+                        attrs.pop(content.key, None)
+                    else:
+                        attrs[content.key] = content.value
+                elif isinstance(content, (ContentEmbed, ContentType)):
+                    flush()
+                    from .shared import out_value
+
+                    runs.append(Diff(out_value(item), dict(attrs) if attrs else None))
+            item = item.right
+        flush()
+        return runs
+
+    def to_json(self) -> str:
+        return self.get_string()
+
+    # --- writes ----------------------------------------------------------------
+
+    def insert(self, txn: Transaction, index: int, chunk: str) -> None:
+        """Parity: types/text.rs:212."""
+        if not chunk:
+            return
+        pos = self._pos(txn, index)
+        txn.create_item(pos, ContentString(chunk), None)
+
+    def insert_embed(self, txn: Transaction, index: int, value: PyAny) -> None:
+        pos = self._pos(txn, index)
+        if hasattr(value, "make_branch"):
+            content, prelim = to_content(value)
+            item = txn.create_item(pos, content, None)
+            prelim.fill(txn, item.content.branch)
+        else:
+            txn.create_item(pos, ContentEmbed(value), None)
+
+    def insert_with_attributes(
+        self, txn: Transaction, index: int, chunk: str, attrs: Dict[str, PyAny]
+    ) -> None:
+        """Parity: types/text.rs:275 — wraps the inserted chunk in format marks."""
+        if not chunk:
+            return
+        pos = find_position(self.branch, txn, index, track_attrs=True)
+        if pos is None:
+            raise IndexError(index)
+        current = pos.current_attrs or {}
+        # only emit marks that actually change the surrounding formatting
+        changed = {k: v for k, v in attrs.items() if current.get(k) != v}
+        reset = {k: None for k in current if k not in attrs}
+        opens = {**changed}
+        for key, value in opens.items():
+            item = txn.create_item(pos, ContentFormat(key, value), None)
+            pos.left = item
+        inserted = txn.create_item(pos, ContentString(chunk), None)
+        pos.left = inserted
+        # close marks so the following text keeps its old formatting
+        for key in opens:
+            old = current.get(key)
+            item = txn.create_item(pos, ContentFormat(key, old), None)
+            pos.left = item
+        del reset  # negations beyond the insert range are format()'s job
+
+    def format(
+        self, txn: Transaction, index: int, length: int, attrs: Dict[str, PyAny]
+    ) -> None:
+        """Apply formatting over an existing range (parity: types/text.rs:353-452)."""
+        if length == 0 or not attrs:
+            return
+        pos = find_position(self.branch, txn, index, track_attrs=True)
+        if pos is None:
+            raise IndexError(index)
+        current = dict(pos.current_attrs or {})
+        pending = {k: v for k, v in attrs.items() if current.get(k) != v}
+        for key, value in pending.items():
+            item = txn.create_item(pos, ContentFormat(key, value), None)
+            pos.left = item
+        # walk `length` visible units, dropping redundant marks
+        remaining = length
+        right = pos.left.right if pos.left is not None else pos.right
+        store = txn.store
+        while right is not None and remaining > 0:
+            if not right.deleted:
+                content = right.content
+                if isinstance(content, ContentFormat):
+                    key = content.key
+                    if key in pending:
+                        # an old mark inside the range would override ours
+                        txn.delete(right)
+                elif right.countable:
+                    if remaining < right.len:
+                        store.blocks.split_at(right, remaining)
+                    remaining -= right.len
+            pos.left = right
+            right = right.right
+        # close the range: restore previous values
+        for key, value in pending.items():
+            old = current.get(key)
+            item = txn.create_item(
+                ItemPosition(self.branch, pos.left, right, 0, None),
+                ContentFormat(key, old),
+                None,
+            )
+            pos.left = item
+
+    def push(self, txn: Transaction, chunk: str) -> None:
+        self.insert(txn, len(self), chunk)
+
+    def remove_range(self, txn: Transaction, index: int, length: int) -> None:
+        """Parity: types/text.rs remove_range."""
+        if length == 0:
+            return
+        pos = self._pos(txn, index)
+        remaining = length
+        right = pos.right
+        store = txn.store
+        while right is not None and remaining > 0:
+            if not right.deleted and right.countable:
+                if remaining < right.len:
+                    store.blocks.split_at(right, remaining)
+                remaining -= min(remaining, right.len)
+                txn.delete(right)
+            right = right.right
+        if remaining > 0:
+            raise IndexError(f"remove_range past end of text ({remaining} left)")
+
+    # --- helpers ---------------------------------------------------------------
+
+    def _pos(self, txn: Transaction, index: int) -> ItemPosition:
+        pos = find_position(self.branch, txn, index)
+        if pos is None:
+            raise IndexError(index)
+        return pos

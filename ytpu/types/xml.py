@@ -1,0 +1,136 @@
+"""XML shared types: XmlFragment / XmlElement / XmlText.
+
+Behavioral parity target: /root/reference/yrs/src/types/xml.rs
+(XmlElementRef :237, XmlTextRef :520, XmlFragmentRef :778, attribute trait
+:976, tree trait :1034). XML nodes reuse the sequence kernel (children) and
+the map kernel (attributes) over the same `Branch` — both components active.
+"""
+
+from __future__ import annotations
+
+from typing import Any as PyAny, Iterator, List, Optional
+
+from ytpu.core.branch import (
+    TYPE_XML_ELEMENT,
+    TYPE_XML_FRAGMENT,
+    TYPE_XML_TEXT,
+)
+from ytpu.core.content import ContentFormat, ContentString
+from ytpu.core.transaction import ItemPosition, Transaction
+
+from .array import Array
+from .map import Map
+from .shared import SharedType, out_value, to_content
+from .text import Text
+
+__all__ = ["XmlFragment", "XmlElement", "XmlText"]
+
+
+class _XmlAttrs:
+    """Attribute component shared by XmlElement / XmlText."""
+
+    def insert_attribute(self, txn: Transaction, name: str, value: str) -> None:
+        Map(self.branch).insert(txn, name, str(value))
+
+    def get_attribute(self, name: str) -> Optional[str]:
+        return Map(self.branch).get(name)
+
+    def remove_attribute(self, txn: Transaction, name: str) -> None:
+        Map(self.branch).remove(txn, name)
+
+    def attributes(self) -> Iterator:
+        return Map(self.branch).items()
+
+
+class _XmlChildren:
+    """Child-sequence component shared by XmlFragment / XmlElement."""
+
+    def __len__(self) -> int:
+        return self.branch.content_len
+
+    def insert(self, txn: Transaction, index: int, value) -> None:
+        Array(self.branch).insert(txn, index, value)
+
+    def insert_range(self, txn: Transaction, index: int, values: List[PyAny]) -> None:
+        Array(self.branch).insert_range(txn, index, values)
+
+    def push_back(self, txn: Transaction, value) -> None:
+        Array(self.branch).push_back(txn, value)
+
+    def remove_range(self, txn: Transaction, index: int, length: int) -> None:
+        Array(self.branch).remove_range(txn, index, length)
+
+    def get(self, index: int):
+        return Array(self.branch).get(index)
+
+    def children(self) -> Iterator:
+        return iter(Array(self.branch))
+
+    def children_str(self) -> str:
+        out = []
+        for child in self.children():
+            if isinstance(child, SharedType):
+                out.append(child.get_string())
+            else:
+                out.append(str(child))
+        return "".join(out)
+
+
+class XmlFragment(_XmlChildren, SharedType):
+    type_ref = TYPE_XML_FRAGMENT
+    __slots__ = ()
+
+    def get_string(self) -> str:
+        return self.children_str()
+
+    def to_json(self) -> str:
+        return self.get_string()
+
+
+class XmlElement(_XmlChildren, _XmlAttrs, SharedType):
+    type_ref = TYPE_XML_ELEMENT
+    __slots__ = ()
+
+    @property
+    def tag(self) -> str:
+        return self.branch.type_name or "UNDEFINED"
+
+    def get_string(self) -> str:
+        attrs = "".join(f' {k}="{v}"' for k, v in sorted(self.attributes()))
+        inner = self.children_str()
+        return f"<{self.tag}{attrs}>{inner}</{self.tag}>"
+
+    def to_json(self) -> str:
+        return self.get_string()
+
+
+class XmlText(_XmlAttrs, Text):
+    type_ref = TYPE_XML_TEXT
+    __slots__ = ()
+
+    def get_string(self) -> str:
+        """Render with embedded formatting as XML-ish tags (reference:
+        types/xml.rs XmlTextRef::get_string)."""
+        out: List[str] = []
+        open_tags: List[str] = []
+        item = self.branch.start
+        while item is not None:
+            if not item.deleted:
+                content = item.content
+                if isinstance(content, ContentString):
+                    out.append(content.text)
+                elif isinstance(content, ContentFormat):
+                    if content.value is None:
+                        if content.key in open_tags:
+                            open_tags.remove(content.key)
+                            out.append(f"</{content.key}>")
+                    else:
+                        open_tags.append(content.key)
+                        out.append(f"<{content.key}>")
+            item = item.right
+        for tag in reversed(open_tags):
+            out.append(f"</{tag}>")
+        return "".join(out)
+
+    def to_json(self) -> str:
+        return self.get_string()
