@@ -1,0 +1,86 @@
+"""Replay of real Yjs-generated datasets and editing traces.
+
+- small-test-dataset.bin: sequences of Yjs updates + expected text/map/array
+  state after each run (format per reference compatibility_tests.rs:437-476).
+- sequential editing traces: (pos, del, ins) patch streams replayed through
+  Text (format per reference tests/edit_traces.rs:16-36, tests at
+  edit_traces_tests.rs:1-60).
+
+These read the reference's asset files directly (read-only test data);
+they skip when the assets are not present.
+"""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from ytpu.core import Doc, Update
+from ytpu.encoding.lib0 import Cursor, read_any
+
+ASSETS = "/root/reference/assets"
+
+requires_assets = pytest.mark.skipif(
+    not os.path.isdir(ASSETS), reason="reference assets not available"
+)
+
+
+@requires_assets
+def test_small_data_set():
+    with open(f"{ASSETS}/bench-input/small-test-dataset.bin", "rb") as f:
+        cur = Cursor(f.read())
+    test_count = cur.read_var_uint()
+    for test_num in range(test_count):
+        updates_len = cur.read_var_uint()
+        doc = Doc(client_id=0xFFFF)
+        txt = doc.get_text("text")
+        m = doc.get_map("map")
+        arr = doc.get_array("array")
+        for _ in range(updates_len):
+            payload = cur.read_buf()
+            doc.apply_update_v1(payload)
+        expected_text = cur.read_string()
+        assert txt.get_string() == expected_text, f"text mismatch in run {test_num}"
+        expected_map = read_any(cur)
+        assert m.to_json() == expected_map, f"map mismatch in run {test_num}"
+        expected_arr = read_any(cur)
+        assert arr.to_json() == expected_arr, f"array mismatch in run {test_num}"
+
+
+def _replay_trace(name: str, limit: int = None):
+    path = f"{ASSETS}/editing-traces/sequential_traces/{name}.json.gz"
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    doc = Doc(client_id=1)
+    txt = doc.get_text("text")
+    txns = data["txns"]
+    if limit is not None:
+        txns = txns[:limit]
+    for txn_data in txns:
+        with doc.transact() as txn:
+            for pos, del_len, ins in txn_data["patches"]:
+                if del_len:
+                    txt.remove_range(txn, pos, del_len)
+                if ins:
+                    txt.insert(txn, pos, ins)
+    return doc, txt, data
+
+
+@requires_assets
+def test_trace_friendsforever_prefix():
+    # full final-content check only when replaying the entire trace; here we
+    # replay a prefix for test-suite speed and assert consistency invariants
+    doc, txt, data = _replay_trace("friendsforever_flat", limit=2000)
+    s = txt.get_string()
+    assert len(txt) == len(s)  # ascii trace: utf16 == python len
+    # re-encode + re-apply must reproduce the same state
+    clone = Doc(client_id=2)
+    clone.apply_update_v1(doc.encode_state_as_update_v1())
+    assert clone.get_text("text").get_string() == s
+
+
+@requires_assets
+def test_trace_sveltecomponent_full():
+    doc, txt, data = _replay_trace("sveltecomponent")
+    assert txt.get_string() == data["endContent"]
