@@ -1,0 +1,131 @@
+"""ytpu benchmark: batched multi-tenant update integration throughput.
+
+Workload (north-star config #2 shape, BASELINE.md): a deterministic synthetic
+editing trace (random-position inserts/deletes, B4-like op mix) is recorded
+as Yjs-wire updates once, then:
+
+- baseline: the host oracle (ytpu.core, single doc) replays the update
+  stream — the reference-shaped sequential `apply_update` path.
+- device: `apply_update_batch` replays the same stream on a D-doc batch
+  (each doc slot a tenant), one jitted step per update.
+
+Metric: updates integrated per second across the batch.
+`vs_baseline` = device rate / host-oracle single-doc rate (measured here, on
+this machine — the reference publishes no absolute numbers, BASELINE.md §1).
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import string
+import time
+
+N_DOCS = 512
+N_UPDATES = 240
+CAPACITY = 4096
+ROWS_PER_STEP = 4
+DELS_PER_STEP = 8
+
+
+def build_trace(seed: int = 7):
+    from ytpu.core import Doc
+
+    rng = random.Random(seed)
+    doc = Doc(client_id=1)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    txt = doc.get_text("text")
+    for _ in range(N_UPDATES):
+        with doc.transact() as txn:
+            n = len(txt)
+            if n > 20 and rng.random() < 0.25:
+                pos = rng.randint(0, n - 6)
+                txt.remove_range(txn, pos, rng.randint(1, 5))
+            else:
+                word = "".join(
+                    rng.choice(string.ascii_lowercase) for _ in range(rng.randint(3, 9))
+                )
+                txt.insert(txn, rng.randint(0, n), word)
+    return log, txt.get_string()
+
+
+def host_replay(log):
+    from ytpu.core import Doc
+
+    doc = Doc(client_id=99)
+    t0 = time.perf_counter()
+    for payload in log:
+        doc.apply_update_v1(payload)
+    dt = time.perf_counter() - t0
+    return dt, doc.get_text("text").get_string()
+
+
+def device_replay(log, expect: str):
+    import jax
+
+    from ytpu.core import Update
+    from ytpu.models.batch_doc import (
+        BatchEncoder,
+        apply_update_batch,
+        get_string,
+        init_state,
+    )
+
+    enc = BatchEncoder()
+    updates = [Update.decode_v1(p) for p in log]
+    batches = [
+        enc.build_batch([u] * N_DOCS, n_rows=ROWS_PER_STEP, n_dels=DELS_PER_STEP)
+        for u in updates
+    ]
+    rank = enc.interner.rank_table()
+
+    # warmup / compile
+    state = init_state(N_DOCS, CAPACITY)
+    state = apply_update_batch(state, batches[0], rank)
+    jax.block_until_ready(state)
+
+    # timed replay
+    state = init_state(N_DOCS, CAPACITY)
+    t0 = time.perf_counter()
+    for batch in batches:
+        state = apply_update_batch(state, batch, rank)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    err = int(jax.numpy.max(state.error))
+    if err != 0:
+        raise RuntimeError(f"device error flag {err}")
+    got = get_string(state, 0, enc.payloads)
+    if got != expect:
+        raise RuntimeError(f"device text mismatch: {got[:50]!r} != {expect[:50]!r}")
+    got_last = get_string(state, N_DOCS - 1, enc.payloads)
+    if got_last != expect:
+        raise RuntimeError("device text mismatch in last doc slot")
+    return dt
+
+
+def main():
+    log, expect = build_trace()
+    host_dt, host_text = host_replay(log)
+    assert host_text == expect
+    device_dt = device_replay(log, expect)
+
+    host_rate = len(log) / host_dt  # updates/sec, single doc
+    device_rate = len(log) * N_DOCS / device_dt  # updates/sec across batch
+    print(
+        json.dumps(
+            {
+                "metric": "updates_integrated_per_sec_batched",
+                "value": round(device_rate, 1),
+                "unit": f"updates/s over {N_DOCS}-doc batch",
+                "vs_baseline": round(device_rate / host_rate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

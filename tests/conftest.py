@@ -1,8 +1,14 @@
-"""Test configuration: force an 8-device virtual CPU mesh before JAX loads."""
+"""Test configuration: force an 8-device virtual CPU mesh before JAX loads.
+
+The tests exercise multi-chip sharding on a virtual CPU mesh
+(xla_force_host_platform_device_count) — the real-TPU path is covered by
+bench.py and the driver's compile checks.
+"""
 
 import os
+import sys
 
-# Must be set before `import jax` anywhere in the test session.
+# Must be set before the JAX backend initializes.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -10,6 +16,13 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import sys
+import jax
+
+# Some environments inject an accelerator platform ahead of the env var
+# (e.g. a tunneled TPU plugin); pin to cpu explicitly for the test session.
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,207 @@
+"""Device-engine parity: apply_update_batch vs the host oracle.
+
+Every scenario builds update streams with host docs, then applies the same
+stream to (a) a fresh host doc and (b) the batched device engine, and
+compares the visible text. This is the semantic-diff harness from
+SURVEY.md §7 step 2/3.
+"""
+
+import random
+import string
+
+import numpy as np
+import pytest
+
+from ytpu.core import Doc, Update
+from ytpu.models.batch_doc import (
+    BatchEncoder,
+    apply_update_batch,
+    get_string,
+    init_state,
+    state_vectors,
+)
+
+
+def capture_updates(doc: Doc):
+    log = []
+    doc.observe_update_v1(lambda payload, origin, txn: log.append(payload))
+    return log
+
+
+def device_replay(update_stream, n_docs=1, capacity=256):
+    """Apply a list of update payloads sequentially to every doc slot."""
+    enc = BatchEncoder()
+    state = init_state(n_docs, capacity)
+    for payload in update_stream:
+        u = Update.decode_v1(payload)
+        batch = enc.build_batch([u] * n_docs)
+        state = apply_update_batch(state, batch, enc.interner.rank_table())
+    return state, enc
+
+
+def host_replay(update_stream) -> Doc:
+    doc = Doc(client_id=0xDEAD)
+    for payload in update_stream:
+        doc.apply_update_v1(payload)
+    return doc
+
+
+def assert_parity(update_stream, root="t", capacity=256):
+    host = host_replay(update_stream)
+    state, enc = device_replay(update_stream, capacity=capacity)
+    assert int(state.error[0]) == 0, f"device error flag {int(state.error[0])}"
+    expect = host.get_text(root).get_string()
+    got = get_string(state, 0, enc.payloads)
+    assert got == expect, f"device {got!r} != host {expect!r}"
+    # pending must be empty on the host for a fair comparison
+    assert host.store.pending is None
+    return host, state, enc
+
+
+def test_single_doc_appends():
+    d = Doc(client_id=1)
+    log = capture_updates(d)
+    t = d.get_text("t")
+    for i in range(5):
+        with d.transact() as txn:
+            t.insert(txn, len(t), f"chunk{i} ")
+    assert_parity(log)
+
+
+def test_single_doc_random_inserts_deletes():
+    rng = random.Random(3)
+    d = Doc(client_id=1)
+    log = capture_updates(d)
+    t = d.get_text("t")
+    for _ in range(40):
+        with d.transact() as txn:
+            n = len(t)
+            if n > 4 and rng.random() < 0.3:
+                pos = rng.randint(0, n - 3)
+                t.remove_range(txn, pos, rng.randint(1, 3))
+            else:
+                pos = rng.randint(0, n)
+                t.insert(txn, pos, rng.choice(["ab", "xyz", "q", "hello"]))
+    assert_parity(log)
+
+
+def test_two_peer_concurrent_conflicts():
+    a, b = Doc(client_id=1), Doc(client_id=2)
+    la, lb = capture_updates(a), capture_updates(b)
+    ta, tb = a.get_text("t"), b.get_text("t")
+    # concurrent inserts at the same (empty) position — pure YATA conflict
+    with a.transact() as txn:
+        ta.insert(txn, 0, "AAA")
+    with b.transact() as txn:
+        tb.insert(txn, 0, "BBB")
+    # interleave the two independent streams both ways
+    for stream in ([la[0], lb[0]], [lb[0], la[0]]):
+        assert_parity(stream)
+
+
+def test_multi_round_concurrency():
+    rng = random.Random(11)
+    peers = [Doc(client_id=i + 1) for i in range(3)]
+    logs = [capture_updates(p) for p in peers]
+    texts = [p.get_text("t") for p in peers]
+    rounds = []
+    for rnd in range(4):
+        marks = [len(lg) for lg in logs]
+        for p, t in zip(peers, texts):
+            for _ in range(rng.randint(1, 3)):
+                with p.transact() as txn:
+                    n = len(t)
+                    if n > 3 and rng.random() < 0.35:
+                        pos = rng.randint(0, n - 2)
+                        t.remove_range(txn, pos, rng.randint(1, 2))
+                    else:
+                        t.insert(
+                            txn,
+                            rng.randint(0, n),
+                            "".join(rng.choice(string.ascii_lowercase) for _ in range(3)),
+                        )
+        # updates captured this round, one bucket per peer
+        round_updates = [lg[m:] for lg, m in zip(logs, marks)]
+        rounds.append(round_updates)
+        # full exchange ends the round
+        from ytpu.testing import exchange_updates
+
+        exchange_updates(peers)
+
+    # causal stream: roundwise, random peer interleaving (per-peer order kept)
+    stream = []
+    for round_updates in rounds:
+        buckets = [list(b) for b in round_updates]
+        while any(buckets):
+            choices = [i for i, b in enumerate(buckets) if b]
+            pick = rng.choice(choices)
+            stream.append(buckets[pick].pop(0))
+    host, state, enc = assert_parity(stream, capacity=1024)
+    # all peers converged to the same string as the replays
+    assert host.get_text("t").get_string() == texts[0].get_string()
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_fuzz_two_peer_parity(seed):
+    rng = random.Random(seed + 1000)
+    a, b = Doc(client_id=7), Doc(client_id=9)
+    la, lb = capture_updates(a), capture_updates(b)
+    ta, tb = a.get_text("t"), b.get_text("t")
+    rounds = []
+    from ytpu.testing import exchange_updates
+
+    for rnd in range(3):
+        ma, mb = len(la), len(lb)
+        for doc, t in ((a, ta), (b, tb)):
+            for _ in range(rng.randint(1, 4)):
+                with doc.transact() as txn:
+                    n = len(t)
+                    roll = rng.random()
+                    if n > 2 and roll < 0.3:
+                        pos = rng.randint(0, n - 1)
+                        t.remove_range(txn, pos, min(rng.randint(1, 4), n - pos))
+                    else:
+                        t.insert(txn, rng.randint(0, n), rng.choice(["zz", "q", "lmnop"]))
+        rounds.append([la[ma:], lb[mb:]])
+        exchange_updates([a, b])
+
+    stream = []
+    for buckets in rounds:
+        buckets = [list(x) for x in buckets]
+        while any(buckets):
+            pick = rng.choice([i for i, x in enumerate(buckets) if x])
+            stream.append(buckets[pick].pop(0))
+    assert_parity(stream, capacity=1024)
+
+
+def test_batched_docs_independent_streams():
+    """Different docs in one batch receive different updates."""
+    docs = [Doc(client_id=i + 1) for i in range(4)]
+    logs = [capture_updates(d) for d in docs]
+    for i, d in enumerate(docs):
+        t = d.get_text("t")
+        with d.transact() as txn:
+            t.insert(txn, 0, f"doc-{i}-")
+        with d.transact() as txn:
+            t.insert(txn, len(t), "tail")
+    enc = BatchEncoder()
+    state = init_state(4, 64)
+    for step in range(2):
+        updates = [Update.decode_v1(logs[d][step]) for d in range(4)]
+        batch = enc.build_batch(updates)
+        state = apply_update_batch(state, batch, enc.interner.rank_table())
+    assert np.all(np.asarray(state.error) == 0)
+    for i in range(4):
+        assert get_string(state, i, enc.payloads) == f"doc-{i}-tail"
+
+
+def test_state_vectors_device():
+    d = Doc(client_id=5)
+    log = capture_updates(d)
+    t = d.get_text("t")
+    with d.transact() as txn:
+        t.insert(txn, 0, "hello")
+    state, enc = device_replay(log)
+    sv = np.asarray(state_vectors(state, max(1, len(enc.interner))))
+    client_idx = enc.interner.to_idx[5]
+    assert sv[0, client_idx] == 5

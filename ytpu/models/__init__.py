@@ -1,0 +1,33 @@
+"""Batched document engines (the framework's "model zoo" equivalent).
+
+The flagship is `batch_doc`: N CRDT documents as one struct-of-arrays pytree
+with `apply_update_batch` / `encode_diff_batch` as jitted programs.
+"""
+
+from .batch_doc import (
+    BatchEncoder,
+    BlockCols,
+    ClientInterner,
+    DocStateBatch,
+    PayloadStore,
+    UpdateBatch,
+    apply_update_batch,
+    get_string,
+    get_values,
+    init_state,
+    state_vectors,
+)
+
+__all__ = [
+    "BatchEncoder",
+    "BlockCols",
+    "ClientInterner",
+    "DocStateBatch",
+    "PayloadStore",
+    "UpdateBatch",
+    "apply_update_batch",
+    "get_string",
+    "get_values",
+    "init_state",
+    "state_vectors",
+]

@@ -1,0 +1,716 @@
+"""batch_doc — the flagship batched CRDT engine: N documents as one pytree.
+
+This is the TPU-native re-design of the reference's per-doc mutable store
+(/root/reference/yrs/src/block_store.rs, block.rs:482-769, update.rs:169-308):
+
+- Document state is a struct-of-arrays block tensor per doc, vmapped over a
+  doc axis (the DP axis of the mesh). Every Item field is a column
+  (SURVEY.md §7's layout); splits append rows instead of mutating a pointer
+  graph; the sequence is a pair of left/right i32 index columns.
+- `apply_update_batch(state, batch)` integrates one decoded update per doc
+  per step under `jit`: per doc a `lax.fori_loop` over incoming rows, each
+  row resolving its origins with vectorized (client, clock) interval lookups,
+  running the YATA conflict scan as a `lax.while_loop` (set membership = B-bit
+  boolean masks), and linking in with O(1) scatters. Delete ranges apply as
+  two guarded splits + a vectorized range mask.
+- Clients are interned to dense i32 on host (SURVEY §2 #8); string/Any
+  payloads stay in host side-buffers addressed by (content_ref, offset, len)
+  columns — the device never touches variable-length data.
+
+Round-1 device scope: the root sequence component (YText/YArray flagship
+configs). Map/XML branch tables ride the host oracle until the multi-branch
+device engine lands; semantic parity is enforced against `ytpu.core` in
+tests/test_batch_parity.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ytpu.core import Doc, Update
+from ytpu.core.block import GCRange, Item, SkipRange
+from ytpu.core.content import (
+    BLOCK_GC,
+    CONTENT_ANY,
+    CONTENT_DELETED,
+    CONTENT_FORMAT,
+    CONTENT_STRING,
+)
+
+__all__ = [
+    "BlockCols",
+    "DocStateBatch",
+    "UpdateBatch",
+    "init_state",
+    "apply_update_batch",
+    "ClientInterner",
+    "PayloadStore",
+    "BatchEncoder",
+    "get_string",
+    "state_vectors",
+]
+
+I32 = jnp.int32
+
+
+class BlockCols(NamedTuple):
+    """Columnar Item schema (reference fields: block.rs:1088-1133)."""
+
+    client: jax.Array  # [*, B] i32 interned client (-1 = unused slot)
+    clock: jax.Array  # [*, B] i32
+    length: jax.Array  # [*, B] i32
+    origin_client: jax.Array  # [*, B] i32 (-1 = none)
+    origin_clock: jax.Array  # [*, B] i32
+    ror_client: jax.Array  # [*, B] i32 right-origin (-1 = none)
+    ror_clock: jax.Array  # [*, B] i32
+    left: jax.Array  # [*, B] i32 sequence link (-1 = head)
+    right: jax.Array  # [*, B] i32 sequence link (-1 = tail)
+    deleted: jax.Array  # [*, B] bool
+    countable: jax.Array  # [*, B] bool
+    kind: jax.Array  # [*, B] i32 content kind
+    content_ref: jax.Array  # [*, B] i32 host payload id
+    content_off: jax.Array  # [*, B] i32 offset into payload (clock units)
+
+
+class DocStateBatch(NamedTuple):
+    blocks: BlockCols
+    start: jax.Array  # [*] i32 head of the root sequence (-1 empty)
+    n_blocks: jax.Array  # [*] i32
+    error: jax.Array  # [*] i32 sticky error flags (0 = healthy)
+
+
+class UpdateBatch(NamedTuple):
+    """One decoded update per doc, padded to U rows / R delete ranges."""
+
+    client: jax.Array  # [*, U] i32
+    clock: jax.Array  # [*, U] i32
+    length: jax.Array  # [*, U] i32
+    origin_client: jax.Array  # [*, U] i32 (-1 none)
+    origin_clock: jax.Array  # [*, U] i32
+    ror_client: jax.Array  # [*, U] i32 (-1 none)
+    ror_clock: jax.Array  # [*, U] i32
+    kind: jax.Array  # [*, U] i32 (BLOCK_GC for GC carriers)
+    content_ref: jax.Array  # [*, U] i32
+    content_off: jax.Array  # [*, U] i32
+    valid: jax.Array  # [*, U] bool
+    del_client: jax.Array  # [*, R] i32
+    del_start: jax.Array  # [*, R] i32
+    del_end: jax.Array  # [*, R] i32
+    del_valid: jax.Array  # [*, R] bool
+
+
+ERR_CAPACITY = 1
+ERR_MISSING_DEP = 2
+
+
+def init_state(n_docs: int, capacity: int) -> DocStateBatch:
+    """Allocate an empty batch of docs with `capacity` block slots each."""
+
+    def full(shape, v, dtype=I32):
+        return jnp.full(shape, v, dtype=dtype)
+
+    shape = (n_docs, capacity)
+    blocks = BlockCols(
+        client=full(shape, -1),
+        clock=full(shape, 0),
+        length=full(shape, 0),
+        origin_client=full(shape, -1),
+        origin_clock=full(shape, 0),
+        ror_client=full(shape, -1),
+        ror_clock=full(shape, 0),
+        left=full(shape, -1),
+        right=full(shape, -1),
+        deleted=jnp.zeros(shape, bool),
+        countable=jnp.zeros(shape, bool),
+        kind=full(shape, 0),
+        content_ref=full(shape, -1),
+        content_off=full(shape, 0),
+    )
+    return DocStateBatch(
+        blocks=blocks,
+        start=full((n_docs,), -1),
+        n_blocks=full((n_docs,), 0),
+        error=full((n_docs,), 0),
+    )
+
+
+# --- per-doc primitives (vmapped over the doc axis) ---------------------------
+
+
+def _capacity(bl: BlockCols) -> int:
+    return bl.client.shape[-1]
+
+
+def _find_slot(bl: BlockCols, n: jax.Array, client: jax.Array, clock: jax.Array):
+    """Slot whose clock interval covers (client, clock); -1 if absent.
+
+    Device analogue of `find_pivot` (block_store.rs:70-96): an O(B) vector
+    compare instead of a binary search — lanes are cheaper than branches.
+    """
+    B = _capacity(bl)
+    slots = jnp.arange(B, dtype=I32)
+    match = (
+        (slots < n)
+        & (bl.client == client)
+        & (bl.clock <= clock)
+        & (clock < bl.clock + bl.length)
+    )
+    idx = jnp.argmax(match).astype(I32)
+    return jnp.where(jnp.any(match), idx, -1)
+
+
+def _client_clock(bl: BlockCols, n: jax.Array, client: jax.Array) -> jax.Array:
+    """Next expected clock for `client` (state-vector entry), 0 if unseen."""
+    B = _capacity(bl)
+    slots = jnp.arange(B, dtype=I32)
+    mask = (slots < n) & (bl.client == client)
+    return jnp.max(jnp.where(mask, bl.clock + bl.length, 0))
+
+
+def _set(arr: jax.Array, idx: jax.Array, val) -> jax.Array:
+    """Guarded scatter: writes with idx >= B are dropped (inactive writes
+    pass idx = B)."""
+    return arr.at[idx].set(val, mode="drop")
+
+
+def _split(state: DocStateBatch, i: jax.Array, off: jax.Array):
+    """Split block `i` at `off` clock units; returns (state, right_slot).
+
+    Device analogue of `split_block` (block_store.rs:456) — the right half
+    is appended as a fresh row; linkage is patched with three scatters.
+    No-op (returning `i`) unless 0 < off < len(i) and i >= 0.
+    """
+    bl = state.blocks
+    B = _capacity(bl)
+    length_i = jnp.where(i >= 0, bl.length[jnp.maximum(i, 0)], 0)
+    do = (i >= 0) & (off > 0) & (off < length_i)
+    j = state.n_blocks
+    overflow = do & (j >= B)
+    do = do & (j < B)
+    wj = jnp.where(do, j, B)  # write slot for the new row ("B" = dropped)
+    wi = jnp.where(do, i, B)  # write slot for the left half
+    safe_i = jnp.maximum(i, 0)
+    right_i = bl.right[safe_i]
+    w_right = jnp.where(do & (right_i >= 0), right_i, B)
+
+    new_bl = BlockCols(
+        client=_set(bl.client, wj, bl.client[safe_i]),
+        clock=_set(bl.clock, wj, bl.clock[safe_i] + off),
+        length=_set(_set(bl.length, wj, length_i - off), wi, off),
+        origin_client=_set(bl.origin_client, wj, bl.client[safe_i]),
+        origin_clock=_set(bl.origin_clock, wj, bl.clock[safe_i] + off - 1),
+        ror_client=_set(bl.ror_client, wj, bl.ror_client[safe_i]),
+        ror_clock=_set(bl.ror_clock, wj, bl.ror_clock[safe_i]),
+        left=_set(_set(bl.left, wj, i), w_right, j),
+        right=_set(_set(bl.right, wj, right_i), wi, j),
+        deleted=_set(bl.deleted, wj, bl.deleted[safe_i]),
+        countable=_set(bl.countable, wj, bl.countable[safe_i]),
+        kind=_set(bl.kind, wj, bl.kind[safe_i]),
+        content_ref=_set(bl.content_ref, wj, bl.content_ref[safe_i]),
+        content_off=_set(bl.content_off, wj, bl.content_off[safe_i] + off),
+    )
+    state = DocStateBatch(
+        blocks=new_bl,
+        start=state.start,
+        n_blocks=state.n_blocks + do.astype(I32),
+        error=state.error | jnp.where(overflow, ERR_CAPACITY, 0),
+    )
+    return state, jnp.where(do, j, i)
+
+
+def _clean_end(state: DocStateBatch, client: jax.Array, clock: jax.Array):
+    """Slot of the block *ending exactly at* (client, clock), splitting if
+    needed (parity: get_item_clean_end, block_store.rs:402-417)."""
+    i = _find_slot(state.blocks, state.n_blocks, client, clock)
+    off = clock - state.blocks.clock[jnp.maximum(i, 0)] + 1
+    state, _ = _split(state, i, off)  # _split no-ops when off == length
+    return state, i
+
+
+def _clean_start(state: DocStateBatch, client: jax.Array, clock: jax.Array):
+    """Slot of the block *starting exactly at* (client, clock)."""
+    i = _find_slot(state.blocks, state.n_blocks, client, clock)
+    off = clock - state.blocks.clock[jnp.maximum(i, 0)]
+    state, j = _split(state, i, off)
+    return state, jnp.where((i >= 0) & (off > 0), j, i)
+
+
+def _origins_equal(ha, ca, ka, hb, cb, kb):
+    both_none = ~ha & ~hb
+    both_same = ha & hb & (ca == cb) & (ka == kb)
+    return both_none | both_same
+
+
+def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array) -> DocStateBatch:
+    """Integrate one incoming block row (YATA; parity: block.rs:482-769).
+
+    `client_rank[c]` is the rank of interned client c in *real client id*
+    order — the YATA tie-break (block.rs:571-580) is defined on real ids,
+    which interning does not preserve.
+    """
+    (
+        r_client,
+        r_clock,
+        r_len,
+        r_oc,
+        r_ok,
+        r_rc,
+        r_rk,
+        r_kind,
+        r_ref,
+        r_off,
+        r_valid,
+    ) = row
+    bl = state.blocks
+    B = _capacity(bl)
+
+    local = _client_clock(bl, state.n_blocks, r_client)
+    applicable = r_valid & (local >= r_clock)
+    missing = r_valid & ~applicable
+    offset = local - r_clock
+    dup = applicable & (offset >= r_len)
+    do = applicable & ~dup
+
+    # offset adjustment (partial dedup; parity: block.rs:487-501)
+    clock = r_clock + offset
+    length = r_len - offset
+    c_off = r_off + offset
+    has_origin = jnp.where(offset > 0, True, r_oc >= 0)
+    origin_client = jnp.where(offset > 0, r_client, r_oc)
+    origin_clock = jnp.where(offset > 0, clock - 1, r_ok)
+    has_ror = r_rc >= 0
+
+    is_gc = r_kind == BLOCK_GC
+    linkable = do & ~is_gc
+
+    # resolve left/right anchors (repair; parity: block.rs:1287-1300)
+    probe_oc = jnp.where(linkable & has_origin, origin_client, -2)
+    state, left_idx = _clean_end(state, probe_oc, origin_clock)
+    probe_rc = jnp.where(linkable & has_ror, r_rc, -2)
+    state, right_idx = _clean_start(state, probe_rc, r_rk)
+    bl = state.blocks
+
+    # device engine requires resolvable anchors (host stashes pending updates)
+    anchor_missing = (linkable & has_origin & (left_idx < 0)) | (
+        linkable & has_ror & (right_idx < 0)
+    )
+    missing = missing | anchor_missing
+    linkable = linkable & ~anchor_missing
+
+    # --- conflict scan (parity: block.rs:537-602) ---
+    safe = lambda idx: jnp.maximum(idx, 0)
+    right_left = jnp.where(right_idx >= 0, bl.left[safe(right_idx)], -1)
+    need_scan = linkable & (
+        ((left_idx < 0) & ((right_idx < 0) | (right_left >= 0)))
+        | ((left_idx >= 0) & (bl.right[safe(left_idx)] != right_idx))
+    )
+    o0 = jnp.where(
+        left_idx >= 0,
+        bl.right[safe(left_idx)],
+        state.start,
+    )
+    o0 = jnp.where(need_scan, o0, -1)
+
+    def scan_cond(carry):
+        o, left, conflicting, before, brk = carry
+        return (o >= 0) & (o != right_idx) & ~brk
+
+    def scan_body(carry):
+        o, left, conflicting, before, brk = carry
+        so = safe(o)
+        before = before.at[so].set(True)
+        conflicting = conflicting.at[so].set(True)
+        same_origin = _origins_equal(
+            has_origin,
+            origin_client,
+            origin_clock,
+            bl.origin_client[so] >= 0,
+            bl.origin_client[so],
+            bl.origin_clock[so],
+        )
+        same_ror = _origins_equal(
+            has_ror, r_rc, r_rk, bl.ror_client[so] >= 0, bl.ror_client[so], bl.ror_clock[so]
+        )
+        # case 1: same left anchor — (real) client id breaks the tie
+        case1_take = same_origin & (
+            client_rank[safe(bl.client[so])] < client_rank[safe(r_client)]
+        )
+        case1_break = same_origin & ~case1_take & same_ror
+        # case 2: o anchors somewhere inside the scanned region
+        o_has_origin = bl.origin_client[so] >= 0
+        o_origin_idx = _find_slot(
+            bl, state.n_blocks, bl.origin_client[so], bl.origin_clock[so]
+        )
+        o_origin_known = o_has_origin & (o_origin_idx >= 0)
+        in_before = o_origin_known & before[safe(o_origin_idx)]
+        in_conflicting = o_origin_known & conflicting[safe(o_origin_idx)]
+        case2_take = ~same_origin & in_before & ~in_conflicting
+        case2_break = ~same_origin & ~in_before
+
+        take = case1_take | case2_take
+        left = jnp.where(take, o, left)
+        conflicting = jnp.where(take, jnp.zeros_like(conflicting), conflicting)
+        brk = case1_break | case2_break
+        o = jnp.where(brk, o, bl.right[so])
+        return (o, left, conflicting, before, brk)
+
+    zeros = jnp.zeros((B,), bool)
+    _, left_scanned, _, _, _ = jax.lax.while_loop(
+        scan_cond, scan_body, (o0, left_idx, zeros, zeros, jnp.array(False))
+    )
+    left_idx = jnp.where(need_scan, left_scanned, left_idx)
+
+    # --- link in (parity: block.rs:614-659) ---
+    j = state.n_blocks
+    overflow = do & (j >= B)
+    do = do & (j < B)
+    linkable = linkable & (j < B)
+    wj = jnp.where(do, j, B)
+
+    has_left = linkable & (left_idx >= 0)
+    right_final = jnp.where(
+        has_left, bl.right[safe(left_idx)], jnp.where(linkable, state.start, -1)
+    )
+    # left.right = j ; start = j when no left
+    w_left = jnp.where(has_left, left_idx, B)
+    new_right_col = _set(bl.right, w_left, j)
+    new_start = jnp.where(linkable & ~has_left, j, state.start)
+    # right.left = j
+    w_right = jnp.where(linkable & (right_final >= 0), right_final, B)
+    new_left_col = _set(bl.left, w_right, j)
+
+    row_deleted = is_gc | (r_kind == CONTENT_DELETED)
+    row_countable = ~row_deleted & (r_kind != CONTENT_FORMAT)
+
+    new_bl = BlockCols(
+        client=_set(bl.client, wj, r_client),
+        clock=_set(bl.clock, wj, clock),
+        length=_set(bl.length, wj, length),
+        origin_client=_set(bl.origin_client, wj, jnp.where(has_origin, origin_client, -1)),
+        origin_clock=_set(bl.origin_clock, wj, jnp.where(has_origin, origin_clock, 0)),
+        ror_client=_set(bl.ror_client, wj, jnp.where(has_ror, r_rc, -1)),
+        ror_clock=_set(bl.ror_clock, wj, jnp.where(has_ror, r_rk, 0)),
+        left=_set(new_left_col, wj, jnp.where(linkable, left_idx, -1)),
+        right=_set(new_right_col, wj, jnp.where(linkable, right_final, -1)),
+        deleted=_set(bl.deleted, wj, row_deleted),
+        countable=_set(bl.countable, wj, row_countable),
+        kind=_set(bl.kind, wj, r_kind),
+        content_ref=_set(bl.content_ref, wj, r_ref),
+        content_off=_set(bl.content_off, wj, c_off),
+    )
+    error = (
+        state.error
+        | jnp.where(overflow, ERR_CAPACITY, 0)
+        | jnp.where(missing, ERR_MISSING_DEP, 0)
+    )
+    return DocStateBatch(
+        blocks=new_bl,
+        start=new_start,
+        n_blocks=state.n_blocks + do.astype(I32),
+        error=error,
+    )
+
+
+def _apply_delete_range(state: DocStateBatch, client, start, end, valid) -> DocStateBatch:
+    """Tombstone [start, end) of `client` (parity: transaction.rs:472-575)."""
+    probe = jnp.where(valid, client, -2)
+    # split the head block at `start` (only non-deleted blocks get split)
+    i = _find_slot(state.blocks, state.n_blocks, probe, start)
+    i_ok = (i >= 0) & ~state.blocks.deleted[jnp.maximum(i, 0)]
+    off = start - state.blocks.clock[jnp.maximum(i, 0)]
+    state, _ = _split(state, jnp.where(i_ok, i, -1), off)
+    # split the tail block at `end`
+    k = _find_slot(state.blocks, state.n_blocks, probe, end - 1)
+    k_ok = (k >= 0) & ~state.blocks.deleted[jnp.maximum(k, 0)]
+    off_k = end - state.blocks.clock[jnp.maximum(k, 0)]
+    state, _ = _split(state, jnp.where(k_ok, k, -1), off_k)
+    # mark fully covered blocks
+    bl = state.blocks
+    B = _capacity(bl)
+    slots = jnp.arange(B, dtype=I32)
+    mask = (
+        valid
+        & (slots < state.n_blocks)
+        & (bl.client == client)
+        & (bl.clock >= start)
+        & (bl.clock + bl.length <= end)
+    )
+    return state._replace(blocks=bl._replace(deleted=bl.deleted | mask))
+
+
+def _apply_update_one_doc(
+    state: DocStateBatch, batch: UpdateBatch, client_rank: jax.Array
+) -> DocStateBatch:
+    U = batch.client.shape[-1]
+    R = batch.del_client.shape[-1]
+
+    def blk_body(i, st):
+        row = (
+            batch.client[i],
+            batch.clock[i],
+            batch.length[i],
+            batch.origin_client[i],
+            batch.origin_clock[i],
+            batch.ror_client[i],
+            batch.ror_clock[i],
+            batch.kind[i],
+            batch.content_ref[i],
+            batch.content_off[i],
+            batch.valid[i],
+        )
+        return _integrate_row(st, row, client_rank)
+
+    state = jax.lax.fori_loop(0, U, blk_body, state)
+
+    def del_body(r, st):
+        return _apply_delete_range(
+            st, batch.del_client[r], batch.del_start[r], batch.del_end[r], batch.del_valid[r]
+        )
+
+    return jax.lax.fori_loop(0, R, del_body, state)
+
+
+@jax.jit
+def apply_update_batch(
+    state: DocStateBatch, batch: UpdateBatch, client_rank: jax.Array
+) -> DocStateBatch:
+    """Integrate one decoded update per doc — the north-star entry point.
+
+    `client_rank` is the [C] interned-client rank table (shared by all docs).
+    """
+    return jax.vmap(_apply_update_one_doc, in_axes=(0, 0, None))(
+        state, batch, client_rank
+    )
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=1)
+def state_vectors(state: DocStateBatch, n_clients: int) -> jax.Array:
+    """[D, C] dense state vectors from the block columns."""
+    from ytpu.ops.state_vector import sv_from_blocks
+
+    return sv_from_blocks(
+        state.blocks.client, state.blocks.clock, state.blocks.length, n_clients
+    )
+
+
+# --- host-side conversion layer -----------------------------------------------
+
+
+class ClientInterner:
+    """Dense i32 interning of 53-bit client ids (SURVEY §2 #8)."""
+
+    def __init__(self):
+        self.to_idx: Dict[int, int] = {}
+        self.from_idx: List[int] = []
+
+    def intern(self, client: int) -> int:
+        idx = self.to_idx.get(client)
+        if idx is None:
+            idx = len(self.from_idx)
+            self.to_idx[client] = idx
+            self.from_idx.append(client)
+        return idx
+
+    def rank_table(self, pad_to: Optional[int] = None) -> jax.Array:
+        """[C] i32: rank of each interned client in real-id order.
+
+        Padded to a power of two so the jitted kernel's shape stays stable
+        as new clients appear.
+        """
+        n = len(self.from_idx)
+        size = pad_to or max(8, 1 << (max(1, n - 1)).bit_length())
+        ranks = np.zeros(size, dtype=np.int32)
+        order = sorted(range(n), key=lambda i: self.from_idx[i])
+        for rank, idx in enumerate(order):
+            ranks[idx] = rank
+        return jnp.asarray(ranks)
+
+    def __len__(self) -> int:
+        return len(self.from_idx)
+
+
+class PayloadStore:
+    """Host side-buffers for variable-length content, addressed by i32 refs.
+
+    Strings are stored as UTF-16LE bytes so (offset, len) columns measured in
+    clock units slice exactly; other payloads store their element lists.
+    """
+
+    def __init__(self):
+        self.items: List[Tuple[int, object]] = []  # (kind, payload)
+
+    def add(self, kind: int, payload) -> int:
+        self.items.append((kind, payload))
+        return len(self.items) - 1
+
+    def slice_text(self, ref: int, off: int, length: int) -> str:
+        kind, payload = self.items[ref]
+        return payload[2 * off : 2 * (off + length)].decode("utf-16-le")
+
+    def slice_values(self, ref: int, off: int, length: int) -> list:
+        kind, payload = self.items[ref]
+        return payload[off : off + length]
+
+
+class BatchEncoder:
+    """Converts host `Update` objects into padded `UpdateBatch` tensors."""
+
+    def __init__(self):
+        self.interner = ClientInterner()
+        self.payloads = PayloadStore()
+
+    def rows_from_update(self, update: Update) -> Tuple[list, list]:
+        rows = []
+        # mirror the reference's descending-client integration order
+        for client in sorted(update.blocks.keys(), reverse=True):
+            for carrier in update.blocks[client]:
+                if isinstance(carrier, SkipRange):
+                    continue
+                c = self.interner.intern(carrier.id.client)
+                if isinstance(carrier, GCRange):
+                    rows.append(
+                        (c, carrier.id.clock, carrier.len, -1, 0, -1, 0, BLOCK_GC, -1, 0)
+                    )
+                    continue
+                item: Item = carrier
+                kind = item.content.kind
+                if kind == CONTENT_STRING:
+                    ref = self.payloads.add(
+                        kind, item.content.text.encode("utf-16-le")
+                    )
+                elif kind in (CONTENT_ANY,):
+                    ref = self.payloads.add(kind, list(item.content.items))
+                elif kind == CONTENT_DELETED:
+                    ref = -1
+                else:
+                    # embed/format/type/doc payloads: stash the content object
+                    ref = self.payloads.add(kind, item.content)
+                oc = self.interner.intern(item.origin.client) if item.origin else -1
+                ok = item.origin.clock if item.origin else 0
+                rc = (
+                    self.interner.intern(item.right_origin.client)
+                    if item.right_origin
+                    else -1
+                )
+                rk = item.right_origin.clock if item.right_origin else 0
+                rows.append((c, item.id.clock, item.len, oc, ok, rc, rk, kind, ref, 0))
+        dels = []
+        for client, ranges in update.delete_set.clients.items():
+            c = self.interner.intern(client)
+            for s, e in ranges:
+                dels.append((c, s, e))
+        return rows, dels
+
+    def build_batch(
+        self,
+        updates: List[Optional[Update]],
+        n_rows: Optional[int] = None,
+        n_dels: Optional[int] = None,
+    ) -> UpdateBatch:
+        """Pad per-doc rows into one [D, U] / [D, R] batch."""
+        all_rows = []
+        all_dels = []
+        for u in updates:
+            if u is None:
+                all_rows.append([])
+                all_dels.append([])
+            else:
+                r, d = self.rows_from_update(u)
+                all_rows.append(r)
+                all_dels.append(d)
+        U = n_rows or max(1, max(len(r) for r in all_rows))
+        R = n_dels or max(1, max(len(d) for d in all_dels))
+        D = len(updates)
+
+        def pad_rows():
+            out = np.zeros((D, U, 10), dtype=np.int32)
+            valid = np.zeros((D, U), dtype=bool)
+            for d, rows in enumerate(all_rows):
+                for i, row in enumerate(rows):
+                    out[d, i] = row
+                    valid[d, i] = True
+            return out, valid
+
+        def pad_dels():
+            out = np.zeros((D, R, 3), dtype=np.int32)
+            valid = np.zeros((D, R), dtype=bool)
+            for d, dels in enumerate(all_dels):
+                for i, de in enumerate(dels):
+                    out[d, i] = de
+                    valid[d, i] = True
+            return out, valid
+
+        rows, rows_valid = pad_rows()
+        dels, dels_valid = pad_dels()
+        return UpdateBatch(
+            client=jnp.asarray(rows[:, :, 0]),
+            clock=jnp.asarray(rows[:, :, 1]),
+            length=jnp.asarray(rows[:, :, 2]),
+            origin_client=jnp.asarray(rows[:, :, 3]),
+            origin_clock=jnp.asarray(rows[:, :, 4]),
+            ror_client=jnp.asarray(rows[:, :, 5]),
+            ror_clock=jnp.asarray(rows[:, :, 6]),
+            kind=jnp.asarray(rows[:, :, 7]),
+            content_ref=jnp.asarray(rows[:, :, 8]),
+            content_off=jnp.asarray(rows[:, :, 9]),
+            valid=jnp.asarray(rows_valid),
+            del_client=jnp.asarray(dels[:, :, 0]),
+            del_start=jnp.asarray(dels[:, :, 1]),
+            del_end=jnp.asarray(dels[:, :, 2]),
+            del_valid=jnp.asarray(dels_valid),
+        )
+
+
+def get_string(state: DocStateBatch, doc: int, payloads: PayloadStore) -> str:
+    """Host assembly of a doc's visible text (device gather + host concat)."""
+    bl = jax.tree.map(lambda a: np.asarray(a[doc]), state.blocks)
+    start = int(state.start[doc])
+    out: List[str] = []
+    idx = start
+    steps = 0
+    limit = int(state.n_blocks[doc]) + 1
+    while idx >= 0 and steps <= limit:
+        if not bl.deleted[idx] and bl.kind[idx] == CONTENT_STRING:
+            out.append(
+                payloads.slice_text(
+                    int(bl.content_ref[idx]),
+                    int(bl.content_off[idx]),
+                    int(bl.length[idx]),
+                )
+            )
+        idx = int(bl.right[idx])
+        steps += 1
+    if steps > limit:
+        raise RuntimeError(f"cycle detected in doc {doc} sequence")
+    return "".join(out)
+
+
+def get_values(state: DocStateBatch, doc: int, payloads: PayloadStore) -> list:
+    """Host assembly of a doc's visible sequence values (Array flagship)."""
+    bl = jax.tree.map(lambda a: np.asarray(a[doc]), state.blocks)
+    idx = int(state.start[doc])
+    out: list = []
+    steps = 0
+    limit = int(state.n_blocks[doc]) + 1
+    while idx >= 0 and steps <= limit:
+        if not bl.deleted[idx] and bl.countable[idx]:
+            kind = int(bl.kind[idx])
+            ref = int(bl.content_ref[idx])
+            off = int(bl.content_off[idx])
+            ln = int(bl.length[idx])
+            if kind == CONTENT_STRING:
+                out.extend(payloads.slice_text(ref, off, ln))
+            elif kind == CONTENT_ANY:
+                out.extend(payloads.slice_values(ref, off, ln))
+        idx = int(bl.right[idx])
+        steps += 1
+    if steps > limit:
+        raise RuntimeError(f"cycle detected in doc {doc} sequence")
+    return out

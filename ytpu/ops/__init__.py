@@ -1,0 +1,17 @@
+"""Device kernels: batched state-vector math, sequence ops, codec helpers."""
+
+from .state_vector import (
+    diff_start_clocks,
+    sv_contains_all,
+    sv_diff_mask,
+    sv_from_blocks,
+    sv_merge,
+)
+
+__all__ = [
+    "sv_merge",
+    "sv_contains_all",
+    "sv_diff_mask",
+    "sv_from_blocks",
+    "diff_start_clocks",
+]

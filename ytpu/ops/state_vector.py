@@ -1,0 +1,67 @@
+"""Batched state-vector math on device.
+
+Reference semantics: /root/reference/yrs/src/state_vector.rs (merge/set_max
+:21-105) and the diff selection in store.rs:234-248 (`diff_state_vectors`).
+
+Device layout: a batch of state vectors is a dense ``[n_docs, n_clients]``
+i32 tensor over a host-interned client dictionary. All ops are elementwise /
+reductions — they tile perfectly onto the VPU and shard over the doc axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "sv_merge",
+    "sv_contains_all",
+    "sv_diff_mask",
+    "sv_from_blocks",
+    "diff_start_clocks",
+]
+
+
+def sv_merge(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Pairwise max over [D, C] clock tensors."""
+    return jnp.maximum(a, b)
+
+
+def sv_contains_all(local: jax.Array, remote: jax.Array) -> jax.Array:
+    """[D] bool: does `local` dominate `remote` per doc?"""
+    return jnp.all(local >= remote, axis=-1)
+
+
+def sv_diff_mask(local: jax.Array, remote: jax.Array) -> jax.Array:
+    """[D, C] bool: clients for which local has blocks the remote lacks.
+
+    This is the batched form of `diff_state_vectors` (store.rs:234-248).
+    """
+    return local > remote
+
+
+def diff_start_clocks(local: jax.Array, remote: jax.Array) -> jax.Array:
+    """[D, C] i32: first clock to ship per (doc, client); -1 if none needed."""
+    need = local > remote
+    return jnp.where(need, remote, -1)
+
+
+def sv_from_blocks(
+    blk_client: jax.Array,  # [D, B] i32 interned client (-1 unused)
+    blk_clock: jax.Array,  # [D, B] i32
+    blk_len: jax.Array,  # [D, B] i32
+    n_clients: int,
+) -> jax.Array:
+    """[D, C] i32 state vectors from block columns (segment max of clock+len)."""
+    end = blk_clock + blk_len
+    valid = blk_client >= 0
+    client = jnp.where(valid, blk_client, 0)
+    contrib = jnp.where(valid, end, 0)
+    # one-hot scatter-max over the client axis
+    def per_doc(cl, co):
+        return jax.ops.segment_max(
+            co, cl, num_segments=n_clients, indices_are_sorted=False
+        )
+
+    out = jax.vmap(per_doc)(client, contrib)
+    return jnp.maximum(out, 0)

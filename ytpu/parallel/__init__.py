@@ -1,0 +1,21 @@
+"""Mesh + sharding layer (dp/tp/sp axes over ICI)."""
+
+from .mesh import (
+    AXIS_DP,
+    AXIS_TP,
+    doc_sharding,
+    make_mesh,
+    shard_batch,
+    shard_state,
+    sv_sharding,
+)
+
+__all__ = [
+    "AXIS_DP",
+    "AXIS_TP",
+    "make_mesh",
+    "doc_sharding",
+    "sv_sharding",
+    "shard_state",
+    "shard_batch",
+]

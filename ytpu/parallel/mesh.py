@@ -1,0 +1,69 @@
+"""Mesh construction and shardings for the batched engine.
+
+Parallelism mapping (SURVEY.md §2 table):
+- dp — the doc-batch axis: `DocStateBatch` shards its leading doc axis here
+  (the reference analogue: N independent Docs; north-star 10k-doc batch).
+- tp — the client axis of dense state-vector tensors ([D, C]) for
+  encode_diff_batch's per-client clock compares.
+- sp — the block axis inside one doc (sequence/context parallelism for hot
+  docs; round-1: layout declared, halo exchange lands with the sharded
+  sequence kernel).
+
+All collectives ride ICI via XLA's sharding propagation — no hand-written
+NCCL-style calls (reference has none either; its y-sync protocol is the
+host-side analogue, see ytpu.sync).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "doc_sharding", "sv_sharding", "shard_state", "AXIS_DP", "AXIS_TP"]
+
+AXIS_DP = "dp"
+AXIS_TP = "tp"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axes: Tuple[str, str] = (AXIS_DP, AXIS_TP),
+    tp: int = 1,
+) -> Mesh:
+    """Mesh with a doc-parallel axis and a (usually small) tp axis."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n % tp != 0:
+        raise ValueError(f"{n} devices not divisible by tp={tp}")
+    arr = np.array(devices).reshape(n // tp, tp)
+    return Mesh(arr, axes)
+
+
+def doc_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading doc axis over dp; block columns stay local."""
+    return NamedSharding(mesh, P(AXIS_DP))
+
+
+def sv_sharding(mesh: Mesh) -> NamedSharding:
+    """[D, C] state-vector tensors: docs over dp, clients over tp."""
+    return NamedSharding(mesh, P(AXIS_DP, AXIS_TP))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_state(state, mesh: Mesh):
+    """Place a DocStateBatch so its doc axis spans the dp mesh axis."""
+    sh = doc_sharding(mesh)
+    return jax.tree.map(lambda a: jax.device_put(a, sh), state)
+
+
+def shard_batch(batch, mesh: Mesh):
+    sh = doc_sharding(mesh)
+    return jax.tree.map(lambda a: jax.device_put(a, sh), batch)
