@@ -1,0 +1,180 @@
+"""y-sync protocol, Awareness, and the multi-tenant server loop.
+
+Model: reference sync/protocol.rs handlers + sync/awareness.rs tests.
+"""
+
+import pytest
+
+from ytpu.core import Doc, StateVector
+from ytpu.encoding.lib0 import Cursor
+from ytpu.sync import (
+    Awareness,
+    AwarenessUpdate,
+    Message,
+    PermissionDenied,
+    Protocol,
+    SyncMessage,
+    SyncServer,
+    message_reader,
+)
+from ytpu.sync.awareness import AwarenessUpdateEntry
+
+
+def test_message_roundtrip():
+    sv = StateVector({1: 5, 9: 2})
+    msgs = [
+        Message.sync(SyncMessage.step1(sv)),
+        Message.sync(SyncMessage.step2(b"\x01\x02\x03")),
+        Message.sync(SyncMessage.update(b"\xff")),
+        Message.auth(None),
+        Message.auth("nope"),
+        Message.awareness_query(),
+        Message.awareness(AwarenessUpdate({7: AwarenessUpdateEntry(3, '{"x":1}')})),
+    ]
+    blob = b"".join(m.encode_v1() for m in msgs)
+    out = list(message_reader(blob))
+    assert out == msgs
+
+
+def test_full_handshake_two_peers():
+    a_doc, b_doc = Doc(client_id=1), Doc(client_id=2)
+    ta, tb = a_doc.get_text("t"), b_doc.get_text("t")
+    with a_doc.transact() as txn:
+        ta.insert(txn, 0, "from-a")
+    with b_doc.transact() as txn:
+        tb.insert(txn, 0, "from-b")
+    a, b = Awareness(a_doc), Awareness(b_doc)
+    proto = Protocol()
+
+    # a opens: sends step1 + awareness; b replies with step2 (+ applies)
+    for msg in message_reader(proto.start(a)):
+        reply = proto.handle_message(b, msg)
+        if reply is not None:
+            out = proto.handle_message(a, reply)
+            assert out is None
+    # now a has b's changes; reverse direction
+    for msg in message_reader(proto.start(b)):
+        reply = proto.handle_message(a, msg)
+        if reply is not None:
+            proto.handle_message(b, reply)
+    assert ta.get_string() == tb.get_string()
+    assert "from-a" in ta.get_string() and "from-b" in ta.get_string()
+
+
+def test_auth_denied():
+    doc = Doc(client_id=1)
+    aw = Awareness(doc)
+    proto = Protocol()
+    with pytest.raises(PermissionDenied):
+        proto.handle_message(aw, Message.auth("no access"))
+
+
+def test_awareness_clock_precedence():
+    doc = Doc(client_id=1)
+    aw = Awareness(doc)
+    aw.apply_update(AwarenessUpdate({5: AwarenessUpdateEntry(2, '{"v":1}')}))
+    # stale clock must be ignored
+    aw.apply_update(AwarenessUpdate({5: AwarenessUpdateEntry(1, '{"v":0}')}))
+    assert aw.all_states()[5] == {"v": 1}
+    # newer clock wins
+    aw.apply_update(AwarenessUpdate({5: AwarenessUpdateEntry(3, '{"v":2}')}))
+    assert aw.all_states()[5] == {"v": 2}
+    # null removes
+    aw.apply_update(AwarenessUpdate({5: AwarenessUpdateEntry(4, "null")}))
+    assert 5 not in aw.all_states()
+
+
+def test_awareness_local_state_resurrection():
+    doc = Doc(client_id=42)
+    aw = Awareness(doc)
+    aw.set_local_state({"name": "me"})
+    clock_before = aw.meta[42].clock
+    # a remote peer claims we're gone — we must survive with a bumped clock
+    aw.apply_update(AwarenessUpdate({42: AwarenessUpdateEntry(clock_before + 1, "null")}))
+    assert aw.all_states()[42] == {"name": "me"}
+    assert aw.meta[42].clock > clock_before
+
+
+def test_awareness_timeout():
+    t = [0.0]
+    doc = Doc(client_id=1)
+    aw = Awareness(doc, clock=lambda: t[0])
+    aw.apply_update(AwarenessUpdate({9: AwarenessUpdateEntry(1, '{"p":1}')}))
+    t[0] = 31_000.0
+    removed = aw.remove_outdated()
+    assert removed == [9]
+    assert 9 not in aw.all_states()
+
+
+def test_awareness_update_wire_roundtrip():
+    u = AwarenessUpdate(
+        {1: AwarenessUpdateEntry(4, '{"cursor":[1,2]}'), 2: AwarenessUpdateEntry(1, "null")}
+    )
+    assert AwarenessUpdate.decode_v1(u.encode_v1()) == u
+
+
+def test_sync_server_two_clients():
+    server = SyncServer()
+    # client A connects and uploads its state
+    ca = Doc(client_id=10)
+    ta = ca.get_text("t")
+    with ca.transact() as txn:
+        ta.insert(txn, 0, "hello")
+    sess_a, greeting_a = server.connect("room-1")
+    proto = Protocol()
+    aw_a = Awareness(ca)
+    # client answers the greeting (step1 → step2 upload; awareness apply)
+    for msg in message_reader(greeting_a):
+        reply = proto.handle_message(aw_a, msg)
+        if reply is not None:
+            server.receive(sess_a, reply.encode_v1())
+    # client also requests server state
+    reply = server.receive(sess_a, proto.start(aw_a))
+    for msg in message_reader(reply):
+        proto.handle_message(aw_a, msg)
+    assert server.doc("room-1").get_text("t").get_string() == "hello"
+
+    # client B connects later and receives state via the greeting exchange
+    cb = Doc(client_id=11)
+    aw_b = Awareness(cb)
+    sess_b, greeting_b = server.connect("room-1")
+    for msg in message_reader(greeting_b):
+        reply = proto.handle_message(aw_b, msg)
+        if reply is not None:
+            server.receive(sess_b, reply.encode_v1())
+    reply = server.receive(sess_b, proto.start(aw_b))
+    for msg in message_reader(reply):
+        proto.handle_message(aw_b, msg)
+    assert cb.get_text("t").get_string() == "hello"
+
+    # live update from A broadcasts to B
+    with ca.transact() as txn:
+        ta.insert(txn, 5, " world")
+    # ship A's latest update (captured via diff) to the server
+    diff = ca.encode_state_as_update_v1(server.doc("room-1").state_vector())
+    server.receive(sess_a, Message.sync(SyncMessage.update(diff)).encode_v1())
+    frames = server.drain(sess_b)
+    assert frames, "B should receive a broadcast"
+    for frame in frames:
+        for msg in message_reader(frame):
+            proto.handle_message(aw_b, msg)
+    assert cb.get_text("t").get_string() == "hello world"
+    # A must not receive its own doc-update echo (awareness broadcasts are fine)
+    for frame in server.drain(sess_a):
+        for msg in message_reader(frame):
+            assert msg.kind != 0, f"unexpected sync echo: {msg!r}"
+
+
+def test_sync_server_tenant_isolation():
+    server = SyncServer()
+    s1, _ = server.connect("room-a")
+    s2, _ = server.connect("room-b")
+    c = Doc(client_id=5)
+    t = c.get_text("t")
+    with c.transact() as txn:
+        t.insert(txn, 0, "secret")
+    diff = c.encode_state_as_update_v1(StateVector())
+    server.receive(s1, Message.sync(SyncMessage.update(diff)).encode_v1())
+    assert server.doc("room-a").get_text("t").get_string() == "secret"
+    assert server.doc("room-b").get_text("t").get_string() == ""
+    assert server.drain(s2) == []

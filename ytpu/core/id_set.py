@@ -120,7 +120,7 @@ class IdSet:
     # (clock, len) pairs ---
 
     def encode(self, w: Optional[Writer] = None) -> Writer:
-        w = w or Writer()
+        w = w if w is not None else Writer()
         entries = [(c, _squash_ranges(rs)) for c, rs in self.clients.items() if rs]
         entries.sort(key=lambda e: -e[0])
         w.write_var_uint(len(entries))

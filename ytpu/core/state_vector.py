@@ -80,7 +80,7 @@ class StateVector:
     # --- wire format (v1) ---
 
     def encode(self, w: Optional[Writer] = None) -> Writer:
-        w = w or Writer()
+        w = w if w is not None else Writer()
         entries = [(c, k) for c, k in self.clocks.items() if k > 0]
         # Deterministic order: higher clients first, mirroring update encoding
         # conventions (reference sorts updates by descending client id).

@@ -317,7 +317,7 @@ class DocStore:
                 lst[i].encode(w, 0)
 
     def encode_diff(self, remote_sv: StateVector, w: Optional[Writer] = None) -> Writer:
-        w = w or Writer()
+        w = w if w is not None else Writer()
         self.write_blocks_from(remote_sv, w)
         self.delete_set().encode(w)
         return w

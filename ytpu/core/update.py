@@ -115,7 +115,7 @@ class Update:
 
     def encode_diff(self, remote_sv: StateVector, w: Optional[Writer] = None) -> Writer:
         """Encode only what `remote_sv` is missing (parity: update.rs:490-535)."""
-        w = w or Writer()
+        w = w if w is not None else Writer()
         per_client: List[Tuple[ClientID, int, List[Carrier]]] = []
         for client, blocks in self.blocks.items():
             remote_clock = remote_sv.get(client)

@@ -1,0 +1,26 @@
+"""y-sync protocol + Awareness + the multi-tenant server loop."""
+
+from .awareness import Awareness, AwarenessUpdate, AwarenessUpdateEntry
+from .protocol import (
+    Message,
+    PermissionDenied,
+    Protocol,
+    SyncMessage,
+    UnsupportedMessage,
+    message_reader,
+)
+from .server import Session, SyncServer
+
+__all__ = [
+    "Awareness",
+    "AwarenessUpdate",
+    "AwarenessUpdateEntry",
+    "Message",
+    "SyncMessage",
+    "Protocol",
+    "message_reader",
+    "PermissionDenied",
+    "UnsupportedMessage",
+    "SyncServer",
+    "Session",
+]

@@ -1,0 +1,260 @@
+"""y-sync protocol: the transport-agnostic sync state machine.
+
+Behavioral parity target: /root/reference/yrs/src/sync/protocol.rs
+(`Protocol` trait with default handlers :42-135, message tags :138-147 and
+:219-224, `Message`/`SyncMessage` codecs :158-272, `MessageReader` :312-330).
+
+Handshake (protocol.rs header comment): on connect each side sends
+SyncStep1(its state vector) + its Awareness snapshot; a SyncStep1 is answered
+with SyncStep2(missing update); live changes flow as Update messages.
+
+The batched server loop in `ytpu.sync.server` replaces the reference's
+per-connection state machine with per-tenant queues feeding
+`apply_update_batch` — the protocol bytes stay identical.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple, Union
+
+from ytpu.core import StateVector, Update
+from ytpu.encoding.lib0 import Cursor, Writer
+
+from .awareness import Awareness, AwarenessUpdate
+
+__all__ = [
+    "MSG_SYNC",
+    "MSG_AWARENESS",
+    "MSG_AUTH",
+    "MSG_QUERY_AWARENESS",
+    "MSG_SYNC_STEP_1",
+    "MSG_SYNC_STEP_2",
+    "MSG_SYNC_UPDATE",
+    "Message",
+    "SyncMessage",
+    "message_reader",
+    "Protocol",
+    "PermissionDenied",
+    "UnsupportedMessage",
+]
+
+MSG_SYNC = 0
+MSG_AWARENESS = 1
+MSG_AUTH = 2
+MSG_QUERY_AWARENESS = 3
+
+PERMISSION_DENIED = 0
+PERMISSION_GRANTED = 1
+
+MSG_SYNC_STEP_1 = 0
+MSG_SYNC_STEP_2 = 1
+MSG_SYNC_UPDATE = 2
+
+
+class PermissionDenied(Exception):
+    pass
+
+
+class UnsupportedMessage(Exception):
+    pass
+
+
+class SyncMessage:
+    """One of SyncStep1(sv) / SyncStep2(update bytes) / Update(update bytes)."""
+
+    __slots__ = ("tag", "payload")
+
+    def __init__(self, tag: int, payload):
+        self.tag = tag
+        self.payload = payload
+
+    @classmethod
+    def step1(cls, sv: StateVector) -> "SyncMessage":
+        return cls(MSG_SYNC_STEP_1, sv)
+
+    @classmethod
+    def step2(cls, update: bytes) -> "SyncMessage":
+        return cls(MSG_SYNC_STEP_2, update)
+
+    @classmethod
+    def update(cls, update: bytes) -> "SyncMessage":
+        return cls(MSG_SYNC_UPDATE, update)
+
+    def encode(self, w: Writer) -> None:
+        w.write_var_uint(self.tag)
+        if self.tag == MSG_SYNC_STEP_1:
+            w.write_buf(self.payload.encode_v1())
+        else:
+            w.write_buf(self.payload)
+
+    @classmethod
+    def decode(cls, cur: Cursor) -> "SyncMessage":
+        tag = cur.read_var_uint()
+        buf = cur.read_buf()
+        if tag == MSG_SYNC_STEP_1:
+            return cls(tag, StateVector.decode_v1(buf))
+        if tag in (MSG_SYNC_STEP_2, MSG_SYNC_UPDATE):
+            return cls(tag, buf)
+        raise UnsupportedMessage(f"sync tag {tag}")
+
+    def __eq__(self, other):
+        if not isinstance(other, SyncMessage):
+            return NotImplemented
+        return self.tag == other.tag and self.payload == other.payload
+
+    def __repr__(self):
+        names = {0: "SyncStep1", 1: "SyncStep2", 2: "Update"}
+        return f"{names.get(self.tag, self.tag)}({self.payload!r})"
+
+
+class Message:
+    """Top-level protocol message (parity: protocol.rs:150-156)."""
+
+    __slots__ = ("kind", "body")
+
+    def __init__(self, kind: int, body):
+        self.kind = kind
+        self.body = body
+
+    @classmethod
+    def sync(cls, msg: SyncMessage) -> "Message":
+        return cls(MSG_SYNC, msg)
+
+    @classmethod
+    def awareness(cls, update: AwarenessUpdate) -> "Message":
+        return cls(MSG_AWARENESS, update)
+
+    @classmethod
+    def awareness_query(cls) -> "Message":
+        return cls(MSG_QUERY_AWARENESS, None)
+
+    @classmethod
+    def auth(cls, deny_reason: Optional[str]) -> "Message":
+        return cls(MSG_AUTH, deny_reason)
+
+    @classmethod
+    def custom(cls, tag: int, data: bytes) -> "Message":
+        return cls(tag, data)
+
+    def encode(self, w: Optional[Writer] = None) -> Writer:
+        w = w if w is not None else Writer()
+        if self.kind == MSG_SYNC:
+            w.write_var_uint(MSG_SYNC)
+            self.body.encode(w)
+        elif self.kind == MSG_AUTH:
+            w.write_var_uint(MSG_AUTH)
+            if self.body is not None:
+                w.write_var_uint(PERMISSION_DENIED)
+                w.write_string(self.body)
+            else:
+                w.write_var_uint(PERMISSION_GRANTED)
+        elif self.kind == MSG_QUERY_AWARENESS:
+            w.write_var_uint(MSG_QUERY_AWARENESS)
+        elif self.kind == MSG_AWARENESS:
+            w.write_var_uint(MSG_AWARENESS)
+            w.write_buf(self.body.encode_v1())
+        else:
+            w.write_u8(self.kind)
+            w.write_buf(self.body)
+        return w
+
+    def encode_v1(self) -> bytes:
+        return self.encode().to_bytes()
+
+    @classmethod
+    def decode(cls, cur: Cursor) -> "Message":
+        tag = cur.read_var_uint()
+        if tag == MSG_SYNC:
+            return cls(MSG_SYNC, SyncMessage.decode(cur))
+        if tag == MSG_AWARENESS:
+            return cls(MSG_AWARENESS, AwarenessUpdate.decode_v1(cur.read_buf()))
+        if tag == MSG_AUTH:
+            if cur.read_var_uint() == PERMISSION_DENIED:
+                return cls(MSG_AUTH, cur.read_string())
+            return cls(MSG_AUTH, None)
+        if tag == MSG_QUERY_AWARENESS:
+            return cls(MSG_QUERY_AWARENESS, None)
+        return cls(tag, cur.read_buf())
+
+    def __eq__(self, other):
+        if not isinstance(other, Message):
+            return NotImplemented
+        return self.kind == other.kind and self.body == other.body
+
+    def __repr__(self):
+        names = {0: "Sync", 1: "Awareness", 2: "Auth", 3: "AwarenessQuery"}
+        return f"Message.{names.get(self.kind, self.kind)}({self.body!r})"
+
+
+def message_reader(data: bytes) -> Iterator[Message]:
+    """Iterate over messages packed one after another (parity: MessageReader,
+    protocol.rs:312-330)."""
+    cur = Cursor(data)
+    while cur.has_content():
+        yield Message.decode(cur)
+
+
+class Protocol:
+    """Default y-sync handlers (parity: protocol.rs:42-135). Subclass to
+    customize (e.g. auth); `handle_message` dispatches one incoming message
+    and returns an optional reply."""
+
+    def start(self, awareness: Awareness) -> bytes:
+        """Connection opening: SyncStep1(local sv) + awareness snapshot."""
+        w = Writer()
+        sv = awareness.doc.state_vector()
+        Message.sync(SyncMessage.step1(sv)).encode(w)
+        Message.awareness(awareness.update()).encode(w)
+        return w.to_bytes()
+
+    def handle_sync_step1(
+        self, awareness: Awareness, sv: StateVector
+    ) -> Optional[Message]:
+        update = awareness.doc.encode_state_as_update_v1(sv)
+        return Message.sync(SyncMessage.step2(update))
+
+    def handle_sync_step2(
+        self, awareness: Awareness, update: bytes
+    ) -> Optional[Message]:
+        awareness.doc.apply_update_v1(update)
+        return None
+
+    def handle_update(self, awareness: Awareness, update: bytes) -> Optional[Message]:
+        return self.handle_sync_step2(awareness, update)
+
+    def handle_auth(
+        self, awareness: Awareness, deny_reason: Optional[str]
+    ) -> Optional[Message]:
+        if deny_reason is not None:
+            raise PermissionDenied(deny_reason)
+        return None
+
+    def handle_awareness_query(self, awareness: Awareness) -> Optional[Message]:
+        return Message.awareness(awareness.update())
+
+    def handle_awareness_update(
+        self, awareness: Awareness, update: AwarenessUpdate
+    ) -> Optional[Message]:
+        awareness.apply_update(update)
+        return None
+
+    def missing_handle(
+        self, awareness: Awareness, tag: int, data: bytes
+    ) -> Optional[Message]:
+        raise UnsupportedMessage(f"message tag {tag}")
+
+    def handle_message(self, awareness: Awareness, msg: Message) -> Optional[Message]:
+        if msg.kind == MSG_SYNC:
+            sub: SyncMessage = msg.body
+            if sub.tag == MSG_SYNC_STEP_1:
+                return self.handle_sync_step1(awareness, sub.payload)
+            if sub.tag == MSG_SYNC_STEP_2:
+                return self.handle_sync_step2(awareness, sub.payload)
+            return self.handle_update(awareness, sub.payload)
+        if msg.kind == MSG_AUTH:
+            return self.handle_auth(awareness, msg.body)
+        if msg.kind == MSG_QUERY_AWARENESS:
+            return self.handle_awareness_query(awareness)
+        if msg.kind == MSG_AWARENESS:
+            return self.handle_awareness_update(awareness, msg.body)
+        return self.missing_handle(awareness, msg.kind, msg.body)

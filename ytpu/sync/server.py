@@ -1,0 +1,120 @@
+"""Multi-tenant sync server loop (host control plane).
+
+The reference is a library: its "server" is whatever embeds the y-sync
+`Protocol` per connection (ecosystem crates like yrs-warp; see
+/root/reference/yrs/src/sync/protocol.rs:8-31 for the handshake contract).
+ytpu ships the batched equivalent as a first-class component: one server
+hosts many tenant docs, terminates the y-sync protocol per (tenant, session),
+and broadcasts document/awareness changes to subscribed sessions.
+
+Transport-agnostic: callers pump bytes via `connect` / `receive` and deliver
+the returned frames. The in-process tests drive it directly; a DCN/gRPC
+frontend feeds the same loop; updates applied here can be mirrored into
+`ytpu.models.batch_doc` slots for device-side fan-in (round-2 wiring).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ytpu.core import Doc
+
+from .awareness import Awareness
+from .protocol import Message, Protocol, SyncMessage, message_reader
+
+__all__ = ["SyncServer", "Session"]
+
+
+class Session:
+    __slots__ = ("id", "tenant", "server", "outbox")
+
+    def __init__(self, id_: int, tenant: str, server: "SyncServer"):
+        self.id = id_
+        self.tenant = tenant
+        self.server = server
+        self.outbox: List[bytes] = []
+
+
+class _Tenant:
+    __slots__ = ("awareness", "sessions")
+
+    def __init__(self, doc: Doc):
+        self.awareness = Awareness(doc)
+        self.sessions: List[Session] = []
+
+
+class SyncServer:
+    def __init__(self, protocol: Optional[Protocol] = None, doc_factory=None):
+        self.protocol = protocol or Protocol()
+        self.tenants: Dict[str, _Tenant] = {}
+        self._doc_factory = doc_factory or (lambda name: Doc())
+        self._next_session = 0
+
+    # --- tenant / doc management ----------------------------------------------
+
+    def tenant(self, name: str) -> _Tenant:
+        t = self.tenants.get(name)
+        if t is None:
+            doc = self._doc_factory(name)
+            t = _Tenant(doc)
+            self.tenants[name] = t
+            # live update broadcast: one observer per tenant doc
+            def broadcast(payload: bytes, origin, txn, _name=name):
+                frame = Message.sync(SyncMessage.update(payload)).encode_v1()
+                for session in self.tenants[_name].sessions:
+                    if origin is not session:
+                        session.outbox.append(frame)
+
+            doc.observe_update_v1(broadcast)
+        return t
+
+    def doc(self, name: str) -> Doc:
+        return self.tenant(name).awareness.doc
+
+    # --- session lifecycle ------------------------------------------------------
+
+    def connect(self, tenant_name: str) -> Tuple[Session, bytes]:
+        """Open a session; returns (session, greeting bytes to send)."""
+        t = self.tenant(tenant_name)
+        self._next_session += 1
+        session = Session(self._next_session, tenant_name, self)
+        t.sessions.append(session)
+        greeting = self.protocol.start(t.awareness)
+        return session, greeting
+
+    def disconnect(self, session: Session) -> None:
+        t = self.tenants.get(session.tenant)
+        if t and session in t.sessions:
+            t.sessions.remove(session)
+
+    # --- message pumping --------------------------------------------------------
+
+    def receive(self, session: Session, data: bytes) -> bytes:
+        """Process incoming frames; returns direct reply bytes. Broadcasts to
+        other sessions land in their `outbox`."""
+        t = self.tenant(session.tenant)
+        replies: List[bytes] = []
+        for msg in message_reader(data):
+            if msg.kind == 0 and msg.body.tag == 2:  # Sync/Update
+                # apply with the session as origin so we don't echo it back
+                t.awareness.doc.apply_update_v1(msg.body.payload, origin=session)
+                continue
+            if msg.kind == 0 and msg.body.tag == 1:  # SyncStep2
+                t.awareness.doc.apply_update_v1(msg.body.payload, origin=session)
+                continue
+            if msg.kind == 1:  # Awareness: apply + broadcast to others
+                t.awareness.apply_update(msg.body)
+                frame = Message.awareness(msg.body).encode_v1()
+                for other in t.sessions:
+                    if other is not session:
+                        other.outbox.append(frame)
+                continue
+            reply = self.protocol.handle_message(t.awareness, msg)
+            if reply is not None:
+                replies.append(reply.encode_v1())
+        return b"".join(replies)
+
+    def drain(self, session: Session) -> List[bytes]:
+        out = session.outbox
+        session.outbox = []
+        return out
