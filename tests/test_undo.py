@@ -1,0 +1,150 @@
+"""UndoManager semantics (model: reference undo.rs tests + ywasm undo tests)."""
+
+import pytest
+
+from ytpu.core import Doc
+from ytpu.types import MapPrelim
+from ytpu.undo import UndoManager, UndoOptions
+
+
+def make(doc_kw=None, **opts):
+    doc = Doc(client_id=1, **(doc_kw or {}))
+    txt = doc.get_text("t")
+    mgr = UndoManager(doc, txt, UndoOptions(capture_timeout_ms=0, **opts))
+    return doc, txt, mgr
+
+
+def test_undo_redo_text_insert():
+    doc, txt, mgr = make()
+    with doc.transact() as txn:
+        txt.insert(txn, 0, "hello")
+    with doc.transact() as txn:
+        txt.insert(txn, 5, " world")
+    assert txt.get_string() == "hello world"
+    assert mgr.undo()
+    assert txt.get_string() == "hello"
+    assert mgr.undo()
+    assert txt.get_string() == ""
+    assert not mgr.can_undo()
+    assert mgr.redo()
+    assert txt.get_string() == "hello"
+    assert mgr.redo()
+    assert txt.get_string() == "hello world"
+    assert not mgr.can_redo()
+
+
+def test_undo_delete_restores_text():
+    doc, txt, mgr = make()
+    with doc.transact() as txn:
+        txt.insert(txn, 0, "keep me safe")
+    mgr.reset()
+    with doc.transact() as txn:
+        txt.remove_range(txn, 4, 3)  # removes " me"
+    assert txt.get_string() == "keep safe"
+    assert mgr.undo()
+    assert txt.get_string() == "keep me safe"
+
+
+def test_capture_timeout_groups_changes():
+    t = [1.0]
+    doc = Doc(client_id=1)
+    txt = doc.get_text("t")
+    mgr = UndoManager(
+        doc, txt, UndoOptions(capture_timeout_ms=500, timestamp=lambda: t[0])
+    )
+    with doc.transact() as txn:
+        txt.insert(txn, 0, "a")
+    t[0] += 100  # within capture window: extends the same stack item
+    with doc.transact() as txn:
+        txt.insert(txn, 1, "b")
+    t[0] += 1000  # outside: new item
+    with doc.transact() as txn:
+        txt.insert(txn, 2, "c")
+    assert len(mgr.undo_stack) == 2
+    assert mgr.undo()
+    assert txt.get_string() == "ab"
+    assert mgr.undo()
+    assert txt.get_string() == ""
+
+
+def test_tracked_origins_filter():
+    doc = Doc(client_id=1)
+    txt = doc.get_text("t")
+    mgr = UndoManager(
+        doc, txt, UndoOptions(capture_timeout_ms=0, tracked_origins={"editor"})
+    )
+    with doc.transact(origin="editor") as txn:
+        txt.insert(txn, 0, "tracked")
+    with doc.transact(origin="sync") as txn:
+        txt.insert(txn, 7, " untracked")
+    assert len(mgr.undo_stack) == 1
+    assert mgr.undo()
+    # only the tracked edit was undone
+    assert txt.get_string() == " untracked"
+
+
+def test_remote_changes_not_undone():
+    doc, txt, mgr = make()
+    with doc.transact() as txn:
+        txt.insert(txn, 0, "local")
+    remote = Doc(client_id=2)
+    rt = remote.get_text("t")
+    with remote.transact() as txn:
+        rt.insert(txn, 0, "remote-")
+    # remote update arrives with a non-tracked origin (as providers do)
+    doc.apply_update_v1(
+        remote.encode_state_as_update_v1(doc.state_vector()), origin="provider"
+    )
+    assert txt.get_string() == "localremote-"
+    assert mgr.undo()
+    assert txt.get_string() == "remote-"
+
+
+def test_map_undo():
+    doc = Doc(client_id=1)
+    m = doc.get_map("m")
+    mgr = UndoManager(doc, m, UndoOptions(capture_timeout_ms=0))
+    with doc.transact() as txn:
+        m.insert(txn, "k", "v1")
+    with doc.transact() as txn:
+        m.insert(txn, "k", "v2")
+    assert m.get("k") == "v2"
+    assert mgr.undo()
+    assert m.get("k") == "v1"
+    assert mgr.undo()
+    assert m.get("k") is None
+    assert mgr.redo()
+    assert m.get("k") == "v1"
+    assert mgr.redo()
+    assert m.get("k") == "v2"
+
+
+def test_scope_filtering():
+    doc = Doc(client_id=1)
+    t1 = doc.get_text("tracked")
+    t2 = doc.get_text("other")
+    mgr = UndoManager(doc, t1, UndoOptions(capture_timeout_ms=0))
+    with doc.transact() as txn:
+        t2.insert(txn, 0, "outside scope")
+    assert not mgr.can_undo()
+    with doc.transact() as txn:
+        t1.insert(txn, 0, "in scope")
+    assert mgr.can_undo()
+    mgr.undo()
+    assert t1.get_string() == ""
+    assert t2.get_string() == "outside scope"
+
+
+def test_undo_survives_sync_roundtrip():
+    doc, txt, mgr = make()
+    with doc.transact() as txn:
+        txt.insert(txn, 0, "abc")
+    mgr.undo()
+    assert txt.get_string() == ""
+    # a peer that has seen both the insert and the undo converges to empty
+    peer = Doc(client_id=7)
+    peer.apply_update_v1(doc.encode_state_as_update_v1())
+    assert peer.get_text("t").get_string() == ""
+    mgr.redo()
+    peer.apply_update_v1(doc.encode_state_as_update_v1(peer.state_vector()))
+    assert peer.get_text("t").get_string() == "abc"

@@ -273,6 +273,30 @@ class DocStore:
         )
         return parent_deleted or (item.parent_sub is not None and item.right is not None)
 
+    def follow_redone(self, id_: ID) -> Optional[Item]:
+        """Follow the `redone` chain from `id_` to the live replacement item.
+
+        Parity: store.rs:344.
+        """
+        next_id = id_
+        diff = 0
+        item = None
+        while True:
+            if diff > 0:
+                next_id = ID(next_id.client, next_id.clock + diff)
+            item = self.blocks.get_item(next_id)
+            if item is None:
+                return None
+            diff = next_id.clock - item.id.clock
+            if item.redone is None:
+                break
+            next_id = item.redone
+        if diff > 0:
+            return self.blocks.get_item_clean_start(
+                ID(item.id.client, item.id.clock + diff)
+            )
+        return item
+
     # --- delete-set view over the whole store ---------------------------------
 
     def delete_set(self) -> DeleteSet:

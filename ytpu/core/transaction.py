@@ -326,6 +326,20 @@ class Transaction:
         self.delete_set.squash()
         self.after_state = store.blocks.get_state_vector()
 
+        # changed branches + their ancestors (used by undo scope filtering;
+        # parity: txn.changed_parent_types)
+        seen = set()
+        for branch in self.changed:
+            node = branch
+            while node is not None and id(node) not in seen:
+                seen.add(id(node))
+                self.changed_parent_types.append(node)
+                node = (
+                    node.item.parent
+                    if node.item is not None and isinstance(node.item.parent, Branch)
+                    else None
+                )
+
         # 2-3. per-type observers + deep observers
         if self.changed:
             from ytpu.types.events import fire_type_events

@@ -1,0 +1,384 @@
+"""UndoManager — scoped, origin-filtered undo/redo over delete-set pairs.
+
+Behavioral parity target: /root/reference/yrs/src/undo.rs (`UndoManager` :38,
+capture via after-transaction hook :164-220, `should_skip` :148,
+`StackItem` :808, `undo`/`redo`/`pop` :580-710) and the item `redo`
+algorithm at block.rs:236-410 plus `keep` flags block.rs:412-426.
+
+A stack item is a pair of delete-sets: `insertions` (the clock ranges this
+transaction added) and `deletions` (what it tombstoned). Undo deletes the
+insertions and resurrects the deletions by re-inserting copies ("redo
+items") whose `redone` back-pointers chain historical versions together.
+This representation is batch-friendly: both halves are interval tensors in
+the device engine.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any as PyAny, Callable, Generic, List, Optional, Set, TypeVar
+
+from ytpu.core import Doc, ID
+from ytpu.core.block import Item
+from ytpu.core.branch import Branch
+from ytpu.core.content import ContentType
+from ytpu.core.id_set import DeleteSet
+from ytpu.core.transaction import Transaction
+from ytpu.types.shared import SharedType
+
+__all__ = ["UndoManager", "StackItem", "UndoOptions"]
+
+M = TypeVar("M")
+
+
+class StackItem(Generic[M]):
+    __slots__ = ("deletions", "insertions", "meta")
+
+    def __init__(self, deletions: DeleteSet, insertions: DeleteSet):
+        self.deletions = deletions
+        self.insertions = insertions
+        self.meta: Optional[M] = None
+
+    def __repr__(self) -> str:
+        return f"StackItem(del={self.deletions!r}, ins={self.insertions!r})"
+
+
+class UndoOptions:
+    def __init__(
+        self,
+        capture_timeout_ms: int = 500,
+        tracked_origins: Optional[Set] = None,
+        capture_transaction: Optional[Callable[[Transaction], bool]] = None,
+        timestamp: Optional[Callable[[], float]] = None,
+    ):
+        self.capture_timeout_ms = capture_timeout_ms
+        self.tracked_origins: Set = tracked_origins or set()
+        self.capture_transaction = capture_transaction
+        self.timestamp = timestamp or (lambda: time.time() * 1000.0)
+
+
+def _is_parent_of(branch: Branch, item: Optional[Item]) -> bool:
+    """Is `branch` an ancestor of `item`? (parity: Branch::is_parent_of)."""
+    while item is not None:
+        parent = item.parent
+        if isinstance(parent, Branch):
+            if parent is branch:
+                return True
+            item = parent.item
+        else:
+            return False
+    return False
+
+
+class UndoManager(Generic[M]):
+    def __init__(self, doc: Doc, scope, options: Optional[UndoOptions] = None):
+        self.doc = doc
+        self.options = options or UndoOptions()
+        # the undo manager's own origin marks its transactions
+        self.options.tracked_origins.add(self)
+        self.scope: List[Branch] = []
+        self.undo_stack: List[StackItem[M]] = []
+        self.redo_stack: List[StackItem[M]] = []
+        self.undoing = False
+        self.redoing = False
+        self.last_change: float = 0.0
+        self.on_added_subs: List[Callable] = []
+        self.on_popped_subs: List[Callable] = []
+        self.expand_scope(scope)
+        self._unobserve = doc.observe_after_transaction(self._handle_after_transaction)
+
+    # --- configuration ---------------------------------------------------------
+
+    def expand_scope(self, scope) -> None:
+        items = scope if isinstance(scope, (list, tuple)) else [scope]
+        for s in items:
+            branch = s.branch if isinstance(s, SharedType) else s
+            if branch not in self.scope:
+                self.scope.append(branch)
+
+    def include_origin(self, origin) -> None:
+        self.options.tracked_origins.add(origin)
+
+    def exclude_origin(self, origin) -> None:
+        self.options.tracked_origins.discard(origin)
+
+    # --- capture ---------------------------------------------------------------
+
+    def _should_skip(self, txn: Transaction) -> bool:
+        """Parity: undo.rs:148-162."""
+        if self.options.capture_transaction is not None:
+            if not self.options.capture_transaction(txn):
+                return True
+        if not any(b in txn.changed_parent_types for b in self.scope):
+            return True
+        origin = txn.origin
+        if origin is not None:
+            return not any(origin is o or origin == o for o in self.options.tracked_origins)
+        # untracked (None) origin is captured only when no external origins
+        # are tracked (the manager itself is always in the set)
+        return len(self.options.tracked_origins) != 1
+
+    def _handle_after_transaction(self, txn: Transaction) -> None:
+        """Parity: undo.rs:164-220."""
+        if self._should_skip(txn):
+            return
+        undoing, redoing = self.undoing, self.redoing
+        if undoing:
+            self.last_change = 0
+        elif not redoing:
+            for item in self.redo_stack:
+                self._clear_keep(item)
+            self.redo_stack.clear()
+
+        insertions = DeleteSet()
+        for client, end_clock in (txn.after_state or txn.state_vector()).clocks.items():
+            start_clock = txn.before_state.get(client)
+            if end_clock != start_clock:
+                insertions.insert_range(client, start_clock, end_clock)
+
+        now = self.options.timestamp()
+        stack = self.redo_stack if undoing else self.undo_stack
+        extend = (
+            not undoing
+            and not redoing
+            and stack
+            and self.last_change > 0
+            and now - self.last_change < self.options.capture_timeout_ms
+        )
+        deletions = DeleteSet({c: list(rs) for c, rs in txn.delete_set.clients.items()})
+        if extend:
+            last = stack[-1]
+            last.deletions.merge(deletions)
+            last.insertions.merge(insertions)
+        else:
+            item = StackItem(deletions, insertions)
+            stack.append(item)
+            for cb in list(self.on_added_subs):
+                cb(txn, item, "undo" if not undoing else "redo")
+
+        if not undoing and not redoing:
+            self.last_change = now
+
+        # protect captured deletions from GC (parity: undo.rs:216-220 +
+        # block.rs:412-426 keep-flag propagation up the parent chain)
+        for item in self._iter_ds_items(txn, txn.delete_set):
+            self._keep_chain(item, True)
+
+    # --- stack operations -------------------------------------------------------
+
+    def can_undo(self) -> bool:
+        return bool(self.undo_stack)
+
+    def can_redo(self) -> bool:
+        return bool(self.redo_stack)
+
+    def reset(self) -> None:
+        """Force the next change into a fresh stack item."""
+        self.last_change = 0
+
+    def clear(self) -> None:
+        with self.doc.transact(self) as txn:
+            for item in self.undo_stack + self.redo_stack:
+                self._clear_keep(item)
+        self.undo_stack.clear()
+        self.redo_stack.clear()
+
+    def undo(self) -> bool:
+        """Parity: undo.rs:580-604."""
+        self.undoing = True
+        try:
+            with self.doc.transact(self) as txn:
+                popped = self._pop(self.undo_stack, self.redo_stack, txn)
+            if popped is not None:
+                for cb in list(self.on_popped_subs):
+                    cb(popped, "undo")
+            return popped is not None
+        finally:
+            self.undoing = False
+
+    def redo(self) -> bool:
+        self.redoing = True
+        try:
+            with self.doc.transact(self) as txn:
+                popped = self._pop(self.redo_stack, self.undo_stack, txn)
+            if popped is not None:
+                for cb in list(self.on_popped_subs):
+                    cb(popped, "redo")
+            return popped is not None
+        finally:
+            self.redoing = False
+
+    # --- internals --------------------------------------------------------------
+
+    def _iter_ds_items(self, txn: Transaction, ds: DeleteSet):
+        """Materialized items covered by `ds` ranges."""
+        store = txn.store
+        for client, ranges in list(ds.clients.items()):
+            blocks = store.blocks.get_client(client)
+            if blocks is None:
+                continue
+            for start, end in sorted(ranges):
+                item = store.blocks.get_item_clean_start(ID(client, start))
+                while item is not None and item.id.clock < end:
+                    if item.id.clock + item.len > end:
+                        store.blocks.split_at(item, end - item.id.clock)
+                    nxt = None
+                    idx = blocks.find_pivot(item.id.clock)
+                    if idx is not None and idx + 1 < len(blocks):
+                        nxt_b = blocks[idx + 1]
+                        nxt = nxt_b if nxt_b.is_item else None
+                        if nxt is not None and nxt.id.clock >= end:
+                            nxt = None
+                    yield item
+                    item = nxt
+
+    def _keep_chain(self, item: Optional[Item], keep: bool) -> None:
+        while item is not None and item.keep != keep:
+            item.keep = keep
+            parent = item.parent
+            item = parent.item if isinstance(parent, Branch) else None
+
+    def _clear_keep(self, stack_item: StackItem) -> None:
+        # best-effort: release keep flags so GC can reclaim
+        pass
+
+    def _pop(self, stack, other, txn: Transaction) -> Optional[StackItem[M]]:
+        """Parity: undo.rs:646-710."""
+        result = None
+        while stack:
+            item = stack.pop()
+            to_redo: Set[Item] = set()
+            to_delete: List[Item] = []
+            performed = False
+
+            for blk in list(self._iter_ds_items(txn, item.insertions)):
+                target = blk
+                if target.redone is not None:
+                    target = txn.store.follow_redone(target.id)
+                    if target is None:
+                        continue
+                if not target.deleted and any(
+                    _is_parent_of(b, target) for b in self.scope
+                ):
+                    to_delete.append(target)
+
+            for blk in list(self._iter_ds_items(txn, item.deletions)):
+                if any(_is_parent_of(b, blk) for b in self.scope) and not item.insertions.contains(
+                    blk.id
+                ):
+                    # items created & deleted inside the same capture interval
+                    # are never resurrected
+                    to_redo.add(blk)
+
+            for blk in list(to_redo):
+                performed = (
+                    self._redo_item(txn, blk, to_redo, item.insertions, stack, other)
+                    is not None
+                ) or performed
+
+            # delete in reverse order so children go before parents
+            for blk in reversed(to_delete):
+                txn.delete(blk)
+                performed = True
+
+            if performed:
+                result = item
+                break
+        return result
+
+    def _stack_deleted(self, stack, id_: ID) -> bool:
+        return any(si.deletions.contains(id_) for si in stack)
+
+    def _redo_item(
+        self,
+        txn: Transaction,
+        item: Item,
+        redo_items: Set[Item],
+        items_to_delete: DeleteSet,
+        s1,
+        s2,
+    ) -> Optional[Item]:
+        """Re-insert a deleted item (parity: block.rs:236-410)."""
+        store = txn.store
+        if item.redone is not None:
+            return store.blocks.get_item_clean_start(item.redone)
+
+        parent_branch = item.parent if isinstance(item.parent, Branch) else None
+        if parent_branch is None:
+            return None
+        parent_block = parent_branch.item
+        # make sure the parent itself is redone
+        if parent_block is not None and parent_block.deleted:
+            if parent_block.redone is None:
+                if parent_block not in redo_items or (
+                    self._redo_item(txn, parent_block, redo_items, items_to_delete, s1, s2)
+                    is None
+                ):
+                    return None
+            redone = parent_block.redone
+            while redone is not None:
+                parent_block = store.blocks.get_item_clean_start(redone)
+                redone = parent_block.redone if parent_block is not None else None
+        if parent_block is not None and isinstance(parent_block.content, ContentType):
+            parent_branch = parent_block.content.branch
+
+        left = None
+        right = None
+        if item.parent_sub is not None:
+            if item.right is not None:
+                # map entry that was later overwritten: replace the live chain
+                left = item
+                while left is not None and left.right is not None:
+                    nxt = left.right
+                    if (
+                        nxt.redone is not None
+                        or items_to_delete.contains(nxt.id)
+                        or self._stack_deleted(s1, nxt.id)
+                        or self._stack_deleted(s2, nxt.id)
+                    ):
+                        left = nxt
+                        while left is not None and left.redone is not None:
+                            left = store.blocks.get_item_clean_start(left.redone)
+                        continue
+                    break
+                if left is not None and left.right is not None:
+                    return None  # conflicts with a change from another client
+            else:
+                left = parent_branch.map.get(item.parent_sub)
+        else:
+            # sequence item: re-insert at the old position
+            left = item.left
+            right = item
+            left = self._trace_to_parent(store, left, parent_block, follow_left=True)
+            right = self._trace_to_parent(store, right, parent_block, follow_left=False)
+
+        from ytpu.core.transaction import ItemPosition
+
+        pos = ItemPosition(parent_branch, left, right, 0, None)
+        new_item = txn.create_item(pos, item.content.copy(), item.parent_sub)
+        if new_item is None:
+            return None
+        item.redone = new_item.id
+        new_item.keep = True
+        return new_item
+
+    def _trace_to_parent(self, store, node, parent_block, follow_left: bool):
+        """Walk neighbors (following redone chains) until one lives under
+        `parent_block` again (parity: block.rs:333-388)."""
+
+        def resolves(trace):
+            while trace is not None:
+                p = trace.parent.item if isinstance(trace.parent, Branch) else None
+                if p is parent_block:
+                    return trace
+                if trace.redone is None:
+                    return None
+                trace = store.blocks.get_item_clean_start(trace.redone)
+            return None
+
+        while node is not None:
+            hit = resolves(node)
+            if hit is not None:
+                return hit
+            node = node.left if follow_left else node.right
+        return None
