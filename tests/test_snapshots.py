@@ -1,0 +1,82 @@
+"""Snapshots / time travel.
+
+Model: reference store.rs:139-184 (encode_state_from_snapshot),
+transaction.rs:986-1018 (split_by_snapshot), text snapshot diffs.
+"""
+
+import pytest
+
+from ytpu.core import Doc, Snapshot
+
+
+def test_snapshot_roundtrip_wire():
+    d = Doc(client_id=1, skip_gc=True)
+    t = d.get_text("t")
+    with d.transact() as txn:
+        t.insert(txn, 0, "hello")
+    snap = d.snapshot()
+    data = snap.encode_v1()
+    out = Snapshot.decode_v1(data)
+    assert out == snap
+
+
+def test_encode_state_from_snapshot():
+    d = Doc(client_id=1, skip_gc=True)
+    t = d.get_text("t")
+    with d.transact() as txn:
+        t.insert(txn, 0, "hello")
+    snap = d.snapshot()
+    with d.transact() as txn:
+        t.insert(txn, 5, " world")
+        t.remove_range(txn, 0, 1)  # "ello world"
+    assert t.get_string() == "ello world"
+    historical = d.encode_state_from_snapshot(snap)
+    replica = Doc(client_id=2)
+    replica.apply_update_v1(historical)
+    assert replica.get_text("t").get_string() == "hello"
+
+
+def test_encode_state_from_snapshot_requires_skip_gc():
+    d = Doc(client_id=1)  # gc enabled
+    t = d.get_text("t")
+    with d.transact() as txn:
+        t.insert(txn, 0, "x")
+    snap = d.snapshot()
+    with pytest.raises(RuntimeError):
+        d.encode_state_from_snapshot(snap)
+
+
+def test_get_string_at_snapshot():
+    d = Doc(client_id=1, skip_gc=True)
+    t = d.get_text("t")
+    with d.transact() as txn:
+        t.insert(txn, 0, "version one")
+    snap1 = d.snapshot()
+    with d.transact() as txn:
+        t.remove_range(txn, 8, 3)
+        t.insert(txn, 8, "two")
+    snap2 = d.snapshot()
+    with d.transact() as txn:
+        t.insert(txn, 0, "THE ")
+    assert t.get_string() == "THE version two"
+    with d.transact() as txn:
+        assert t.get_string_at(txn, snap1) == "version one"
+        assert t.get_string_at(txn, snap2) == "version two"
+
+
+def test_snapshot_of_multiple_clients():
+    a, b = Doc(client_id=1, skip_gc=True), Doc(client_id=2, skip_gc=True)
+    ta, tb = a.get_text("t"), b.get_text("t")
+    with a.transact() as txn:
+        ta.insert(txn, 0, "aaa")
+    b.apply_update_v1(a.encode_state_as_update_v1())
+    with b.transact() as txn:
+        tb.insert(txn, 3, "bbb")
+    a.apply_update_v1(b.encode_state_as_update_v1(a.state_vector()))
+    snap = a.snapshot()
+    with a.transact() as txn:
+        ta.insert(txn, 6, "ccc")
+    historical = a.encode_state_from_snapshot(snap)
+    replica = Doc(client_id=9)
+    replica.apply_update_v1(historical)
+    assert replica.get_text("t").get_string() == "aaabbb"

@@ -194,6 +194,10 @@ class Doc:
     def snapshot(self) -> Snapshot:
         return self.store.snapshot()
 
+    def encode_state_from_snapshot(self, snapshot: Snapshot) -> bytes:
+        """Encode the document as it looked at `snapshot` (requires skip_gc)."""
+        return self.store.encode_state_from_snapshot(snapshot)
+
     def to_json(self) -> dict:
         from ytpu.types import wrap_branch
 

@@ -346,6 +346,67 @@ class DocStore:
         self.delete_set().encode(w)
         return w
 
+    def write_blocks_to(self, sv: StateVector, w: Writer) -> None:
+        """Encode all blocks *up to* `sv` (snapshot prefix encode).
+
+        Parity: store.rs:153-184.
+        """
+        local_sv = self.blocks.get_state_vector()
+        diff = [
+            (client, min(clock, local_sv.get(client)))
+            for client, clock in sv.clocks.items()
+            if client in local_sv.clocks
+        ]
+        diff.sort(key=lambda e: -e[0])
+        w.write_var_uint(len(diff))
+        for client, clock in diff:
+            blocks = self.blocks.clients[client]
+            clock = min(clock, blocks.clock() + 1)
+            last_idx = blocks.find_pivot(clock - 1)
+            if last_idx is None:
+                continue
+            w.write_var_uint(last_idx + 1)
+            w.write_var_uint(client)
+            w.write_var_uint(0)
+            for i in range(last_idx):
+                blocks[i].encode(w, 0)
+            last = blocks[last_idx]
+            # encode the last block trimmed to end exactly at `clock`
+            end_trim = (last.id.clock + last.len) - clock
+            if end_trim > 0 and last.is_item:
+                head = last.content.copy()
+                head.splice(last.len - end_trim)
+                trimmed = Item(
+                    last.id,
+                    None,
+                    last.origin,
+                    None,
+                    last.right_origin,
+                    last.parent,
+                    last.parent_sub,
+                    head,
+                )
+                trimmed.encode(w, 0)
+            elif end_trim > 0:
+                w.write_u8(0)  # GC
+                w.write_var_uint(last.len - end_trim)
+            else:
+                last.encode(w, 0)
+
+    def encode_state_from_snapshot(self, snapshot: Snapshot) -> bytes:
+        """Historical state encode (time travel). Requires `skip_gc`.
+
+        Parity: store.rs:139-151.
+        """
+        if not self.doc.options.skip_gc:
+            raise RuntimeError(
+                "encode_state_from_snapshot requires a Doc with skip_gc=True"
+            )
+        w = Writer()
+        self.write_blocks_to(snapshot.state_vector, w)
+        snapshot.delete_set.encode(w)
+        return w.to_bytes()
+
     def encode_state_as_update_v1(self, remote_sv: StateVector) -> bytes:
         """Full diff vs `remote_sv`, folding in any pending stashed data.
 

@@ -287,6 +287,23 @@ class Transaction:
     def apply_update_v1(self, data: bytes) -> None:
         self.apply_update(Update.decode_v1(data))
 
+    def split_by_snapshot(self, snapshot: Snapshot) -> None:
+        """Split blocks at snapshot boundaries so historical visibility
+        checks are block-aligned (parity: transaction.rs:986-1018)."""
+        store = self.store
+        for client, clock in snapshot.state_vector.clocks.items():
+            item = store.blocks.get_item(ID(client, clock))
+            if item is not None and item.id.clock < clock:
+                store.blocks.split_at(item, clock - item.id.clock)
+                self.merge_blocks.append(ID(client, clock))
+        for client, ranges in snapshot.delete_set.clients.items():
+            for start, end in ranges:
+                for edge in (start, end):
+                    item = store.blocks.get_item(ID(client, edge))
+                    if item is not None and item.id.clock < edge:
+                        store.blocks.split_at(item, edge - item.id.clock)
+                        self.merge_blocks.append(ID(client, edge))
+
     # --- local inserts ---------------------------------------------------------
 
     def create_item(self, pos: ItemPosition, content, parent_sub: Optional[str]) -> Optional[Item]:

@@ -103,6 +103,27 @@ class Text(SharedType):
     def to_json(self) -> str:
         return self.get_string()
 
+    # --- time travel -----------------------------------------------------------
+
+    def get_string_at(self, txn: Transaction, snapshot) -> str:
+        """Render the text as it was at `snapshot` (parity: the snapshot
+        visibility rule of types/text.rs:569-634: an element is visible iff
+        it was inserted before the snapshot and not deleted by it)."""
+        txn.split_by_snapshot(snapshot)
+        sv = snapshot.state_vector
+        ds = snapshot.delete_set
+        out: List[str] = []
+        item = self.branch.start
+        while item is not None:
+            if (
+                item.id.clock < sv.get(item.id.client)
+                and not ds.contains(item.id)
+                and isinstance(item.content, ContentString)
+            ):
+                out.append(item.content.text)
+            item = item.right
+        return "".join(out)
+
     # --- writes ----------------------------------------------------------------
 
     def insert(self, txn: Transaction, index: int, chunk: str) -> None:
