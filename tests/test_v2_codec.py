@@ -1,0 +1,172 @@
+"""lib0 v2 columnar codec: Yjs byte-capture conformance + v1/v2 cross checks.
+
+Fixtures are Yjs-generated v2 payloads from the reference compatibility
+corpus (/root/reference/yrs/src/tests/compatibility_tests.rs — generating JS
+documented there): map_set :184, array_insert :225, xml_fragment :284,
+utf32_lib0_v2_decoding :321.
+"""
+
+import random
+import string
+
+import pytest
+
+from ytpu.core import Doc, Update
+
+MAP_V2 = bytes(
+    [
+        0, 0, 5, 177, 153, 227, 163, 3, 0, 0, 1, 40, 17, 12, 116, 101, 115, 116,
+        107, 49, 116, 101, 115, 116, 107, 50, 4, 2, 4, 2, 1, 1, 0, 2, 65, 0, 1,
+        2, 0, 119, 2, 118, 49, 119, 2, 118, 50, 0,
+    ]
+)
+MAP_V1 = bytes(
+    [
+        1, 2, 241, 204, 241, 209, 1, 0, 40, 1, 4, 116, 101, 115, 116, 2, 107, 49,
+        1, 119, 2, 118, 49, 40, 1, 4, 116, 101, 115, 116, 2, 107, 50, 1, 119, 2,
+        118, 50, 0,
+    ]
+)
+
+ARRAY_V2 = bytes(
+    [
+        0, 0, 5, 144, 233, 212, 232, 18, 0, 0, 1, 8, 6, 4, 116, 101, 115, 116,
+        4, 1, 1, 0, 1, 2, 1, 1, 0, 119, 1, 97, 119, 1, 98, 0,
+    ]
+)
+
+XML_V2 = bytes(
+    [
+        0, 1, 0, 6, 208, 198, 246, 169, 18, 0, 1, 0, 0, 3, 7, 0, 135, 25, 22,
+        102, 114, 97, 103, 109, 101, 110, 116, 45, 110, 97, 109, 101, 110, 111,
+        100, 101, 45, 110, 97, 109, 101, 13, 9, 1, 1, 2, 6, 3, 0, 1, 2, 0, 0,
+    ]
+)
+
+UTF32_V2 = bytes(
+    [
+        0, 1, 0, 11, 144, 161, 211, 222, 18, 226, 133, 156, 142, 8, 25, 23, 1, 0,
+        4, 6, 0, 14, 0, 16, 14, 1, 2, 14, 4, 2, 4, 2, 20, 4, 10, 8, 10, 8, 10, 1,
+        56, 55, 40, 4, 39, 0, 4, 0, 161, 0, 0, 0, 167, 0, 4, 0, 167, 0, 4, 0,
+        167, 0, 4, 0, 7, 0, 1, 0, 0, 0, 40, 3, 71, 0, 1, 0, 132, 0, 129, 0, 132,
+        0, 129, 0, 132, 0, 129, 0, 132, 0, 129, 0, 132, 0, 129, 0, 132, 237, 1,
+        208, 1, 110, 111, 116, 101, 46, 103, 117, 105, 100, 110, 111, 116, 101,
+        71, 117, 105, 100, 110, 111, 116, 101, 46, 111, 119, 110, 101, 114, 111,
+        119, 110, 101, 114, 110, 111, 116, 101, 46, 116, 121, 112, 101, 110, 111,
+        116, 101, 84, 121, 112, 101, 110, 111, 116, 101, 46, 112, 114, 105, 118,
+        97, 116, 101, 105, 115, 80, 114, 105, 118, 97, 116, 101, 110, 111, 116,
+        101, 46, 99, 114, 101, 97, 116, 101, 84, 105, 109, 101, 99, 114, 101, 97,
+        116, 101, 84, 105, 109, 101, 110, 111, 116, 101, 46, 116, 105, 116, 108,
+        101, 116, 105, 116, 108, 101, 102, 102, 195, 188, 108, 108, 101, 110,
+        102, 195, 188, 108, 104, 108, 101, 110, 102, 195, 188, 104, 108, 101,
+        110, 112, 114, 111, 115, 101, 109, 105, 114, 114, 111, 114, 112, 105,
+        110, 100, 101, 110, 116, 116, 97, 103, 78, 97, 109, 101, 108, 105, 110,
+        101, 72, 101, 105, 103, 104, 116, 98, 95, 105, 100, 229, 156, 168, 227,
+        129, 174, 233, 159, 169, 229, 155, 189, 240, 159, 135, 176, 240, 159,
+        135, 183, 240, 159, 135, 168, 240, 159, 135, 179, 240, 159, 135, 175,
+        240, 159, 135, 181, 9, 8, 10, 5, 9, 8, 12, 9, 15, 74, 0, 5, 1, 6, 7, 6,
+        11, 1, 6, 7, 10, 4, 65, 0, 2, 68, 1, 7, 1, 5, 0, 3, 1, 0, 0, 4, 66, 2,
+        3, 6, 10, 65, 4, 2, 65, 4, 66, 0, 10, 69, 1, 2, 5, 0, 119, 22, 66, 71,
+        108, 122, 109, 85, 106, 50, 84, 82, 45, 108, 100, 106, 102, 113, 49, 90,
+        112, 82, 49, 81, 125, 34, 125, 0, 121, 119, 13, 49, 54, 53, 50, 57, 51,
+        51, 50, 50, 50, 56, 56, 50, 30, 0, 125, 0, 119, 3, 100, 105, 118, 119,
+        0, 119, 11, 74, 88, 98, 65, 83, 97, 45, 97, 57, 50, 106, 1, 226, 130,
+        142, 135, 4, 8, 0, 19, 8, 1, 5, 1, 1, 1, 1, 9, 2, 4, 4, 4, 4, 4,
+    ]
+)
+
+
+def test_map_v2_decode_matches_v1():
+    u1 = Update.decode_v1(MAP_V1)
+    u2 = Update.decode_v2(MAP_V2)
+    assert set(u1.blocks.keys()) == set(u2.blocks.keys())
+    for client in u1.blocks:
+        b1 = list(u1.blocks[client])
+        b2 = list(u2.blocks[client])
+        assert len(b1) == len(b2)
+        for x, y in zip(b1, b2):
+            assert x.id == y.id and x.len == y.len
+            assert x.parent == y.parent and x.parent_sub == y.parent_sub
+            assert type(x.content) is type(y.content)
+
+
+def test_map_v2_apply():
+    doc = Doc(client_id=1)
+    doc.apply_update_v2(MAP_V2)
+    assert doc.get_map("test").to_json() == {"k1": "v1", "k2": "v2"}
+
+
+def test_map_v2_reencode_byte_exact():
+    u = Update.decode_v2(MAP_V2)
+    assert u.encode_v2() == MAP_V2
+
+
+def test_array_v2_apply_and_reencode():
+    doc = Doc(client_id=1)
+    doc.apply_update_v2(ARRAY_V2)
+    assert doc.get_array("test").to_list() == ["a", "b"]
+    assert Update.decode_v2(ARRAY_V2).encode_v2() == ARRAY_V2
+
+
+def test_xml_v2_apply_and_reencode():
+    doc = Doc(client_id=1)
+    doc.apply_update_v2(XML_V2)
+    frag = doc.get_xml_fragment("fragment-name")
+    assert frag.get_string() == "<node-name></node-name>"
+    assert Update.decode_v2(XML_V2).encode_v2() == XML_V2
+
+
+def test_utf32_v2_prosemirror_capture():
+    """Real-world prosemirror v2 capture with astral chars (flag emoji)."""
+    doc = Doc(client_id=1)
+    frag = doc.get_xml_fragment("prosemirror")
+    doc.apply_update_v2(UTF32_V2)
+    el = frag.get(0)
+    attrs = dict(el.attributes())
+    assert attrs == {
+        "b_id": "JXbASa-a92j",
+        "indent": "0",
+        "tagName": "div",
+        "lineHeight": "",
+    }
+    txt = el.get(0)
+    assert txt.get_string() == "在の韩国🇰🇷🇨🇳🇯🇵"
+
+
+def test_v1_v2_cross_roundtrip_random_docs():
+    rng = random.Random(42)
+    for trial in range(5):
+        doc = Doc(client_id=trial + 1)
+        t = doc.get_text("t")
+        m = doc.get_map("m")
+        arr = doc.get_array("a")
+        with doc.transact() as txn:
+            for _ in range(rng.randint(3, 10)):
+                word = "".join(rng.choice(string.ascii_lowercase) for _ in range(4))
+                t.insert(txn, rng.randint(0, len(t)), word)
+                m.insert(txn, rng.choice("abc"), rng.randint(0, 99))
+                arr.push_back(txn, word)
+        with doc.transact() as txn:
+            t.remove_range(txn, 0, 2)
+        # encode v2 → decode v2 → fresh doc must equal v1 path
+        v2 = doc.encode_state_as_update_v2()
+        v1 = doc.encode_state_as_update_v1()
+        d_v2, d_v1 = Doc(client_id=100), Doc(client_id=101)
+        d_v2.apply_update_v2(v2)
+        d_v1.apply_update_v1(v1)
+        assert d_v2.to_json() == d_v1.to_json() == doc.to_json()
+        # v2 is the columnar format: it should not be larger than v1 for
+        # repetitive block runs (sanity, not a strict guarantee)
+        assert isinstance(v2, bytes) and len(v2) > 0
+
+
+def test_v2_update_event_payload():
+    doc = Doc(client_id=1)
+    log = []
+    t = doc.get_text("t")
+    with doc.transact() as txn:
+        t.insert(txn, 0, "v2 event")
+        payload = txn.encode_update_v2()
+    d2 = Doc(client_id=2)
+    d2.apply_update_v2(payload)
+    assert d2.get_text("t").get_string() == "v2 event"

@@ -14,8 +14,6 @@ from __future__ import annotations
 
 from typing import Optional, Union
 
-from ytpu.encoding.lib0 import Writer
-
 from .branch import Branch
 from .content import (
     BLOCK_GC,
@@ -55,9 +53,9 @@ class GCRange:
     def last_id(self) -> ID:
         return ID(self.id.client, self.id.clock + self.len - 1)
 
-    def encode(self, w: Writer, offset: int = 0) -> None:
-        w.write_u8(BLOCK_GC)
-        w.write_var_uint(self.len - offset)
+    def encode(self, enc, offset: int = 0) -> None:
+        enc.write_info(BLOCK_GC)
+        enc.write_len(self.len - offset)
 
     def __repr__(self) -> str:
         return f"GC{self.id}+{self.len}"
@@ -74,9 +72,10 @@ class SkipRange:
         self.id = id_
         self.len = length
 
-    def encode(self, w: Writer, offset: int = 0) -> None:
-        w.write_u8(BLOCK_SKIP)
-        w.write_var_uint(self.len - offset)
+    def encode(self, enc, offset: int = 0) -> None:
+        enc.write_info(BLOCK_SKIP)
+        # skip lengths ride the main stream, not the len column (update.rs:437)
+        enc.write_var(self.len - offset)
 
     def __repr__(self) -> str:
         return f"Skip{self.id}+{self.len}"
@@ -154,7 +153,7 @@ class Item:
 
     # --- wire (v1) ---
 
-    def encode(self, w: Writer, offset: int = 0) -> None:
+    def encode(self, enc, offset: int = 0) -> None:
         """Encode, optionally skipping the first `offset` clock units.
 
         Parity: block.rs:868-908 (plain) and the partial-block slice encode
@@ -170,40 +169,36 @@ class Item:
             | (HAS_RIGHT_ORIGIN if self.right_origin is not None else 0)
             | (HAS_PARENT_SUB if self.parent_sub is not None else 0)
         )
-        w.write_u8(info)
+        enc.write_info(info)
         if origin is not None:
-            w.write_var_uint(origin.client)
-            w.write_var_uint(origin.clock)
+            enc.write_left_id(origin)
         if self.right_origin is not None:
-            w.write_var_uint(self.right_origin.client)
-            w.write_var_uint(self.right_origin.clock)
+            enc.write_right_id(self.right_origin)
         if origin is None and self.right_origin is None:
             parent = self.parent
             if isinstance(parent, Branch):
                 if parent.item is not None:
-                    w.write_var_uint(0)
-                    w.write_var_uint(parent.item.id.client)
-                    w.write_var_uint(parent.item.id.clock)
+                    enc.write_parent_info(False)
+                    enc.write_left_id(parent.item.id)
                 else:
-                    w.write_var_uint(1)
-                    w.write_string(parent.name or "")
+                    enc.write_parent_info(True)
+                    enc.write_string(parent.name or "")
             elif isinstance(parent, ID):
-                w.write_var_uint(0)
-                w.write_var_uint(parent.client)
-                w.write_var_uint(parent.clock)
+                enc.write_parent_info(False)
+                enc.write_left_id(parent)
             elif isinstance(parent, str):
-                w.write_var_uint(1)
-                w.write_string(parent)
+                enc.write_parent_info(True)
+                enc.write_string(parent)
             else:
                 raise ValueError(f"cannot encode item {self.id}: unknown parent")
             if self.parent_sub is not None:
-                w.write_string(self.parent_sub)
+                enc.write_string(self.parent_sub)
         if offset > 0:
             head = self.content.copy()
             tail = head.splice(offset)  # splice keeps the head, returns the tail
-            tail.encode(w)
+            tail.encode(enc)
         else:
-            self.content.encode(w)
+            self.content.encode(enc)
 
     # --- splitting & squashing ---
 

@@ -105,11 +105,11 @@ class Branch:
 
     # --- wire ---
 
-    def encode_type_ref(self, w: Writer) -> None:
-        """Parity: types/mod.rs:118-158 (v1 writes the tag as a single byte)."""
-        w.write_u8(self.type_ref)
+    def encode_type_ref(self, enc) -> None:
+        """Parity: types/mod.rs:118-158."""
+        enc.write_type_ref(self.type_ref)
         if self.type_ref in (TYPE_XML_ELEMENT, TYPE_XML_HOOK):
-            w.write_string(self.type_name or "")
+            enc.write_key(self.type_name or "")
         elif self.type_ref == TYPE_WEAK:
             src = self.link_source
             info = 0 if src.is_single() else 1
@@ -117,25 +117,25 @@ class Branch:
                 info |= 2
             if src.quote_end.assoc == ASSOC_AFTER:
                 info |= 4
-            w.write_u8(info)
-            w.write_var_uint(src.quote_start.id.client)
-            w.write_var_uint(src.quote_start.id.clock)
+            enc.write_u8(info)
+            enc.write_var(src.quote_start.id.client)
+            enc.write_var(src.quote_start.id.clock)
             if not src.is_single():
-                w.write_var_uint(src.quote_end.id.client)
-                w.write_var_uint(src.quote_end.id.clock)
+                enc.write_var(src.quote_end.id.client)
+                enc.write_var(src.quote_end.id.clock)
 
     @classmethod
-    def decode_type_ref(cls, cur: Cursor) -> "Branch":
-        tag = cur.read_u8()
+    def decode_type_ref(cls, dec) -> "Branch":
+        tag = dec.read_type_ref()
         if tag in (TYPE_XML_ELEMENT, TYPE_XML_HOOK):
-            return cls(tag, type_name=cur.read_string())
+            return cls(tag, type_name=dec.read_key())
         if tag == TYPE_WEAK:
-            flags = cur.read_u8()
+            flags = dec.read_u8()
             single = flags & 1 == 0
             start_assoc = ASSOC_AFTER if flags & 2 else ASSOC_BEFORE
             end_assoc = ASSOC_AFTER if flags & 4 else ASSOC_BEFORE
-            start_id = ID(cur.read_var_uint(), cur.read_var_uint())
-            end_id = start_id if single else ID(cur.read_var_uint(), cur.read_var_uint())
+            start_id = ID(dec.read_var(), dec.read_var())
+            end_id = start_id if single else ID(dec.read_var(), dec.read_var())
             src = LinkSource(
                 StickyIndex.from_id(start_id, start_assoc),
                 StickyIndex.from_id(end_id, end_assoc),

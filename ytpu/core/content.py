@@ -17,14 +17,7 @@ from __future__ import annotations
 import json
 from typing import Any as PyAny, List, Optional, Tuple
 
-from ytpu.encoding.lib0 import (
-    Cursor,
-    Writer,
-    any_from_json,
-    any_to_json,
-    read_any,
-    write_any,
-)
+from ytpu.encoding.lib0 import Cursor, Writer
 
 __all__ = [
     "BLOCK_GC",
@@ -115,7 +108,7 @@ class Content:
         """Try to append `other` (right neighbor's content). True on success."""
         return False
 
-    def encode(self, w: Writer) -> None:
+    def encode(self, enc) -> None:
         raise NotImplementedError
 
     def values(self) -> List[PyAny]:
@@ -148,8 +141,8 @@ class ContentDeleted(Content):
             return True
         return False
 
-    def encode(self, w: Writer) -> None:
-        w.write_var_uint(self.len)
+    def encode(self, enc) -> None:
+        enc.write_len(self.len)
 
     def copy(self) -> "ContentDeleted":
         return ContentDeleted(self.len)
@@ -182,10 +175,10 @@ class ContentJSON(Content):
             return True
         return False
 
-    def encode(self, w: Writer) -> None:
-        w.write_var_uint(len(self.raw))
+    def encode(self, enc) -> None:
+        enc.write_len(len(self.raw))
         for s in self.raw:
-            w.write_string(s)
+            enc.write_string(s)
 
     def values(self) -> List[PyAny]:
         out = []
@@ -214,8 +207,8 @@ class ContentBinary(Content):
     def length(self) -> int:
         return 1
 
-    def encode(self, w: Writer) -> None:
-        w.write_buf(self.data)
+    def encode(self, enc) -> None:
+        enc.write_buf(self.data)
 
     def values(self) -> List[PyAny]:
         return [self.data]
@@ -252,8 +245,8 @@ class ContentString(Content):
             return True
         return False
 
-    def encode(self, w: Writer) -> None:
-        w.write_string(self.text)
+    def encode(self, enc) -> None:
+        enc.write_string(self.text)
 
     def values(self) -> List[PyAny]:
         return list(self.text)
@@ -276,8 +269,8 @@ class ContentEmbed(Content):
     def length(self) -> int:
         return 1
 
-    def encode(self, w: Writer) -> None:
-        w.write_string(any_to_json(self.value))
+    def encode(self, enc) -> None:
+        enc.write_json(self.value)
 
     def values(self) -> List[PyAny]:
         return [self.value]
@@ -301,9 +294,9 @@ class ContentFormat(Content):
     def length(self) -> int:
         return 1
 
-    def encode(self, w: Writer) -> None:
-        w.write_string(self.key)
-        w.write_string(any_to_json(self.value))
+    def encode(self, enc) -> None:
+        enc.write_key(self.key)
+        enc.write_json(self.value)
 
     def copy(self) -> "ContentFormat":
         return ContentFormat(self.key, self.value)
@@ -325,8 +318,8 @@ class ContentType(Content):
     def length(self) -> int:
         return 1
 
-    def encode(self, w: Writer) -> None:
-        self.branch.encode_type_ref(w)
+    def encode(self, enc) -> None:
+        self.branch.encode_type_ref(enc)
 
     def values(self) -> List[PyAny]:
         return [self.branch]
@@ -361,10 +354,10 @@ class ContentAny(Content):
             return True
         return False
 
-    def encode(self, w: Writer) -> None:
-        w.write_var_uint(len(self.items))
+    def encode(self, enc) -> None:
+        enc.write_len(len(self.items))
         for v in self.items:
-            write_any(w, v)
+            enc.write_any(v)
 
     def values(self) -> List[PyAny]:
         return list(self.items)
@@ -389,8 +382,8 @@ class ContentDoc(Content):
     def length(self) -> int:
         return 1
 
-    def encode(self, w: Writer) -> None:
-        self.doc.options.encode(w)
+    def encode(self, enc) -> None:
+        self.doc.options.encode(enc)
 
     def values(self) -> List[PyAny]:
         return [self.doc]
@@ -415,8 +408,8 @@ class ContentMove(Content):
     def length(self) -> int:
         return 1
 
-    def encode(self, w: Writer) -> None:
-        self.move.encode(w)
+    def encode(self, enc) -> None:
+        self.move.encode(enc)
 
     def copy(self) -> "ContentMove":
         return ContentMove(self.move.copy())
@@ -425,37 +418,37 @@ class ContentMove(Content):
         return f"Move({self.move})"
 
 
-def decode_content(cur: Cursor, info: int, decode_branch, decode_doc, decode_move) -> Content:
-    """Decode an item's content given its info byte.
+def decode_content(dec, info: int, decode_branch, decode_doc, decode_move) -> Content:
+    """Decode an item's content given its info byte and a v1/v2 decoder.
 
-    `decode_branch(cur)` / `decode_doc(cur)` / `decode_move(cur)` are injected
+    `decode_branch(dec)` / `decode_doc(dec)` / `decode_move(dec)` are injected
     to avoid circular imports with the branch/doc/move modules.
     Parity: block.rs:1786-1835 (note: the reference masks with 0b1111).
     """
     ref = info & 0b1111
     if ref == CONTENT_DELETED:
-        return ContentDeleted(cur.read_var_uint())
+        return ContentDeleted(dec.read_len())
     if ref == CONTENT_JSON:
         # Note: Yjs writes n then n JSON strings; yrs's decoder (block.rs:1790-1797)
         # reads n+1 which is asymmetric with its own encoder — we follow Yjs.
-        n = cur.read_var_uint()
-        return ContentJSON([cur.read_string() for _ in range(n)])
+        n = dec.read_len()
+        return ContentJSON([dec.read_string() for _ in range(n)])
     if ref == CONTENT_BINARY:
-        return ContentBinary(cur.read_buf())
+        return ContentBinary(dec.read_buf())
     if ref == CONTENT_STRING:
-        return ContentString(cur.read_string())
+        return ContentString(dec.read_string())
     if ref == CONTENT_EMBED:
-        return ContentEmbed(any_from_json(cur.read_string()))
+        return ContentEmbed(dec.read_json())
     if ref == CONTENT_FORMAT:
-        key = cur.read_string()
-        return ContentFormat(key, any_from_json(cur.read_string()))
+        key = dec.read_key()
+        return ContentFormat(key, dec.read_json())
     if ref == CONTENT_TYPE:
-        return ContentType(decode_branch(cur))
+        return ContentType(decode_branch(dec))
     if ref == CONTENT_ANY:
-        n = cur.read_var_uint()
-        return ContentAny([read_any(cur) for _ in range(n)])
+        n = dec.read_len()
+        return ContentAny([dec.read_any() for _ in range(n)])
     if ref == CONTENT_DOC:
-        return ContentDoc(decode_doc(cur))
+        return ContentDoc(decode_doc(dec))
     if ref == CONTENT_MOVE:
-        return ContentMove(decode_move(cur))
+        return ContentMove(decode_move(dec))
     raise ValueError(f"unexpected content ref {ref}")

@@ -69,33 +69,24 @@ class Options:
         self.auto_load = auto_load
         self.should_load = should_load
 
-    def encode(self, w: Writer) -> None:
+    def encode(self, enc) -> None:
         """Parity: doc.rs:814-845."""
-        w.write_string(self.guid)
+        from ytpu.encoding.lib0 import BigInt
+
+        enc.write_string(self.guid)
         m: Dict[str, object] = {"gc": not self.skip_gc}
         if self.collection_id is not None:
             m["collectionId"] = self.collection_id
-        m["encoding"] = 2**53 + (1 if self.offset_kind == OFFSET_BYTES else 0)
+        m["encoding"] = BigInt(1 if self.offset_kind == OFFSET_BYTES else 0)
         m["autoLoad"] = self.auto_load
         m["shouldLoad"] = self.should_load
-        # "encoding" must encode as BigInt; bump it out of the safe-int range
-        # is a hack — write explicitly instead:
-        del m["encoding"]
-        w.write_u8(118)  # Any map tag
-        items = list(m.items())
-        w.write_var_uint(len(items) + 1)
-        for key, value in items:
-            w.write_string(key)
-            write_any(w, value)
-        w.write_string("encoding")
-        w.write_u8(122)  # BigInt tag
-        w.write_i64(1 if self.offset_kind == OFFSET_BYTES else 0)
+        enc.write_any(m)
 
     @classmethod
-    def decode(cls, cur: Cursor) -> "Options":
-        guid = cur.read_string()
+    def decode(cls, dec) -> "Options":
+        guid = dec.read_string()
         opts = cls(guid=guid, should_load=False)
-        m = read_any(cur)
+        m = dec.read_any()
         if isinstance(m, dict):
             if isinstance(m.get("gc"), bool):
                 opts.skip_gc = not m["gc"]
@@ -185,8 +176,15 @@ class Doc:
         with self.transact(origin) as txn:
             txn.apply_update(Update.decode_v1(data))
 
+    def apply_update_v2(self, data: bytes, origin=None) -> None:
+        with self.transact(origin) as txn:
+            txn.apply_update(Update.decode_v2(data))
+
     def encode_state_as_update_v1(self, remote_sv: Optional[StateVector] = None) -> bytes:
         return self.store.encode_state_as_update_v1(remote_sv or StateVector())
+
+    def encode_state_as_update_v2(self, remote_sv: Optional[StateVector] = None) -> bytes:
+        return self.store.encode_state_as_update_v2(remote_sv or StateVector())
 
     def state_vector(self) -> StateVector:
         return self.store.blocks.get_state_vector()

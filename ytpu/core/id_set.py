@@ -116,43 +116,49 @@ class IdSet:
             parts.append(f"{client}:{rr}")
         return f"{type(self).__name__}({'; '.join(parts)})"
 
-    # --- wire format (v1): clients count, then per client: id, range count,
-    # (clock, len) pairs ---
+    # --- wire format: clients count, then per client: id, range count,
+    # (clock, len) pairs (v2 delta-encodes clocks via the ds channel) ---
 
-    def encode(self, w: Optional[Writer] = None) -> Writer:
-        w = w if w is not None else Writer()
+    def encode(self, enc) -> None:
         entries = [(c, _squash_ranges(rs)) for c, rs in self.clients.items() if rs]
         entries.sort(key=lambda e: -e[0])
-        w.write_var_uint(len(entries))
+        enc.write_var(len(entries))
         for client, rs in entries:
-            w.write_var_uint(client)
-            w.write_var_uint(len(rs))
+            enc.reset_ds_cur_val()
+            enc.write_var(client)
+            enc.write_var(len(rs))
             for start, end in rs:
-                w.write_var_uint(start)
-                w.write_var_uint(end - start)
-        return w
+                enc.write_ds_clock(start)
+                enc.write_ds_len(end - start)
 
     def encode_v1(self) -> bytes:
-        return self.encode().to_bytes()
+        from ytpu.encoding.codec import EncoderV1
+
+        enc = EncoderV1()
+        self.encode(enc)
+        return enc.to_bytes()
 
     @classmethod
-    def decode(cls, cur: Cursor) -> "IdSet":
-        n_clients = cur.read_var_uint()
+    def decode(cls, dec) -> "IdSet":
+        n_clients = dec.read_var()
         out = cls()
         for _ in range(n_clients):
-            client = cur.read_var_uint()
-            n_ranges = cur.read_var_uint()
+            dec.reset_ds_cur_val()
+            client = dec.read_var()
+            n_ranges = dec.read_var()
             rs = out.clients.setdefault(client, [])
             for _ in range(n_ranges):
-                clock = cur.read_var_uint()
-                length = cur.read_var_uint()
+                clock = dec.read_ds_clock()
+                length = dec.read_ds_len()
                 if length:
                     rs.append((clock, clock + length))
         return out
 
     @classmethod
     def decode_v1(cls, data: bytes) -> "IdSet":
-        return cls.decode(Cursor(data))
+        from ytpu.encoding.codec import DecoderV1
+
+        return cls.decode(DecoderV1(data))
 
 
 class DeleteSet(IdSet):

@@ -80,7 +80,7 @@ class Move:
     def copy(self) -> "Move":
         return Move(self.start, self.end, self.priority)
 
-    def encode(self, w: Writer) -> None:
+    def encode(self, enc) -> None:
         collapsed = self.is_collapsed()
         flags = 0
         if collapsed:
@@ -90,22 +90,22 @@ class Move:
         if self.end.assoc == ASSOC_AFTER:
             flags |= 0b100
         flags |= self.priority << 6
-        w.write_var_uint(flags)
-        w.write_var_uint(self.start.id.client)
-        w.write_var_uint(self.start.id.clock)
+        enc.write_var(flags)
+        enc.write_var(self.start.id.client)
+        enc.write_var(self.start.id.clock)
         if not collapsed:
-            w.write_var_uint(self.end.id.client)
-            w.write_var_uint(self.end.id.clock)
+            enc.write_var(self.end.id.client)
+            enc.write_var(self.end.id.clock)
 
     @classmethod
-    def decode(cls, cur: Cursor) -> "Move":
-        flags = cur.read_var_uint()
+    def decode(cls, dec) -> "Move":
+        flags = dec.read_var()
         collapsed = flags & 0b001 != 0
         start_assoc = ASSOC_AFTER if flags & 0b010 else ASSOC_BEFORE
         end_assoc = ASSOC_AFTER if flags & 0b100 else ASSOC_BEFORE
         priority = flags >> 6
-        start_id = ID(cur.read_var_uint(), cur.read_var_uint())
-        end_id = start_id if collapsed else ID(cur.read_var_uint(), cur.read_var_uint())
+        start_id = ID(dec.read_var(), dec.read_var())
+        end_id = start_id if collapsed else ID(dec.read_var(), dec.read_var())
         return cls(
             StickyIndex.from_id(start_id, start_assoc),
             StickyIndex.from_id(end_id, end_assoc),

@@ -131,16 +131,20 @@ class Snapshot:
         )
 
     def encode_v1(self) -> bytes:
-        w = Writer()
-        self.delete_set.encode(w)
-        self.state_vector.encode(w)
-        return w.to_bytes()
+        from ytpu.encoding.codec import EncoderV1
+
+        enc = EncoderV1()
+        self.delete_set.encode(enc)
+        self.state_vector.encode(enc.w)
+        return enc.to_bytes()
 
     @classmethod
     def decode_v1(cls, data: bytes) -> "Snapshot":
+        from ytpu.encoding.codec import DecoderV1
+
         from .id_set import DeleteSet
 
-        cur = Cursor(data)
-        ds = DeleteSet.decode(cur)
-        sv = StateVector.decode(cur)
+        dec = DecoderV1(data)
+        ds = DeleteSet.decode(dec)
+        sv = StateVector.decode(dec.cur)
         return cls(sv, ds)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Set, Tuple
 
+from ytpu.encoding.codec import DecoderV1, DecoderV2, EncoderV1, EncoderV2
 from ytpu.encoding.lib0 import Writer
 
 from .block import GCRange, Item, SkipRange
@@ -314,7 +315,7 @@ class DocStore:
 
     # --- diff encoding (parity: store.rs:194-248) ------------------------------
 
-    def write_blocks_from(self, remote_sv: StateVector, w: Writer) -> None:
+    def write_blocks_from(self, remote_sv: StateVector, enc) -> None:
         local_sv = self.blocks.get_state_vector()
         # clients whose local clock is ahead of the remote's view
         diff: List[Tuple[ClientID, int]] = []
@@ -324,7 +325,7 @@ class DocStore:
                 diff.append((client, remote_clock))
         # higher client ids first — "heavily improves the conflict algorithm"
         diff.sort(key=lambda e: -e[0])
-        w.write_var_uint(len(diff))
+        enc.write_var(len(diff))
         for client, remote_clock in diff:
             lst = self.blocks.clients[client]
             pivot = lst.find_pivot(remote_clock) if remote_clock > 0 else 0
@@ -333,20 +334,28 @@ class DocStore:
             count = len(lst) - pivot
             first = lst[pivot]
             offset = max(0, remote_clock - first.id.clock)
-            w.write_var_uint(count)
-            w.write_var_uint(client)
-            w.write_var_uint(first.id.clock + offset)
-            first.encode(w, offset)
+            enc.write_var(count)
+            enc.write_client(client)
+            enc.write_var(first.id.clock + offset)
+            first.encode(enc, offset)
             for i in range(pivot + 1, len(lst)):
-                lst[i].encode(w, 0)
+                lst[i].encode(enc, 0)
 
-    def encode_diff(self, remote_sv: StateVector, w: Optional[Writer] = None) -> Writer:
-        w = w if w is not None else Writer()
-        self.write_blocks_from(remote_sv, w)
-        self.delete_set().encode(w)
-        return w
+    def encode_diff(self, remote_sv: StateVector, enc) -> None:
+        self.write_blocks_from(remote_sv, enc)
+        self.delete_set().encode(enc)
 
-    def write_blocks_to(self, sv: StateVector, w: Writer) -> None:
+    def encode_diff_v1(self, remote_sv: StateVector) -> bytes:
+        enc = EncoderV1()
+        self.encode_diff(remote_sv, enc)
+        return enc.to_bytes()
+
+    def encode_diff_v2(self, remote_sv: StateVector) -> bytes:
+        enc = EncoderV2()
+        self.encode_diff(remote_sv, enc)
+        return enc.to_bytes()
+
+    def write_blocks_to(self, sv: StateVector, enc) -> None:
         """Encode all blocks *up to* `sv` (snapshot prefix encode).
 
         Parity: store.rs:153-184.
@@ -358,18 +367,18 @@ class DocStore:
             if client in local_sv.clocks
         ]
         diff.sort(key=lambda e: -e[0])
-        w.write_var_uint(len(diff))
+        enc.write_var(len(diff))
         for client, clock in diff:
             blocks = self.blocks.clients[client]
             clock = min(clock, blocks.clock() + 1)
             last_idx = blocks.find_pivot(clock - 1)
             if last_idx is None:
                 continue
-            w.write_var_uint(last_idx + 1)
-            w.write_var_uint(client)
-            w.write_var_uint(0)
+            enc.write_var(last_idx + 1)
+            enc.write_client(client)
+            enc.write_var(0)
             for i in range(last_idx):
-                blocks[i].encode(w, 0)
+                blocks[i].encode(enc, 0)
             last = blocks[last_idx]
             # encode the last block trimmed to end exactly at `clock`
             end_trim = (last.id.clock + last.len) - clock
@@ -386,12 +395,12 @@ class DocStore:
                     last.parent_sub,
                     head,
                 )
-                trimmed.encode(w, 0)
+                trimmed.encode(enc, 0)
             elif end_trim > 0:
-                w.write_u8(0)  # GC
-                w.write_var_uint(last.len - end_trim)
+                enc.write_info(0)  # GC
+                enc.write_len(last.len - end_trim)
             else:
-                last.encode(w, 0)
+                last.encode(enc, 0)
 
     def encode_state_from_snapshot(self, snapshot: Snapshot) -> bytes:
         """Historical state encode (time travel). Requires `skip_gc`.
@@ -402,23 +411,32 @@ class DocStore:
             raise RuntimeError(
                 "encode_state_from_snapshot requires a Doc with skip_gc=True"
             )
-        w = Writer()
-        self.write_blocks_to(snapshot.state_vector, w)
-        snapshot.delete_set.encode(w)
-        return w.to_bytes()
+        enc = EncoderV1()
+        self.write_blocks_to(snapshot.state_vector, enc)
+        snapshot.delete_set.encode(enc)
+        return enc.to_bytes()
 
-    def encode_state_as_update_v1(self, remote_sv: StateVector) -> bytes:
+    def _encode_state_as_update(self, remote_sv: StateVector, v2: bool) -> bytes:
         """Full diff vs `remote_sv`, folding in any pending stashed data.
 
-        Parity: transaction.rs:73-93 + merge_pending_v1 :247-263.
+        Parity: transaction.rs:73-93 + merge_pending_v1/v2 :247-281.
         """
-        base = self.encode_diff(remote_sv).to_bytes()
+        base = self.encode_diff_v2(remote_sv) if v2 else self.encode_diff_v1(remote_sv)
+        decode = Update.decode_v2 if v2 else Update.decode_v1
         to_merge: List[Update] = []
         if self.pending is not None:
+            # round-trip for a deep copy: merge() splits carriers in place
             to_merge.append(Update.decode_v1(self.pending.update.encode_v1()))
         if self.pending_ds is not None:
             to_merge.append(Update(None, DeleteSet(dict(self.pending_ds.clients))))
         if not to_merge:
             return base
-        to_merge.insert(0, Update.decode_v1(base))
-        return Update.merge(to_merge).encode_v1()
+        to_merge.insert(0, decode(base))
+        merged = Update.merge(to_merge)
+        return merged.encode_v2() if v2 else merged.encode_v1()
+
+    def encode_state_as_update_v1(self, remote_sv: StateVector) -> bytes:
+        return self._encode_state_as_update(remote_sv, v2=False)
+
+    def encode_state_as_update_v2(self, remote_sv: StateVector) -> bytes:
+        return self._encode_state_as_update(remote_sv, v2=True)

@@ -123,17 +123,30 @@ class Transaction:
         return self.store.encode_state_as_update_v1(remote_sv or StateVector())
 
     def encode_diff_v1(self, remote_sv: StateVector) -> bytes:
-        return self.store.encode_diff(remote_sv).to_bytes()
+        return self.store.encode_diff_v1(remote_sv)
+
+    def encode_diff_v2(self, remote_sv: StateVector) -> bytes:
+        return self.store.encode_diff_v2(remote_sv)
 
     def encode_update_v1(self) -> bytes:
         """This transaction's own delta (the update-event payload).
 
         Parity: transaction.rs:464-468.
         """
-        w = Writer()
-        self.store.write_blocks_from(self.before_state, w)
-        self.delete_set.encode(w)
-        return w.to_bytes()
+        from ytpu.encoding.codec import EncoderV1
+
+        enc = EncoderV1()
+        self.store.write_blocks_from(self.before_state, enc)
+        self.delete_set.encode(enc)
+        return enc.to_bytes()
+
+    def encode_update_v2(self) -> bytes:
+        from ytpu.encoding.codec import EncoderV2
+
+        enc = EncoderV2()
+        self.store.write_blocks_from(self.before_state, enc)
+        self.delete_set.encode(enc)
+        return enc.to_bytes()
 
     # --- change tracking -------------------------------------------------------
 

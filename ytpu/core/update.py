@@ -20,6 +20,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple, Union
 
+from ytpu.encoding.codec import DecoderV1, DecoderV2, EncoderV1, EncoderV2
 from ytpu.encoding.lib0 import Cursor, Writer
 
 from .block import GCRange, Item, SkipRange
@@ -35,8 +36,11 @@ __all__ = [
     "PendingUpdate",
     "decode_update_v1",
     "merge_updates_v1",
+    "merge_updates_v2",
     "encode_state_vector_from_update_v1",
+    "encode_state_vector_from_update_v2",
     "diff_updates_v1",
+    "diff_updates_v2",
 ]
 
 Carrier = Union[Item, GCRange, SkipRange]
@@ -82,40 +86,50 @@ class Update:
                 sv.set_max(client, last.id.clock + last.len)
         return sv
 
-    # --- decoding (v1) ---
+    # --- decoding ---
 
     @classmethod
-    def decode(cls, cur: Cursor) -> "Update":
-        n_clients = cur.read_var_uint()
+    def decode(cls, dec) -> "Update":
+        n_clients = dec.read_var()
         blocks: Dict[ClientID, Deque[Carrier]] = {}
         for _ in range(n_clients):
-            n_blocks = cur.read_var_uint()
-            client = cur.read_var_uint()
-            clock = cur.read_var_uint()
+            n_blocks = dec.read_var()
+            client = dec.read_client()
+            clock = dec.read_var()
             dq = blocks.setdefault(client, deque())
             for _ in range(n_blocks):
-                carrier = _decode_block(ID(client, clock), cur)
+                carrier = _decode_block(ID(client, clock), dec)
                 if carrier is not None:
                     clock += carrier.len
                     dq.append(carrier)
-        delete_set = DeleteSet.decode(cur)
+        delete_set = DeleteSet.decode(dec)
         return cls(blocks, delete_set)
 
     @classmethod
     def decode_v1(cls, data: bytes) -> "Update":
-        return cls.decode(Cursor(data))
+        return cls.decode(DecoderV1(data))
 
-    # --- encoding (v1) ---
+    @classmethod
+    def decode_v2(cls, data: bytes) -> "Update":
+        return cls.decode(DecoderV2(data))
 
-    def encode(self, w: Optional[Writer] = None) -> Writer:
-        return self.encode_diff(StateVector(), w)
+    # --- encoding ---
+
+    def encode(self, enc) -> None:
+        self.encode_diff(StateVector(), enc)
 
     def encode_v1(self) -> bytes:
-        return self.encode().to_bytes()
+        enc = EncoderV1()
+        self.encode(enc)
+        return enc.to_bytes()
 
-    def encode_diff(self, remote_sv: StateVector, w: Optional[Writer] = None) -> Writer:
+    def encode_v2(self) -> bytes:
+        enc = EncoderV2()
+        self.encode(enc)
+        return enc.to_bytes()
+
+    def encode_diff(self, remote_sv: StateVector, enc) -> None:
         """Encode only what `remote_sv` is missing (parity: update.rs:490-535)."""
-        w = w if w is not None else Writer()
         per_client: List[Tuple[ClientID, int, List[Carrier]]] = []
         for client, blocks in self.blocks.items():
             remote_clock = remote_sv.get(client)
@@ -133,16 +147,20 @@ class Update:
             if out:
                 per_client.append((client, offset, out))
         per_client.sort(key=lambda e: -e[0])  # higher clients first
-        w.write_var_uint(len(per_client))
+        enc.write_var(len(per_client))
         for client, offset, out in per_client:
-            w.write_var_uint(len(out))
-            w.write_var_uint(client)
-            w.write_var_uint(out[0].id.clock + offset)
-            out[0].encode(w, offset)
+            enc.write_var(len(out))
+            enc.write_client(client)
+            enc.write_var(out[0].id.clock + offset)
+            out[0].encode(enc, offset)
             for block in out[1:]:
-                block.encode(w, 0)
-        self.delete_set.encode(w)
-        return w
+                block.encode(enc, 0)
+        self.delete_set.encode(enc)
+
+    def encode_diff_v1(self, remote_sv: StateVector) -> bytes:
+        enc = EncoderV1()
+        self.encode_diff(remote_sv, enc)
+        return enc.to_bytes()
 
     # --- integration driver (parity: update.rs:169-308) ---
 
@@ -296,41 +314,41 @@ class Update:
 # --- block decode helper -------------------------------------------------------
 
 
-def _decode_branch(cur: Cursor) -> Branch:
-    return Branch.decode_type_ref(cur)
+def _decode_branch(dec) -> Branch:
+    return Branch.decode_type_ref(dec)
 
 
-def _decode_doc(cur: Cursor):
+def _decode_doc(dec):
     from .doc import Doc, Options
 
-    opts = Options.decode(cur)
+    opts = Options.decode(dec)
     return Doc(options=opts)
 
 
-def _decode_block(id_: ID, cur: Cursor) -> Optional[Carrier]:
+def _decode_block(id_: ID, dec) -> Optional[Carrier]:
     """Parity: update.rs:433-488."""
-    info = cur.read_u8()
+    info = dec.read_info()
     if info == BLOCK_SKIP:
-        return SkipRange(id_, cur.read_var_uint())
+        return SkipRange(id_, dec.read_var())
     if info == BLOCK_GC:
-        return GCRange(id_, cur.read_var_uint())
+        return GCRange(id_, dec.read_len())
     cant_copy_parent = info & (HAS_ORIGIN | HAS_RIGHT_ORIGIN) == 0
     origin = None
     right_origin = None
     if info & HAS_ORIGIN:
-        origin = ID(cur.read_var_uint(), cur.read_var_uint())
+        origin = ID(*dec.read_left_id())
     if info & HAS_RIGHT_ORIGIN:
-        right_origin = ID(cur.read_var_uint(), cur.read_var_uint())
+        right_origin = ID(*dec.read_right_id())
     parent = None
     parent_sub = None
     if cant_copy_parent:
-        if cur.read_var_uint() == 1:
-            parent = cur.read_string()
+        if dec.read_parent_info():
+            parent = dec.read_string()
         else:
-            parent = ID(cur.read_var_uint(), cur.read_var_uint())
+            parent = ID(*dec.read_left_id())
         if info & HAS_PARENT_SUB:
-            parent_sub = cur.read_string()
-    content = decode_content(cur, info, _decode_branch, _decode_doc, Move.decode)
+            parent_sub = dec.read_string()
+    content = decode_content(dec, info, _decode_branch, _decode_doc, Move.decode)
     if content.length() == 0:
         return None  # historical empty blocks have no effect
     return Item(id_, None, origin, None, right_origin, parent, parent_sub, content)
@@ -420,10 +438,26 @@ def merge_updates_v1(updates: List[bytes]) -> bytes:
     return Update.merge([Update.decode_v1(u) for u in updates]).encode_v1()
 
 
+def merge_updates_v2(updates: List[bytes]) -> bytes:
+    return Update.merge([Update.decode_v2(u) for u in updates]).encode_v2()
+
+
 def encode_state_vector_from_update_v1(update: bytes) -> bytes:
     return Update.decode_v1(update).state_vector().encode_v1()
 
 
+def encode_state_vector_from_update_v2(update: bytes) -> bytes:
+    return Update.decode_v2(update).state_vector().encode_v1()
+
+
 def diff_updates_v1(update: bytes, state_vector: bytes) -> bytes:
     sv = StateVector.decode_v1(state_vector)
-    return Update.decode_v1(update).encode_diff(sv).to_bytes()
+    return Update.decode_v1(update).encode_diff_v1(sv)
+
+
+def diff_updates_v2(update: bytes, state_vector: bytes) -> bytes:
+    sv = StateVector.decode_v1(state_vector)
+    u = Update.decode_v2(update)
+    enc = EncoderV2()
+    u.encode_diff(sv, enc)
+    return enc.to_bytes()

@@ -64,6 +64,10 @@ class _UndefinedType:
 Undefined = _UndefinedType()
 
 
+class BigInt(int):
+    """Marker for values that must encode with the BigInt tag (122)."""
+
+
 class Cursor:
     """Read cursor over an immutable byte buffer."""
 
@@ -291,6 +295,9 @@ def write_any(w: Writer, value: PyAny) -> None:
     elif isinstance(value, str):
         w.write_u8(_TAG_STRING)
         w.write_string(value)
+    elif isinstance(value, BigInt):
+        w.write_u8(_TAG_BIGINT)
+        w.write_i64(value)
     elif isinstance(value, int):
         if F64_MIN_SAFE_INTEGER <= value <= F64_MAX_SAFE_INTEGER:
             w.write_u8(_TAG_INTEGER)

@@ -60,6 +60,24 @@ _WRAPPERS = {
 
 
 def wrap_branch(branch: Branch) -> SharedType:
-    """Wrap a branch in its user-facing shared type (by runtime type tag)."""
-    cls = _WRAPPERS.get(branch.type_ref, Array)
+    """Wrap a branch in its user-facing shared type (by runtime type tag).
+
+    Root branches decoded off the wire are `Undefined` until first typed
+    access (reference: root-type reinterpretation, transaction.rs:123-180);
+    for display purposes infer a view from the branch contents.
+    """
+    cls = _WRAPPERS.get(branch.type_ref)
+    if cls is None:
+        from ytpu.core.content import ContentString
+
+        if branch.start is None and branch.map:
+            cls = Map
+        else:
+            node = branch.start
+            cls = Array
+            while node is not None:
+                if isinstance(node.content, ContentString):
+                    cls = Text
+                    break
+                node = node.right
     return cls(branch)

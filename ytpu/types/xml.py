@@ -26,6 +26,21 @@ from .text import Text
 __all__ = ["XmlFragment", "XmlElement", "XmlText"]
 
 
+def _attr_str(value) -> str:
+    """XML attribute values render as strings (parity: xml.rs attr iter)."""
+    if isinstance(value, str):
+        return value
+    if value is True:
+        return "true"
+    if value is False:
+        return "false"
+    if value is None:
+        return "null"
+    if isinstance(value, float) and value.is_integer():
+        return str(int(value))
+    return str(value)
+
+
 class _XmlAttrs:
     """Attribute component shared by XmlElement / XmlText."""
 
@@ -33,13 +48,15 @@ class _XmlAttrs:
         Map(self.branch).insert(txn, name, str(value))
 
     def get_attribute(self, name: str) -> Optional[str]:
-        return Map(self.branch).get(name)
+        value = Map(self.branch).get(name)
+        return None if value is None else _attr_str(value)
 
     def remove_attribute(self, txn: Transaction, name: str) -> None:
         Map(self.branch).remove(txn, name)
 
     def attributes(self) -> Iterator:
-        return Map(self.branch).items()
+        for key, value in Map(self.branch).items():
+            yield key, _attr_str(value)
 
 
 class _XmlChildren:
