@@ -87,8 +87,25 @@ def utf16_index(s: str, offset: int) -> int:
 
 
 def split_str_utf16(s: str, offset: int) -> Tuple[str, str]:
-    i = utf16_index(s, offset)
-    return s[:i], s[i:]
+    """Split at a UTF-16 code-unit offset.
+
+    If the offset lands inside a surrogate pair (astral char), both halves
+    get a U+FFFD replacement for their severed half so the UTF-16 lengths
+    stay consistent with the clock split (the workaround documented at
+    reference block.rs:1852-1860).
+    """
+    if offset <= 0:
+        return "", s
+    units = 0
+    for i, ch in enumerate(s):
+        if units == offset:
+            return s[:i], s[i:]
+        width = 2 if ord(ch) > 0xFFFF else 1
+        if units + width > offset:
+            # offset splits this astral char
+            return s[:i] + "�", "�" + s[i + 1 :]
+        units += width
+    return s, ""
 
 
 class Content:

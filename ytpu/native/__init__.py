@@ -1,0 +1,163 @@
+"""Native (C++) host runtime pieces, loaded via ctypes.
+
+The shared library is built on demand with g++ (see `_build`). Everything
+here degrades gracefully: if no compiler is available the Python
+implementations in `ytpu.encoding` / `ytpu.core` are used instead —
+`available()` reports which path is active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+__all__ = ["load", "available", "NativeColumns", "decode_update_columns"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "lib0_codec.cpp")
+_LIB = os.path.join(_HERE, "_libytpu.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_COLUMNS = [
+    "client",
+    "clock",
+    "length",
+    "kind",
+    "origin_client",
+    "origin_clock",
+    "ror_client",
+    "ror_clock",
+    "parent_kind",
+    "parent_name_start",
+    "parent_name_len",
+    "parent_id_client",
+    "parent_id_clock",
+    "parent_sub_start",
+    "parent_sub_len",
+    "content_start",
+    "content_len_bytes",
+]
+_DEL_COLUMNS = ["del_client", "del_start", "del_end"]
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            [
+                "g++",
+                "-O2",
+                "-shared",
+                "-fPIC",
+                "-std=c++17",
+                _SRC,
+                "-o",
+                _LIB,
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.ytpu_decode_update_v1.restype = ctypes.c_void_p
+        lib.ytpu_decode_update_v1.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.ytpu_columns_error.restype = ctypes.c_int
+        lib.ytpu_columns_error.argtypes = [ctypes.c_void_p]
+        lib.ytpu_columns_n_blocks.restype = ctypes.c_size_t
+        lib.ytpu_columns_n_blocks.argtypes = [ctypes.c_void_p]
+        lib.ytpu_columns_n_dels.restype = ctypes.c_size_t
+        lib.ytpu_columns_n_dels.argtypes = [ctypes.c_void_p]
+        lib.ytpu_columns_free.argtypes = [ctypes.c_void_p]
+        for name in _COLUMNS + _DEL_COLUMNS:
+            fn = getattr(lib, f"ytpu_col_{name}")
+            fn.restype = ctypes.POINTER(ctypes.c_int64)
+            fn.argtypes = [ctypes.c_void_p]
+        lib.ytpu_decode_var_uints.restype = ctypes.c_size_t
+        lib.ytpu_decode_var_uints.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_size_t,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class NativeColumns:
+    """Columnar view over one decoded update (owns the native handle)."""
+
+    def __init__(self, lib: ctypes.CDLL, handle: int, payload: bytes):
+        self._lib = lib
+        self._handle = handle
+        self.payload = payload  # original wire bytes; spans index into this
+        self.error = bool(lib.ytpu_columns_error(handle))
+        self.n_blocks = int(lib.ytpu_columns_n_blocks(handle))
+        self.n_dels = int(lib.ytpu_columns_n_dels(handle))
+        import numpy as np
+
+        def grab(name: str, count: int):
+            if count == 0:
+                return np.empty(0, dtype=np.int64)
+            ptr = getattr(lib, f"ytpu_col_{name}")(handle)
+            return np.ctypeslib.as_array(ptr, shape=(count,)).copy()
+
+        for name in _COLUMNS:
+            setattr(self, name, grab(name, self.n_blocks))
+        for name in _DEL_COLUMNS:
+            setattr(self, name, grab(name, self.n_dels))
+        lib.ytpu_columns_free(handle)
+        self._handle = None
+
+    def span(self, start: int, length: int) -> bytes:
+        return self.payload[start : start + length]
+
+    def parent_name(self, i: int) -> str:
+        s, n = int(self.parent_name_start[i]), int(self.parent_name_len[i])
+        return self.span(s, n).decode("utf-8")
+
+    def parent_sub(self, i: int):
+        s, n = int(self.parent_sub_start[i]), int(self.parent_sub_len[i])
+        if s < 0:
+            return None
+        return self.span(s, n).decode("utf-8")
+
+    def content_bytes(self, i: int) -> bytes:
+        return self.span(int(self.content_start[i]), int(self.content_len_bytes[i]))
+
+
+def decode_update_columns(payload: bytes) -> Optional[NativeColumns]:
+    """Decode a v1 update into block columns via the native codec.
+
+    Returns None if the native library is unavailable.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    handle = lib.ytpu_decode_update_v1(payload, len(payload))
+    return NativeColumns(lib, handle, payload)
