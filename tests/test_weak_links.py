@@ -1,0 +1,84 @@
+"""Weak links / quotations (model: reference types/weak.rs tests)."""
+
+from ytpu.core import Doc
+from ytpu.types import map_link, quote_range
+
+
+def test_map_link_deref():
+    d = Doc(client_id=1)
+    m = d.get_map("m")
+    target = d.get_map("data")
+    with d.transact() as txn:
+        target.insert(txn, "k", "value1")
+    link = map_link(target, "k")
+    with d.transact() as txn:
+        m.insert(txn, "ref", link)
+    ref = m.get("ref")
+    assert ref.try_deref() == "value1"
+
+
+def test_map_link_follows_overwrites():
+    d = Doc(client_id=1)
+    m = d.get_map("m")
+    data = d.get_map("data")
+    with d.transact() as txn:
+        data.insert(txn, "k", "old")
+    with d.transact() as txn:
+        m.insert(txn, "ref", map_link(data, "k"))
+    with d.transact() as txn:
+        data.insert(txn, "k", "new")
+    assert m.get("ref").try_deref() == "new"
+
+
+def test_map_link_cleared_on_delete():
+    d = Doc(client_id=1)
+    m = d.get_map("m")
+    data = d.get_map("data")
+    with d.transact() as txn:
+        data.insert(txn, "k", "val")
+    with d.transact() as txn:
+        m.insert(txn, "ref", map_link(data, "k"))
+    with d.transact() as txn:
+        data.remove(txn, "k")
+    assert m.get("ref").try_deref() is None
+
+
+def test_array_quote_unquote():
+    d = Doc(client_id=1)
+    arr = d.get_array("a")
+    m = d.get_map("m")
+    with d.transact() as txn:
+        arr.insert_range(txn, 0, [10, 20, 30, 40, 50])
+    with d.transact() as txn:
+        q = quote_range(arr, txn, 1, 3)
+        m.insert(txn, "q", q)
+    assert m.get("q").unquote() == [20, 30, 40]
+
+
+def test_quote_survives_sync():
+    a, b = Doc(client_id=1), Doc(client_id=2)
+    arr_a = a.get_array("a")
+    map_a = a.get_map("m")
+    with a.transact() as txn:
+        arr_a.insert_range(txn, 0, ["x", "y", "z"])
+    with a.transact() as txn:
+        map_a.insert(txn, "q", quote_range(arr_a, txn, 0, 2))
+    b.apply_update_v1(a.encode_state_as_update_v1())
+    ref = b.get_map("m").get("q")
+    assert ref.unquote() == ["x", "y"]
+
+
+def test_weak_link_observer_fires_on_target_change():
+    d = Doc(client_id=1)
+    m = d.get_map("m")
+    data = d.get_map("data")
+    with d.transact() as txn:
+        data.insert(txn, "k", "v0")
+    with d.transact() as txn:
+        m.insert(txn, "ref", map_link(data, "k"))
+    ref = m.get("ref")
+    fired = []
+    ref.observe(lambda txn, event: fired.append(event))
+    with d.transact() as txn:
+        data.insert(txn, "k", "v1")
+    assert fired, "link observer should fire when the target entry changes"

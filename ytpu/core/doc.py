@@ -115,6 +115,7 @@ class Doc:
         self._txn: Optional[Transaction] = None
         # observers
         self.update_v1_subs: List[Callable] = []
+        self.update_v2_subs: List[Callable] = []
         self.after_transaction_subs: List[Callable] = []
         self.transaction_cleanup_subs: List[Callable] = []
         self.subdocs_subs: List[Callable] = []
@@ -209,6 +210,10 @@ class Doc:
     def observe_update_v1(self, cb: Callable) -> Callable[[], None]:
         self.update_v1_subs.append(cb)
         return lambda: self.update_v1_subs.remove(cb)
+
+    def observe_update_v2(self, cb: Callable) -> Callable[[], None]:
+        self.update_v2_subs.append(cb)
+        return lambda: self.update_v2_subs.remove(cb)
 
     def observe_after_transaction(self, cb: Callable) -> Callable[[], None]:
         self.after_transaction_subs.append(cb)

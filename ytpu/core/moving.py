@@ -45,6 +45,74 @@ class StickyIndex:
     def from_id(cls, id_: ID, assoc: int) -> "StickyIndex":
         return cls(id_=id_, assoc=assoc)
 
+    @classmethod
+    def from_type_index(cls, branch, index: int, assoc: int = ASSOC_AFTER) -> "StickyIndex":
+        """Sticky position at `index` of a sequence (parity: moving.rs:809 /
+        IndexedSequence::sticky_index)."""
+        if assoc == ASSOC_BEFORE:
+            if index == 0:
+                return cls._from_branch(branch, assoc)
+            index -= 1
+        item = branch.start
+        while item is not None:
+            if not item.deleted and item.countable:
+                if item.len > index:
+                    return cls(
+                        id_=ID(item.id.client, item.id.clock + index), assoc=assoc
+                    )
+                index -= item.len
+            item = item.right
+        return cls._from_branch(branch, assoc)
+
+    @classmethod
+    def _from_branch(cls, branch, assoc: int) -> "StickyIndex":
+        if branch.item is not None:
+            return cls(branch_id=branch.item.id, assoc=assoc)
+        return cls(name=branch.name, assoc=assoc)
+
+    def get_offset(self, store) -> Optional[tuple]:
+        """Resolve back to (branch, index) against the current doc state
+        (parity: moving.rs:483 / Yjs createAbsolutePositionFromRelativePosition).
+        """
+        from ytpu.core.content import ContentType
+
+        if self.id is not None:
+            if store.blocks.get_clock(self.id.client) <= self.id.clock:
+                return None
+            right = store.follow_redone(self.id)
+            if right is None:
+                return None
+            diff = self.id.clock - right.id.clock if right.contains(self.id) else 0
+            branch = right.parent
+            from ytpu.core.branch import Branch
+
+            if not isinstance(branch, Branch):
+                return None
+            index = 0
+            if branch.item is None or not branch.item.deleted:
+                if not right.deleted and right.countable:
+                    index = diff + (0 if self.assoc >= 0 else 1)
+                node = right.left
+                while node is not None:
+                    if not node.deleted and node.countable:
+                        index += node.len
+                    node = node.left
+            return branch, index
+        if self.name is not None:
+            branch = store.types.get(self.name)
+        elif self.branch_id is not None:
+            anchor = store.blocks.get_item(self.branch_id)
+            branch = (
+                anchor.content.branch
+                if anchor is not None and isinstance(anchor.content, ContentType)
+                else None
+            )
+        else:
+            return None
+        if branch is None:
+            return None
+        return branch, (branch.content_len if self.assoc >= 0 else 0)
+
     def __eq__(self, other: object) -> bool:
         if not isinstance(other, StickyIndex):
             return NotImplemented

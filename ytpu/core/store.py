@@ -231,6 +231,17 @@ class DocStore:
             # became the live value of a map entry; shadow the previous chain
             parent.map[item.parent_sub] = item
             if item.left is not None:
+                if item.left.linked:
+                    # inherit links from the entry we're overriding
+                    # (parity: block.rs:642-655)
+                    links = self.linked_by.pop(item.left, None)
+                    item.left.linked = False
+                    if links:
+                        item.linked = True
+                        self.linked_by.setdefault(item, set()).update(links)
+                        for link in links:
+                            if link.link_source is not None:
+                                link.link_source.first_item = item
                 txn.delete(item.left)
 
         # parent length bookkeeping (block.rs:661-675)
@@ -264,8 +275,17 @@ class DocStore:
         elif isinstance(content, ContentType):
             if not item.deleted:
                 self.register(content.branch)
+            if content.branch.link_source is not None:
+                from ytpu.types.weak import materialize_link
+
+                materialize_link(self, content.branch)
 
         txn.add_changed_type(parent, item.parent_sub)
+
+        # notify weak links covering this position (parity: block.rs:743-750)
+        if item.linked:
+            for link in self.linked_by.get(item, ()):  # pragma: no branch
+                txn.add_changed_type(link, item.parent_sub)
 
         parent_deleted = (
             isinstance(item.parent, Branch)

@@ -204,6 +204,16 @@ class Transaction:
                         node = node.left
             elif isinstance(content, ContentMove):
                 pass  # move service integration point
+            if item.linked:
+                # notify links that the element was removed
+                # (parity: transaction.rs:634-647)
+                links = self.store.linked_by.pop(item, None)
+                if links:
+                    for link in links:
+                        self.add_changed_type(link, item.parent_sub)
+                        src = link.link_source
+                        if src is not None and src.is_single():
+                            src.first_item = None
             result = True
 
         for node in recurse:
@@ -417,6 +427,10 @@ class Transaction:
             if payload != b"\x00\x00":  # skip no-op transactions
                 for cb in doc.update_v1_subs:
                     cb(payload, self.origin, self)
+        if doc.update_v2_subs:
+            payload = self.encode_update_v2()
+            for cb in doc.update_v2_subs:
+                cb(payload, self.origin, self)
 
         # 11. subdoc bookkeeping
         if self.subdocs_added or self.subdocs_removed or self.subdocs_loaded:

@@ -29,6 +29,7 @@ from .shared import (
     XmlTextPrelim,
 )
 from .text import Diff, Text
+from .weak import WeakPrelim, WeakRef, map_link, quote_range
 from .xml import XmlElement, XmlFragment, XmlText
 
 __all__ = [
@@ -46,8 +47,14 @@ __all__ = [
     "MapPrelim",
     "XmlElementPrelim",
     "XmlTextPrelim",
+    "WeakRef",
+    "WeakPrelim",
+    "quote_range",
+    "map_link",
     "wrap_branch",
 ]
+
+from ytpu.core.branch import TYPE_WEAK
 
 _WRAPPERS = {
     TYPE_ARRAY: Array,
@@ -56,6 +63,7 @@ _WRAPPERS = {
     TYPE_XML_ELEMENT: XmlElement,
     TYPE_XML_FRAGMENT: XmlFragment,
     TYPE_XML_TEXT: XmlText,
+    TYPE_WEAK: WeakRef,
 }
 
 

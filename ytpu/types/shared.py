@@ -58,6 +58,24 @@ class SharedType:
     def __init__(self, branch: Branch):
         self.branch = branch
 
+    # --- sticky indices (parity: moving.rs IndexedSequence :809) ---------------
+
+    def sticky_index(self, index: int, assoc: int = 0):
+        """A position that follows its neighborhood across concurrent edits."""
+        from ytpu.core.moving import StickyIndex
+
+        return StickyIndex.from_type_index(self.branch, index, assoc)
+
+    def sticky_index_offset(self, txn, sticky) -> Optional[int]:
+        """Resolve a sticky index to the current absolute offset (or None)."""
+        resolved = sticky.get_offset(txn.store)
+        if resolved is None:
+            return None
+        branch, index = resolved
+        if branch is not self.branch:
+            return None
+        return index
+
     def observe(self, cb) -> callable:
         self.branch.observers.append(cb)
         return lambda: self.branch.observers.remove(cb)
