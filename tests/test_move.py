@@ -1,0 +1,111 @@
+"""Array move semantics (model: reference types/array.rs move tests
++ moving.rs integration)."""
+
+import pytest
+
+from ytpu.core import Doc
+
+
+def fill(doc, values):
+    arr = doc.get_array("array")
+    with doc.transact() as txn:
+        n = len(arr)
+        if n:
+            arr.remove_range(txn, 0, n)
+        arr.insert_range(txn, 0, values)
+    return arr
+
+
+def exchange(a, b):
+    ua = a.encode_state_as_update_v1(b.state_vector())
+    ub = b.encode_state_as_update_v1(a.state_vector())
+    b.apply_update_v1(ua)
+    a.apply_update_v1(ub)
+
+
+def test_move_to_basic():
+    d = Doc(client_id=1)
+    arr = fill(d, [1, 2, 3])
+    with d.transact() as txn:
+        arr.move_to(txn, 2, 0)  # move "3" to the front
+    assert arr.to_list() == [3, 1, 2]
+    assert len(arr) == 3
+
+
+def test_move_range_to_matches_reference():
+    # reference array.rs test: move 1-2 to 4 on [0,1,2,3] -> [0,3,1,2]
+    d = Doc(client_id=1)
+    arr = fill(d, [0, 1, 2, 3])
+    with d.transact() as txn:
+        arr.move_range_to(txn, 1, 2, 4)
+    assert arr.to_list() == [0, 3, 1, 2]
+
+
+def test_move_to_end():
+    d = Doc(client_id=1)
+    arr = fill(d, ["a", "b", "c"])
+    with d.transact() as txn:
+        arr.move_to(txn, 0, 3)
+    assert arr.to_list() == ["b", "c", "a"]
+
+
+def test_move_is_noop_into_itself():
+    d = Doc(client_id=1)
+    arr = fill(d, [1, 2, 3])
+    with d.transact() as txn:
+        arr.move_to(txn, 1, 1)
+        arr.move_to(txn, 1, 2)
+    assert arr.to_list() == [1, 2, 3]
+
+
+def test_move_syncs_to_peer():
+    a = Doc(client_id=1)
+    arr_a = fill(a, ["x", "y", "z"])
+    b = Doc(client_id=2)
+    b.apply_update_v1(a.encode_state_as_update_v1())
+    with a.transact() as txn:
+        arr_a.move_to(txn, 2, 0)
+    b.apply_update_v1(a.encode_state_as_update_v1(b.state_vector()))
+    assert b.get_array("array").to_list() == ["z", "x", "y"]
+    assert arr_a.to_list() == ["z", "x", "y"]
+
+
+def test_concurrent_moves_converge():
+    a = Doc(client_id=1)
+    arr_a = fill(a, [0, 1, 2, 3])
+    b = Doc(client_id=2)
+    b.apply_update_v1(a.encode_state_as_update_v1())
+    arr_b = b.get_array("array")
+    # both peers move element "1" to different places concurrently
+    with a.transact() as txn:
+        arr_a.move_to(txn, 1, 4)
+    with b.transact() as txn:
+        arr_b.move_to(txn, 1, 0)
+    exchange(a, b)
+    la, lb = arr_a.to_list(), arr_b.to_list()
+    assert la == lb
+    assert sorted(la) == [0, 1, 2, 3]  # nothing lost or duplicated
+    assert len(la) == 4
+
+
+def test_move_then_delete_moved_element():
+    d = Doc(client_id=1)
+    arr = fill(d, ["a", "b", "c"])
+    with d.transact() as txn:
+        arr.move_to(txn, 0, 3)  # b c a
+    with d.transact() as txn:
+        arr.remove(txn, 2)  # delete the moved "a"
+    assert arr.to_list() == ["b", "c"]
+
+
+def test_undo_of_move():
+    from ytpu.undo import UndoManager, UndoOptions
+
+    d = Doc(client_id=1)
+    arr = fill(d, [1, 2, 3])
+    mgr = UndoManager(d, arr, UndoOptions(capture_timeout_ms=0))
+    with d.transact() as txn:
+        arr.move_to(txn, 0, 3)
+    assert arr.to_list() == [2, 3, 1]
+    assert mgr.undo()
+    assert arr.to_list() == [1, 2, 3]

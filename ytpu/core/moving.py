@@ -180,6 +180,137 @@ class Move:
             priority,
         )
 
+    # --- integration (parity: moving.rs:100-265) -------------------------------
+
+    @staticmethod
+    def _item_ptr(store, sticky: StickyIndex):
+        """Range coordinate resolution (parity: moving.rs:100-111):
+        assoc After → the item starting at id (in-range); assoc Before →
+        the item *after* the one ending at id (exclusive bound)."""
+        if sticky.id is None:
+            return None
+        if sticky.assoc == ASSOC_AFTER:
+            return store.blocks.get_item_clean_start(sticky.id)
+        item = store.blocks.get_item_clean_end(sticky.id)
+        return item.right if item is not None else None
+
+    def get_coords(self, store):
+        return self._item_ptr(store, self.start), self._item_ptr(store, self.end)
+
+    def push_override(self, item) -> None:
+        if self.overrides is None:
+            self.overrides = set()
+        self.overrides.add(item)
+
+    def find_move_loop(self, store, moved_item, tracked) -> bool:
+        """Cycle detection across nested moves (parity: moving.rs:113-141)."""
+        if moved_item in tracked:
+            return True
+        tracked.add(moved_item)
+        from ytpu.core.content import ContentMove
+
+        start, end = self.get_coords(store)
+        cur = start
+        while cur is not None and cur is not end:
+            if not cur.deleted and cur.moved is moved_item:
+                if isinstance(cur.content, ContentMove):
+                    if cur.content.move.find_move_loop(store, cur, tracked):
+                        return True
+            cur = cur.right
+        return False
+
+    def integrate_block(self, txn, item) -> None:
+        """Claim the moved range, reconciling concurrent moves by priority
+        (parity: moving.rs:149-227). `item` is the ContentMove item."""
+        from ytpu.core.content import ContentMove
+
+        store = txn.store
+        start, end = self.get_coords(store)
+        max_priority = 0
+        adapt = self.priority < 0
+        cur = start
+        while cur is not None and cur is not end:
+            prev_move = cur.moved
+            if prev_move is not None and isinstance(prev_move.content, ContentMove):
+                next_prio = prev_move.content.move.priority
+            else:
+                next_prio = -1
+            takes = (
+                adapt
+                or next_prio < self.priority
+                or (
+                    prev_move is not None
+                    and next_prio == self.priority
+                    and (prev_move.id.client, prev_move.id.clock)
+                    < (item.id.client, item.id.clock)
+                )
+            )
+            if takes:
+                if prev_move is not None:
+                    if (
+                        isinstance(prev_move.content, ContentMove)
+                        and prev_move.content.move.is_collapsed()
+                    ):
+                        self._delete_as_cleanup(txn, prev_move, adapt)
+                    self.push_override(prev_move)
+                    if cur is not start:
+                        txn.merge_blocks.append(cur.id)
+                    max_priority = max(max_priority, next_prio)
+                    # remember who moved this item before (for event diffing),
+                    # unless the previous move was created in this very txn
+                    if cur not in txn.prev_moved and not txn.has_added(prev_move.id):
+                        txn.prev_moved[cur] = prev_move
+                cur.moved = item
+                if not cur.deleted and isinstance(cur.content, ContentMove):
+                    if cur.content.move.find_move_loop(store, cur, {item}):
+                        self._delete_as_cleanup(txn, item, adapt)
+                        return
+            else:
+                if prev_move is not None and isinstance(prev_move.content, ContentMove):
+                    prev_move.content.move.push_override(item)
+            cur = cur.right
+        if adapt:
+            self.priority = max_priority + 1
+
+    def delete(self, txn, item) -> None:
+        """Release the moved range and reintegrate overridden moves
+        (parity: moving.rs:229-280)."""
+        from ytpu.core.content import ContentMove
+
+        store = txn.store
+        start, end = self.get_coords(store)
+        cur = start
+        while cur is not None and cur is not end:
+            if cur.moved is item:
+                if cur in txn.prev_moved:
+                    if txn.has_added(item.id) and txn.prev_moved[cur] is item:
+                        del txn.prev_moved[cur]
+                else:
+                    txn.prev_moved[cur] = item
+                cur.moved = None
+            cur = cur.right
+
+        def reintegrate(it):
+            if isinstance(it.content, ContentMove):
+                if it.deleted:
+                    inner_overrides = it.content.move.overrides
+                    if inner_overrides:
+                        for inner in list(inner_overrides):
+                            reintegrate(inner)
+                else:
+                    it.content.move.integrate_block(txn, it)
+
+        if self.overrides:
+            for inner in list(self.overrides):
+                reintegrate(inner)
+
+    @staticmethod
+    def _delete_as_cleanup(txn, item, adapt_priority: bool) -> None:
+        txn.delete(item)
+        if adapt_priority:
+            # losing move markers created concurrently clean up silently
+            txn.merge_blocks.append(item.id)
+
     def __eq__(self, other: object) -> bool:
         if not isinstance(other, Move):
             return NotImplemented

@@ -250,13 +250,18 @@ class DocStore:
                 parent.block_len += item.len
                 parent.content_len += item.len
 
-        # moved-range inheritance (block.rs:677-702; move reconciliation is
-        # handled by the move service once ContentMove integration lands)
+        # moved-range inheritance / reconciliation (block.rs:677-702)
         left_moved = item.left.moved if item.left is not None else None
         right_moved = item.right.moved if item.right is not None else None
         if left_moved is not None or right_moved is not None:
             if left_moved is right_moved:
                 item.moved = left_moved
+            else:
+                for mover in (left_moved, right_moved):
+                    if mover is not None and isinstance(mover.content, ContentMove):
+                        m = mover.content.move
+                        if not m.is_collapsed():
+                            m.integrate_block(txn, mover)
 
         # content side effects (block.rs:704-741)
         content = item.content
@@ -271,7 +276,7 @@ class DocStore:
             if subdoc.options.should_load:
                 txn.subdocs_loaded[subdoc.guid] = subdoc
         elif isinstance(content, ContentMove):
-            pass  # move integration: service layer (ytpu.services.move)
+            content.move.integrate_block(txn, item)
         elif isinstance(content, ContentType):
             if not item.deleted:
                 self.register(content.branch)

@@ -82,6 +82,7 @@ class Transaction:
         "subdocs_removed",
         "subdocs_loaded",
         "committed",
+        "prev_moved",
         "_events",
     )
 
@@ -99,6 +100,7 @@ class Transaction:
         self.subdocs_removed: Dict[str, object] = {}
         self.subdocs_loaded: Dict[str, object] = {}
         self.committed = False
+        self.prev_moved: Dict[Item, Item] = {}  # item -> move that owned it
         self._events = []
 
     # --- context manager -------------------------------------------------------
@@ -147,6 +149,10 @@ class Transaction:
         self.store.write_blocks_from(self.before_state, enc)
         self.delete_set.encode(enc)
         return enc.to_bytes()
+
+    def has_added(self, id_: ID) -> bool:
+        """Was the block at `id_` created inside this transaction?"""
+        return id_.clock >= self.before_state.get(id_.client)
 
     # --- change tracking -------------------------------------------------------
 
@@ -203,7 +209,7 @@ class Transaction:
                             recurse.append(node)
                         node = node.left
             elif isinstance(content, ContentMove):
-                pass  # move service integration point
+                content.move.delete(self, item)
             if item.linked:
                 # notify links that the element was removed
                 # (parity: transaction.rs:634-647)

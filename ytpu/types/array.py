@@ -14,7 +14,7 @@ from ytpu.core.branch import TYPE_ARRAY
 from ytpu.core.content import ContentAny
 from ytpu.core.transaction import Transaction
 
-from .shared import Prelim, SharedType, find_position, out_value, to_content
+from .shared import Prelim, SharedType, out_value, to_content, visible_items
 
 __all__ = ["Array"]
 
@@ -31,12 +31,38 @@ class Array(SharedType):
     def insert(self, txn: Transaction, index: int, value: PyAny) -> None:
         self.insert_range(txn, index, [value])
 
+    def _visible_position(self, txn: Transaction, index: int):
+        """Insertion cursor at a *visible* index (move-aware; the raw
+        neighbors are adjacent so moved-flag inheritance at integrate places
+        the new item inside moved ranges correctly, block.rs:677-702)."""
+        from ytpu.core.transaction import ItemPosition
+
+        if index == 0:
+            return ItemPosition(self.branch, None, self.branch.start, 0, None)
+        remaining = index
+        last = None
+        for item in visible_items(self.branch):
+            if remaining == 0:
+                break
+            if item.deleted or not item.countable:
+                continue
+            if remaining < item.len:
+                txn.store.blocks.split_at(item, remaining)
+                last = item
+                remaining = 0
+                break
+            remaining -= item.len
+            last = item
+        if remaining > 0:
+            raise IndexError(index)
+        return ItemPosition(
+            self.branch, last, last.right if last is not None else self.branch.start
+        )
+
     def insert_range(self, txn: Transaction, index: int, values: List[PyAny]) -> None:
         """Parity: types/array.rs:245 (consecutive primitives batch into one
         ContentAny block)."""
-        pos = find_position(self.branch, txn, index)
-        if pos is None:
-            raise IndexError(index)
+        pos = self._visible_position(txn, index)
         batch: List[PyAny] = []
 
         def flush_batch():
@@ -69,42 +95,73 @@ class Array(SharedType):
         self.remove_range(txn, index, 1)
 
     def remove_range(self, txn: Transaction, index: int, length: int) -> None:
-        pos = find_position(self.branch, txn, index)
-        if pos is None:
-            raise IndexError(index)
-        remaining = length
-        right = pos.right
+        """Move-aware removal over the visible order."""
+        to_skip = index
+        to_del = length
         store = txn.store
-        while right is not None and remaining > 0:
-            if not right.deleted and right.countable:
-                if remaining < right.len:
-                    store.blocks.split_at(right, remaining)
-                remaining -= min(remaining, right.len)
-                txn.delete(right)
-            right = right.right
-        if remaining > 0:
-            raise IndexError(f"remove_range past end of array ({remaining} left)")
+        for item in visible_items(self.branch):
+            if to_del == 0:
+                break
+            if item.deleted or not item.countable:
+                continue
+            if to_skip > 0:
+                if to_skip >= item.len:
+                    to_skip -= item.len
+                    continue
+                store.blocks.split_at(item, to_skip)
+                to_skip = 0
+                continue  # next visible item is the split-off right half
+            if to_del < item.len:
+                store.blocks.split_at(item, to_del)
+            to_del -= min(to_del, item.len)
+            txn.delete(item)
+        if to_del > 0:
+            raise IndexError(f"remove_range past end of array ({to_del} left)")
+
+    def move_to(self, txn: Transaction, source: int, target: int) -> None:
+        """Move the element at `source` before the current element at `target`.
+
+        Parity: types/array.rs move_to (a collapsed ContentMove marker).
+        """
+        if source == target or source + 1 == target:
+            return  # moving into itself is a no-op
+        self.move_range_to(txn, source, source, target)
+
+    def move_range_to(self, txn: Transaction, start: int, end: int, target: int) -> None:
+        """Move elements [start..=end] before the element at `target`.
+
+        Parity: types/array.rs move_range_to (start anchored After, end
+        anchored Before — see moving.rs:100-111 for coordinate semantics).
+        """
+        from ytpu.core.content import ContentMove
+        from ytpu.core.moving import ASSOC_AFTER, ASSOC_BEFORE, Move, StickyIndex
+
+        if start <= target <= end:
+            return  # moving a range into itself is a no-op
+        left = StickyIndex.from_type_index(self.branch, start, ASSOC_AFTER)
+        right = StickyIndex.from_type_index(self.branch, end + 1, ASSOC_BEFORE)
+        if left.id is None or right.id is None:
+            raise IndexError(f"move range [{start}..{end}] out of bounds")
+        pos = self._visible_position(txn, target)
+        # priority -1: adapted to max(overridden priorities) + 1 on integrate
+        txn.create_item(pos, ContentMove(Move(left, right, -1)), None)
 
     # --- reads -----------------------------------------------------------------
 
     def get(self, index: int) -> Optional[PyAny]:
-        item = self.branch.start
         remaining = index
-        while item is not None:
+        for item in visible_items(self.branch):
             if not item.deleted and item.countable:
                 if remaining < item.len:
                     return out_value(item, remaining)
                 remaining -= item.len
-            item = item.right
         return None
 
     def __iter__(self) -> Iterator[PyAny]:
-        item = self.branch.start
-        while item is not None:
+        for item in visible_items(self.branch):
             if not item.deleted and item.countable:
                 for i in range(item.len):
                     yield out_value(item, i)
-            item = item.right
 
     def to_list(self) -> List[PyAny]:
         return list(self)

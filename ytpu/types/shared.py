@@ -205,6 +205,43 @@ def out_value(item: Item, index: int = -1) -> PyAny:
     return vals[index]
 
 
+def visible_items(branch: Branch):
+    """Iterate sequence items in *visible* order, honoring move ranges.
+
+    Parity: the move-aware traversal of iter.rs:46-116 (MoveIter): an item
+    whose `moved` pointer differs from the current move scope is skipped
+    (it renders at its destination); an alive ContentMove item descends
+    into its range.
+    """
+    from ytpu.core.content import ContentMove
+
+    store = branch.store
+    stack = []  # (resume_item, outer_scope_move, outer_scope_end)
+    cur = branch.start
+    scope_move = None
+    scope_end = None
+    while True:
+        if cur is None or (scope_end is not None and cur is scope_end):
+            if stack:
+                cur, scope_move, scope_end = stack.pop()
+                continue
+            break
+        if (
+            isinstance(cur.content, ContentMove)
+            and not cur.deleted
+            and cur.moved is scope_move
+            and store is not None
+        ):
+            start, end = cur.content.move.get_coords(store)
+            stack.append((cur.right, scope_move, scope_end))
+            scope_move, scope_end = cur, end
+            cur = start
+            continue
+        if cur.moved is scope_move and not isinstance(cur.content, ContentMove):
+            yield cur
+        cur = cur.right
+
+
 def find_position(
     branch: Branch,
     txn: Transaction,
