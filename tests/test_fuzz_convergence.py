@@ -113,3 +113,72 @@ def test_fuzz_mixed_5_peers(seed):
         n_peers=5,
         n_iterations=200,
     )
+
+
+# --- move mutators ---
+
+
+def array_move(doc, rng):
+    arr = doc.get_array("array")
+    n = len(arr)
+    if n < 2:
+        return
+    src = rng.randint(0, n - 1)
+    dst = rng.randint(0, n)
+    with doc.transact() as txn:
+        arr.move_to(txn, src, dst)
+
+
+def xml_mutate(doc, rng):
+    frag = doc.get_xml_fragment("xml")
+    from ytpu.types import XmlElementPrelim, XmlTextPrelim
+
+    with doc.transact() as txn:
+        roll = rng.random()
+        n = len(frag)
+        if roll < 0.4 or n == 0:
+            kind = rng.randint(0, 1)
+            node = (
+                XmlElementPrelim(rng.choice(["p", "div", "span"]))
+                if kind
+                else XmlTextPrelim(_rand_word(rng))
+            )
+            frag.insert(txn, rng.randint(0, n), node)
+        elif roll < 0.7:
+            frag.remove_range(txn, rng.randint(0, n - 1), 1)
+        else:
+            child = frag.get(rng.randint(0, n - 1))
+            if child is not None and hasattr(child, "insert_attribute"):
+                child.insert_attribute(txn, rng.choice("abc"), _rand_word(rng))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_array_with_moves(seed):
+    run_scenario(
+        seed + 400, [array_insert, array_delete, array_move], n_peers=3, n_iterations=150
+    )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_xml(seed):
+    run_scenario(seed + 500, [xml_mutate], n_peers=3, n_iterations=120)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_fuzz_everything(seed):
+    run_scenario(
+        seed + 600,
+        [
+            text_insert,
+            text_delete,
+            array_insert,
+            array_delete,
+            array_move,
+            map_set,
+            map_set_nested,
+            map_delete,
+            xml_mutate,
+        ],
+        n_peers=4,
+        n_iterations=250,
+    )

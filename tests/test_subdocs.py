@@ -1,0 +1,74 @@
+"""Sub-documents (model: reference doc.rs:625-678 + subdocs tests)."""
+
+from ytpu.core import Doc, Options
+
+
+def test_subdoc_insert_and_events():
+    parent = Doc(client_id=1)
+    events = []
+    parent.observe_subdocs(
+        lambda txn, added, removed, loaded: events.append(
+            (sorted(added), sorted(removed), sorted(loaded))
+        )
+    )
+    arr = parent.get_array("docs")
+    child = Doc(client_id=1, guid="child-guid")
+    with parent.transact() as txn:
+        arr.push_back(txn, child)
+    assert events == [(["child-guid"], [], ["child-guid"])]
+    assert parent.store.subdocs["child-guid"] is child
+    assert child.parent_doc is parent
+
+
+def test_subdoc_removal_event():
+    parent = Doc(client_id=1)
+    events = []
+    parent.observe_subdocs(
+        lambda txn, added, removed, loaded: events.append(
+            (sorted(added), sorted(removed))
+        )
+    )
+    arr = parent.get_array("docs")
+    child = Doc(client_id=1, guid="gone")
+    with parent.transact() as txn:
+        arr.push_back(txn, child)
+    with parent.transact() as txn:
+        arr.remove(txn, 0)
+    assert events[-1] == ([], ["gone"])
+    assert "gone" not in parent.store.subdocs
+    assert child.destroyed
+
+
+def test_subdoc_guid_syncs_to_peer():
+    parent = Doc(client_id=1)
+    arr = parent.get_array("docs")
+    child = Doc(client_id=1, guid="shared-child", auto_load=True)
+    with parent.transact() as txn:
+        arr.push_back(txn, child)
+    replica = Doc(client_id=2)
+    replica.apply_update_v1(parent.encode_state_as_update_v1())
+    got = replica.get_array("docs").get(0)
+    assert got.guid == "shared-child"
+    assert got.options.auto_load
+    # should_load is false by default on the receiving side unless auto_load
+    assert got.options.should_load
+    assert replica.store.subdocs["shared-child"] is got
+
+
+def test_subdoc_content_is_independent():
+    parent = Doc(client_id=1)
+    arr = parent.get_array("docs")
+    child = Doc(client_id=5, guid="c1")
+    with parent.transact() as txn:
+        arr.push_back(txn, child)
+    # subdoc contents sync through their own update channel
+    with child.transact() as txn:
+        child.get_text("t").insert(txn, 0, "inner")
+    replica_child = Doc(client_id=6)
+    replica_child.apply_update_v1(child.encode_state_as_update_v1())
+    assert replica_child.get_text("t").get_string() == "inner"
+    # parent update does not carry subdoc content
+    replica_parent = Doc(client_id=7)
+    replica_parent.apply_update_v1(parent.encode_state_as_update_v1())
+    inner = replica_parent.get_array("docs").get(0)
+    assert inner.get_text("t").get_string() == ""

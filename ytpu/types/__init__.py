@@ -81,11 +81,20 @@ def wrap_branch(branch: Branch) -> SharedType:
         if branch.start is None and branch.map:
             cls = Map
         else:
+            from ytpu.core.content import ContentType
+
+            xml_refs = (TYPE_XML_ELEMENT, TYPE_XML_FRAGMENT, TYPE_XML_TEXT)
             node = branch.start
             cls = Array
             while node is not None:
                 if isinstance(node.content, ContentString):
                     cls = Text
+                    break
+                if (
+                    isinstance(node.content, ContentType)
+                    and node.content.branch.type_ref in xml_refs
+                ):
+                    cls = XmlFragment
                     break
                 node = node.right
     return cls(branch)
