@@ -25,6 +25,7 @@ tests/test_batch_parity.py.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import jax
@@ -462,13 +463,29 @@ def _apply_update_one_doc(
             batch.content_off[i],
             batch.valid[i],
         )
-        return _integrate_row(st, row, client_rank)
+        # padding rows skip all work; with a broadcast (unbatched) update the
+        # predicate is scalar, so XLA executes only one branch
+        return jax.lax.cond(
+            batch.valid[i],
+            lambda s: _integrate_row(s, row, client_rank),
+            lambda s: s,
+            st,
+        )
 
     state = jax.lax.fori_loop(0, U, blk_body, state)
 
     def del_body(r, st):
-        return _apply_delete_range(
-            st, batch.del_client[r], batch.del_start[r], batch.del_end[r], batch.del_valid[r]
+        return jax.lax.cond(
+            batch.del_valid[r],
+            lambda s: _apply_delete_range(
+                s,
+                batch.del_client[r],
+                batch.del_start[r],
+                batch.del_end[r],
+                batch.del_valid[r],
+            ),
+            lambda s: s,
+            st,
         )
 
     return jax.lax.fori_loop(0, R, del_body, state)
@@ -487,7 +504,26 @@ def apply_update_batch(
     )
 
 
-from functools import partial
+@partial(jax.jit, donate_argnums=0)
+def apply_update_stream(
+    state: DocStateBatch, stream: UpdateBatch, client_rank: jax.Array
+) -> DocStateBatch:
+    """Integrate a whole stream of updates per doc in one compiled program.
+
+    `stream` leaves carry a leading step axis [S, ...] *without* a doc axis:
+    each step's update is broadcast to every doc slot (the multi-tenant
+    replay shape of BASELINE.md config #2). `lax.scan` amortizes dispatch —
+    wall-clock per step is pure device time.
+    """
+
+    def step(st, batch):
+        st = jax.vmap(_apply_update_one_doc, in_axes=(0, None, None))(
+            st, batch, client_rank
+        )
+        return st, None
+
+    state, _ = jax.lax.scan(step, state, stream)
+    return state
 
 
 @partial(jax.jit, static_argnums=1)
@@ -667,6 +703,47 @@ class BatchEncoder:
             del_valid=jnp.asarray(dels_valid),
         )
 
+    def build_step(self, update: Update, n_rows: int, n_dels: int) -> UpdateBatch:
+        """One update as a doc-axis-free batch (leaves [U]/[R]) for
+        `apply_update_stream`."""
+        rows, dels = self.rows_from_update(update)
+        if len(rows) > n_rows or len(dels) > n_dels:
+            raise ValueError(
+                f"update needs {len(rows)} rows/{len(dels)} dels, "
+                f"buckets are {n_rows}/{n_dels}"
+            )
+        row_arr = np.zeros((n_rows, 10), dtype=np.int32)
+        row_valid = np.zeros(n_rows, dtype=bool)
+        for i, row in enumerate(rows):
+            row_arr[i] = row
+            row_valid[i] = True
+        del_arr = np.zeros((n_dels, 3), dtype=np.int32)
+        del_valid = np.zeros(n_dels, dtype=bool)
+        for i, de in enumerate(dels):
+            del_arr[i] = de
+            del_valid[i] = True
+        return UpdateBatch(
+            client=jnp.asarray(row_arr[:, 0]),
+            clock=jnp.asarray(row_arr[:, 1]),
+            length=jnp.asarray(row_arr[:, 2]),
+            origin_client=jnp.asarray(row_arr[:, 3]),
+            origin_clock=jnp.asarray(row_arr[:, 4]),
+            ror_client=jnp.asarray(row_arr[:, 5]),
+            ror_clock=jnp.asarray(row_arr[:, 6]),
+            kind=jnp.asarray(row_arr[:, 7]),
+            content_ref=jnp.asarray(row_arr[:, 8]),
+            content_off=jnp.asarray(row_arr[:, 9]),
+            valid=jnp.asarray(row_valid),
+            del_client=jnp.asarray(del_arr[:, 0]),
+            del_start=jnp.asarray(del_arr[:, 1]),
+            del_end=jnp.asarray(del_arr[:, 2]),
+            del_valid=jnp.asarray(del_valid),
+        )
+
+    @staticmethod
+    def stack_steps(steps: List[UpdateBatch]) -> UpdateBatch:
+        """Stack per-step batches into [S, ...] leaves for lax.scan."""
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *steps)
 
 def get_string(state: DocStateBatch, doc: int, payloads: PayloadStore) -> str:
     """Host assembly of a doc's visible text (device gather + host concat)."""
