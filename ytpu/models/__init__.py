@@ -6,6 +6,9 @@ with `apply_update_batch` / `encode_diff_batch` as jitted programs.
 
 from .batch_doc import (
     BatchEncoder,
+    apply_update_stream,
+    encode_diff_batch,
+    finish_encode_diff,
     BlockCols,
     ClientInterner,
     DocStateBatch,
@@ -20,6 +23,9 @@ from .batch_doc import (
 
 __all__ = [
     "BatchEncoder",
+    "apply_update_stream",
+    "encode_diff_batch",
+    "finish_encode_diff",
     "BlockCols",
     "ClientInterner",
     "DocStateBatch",

@@ -526,6 +526,123 @@ def apply_update_stream(
     return state
 
 
+@partial(jax.jit, static_argnums=2)
+def encode_diff_batch(state: DocStateBatch, remote_sv: jax.Array, n_clients: int):
+    """Device half of the batched sync step 2 (north-star encode_diff_batch).
+
+    For every (doc, block): should it ship to a remote whose state vector is
+    `remote_sv[d]` ([D, C] i32 over interned clients), and from which clock
+    offset? Mirrors `Store::write_blocks_from` / `diff_state_vectors`
+    (reference store.rs:204-248) as pure tensor ops:
+
+    returns (ship_mask [D, B] bool, offsets [D, B] i32, local_sv [D, C] i32,
+    deleted [D, B] bool). The host finisher gathers selected rows (sorted by
+    client desc, clock asc per the wire contract) and emits bytes from the
+    payload store.
+    """
+    from ytpu.ops.state_vector import sv_from_blocks
+
+    bl = state.blocks
+    B = bl.client.shape[-1]
+    slots = jnp.arange(B, dtype=I32)
+    valid = (slots[None, :] < state.n_blocks[:, None]) & (bl.client >= 0)
+    # remote clock per block row (gather along the client axis)
+    safe_client = jnp.clip(bl.client, 0, n_clients - 1)
+    remote_clock = jnp.take_along_axis(remote_sv, safe_client, axis=1)
+    end = bl.clock + bl.length
+    ship = valid & (end > remote_clock)
+    offsets = jnp.clip(remote_clock - bl.clock, 0, None) * ship
+    local_sv = sv_from_blocks(bl.client, bl.clock, bl.length, n_clients)
+    return ship, offsets, local_sv, bl.deleted & valid
+
+
+def finish_encode_diff(
+    state: DocStateBatch,
+    doc: int,
+    ship: np.ndarray,
+    offsets: np.ndarray,
+    deleted: np.ndarray,
+    enc: "BatchEncoder",
+) -> bytes:
+    """Host finisher: selected device rows -> a v1 update payload.
+
+    Emits the same wire layout as the host oracle (clients descending,
+    clock-contiguous runs, first block offset-trimmed) from the device block
+    columns + payload side-buffers.
+    """
+    from ytpu.encoding.codec import EncoderV1
+    from ytpu.core.id_set import DeleteSet
+
+    bl = jax.tree.map(lambda a: np.asarray(a[doc]), state.blocks)
+    rows = np.nonzero(ship[doc])[0]
+    per_client: Dict[int, List[int]] = {}
+    for r in rows:
+        per_client.setdefault(int(bl.client[r]), []).append(int(r))
+    out = EncoderV1()
+    out.write_var(len(per_client))
+    for cidx in sorted(per_client, key=lambda c: -enc.interner.from_idx[c]):
+        slots = sorted(per_client[cidx], key=lambda r: int(bl.clock[r]))
+        real_client = enc.interner.from_idx[cidx]
+        out.write_var(len(slots))
+        out.write_client(real_client)
+        first_off = int(offsets[doc][slots[0]])
+        out.write_var(int(bl.clock[slots[0]]) + first_off)
+        for pos, r in enumerate(slots):
+            off = first_off if pos == 0 else 0
+            _encode_device_row(out, bl, r, off, real_client, enc)
+    ds = DeleteSet()
+    for r in np.nonzero(deleted[doc])[0]:
+        real_client = enc.interner.from_idx[int(bl.client[r])]
+        ds.insert_range(real_client, int(bl.clock[r]), int(bl.clock[r] + bl.length[r]))
+    ds.encode(out)
+    return out.to_bytes()
+
+
+def _encode_device_row(out, bl, r, off, real_client, enc: "BatchEncoder") -> None:
+    from ytpu.core.content import (
+        BLOCK_SKIP,
+        CONTENT_DELETED,
+    )
+    from ytpu.core.ids import ID
+
+    kind = int(bl.kind[r])
+    if kind == BLOCK_GC:
+        out.write_info(BLOCK_GC)
+        out.write_len(int(bl.length[r]) - off)
+        return
+    oc, ok = int(bl.origin_client[r]), int(bl.origin_clock[r])
+    rc, rk = int(bl.ror_client[r]), int(bl.ror_clock[r])
+    clock = int(bl.clock[r])
+    if off > 0:
+        oc, ok = int(bl.client[r]), clock + off - 1
+    has_o, has_r = oc >= 0, rc >= 0
+    info = kind | (0x80 if has_o else 0) | (0x40 if has_r else 0)
+    out.write_info(info)
+    if has_o:
+        out.write_left_id(ID(enc.interner.from_idx[oc], ok))
+    if has_r:
+        out.write_right_id(ID(enc.interner.from_idx[rc], rk))
+    if not has_o and not has_r:
+        # round-1 device scope: single root sequence named "text"
+        out.write_parent_info(True)
+        out.write_string(enc.root_name)
+    ref = int(bl.content_ref[r])
+    c_off = int(bl.content_off[r]) + off
+    length = int(bl.length[r]) - off
+    if kind == CONTENT_STRING:
+        out.write_string(enc.payloads.slice_text(ref, c_off, length))
+    elif kind == CONTENT_ANY:
+        out.write_len(length)
+        for v in enc.payloads.slice_values(ref, c_off, length):
+            out.write_any(v)
+    elif kind == CONTENT_DELETED:
+        out.write_len(length)
+    else:
+        # other payload kinds stash the host content object directly
+        content = enc.payloads.items[ref][1]
+        content.encode(out)
+
+
 @partial(jax.jit, static_argnums=1)
 def state_vectors(state: DocStateBatch, n_clients: int) -> jax.Array:
     """[D, C] dense state vectors from the block columns."""
@@ -598,9 +715,10 @@ class PayloadStore:
 class BatchEncoder:
     """Converts host `Update` objects into padded `UpdateBatch` tensors."""
 
-    def __init__(self):
+    def __init__(self, root_name: str = "text"):
         self.interner = ClientInterner()
         self.payloads = PayloadStore()
+        self.root_name = root_name  # root branch of the device sequence
 
     def rows_from_update(self, update: Update) -> Tuple[list, list]:
         rows = []
