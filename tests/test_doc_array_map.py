@@ -137,3 +137,32 @@ def test_binary_payload():
         arr.push_back(txn, b"\x01\x02\xff")
     exchange(a, b)
     assert b.get_array("a").to_list() == [b"\x01\x02\xff"]
+
+
+def test_xml_tree_navigation():
+    from ytpu.types import XmlElementPrelim, XmlTextPrelim
+
+    d = Doc(client_id=1)
+    frag = d.get_xml_fragment("f")
+    with d.transact() as txn:
+        frag.insert_range(
+            txn,
+            0,
+            [
+                XmlElementPrelim("div", children=[XmlElementPrelim("span"), XmlTextPrelim("hi")]),
+                XmlTextPrelim("tail"),
+            ],
+        )
+    div = frag.first_child()
+    assert div.tag == "div"
+    span = div.first_child()
+    assert span.tag == "span"
+    assert span.next_sibling().get_string() == "hi"
+    assert div.next_sibling().get_string() == "tail"
+    assert div.next_sibling().prev_sibling().tag == "div"
+    assert span.parent().tag == "div"
+    # depth-first walk
+    tags = []
+    for node in frag.successors():
+        tags.append(getattr(node, "tag", None) or node.get_string())
+    assert tags == ["div", "span", "hi", "tail"]

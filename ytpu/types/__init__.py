@@ -30,7 +30,7 @@ from .shared import (
 )
 from .text import Diff, Text
 from .weak import WeakPrelim, WeakRef, map_link, quote_range
-from .xml import XmlElement, XmlFragment, XmlText
+from .xml import TreeWalker, XmlElement, XmlFragment, XmlHook, XmlText
 
 __all__ = [
     "Array",
@@ -39,7 +39,9 @@ __all__ = [
     "Diff",
     "XmlElement",
     "XmlFragment",
+    "XmlHook",
     "XmlText",
+    "TreeWalker",
     "SharedType",
     "Prelim",
     "TextPrelim",
@@ -63,6 +65,7 @@ _WRAPPERS = {
     TYPE_XML_ELEMENT: XmlElement,
     TYPE_XML_FRAGMENT: XmlFragment,
     TYPE_XML_TEXT: XmlText,
+    TYPE_XML_HOOK: XmlHook,
     TYPE_WEAK: WeakRef,
 }
 

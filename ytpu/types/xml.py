@@ -11,8 +11,10 @@ from __future__ import annotations
 from typing import Any as PyAny, Iterator, List, Optional
 
 from ytpu.core.branch import (
+    Branch,
     TYPE_XML_ELEMENT,
     TYPE_XML_FRAGMENT,
+    TYPE_XML_HOOK,
     TYPE_XML_TEXT,
 )
 from ytpu.core.content import ContentFormat, ContentString
@@ -23,7 +25,7 @@ from .map import Map
 from .shared import SharedType, out_value, to_content
 from .text import Text
 
-__all__ = ["XmlFragment", "XmlElement", "XmlText"]
+__all__ = ["XmlFragment", "XmlElement", "XmlText", "XmlHook", "TreeWalker"]
 
 
 def _attr_str(value) -> str:
@@ -93,24 +95,86 @@ class _XmlChildren:
         return "".join(out)
 
 
-class XmlFragment(_XmlChildren, SharedType):
+class _XmlNode:
+    """Tree navigation shared by all XML nodes (parity: xml.rs Xml trait
+    :976 + tree traversal)."""
+
+    def parent(self):
+        item = self.branch.item
+        if item is None or not isinstance(item.parent, Branch):
+            return None
+        from . import wrap_branch
+
+        return wrap_branch(item.parent)
+
+    def _sibling(self, forward: bool):
+        item = self.branch.item
+        if item is None:
+            return None
+        node = item.right if forward else item.left
+        while node is not None:
+            if not node.deleted and node.countable:
+                return out_value(node)
+            node = node.right if forward else node.left
+        return None
+
+    def next_sibling(self):
+        return self._sibling(True)
+
+    def prev_sibling(self):
+        return self._sibling(False)
+
+
+class TreeWalker:
+    """Depth-first iterator over an XML subtree (parity: xml.rs TreeWalker)."""
+
+    def __init__(self, root):
+        self.stack = list(reversed(list(root.children()))) if hasattr(
+            root, "children"
+        ) else []
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self.stack:
+            raise StopIteration
+        node = self.stack.pop()
+        if hasattr(node, "children"):
+            self.stack.extend(reversed(list(node.children())))
+        return node
+
+
+class XmlFragment(_XmlChildren, _XmlNode, SharedType):
     type_ref = TYPE_XML_FRAGMENT
     __slots__ = ()
 
     def get_string(self) -> str:
         return self.children_str()
 
+    def successors(self) -> TreeWalker:
+        return TreeWalker(self)
+
+    def first_child(self):
+        return self.get(0)
+
     def to_json(self) -> str:
         return self.get_string()
 
 
-class XmlElement(_XmlChildren, _XmlAttrs, SharedType):
+class XmlElement(_XmlChildren, _XmlAttrs, _XmlNode, SharedType):
     type_ref = TYPE_XML_ELEMENT
     __slots__ = ()
 
     @property
     def tag(self) -> str:
         return self.branch.type_name or "UNDEFINED"
+
+    def successors(self) -> TreeWalker:
+        return TreeWalker(self)
+
+    def first_child(self):
+        return self.get(0)
 
     def get_string(self) -> str:
         attrs = "".join(f' {k}="{v}"' for k, v in sorted(self.attributes()))
@@ -121,7 +185,22 @@ class XmlElement(_XmlChildren, _XmlAttrs, SharedType):
         return self.get_string()
 
 
-class XmlText(_XmlAttrs, Text):
+class XmlHook(_XmlAttrs, SharedType):
+    """An opaque hook node keyed by name (parity: xml.rs XmlHook / map
+    component only)."""
+
+    type_ref = TYPE_XML_HOOK
+    __slots__ = ()
+
+    @property
+    def hook_name(self) -> str:
+        return self.branch.type_name or ""
+
+    def to_json(self) -> dict:
+        return {k: v for k, v in self.attributes()}
+
+
+class XmlText(_XmlAttrs, _XmlNode, Text):
     type_ref = TYPE_XML_TEXT
     __slots__ = ()
 
