@@ -1,0 +1,114 @@
+"""Fused Pallas integrate kernel parity vs the XLA path and the host oracle.
+
+Runs in interpreter mode on the CPU test mesh; the real-TPU compilation is
+exercised by bench.py.
+"""
+
+import random
+import string
+
+import jax
+import numpy as np
+import pytest
+
+from ytpu.core import Doc, Update
+from ytpu.models.batch_doc import (
+    BatchEncoder,
+    apply_update_stream,
+    get_string,
+    init_state,
+)
+from ytpu.ops.integrate_kernel import apply_update_stream_fused
+
+
+def build_stream(ops_fn, n_docs=8, capacity=128, rows=4, dels=4):
+    doc = Doc(client_id=1)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    ops_fn(doc)
+    enc = BatchEncoder()
+    steps = [enc.build_step(Update.decode_v1(p), rows, dels) for p in log]
+    stream = BatchEncoder.stack_steps(steps)
+    rank = enc.interner.rank_table()
+    expect = doc.get_text("text").get_string()
+    return stream, rank, enc, expect
+
+
+def assert_same_state(a, b):
+    for name in a.blocks._fields:
+        va, vb = np.asarray(getattr(a.blocks, name)), np.asarray(getattr(b.blocks, name))
+        assert np.array_equal(va, vb), f"column {name} diverged"
+    assert np.array_equal(np.asarray(a.start), np.asarray(b.start))
+    assert np.array_equal(np.asarray(a.n_blocks), np.asarray(b.n_blocks))
+    assert np.array_equal(np.asarray(a.error), np.asarray(b.error))
+
+
+def run_both(stream, rank, n_docs=8, capacity=128, d_block=4):
+    xla_state = apply_update_stream(init_state(n_docs, capacity), stream, rank)
+    fused_state = apply_update_stream_fused(
+        init_state(n_docs, capacity), stream, rank, d_block=d_block, interpret=True
+    )
+    return xla_state, fused_state
+
+
+def test_fused_sequential_inserts():
+    def ops(doc):
+        t = doc.get_text("text")
+        for i, chunk in enumerate(["hello ", "world", "!"]):
+            with doc.transact() as txn:
+                t.insert(txn, len(t), chunk)
+
+    stream, rank, enc, expect = build_stream(ops)
+    xla_state, fused_state = run_both(stream, rank)
+    assert_same_state(xla_state, fused_state)
+    assert get_string(fused_state, 0, enc.payloads) == expect
+    assert int(np.asarray(fused_state.error).max()) == 0
+
+
+def test_fused_random_edit_trace():
+    def ops(doc):
+        rng = random.Random(9)
+        t = doc.get_text("text")
+        for _ in range(30):
+            with doc.transact() as txn:
+                n = len(t)
+                if n > 5 and rng.random() < 0.35:
+                    pos = rng.randint(0, n - 2)
+                    t.remove_range(txn, pos, min(rng.randint(1, 3), n - pos))
+                else:
+                    word = "".join(
+                        rng.choice(string.ascii_lowercase) for _ in range(3)
+                    )
+                    t.insert(txn, rng.randint(0, n), word)
+
+    stream, rank, enc, expect = build_stream(ops, capacity=256)
+    xla_state, fused_state = run_both(stream, rank, capacity=256)
+    assert_same_state(xla_state, fused_state)
+    assert get_string(fused_state, 0, enc.payloads) == expect
+    assert get_string(fused_state, 7, enc.payloads) == expect
+
+
+def test_fused_concurrent_clients():
+    a, b = Doc(client_id=5), Doc(client_id=3)
+    la, lb = [], []
+    a.observe_update_v1(lambda p, o, t: la.append(p))
+    b.observe_update_v1(lambda p, o, t: lb.append(p))
+    ta, tb = a.get_text("text"), b.get_text("text")
+    with a.transact() as txn:
+        ta.insert(txn, 0, "AAA")
+    with b.transact() as txn:
+        tb.insert(txn, 0, "BB")
+    # interleave the two independent (conflicting) streams
+    payloads = [la[0], lb[0]]
+    host = Doc(client_id=99)
+    for p in payloads:
+        host.apply_update_v1(p)
+    expect = host.get_text("text").get_string()
+
+    enc = BatchEncoder()
+    steps = [enc.build_step(Update.decode_v1(p), 4, 4) for p in payloads]
+    stream = BatchEncoder.stack_steps(steps)
+    rank = enc.interner.rank_table()
+    xla_state, fused_state = run_both(stream, rank)
+    assert_same_state(xla_state, fused_state)
+    assert get_string(fused_state, 0, enc.payloads) == expect

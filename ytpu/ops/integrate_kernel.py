@@ -1,0 +1,495 @@
+"""Fused Pallas integrate kernel — the whole update-stream replay in VMEM.
+
+The XLA path (`ytpu.models.batch_doc.apply_update_stream`) streams the full
+[docs, capacity] block state through HBM once per update step (every scatter
+and select materializes columns). This kernel removes that bottleneck:
+
+- the doc axis is tiled (D_BLK docs per grid program) and each tile's block
+  columns are DMA'd into VMEM **once**;
+- the *entire* S-step update stream is integrated in-core (YATA conflict
+  scans, splits, delete ranges — all vectorized over the doc sublanes with
+  one-hot selects over the capacity lanes);
+- the tile is written back **once**. HBM traffic drops from
+  O(S · docs · capacity) to O(docs · capacity + S).
+
+Semantics mirror `_integrate_row` / `_apply_delete_range` in batch_doc.py
+(reference: block.rs:482-769, transaction.rs:472-575); parity is enforced in
+tests/test_pallas_kernel.py against both the XLA path and the host oracle.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ytpu.core.content import BLOCK_GC, CONTENT_DELETED, CONTENT_FORMAT
+from ytpu.models.batch_doc import BlockCols, DocStateBatch, UpdateBatch
+
+__all__ = [
+    "pack_state",
+    "unpack_state",
+    "pack_stream",
+    "apply_update_stream_fused",
+]
+
+I32 = jnp.int32
+
+# column indices in the packed [NC, D, C] state
+(
+    CL,  # client
+    CK,  # clock
+    LN,  # length
+    OC,  # origin client
+    OK,  # origin clock
+    RC,  # right-origin client
+    RK,  # right-origin clock
+    LT,  # left link
+    RT,  # right link
+    DL,  # deleted flag
+    CN,  # countable flag
+    KD,  # content kind
+    RF,  # content ref
+    OF,  # content offset
+) = range(14)
+NC = 14
+
+# meta columns in the packed [D, 8] array (padded to a TPU-friendly lane dim)
+M_START, M_NBLOCKS, M_ERROR = 0, 1, 2
+M_PAD = 8
+
+ERR_CAPACITY = 1
+ERR_MISSING_DEP = 2
+
+
+def pack_state(state: DocStateBatch) -> Tuple[jax.Array, jax.Array]:
+    bl = state.blocks
+    cols = jnp.stack(
+        [
+            bl.client,
+            bl.clock,
+            bl.length,
+            bl.origin_client,
+            bl.origin_clock,
+            bl.ror_client,
+            bl.ror_clock,
+            bl.left,
+            bl.right,
+            bl.deleted.astype(I32),
+            bl.countable.astype(I32),
+            bl.kind,
+            bl.content_ref,
+            bl.content_off,
+        ]
+    )  # [NC, D, C]
+    D = state.start.shape[0]
+    meta = jnp.zeros((D, M_PAD), I32)
+    meta = meta.at[:, M_START].set(state.start)
+    meta = meta.at[:, M_NBLOCKS].set(state.n_blocks)
+    meta = meta.at[:, M_ERROR].set(state.error)
+    return cols, meta
+
+
+def unpack_state(cols: jax.Array, meta: jax.Array) -> DocStateBatch:
+    blocks = BlockCols(
+        client=cols[CL],
+        clock=cols[CK],
+        length=cols[LN],
+        origin_client=cols[OC],
+        origin_clock=cols[OK],
+        ror_client=cols[RC],
+        ror_clock=cols[RK],
+        left=cols[LT],
+        right=cols[RT],
+        deleted=cols[DL].astype(bool),
+        countable=cols[CN].astype(bool),
+        kind=cols[KD],
+        content_ref=cols[RF],
+        content_off=cols[OF],
+    )
+    return DocStateBatch(
+        blocks=blocks,
+        start=meta[:, M_START],
+        n_blocks=meta[:, M_NBLOCKS],
+        error=meta[:, M_ERROR],
+    )
+
+
+def pack_stream(stream: UpdateBatch) -> Tuple[jax.Array, jax.Array]:
+    """Stacked doc-axis-free stream → rows [S, U, 11] / dels [S, R, 4] i32."""
+    rows = jnp.stack(
+        [
+            stream.client,
+            stream.clock,
+            stream.length,
+            stream.origin_client,
+            stream.origin_clock,
+            stream.ror_client,
+            stream.ror_clock,
+            stream.kind,
+            stream.content_ref,
+            stream.content_off,
+            stream.valid.astype(I32),
+        ],
+        axis=-1,
+    )  # [S, U, 11]
+    dels = jnp.stack(
+        [
+            stream.del_client,
+            stream.del_start,
+            stream.del_end,
+            stream.del_valid.astype(I32),
+        ],
+        axis=-1,
+    )  # [S, R, 4]
+    return rows, dels
+
+
+def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref):
+    """One doc tile: integrate the whole stream in VMEM.
+
+    cols_ref: [NC, DB, C] out-ref aliased to the input (holds the state),
+    meta_ref: [DB, 8] aliased; rows_ref: [S, U, 11], dels_ref: [S, R, 4],
+    rank_ref: [1, K]. The plain in-refs are shadows of the aliased buffers
+    and are unused.
+    """
+    S, U, _ = rows_ref.shape
+    R = dels_ref.shape[1]
+    DB = cols_ref.shape[1]
+    C = cols_ref.shape[2]
+    iota_c = jax.lax.broadcasted_iota(I32, (DB, C), 1)
+
+    def col(i):
+        return cols_ref[i]
+
+    def gather(i, idx, fill):
+        """Per-doc element col(i)[d, idx[d]] with idx < 0 -> fill."""
+        onehot = iota_c == idx[:, None]
+        v = jnp.sum(jnp.where(onehot, col(i), 0), axis=1)
+        return jnp.where(idx >= 0, v, fill)
+
+    def put(i, idx, val, active):
+        """col(i)[d, idx[d]] = val[d] where active[d] & idx valid."""
+        mask = (iota_c == idx[:, None]) & active[:, None] & (idx >= 0)[:, None]
+        cols_ref[i] = jnp.where(mask, val[:, None], col(i))
+
+    def n_blocks():
+        return meta_ref[:, M_NBLOCKS]
+
+    K = rank_ref.shape[1]
+    iota_k = jax.lax.broadcasted_iota(I32, (DB, K), 1)
+
+    def gather_rank(client_v):
+        """rank_ref[0, client_v[d]] per doc (one-hot gather)."""
+        onehot = iota_k == jnp.maximum(client_v, 0)[:, None]
+        return jnp.sum(jnp.where(onehot, rank_ref[0][None, :], 0), axis=1)
+
+    def find_slot(client_v, clock_v, enable):
+        """(idx[DB], found[DB]) of the block covering (client, clock);
+        `client_v`/`clock_v` are per-doc (DB,) vectors."""
+        valid = iota_c < n_blocks()[:, None]
+        m = (
+            valid
+            & (col(CL) == client_v[:, None])
+            & (col(CK) <= clock_v[:, None])
+            & (clock_v[:, None] < col(CK) + col(LN))
+            & enable[:, None]
+        )
+        # integer argmax is unsupported in Mosaic: min-reduce the indices
+        idx = jnp.min(jnp.where(m, iota_c, C), axis=1).astype(I32)
+        found = idx < C
+        return jnp.where(found, idx, -1), found
+
+    def client_clock(client_s):
+        valid = iota_c < n_blocks()[:, None]
+        m = valid & (col(CL) == client_s)
+        return jnp.max(jnp.where(m, col(CK) + col(LN), 0), axis=1)
+
+    def split(i_idx, off, want):
+        """Split block i at off (per doc); returns right-half slot (or i)."""
+        length_i = gather(LN, i_idx, 0)
+        do = want & (i_idx >= 0) & (off > 0) & (off < length_i)
+        j = n_blocks()
+        overflow = do & (j >= C)
+        do = do & (j < C)
+        right_i = gather(RT, i_idx, -1)
+        # new row j = right half
+        put(CL, j, gather(CL, i_idx, -1), do)
+        put(CK, j, gather(CK, i_idx, 0) + off, do)
+        put(LN, j, length_i - off, do)
+        put(OC, j, gather(CL, i_idx, -1), do)
+        put(OK, j, gather(CK, i_idx, 0) + off - 1, do)
+        put(RC, j, gather(RC, i_idx, -1), do)
+        put(RK, j, gather(RK, i_idx, 0), do)
+        put(LT, j, i_idx, do)
+        put(RT, j, right_i, do)
+        put(DL, j, gather(DL, i_idx, 0), do)
+        put(CN, j, gather(CN, i_idx, 0), do)
+        put(KD, j, gather(KD, i_idx, 0), do)
+        put(RF, j, gather(RF, i_idx, -1), do)
+        put(OF, j, gather(OF, i_idx, 0) + off, do)
+        # fix left half + old right neighbor
+        put(LN, i_idx, off, do)
+        put(RT, i_idx, j, do)
+        put(LT, right_i, j, do & (right_i >= 0))
+        meta_ref[:, M_NBLOCKS] = n_blocks() + do.astype(I32)
+        meta_ref[:, M_ERROR] = meta_ref[:, M_ERROR] | jnp.where(overflow, ERR_CAPACITY, 0)
+        return jnp.where(do, j, i_idx)
+
+    def clean_end(client_s, clock_v, enable):
+        i, found = find_slot(client_s, clock_v, enable)
+        off = clock_v - gather(CK, i, 0) + 1
+        split(i, off, enable & found)
+        return i, found
+
+    def clean_start(client_s, clock_v, enable):
+        i, found = find_slot(client_s, clock_v, enable)
+        off = clock_v - gather(CK, i, 0)
+        j = split(i, off, enable & found)
+        return jnp.where((i >= 0) & (off > 0), j, i), found
+
+    def integrate_row(s, u):
+        r_client = rows_ref[s, u, 0]
+        r_clock = rows_ref[s, u, 1]
+        r_len = rows_ref[s, u, 2]
+        r_oc = rows_ref[s, u, 3]
+        r_ok = rows_ref[s, u, 4]
+        r_rc = rows_ref[s, u, 5]
+        r_rk = rows_ref[s, u, 6]
+        r_kind = rows_ref[s, u, 7]
+        r_ref = rows_ref[s, u, 8]
+        r_off = rows_ref[s, u, 9]
+
+        local = client_clock(r_client)  # (DB,)
+        applicable = local >= r_clock
+        missing = ~applicable
+        offset = local - r_clock
+        dup = applicable & (offset >= r_len)
+        do = applicable & ~dup
+
+        clock = r_clock + offset
+        length = r_len - offset
+        c_off = r_off + offset
+        has_origin = (offset > 0) | (r_oc >= 0)
+        origin_client = jnp.where(offset > 0, r_client, r_oc)
+        origin_clock = jnp.where(offset > 0, clock - 1, r_ok)
+        has_ror = r_rc >= 0
+
+        is_gc = r_kind == BLOCK_GC
+        linkable = do & ~is_gc
+
+        left_idx, lfound = clean_end(
+            origin_client, origin_clock, linkable & has_origin
+        )
+        right_idx, rfound = clean_start(
+            jnp.full((DB,), r_rc, I32), jnp.full((DB,), r_rk, I32),
+            linkable & has_ror,
+        )
+        left_idx = jnp.where(linkable & has_origin, left_idx, -1)
+        right_idx = jnp.where(linkable & has_ror, right_idx, -1)
+
+        anchor_missing = (linkable & has_origin & (left_idx < 0)) | (
+            linkable & has_ror & (right_idx < 0)
+        )
+        missing = missing | anchor_missing
+        linkable = linkable & ~anchor_missing
+
+        right_left = gather(LT, right_idx, -1)
+        need_scan = linkable & (
+            ((left_idx < 0) & ((right_idx < 0) | (right_left >= 0)))
+            | ((left_idx >= 0) & (gather(RT, left_idx, -1) != right_idx))
+        )
+        o0 = jnp.where(left_idx >= 0, gather(RT, left_idx, -1), meta_ref[:, M_START])
+        o0 = jnp.where(need_scan, o0, -1)
+
+        def origins_equal(ha, ca, ka, hb, cb, kb):
+            return (~ha & ~hb) | (ha & hb & (ca == cb) & (ka == kb))
+
+        def scan_cond(carry):
+            o, left, conflicting, before, brk = carry
+            active = (o >= 0) & (o != right_idx) & (brk == 0)
+            return jnp.any(active)
+
+        def scan_body(carry):
+            o, left, conflicting, before, brk = carry
+            active = (o >= 0) & (o != right_idx) & (brk == 0)
+            onehot_o = ((iota_c == o[:, None]) & active[:, None]).astype(I32)
+            before = before | onehot_o
+            conflicting = conflicting | onehot_o
+            o_oc = gather(OC, o, -1)
+            o_ok = gather(OK, o, 0)
+            same_origin = origins_equal(
+                has_origin, origin_client, origin_clock, o_oc >= 0, o_oc, o_ok
+            )
+            o_rc = gather(RC, o, -1)
+            o_rk = gather(RK, o, 0)
+            same_ror = origins_equal(has_ror, r_rc, r_rk, o_rc >= 0, o_rc, o_rk)
+            o_client = gather(CL, o, -1)
+            rank_o = gather_rank(o_client)
+            rank_r = gather_rank(jnp.full((DB,), r_client, I32))
+            case1_take = same_origin & (rank_o < rank_r)
+            case1_break = same_origin & ~case1_take & same_ror
+            # case 2: does o's origin sit inside the scanned region?
+            oo_idx, oo_found = find_slot(o_oc, o_ok, active & (o_oc >= 0))
+            # per-doc membership of oo_idx in before/conflicting
+            in_before = oo_found & (
+                jnp.sum(jnp.where(iota_c == oo_idx[:, None], before, 0), axis=1) > 0
+            )
+            in_conflicting = oo_found & (
+                jnp.sum(jnp.where(iota_c == oo_idx[:, None], conflicting, 0), axis=1)
+                > 0
+            )
+            case2_take = ~same_origin & in_before & ~in_conflicting
+            case2_break = ~same_origin & ~in_before
+
+            take = (case1_take | case2_take) & active
+            left = jnp.where(take, o, left)
+            conflicting = jnp.where(take[:, None], 0, conflicting)
+            brk = brk | ((case1_break | case2_break) & active).astype(I32)
+            o_next = gather(RT, o, -1)
+            o = jnp.where(active & (brk == 0), o_next, o)
+            return (o, left, conflicting, before, brk)
+
+        zeros = jnp.zeros((DB, C), I32)
+        _, left_scanned, _, _, _ = jax.lax.while_loop(
+            scan_cond,
+            scan_body,
+            (o0, left_idx, zeros, zeros, jnp.zeros((DB,), I32)),
+        )
+        left_idx = jnp.where(need_scan, left_scanned, left_idx)
+
+        j = n_blocks()
+        overflow = do & (j >= C)
+        do = do & (j < C)
+        linkable = linkable & (j < C)
+
+        has_left = linkable & (left_idx >= 0)
+        right_final = jnp.where(
+            has_left, gather(RT, left_idx, -1), jnp.where(linkable, meta_ref[:, M_START], -1)
+        )
+        put(RT, left_idx, j, has_left)
+        meta_ref[:, M_START] = jnp.where(linkable & ~has_left, j, meta_ref[:, M_START])
+        put(LT, right_final, j, linkable & (right_final >= 0))
+
+        row_deleted = is_gc | (r_kind == CONTENT_DELETED)
+        row_countable = ~row_deleted & (r_kind != CONTENT_FORMAT)
+
+        put(CL, j, jnp.full((DB,), r_client, I32), do)
+        put(CK, j, clock, do)
+        put(LN, j, length, do)
+        put(OC, j, jnp.where(has_origin, origin_client, -1), do)
+        put(OK, j, jnp.where(has_origin, origin_clock, 0), do)
+        put(RC, j, jnp.full((DB,), jnp.where(has_ror, r_rc, -1), I32), do)
+        put(RK, j, jnp.full((DB,), jnp.where(has_ror, r_rk, 0), I32), do)
+        put(LT, j, jnp.where(linkable, left_idx, -1), do)
+        put(RT, j, jnp.where(linkable, right_final, -1), do)
+        put(DL, j, jnp.full((DB,), row_deleted.astype(I32), I32), do)
+        put(CN, j, jnp.full((DB,), row_countable.astype(I32), I32), do)
+        put(KD, j, jnp.full((DB,), r_kind, I32), do)
+        put(RF, j, jnp.full((DB,), r_ref, I32), do)
+        put(OF, j, c_off, do)
+        meta_ref[:, M_NBLOCKS] = n_blocks() + do.astype(I32)
+        meta_ref[:, M_ERROR] = (
+            meta_ref[:, M_ERROR]
+            | jnp.where(overflow, ERR_CAPACITY, 0)
+            | jnp.where(missing, ERR_MISSING_DEP, 0)
+        )
+
+    def delete_range(s, r):
+        client = dels_ref[s, r, 0]
+        start = dels_ref[s, r, 1]
+        end = dels_ref[s, r, 2]
+        enable = jnp.ones((DB,), bool)
+        client_v = jnp.full((DB,), client, I32)
+        start_v = jnp.full((DB,), start, I32)
+        end_v = jnp.full((DB,), end, I32)
+        # split head
+        i, found = find_slot(client_v, start_v, enable)
+        i_ok = found & (gather(DL, i, 1) == 0)
+        split(i, start_v - gather(CK, i, 0), i_ok)
+        # split tail
+        k, kfound = find_slot(client_v, end_v - 1, enable)
+        k_ok = kfound & (gather(DL, k, 1) == 0)
+        split(k, end_v - gather(CK, k, 0), k_ok)
+        # mark covered blocks deleted
+        valid = iota_c < n_blocks()[:, None]
+        m = (
+            valid
+            & (col(CL) == client)
+            & (col(CK) >= start)
+            & (col(CK) + col(LN) <= end)
+        )
+        cols_ref[DL] = jnp.where(m, 1, col(DL))
+
+    def step(s, _):
+        def row_body(u, __):
+            @pl.when(rows_ref[s, u, 10] == 1)
+            def _():
+                integrate_row(s, u)
+
+            return 0
+
+        jax.lax.fori_loop(0, U, row_body, 0)
+
+        def del_body(r, __):
+            @pl.when(dels_ref[s, r, 3] == 1)
+            def _():
+                delete_range(s, r)
+
+            return 0
+
+        jax.lax.fori_loop(0, R, del_body, 0)
+        return 0
+
+    jax.lax.fori_loop(0, S, step, 0)
+
+
+@partial(jax.jit, static_argnums=(3, 4), donate_argnums=(0, 1))
+def _run(cols, meta, packed, d_block: int, interpret: bool):
+    rows, dels, rank = packed
+    NC_, D, C = cols.shape
+    grid = (D // d_block,)
+    rank = rank.reshape(1, -1)
+    out = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(rows.shape, lambda d: (0, 0, 0)),
+            pl.BlockSpec(dels.shape, lambda d: (0, 0, 0)),
+            pl.BlockSpec(rank.shape, lambda d: (0, 0)),
+            pl.BlockSpec((NC, d_block, C), lambda d: (0, d, 0)),
+            pl.BlockSpec((d_block, M_PAD), lambda d: (d, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((NC, d_block, C), lambda d: (0, d, 0)),
+            pl.BlockSpec((d_block, M_PAD), lambda d: (d, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(cols.shape, I32),
+            jax.ShapeDtypeStruct(meta.shape, I32),
+        ],
+        input_output_aliases={3: 0, 4: 1},
+        interpret=interpret,
+    )(rows, dels, rank, cols, meta)
+    return out
+
+
+def apply_update_stream_fused(
+    state: DocStateBatch,
+    stream: UpdateBatch,
+    client_rank: jax.Array,
+    d_block: int = 32,
+    interpret: bool = False,
+) -> DocStateBatch:
+    """Fused-replay drop-in for `apply_update_stream` (same semantics)."""
+    cols, meta = pack_state(state)
+    D = cols.shape[1]
+    if D % d_block != 0:
+        raise ValueError(f"n_docs {D} must be a multiple of d_block {d_block}")
+    rows, dels = pack_stream(stream)
+    cols, meta = _run(cols, meta, (rows, dels, client_rank), d_block, interpret)
+    return unpack_state(cols, meta)
