@@ -1,0 +1,171 @@
+"""Rich-text parity: snapshot diff_range/YChange + TextEvent attribute deltas.
+
+Reference behavior: /root/reference/yrs/src/types/text.rs — DiffIterator with
+snapshot visibility (:534-634), YChange (:1190), event-delta state machine
+(:1213-1305).
+"""
+
+from ytpu.core import Doc
+from ytpu.types.events import Change
+from ytpu.types.text import Diff, YChange
+
+
+def test_diff_range_added_and_removed():
+    # skip_gc keeps tombstoned content renderable (same caveat as the
+    # reference's encode_state_from_snapshot, lib.rs:410-417)
+    doc = Doc(client_id=1, skip_gc=True)
+    txt = doc.get_text("t")
+    with doc.transact() as txn:
+        txt.insert(txn, 0, "hello world")
+    lo = doc.snapshot()
+    with doc.transact() as txn:
+        txt.remove_range(txn, 0, 6)       # drop "hello "
+        txt.insert(txn, 5, "!")           # "world!"
+    hi = doc.snapshot()
+    with doc.transact() as txn:
+        runs = txt.diff_range(txn, hi, lo)
+    assert [r.insert for r in runs] == ["hello ", "world", "!"]
+    assert runs[0].ychange.kind == YChange.REMOVED
+    assert runs[1].ychange is None
+    assert runs[2].ychange.kind == YChange.ADDED
+
+
+def test_diff_range_current_vs_lo():
+    doc = Doc(client_id=1)
+    txt = doc.get_text("t")
+    with doc.transact() as txn:
+        txt.insert(txn, 0, "abc")
+    lo = doc.snapshot()
+    with doc.transact() as txn:
+        txt.insert(txn, 3, "def")
+    with doc.transact() as txn:
+        runs = txt.diff_range(txn, None, lo)
+    assert runs == [
+        Diff("abc"),
+        Diff("def", None, YChange(YChange.ADDED, runs[1].ychange.id)),
+    ]
+
+
+def test_diff_range_no_snapshots_matches_diff():
+    doc = Doc(client_id=1)
+    txt = doc.get_text("t")
+    with doc.transact() as txn:
+        txt.insert(txn, 0, "plain ")
+        txt.insert_with_attributes(txn, 6, "bold", {"bold": True})
+    with doc.transact() as txn:
+        runs = txt.diff_range(txn, None, None)
+    assert runs == txt.diff()
+    assert runs == [Diff("plain "), Diff("bold", {"bold": True})]
+
+
+def test_diff_range_keeps_formats_of_hi_snapshot():
+    doc = Doc(client_id=1)
+    txt = doc.get_text("t")
+    with doc.transact() as txn:
+        txt.insert_with_attributes(txn, 0, "xy", {"em": 1})
+    hi = doc.snapshot()
+    with doc.transact() as txn:
+        txt.insert(txn, 2, "z")
+    with doc.transact() as txn:
+        runs = txt.diff_range(txn, hi, None)
+    assert runs == [Diff("xy", {"em": 1})]
+
+
+def test_event_delta_format_retain_attributes():
+    doc = Doc(client_id=1)
+    txt = doc.get_text("t")
+    with doc.transact() as txn:
+        txt.insert(txn, 0, "hello world")
+    deltas = []
+    txt.observe(lambda txn, e: deltas.append(e.delta()))
+    with doc.transact() as txn:
+        txt.format(txn, 0, 5, {"bold": True})
+    assert deltas == [[Change.retain(5, {"bold": True})]]
+
+
+def test_event_delta_insert_with_attributes():
+    doc = Doc(client_id=1)
+    txt = doc.get_text("t")
+    with doc.transact() as txn:
+        txt.insert(txn, 0, "hello world")
+    deltas = []
+    txt.observe(lambda txn, e: deltas.append(e.delta()))
+    with doc.transact() as txn:
+        txt.insert_with_attributes(txn, 5, "XX", {"italic": True})
+    assert deltas == [
+        [Change.retain(5), Change.insert(list("XX"), {"italic": True})]
+    ]
+
+
+def test_event_delta_unformat():
+    doc = Doc(client_id=1)
+    txt = doc.get_text("t")
+    with doc.transact() as txn:
+        txt.insert_with_attributes(txn, 0, "abc", {"bold": True})
+        txt.insert(txn, 3, "def")
+    deltas = []
+    txt.observe(lambda txn, e: deltas.append(e.delta()))
+    with doc.transact() as txn:
+        txt.format(txn, 0, 3, {"bold": None})
+    assert deltas == [[Change.retain(3, {"bold": None})]]
+
+
+def test_event_delta_plain_ops_unchanged():
+    doc = Doc(client_id=1)
+    txt = doc.get_text("t")
+    with doc.transact() as txn:
+        txt.insert(txn, 0, "abcdef")
+    deltas = []
+    txt.observe(lambda txn, e: deltas.append(e.delta()))
+    with doc.transact() as txn:
+        txt.remove_range(txn, 1, 2)
+        txt.insert(txn, 1, "XY")
+    assert len(deltas) == 1
+    kinds = [c.kind for c in deltas[0]]
+    assert kinds[0] == "retain" and set(kinds) <= {"retain", "insert", "delete"}
+
+
+def test_event_delta_deleted_mark_keeps_pending_attr():
+    """Deleting an unformat mark re-bolds the following run; the event delta
+    must keep the pending attribute past a later old mark with equal value."""
+    doc = Doc(client_id=1)
+    txt = doc.get_text("t")
+    with doc.transact() as txn:
+        txt.insert(txn, 0, "abc")
+        txt.format(txn, 0, 1, {"bold": True})  # F(T) 'a' F(None) 'bc'
+        txt.format(txn, 2, 1, {"bold": True})  # ... 'b' F(T) 'c' F(None)
+    deltas = []
+    txt.observe(lambda txn, e: deltas.append(e.delta()))
+    with doc.transact() as txn:
+        # delete the F(bold, None) mark between "a" and "b"
+        item = txt.branch.start
+        while item is not None:
+            from ytpu.core.content import ContentFormat
+
+            if isinstance(item.content, ContentFormat) and item.content.value is None:
+                txn.delete(item)
+                break
+            item = item.right
+    assert deltas and deltas[0], "formatting change must produce a delta"
+    assert deltas[0] == [
+        Change.retain(1),
+        Change.retain(2, {"bold": True}),
+    ]
+
+
+def test_diff_range_remote_concurrent():
+    """Annotations survive a merge of concurrent edits."""
+    a, b = Doc(client_id=1), Doc(client_id=2)
+    ta, tb = a.get_text("t"), b.get_text("t")
+    with a.transact() as txn:
+        ta.insert(txn, 0, "base")
+    b.apply_update_v1(a.encode_state_as_update_v1())
+    lo = a.snapshot()
+    with b.transact() as txn:
+        tb.insert(txn, 4, "+remote")
+    a.apply_update_v1(b.encode_state_as_update_v1(a.state_vector()))
+    with a.transact() as txn:
+        runs = ta.diff_range(txn, None, lo)
+    assert [r.insert for r in runs] == ["base", "+remote"]
+    assert runs[0].ychange is None
+    assert runs[1].ychange.kind == YChange.ADDED

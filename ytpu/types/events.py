@@ -22,36 +22,53 @@ __all__ = ["Event", "Change", "EntryChange", "fire_type_events"]
 
 
 class Change:
-    """A sequence delta segment: ('insert', values) / ('delete', n) / ('retain', n)."""
+    """A sequence delta segment: ('insert', values) / ('delete', n) / ('retain', n).
 
-    __slots__ = ("kind", "values", "len")
+    Insert and retain segments may carry formatting `attributes` (parity:
+    the `Delta` variants of types/mod.rs:1068-1183 / types/text.rs:1213-1305).
+    """
 
-    def __init__(self, kind: str, values: Optional[List[PyAny]] = None, length: int = 0):
+    __slots__ = ("kind", "values", "len", "attributes")
+
+    def __init__(
+        self,
+        kind: str,
+        values: Optional[List[PyAny]] = None,
+        length: int = 0,
+        attributes: Optional[Dict[str, PyAny]] = None,
+    ):
         self.kind = kind
         self.values = values
         self.len = length
+        self.attributes = attributes or None
 
     @classmethod
-    def insert(cls, values: List[PyAny]) -> "Change":
-        return cls("insert", values, len(values))
+    def insert(cls, values: List[PyAny], attributes=None) -> "Change":
+        return cls("insert", values, len(values), attributes)
 
     @classmethod
     def delete(cls, n: int) -> "Change":
         return cls("delete", None, n)
 
     @classmethod
-    def retain(cls, n: int) -> "Change":
-        return cls("retain", None, n)
+    def retain(cls, n: int, attributes=None) -> "Change":
+        return cls("retain", None, n, attributes)
 
     def __repr__(self) -> str:
+        suffix = f", {self.attributes!r}" if self.attributes else ""
         if self.kind == "insert":
-            return f"Insert({self.values!r})"
-        return f"{self.kind.capitalize()}({self.len})"
+            return f"Insert({self.values!r}{suffix})"
+        return f"{self.kind.capitalize()}({self.len}{suffix})"
 
     def __eq__(self, other):
         if not isinstance(other, Change):
             return NotImplemented
-        return self.kind == other.kind and self.len == other.len and self.values == other.values
+        return (
+            self.kind == other.kind
+            and self.len == other.len
+            and self.values == other.values
+            and (self.attributes or None) == (other.attributes or None)
+        )
 
 
 class EntryChange:
@@ -110,43 +127,108 @@ class Event:
     # --- sequence delta --------------------------------------------------------
 
     def delta(self) -> List[Change]:
-        """Reconstruct insert/delete/retain runs for the sequence component."""
+        """Reconstruct insert/delete/retain runs for the sequence component,
+        carrying formatting attributes (parity: the event-delta state machine
+        of types/text.rs:1213-1305: track current vs. pre-transaction
+        attributes; a surviving new Format mark turns into a retain-with-
+        attributes segment unless it restores the old value)."""
         if self._delta is None:
             from ytpu.types.shared import out_value
 
             txn = self.txn
             before = txn.before_state
             changes: List[Change] = []
+            action: Optional[str] = None
+            insert_buf: List[PyAny] = []
             retain = 0
+            delete_len = 0
+            current_attrs: Dict[str, PyAny] = {}   # formatting left of the cursor, now
+            old_attrs: Dict[str, PyAny] = {}       # formatting left of the cursor, before txn
+            pending_attrs: Dict[str, PyAny] = {}   # attribute changes for retain segments
+
+            def add_op():
+                nonlocal action, retain, delete_len
+                if action == "insert" and insert_buf:
+                    attrs = {
+                        k: v for k, v in current_attrs.items() if v is not None
+                    }
+                    changes.append(Change.insert(insert_buf[:], attrs or None))
+                    insert_buf.clear()
+                elif action == "delete" and delete_len:
+                    changes.append(Change.delete(delete_len))
+                    delete_len = 0
+                elif action == "retain" and retain:
+                    changes.append(
+                        Change.retain(retain, dict(pending_attrs) or None)
+                    )
+                    retain = 0
+                action = None
+
+            def set_action(a: str):
+                nonlocal action
+                if action != a:
+                    add_op()
+                    action = a
+
             item = self.target.start
             while item is not None:
-                known_before = item.id.clock < before.get(item.id.client)
-                deleted_now = item.deleted
-                deleted_in_txn = txn.delete_set.contains(item.id)
-                if not known_before and not deleted_now:
-                    # fresh insert that survived
-                    if item.countable:
-                        if retain:
-                            changes.append(Change.retain(retain))
-                            retain = 0
-                        values = [out_value(item, i) for i in range(item.len)]
-                        if changes and changes[-1].kind == "insert":
-                            changes[-1].values.extend(values)
-                            changes[-1].len += len(values)
+                adds = item.id.clock >= before.get(item.id.client)
+                dels = txn.delete_set.contains(item.id)
+                content = item.content
+                if isinstance(content, ContentFormat):
+                    key, value = content.key, content.value
+                    if adds:
+                        if not dels:
+                            cur = current_attrs.get(key)
+                            if cur != value:
+                                if action == "retain":
+                                    add_op()
+                                if value == old_attrs.get(key):
+                                    pending_attrs.pop(key, None)
+                                else:
+                                    pending_attrs[key] = value
+                    elif dels:
+                        old_attrs[key] = value
+                        cur = current_attrs.get(key)
+                        if cur != value:
+                            if action == "retain":
+                                add_op()
+                            pending_attrs[key] = cur
+                    elif not item.deleted:
+                        old_attrs[key] = value
+                        if key in pending_attrs and pending_attrs[key] != value:
+                            if action == "retain":
+                                add_op()
+                            if value is None:
+                                pending_attrs.pop(key)
+                            else:
+                                pending_attrs[key] = value
+                        # equal pending value: keep it — the run between the
+                        # change and this old mark still needs the attribute
+                    if not item.deleted:
+                        if action == "insert":
+                            add_op()
+                        if value is None:
+                            current_attrs.pop(key, None)
                         else:
-                            changes.append(Change.insert(values))
-                elif known_before and deleted_in_txn and deleted_now:
-                    if item.countable:
-                        if retain:
-                            changes.append(Change.retain(retain))
-                            retain = 0
-                        if changes and changes[-1].kind == "delete":
-                            changes[-1].len += item.len
-                        else:
-                            changes.append(Change.delete(item.len))
-                elif not deleted_now and item.countable:
-                    retain += item.len
+                            current_attrs[key] = value
+                elif item.countable:
+                    if adds:
+                        if not dels:
+                            set_action("insert")
+                            insert_buf.extend(
+                                out_value(item, i) for i in range(item.len)
+                            )
+                    elif dels:
+                        set_action("delete")
+                        delete_len += item.len
+                    elif not item.deleted:
+                        set_action("retain")
+                        retain += item.len
                 item = item.right
+            add_op()
+            while changes and changes[-1].kind == "retain" and not changes[-1].attributes:
+                changes.pop()
             self._delta = changes
         return self._delta
 

@@ -24,29 +24,63 @@ from ytpu.core.transaction import ItemPosition, Transaction
 
 from .shared import SharedType, find_position, to_content
 
-__all__ = ["Text", "Diff"]
+__all__ = ["Text", "Diff", "YChange"]
+
+
+class YChange:
+    """Change annotation on a snapshot diff run (parity: types/text.rs:1190 —
+    `YChange { kind, id }`; kinds Added/Removed)."""
+
+    ADDED = "added"
+    REMOVED = "removed"
+
+    __slots__ = ("kind", "id")
+
+    def __init__(self, kind: str, id):
+        self.kind = kind
+        self.id = id
+
+    def __eq__(self, other):
+        if not isinstance(other, YChange):
+            return NotImplemented
+        return self.kind == other.kind and self.id == other.id
+
+    def __repr__(self):
+        return f"YChange({self.kind}, {self.id})"
 
 
 class Diff:
-    """One run of a text diff: a value plus its formatting attributes."""
+    """One run of a text diff: a value plus its formatting attributes and an
+    optional snapshot-change annotation (parity: types/text.rs:1103 `Diff`)."""
 
-    __slots__ = ("insert", "attributes")
+    __slots__ = ("insert", "attributes", "ychange")
 
-    def __init__(self, insert: PyAny, attributes: Optional[Dict[str, PyAny]] = None):
+    def __init__(
+        self,
+        insert: PyAny,
+        attributes: Optional[Dict[str, PyAny]] = None,
+        ychange: Optional[YChange] = None,
+    ):
         self.insert = insert
         self.attributes = attributes
+        self.ychange = ychange
 
     def __eq__(self, other):
         if not isinstance(other, Diff):
             return NotImplemented
-        return self.insert == other.insert and (self.attributes or None) == (
-            other.attributes or None
+        return (
+            self.insert == other.insert
+            and (self.attributes or None) == (other.attributes or None)
+            and self.ychange == other.ychange
         )
 
     def __repr__(self):
+        parts = [repr(self.insert)]
         if self.attributes:
-            return f"Diff({self.insert!r}, {self.attributes!r})"
-        return f"Diff({self.insert!r})"
+            parts.append(repr(self.attributes))
+        if self.ychange:
+            parts.append(repr(self.ychange))
+        return f"Diff({', '.join(parts)})"
 
 
 class Text(SharedType):
@@ -70,32 +104,97 @@ class Text(SharedType):
 
     def diff(self) -> List[Diff]:
         """Current content as runs annotated with formatting attributes."""
+        return self.diff_range(None, None, None)
+
+    def diff_range(
+        self,
+        txn: Optional[Transaction],
+        hi=None,
+        lo=None,
+        compute_ychange=None,
+    ) -> List[Diff]:
+        """Diff runs between two historical states (parity: types/text.rs:534-
+        `diff_range` / DiffIterator with snapshot visibility :577).
+
+        `hi` is the snapshot to render (None = current state); `lo` is an
+        earlier snapshot used to annotate runs: content visible in `hi` but
+        not in `lo` is marked `YChange.ADDED`; content visible in `lo` but
+        deleted by `hi` is included and marked `YChange.REMOVED`.
+        """
+        if compute_ychange is None:
+            compute_ychange = YChange
+        for snap in (hi, lo):
+            if snap is not None:
+                if txn is None:
+                    raise ValueError("diff_range with snapshots needs a write txn")
+                txn.split_by_snapshot(snap)
+
+        def visible(item: Item, snap) -> bool:
+            if snap is None:
+                return not item.deleted
+            return item.id.clock < snap.state_vector.get(
+                item.id.client
+            ) and not snap.delete_set.contains(item.id)
+
         runs: List[Diff] = []
         attrs: Dict[str, PyAny] = {}
-        item = self.branch.start
         buf: List[str] = []
+        cur_kind: Optional[str] = None
+        cur_change: Optional[YChange] = None
 
         def flush():
             if buf:
-                runs.append(Diff("".join(buf), dict(attrs) if attrs else None))
+                runs.append(
+                    Diff("".join(buf), dict(attrs) if attrs else None, cur_change)
+                )
                 buf.clear()
 
+        item = self.branch.start
         while item is not None:
-            if not item.deleted:
+            vis_hi = visible(item, hi)
+            vis_lo = lo is not None and visible(item, lo)
+            if vis_hi or vis_lo:
                 content = item.content
                 if isinstance(content, ContentString):
+                    if not vis_hi:
+                        kind = YChange.REMOVED
+                    elif lo is not None and not vis_lo:
+                        kind = YChange.ADDED
+                    else:
+                        kind = None
+                    if kind != cur_kind:
+                        flush()
+                        cur_kind = kind
+                        cur_change = (
+                            compute_ychange(kind, item.id) if kind else None
+                        )
                     buf.append(content.text)
                 elif isinstance(content, ContentFormat):
-                    flush()
-                    if content.value is None:
-                        attrs.pop(content.key, None)
-                    else:
-                        attrs[content.key] = content.value
+                    if vis_hi:
+                        if attrs.get(content.key) != content.value:
+                            flush()
+                        if content.value is None:
+                            attrs.pop(content.key, None)
+                        else:
+                            attrs[content.key] = content.value
                 elif isinstance(content, (ContentEmbed, ContentType)):
                     flush()
                     from .shared import out_value
 
-                    runs.append(Diff(out_value(item), dict(attrs) if attrs else None))
+                    if not vis_hi:
+                        kind = YChange.REMOVED
+                    elif lo is not None and not vis_lo:
+                        kind = YChange.ADDED
+                    else:
+                        kind = None
+                    runs.append(
+                        Diff(
+                            out_value(item),
+                            dict(attrs) if attrs else None,
+                            compute_ychange(kind, item.id) if kind else None,
+                        )
+                    )
+                    cur_kind, cur_change = None, None
             item = item.right
         flush()
         return runs
@@ -178,11 +277,17 @@ class Text(SharedType):
         if pos is None:
             raise IndexError(index)
         current = dict(pos.current_attrs or {})
-        pending = {k: v for k, v in attrs.items() if current.get(k) != v}
-        for key, value in pending.items():
-            item = txn.create_item(pos, ContentFormat(key, value), None)
-            pos.left = item
-        # walk `length` visible units, dropping redundant marks
+        # open marks for attributes that differ at the cursor; `negated`
+        # remembers what to restore after the range
+        negated: Dict[str, PyAny] = {}
+        for key, value in attrs.items():
+            if current.get(key) != value:
+                negated[key] = current.get(key)
+                item = txn.create_item(pos, ContentFormat(key, value), None)
+                pos.left = item
+        # walk `length` visible units; old marks for formatted keys inside
+        # the range are deleted (they would override ours) and fold into
+        # `negated` so the close restores the right value
         remaining = length
         right = pos.left.right if pos.left is not None else pos.right
         store = txn.store
@@ -191,8 +296,11 @@ class Text(SharedType):
                 content = right.content
                 if isinstance(content, ContentFormat):
                     key = content.key
-                    if key in pending:
-                        # an old mark inside the range would override ours
+                    if key in attrs:
+                        if attrs[key] == content.value:
+                            negated.pop(key, None)
+                        else:
+                            negated[key] = content.value
                         txn.delete(right)
                 elif right.countable:
                     if remaining < right.len:
@@ -201,11 +309,10 @@ class Text(SharedType):
             pos.left = right
             right = right.right
         # close the range: restore previous values
-        for key, value in pending.items():
-            old = current.get(key)
+        for key, value in negated.items():
             item = txn.create_item(
                 ItemPosition(self.branch, pos.left, right, 0, None),
-                ContentFormat(key, old),
+                ContentFormat(key, value),
                 None,
             )
             pos.left = item
