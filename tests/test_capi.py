@@ -1,0 +1,67 @@
+"""Build + run the C ABI conformance suite (tests_ffi/main.cpp).
+
+Port model: the reference runs its C FFI tests as a separate doctest binary
+against the cbindgen header (/root/reference/.github/workflows/main.yml:79-111,
+tests-ffi/main.cpp). Here pytest builds libytpu_capi.so + the test binary
+with g++ and asserts a clean exit.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "ytpu", "native")
+TESTS_FFI = os.path.join(REPO, "tests_ffi")
+TEST_BIN = os.path.join(TESTS_FFI, "test_main")
+
+
+@pytest.fixture(scope="module")
+def capi_binary():
+    if shutil.which("g++") is None:
+        pytest.skip("g++ unavailable")
+    from ytpu.native import build_capi
+
+    lib = build_capi()
+    if lib is None:
+        pytest.skip("libytpu_capi.so build failed (no libpython?)")
+    src = os.path.join(TESTS_FFI, "main.cpp")
+    header = os.path.join(NATIVE, "include", "ytpu.h")
+    if not os.path.exists(TEST_BIN) or os.path.getmtime(TEST_BIN) < max(
+        os.path.getmtime(src), os.path.getmtime(lib), os.path.getmtime(header)
+    ):
+        subprocess.run(
+            [
+                "g++",
+                "-O1",
+                "-std=c++17",
+                src,
+                f"-I{os.path.join(NATIVE, 'include')}",
+                f"-L{NATIVE}",
+                "-lytpu_capi",
+                f"-Wl,-rpath,{NATIVE}",
+                "-o",
+                TEST_BIN,
+            ],
+            check=True,
+            capture_output=True,
+            timeout=180,
+        )
+    return TEST_BIN
+
+
+def test_capi_suite(capi_binary):
+    env = dict(os.environ)
+    # the embedded interpreter must not grab the TPU while pytest holds it
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [capi_binary], capture_output=True, text=True, timeout=300, env=env
+    )
+    assert proc.returncode == 0, (
+        f"C ABI suite failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "0 failures" in proc.stdout

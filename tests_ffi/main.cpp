@@ -1,0 +1,629 @@
+// C ABI conformance tests for libytpu.
+//
+// Port model: the reference's C FFI suite (/root/reference/tests-ffi/main.cpp,
+// 66 doctest cases incl. an exchange_updates helper :21-56). Uses a tiny
+// assert harness instead of doctest (not vendored in this environment).
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "ytpu.h"
+
+static int g_failures = 0;
+static int g_checks = 0;
+
+#define CHECK(cond)                                                        \
+  do {                                                                     \
+    ++g_checks;                                                            \
+    if (!(cond)) {                                                         \
+      ++g_failures;                                                        \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      const char *err = ytpu_last_error();                                 \
+      if (err) std::fprintf(stderr, "  last error: %s\n", err);            \
+    }                                                                      \
+  } while (0)
+
+#define CHECK_STR(actual_expr, expected)                                  \
+  do {                                                                    \
+    char *actual__ = (actual_expr);                                       \
+    CHECK(actual__ != nullptr && std::strcmp(actual__, (expected)) == 0); \
+    if (actual__ && std::strcmp(actual__, (expected)) != 0)               \
+      std::fprintf(stderr, "  actual: %s\n", actual__);                   \
+    ystring_destroy(actual__);                                            \
+  } while (0)
+
+// reference tests-ffi/main.cpp:21-56 — bidirectional state-vector exchange
+static void exchange_updates(YDoc *a, YDoc *b) {
+  for (int dir = 0; dir < 2; ++dir) {
+    YDoc *src = dir == 0 ? a : b;
+    YDoc *dst = dir == 0 ? b : a;
+    YTransaction *src_txn = ydoc_read_transaction(src);
+    YTransaction *dst_txn = ydoc_write_transaction(dst, 0, nullptr);
+    YBinary sv = ytransaction_state_vector_v1(dst_txn);
+    YBinary diff = ytransaction_state_diff_v1(src_txn, sv.data, (uint32_t)sv.len);
+    CHECK(ytransaction_apply(dst_txn, diff.data, (uint32_t)diff.len) == 0);
+    ybinary_destroy(sv);
+    ybinary_destroy(diff);
+    ytransaction_commit(src_txn);
+    ytransaction_commit(dst_txn);
+  }
+}
+
+static void test_doc_lifecycle() {
+  YOptions opts{};
+  opts.id = 42;
+  opts.guid = "doc-guid-1";
+  opts.collection_id = "coll";
+  opts.encoding = Y_OFFSET_UTF16;
+  opts.should_load = 1;
+  YDoc *doc = ydoc_new_with_options(opts);
+  CHECK(doc != nullptr);
+  CHECK(ydoc_id(doc) == 42);
+  CHECK_STR(ydoc_guid(doc), "doc-guid-1");
+  CHECK_STR(ydoc_collection_id(doc), "coll");
+  CHECK(ydoc_should_load(doc) == 1);
+  CHECK(ydoc_auto_load(doc) == 0);
+  ydoc_destroy(doc);
+
+  YDoc *rnd = ydoc_new();
+  CHECK(rnd != nullptr);
+  CHECK(ydoc_id(rnd) != 0);
+  char *guid = ydoc_guid(rnd);
+  CHECK(guid != nullptr && std::strlen(guid) > 0);
+  ystring_destroy(guid);
+  ydoc_destroy(rnd);
+}
+
+static void test_text_basic() {
+  YDoc *doc = ydoc_new();
+  Branch *txt = ytext(doc, "text");
+  CHECK(ytype_kind(txt) == Y_TEXT);
+
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  CHECK(ytransaction_writeable(txn) == 1);
+  ytext_insert(txt, txn, 0, "hello!", nullptr);
+  ytext_insert(txt, txn, 5, " world", nullptr);
+  ytransaction_commit(txn);
+
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  CHECK_STR(ytext_string(txt, txn), "hello world!");
+  CHECK(ytext_len(txt, txn) == 12);
+  ytext_remove_range(txt, txn, 5, 6);
+  CHECK_STR(ytext_string(txt, txn), "hello!");
+  ytransaction_commit(txn);
+
+  ybranch_destroy(txt);
+  ydoc_destroy(doc);
+}
+
+static void test_text_exchange() {
+  YDoc *a = ydoc_new();
+  YDoc *b = ydoc_new();
+  Branch *ta = ytext(a, "t");
+  Branch *tb = ytext(b, "t");
+
+  YTransaction *txn = ydoc_write_transaction(a, 0, nullptr);
+  ytext_insert(ta, txn, 0, "abc", nullptr);
+  ytransaction_commit(txn);
+  txn = ydoc_write_transaction(b, 0, nullptr);
+  ytext_insert(tb, txn, 0, "XYZ", nullptr);
+  ytransaction_commit(txn);
+
+  exchange_updates(a, b);
+
+  txn = ydoc_read_transaction(a);
+  char *sa = ytext_string(ta, txn);
+  ytransaction_commit(txn);
+  txn = ydoc_read_transaction(b);
+  char *sb = ytext_string(tb, txn);
+  ytransaction_commit(txn);
+  CHECK(sa != nullptr && sb != nullptr && std::strcmp(sa, sb) == 0);
+  CHECK(sa != nullptr && std::strlen(sa) == 6);
+  ystring_destroy(sa);
+  ystring_destroy(sb);
+
+  ybranch_destroy(ta);
+  ybranch_destroy(tb);
+  ydoc_destroy(a);
+  ydoc_destroy(b);
+}
+
+static void test_map() {
+  YDoc *doc = ydoc_new();
+  Branch *map = ymap(doc, "map");
+  CHECK(ytype_kind(map) == Y_MAP);
+
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  YInput num{};
+  num.tag = Y_JSON_NUM;
+  num.value.num = 3.5;
+  ymap_insert(map, txn, "pi", &num);
+  YInput str{};
+  str.tag = Y_JSON_STR;
+  str.value.str = "value";
+  ymap_insert(map, txn, "key", &str);
+  YInput arr{};
+  arr.tag = Y_JSON_ARR;
+  arr.value.str = "[1,2,3]";
+  ymap_insert(map, txn, "list", &arr);
+  ytransaction_commit(txn);
+
+  txn = ydoc_read_transaction(doc);
+  CHECK(ymap_len(map, txn) == 3);
+  YOutput *pi = ymap_get(map, txn, "pi");
+  CHECK(pi != nullptr && youtput_tag(pi) == Y_JSON_NUM);
+  CHECK(pi != nullptr && youtput_read_float(pi) == 3.5);
+  youtput_destroy(pi);
+  YOutput *val = ymap_get(map, txn, "key");
+  CHECK(val != nullptr && youtput_tag(val) == Y_JSON_STR);
+  CHECK_STR(youtput_read_string(val), "value");
+  youtput_destroy(val);
+  YOutput *lst = ymap_get(map, txn, "list");
+  CHECK(lst != nullptr && youtput_tag(lst) == Y_JSON_ARR);
+  CHECK_STR(youtput_json(lst), "[1, 2, 3]");
+  youtput_destroy(lst);
+  CHECK(ymap_get(map, txn, "missing") == nullptr);
+  ytransaction_commit(txn);
+
+  // iterate
+  txn = ydoc_read_transaction(doc);
+  YMapIter *iter = ymap_iter(map, txn);
+  int seen = 0;
+  while (YMapEntry *entry = ymap_iter_next(iter)) {
+    CHECK(entry->key != nullptr && entry->value != nullptr);
+    ++seen;
+    ymap_entry_destroy(entry);
+  }
+  CHECK(seen == 3);
+  ymap_iter_destroy(iter);
+  ytransaction_commit(txn);
+
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  CHECK(ymap_remove(map, txn, "pi") == 1);
+  CHECK(ymap_remove(map, txn, "pi") == 0);
+  CHECK(ymap_len(map, txn) == 2);
+  ymap_remove_all(map, txn);
+  CHECK(ymap_len(map, txn) == 0);
+  ytransaction_commit(txn);
+
+  ybranch_destroy(map);
+  ydoc_destroy(doc);
+}
+
+static void test_array() {
+  YDoc *doc = ydoc_new();
+  Branch *arr = yarray(doc, "array");
+  CHECK(ytype_kind(arr) == Y_ARRAY);
+
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  YInput items[3];
+  items[0].tag = Y_JSON_INT;
+  items[0].value.integer = 10;
+  items[1].tag = Y_JSON_STR;
+  items[1].value.str = "mid";
+  items[2].tag = Y_JSON_BOOL;
+  items[2].value.flag = 1;
+  yarray_insert_range(arr, txn, 0, items, 3);
+  ytransaction_commit(txn);
+
+  txn = ydoc_read_transaction(doc);
+  CHECK(yarray_len(arr) == 3);
+  YOutput *v0 = yarray_get(arr, txn, 0);
+  CHECK(v0 != nullptr && youtput_read_long(v0) == 10);
+  youtput_destroy(v0);
+  YOutput *v1 = yarray_get(arr, txn, 1);
+  CHECK_STR(youtput_read_string(v1), "mid");
+  youtput_destroy(v1);
+  YOutput *v2 = yarray_get(arr, txn, 2);
+  CHECK(v2 != nullptr && youtput_tag(v2) == Y_JSON_BOOL);
+  CHECK(v2 != nullptr && youtput_read_bool(v2) == 1);
+  youtput_destroy(v2);
+
+  YArrayIter *iter = yarray_iter(arr, txn);
+  int n = 0;
+  while (YOutput *item = yarray_iter_next(iter)) {
+    ++n;
+    youtput_destroy(item);
+  }
+  CHECK(n == 3);
+  yarray_iter_destroy(iter);
+  ytransaction_commit(txn);
+
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  yarray_move(arr, txn, 0, 3);  // move the 10 to the end
+  ytransaction_commit(txn);
+  txn = ydoc_read_transaction(doc);
+  YOutput *last = yarray_get(arr, txn, 2);
+  CHECK(last != nullptr && youtput_read_long(last) == 10);
+  youtput_destroy(last);
+  ytransaction_commit(txn);
+
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  yarray_remove_range(arr, txn, 0, 2);
+  CHECK(yarray_len(arr) == 1);
+  ytransaction_commit(txn);
+
+  ybranch_destroy(arr);
+  ydoc_destroy(doc);
+}
+
+static void test_nested_types() {
+  YDoc *doc = ydoc_new();
+  Branch *map = ymap(doc, "root");
+
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  YInput nested_text{};
+  nested_text.tag = Y_TEXT;
+  nested_text.value.str = "inner";
+  ymap_insert(map, txn, "text", &nested_text);
+  YInput nested_arr{};
+  nested_arr.tag = Y_ARRAY;
+  nested_arr.value.str = "[1,2]";
+  ymap_insert(map, txn, "arr", &nested_arr);
+  ytransaction_commit(txn);
+
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  YOutput *out = ymap_get(map, txn, "text");
+  CHECK(out != nullptr && youtput_tag(out) == Y_TEXT);
+  Branch *inner = youtput_read_ytext(out);
+  CHECK(inner != nullptr);
+  ytext_insert(inner, txn, 5, "!", nullptr);
+  CHECK_STR(ytext_string(inner, txn), "inner!");
+  ybranch_destroy(inner);
+  youtput_destroy(out);
+
+  YOutput *arr_out = ymap_get(map, txn, "arr");
+  CHECK(arr_out != nullptr && youtput_tag(arr_out) == Y_ARRAY);
+  Branch *inner_arr = youtput_read_yarray(arr_out);
+  CHECK(inner_arr != nullptr && yarray_len(inner_arr) == 2);
+  ybranch_destroy(inner_arr);
+  youtput_destroy(arr_out);
+  ytransaction_commit(txn);
+
+  ybranch_destroy(map);
+  ydoc_destroy(doc);
+}
+
+static void test_xml() {
+  YDoc *doc = ydoc_new();
+  Branch *frag = yxmlfragment(doc, "xml");
+  CHECK(ytype_kind(frag) == Y_XML_FRAG);
+
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  Branch *div = yxmlelem_insert_elem(frag, txn, 0, "div");
+  CHECK(div != nullptr);
+  CHECK_STR(yxmlelem_tag(div), "div");
+  yxmlelem_insert_attr(div, txn, "class", "header");
+  CHECK_STR(yxmlelem_get_attr(div, txn, "class"), "header");
+  CHECK(yxmlelem_get_attr(div, txn, "id") == nullptr);
+
+  Branch *txt = yxmlelem_insert_text(div, txn, 0);
+  CHECK(txt != nullptr);
+  yxmltext_insert(txt, txn, 0, "hi", nullptr);
+  CHECK(yxmlelem_child_len(div, txn) == 1);
+  CHECK_STR(yxmlelem_string(div, txn), "<div class=\"header\">hi</div>");
+
+  Branch *p = yxmlelem_insert_elem(div, txn, 1, "p");
+  CHECK(p != nullptr);
+  CHECK(yxmlelem_child_len(div, txn) == 2);
+
+  // siblings from the text node
+  YOutput *sib = yxml_next_sibling(txt, txn);
+  CHECK(sib != nullptr && youtput_tag(sib) == Y_XML_ELEM);
+  youtput_destroy(sib);
+
+  // tree walker from the fragment: div, text, p
+  YXmlTreeWalker *walker = yxmlelem_tree_walker(frag, txn);
+  int visited = 0;
+  while (YOutput *node = yxmlelem_tree_walker_next(walker)) {
+    ++visited;
+    youtput_destroy(node);
+  }
+  CHECK(visited == 3);
+  yxmlelem_tree_walker_destroy(walker);
+
+  yxmlelem_remove_attr(div, txn, "class");
+  CHECK(yxmlelem_get_attr(div, txn, "class") == nullptr);
+  ytransaction_commit(txn);
+
+  ybranch_destroy(p);
+  ybranch_destroy(txt);
+  ybranch_destroy(div);
+  ybranch_destroy(frag);
+  ydoc_destroy(doc);
+}
+
+struct UpdateCollector {
+  std::vector<std::vector<uint8_t>> updates;
+};
+
+static void collect_update(void *state, uint32_t len, const uint8_t *bytes) {
+  auto *collector = (UpdateCollector *)state;
+  collector->updates.emplace_back(bytes, bytes + len);
+}
+
+static void test_observers() {
+  YDoc *a = ydoc_new();
+  YDoc *b = ydoc_new();
+  Branch *ta = ytext(a, "t");
+  Branch *tb = ytext(b, "t");
+
+  UpdateCollector collected;
+  YSubscription *sub = ydoc_observe_updates_v1(a, &collected, collect_update);
+  CHECK(sub != nullptr);
+
+  YTransaction *txn = ydoc_write_transaction(a, 0, nullptr);
+  ytext_insert(ta, txn, 0, "observed", nullptr);
+  ytransaction_commit(txn);
+  CHECK(collected.updates.size() == 1);
+
+  // live-replicate the captured update into b
+  txn = ydoc_write_transaction(b, 0, nullptr);
+  CHECK(ytransaction_apply(txn, collected.updates[0].data(),
+                           (uint32_t)collected.updates[0].size()) == 0);
+  CHECK_STR(ytext_string(tb, txn), "observed");
+  ytransaction_commit(txn);
+
+  yunobserve(sub);
+  txn = ydoc_write_transaction(a, 0, nullptr);
+  ytext_insert(ta, txn, 0, "x", nullptr);
+  ytransaction_commit(txn);
+  CHECK(collected.updates.size() == 1);  // no longer observing
+
+  ybranch_destroy(ta);
+  ybranch_destroy(tb);
+  ydoc_destroy(a);
+  ydoc_destroy(b);
+}
+
+static void test_undo() {
+  YDoc *doc = ydoc_new();
+  Branch *txt = ytext(doc, "t");
+  YUndoManagerOptions opts{0};
+  YUndoManager *mgr = yundo_manager(doc, &opts);
+  CHECK(mgr != nullptr);
+  yundo_manager_add_scope(mgr, txt);
+
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  ytext_insert(txt, txn, 0, "hello", nullptr);
+  ytransaction_commit(txn);
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  ytext_insert(txt, txn, 5, " world", nullptr);
+  ytransaction_commit(txn);
+
+  CHECK(yundo_manager_can_undo(mgr) == 1);
+  CHECK(yundo_manager_undo(mgr) == 1);
+  txn = ydoc_read_transaction(doc);
+  CHECK_STR(ytext_string(txt, txn), "hello");
+  ytransaction_commit(txn);
+
+  CHECK(yundo_manager_can_redo(mgr) == 1);
+  CHECK(yundo_manager_redo(mgr) == 1);
+  txn = ydoc_read_transaction(doc);
+  CHECK_STR(ytext_string(txt, txn), "hello world");
+  ytransaction_commit(txn);
+
+  yundo_manager_clear(mgr);
+  CHECK(yundo_manager_can_undo(mgr) == 0);
+
+  yundo_manager_destroy(mgr);
+  ybranch_destroy(txt);
+  ydoc_destroy(doc);
+}
+
+static void test_sticky_index() {
+  YDoc *doc = ydoc_new();
+  Branch *txt = ytext(doc, "t");
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  ytext_insert(txt, txn, 0, "hello world", nullptr);
+
+  YStickyIndex *pos = ysticky_index_from_index(txt, txn, 6, Y_ASSOC_AFTER);
+  CHECK(pos != nullptr);
+  CHECK(ysticky_index_assoc(pos) == Y_ASSOC_AFTER);
+
+  YBinary encoded = ysticky_index_encode(pos);
+  CHECK(encoded.data != nullptr && encoded.len > 0);
+  YStickyIndex *decoded =
+      ysticky_index_decode(encoded.data, (uint32_t)encoded.len);
+  CHECK(decoded != nullptr);
+  ybinary_destroy(encoded);
+
+  // concurrent insert before the tracked position shifts the index
+  ytext_insert(txt, txn, 0, ">> ", nullptr);
+  uint32_t index = 0;
+  CHECK(ysticky_index_read(pos, txn, &index) == 1);
+  CHECK(index == 9);
+  CHECK(ysticky_index_read(decoded, txn, &index) == 1);
+  CHECK(index == 9);
+  ytransaction_commit(txn);
+
+  ysticky_index_destroy(pos);
+  ysticky_index_destroy(decoded);
+  ybranch_destroy(txt);
+  ydoc_destroy(doc);
+}
+
+static void test_snapshot() {
+  YOptions opts{};
+  opts.skip_gc = 1;  // snapshots need skip_gc (reference lib.rs:410-417)
+  opts.should_load = 1;
+  opts.encoding = Y_OFFSET_UTF16;
+  YDoc *doc = ydoc_new_with_options(opts);
+  Branch *txt = ytext(doc, "t");
+
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  ytext_insert(txt, txn, 0, "state one", nullptr);
+  ytransaction_commit(txn);
+
+  txn = ydoc_read_transaction(doc);
+  YBinary snapshot = ytransaction_snapshot(txn);
+  CHECK(snapshot.data != nullptr);
+  ytransaction_commit(txn);
+
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  ytext_insert(txt, txn, 9, " and two", nullptr);
+  ytransaction_commit(txn);
+
+  txn = ydoc_read_transaction(doc);
+  YBinary historic = ytransaction_encode_state_from_snapshot_v1(
+      txn, snapshot.data, (uint32_t)snapshot.len);
+  CHECK(historic.data != nullptr);
+  ytransaction_commit(txn);
+
+  YDoc *replica = ydoc_new();
+  Branch *rt = ytext(replica, "t");
+  txn = ydoc_write_transaction(replica, 0, nullptr);
+  CHECK(ytransaction_apply(txn, historic.data, (uint32_t)historic.len) == 0);
+  CHECK_STR(ytext_string(rt, txn), "state one");
+  ytransaction_commit(txn);
+
+  ybinary_destroy(snapshot);
+  ybinary_destroy(historic);
+  ybranch_destroy(rt);
+  ybranch_destroy(txt);
+  ydoc_destroy(replica);
+  ydoc_destroy(doc);
+}
+
+static void test_v2_roundtrip() {
+  YDoc *a = ydoc_new();
+  Branch *ta = ytext(a, "t");
+  YTransaction *txn = ydoc_write_transaction(a, 0, nullptr);
+  ytext_insert(ta, txn, 0, "v2 payload", nullptr);
+  ytransaction_commit(txn);
+
+  txn = ydoc_read_transaction(a);
+  YBinary diff = ytransaction_state_diff_v2(txn, nullptr, 0);
+  CHECK(diff.data != nullptr);
+  ytransaction_commit(txn);
+
+  YDoc *b = ydoc_new();
+  Branch *tb = ytext(b, "t");
+  txn = ydoc_write_transaction(b, 0, nullptr);
+  CHECK(ytransaction_apply_v2(txn, diff.data, (uint32_t)diff.len) == 0);
+  CHECK_STR(ytext_string(tb, txn), "v2 payload");
+  ytransaction_commit(txn);
+
+  char *debug = yupdate_debug_v2(diff.data, (uint32_t)diff.len);
+  CHECK(debug != nullptr);
+  ystring_destroy(debug);
+
+  ybinary_destroy(diff);
+  ybranch_destroy(ta);
+  ybranch_destroy(tb);
+  ydoc_destroy(a);
+  ydoc_destroy(b);
+}
+
+static void test_text_formatting() {
+  YDoc *doc = ydoc_new();
+  Branch *txt = ytext(doc, "t");
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  ytext_insert(txt, txn, 0, "bold move", nullptr);
+  ytext_format(txt, txn, 0, 4, "{\"bold\":true}");
+  // formatting marks are invisible in the plain string
+  CHECK_STR(ytext_string(txt, txn), "bold move");
+  CHECK(ytext_len(txt, txn) == 9);
+  ytext_insert(txt, txn, 9, "!", "{\"italic\":true}");
+  CHECK_STR(ytext_string(txt, txn), "bold move!");
+  ytransaction_commit(txn);
+  ybranch_destroy(txt);
+  ydoc_destroy(doc);
+}
+
+static void test_clone_and_errors() {
+  YDoc *doc = ydoc_new();
+  Branch *txt = ytext(doc, "t");
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  ytext_insert(txt, txn, 0, "cloned", nullptr);
+  ytransaction_commit(txn);
+
+  // yffi contract: the clone is a second handle onto the SAME instance
+  YDoc *copy = ydoc_clone(doc);
+  CHECK(copy != nullptr);
+  CHECK(ydoc_id(copy) == ydoc_id(doc));
+  Branch *ct = ytext(copy, "t");
+  txn = ydoc_read_transaction(copy);
+  CHECK_STR(ytext_string(ct, txn), "cloned");
+  ytransaction_commit(txn);
+  txn = ydoc_write_transaction(copy, 0, nullptr);
+  ytext_insert(ct, txn, 6, "!", nullptr);
+  ytransaction_commit(txn);
+  txn = ydoc_read_transaction(doc);
+  CHECK_STR(ytext_string(txt, txn), "cloned!");  // visible via the original
+  ytransaction_commit(txn);
+
+  // malformed update must fail cleanly, not crash
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  uint8_t garbage[] = {0xff, 0xff, 0xff, 0x01};
+  CHECK(ytransaction_apply(txn, garbage, sizeof(garbage)) != 0);
+  CHECK(ytpu_last_error() != nullptr);
+  ytransaction_commit(txn);
+
+  ybranch_destroy(ct);
+  ybranch_destroy(txt);
+  ydoc_destroy(copy);
+  ydoc_destroy(doc);
+}
+
+static void test_read_transactions() {
+  YDoc *doc = ydoc_new();
+  Branch *txt = ytext(doc, "t");
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  ytext_insert(txt, txn, 0, "shared", nullptr);
+  ytransaction_commit(txn);
+
+  // many read transactions may coexist on one doc
+  YTransaction *r1 = ydoc_read_transaction(doc);
+  YTransaction *r2 = ydoc_read_transaction(doc);
+  CHECK(r1 != nullptr && r2 != nullptr);
+  CHECK(ytransaction_writeable(r1) == 0);
+  YBinary sv1 = ytransaction_state_vector_v1(r1);
+  YBinary sv2 = ytransaction_state_vector_v1(r2);
+  CHECK(sv1.len == sv2.len && sv1.data != nullptr);
+  ybinary_destroy(sv1);
+  ybinary_destroy(sv2);
+
+  // writes through a read transaction are rejected
+  YBinary diff = ytransaction_state_diff_v1(r1, nullptr, 0);
+  CHECK(ytransaction_apply(r2, diff.data, (uint32_t)diff.len) != 0);
+  CHECK(ytpu_last_error() != nullptr);
+  ybinary_destroy(diff);
+  ytransaction_commit(r1);
+  ytransaction_commit(r2);
+
+  // the error slot describes only the most recent call: a legitimate
+  // "missing" NULL after a failure must not look like an error
+  Branch *map = ymap(doc, "m");
+  YTransaction *rt = ydoc_read_transaction(doc);
+  CHECK(ymap_get(map, rt, "absent") == nullptr);
+  CHECK(ytpu_last_error() == nullptr);
+  ytransaction_commit(rt);
+
+  ybranch_destroy(map);
+  ybranch_destroy(txt);
+  ydoc_destroy(doc);
+}
+
+int main() {
+  test_doc_lifecycle();
+  test_text_basic();
+  test_text_exchange();
+  test_map();
+  test_array();
+  test_nested_types();
+  test_xml();
+  test_observers();
+  test_undo();
+  test_sticky_index();
+  test_snapshot();
+  test_v2_roundtrip();
+  test_text_formatting();
+  test_clone_and_errors();
+  test_read_transactions();
+
+  std::printf("%d checks, %d failures\n", g_checks, g_failures);
+  return g_failures == 0 ? 0 : 1;
+}

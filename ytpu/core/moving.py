@@ -113,6 +113,44 @@ class StickyIndex:
             return None
         return branch, (branch.content_len if self.assoc >= 0 else 0)
 
+    def encode_v1(self) -> bytes:
+        """Wire form: IndexScope tag + payload, then assoc as a signed varint
+        (parity: moving.rs:610-614, IndexScope :672-691, Assoc :786-793)."""
+        w = Writer()
+        if self.id is not None:
+            w.write_var_uint(0)
+            w.write_var_uint(self.id.client)
+            w.write_var_uint(self.id.clock)
+        elif self.branch_id is not None:
+            w.write_var_uint(2)
+            w.write_var_uint(self.branch_id.client)
+            w.write_var_uint(self.branch_id.clock)
+        else:
+            w.write_var_uint(1)
+            w.write_string(self.name or "")
+        w.write_var_int(self.assoc)
+        return w.to_bytes()
+
+    @classmethod
+    def decode_v1(cls, data: bytes) -> "StickyIndex":
+        """Parity: moving.rs:617-623, :693-710, :795-801 (assoc optional for
+        pre-assoc payloads, defaulting to After)."""
+        cur = Cursor(data)
+        tag = cur.read_var_uint()
+        id_ = name = branch_id = None
+        if tag == 0:
+            id_ = ID(cur.read_var_uint(), cur.read_var_uint())
+        elif tag == 1:
+            name = cur.read_string()
+        elif tag == 2:
+            branch_id = ID(cur.read_var_uint(), cur.read_var_uint())
+        else:
+            raise ValueError(f"unknown sticky-index scope tag {tag}")
+        assoc = ASSOC_AFTER
+        if cur.has_content():
+            assoc = ASSOC_BEFORE if cur.read_var_int() < 0 else ASSOC_AFTER
+        return cls(id_=id_, name=name, branch_id=branch_id, assoc=assoc)
+
     def __eq__(self, other: object) -> bool:
         if not isinstance(other, StickyIndex):
             return NotImplemented

@@ -14,7 +14,13 @@ import subprocess
 import threading
 from typing import Optional
 
-__all__ = ["load", "available", "NativeColumns", "decode_update_columns"]
+__all__ = [
+    "load",
+    "available",
+    "NativeColumns",
+    "decode_update_columns",
+    "build_capi",
+]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "lib0_codec.cpp")
@@ -66,6 +72,58 @@ def _build() -> bool:
         return True
     except Exception:
         return False
+
+
+_CAPI_SRC = os.path.join(_HERE, "capi.cpp")
+_CAPI_LIB = os.path.join(_HERE, "libytpu_capi.so")
+
+
+def build_capi(force: bool = False) -> Optional[str]:
+    """Build the yffi-parity C ABI library (`libytpu_capi.so`).
+
+    Embeds CPython: links against the running interpreter's libpython so
+    arbitrary C programs can drive the engine (see include/ytpu.h).
+    Returns the library path, or None if the toolchain is unavailable.
+    """
+    import sysconfig
+
+    header = os.path.join(_HERE, "include", "ytpu.h")
+    support = os.path.join(_HERE, "support.py")
+    inputs = [p for p in (_CAPI_SRC, header, support) if os.path.exists(p)]
+    if (
+        not force
+        and os.path.exists(_CAPI_LIB)
+        and os.path.getmtime(_CAPI_LIB) >= max(os.path.getmtime(p) for p in inputs)
+    ):
+        return _CAPI_LIB
+    include = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    version = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION"
+    )
+    try:
+        subprocess.run(
+            [
+                "g++",
+                "-O2",
+                "-shared",
+                "-fPIC",
+                "-std=c++17",
+                _CAPI_SRC,
+                f"-I{include}",
+                f"-L{libdir}",
+                f"-lpython{version}",
+                f"-Wl,-rpath,{libdir}",
+                "-o",
+                _CAPI_LIB,
+            ],
+            check=True,
+            capture_output=True,
+            timeout=180,
+        )
+        return _CAPI_LIB
+    except Exception:
+        return None
 
 
 def load() -> Optional[ctypes.CDLL]:

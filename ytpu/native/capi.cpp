@@ -1,0 +1,1337 @@
+/* libytpu C ABI implementation.
+ *
+ * Native host-runtime layer: embeds CPython, drives the ytpu engine
+ * (JAX/XLA data plane + Python host semantics) through
+ * ytpu/native/support.py, and exposes the yffi-shaped C surface declared
+ * in include/ytpu.h (parity: /root/reference/yffi/src/lib.rs).
+ *
+ * Responsibilities handled here (not in Python):
+ *  - interpreter lifecycle + sys.path bootstrap (locates the repo relative
+ *    to this shared object via dladdr)
+ *  - GIL acquisition around every entry point (callable from any thread)
+ *  - handle management: every opaque pointer owns one Python reference
+ *  - YInput/YOutput conversion and malloc'd result buffers
+ *  - C function-pointer observer trampolines (PyCFunction over a capsule)
+ *  - thread-local error capture (ytpu_last_error)
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <dlfcn.h>
+
+#include <cstdarg>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+#include "include/ytpu.h"
+
+/* ---- opaque handle definitions ------------------------------------------ */
+struct YDoc {
+  PyObject *obj;
+};
+struct Branch {
+  PyObject *obj;
+};
+struct YTransaction {
+  PyObject *obj;
+  bool writeable;
+};
+struct YOutput {
+  PyObject *obj;
+};
+struct YUndoManager {
+  PyObject *obj;
+};
+struct YStickyIndex {
+  PyObject *obj;
+};
+struct YSubscription {
+  PyObject *unobserve;
+  PyObject *callback;
+};
+struct YArrayIter {
+  PyObject *iter;
+};
+struct YMapIter {
+  PyObject *iter;
+};
+struct YXmlTreeWalker {
+  PyObject *iter;
+};
+
+/* ---- interpreter bootstrap ---------------------------------------------- */
+static PyObject *g_support = nullptr; /* ytpu.native.support module */
+static std::once_flag g_init_once;
+static thread_local std::string g_last_error;
+
+static void set_err(const std::string &msg) { g_last_error = msg; }
+
+static void set_err_py() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  if (type) {
+    PyObject *n = PyObject_GetAttrString(type, "__name__");
+    if (n) {
+      const char *c = PyUnicode_AsUTF8(n);
+      if (c) msg = std::string(c) + ": " + msg;
+      Py_DECREF(n);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_err(msg);
+}
+
+static void bootstrap() {
+  bool started_here = !Py_IsInitialized();
+  if (started_here) {
+    Py_InitializeEx(0);
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  /* Make the repo importable: this .so lives at <root>/ytpu/native/. */
+  Dl_info info;
+  if (dladdr((void *)&bootstrap, &info) && info.dli_fname) {
+    std::string path(info.dli_fname);
+    for (int up = 0; up < 3; ++up) {
+      size_t slash = path.find_last_of('/');
+      if (slash == std::string::npos) break;
+      path.resize(slash);
+    }
+    PyObject *sys_path = PySys_GetObject("path"); /* borrowed */
+    if (sys_path && !path.empty()) {
+      PyObject *dir = PyUnicode_FromString(path.c_str());
+      if (dir) {
+        PyList_Insert(sys_path, 0, dir);
+        Py_DECREF(dir);
+      }
+    }
+  }
+  g_support = PyImport_ImportModule("ytpu.native.support");
+  if (!g_support) {
+    set_err_py();
+  }
+  PyGILState_Release(st);
+  if (started_here) {
+    /* Release the GIL acquired by Py_Initialize so any thread can enter. */
+    PyEval_SaveThread();
+  }
+}
+
+static bool ensure_init() {
+  std::call_once(g_init_once, bootstrap);
+  return g_support != nullptr;
+}
+
+/* RAII GIL guard; every extern "C" entry point opens one. The last-error
+ * slot always describes the most recent entry point, so a NULL/0 result
+ * from a call that left no message is a legitimate "absent" answer. */
+struct Gil {
+  PyGILState_STATE st;
+  bool ok;
+  Gil() : ok(ensure_init()) {
+    g_last_error.clear();
+    if (ok) st = PyGILState_Ensure();
+  }
+  ~Gil() {
+    if (ok) PyGILState_Release(st);
+  }
+};
+
+/* Call `target.<name>(args…)`; returns a new reference or NULL with the
+ * error captured. */
+static PyObject *vcall(PyObject *target, const char *name, const char *fmt,
+                       va_list args) {
+  PyObject *fn = PyObject_GetAttrString(target, name);
+  if (!fn) {
+    set_err_py();
+    return nullptr;
+  }
+  PyObject *tuple = fmt ? Py_VaBuildValue(fmt, args) : PyTuple_New(0);
+  if (!tuple) {
+    set_err_py();
+    Py_DECREF(fn);
+    return nullptr;
+  }
+  if (!PyTuple_Check(tuple)) {
+    PyObject *wrapped = PyTuple_Pack(1, tuple);
+    Py_DECREF(tuple);
+    tuple = wrapped;
+  }
+  PyObject *res = PyObject_CallObject(fn, tuple);
+  Py_DECREF(fn);
+  Py_DECREF(tuple);
+  if (!res) set_err_py();
+  return res;
+}
+
+/* Call a function in ytpu.native.support. */
+static PyObject *support_call(const char *name, const char *fmt, ...) {
+  va_list args;
+  va_start(args, fmt);
+  PyObject *res = vcall(g_support, name, fmt, args);
+  va_end(args);
+  return res;
+}
+
+/* Call a method on an engine object. */
+static PyObject *method_call(PyObject *obj, const char *name, const char *fmt,
+                             ...) {
+  va_list args;
+  va_start(args, fmt);
+  PyObject *res = vcall(obj, name, fmt, args);
+  va_end(args);
+  return res;
+}
+
+/* ---- conversions --------------------------------------------------------- */
+static char *dup_str(const char *s) {
+  if (!s) return nullptr;
+  size_t n = strlen(s) + 1;
+  char *out = (char *)malloc(n);
+  if (out) memcpy(out, s, n);
+  return out;
+}
+
+static char *py_to_cstr(PyObject *obj) { /* consumes obj */
+  if (!obj) return nullptr;
+  char *out = nullptr;
+  if (obj != Py_None) {
+    const char *c = PyUnicode_AsUTF8(obj);
+    if (c) out = dup_str(c);
+  }
+  Py_DECREF(obj);
+  return out;
+}
+
+static YBinary py_to_binary(PyObject *obj) { /* consumes obj */
+  YBinary bin{nullptr, 0};
+  if (!obj) return bin;
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(obj, &buf, &len) == 0) {
+    bin.data = (uint8_t *)malloc(len > 0 ? (size_t)len : 1);
+    if (bin.data) {
+      memcpy(bin.data, buf, (size_t)len);
+      bin.len = (uint64_t)len;
+    }
+  } else {
+    set_err_py();
+  }
+  Py_DECREF(obj);
+  return bin;
+}
+
+/* (tag, payload) pair for support.input_to_value. Returns new ref payload. */
+static PyObject *input_payload(const YInput *input) {
+  if (!input) Py_RETURN_NONE;
+  switch (input->tag) {
+    case Y_JSON_BOOL:
+      return PyBool_FromLong(input->value.flag);
+    case Y_JSON_NUM:
+      return PyFloat_FromDouble(input->value.num);
+    case Y_JSON_INT:
+      return PyLong_FromLongLong(input->value.integer);
+    case Y_JSON_STR:
+    case Y_JSON_ARR:
+    case Y_JSON_MAP:
+    case Y_TEXT:
+    case Y_XML_TEXT:
+    case Y_XML_ELEM:
+    case Y_ARRAY:
+    case Y_MAP:
+      if (input->value.str) return PyUnicode_FromString(input->value.str);
+      Py_RETURN_NONE;
+    case Y_JSON_BUF:
+      return PyBytes_FromStringAndSize((const char *)input->value.buf.data,
+                                       (Py_ssize_t)input->value.buf.len);
+    default:
+      Py_RETURN_NONE;
+  }
+}
+
+static PyObject *input_to_value(const YInput *input) {
+  int tag = input ? input->tag : Y_JSON_NULL;
+  PyObject *payload = input_payload(input);
+  if (!payload) {
+    set_err_py();
+    return nullptr;
+  }
+  PyObject *res = support_call("input_to_value", "(iN)", tag, payload);
+  return res;
+}
+
+static YOutput *wrap_output(PyObject *obj) { /* takes ownership */
+  if (!obj) return nullptr;
+  if (obj == Py_None) {
+    Py_DECREF(obj);
+    return nullptr;
+  }
+  YOutput *out = new YOutput{obj};
+  return out;
+}
+
+static Branch *wrap_branch(PyObject *obj) { /* takes ownership */
+  if (!obj || obj == Py_None) {
+    Py_XDECREF(obj);
+    return nullptr;
+  }
+  return new Branch{obj};
+}
+
+/* ---- runtime / errors ---------------------------------------------------- */
+extern "C" const char *ytpu_last_error(void) {
+  return g_last_error.empty() ? nullptr : g_last_error.c_str();
+}
+
+extern "C" void ystring_destroy(char *str) { free(str); }
+
+extern "C" void ybinary_destroy(YBinary bin) { free(bin.data); }
+
+/* ---- document lifecycle -------------------------------------------------- */
+static YDoc *doc_from_options(const YOptions *o) {
+  Gil gil;
+  if (!gil.ok) return nullptr;
+  PyObject *obj = support_call(
+      "doc_new", "(KzziiiI)", (unsigned long long)(o ? o->id : 0),
+      o ? o->guid : nullptr, o ? o->collection_id : nullptr,
+      o ? (int)o->skip_gc : 0, o ? (int)o->auto_load : 0,
+      o ? (int)o->should_load : 1,
+      (o == nullptr || o->encoding == Y_OFFSET_UTF16) ? 1u : 0u);
+  if (!obj) return nullptr;
+  return new YDoc{obj};
+}
+
+extern "C" YDoc *ydoc_new(void) { return doc_from_options(nullptr); }
+
+extern "C" YDoc *ydoc_new_with_options(YOptions options) {
+  return doc_from_options(&options);
+}
+
+extern "C" YDoc *ydoc_clone(YDoc *doc) {
+  /* yffi contract (lib.rs:398-407): the clone is the SAME document
+   * instance — a second handle, not a replica. */
+  Gil gil;
+  if (!gil.ok || !doc) return nullptr;
+  Py_INCREF(doc->obj);
+  return new YDoc{doc->obj};
+}
+
+extern "C" void ydoc_destroy(YDoc *doc) {
+  if (!doc) return;
+  Gil gil;
+  if (gil.ok) Py_DECREF(doc->obj);
+  delete doc;
+}
+
+extern "C" uint64_t ydoc_id(YDoc *doc) {
+  Gil gil;
+  if (!gil.ok || !doc) return 0;
+  PyObject *v = PyObject_GetAttrString(doc->obj, "client_id");
+  if (!v) {
+    set_err_py();
+    return 0;
+  }
+  uint64_t id = PyLong_AsUnsignedLongLong(v);
+  Py_DECREF(v);
+  return id;
+}
+
+extern "C" char *ydoc_guid(YDoc *doc) {
+  Gil gil;
+  if (!gil.ok || !doc) return nullptr;
+  return py_to_cstr(PyObject_GetAttrString(doc->obj, "guid"));
+}
+
+extern "C" char *ydoc_collection_id(YDoc *doc) {
+  Gil gil;
+  if (!gil.ok || !doc) return nullptr;
+  PyObject *opts = PyObject_GetAttrString(doc->obj, "options");
+  if (!opts) return nullptr;
+  char *out = py_to_cstr(PyObject_GetAttrString(opts, "collection_id"));
+  Py_DECREF(opts);
+  return out;
+}
+
+static uint8_t doc_option_flag(YDoc *doc, const char *name) {
+  Gil gil;
+  if (!gil.ok || !doc) return 0;
+  PyObject *opts = PyObject_GetAttrString(doc->obj, "options");
+  if (!opts) return 0;
+  PyObject *v = PyObject_GetAttrString(opts, name);
+  Py_DECREF(opts);
+  if (!v) return 0;
+  uint8_t out = PyObject_IsTrue(v) == 1 ? 1 : 0;
+  Py_DECREF(v);
+  return out;
+}
+
+extern "C" uint8_t ydoc_should_load(YDoc *doc) {
+  return doc_option_flag(doc, "should_load");
+}
+
+extern "C" uint8_t ydoc_auto_load(YDoc *doc) {
+  return doc_option_flag(doc, "auto_load");
+}
+
+extern "C" void ydoc_load(YDoc *doc) {
+  Gil gil;
+  if (!gil.ok || !doc) return;
+  PyObject *r = method_call(doc->obj, "load", nullptr);
+  Py_XDECREF(r);
+}
+
+/* ---- transactions -------------------------------------------------------- */
+static YTransaction *txn_new(YDoc *doc, const char *origin,
+                             uint32_t origin_len, bool writeable) {
+  Gil gil;
+  if (!gil.ok || !doc) return nullptr;
+  PyObject *obj =
+      origin ? support_call("txn_new", "(Oy#i)", doc->obj, origin,
+                            (Py_ssize_t)origin_len, (int)writeable)
+             : support_call("txn_new", "(Ozi)", doc->obj, nullptr,
+                            (int)writeable);
+  if (!obj) return nullptr;
+  return new YTransaction{obj, writeable};
+}
+
+extern "C" YTransaction *ydoc_read_transaction(YDoc *doc) {
+  return txn_new(doc, nullptr, 0, false);
+}
+
+extern "C" YTransaction *ydoc_write_transaction(YDoc *doc,
+                                                uint32_t origin_len,
+                                                const char *origin) {
+  return txn_new(doc, origin, origin_len, true);
+}
+
+extern "C" void ytransaction_commit(YTransaction *txn) {
+  if (!txn) return;
+  Gil gil;
+  if (gil.ok) {
+    PyObject *r = support_call("txn_commit", "(O)", txn->obj);
+    Py_XDECREF(r);
+    Py_DECREF(txn->obj);
+  }
+  delete txn;
+}
+
+extern "C" uint8_t ytransaction_writeable(YTransaction *txn) {
+  return txn && txn->writeable ? 1 : 0;
+}
+
+extern "C" YBinary ytransaction_state_vector_v1(YTransaction *txn) {
+  Gil gil;
+  if (!gil.ok || !txn) return YBinary{nullptr, 0};
+  return py_to_binary(support_call("txn_state_vector_v1", "(O)", txn->obj));
+}
+
+static YBinary state_diff(YTransaction *txn, const uint8_t *sv,
+                          uint32_t sv_len, const char *fn) {
+  Gil gil;
+  if (!gil.ok || !txn) return YBinary{nullptr, 0};
+  PyObject *res = sv ? support_call(fn, "(Oy#)", txn->obj, (const char *)sv,
+                                    (Py_ssize_t)sv_len)
+                     : support_call(fn, "(Oz)", txn->obj, nullptr);
+  return py_to_binary(res);
+}
+
+extern "C" YBinary ytransaction_state_diff_v1(YTransaction *txn,
+                                              const uint8_t *sv,
+                                              uint32_t sv_len) {
+  return state_diff(txn, sv, sv_len, "txn_state_diff_v1");
+}
+
+extern "C" YBinary ytransaction_state_diff_v2(YTransaction *txn,
+                                              const uint8_t *sv,
+                                              uint32_t sv_len) {
+  return state_diff(txn, sv, sv_len, "txn_state_diff_v2");
+}
+
+static uint8_t txn_apply(YTransaction *txn, const uint8_t *diff,
+                         uint32_t diff_len, int v2) {
+  Gil gil;
+  if (!gil.ok || !txn || !diff) return 1;
+  PyObject *r = support_call("txn_apply", "(Oy#i)", txn->obj,
+                             (const char *)diff, (Py_ssize_t)diff_len, v2);
+  if (!r) return 2;
+  Py_DECREF(r);
+  return 0;
+}
+
+extern "C" uint8_t ytransaction_apply(YTransaction *txn, const uint8_t *diff,
+                                      uint32_t diff_len) {
+  return txn_apply(txn, diff, diff_len, 0);
+}
+
+extern "C" uint8_t ytransaction_apply_v2(YTransaction *txn,
+                                         const uint8_t *diff,
+                                         uint32_t diff_len) {
+  return txn_apply(txn, diff, diff_len, 1);
+}
+
+extern "C" YBinary ytransaction_snapshot(YTransaction *txn) {
+  Gil gil;
+  if (!gil.ok || !txn) return YBinary{nullptr, 0};
+  return py_to_binary(support_call("txn_snapshot", "(O)", txn->obj));
+}
+
+static YBinary encode_from_snapshot(YTransaction *txn, const uint8_t *snap,
+                                    uint32_t len, int v2) {
+  Gil gil;
+  if (!gil.ok || !txn || !snap) return YBinary{nullptr, 0};
+  return py_to_binary(support_call("txn_encode_from_snapshot", "(Oy#i)",
+                                   txn->obj, (const char *)snap,
+                                   (Py_ssize_t)len, v2));
+}
+
+extern "C" YBinary ytransaction_encode_state_from_snapshot_v1(
+    YTransaction *txn, const uint8_t *snapshot, uint32_t snapshot_len) {
+  return encode_from_snapshot(txn, snapshot, snapshot_len, 0);
+}
+
+extern "C" YBinary ytransaction_encode_state_from_snapshot_v2(
+    YTransaction *txn, const uint8_t *snapshot, uint32_t snapshot_len) {
+  return encode_from_snapshot(txn, snapshot, snapshot_len, 1);
+}
+
+static char *update_debug(const uint8_t *update, uint32_t len, int v2) {
+  Gil gil;
+  if (!gil.ok || !update) return nullptr;
+  return py_to_cstr(support_call("update_debug", "(y#i)",
+                                 (const char *)update, (Py_ssize_t)len, v2));
+}
+
+extern "C" char *yupdate_debug_v1(const uint8_t *update, uint32_t update_len) {
+  return update_debug(update, update_len, 0);
+}
+
+extern "C" char *yupdate_debug_v2(const uint8_t *update, uint32_t update_len) {
+  return update_debug(update, update_len, 1);
+}
+
+/* ---- root types ----------------------------------------------------------- */
+static Branch *root_type(YDoc *doc, int kind, const char *name) {
+  Gil gil;
+  if (!gil.ok || !doc || !name) return nullptr;
+  return wrap_branch(support_call("doc_root", "(Ois)", doc->obj, kind, name));
+}
+
+extern "C" Branch *ytext(YDoc *doc, const char *name) {
+  return root_type(doc, Y_TEXT, name);
+}
+extern "C" Branch *yarray(YDoc *doc, const char *name) {
+  return root_type(doc, Y_ARRAY, name);
+}
+extern "C" Branch *ymap(YDoc *doc, const char *name) {
+  return root_type(doc, Y_MAP, name);
+}
+extern "C" Branch *yxmlfragment(YDoc *doc, const char *name) {
+  return root_type(doc, Y_XML_FRAG, name);
+}
+extern "C" Branch *yxmltext(YDoc *doc, const char *name) {
+  return root_type(doc, Y_XML_TEXT, name);
+}
+
+extern "C" int8_t ytype_kind(Branch *branch) {
+  Gil gil;
+  if (!gil.ok || !branch) return Y_JSON_UNDEF;
+  PyObject *r = support_call("branch_kind", "(O)", branch->obj);
+  if (!r) return Y_JSON_UNDEF;
+  int8_t kind = (int8_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return kind;
+}
+
+extern "C" uint8_t ybranch_alive(Branch *branch) {
+  return branch && branch->obj ? 1 : 0;
+}
+
+extern "C" void ybranch_destroy(Branch *branch) {
+  if (!branch) return;
+  Gil gil;
+  if (gil.ok) Py_DECREF(branch->obj);
+  delete branch;
+}
+
+/* ---- YOutput --------------------------------------------------------------- */
+extern "C" int8_t youtput_tag(const YOutput *val) {
+  Gil gil;
+  if (!gil.ok || !val) return Y_JSON_UNDEF;
+  PyObject *r = support_call("output_tag", "(O)", val->obj);
+  if (!r) return Y_JSON_UNDEF;
+  int8_t tag = (int8_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return tag;
+}
+
+extern "C" char *youtput_read_string(const YOutput *val) {
+  Gil gil;
+  if (!gil.ok || !val || !PyUnicode_Check(val->obj)) return nullptr;
+  Py_INCREF(val->obj);
+  return py_to_cstr(val->obj);
+}
+
+extern "C" uint8_t youtput_read_bool(const YOutput *val) {
+  Gil gil;
+  if (!gil.ok || !val) return 0;
+  return PyObject_IsTrue(val->obj) == 1 ? 1 : 0;
+}
+
+extern "C" double youtput_read_float(const YOutput *val) {
+  Gil gil;
+  if (!gil.ok || !val) return 0.0;
+  double d = PyFloat_AsDouble(val->obj);
+  if (PyErr_Occurred()) {
+    set_err_py();
+    return 0.0;
+  }
+  return d;
+}
+
+extern "C" int64_t youtput_read_long(const YOutput *val) {
+  Gil gil;
+  if (!gil.ok || !val) return 0;
+  int64_t v = PyLong_AsLongLong(val->obj);
+  if (PyErr_Occurred()) {
+    set_err_py();
+    return 0;
+  }
+  return v;
+}
+
+extern "C" YBinary youtput_read_binary(const YOutput *val) {
+  Gil gil;
+  if (!gil.ok || !val || !PyBytes_Check(val->obj)) return YBinary{nullptr, 0};
+  Py_INCREF(val->obj);
+  return py_to_binary(val->obj);
+}
+
+extern "C" char *youtput_json(const YOutput *val) {
+  Gil gil;
+  if (!gil.ok || !val) return nullptr;
+  return py_to_cstr(support_call("output_json", "(O)", val->obj));
+}
+
+static Branch *output_branch(YOutput *val, int8_t expect) {
+  Gil gil;
+  if (!gil.ok || !val) return nullptr;
+  PyObject *r = support_call("output_tag", "(O)", val->obj);
+  if (!r) return nullptr;
+  int8_t tag = (int8_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  if (tag != expect) return nullptr;
+  Py_INCREF(val->obj);
+  return new Branch{val->obj};
+}
+
+extern "C" Branch *youtput_read_yarray(YOutput *val) {
+  return output_branch(val, Y_ARRAY);
+}
+extern "C" Branch *youtput_read_ymap(YOutput *val) {
+  return output_branch(val, Y_MAP);
+}
+extern "C" Branch *youtput_read_ytext(YOutput *val) {
+  return output_branch(val, Y_TEXT);
+}
+extern "C" Branch *youtput_read_yxmlelem(YOutput *val) {
+  return output_branch(val, Y_XML_ELEM);
+}
+extern "C" Branch *youtput_read_yxmltext(YOutput *val) {
+  return output_branch(val, Y_XML_TEXT);
+}
+
+extern "C" YDoc *youtput_read_ydoc(YOutput *val) {
+  Gil gil;
+  if (!gil.ok || !val) return nullptr;
+  PyObject *r = support_call("output_tag", "(O)", val->obj);
+  if (!r) return nullptr;
+  int8_t tag = (int8_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  if (tag != Y_DOC) return nullptr;
+  Py_INCREF(val->obj);
+  return new YDoc{val->obj};
+}
+
+extern "C" void youtput_destroy(YOutput *val) {
+  if (!val) return;
+  Gil gil;
+  if (gil.ok) Py_DECREF(val->obj);
+  delete val;
+}
+
+/* ---- YText ------------------------------------------------------------------ */
+extern "C" uint32_t ytext_len(Branch *txt, YTransaction *txn) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !txt) return 0;
+  PyObject *r = support_call("type_len", "(O)", txt->obj);
+  if (!r) return 0;
+  uint32_t n = (uint32_t)PyLong_AsUnsignedLong(r);
+  Py_DECREF(r);
+  return n;
+}
+
+extern "C" char *ytext_string(Branch *txt, YTransaction *txn) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !txt) return nullptr;
+  return py_to_cstr(method_call(txt->obj, "get_string", nullptr));
+}
+
+extern "C" void ytext_insert(Branch *txt, YTransaction *txn, uint32_t index,
+                             const char *value, const char *attrs_json) {
+  Gil gil;
+  if (!gil.ok || !txt || !txn || !value) return;
+  PyObject *r = support_call("text_insert", "(OOIsz)", txn->obj, txt->obj,
+                             (unsigned)index, value, attrs_json);
+  Py_XDECREF(r);
+}
+
+extern "C" void ytext_insert_embed(Branch *txt, YTransaction *txn,
+                                   uint32_t index, const YInput *content,
+                                   const char *attrs_json) {
+  Gil gil;
+  if (!gil.ok || !txt || !txn || !content) return;
+  /* embed payload rides as JSON (same simplification as YInput) */
+  PyObject *payload = input_payload(content);
+  if (!payload) return;
+  PyObject *json_str = nullptr;
+  if (content->tag == Y_JSON_ARR || content->tag == Y_JSON_MAP) {
+    json_str = payload;
+  } else {
+    PyObject *json_mod = PyImport_ImportModule("json");
+    if (json_mod) {
+      json_str = method_call(json_mod, "dumps", "(N)", payload);
+      Py_DECREF(json_mod);
+    } else {
+      Py_DECREF(payload);
+    }
+  }
+  if (!json_str) return;
+  PyObject *r = support_call("text_insert_embed", "(OOINz)", txn->obj,
+                             txt->obj, (unsigned)index, json_str, attrs_json);
+  Py_XDECREF(r);
+}
+
+extern "C" void ytext_format(Branch *txt, YTransaction *txn, uint32_t index,
+                             uint32_t len, const char *attrs_json) {
+  Gil gil;
+  if (!gil.ok || !txt || !txn || !attrs_json) return;
+  PyObject *r = support_call("text_format", "(OOIIs)", txn->obj, txt->obj,
+                             (unsigned)index, (unsigned)len, attrs_json);
+  Py_XDECREF(r);
+}
+
+extern "C" void ytext_remove_range(Branch *txt, YTransaction *txn,
+                                   uint32_t index, uint32_t len) {
+  Gil gil;
+  if (!gil.ok || !txt || !txn) return;
+  PyObject *r = method_call(txt->obj, "remove_range", "(OII)", txn->obj,
+                            (unsigned)index, (unsigned)len);
+  Py_XDECREF(r);
+}
+
+/* ---- YArray ----------------------------------------------------------------- */
+extern "C" uint32_t yarray_len(Branch *array) {
+  Gil gil;
+  if (!gil.ok || !array) return 0;
+  PyObject *r = support_call("type_len", "(O)", array->obj);
+  if (!r) return 0;
+  uint32_t n = (uint32_t)PyLong_AsUnsignedLong(r);
+  Py_DECREF(r);
+  return n;
+}
+
+extern "C" YOutput *yarray_get(Branch *array, YTransaction *txn,
+                               uint32_t index) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !array) return nullptr;
+  return wrap_output(method_call(array->obj, "get", "(I)", (unsigned)index));
+}
+
+extern "C" void yarray_insert_range(Branch *array, YTransaction *txn,
+                                    uint32_t index, const YInput *items,
+                                    uint32_t items_len) {
+  Gil gil;
+  if (!gil.ok || !array || !txn || (!items && items_len)) return;
+  PyObject *pairs = PyList_New((Py_ssize_t)items_len);
+  if (!pairs) return;
+  for (uint32_t i = 0; i < items_len; ++i) {
+    PyObject *payload = input_payload(&items[i]);
+    PyObject *pair = payload ? Py_BuildValue("(iN)", (int)items[i].tag, payload)
+                             : nullptr;
+    if (!pair) {
+      Py_DECREF(pairs);
+      set_err_py();
+      return;
+    }
+    PyList_SET_ITEM(pairs, (Py_ssize_t)i, pair);
+  }
+  PyObject *r = support_call("array_insert_range", "(OOIN)", txn->obj,
+                             array->obj, (unsigned)index, pairs);
+  Py_XDECREF(r);
+}
+
+extern "C" void yarray_remove_range(Branch *array, YTransaction *txn,
+                                    uint32_t index, uint32_t len) {
+  Gil gil;
+  if (!gil.ok || !array || !txn) return;
+  PyObject *r = method_call(array->obj, "remove_range", "(OII)", txn->obj,
+                            (unsigned)index, (unsigned)len);
+  Py_XDECREF(r);
+}
+
+extern "C" void yarray_move(Branch *array, YTransaction *txn, uint32_t source,
+                            uint32_t target) {
+  Gil gil;
+  if (!gil.ok || !array || !txn) return;
+  PyObject *r = method_call(array->obj, "move_to", "(OII)", txn->obj,
+                            (unsigned)source, (unsigned)target);
+  Py_XDECREF(r);
+}
+
+extern "C" YArrayIter *yarray_iter(Branch *array, YTransaction *txn) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !array) return nullptr;
+  PyObject *lst = method_call(array->obj, "to_list", nullptr);
+  if (!lst) return nullptr;
+  PyObject *it = PyObject_GetIter(lst);
+  Py_DECREF(lst);
+  if (!it) {
+    set_err_py();
+    return nullptr;
+  }
+  return new YArrayIter{it};
+}
+
+extern "C" YOutput *yarray_iter_next(YArrayIter *iter) {
+  Gil gil;
+  if (!gil.ok || !iter) return nullptr;
+  PyObject *v = PyIter_Next(iter->iter);
+  if (!v) {
+    if (PyErr_Occurred()) set_err_py();
+    return nullptr;
+  }
+  return wrap_output(v);
+}
+
+extern "C" void yarray_iter_destroy(YArrayIter *iter) {
+  if (!iter) return;
+  Gil gil;
+  if (gil.ok) Py_DECREF(iter->iter);
+  delete iter;
+}
+
+/* ---- YMap ------------------------------------------------------------------- */
+extern "C" uint32_t ymap_len(Branch *map, YTransaction *txn) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !map) return 0;
+  PyObject *r = support_call("type_len", "(O)", map->obj);
+  if (!r) return 0;
+  uint32_t n = (uint32_t)PyLong_AsUnsignedLong(r);
+  Py_DECREF(r);
+  return n;
+}
+
+extern "C" void ymap_insert(Branch *map, YTransaction *txn, const char *key,
+                            const YInput *value) {
+  Gil gil;
+  if (!gil.ok || !map || !txn || !key) return;
+  PyObject *v = input_to_value(value);
+  if (!v) return;
+  PyObject *r = method_call(map->obj, "insert", "(OsN)", txn->obj, key, v);
+  Py_XDECREF(r);
+}
+
+extern "C" uint8_t ymap_remove(Branch *map, YTransaction *txn,
+                               const char *key) {
+  Gil gil;
+  if (!gil.ok || !map || !txn || !key) return 0;
+  PyObject *r = method_call(map->obj, "remove", "(Os)", txn->obj, key);
+  if (!r) return 0;
+  uint8_t removed = PyObject_IsTrue(r) == 1 ? 1 : 0;
+  Py_DECREF(r);
+  return removed;
+}
+
+extern "C" YOutput *ymap_get(Branch *map, YTransaction *txn, const char *key) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !map || !key) return nullptr;
+  return wrap_output(method_call(map->obj, "get", "(s)", key));
+}
+
+extern "C" void ymap_remove_all(Branch *map, YTransaction *txn) {
+  Gil gil;
+  if (!gil.ok || !map || !txn) return;
+  PyObject *r = method_call(map->obj, "clear", "(O)", txn->obj);
+  Py_XDECREF(r);
+}
+
+extern "C" YMapIter *ymap_iter(Branch *map, YTransaction *txn) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !map) return nullptr;
+  PyObject *items = support_call("map_iter_items", "(O)", map->obj);
+  if (!items) return nullptr;
+  PyObject *it = PyObject_GetIter(items);
+  Py_DECREF(items);
+  if (!it) {
+    set_err_py();
+    return nullptr;
+  }
+  return new YMapIter{it};
+}
+
+extern "C" YMapEntry *ymap_iter_next(YMapIter *iter) {
+  Gil gil;
+  if (!gil.ok || !iter) return nullptr;
+  PyObject *pair = PyIter_Next(iter->iter);
+  if (!pair) {
+    if (PyErr_Occurred()) set_err_py();
+    return nullptr;
+  }
+  PyObject *key = PyTuple_GetItem(pair, 0);   /* borrowed */
+  PyObject *value = PyTuple_GetItem(pair, 1); /* borrowed */
+  if (!key || !value) {
+    Py_DECREF(pair);
+    set_err_py();
+    return nullptr;
+  }
+  const char *k = PyUnicode_AsUTF8(key);
+  YMapEntry *entry = new YMapEntry{dup_str(k ? k : ""), nullptr};
+  Py_INCREF(value);
+  entry->value = wrap_output(value);
+  Py_DECREF(pair);
+  return entry;
+}
+
+extern "C" void ymap_entry_destroy(YMapEntry *entry) {
+  if (!entry) return;
+  free(entry->key);
+  youtput_destroy(entry->value);
+  delete entry;
+}
+
+extern "C" void ymap_iter_destroy(YMapIter *iter) {
+  if (!iter) return;
+  Gil gil;
+  if (gil.ok) Py_DECREF(iter->iter);
+  delete iter;
+}
+
+/* ---- YXml ------------------------------------------------------------------- */
+extern "C" char *yxmlelem_tag(Branch *xml) {
+  Gil gil;
+  if (!gil.ok || !xml) return nullptr;
+  return py_to_cstr(PyObject_GetAttrString(xml->obj, "tag"));
+}
+
+extern "C" char *yxmlelem_string(Branch *xml, YTransaction *txn) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !xml) return nullptr;
+  return py_to_cstr(method_call(xml->obj, "get_string", nullptr));
+}
+
+extern "C" void yxmlelem_insert_attr(Branch *xml, YTransaction *txn,
+                                     const char *attr_name,
+                                     const char *attr_value) {
+  Gil gil;
+  if (!gil.ok || !xml || !txn || !attr_name || !attr_value) return;
+  PyObject *r = method_call(xml->obj, "insert_attribute", "(Oss)", txn->obj,
+                            attr_name, attr_value);
+  Py_XDECREF(r);
+}
+
+extern "C" void yxmlelem_remove_attr(Branch *xml, YTransaction *txn,
+                                     const char *attr_name) {
+  Gil gil;
+  if (!gil.ok || !xml || !txn || !attr_name) return;
+  PyObject *r =
+      method_call(xml->obj, "remove_attribute", "(Os)", txn->obj, attr_name);
+  Py_XDECREF(r);
+}
+
+extern "C" char *yxmlelem_get_attr(Branch *xml, YTransaction *txn,
+                                   const char *attr_name) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !xml || !attr_name) return nullptr;
+  return py_to_cstr(method_call(xml->obj, "get_attribute", "(s)", attr_name));
+}
+
+extern "C" uint32_t yxmlelem_child_len(Branch *xml, YTransaction *txn) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !xml) return 0;
+  Py_ssize_t n = PyObject_Length(xml->obj);
+  if (n < 0) {
+    set_err_py();
+    return 0;
+  }
+  return (uint32_t)n;
+}
+
+extern "C" Branch *yxmlelem_insert_elem(Branch *xml, YTransaction *txn,
+                                        uint32_t index, const char *name) {
+  Gil gil;
+  if (!gil.ok || !xml || !txn || !name) return nullptr;
+  return wrap_branch(support_call("xml_insert_elem", "(OOIs)", txn->obj,
+                                  xml->obj, (unsigned)index, name));
+}
+
+extern "C" Branch *yxmlelem_insert_text(Branch *xml, YTransaction *txn,
+                                        uint32_t index) {
+  Gil gil;
+  if (!gil.ok || !xml || !txn) return nullptr;
+  return wrap_branch(support_call("xml_insert_text", "(OOI)", txn->obj,
+                                  xml->obj, (unsigned)index));
+}
+
+extern "C" void yxmlelem_remove_range(Branch *xml, YTransaction *txn,
+                                      uint32_t index, uint32_t len) {
+  Gil gil;
+  if (!gil.ok || !xml || !txn) return;
+  PyObject *r = method_call(xml->obj, "remove_range", "(OII)", txn->obj,
+                            (unsigned)index, (unsigned)len);
+  Py_XDECREF(r);
+}
+
+extern "C" YOutput *yxmlelem_get(Branch *xml, YTransaction *txn,
+                                 uint32_t index) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !xml) return nullptr;
+  return wrap_output(method_call(xml->obj, "get", "(I)", (unsigned)index));
+}
+
+extern "C" YOutput *yxmlelem_first_child(Branch *xml) {
+  Gil gil;
+  if (!gil.ok || !xml) return nullptr;
+  return wrap_output(method_call(xml->obj, "first_child", nullptr));
+}
+
+extern "C" YOutput *yxml_next_sibling(Branch *xml, YTransaction *txn) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !xml) return nullptr;
+  return wrap_output(method_call(xml->obj, "next_sibling", nullptr));
+}
+
+extern "C" YOutput *yxml_prev_sibling(Branch *xml, YTransaction *txn) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !xml) return nullptr;
+  return wrap_output(method_call(xml->obj, "prev_sibling", nullptr));
+}
+
+extern "C" YXmlTreeWalker *yxmlelem_tree_walker(Branch *xml,
+                                                YTransaction *txn) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !xml) return nullptr;
+  PyObject *walker = method_call(xml->obj, "successors", nullptr);
+  if (!walker) return nullptr;
+  PyObject *it = PyObject_GetIter(walker);
+  Py_DECREF(walker);
+  if (!it) {
+    set_err_py();
+    return nullptr;
+  }
+  return new YXmlTreeWalker{it};
+}
+
+extern "C" YOutput *yxmlelem_tree_walker_next(YXmlTreeWalker *walker) {
+  Gil gil;
+  if (!gil.ok || !walker) return nullptr;
+  PyObject *v = PyIter_Next(walker->iter);
+  if (!v) {
+    if (PyErr_Occurred()) set_err_py();
+    return nullptr;
+  }
+  return wrap_output(v);
+}
+
+extern "C" void yxmlelem_tree_walker_destroy(YXmlTreeWalker *walker) {
+  if (!walker) return;
+  Gil gil;
+  if (gil.ok) Py_DECREF(walker->iter);
+  delete walker;
+}
+
+extern "C" uint32_t yxmltext_len(Branch *xml, YTransaction *txn) {
+  return ytext_len(xml, txn);
+}
+
+extern "C" char *yxmltext_string(Branch *xml, YTransaction *txn) {
+  return ytext_string(xml, txn);
+}
+
+extern "C" void yxmltext_insert(Branch *xml, YTransaction *txn, uint32_t index,
+                                const char *str, const char *attrs_json) {
+  ytext_insert(xml, txn, index, str, attrs_json);
+}
+
+extern "C" void yxmltext_remove_range(Branch *xml, YTransaction *txn,
+                                      uint32_t index, uint32_t len) {
+  ytext_remove_range(xml, txn, index, len);
+}
+
+extern "C" void yxmltext_format(Branch *xml, YTransaction *txn, uint32_t index,
+                                uint32_t len, const char *attrs_json) {
+  ytext_format(xml, txn, index, len, attrs_json);
+}
+
+extern "C" void yxmltext_insert_attr(Branch *xml, YTransaction *txn,
+                                     const char *attr_name,
+                                     const char *attr_value) {
+  yxmlelem_insert_attr(xml, txn, attr_name, attr_value);
+}
+
+extern "C" char *yxmltext_get_attr(Branch *xml, YTransaction *txn,
+                                   const char *attr_name) {
+  return yxmlelem_get_attr(xml, txn, attr_name);
+}
+
+/* ---- UndoManager ------------------------------------------------------------ */
+extern "C" YUndoManager *yundo_manager(YDoc *doc,
+                                       const YUndoManagerOptions *options) {
+  Gil gil;
+  if (!gil.ok || !doc) return nullptr;
+  int timeout = options ? options->capture_timeout_millis : 500;
+  PyObject *obj = support_call("undo_manager_new", "(Oi)", doc->obj, timeout);
+  if (!obj) return nullptr;
+  return new YUndoManager{obj};
+}
+
+extern "C" void yundo_manager_destroy(YUndoManager *mgr) {
+  if (!mgr) return;
+  Gil gil;
+  if (gil.ok) Py_DECREF(mgr->obj);
+  delete mgr;
+}
+
+extern "C" void yundo_manager_add_scope(YUndoManager *mgr, Branch *ytype) {
+  Gil gil;
+  if (!gil.ok || !mgr || !ytype) return;
+  PyObject *r = method_call(mgr->obj, "expand_scope", "(O)", ytype->obj);
+  Py_XDECREF(r);
+}
+
+static void undo_origin(YUndoManager *mgr, const char *origin, uint32_t len,
+                        const char *fn) {
+  Gil gil;
+  if (!gil.ok || !mgr || !origin) return;
+  PyObject *r =
+      method_call(mgr->obj, fn, "(y#)", origin, (Py_ssize_t)len);
+  Py_XDECREF(r);
+}
+
+extern "C" void yundo_manager_add_origin(YUndoManager *mgr,
+                                         uint32_t origin_len,
+                                         const char *origin) {
+  undo_origin(mgr, origin, origin_len, "include_origin");
+}
+
+extern "C" void yundo_manager_remove_origin(YUndoManager *mgr,
+                                            uint32_t origin_len,
+                                            const char *origin) {
+  undo_origin(mgr, origin, origin_len, "exclude_origin");
+}
+
+static uint8_t undo_flag(YUndoManager *mgr, const char *name) {
+  Gil gil;
+  if (!gil.ok || !mgr) return 0;
+  PyObject *r = method_call(mgr->obj, name, nullptr);
+  if (!r) return 0;
+  uint8_t out = PyObject_IsTrue(r) == 1 ? 1 : 0;
+  Py_DECREF(r);
+  return out;
+}
+
+extern "C" uint8_t yundo_manager_undo(YUndoManager *mgr) {
+  return undo_flag(mgr, "undo");
+}
+extern "C" uint8_t yundo_manager_redo(YUndoManager *mgr) {
+  return undo_flag(mgr, "redo");
+}
+extern "C" uint8_t yundo_manager_can_undo(YUndoManager *mgr) {
+  return undo_flag(mgr, "can_undo");
+}
+extern "C" uint8_t yundo_manager_can_redo(YUndoManager *mgr) {
+  return undo_flag(mgr, "can_redo");
+}
+extern "C" void yundo_manager_clear(YUndoManager *mgr) {
+  undo_flag(mgr, "clear");
+}
+extern "C" void yundo_manager_stop(YUndoManager *mgr) {
+  undo_flag(mgr, "reset");
+}
+
+/* ---- StickyIndex ------------------------------------------------------------ */
+extern "C" YStickyIndex *ysticky_index_from_index(Branch *ytype,
+                                                  YTransaction *txn,
+                                                  uint32_t index,
+                                                  int8_t assoc) {
+  Gil gil;
+  if (!gil.ok || !ytype || !txn) return nullptr;
+  PyObject *obj = support_call("sticky_from_index", "(OOIi)", txn->obj,
+                               ytype->obj, (unsigned)index, (int)assoc);
+  if (!obj) return nullptr;
+  return new YStickyIndex{obj};
+}
+
+extern "C" void ysticky_index_destroy(YStickyIndex *pos) {
+  if (!pos) return;
+  Gil gil;
+  if (gil.ok) Py_DECREF(pos->obj);
+  delete pos;
+}
+
+extern "C" int8_t ysticky_index_assoc(YStickyIndex *pos) {
+  Gil gil;
+  if (!gil.ok || !pos) return Y_ASSOC_AFTER;
+  PyObject *r = support_call("sticky_assoc", "(O)", pos->obj);
+  if (!r) return Y_ASSOC_AFTER;
+  int8_t assoc = (int8_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return assoc;
+}
+
+extern "C" YBinary ysticky_index_encode(YStickyIndex *pos) {
+  Gil gil;
+  if (!gil.ok || !pos) return YBinary{nullptr, 0};
+  return py_to_binary(support_call("sticky_encode", "(O)", pos->obj));
+}
+
+extern "C" YStickyIndex *ysticky_index_decode(const uint8_t *bin,
+                                              uint32_t len) {
+  Gil gil;
+  if (!gil.ok || !bin) return nullptr;
+  PyObject *obj = support_call("sticky_decode", "(y#)", (const char *)bin,
+                               (Py_ssize_t)len);
+  if (!obj) return nullptr;
+  return new YStickyIndex{obj};
+}
+
+extern "C" uint8_t ysticky_index_read(YStickyIndex *pos, YTransaction *txn,
+                                      uint32_t *out_index) {
+  Gil gil;
+  if (!gil.ok || !pos || !txn || !out_index) return 0;
+  PyObject *r = support_call("sticky_read", "(OO)", pos->obj, txn->obj);
+  if (!r) return 0;
+  if (r == Py_None) {
+    Py_DECREF(r);
+    return 0;
+  }
+  *out_index = (uint32_t)PyLong_AsUnsignedLong(r);
+  Py_DECREF(r);
+  return 1;
+}
+
+/* ---- observers -------------------------------------------------------------- */
+struct CallbackData {
+  void *state;
+  ytpu_observe_cb cb;
+};
+
+static void capsule_free(PyObject *capsule) {
+  CallbackData *cd =
+      (CallbackData *)PyCapsule_GetPointer(capsule, "ytpu.callback");
+  delete cd;
+}
+
+static PyObject *observer_trampoline(PyObject *self, PyObject *args) {
+  CallbackData *cd =
+      (CallbackData *)PyCapsule_GetPointer(self, "ytpu.callback");
+  if (!cd) return nullptr;
+  PyObject *payload = nullptr;
+  if (!PyArg_ParseTuple(args, "O", &payload)) return nullptr;
+  const uint8_t *data = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_Check(payload)) {
+    char *buf = nullptr;
+    PyBytes_AsStringAndSize(payload, &buf, &len);
+    data = (const uint8_t *)buf;
+  }
+  /* user C callback runs with the GIL held; it must not re-enter Python */
+  cd->cb(cd->state, (uint32_t)len, data);
+  Py_RETURN_NONE;
+}
+
+static PyMethodDef g_trampoline_def = {"_ytpu_observer", observer_trampoline,
+                                       METH_VARARGS, nullptr};
+
+static YSubscription *observe(YDoc *doc, int kind, void *state,
+                              ytpu_observe_cb cb) {
+  Gil gil;
+  if (!gil.ok || !doc || !cb) return nullptr;
+  CallbackData *cd = new CallbackData{state, cb};
+  PyObject *capsule = PyCapsule_New(cd, "ytpu.callback", capsule_free);
+  if (!capsule) {
+    delete cd;
+    set_err_py();
+    return nullptr;
+  }
+  PyObject *fn = PyCFunction_New(&g_trampoline_def, capsule);
+  Py_DECREF(capsule); /* fn owns it now */
+  if (!fn) {
+    set_err_py();
+    return nullptr;
+  }
+  PyObject *unobserve = support_call("observe", "(OiO)", doc->obj, kind, fn);
+  if (!unobserve) {
+    Py_DECREF(fn);
+    return nullptr;
+  }
+  return new YSubscription{unobserve, fn};
+}
+
+extern "C" YSubscription *ydoc_observe_updates_v1(YDoc *doc, void *state,
+                                                  ytpu_observe_cb cb) {
+  return observe(doc, 0, state, cb);
+}
+
+extern "C" YSubscription *ydoc_observe_updates_v2(YDoc *doc, void *state,
+                                                  ytpu_observe_cb cb) {
+  return observe(doc, 1, state, cb);
+}
+
+extern "C" YSubscription *ydoc_observe_after_transaction(YDoc *doc,
+                                                         void *state,
+                                                         ytpu_observe_cb cb) {
+  return observe(doc, 2, state, cb);
+}
+
+extern "C" void yunobserve(YSubscription *subscription) {
+  if (!subscription) return;
+  Gil gil;
+  if (gil.ok) {
+    PyObject *r = PyObject_CallObject(subscription->unobserve, nullptr);
+    if (!r) {
+      set_err_py();
+    } else {
+      Py_DECREF(r);
+    }
+    Py_DECREF(subscription->unobserve);
+    Py_DECREF(subscription->callback);
+  }
+  delete subscription;
+}

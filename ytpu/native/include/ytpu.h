@@ -1,0 +1,292 @@
+/* libytpu — C ABI for the ytpu CRDT framework.
+ *
+ * Function-shape parity target: the reference's C FFI layer
+ * (/root/reference/yffi/src/lib.rs, 192 extern "C" fns; generated header
+ * tests-ffi/include/libyrs.h). Same names and call shapes wherever the
+ * engine supports the feature, so the reference's tests-ffi doctest suite
+ * ports mechanically. Tag constants match yffi/src/lib.rs:32-100.
+ *
+ * Differences from libyrs.h (documented, deliberate):
+ *  - YInput is a flat tagged scalar; JSON arrays/maps and nested-type
+ *    initializers are passed as JSON strings instead of recursive YInput
+ *    arrays (value.str).
+ *  - YOutput is an opaque handle with youtput_* accessors instead of a
+ *    by-value tagged union.
+ *  - Binary results come back as YBinary {data,len} released with
+ *    ybinary_destroy; strings via ystring_destroy.
+ *  - On error, fallible functions return 0/NULL and ytpu_last_error()
+ *    carries a message (thread-local, describing the most recent call).
+ *  - Read transactions may coexist (any number per doc) but reject writes;
+ *    write transactions are exclusive, like the engine's.
+ */
+#ifndef YTPU_H
+#define YTPU_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- opaque handles ---------------------------------------------------- */
+typedef struct YDoc YDoc;
+typedef struct Branch Branch;
+typedef struct YTransaction YTransaction;
+typedef struct YOutput YOutput;
+typedef struct YUndoManager YUndoManager;
+typedef struct YStickyIndex YStickyIndex;
+typedef struct YSubscription YSubscription;
+typedef struct YArrayIter YArrayIter;
+typedef struct YMapIter YMapIter;
+typedef struct YXmlTreeWalker YXmlTreeWalker;
+
+/* ---- value tags (yffi/src/lib.rs:32-100) -------------------------------- */
+#define Y_JSON_BOOL (-8)
+#define Y_JSON_NUM (-7)
+#define Y_JSON_INT (-6)
+#define Y_JSON_STR (-5)
+#define Y_JSON_BUF (-4)
+#define Y_JSON_ARR (-3)
+#define Y_JSON_MAP (-2)
+#define Y_JSON_NULL (-1)
+#define Y_JSON_UNDEF 0
+#define Y_ARRAY 1
+#define Y_MAP 2
+#define Y_TEXT 3
+#define Y_XML_ELEM 4
+#define Y_XML_TEXT 5
+#define Y_XML_FRAG 6
+#define Y_DOC 7
+#define Y_WEAK_LINK 8
+
+#define Y_OFFSET_BYTES 0
+#define Y_OFFSET_UTF16 1
+
+#define Y_ASSOC_BEFORE (-1)
+#define Y_ASSOC_AFTER 0
+
+/* ---- plain data -------------------------------------------------------- */
+typedef struct YOptions {
+  uint64_t id;               /* 0 = random client id */
+  const char *guid;          /* NULL = random v4 uuid */
+  const char *collection_id; /* NULL = none */
+  uint8_t encoding;          /* Y_OFFSET_BYTES | Y_OFFSET_UTF16 */
+  uint8_t skip_gc;
+  uint8_t auto_load;
+  uint8_t should_load;
+} YOptions;
+
+typedef struct YBinary {
+  uint8_t *data; /* NULL on error */
+  uint64_t len;
+} YBinary;
+
+typedef struct YInput {
+  int8_t tag; /* Y_JSON_* scalar, or Y_TEXT/Y_ARRAY/Y_MAP/Y_XML_* prelim */
+  union {
+    uint8_t flag;    /* Y_JSON_BOOL */
+    double num;      /* Y_JSON_NUM */
+    int64_t integer; /* Y_JSON_INT */
+    const char *str; /* Y_JSON_STR; JSON for ARR/MAP; init for prelims */
+    struct {
+      const uint8_t *data;
+      uint64_t len;
+    } buf; /* Y_JSON_BUF */
+  } value;
+} YInput;
+
+typedef struct YMapEntry {
+  char *key;      /* released with the entry */
+  YOutput *value; /* released with the entry */
+} YMapEntry;
+
+/* ---- runtime / errors --------------------------------------------------- */
+/* Last error message for this thread, or NULL. Owned by the library. */
+const char *ytpu_last_error(void);
+void ystring_destroy(char *str);
+void ybinary_destroy(YBinary bin);
+
+/* ---- document lifecycle (yffi: ydoc_*) ---------------------------------- */
+YDoc *ydoc_new(void);
+YDoc *ydoc_new_with_options(YOptions options);
+YDoc *ydoc_clone(YDoc *doc);
+void ydoc_destroy(YDoc *doc);
+uint64_t ydoc_id(YDoc *doc);
+char *ydoc_guid(YDoc *doc);
+char *ydoc_collection_id(YDoc *doc); /* NULL if unset */
+uint8_t ydoc_should_load(YDoc *doc);
+uint8_t ydoc_auto_load(YDoc *doc);
+void ydoc_load(YDoc *doc);
+
+/* ---- transactions (yffi: ydoc_*_transaction / ytransaction_*) ----------- */
+YTransaction *ydoc_read_transaction(YDoc *doc);
+YTransaction *ydoc_write_transaction(YDoc *doc, uint32_t origin_len,
+                                     const char *origin);
+void ytransaction_commit(YTransaction *txn);
+uint8_t ytransaction_writeable(YTransaction *txn);
+
+YBinary ytransaction_state_vector_v1(YTransaction *txn);
+YBinary ytransaction_state_diff_v1(YTransaction *txn, const uint8_t *sv,
+                                   uint32_t sv_len);
+YBinary ytransaction_state_diff_v2(YTransaction *txn, const uint8_t *sv,
+                                   uint32_t sv_len);
+/* 0 on success, nonzero error code otherwise */
+uint8_t ytransaction_apply(YTransaction *txn, const uint8_t *diff,
+                           uint32_t diff_len);
+uint8_t ytransaction_apply_v2(YTransaction *txn, const uint8_t *diff,
+                              uint32_t diff_len);
+YBinary ytransaction_snapshot(YTransaction *txn);
+YBinary ytransaction_encode_state_from_snapshot_v1(YTransaction *txn,
+                                                   const uint8_t *snapshot,
+                                                   uint32_t snapshot_len);
+YBinary ytransaction_encode_state_from_snapshot_v2(YTransaction *txn,
+                                                   const uint8_t *snapshot,
+                                                   uint32_t snapshot_len);
+char *yupdate_debug_v1(const uint8_t *update, uint32_t update_len);
+char *yupdate_debug_v2(const uint8_t *update, uint32_t update_len);
+
+/* ---- root types --------------------------------------------------------- */
+Branch *ytext(YDoc *doc, const char *name);
+Branch *yarray(YDoc *doc, const char *name);
+Branch *ymap(YDoc *doc, const char *name);
+Branch *yxmlfragment(YDoc *doc, const char *name);
+Branch *yxmltext(YDoc *doc, const char *name);
+int8_t ytype_kind(Branch *branch);
+uint8_t ybranch_alive(Branch *branch);
+void ybranch_destroy(Branch *branch); /* releases the handle, not the type */
+
+/* ---- YOutput ------------------------------------------------------------ */
+int8_t youtput_tag(const YOutput *val);
+char *youtput_read_string(const YOutput *val); /* NULL if not a string */
+uint8_t youtput_read_bool(const YOutput *val);
+double youtput_read_float(const YOutput *val);
+int64_t youtput_read_long(const YOutput *val);
+YBinary youtput_read_binary(const YOutput *val);
+char *youtput_json(const YOutput *val); /* any value as JSON */
+Branch *youtput_read_yarray(YOutput *val);
+Branch *youtput_read_ymap(YOutput *val);
+Branch *youtput_read_ytext(YOutput *val);
+Branch *youtput_read_yxmlelem(YOutput *val);
+Branch *youtput_read_yxmltext(YOutput *val);
+YDoc *youtput_read_ydoc(YOutput *val);
+void youtput_destroy(YOutput *val);
+
+/* ---- YText (yffi: ytext_*) ---------------------------------------------- */
+uint32_t ytext_len(Branch *txt, YTransaction *txn);
+char *ytext_string(Branch *txt, YTransaction *txn);
+void ytext_insert(Branch *txt, YTransaction *txn, uint32_t index,
+                  const char *value, const char *attrs_json);
+void ytext_insert_embed(Branch *txt, YTransaction *txn, uint32_t index,
+                        const YInput *content, const char *attrs_json);
+void ytext_format(Branch *txt, YTransaction *txn, uint32_t index,
+                  uint32_t len, const char *attrs_json);
+void ytext_remove_range(Branch *txt, YTransaction *txn, uint32_t index,
+                        uint32_t len);
+
+/* ---- YArray (yffi: yarray_*) -------------------------------------------- */
+uint32_t yarray_len(Branch *array);
+YOutput *yarray_get(Branch *array, YTransaction *txn, uint32_t index);
+void yarray_insert_range(Branch *array, YTransaction *txn, uint32_t index,
+                         const YInput *items, uint32_t items_len);
+void yarray_remove_range(Branch *array, YTransaction *txn, uint32_t index,
+                         uint32_t len);
+void yarray_move(Branch *array, YTransaction *txn, uint32_t source,
+                 uint32_t target);
+YArrayIter *yarray_iter(Branch *array, YTransaction *txn);
+YOutput *yarray_iter_next(YArrayIter *iter); /* NULL at end */
+void yarray_iter_destroy(YArrayIter *iter);
+
+/* ---- YMap (yffi: ymap_*) ------------------------------------------------ */
+uint32_t ymap_len(Branch *map, YTransaction *txn);
+void ymap_insert(Branch *map, YTransaction *txn, const char *key,
+                 const YInput *value);
+uint8_t ymap_remove(Branch *map, YTransaction *txn, const char *key);
+YOutput *ymap_get(Branch *map, YTransaction *txn, const char *key);
+void ymap_remove_all(Branch *map, YTransaction *txn);
+YMapIter *ymap_iter(Branch *map, YTransaction *txn);
+YMapEntry *ymap_iter_next(YMapIter *iter); /* NULL at end */
+void ymap_entry_destroy(YMapEntry *entry);
+void ymap_iter_destroy(YMapIter *iter);
+
+/* ---- YXml (yffi: yxmlelem_* / yxmltext_* / yxml_*) ---------------------- */
+char *yxmlelem_tag(Branch *xml);
+char *yxmlelem_string(Branch *xml, YTransaction *txn);
+void yxmlelem_insert_attr(Branch *xml, YTransaction *txn,
+                          const char *attr_name, const char *attr_value);
+void yxmlelem_remove_attr(Branch *xml, YTransaction *txn,
+                          const char *attr_name);
+char *yxmlelem_get_attr(Branch *xml, YTransaction *txn,
+                        const char *attr_name); /* NULL if missing */
+uint32_t yxmlelem_child_len(Branch *xml, YTransaction *txn);
+Branch *yxmlelem_insert_elem(Branch *xml, YTransaction *txn, uint32_t index,
+                             const char *name);
+Branch *yxmlelem_insert_text(Branch *xml, YTransaction *txn, uint32_t index);
+void yxmlelem_remove_range(Branch *xml, YTransaction *txn, uint32_t index,
+                           uint32_t len);
+YOutput *yxmlelem_get(Branch *xml, YTransaction *txn, uint32_t index);
+YOutput *yxmlelem_first_child(Branch *xml);
+YOutput *yxml_next_sibling(Branch *xml, YTransaction *txn);
+YOutput *yxml_prev_sibling(Branch *xml, YTransaction *txn);
+YXmlTreeWalker *yxmlelem_tree_walker(Branch *xml, YTransaction *txn);
+YOutput *yxmlelem_tree_walker_next(YXmlTreeWalker *walker);
+void yxmlelem_tree_walker_destroy(YXmlTreeWalker *walker);
+
+uint32_t yxmltext_len(Branch *xml, YTransaction *txn);
+char *yxmltext_string(Branch *xml, YTransaction *txn);
+void yxmltext_insert(Branch *xml, YTransaction *txn, uint32_t index,
+                     const char *str, const char *attrs_json);
+void yxmltext_remove_range(Branch *xml, YTransaction *txn, uint32_t index,
+                           uint32_t len);
+void yxmltext_format(Branch *xml, YTransaction *txn, uint32_t index,
+                     uint32_t len, const char *attrs_json);
+void yxmltext_insert_attr(Branch *xml, YTransaction *txn,
+                          const char *attr_name, const char *attr_value);
+char *yxmltext_get_attr(Branch *xml, YTransaction *txn,
+                        const char *attr_name);
+
+/* ---- UndoManager (yffi: yundo_manager_*) -------------------------------- */
+typedef struct YUndoManagerOptions {
+  int32_t capture_timeout_millis;
+} YUndoManagerOptions;
+YUndoManager *yundo_manager(YDoc *doc, const YUndoManagerOptions *options);
+void yundo_manager_destroy(YUndoManager *mgr);
+void yundo_manager_add_scope(YUndoManager *mgr, Branch *ytype);
+void yundo_manager_add_origin(YUndoManager *mgr, uint32_t origin_len,
+                              const char *origin);
+void yundo_manager_remove_origin(YUndoManager *mgr, uint32_t origin_len,
+                                 const char *origin);
+uint8_t yundo_manager_undo(YUndoManager *mgr);
+uint8_t yundo_manager_redo(YUndoManager *mgr);
+uint8_t yundo_manager_can_undo(YUndoManager *mgr);
+uint8_t yundo_manager_can_redo(YUndoManager *mgr);
+void yundo_manager_clear(YUndoManager *mgr);
+void yundo_manager_stop(YUndoManager *mgr);
+
+/* ---- StickyIndex (yffi: ysticky_index_*) -------------------------------- */
+YStickyIndex *ysticky_index_from_index(Branch *ytype, YTransaction *txn,
+                                       uint32_t index, int8_t assoc);
+void ysticky_index_destroy(YStickyIndex *pos);
+int8_t ysticky_index_assoc(YStickyIndex *pos);
+YBinary ysticky_index_encode(YStickyIndex *pos);
+YStickyIndex *ysticky_index_decode(const uint8_t *bin, uint32_t len);
+/* writes the resolved index to *out_index; 0 if position vanished */
+uint8_t ysticky_index_read(YStickyIndex *pos, YTransaction *txn,
+                           uint32_t *out_index);
+
+/* ---- observers (yffi: ydoc_observe_*) ----------------------------------- */
+typedef void (*ytpu_observe_cb)(void *state, uint32_t len,
+                                const uint8_t *bytes);
+YSubscription *ydoc_observe_updates_v1(YDoc *doc, void *state,
+                                       ytpu_observe_cb cb);
+YSubscription *ydoc_observe_updates_v2(YDoc *doc, void *state,
+                                       ytpu_observe_cb cb);
+/* after-transaction: cb invoked with len=0 */
+YSubscription *ydoc_observe_after_transaction(YDoc *doc, void *state,
+                                              ytpu_observe_cb cb);
+void yunobserve(YSubscription *subscription);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+#endif /* YTPU_H */

@@ -1,0 +1,352 @@
+"""Glue functions for the C ABI (`ytpu/native/capi.cpp`).
+
+The native `libytpu` shared library embeds CPython and calls into this
+module; every function here takes/returns only types the C layer can
+convert cheaply (ints, bytes, str, tuples, opaque engine objects).
+
+Parity target: the reference's C FFI crate (/root/reference/yffi/src/lib.rs,
+192 `extern "C"` functions; header tests-ffi/include/libyrs.h). Tag
+constants mirror yffi/src/lib.rs:32-100 so ported FFI tests keep their
+switch statements.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from ytpu.core import Doc, ID, Snapshot, StateVector
+from ytpu.core.doc import OFFSET_BYTES, OFFSET_UTF16, Options
+from ytpu.core.update import Update
+from ytpu.core.moving import ASSOC_AFTER, ASSOC_BEFORE, StickyIndex
+from ytpu.encoding.lib0 import Cursor, Writer
+from ytpu.types.array import Array
+from ytpu.types.map import Map
+from ytpu.types.shared import (
+    ArrayPrelim,
+    MapPrelim,
+    SharedType,
+    TextPrelim,
+    XmlElementPrelim,
+    XmlTextPrelim,
+)
+from ytpu.types.text import Text
+from ytpu.types.xml import XmlElement, XmlFragment, XmlText
+from ytpu.undo import UndoManager, UndoOptions
+
+# --- yffi tag constants (yffi/src/lib.rs:32-100) ---------------------------
+Y_JSON_BOOL = -8
+Y_JSON_NUM = -7
+Y_JSON_INT = -6
+Y_JSON_STR = -5
+Y_JSON_BUF = -4
+Y_JSON_ARR = -3
+Y_JSON_MAP = -2
+Y_JSON_NULL = -1
+Y_JSON_UNDEF = 0
+Y_ARRAY = 1
+Y_MAP = 2
+Y_TEXT = 3
+Y_XML_ELEM = 4
+Y_XML_TEXT = 5
+Y_XML_FRAG = 6
+Y_DOC = 7
+Y_WEAK_LINK = 8
+
+
+# --- doc lifecycle ---------------------------------------------------------
+
+def doc_new(
+    client_id: int,
+    guid: Optional[str],
+    collection_id: Optional[str],
+    skip_gc: bool,
+    auto_load: bool,
+    should_load: bool,
+    offset_utf16: bool,
+) -> Doc:
+    opts = Options(
+        client_id=client_id if client_id != 0 else None,
+        guid=guid,
+        collection_id=collection_id,
+        skip_gc=skip_gc,
+        auto_load=auto_load,
+        should_load=should_load,
+        offset_kind=OFFSET_UTF16 if offset_utf16 else OFFSET_BYTES,
+    )
+    return Doc(options=opts)
+
+
+class ReadTxn:
+    """Read-only transaction shim (yffi: many ydoc_read_transaction handles
+    may coexist; writes through them are rejected). The engine's exclusive
+    `Transaction` is only taken for writes."""
+
+    __slots__ = ("doc",)
+
+    def __init__(self, doc: Doc):
+        self.doc = doc
+
+    def state_vector(self) -> StateVector:
+        return self.doc.state_vector()
+
+    def snapshot(self) -> Snapshot:
+        return self.doc.snapshot()
+
+    def encode_diff_v1(self, remote_sv: StateVector) -> bytes:
+        return self.doc.encode_state_as_update_v1(remote_sv)
+
+    def encode_diff_v2(self, remote_sv: StateVector) -> bytes:
+        return self.doc.encode_state_as_update_v2(remote_sv)
+
+    def apply_update(self, update) -> None:
+        raise RuntimeError("cannot apply an update through a read-only transaction")
+
+
+def doc_root(doc: Doc, kind: int, name: str) -> SharedType:
+    if kind == Y_TEXT:
+        return doc.get_text(name)
+    if kind == Y_ARRAY:
+        return doc.get_array(name)
+    if kind == Y_MAP:
+        return doc.get_map(name)
+    if kind == Y_XML_FRAG:
+        return doc.get_xml_fragment(name)
+    if kind == Y_XML_TEXT:
+        return doc.get_xml_text(name)
+    raise ValueError(f"unsupported root kind {kind}")
+
+
+def txn_new(doc: Doc, origin: Optional[bytes], writeable: bool):
+    if not writeable:
+        return ReadTxn(doc)
+    txn = doc.transact(origin=origin)
+    txn.__enter__()
+    return txn
+
+
+def txn_commit(txn) -> None:
+    if isinstance(txn, ReadTxn):
+        return
+    txn.__exit__(None, None, None)
+
+
+# --- sync / encoding -------------------------------------------------------
+
+def txn_state_vector_v1(txn) -> bytes:
+    return txn.state_vector().encode_v1()
+
+
+def txn_state_diff_v1(txn, sv: Optional[bytes]) -> bytes:
+    remote = StateVector.decode_v1(sv) if sv else StateVector()
+    return txn.encode_diff_v1(remote)
+
+
+def txn_state_diff_v2(txn, sv: Optional[bytes]) -> bytes:
+    remote = StateVector.decode_v1(sv) if sv else StateVector()
+    return txn.encode_diff_v2(remote)
+
+
+def txn_apply(txn, update: bytes, v2: bool) -> None:
+    txn.apply_update(Update.decode_v2(update) if v2 else Update.decode_v1(update))
+
+
+def txn_snapshot(txn) -> bytes:
+    return txn.snapshot().encode_v1()
+
+
+def txn_encode_from_snapshot(txn, snapshot: bytes, v2: bool) -> bytes:
+    snap = Snapshot.decode_v1(snapshot)
+    data = txn.doc.encode_state_from_snapshot(snap)
+    if v2:
+        return Update.decode_v1(data).encode_v2()
+    return data
+
+
+def update_debug(update: bytes, v2: bool) -> str:
+    u = Update.decode_v2(update) if v2 else Update.decode_v1(update)
+    return repr(u)
+
+
+# --- values (YInput / YOutput) ---------------------------------------------
+
+def input_to_value(tag: int, payload: Any) -> Any:
+    """Convert a (tag, scalar-payload) pair from the C layer to an engine value.
+
+    For Y_JSON_ARR/Y_JSON_MAP the payload is a JSON string (the C API's
+    simplification of yffi's recursive YInput arrays); for nested shared
+    types it is a JSON string used as the prelim's initial content.
+    """
+    if tag == Y_JSON_NULL:
+        return None
+    if tag == Y_JSON_UNDEF:
+        return None
+    if tag in (Y_JSON_BOOL, Y_JSON_NUM, Y_JSON_INT, Y_JSON_STR, Y_JSON_BUF):
+        return payload
+    if tag == Y_JSON_ARR:
+        return json.loads(payload)
+    if tag == Y_JSON_MAP:
+        return json.loads(payload)
+    if tag == Y_TEXT:
+        return TextPrelim(payload or "")
+    if tag == Y_XML_TEXT:
+        return XmlTextPrelim(payload or "")
+    if tag == Y_ARRAY:
+        return ArrayPrelim(json.loads(payload) if payload else [])
+    if tag == Y_MAP:
+        return MapPrelim(json.loads(payload) if payload else {})
+    if tag == Y_XML_ELEM:
+        return XmlElementPrelim(payload or "UNDEFINED")
+    raise ValueError(f"unsupported YInput tag {tag}")
+
+
+def output_tag(value: Any) -> int:
+    if value is None:
+        return Y_JSON_NULL
+    if isinstance(value, bool):
+        return Y_JSON_BOOL
+    if isinstance(value, int):
+        return Y_JSON_INT
+    if isinstance(value, float):
+        return Y_JSON_NUM
+    if isinstance(value, str):
+        return Y_JSON_STR
+    if isinstance(value, (bytes, bytearray)):
+        return Y_JSON_BUF
+    if isinstance(value, list):
+        return Y_JSON_ARR
+    if isinstance(value, dict):
+        return Y_JSON_MAP
+    if isinstance(value, XmlElement):
+        return Y_XML_ELEM
+    if isinstance(value, XmlText):
+        return Y_XML_TEXT
+    if isinstance(value, XmlFragment):
+        return Y_XML_FRAG
+    if isinstance(value, Text):
+        return Y_TEXT
+    if isinstance(value, Array):
+        return Y_ARRAY
+    if isinstance(value, Map):
+        return Y_MAP
+    if isinstance(value, Doc):
+        return Y_DOC
+    from ytpu.types.weak import WeakRef
+
+    if isinstance(value, WeakRef):
+        return Y_WEAK_LINK
+    return Y_JSON_UNDEF
+
+
+def output_json(value: Any) -> str:
+    if isinstance(value, SharedType):
+        return json.dumps(value.to_json())
+    if isinstance(value, (bytes, bytearray)):
+        return json.dumps(list(value))
+    return json.dumps(value)
+
+
+def branch_kind(branch: Any) -> int:
+    return output_tag(branch)
+
+
+# --- type operations -------------------------------------------------------
+
+def type_len(t) -> int:
+    if isinstance(t, Map):
+        return sum(1 for _ in t.keys())
+    return t.branch.content_len
+
+
+def xml_insert_elem(txn, xml, index: int, name: str):
+    xml.insert(txn, index, XmlElementPrelim(name))
+    return xml.get(index)
+
+
+def xml_insert_text(txn, xml, index: int):
+    xml.insert(txn, index, XmlTextPrelim(""))
+    return xml.get(index)
+
+
+def text_insert(txn, text, index: int, chunk: str, attrs: Optional[str]) -> None:
+    if attrs:
+        text.insert_with_attributes(txn, index, chunk, json.loads(attrs))
+    else:
+        text.insert(txn, index, chunk)
+
+
+def text_insert_embed(txn, text, index: int, content_json: str, attrs: Optional[str]) -> None:
+    text.insert_embed(txn, index, json.loads(content_json))
+    if attrs:
+        text.format(txn, index, 1, json.loads(attrs))
+
+
+def text_format(txn, text, index: int, length: int, attrs: str) -> None:
+    text.format(txn, index, length, json.loads(attrs))
+
+
+def array_insert_range(txn, arr, index: int, tags_payloads: list) -> None:
+    values = [input_to_value(t, p) for (t, p) in tags_payloads]
+    arr.insert_range(txn, index, values)
+
+
+def map_iter_items(m) -> list:
+    return list(m.items())
+
+
+def xml_attrs(x) -> list:
+    return [(k, v) for k, v in x.attributes()]
+
+
+def xml_kind_children(x) -> list:
+    return list(x.children())
+
+
+# --- sticky index -----------------------------------------------------------
+
+def sticky_from_index(txn, branch, index: int, assoc: int) -> StickyIndex:
+    return StickyIndex.from_type_index(
+        branch.branch if isinstance(branch, SharedType) else branch,
+        index,
+        ASSOC_AFTER if assoc >= 0 else ASSOC_BEFORE,
+    )
+
+
+def sticky_read(si: StickyIndex, txn):
+    """(index,) or None if the position is gone."""
+    out = si.get_offset(txn.doc.store)
+    if out is None:
+        return None
+    branch, index = out
+    return index
+
+
+def sticky_assoc(si: StickyIndex) -> int:
+    return 0 if si.assoc == ASSOC_AFTER else -1
+
+
+def sticky_encode(si: StickyIndex) -> bytes:
+    return si.encode_v1()
+
+
+def sticky_decode(data: bytes) -> StickyIndex:
+    return StickyIndex.decode_v1(data)
+
+
+# --- undo -------------------------------------------------------------------
+
+def undo_manager_new(doc: Doc, capture_timeout_ms: int) -> UndoManager:
+    return UndoManager(doc, [], UndoOptions(capture_timeout_ms=capture_timeout_ms))
+
+
+# --- observers --------------------------------------------------------------
+
+def observe(doc: Doc, kind: int, cb) -> Any:
+    """kind: 0=update_v1 1=update_v2 2=after_transaction. Returns unobserve."""
+    if kind == 0:
+        return doc.observe_update_v1(lambda payload, origin, txn: cb(payload))
+    if kind == 1:
+        return doc.observe_update_v2(lambda payload, origin, txn: cb(payload))
+    if kind == 2:
+        return doc.observe_after_transaction(lambda txn: cb(b""))
+    raise ValueError(f"unsupported observer kind {kind}")
