@@ -64,6 +64,7 @@ struct YXmlTreeWalker {
 /* ---- interpreter bootstrap ---------------------------------------------- */
 static PyObject *g_support = nullptr; /* ytpu.native.support module */
 static std::once_flag g_init_once;
+static std::string g_boot_error; /* sticky bootstrap failure, if any */
 static thread_local std::string g_last_error;
 
 static void set_err(const std::string &msg) { g_last_error = msg; }
@@ -122,6 +123,7 @@ static void bootstrap() {
   g_support = PyImport_ImportModule("ytpu.native.support");
   if (!g_support) {
     set_err_py();
+    g_boot_error = "ytpu bootstrap failed: " + g_last_error;
   }
   PyGILState_Release(st);
   if (started_here) {
@@ -141,9 +143,14 @@ static bool ensure_init() {
 struct Gil {
   PyGILState_STATE st;
   bool ok;
-  Gil() : ok(ensure_init()) {
+  Gil() {
     g_last_error.clear();
-    if (ok) st = PyGILState_Ensure();
+    ok = ensure_init();
+    if (ok) {
+      st = PyGILState_Ensure();
+    } else {
+      g_last_error = g_boot_error; /* init failures stay diagnosable */
+    }
   }
   ~Gil() {
     if (ok) PyGILState_Release(st);

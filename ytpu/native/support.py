@@ -128,7 +128,13 @@ def txn_new(doc: Doc, origin: Optional[bytes], writeable: bool):
 def txn_commit(txn) -> None:
     if isinstance(txn, ReadTxn):
         return
-    txn.__exit__(None, None, None)
+    try:
+        txn.__exit__(None, None, None)
+    finally:
+        # a commit-time exception (e.g. an observer raising) must not leave
+        # the doc's exclusive write slot held forever
+        if getattr(txn.doc, "_txn", None) is txn:
+            txn.doc._txn = None
 
 
 # --- sync / encoding -------------------------------------------------------

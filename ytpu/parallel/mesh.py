@@ -5,9 +5,9 @@ Parallelism mapping (SURVEY.md §2 table):
   (the reference analogue: N independent Docs; north-star 10k-doc batch).
 - tp — the client axis of dense state-vector tensors ([D, C]) for
   encode_diff_batch's per-client clock compares.
-- sp — the block axis inside one doc (sequence/context parallelism for hot
-  docs; round-1: layout declared, halo exchange lands with the sharded
-  sequence kernel).
+- sp — the sequence axis inside one hot doc (sequence/context parallelism):
+  `ytpu.parallel.seq_shard` — contiguous chunk partitioning, prefix-sum
+  index routing, ppermute halo exchange.
 
 All collectives ride ICI via XLA's sharding propagation — no hand-written
 NCCL-style calls (reference has none either; its y-sync protocol is the
