@@ -607,6 +607,676 @@ static void test_read_transactions() {
   ydoc_destroy(doc);
 }
 
+// --- typed event observers (reference tests-ffi main.cpp YText/YMap
+// observer cases) ------------------------------------------------------------
+struct TextEventCapture {
+  bool fired = false;
+  uint32_t delta_len = 0;
+  char tag0 = 0;
+  uint32_t len0 = 0;
+  std::string insert0;
+  std::string target_str;
+};
+
+static void on_text_event(void *state, const YTextEvent *e) {
+  TextEventCapture *cap = (TextEventCapture *)state;
+  cap->fired = true;
+  CHECK(yevent_kind(e) == Y_TEXT);
+  Branch *target = ytext_event_target(e);
+  CHECK(target != nullptr);
+  char *s = ytext_string(target, nullptr);
+  if (s) cap->target_str = s;
+  ystring_destroy(s);
+  ybranch_destroy(target);
+  YDelta *delta = ytext_event_delta(e, &cap->delta_len);
+  if (delta && cap->delta_len > 0) {
+    cap->tag0 = delta[0].tag;
+    cap->len0 = delta[0].len;
+    if (delta[0].insert) {
+      char *ins = youtput_read_string(delta[0].insert);
+      if (ins) cap->insert0 = ins;
+      ystring_destroy(ins);
+    }
+  }
+  ytext_delta_destroy(delta, cap->delta_len);
+}
+
+static void test_typed_text_observer() {
+  YDoc *doc = ydoc_new();
+  Branch *txt = ytext(doc, "t");
+  TextEventCapture cap;
+  YSubscription *sub = ytext_observe(txt, &cap, on_text_event);
+  CHECK(sub != nullptr);
+
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  ytext_insert(txt, txn, 0, "hello", nullptr);
+  ytransaction_commit(txn);
+
+  CHECK(cap.fired);
+  CHECK(cap.delta_len == 1);
+  CHECK(cap.tag0 == Y_EVENT_CHANGE_ADD);
+  CHECK(cap.len0 == 5);
+  CHECK(cap.insert0 == "hello");
+  CHECK(cap.target_str == "hello");
+
+  // delete from the middle → retain + delete segments
+  cap = TextEventCapture{};
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  ytext_remove_range(txt, txn, 1, 2);
+  ytransaction_commit(txn);
+  CHECK(cap.fired);
+  CHECK(cap.delta_len == 2);
+  CHECK(cap.tag0 == Y_EVENT_CHANGE_RETAIN);
+  CHECK(cap.len0 == 1);
+
+  yunobserve(sub);
+  cap = TextEventCapture{};
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  ytext_insert(txt, txn, 0, "x", nullptr);
+  ytransaction_commit(txn);
+  CHECK(!cap.fired);
+
+  ybranch_destroy(txt);
+  ydoc_destroy(doc);
+}
+
+struct MapEventCapture {
+  bool fired = false;
+  uint32_t keys_len = 0;
+  std::string key0;
+  char tag0 = 0;
+  std::string new0;
+};
+
+static void on_map_event(void *state, const YMapEvent *e) {
+  MapEventCapture *cap = (MapEventCapture *)state;
+  cap->fired = true;
+  CHECK(yevent_kind(e) == Y_MAP);
+  YEventKeyChange *keys = ymap_event_keys(e, &cap->keys_len);
+  if (keys && cap->keys_len > 0) {
+    cap->key0 = keys[0].key ? keys[0].key : "";
+    cap->tag0 = keys[0].tag;
+    if (keys[0].new_value) {
+      char *s = youtput_read_string(keys[0].new_value);
+      if (s) cap->new0 = s;
+      ystring_destroy(s);
+    }
+  }
+  yevent_keys_destroy(keys, cap->keys_len);
+}
+
+static void test_typed_map_observer() {
+  YDoc *doc = ydoc_new();
+  Branch *map = ymap(doc, "m");
+  MapEventCapture cap;
+  YSubscription *sub = ymap_observe(map, &cap, on_map_event);
+
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  YInput v = yinput_string("world");
+  ymap_insert(map, txn, "greeting", &v);
+  ytransaction_commit(txn);
+
+  CHECK(cap.fired);
+  CHECK(cap.keys_len == 1);
+  CHECK(cap.key0 == "greeting");
+  CHECK(cap.tag0 == Y_EVENT_KEY_CHANGE_ADD);
+  CHECK(cap.new0 == "world");
+
+  cap = MapEventCapture{};
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  CHECK(ymap_remove(map, txn, "greeting") == 1);
+  ytransaction_commit(txn);
+  CHECK(cap.fired);
+  CHECK(cap.tag0 == Y_EVENT_KEY_CHANGE_DELETE);
+
+  yunobserve(sub);
+  ybranch_destroy(map);
+  ydoc_destroy(doc);
+}
+
+struct ArrayEventCapture {
+  bool fired = false;
+  uint32_t delta_len = 0;
+  char tag0 = 0;
+  uint32_t len0 = 0;
+  int64_t first_value = 0;
+};
+
+static void on_array_event(void *state, const YArrayEvent *e) {
+  ArrayEventCapture *cap = (ArrayEventCapture *)state;
+  cap->fired = true;
+  YEventChange *delta = yarray_event_delta(e, &cap->delta_len);
+  if (delta && cap->delta_len > 0) {
+    cap->tag0 = delta[0].tag;
+    cap->len0 = delta[0].len;
+    if (delta[0].values && delta[0].len > 0 && delta[0].values[0]) {
+      cap->first_value = youtput_read_long(delta[0].values[0]);
+    }
+  }
+  yevent_delta_destroy(delta, cap->delta_len);
+}
+
+static void test_typed_array_observer() {
+  YDoc *doc = ydoc_new();
+  Branch *arr = yarray(doc, "a");
+  ArrayEventCapture cap;
+  YSubscription *sub = yarray_observe(arr, &cap, on_array_event);
+
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  YInput items[2] = {yinput_long(11), yinput_long(22)};
+  yarray_insert_range(arr, txn, 0, items, 2);
+  ytransaction_commit(txn);
+
+  CHECK(cap.fired);
+  CHECK(cap.delta_len == 1);
+  CHECK(cap.tag0 == Y_EVENT_CHANGE_ADD);
+  CHECK(cap.len0 == 2);
+  CHECK(cap.first_value == 11);
+
+  yunobserve(sub);
+  ybranch_destroy(arr);
+  ydoc_destroy(doc);
+}
+
+struct DeepCapture {
+  bool fired = false;
+  uint32_t count = 0;
+  int8_t kind0 = 0;
+  uint32_t path_len = 0;
+  std::string path_key0;
+};
+
+static void on_deep_event(void *state, uint32_t count,
+                          const YEvent *const *events) {
+  DeepCapture *cap = (DeepCapture *)state;
+  cap->fired = true;
+  cap->count = count;
+  if (count > 0) {
+    cap->kind0 = yevent_kind(events[0]);
+    YPathSegment *path = ytext_event_path(events[0], &cap->path_len);
+    if (path && cap->path_len > 0 && path[0].tag == Y_EVENT_PATH_KEY) {
+      cap->path_key0 = path[0].value.key;
+    }
+    ypath_destroy(path, cap->path_len);
+  }
+}
+
+static void test_deep_observer() {
+  YDoc *doc = ydoc_new();
+  Branch *map = ymap(doc, "root");
+
+  // nest a text under the map, then observe deep from the map
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  YInput nested = yinput_ytext("");
+  ymap_insert(map, txn, "inner", &nested);
+  ytransaction_commit(txn);
+
+  DeepCapture cap;
+  YSubscription *sub = yobserve_deep(map, &cap, on_deep_event);
+
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  YTransaction *rt = nullptr;
+  YOutput *out = ymap_get(map, nullptr, "inner");
+  CHECK(out != nullptr);
+  Branch *inner = youtput_read_ytext(out);
+  CHECK(inner != nullptr);
+  ytext_insert(inner, txn, 0, "deep", nullptr);
+  ytransaction_commit(txn);
+  (void)rt;
+
+  CHECK(cap.fired);
+  CHECK(cap.count == 1);
+  CHECK(cap.kind0 == Y_TEXT);
+  CHECK(cap.path_len == 1);
+  CHECK(cap.path_key0 == "inner");
+
+  yunobserve(sub);
+  ybranch_destroy(inner);
+  youtput_destroy(out);
+  ybranch_destroy(map);
+  ydoc_destroy(doc);
+}
+
+// --- weak links (reference tests-ffi weak cases) -----------------------------
+static void test_weak_links() {
+  YDoc *doc = ydoc_new();
+  Branch *map = ymap(doc, "m");
+  Branch *arr = yarray(doc, "a");
+
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  YInput v = yinput_string("payload");
+  ymap_insert(map, txn, "k", &v);
+  YInput nums[3] = {yinput_long(1), yinput_long(2), yinput_long(3)};
+  yarray_insert_range(arr, txn, 0, nums, 3);
+
+  // link to a map entry, store the link in the array
+  YWeak *link = ymap_link(map, txn, "k");
+  CHECK(link != nullptr);
+  YInput wl = yinput_weak(link);
+  yarray_insert_range(arr, txn, 3, &wl, 1);
+  ytransaction_commit(txn);
+  yweak_destroy(link);
+
+  YOutput *out = yarray_get(arr, nullptr, 3);
+  CHECK(out != nullptr);
+  Branch *weak_ref = youtput_read_yweak(out);
+  CHECK(weak_ref != nullptr);
+  YOutput *deref = yweak_deref(weak_ref, nullptr);
+  CHECK(deref != nullptr);
+  CHECK_STR(youtput_read_string(deref), "payload");
+  youtput_destroy(deref);
+
+  // map entry update → link follows the live value
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  YInput v2 = yinput_string("updated");
+  ymap_insert(map, txn, "k", &v2);
+  ytransaction_commit(txn);
+  deref = yweak_deref(weak_ref, nullptr);
+  CHECK(deref != nullptr);
+  CHECK_STR(youtput_read_string(deref), "updated");
+  youtput_destroy(deref);
+  ybranch_destroy(weak_ref);
+  youtput_destroy(out);
+
+  // quote an array range and iterate it through the weak iter
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  YWeak *quote = yarray_quote(arr, txn, 0, 2, 0, 0); // [1,2,3] inclusive
+  CHECK(quote != nullptr);
+  YInput wq = yinput_weak(quote);
+  yarray_insert_range(arr, txn, 4, &wq, 1);
+  ytransaction_commit(txn);
+  yweak_destroy(quote);
+
+  out = yarray_get(arr, nullptr, 4);
+  CHECK(out != nullptr);
+  Branch *quote_ref = youtput_read_yweak(out);
+  CHECK(quote_ref != nullptr);
+  YWeakIter *iter = yweak_iter(quote_ref, nullptr);
+  CHECK(iter != nullptr);
+  int64_t expect[3] = {1, 2, 3};
+  for (int i = 0; i < 3; ++i) {
+    YOutput *item = yweak_iter_next(iter);
+    CHECK(item != nullptr);
+    if (item) CHECK(youtput_read_long(item) == expect[i]);
+    youtput_destroy(item);
+  }
+  CHECK(yweak_iter_next(iter) == nullptr);
+  yweak_iter_destroy(iter);
+  ybranch_destroy(quote_ref);
+  youtput_destroy(out);
+
+  // quote a text range → yweak_string
+  Branch *txt = ytext(doc, "t");
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  ytext_insert(txt, txn, 0, "abcdef", nullptr);
+  YWeak *tq = ytext_quote(txt, txn, 1, 4, 0, 0); // "bcde"
+  CHECK(tq != nullptr);
+  YInput wtq = yinput_weak(tq);
+  yarray_insert_range(arr, txn, 5, &wtq, 1);
+  ytransaction_commit(txn);
+  yweak_destroy(tq);
+
+  out = yarray_get(arr, nullptr, 5);
+  Branch *text_link = out ? youtput_read_yweak(out) : nullptr;
+  CHECK(text_link != nullptr);
+  CHECK_STR(yweak_string(text_link, nullptr), "bcde");
+  ybranch_destroy(text_link);
+  youtput_destroy(out);
+
+  ybranch_destroy(txt);
+  ybranch_destroy(arr);
+  ybranch_destroy(map);
+  ydoc_destroy(doc);
+}
+
+// --- subdocuments over the C ABI ---------------------------------------------
+struct SubdocsCapture {
+  bool fired = false;
+  uint32_t added = 0, removed = 0, loaded = 0;
+  std::string guid0;
+};
+
+static void on_subdocs(void *state, const YSubdocsEvent *e) {
+  SubdocsCapture *cap = (SubdocsCapture *)state;
+  cap->fired = true;
+  cap->added += e->added_len;
+  cap->removed += e->removed_len;
+  cap->loaded += e->loaded_len;
+  if (e->added_len > 0 && e->added[0]) {
+    char *guid = ydoc_guid(e->added[0]);
+    if (guid) cap->guid0 = guid;
+    ystring_destroy(guid);
+  }
+}
+
+static void test_subdocs() {
+  YDoc *doc = ydoc_new();
+  Branch *map = ymap(doc, "m");
+  SubdocsCapture cap;
+  YSubscription *sub = ydoc_observe_subdocs(doc, &cap, on_subdocs);
+
+  YOptions opts = yoptions();
+  opts.guid = "child-doc";
+  YDoc *child = ydoc_new_with_options(opts);
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  YInput di = yinput_ydoc(child);
+  ymap_insert(map, txn, "sub", &di);
+  ytransaction_commit(txn);
+
+  CHECK(cap.fired);
+  CHECK(cap.added == 1);
+  CHECK(cap.guid0 == "child-doc");
+
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  uint32_t n = 0;
+  YDoc **subdocs = ytransaction_subdocs(txn, &n);
+  CHECK(n == 1);
+  if (subdocs && n == 1 && subdocs[0]) {
+    CHECK_STR(ydoc_guid(subdocs[0]), "child-doc");
+    ydoc_destroy(subdocs[0]);
+  }
+  free(subdocs);
+  ytransaction_commit(txn);
+
+  YOutput *out = ymap_get(map, nullptr, "sub");
+  CHECK(out != nullptr);
+  YDoc *got = youtput_read_ydoc(out);
+  CHECK(got != nullptr);
+  if (got) {
+    CHECK_STR(ydoc_guid(got), "child-doc");
+    ydoc_destroy(got);
+  }
+  youtput_destroy(out);
+
+  yunobserve(sub);
+  ydoc_destroy(child);
+  ybranch_destroy(map);
+  ydoc_destroy(doc);
+}
+
+// --- pending update introspection --------------------------------------------
+static void test_pending_update() {
+  // create an update with a dependency gap: apply doc-b's SECOND txn first
+  YDoc *a = ydoc_new();
+  YOptions opts = yoptions();
+  opts.id = 7777;
+  YDoc *b = ydoc_new_with_options(opts);
+  Branch *bt = ytext(b, "t");
+
+  YTransaction *txn = ydoc_write_transaction(b, 0, nullptr);
+  ytext_insert(bt, txn, 0, "first", nullptr);
+  ytransaction_commit(txn);
+  YTransaction *rb = ydoc_read_transaction(b);
+  YBinary full1 = ytransaction_state_diff_v1(rb, nullptr, 0);
+  ytransaction_commit(rb);
+
+  txn = ydoc_write_transaction(b, 0, nullptr);
+  ytext_insert(bt, txn, 5, "second", nullptr);
+  ytransaction_commit(txn);
+  rb = ydoc_read_transaction(b);
+  YBinary sv1 = {nullptr, 0};
+  {
+    // state vector covering only txn1: decode diff1's target state
+    YDoc *tmp = ydoc_new();
+    YTransaction *tt = ydoc_write_transaction(tmp, 0, nullptr);
+    CHECK(ytransaction_apply(tt, full1.data, (uint32_t)full1.len) == 0);
+    sv1 = ytransaction_state_vector_v1(tt);
+    ytransaction_commit(tt);
+    ydoc_destroy(tmp);
+  }
+  YBinary diff2 = ytransaction_state_diff_v1(rb, sv1.data, (uint32_t)sv1.len);
+  ytransaction_commit(rb);
+
+  // apply the dependent update first → must stash as pending
+  txn = ydoc_write_transaction(a, 0, nullptr);
+  CHECK(ytransaction_apply(txn, diff2.data, (uint32_t)diff2.len) == 0);
+  YPendingUpdate *pending = ytransaction_pending_update(txn);
+  CHECK(pending != nullptr);
+  if (pending) {
+    CHECK(pending->missing.len > 0);
+    CHECK(pending->update_v1.len > 0);
+  }
+  ypending_update_destroy(pending);
+  ytransaction_commit(txn);
+
+  // then the base update → pending drains, text completes
+  txn = ydoc_write_transaction(a, 0, nullptr);
+  CHECK(ytransaction_apply(txn, full1.data, (uint32_t)full1.len) == 0);
+  YPendingUpdate *drained = ytransaction_pending_update(txn);
+  CHECK(drained == nullptr);
+  ytransaction_commit(txn);
+
+  Branch *at = ytext(a, "t");
+  CHECK_STR(ytext_string(at, nullptr), "firstsecond");
+
+  ybinary_destroy(full1);
+  ybinary_destroy(sv1);
+  ybinary_destroy(diff2);
+  ybranch_destroy(at);
+  ybranch_destroy(bt);
+  ydoc_destroy(a);
+  ydoc_destroy(b);
+}
+
+// --- logical branch ids -------------------------------------------------------
+static void test_branch_ids() {
+  YDoc *doc = ydoc_new();
+  Branch *map = ymap(doc, "root-map");
+
+  // root branch → name id
+  YBranchId root_id = ybranch_id(map);
+  CHECK(root_id.client_or_len < 0);
+  CHECK(root_id.variant.name != nullptr);
+  std::string name((const char *)root_id.variant.name,
+                   (size_t)(-root_id.client_or_len));
+  CHECK(name == "root-map");
+
+  // nested branch → (client, clock) id
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  YInput nested = yinput_yarray(nullptr);
+  ymap_insert(map, txn, "list", &nested);
+  ytransaction_commit(txn);
+  YOutput *out = ymap_get(map, nullptr, "list");
+  Branch *list = out ? youtput_read_yarray(out) : nullptr;
+  CHECK(list != nullptr);
+  YBranchId nested_id = ybranch_id(list);
+  CHECK(nested_id.client_or_len >= 0);
+
+  // both resolve back through ybranch_get
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  Branch *root_back = ybranch_get(&root_id, txn);
+  CHECK(root_back != nullptr);
+  CHECK(ytype_kind(root_back) == Y_MAP);
+  Branch *nested_back = ybranch_get(&nested_id, txn);
+  CHECK(nested_back != nullptr);
+  CHECK(ytype_kind(nested_back) == Y_ARRAY);
+  // ytype_get finds existing roots without creating
+  Branch *found = ytype_get(txn, "root-map");
+  CHECK(found != nullptr);
+  CHECK(ytype_get(txn, "never-defined") == nullptr);
+  ytransaction_commit(txn);
+
+  ystring_destroy((char *)root_id.variant.name);
+  ybranch_destroy(root_back);
+  ybranch_destroy(nested_back);
+  ybranch_destroy(found);
+  ybranch_destroy(list);
+  youtput_destroy(out);
+  ybranch_destroy(map);
+  ydoc_destroy(doc);
+}
+
+// --- text chunks --------------------------------------------------------------
+static void test_text_chunks() {
+  YDoc *doc = ydoc_new();
+  Branch *txt = ytext(doc, "t");
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  ytext_insert(txt, txn, 0, "plainbold", nullptr);
+  ytext_format(txt, txn, 5, 4, "{\"bold\":true}");
+  ytransaction_commit(txn);
+
+  uint32_t n = 0;
+  YChunk *chunks = ytext_chunks(txt, nullptr, &n);
+  CHECK(n == 2);
+  if (chunks && n == 2) {
+    CHECK_STR(youtput_read_string(chunks[0].data), "plain");
+    CHECK(chunks[0].fmt_len == 0);
+    CHECK_STR(youtput_read_string(chunks[1].data), "bold");
+    CHECK(chunks[1].fmt_len == 1);
+    if (chunks[1].fmt_len == 1) {
+      CHECK(std::strcmp(chunks[1].fmt[0].key, "bold") == 0);
+      CHECK(youtput_read_bool(chunks[1].fmt[0].value) == 1);
+    }
+  }
+  ychunks_destroy(chunks, n);
+  ybranch_destroy(txt);
+  ydoc_destroy(doc);
+}
+
+// --- xml attr iteration + parent ---------------------------------------------
+static void test_xml_attrs_and_parent() {
+  YDoc *doc = ydoc_new();
+  Branch *frag = yxmlfragment(doc, "f");
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  Branch *div = yxmlelem_insert_elem(frag, txn, 0, "div");
+  CHECK(div != nullptr);
+  yxmlelem_insert_attr(div, txn, "id", "main");
+  yxmlelem_insert_attr(div, txn, "class", "wide");
+  ytransaction_commit(txn);
+
+  uint32_t seen = 0;
+  bool saw_id = false, saw_class = false;
+  YXmlAttrIter *iter = yxmlelem_attr_iter(div, nullptr);
+  CHECK(iter != nullptr);
+  while (YXmlAttr *attr = yxmlattr_iter_next(iter)) {
+    ++seen;
+    if (std::strcmp(attr->name, "id") == 0)
+      saw_id = std::strcmp(attr->value, "main") == 0;
+    if (std::strcmp(attr->name, "class") == 0)
+      saw_class = std::strcmp(attr->value, "wide") == 0;
+    yxmlattr_destroy(attr);
+  }
+  yxmlattr_iter_destroy(iter);
+  CHECK(seen == 2);
+  CHECK(saw_id);
+  CHECK(saw_class);
+
+  Branch *parent = yxmlelem_parent(div);
+  CHECK(parent != nullptr);
+  CHECK(ytype_kind(parent) == Y_XML_FRAG);
+  ybranch_destroy(parent);
+
+  ybranch_destroy(div);
+  ybranch_destroy(frag);
+  ydoc_destroy(doc);
+}
+
+// --- undo observers with meta round-trip -------------------------------------
+struct UndoCapture {
+  int added = 0;
+  int popped = 0;
+  char last_kind = -1;
+  void *meta_seen = nullptr;
+};
+
+static void on_undo_added(void *state, YUndoEvent *e) {
+  UndoCapture *cap = (UndoCapture *)state;
+  ++cap->added;
+  cap->last_kind = e->kind;
+  e->meta = (void *)(intptr_t)0x1234; // user metadata attaches to the item
+}
+
+static void on_undo_popped(void *state, YUndoEvent *e) {
+  UndoCapture *cap = (UndoCapture *)state;
+  ++cap->popped;
+  cap->last_kind = e->kind;
+  cap->meta_seen = e->meta;
+}
+
+static void test_undo_observers() {
+  YDoc *doc = ydoc_new();
+  Branch *txt = ytext(doc, "t");
+  YUndoManager *mgr = yundo_manager(doc, nullptr);
+  yundo_manager_add_scope(mgr, txt);
+  UndoCapture cap;
+  YSubscription *sub_a = yundo_manager_observe_added(mgr, &cap, on_undo_added);
+  YSubscription *sub_p =
+      yundo_manager_observe_popped(mgr, &cap, on_undo_popped);
+
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  ytext_insert(txt, txn, 0, "tracked", nullptr);
+  ytransaction_commit(txn);
+  CHECK(cap.added == 1);
+  // a normal edit fires Redo for the ADDED event (yrs undo.rs:229-233)
+  CHECK(cap.last_kind == Y_KIND_REDO);
+
+  CHECK(yundo_manager_undo(mgr) == 1);
+  CHECK(cap.popped == 1);
+  // the meta pointer written in observe_added comes back in observe_popped
+  CHECK(cap.meta_seen == (void *)(intptr_t)0x1234);
+  CHECK_STR(ytext_string(txt, nullptr), "");
+
+  yunobserve(sub_a);
+  yunobserve(sub_p);
+  yundo_manager_destroy(mgr);
+  ybranch_destroy(txt);
+  ydoc_destroy(doc);
+}
+
+// --- json collection outputs --------------------------------------------------
+static void test_json_outputs() {
+  YDoc *doc = ydoc_new();
+  Branch *map = ymap(doc, "m");
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  YInput arr = yinput_json_array("[1, \"two\", 3.5]");
+  ymap_insert(map, txn, "list", &arr);
+  YInput obj = yinput_json_map("{\"a\": 1, \"b\": \"bee\"}");
+  ymap_insert(map, txn, "obj", &obj);
+  ytransaction_commit(txn);
+
+  YOutput *out = ymap_get(map, nullptr, "list");
+  CHECK(out != nullptr);
+  CHECK(youtput_tag(out) == Y_JSON_ARR);
+  uint32_t n = 0;
+  YOutput **items = youtput_read_json_array(out, &n);
+  CHECK(n == 3);
+  if (items && n == 3) {
+    CHECK(youtput_read_long(items[0]) == 1);
+    CHECK_STR(youtput_read_string(items[1]), "two");
+    CHECK(youtput_read_float(items[2]) == 3.5);
+    for (uint32_t i = 0; i < n; ++i) youtput_destroy(items[i]);
+  }
+  free(items);
+  youtput_destroy(out);
+
+  out = ymap_get(map, nullptr, "obj");
+  CHECK(out != nullptr);
+  CHECK(youtput_tag(out) == Y_JSON_MAP);
+  YMapEntry **entries = youtput_read_json_map(out, &n);
+  CHECK(n == 2);
+  bool saw_a = false, saw_b = false;
+  if (entries) {
+    for (uint32_t i = 0; i < n; ++i) {
+      if (!entries[i]) continue;
+      if (std::strcmp(entries[i]->key, "a") == 0)
+        saw_a = youtput_read_long(entries[i]->value) == 1;
+      if (std::strcmp(entries[i]->key, "b") == 0) {
+        char *s = youtput_read_string(entries[i]->value);
+        saw_b = s && std::strcmp(s, "bee") == 0;
+        ystring_destroy(s);
+      }
+      ymap_entry_destroy(entries[i]);
+    }
+  }
+  free(entries);
+  CHECK(saw_a);
+  CHECK(saw_b);
+  youtput_destroy(out);
+
+  ybranch_destroy(map);
+  ydoc_destroy(doc);
+}
+
 int main() {
   test_doc_lifecycle();
   test_text_basic();
@@ -623,6 +1293,18 @@ int main() {
   test_text_formatting();
   test_clone_and_errors();
   test_read_transactions();
+  test_typed_text_observer();
+  test_typed_map_observer();
+  test_typed_array_observer();
+  test_deep_observer();
+  test_weak_links();
+  test_subdocs();
+  test_pending_update();
+  test_branch_ids();
+  test_text_chunks();
+  test_xml_attrs_and_parent();
+  test_undo_observers();
+  test_json_outputs();
 
   std::printf("%d checks, %d failures\n", g_checks, g_failures);
   return g_failures == 0 ? 0 : 1;

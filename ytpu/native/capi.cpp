@@ -18,6 +18,7 @@
 #include <Python.h>
 
 #include <dlfcn.h>
+#include <limits.h>
 
 #include <cstdarg>
 #include <cstdlib>
@@ -58,6 +59,18 @@ struct YMapIter {
   PyObject *iter;
 };
 struct YXmlTreeWalker {
+  PyObject *iter;
+};
+struct YEvent {
+  PyObject *obj; /* borrowed; valid only during the observer callback */
+};
+struct YWeak {
+  PyObject *obj; /* WeakPrelim */
+};
+struct YWeakIter {
+  PyObject *iter;
+};
+struct YXmlAttrIter {
   PyObject *iter;
 };
 
@@ -106,6 +119,10 @@ static void bootstrap() {
   Dl_info info;
   if (dladdr((void *)&bootstrap, &info) && info.dli_fname) {
     std::string path(info.dli_fname);
+    /* dladdr reports the path as given at link time; canonicalize so a
+     * relative -l path still resolves to the repo root */
+    char resolved[PATH_MAX];
+    if (realpath(path.c_str(), resolved)) path = resolved;
     for (int up = 0; up < 3; ++up) {
       size_t slash = path.find_last_of('/');
       if (slash == std::string::npos) break;
@@ -264,6 +281,18 @@ static PyObject *input_payload(const YInput *input) {
     case Y_JSON_BUF:
       return PyBytes_FromStringAndSize((const char *)input->value.buf.data,
                                        (Py_ssize_t)input->value.buf.len);
+    case Y_DOC:
+      if (input->value.doc) {
+        Py_INCREF(input->value.doc->obj);
+        return input->value.doc->obj;
+      }
+      Py_RETURN_NONE;
+    case Y_WEAK_LINK:
+      if (input->value.weak) {
+        Py_INCREF(input->value.weak->obj);
+        return input->value.weak->obj;
+      }
+      Py_RETURN_NONE;
     default:
       Py_RETURN_NONE;
   }
@@ -1327,6 +1356,490 @@ extern "C" YSubscription *ydoc_observe_after_transaction(YDoc *doc,
   return observe(doc, 2, state, cb);
 }
 
+/* ---- typed event observers --------------------------------------------- */
+/* One trampoline family for every callback that delivers a structured
+ * event. The capsule carries the user's state+fn plus a kind selector so
+ * a single PyCFunction body can unpack the support-layer payload. */
+enum TypedCbKind {
+  CB_EVENT = 0,    /* args: (event,)                         */
+  CB_DEEP = 1,     /* args: (events_list,)                   */
+  CB_SUBDOCS = 2,  /* args: (added, removed, loaded) lists   */
+  CB_CLEAR = 3,    /* args: (doc,)                           */
+  CB_UNDO = 4,     /* args: (kind, origin|None, stack_item)  */
+};
+
+struct TypedCbData {
+  void *state;
+  void *cb;
+  int kind;
+};
+
+static void typed_capsule_free(PyObject *capsule) {
+  TypedCbData *cd =
+      (TypedCbData *)PyCapsule_GetPointer(capsule, "ytpu.typed_callback");
+  delete cd;
+}
+
+static PyObject *typed_trampoline(PyObject *self, PyObject *args) {
+  TypedCbData *cd =
+      (TypedCbData *)PyCapsule_GetPointer(self, "ytpu.typed_callback");
+  if (!cd) return nullptr;
+  switch (cd->kind) {
+    case CB_EVENT: {
+      PyObject *ev = nullptr;
+      if (!PyArg_ParseTuple(args, "O", &ev)) return nullptr;
+      YEvent e{ev};
+      ((void (*)(void *, const YEvent *))cd->cb)(cd->state, &e);
+      break;
+    }
+    case CB_DEEP: {
+      PyObject *list = nullptr;
+      if (!PyArg_ParseTuple(args, "O", &list)) return nullptr;
+      Py_ssize_t n = PySequence_Length(list);
+      if (n < 0) return nullptr;
+      YEvent *events = new YEvent[n > 0 ? n : 1];
+      const YEvent **ptrs = new const YEvent *[n > 0 ? n : 1];
+      bool ok = true;
+      for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject *item = PySequence_GetItem(list, i); /* new ref */
+        if (!item) {
+          ok = false;
+          break;
+        }
+        events[i].obj = item;
+        ptrs[i] = &events[i];
+      }
+      if (ok) {
+        ((void (*)(void *, uint32_t, const YEvent *const *))cd->cb)(
+            cd->state, (uint32_t)n, ptrs);
+      }
+      for (Py_ssize_t i = 0; i < n; ++i) {
+        if (events[i].obj) Py_DECREF(events[i].obj);
+      }
+      delete[] events;
+      delete[] ptrs;
+      if (!ok) return nullptr;
+      break;
+    }
+    case CB_SUBDOCS: {
+      PyObject *added = nullptr, *removed = nullptr, *loaded = nullptr;
+      if (!PyArg_ParseTuple(args, "OOO", &added, &removed, &loaded))
+        return nullptr;
+      YSubdocsEvent ev{};
+      PyObject *lists[3] = {added, removed, loaded};
+      YDoc **arrays[3] = {nullptr, nullptr, nullptr};
+      uint32_t lens[3] = {0, 0, 0};
+      for (int k = 0; k < 3; ++k) {
+        Py_ssize_t n = PySequence_Length(lists[k]);
+        lens[k] = n > 0 ? (uint32_t)n : 0;
+        arrays[k] = new YDoc *[lens[k] ? lens[k] : 1];
+        for (uint32_t i = 0; i < lens[k]; ++i) {
+          PyObject *d = PySequence_GetItem(lists[k], (Py_ssize_t)i);
+          arrays[k][i] = d ? new YDoc{d} : nullptr; /* owns the new ref */
+        }
+      }
+      ev.added_len = lens[0];
+      ev.removed_len = lens[1];
+      ev.loaded_len = lens[2];
+      ev.added = arrays[0];
+      ev.removed = arrays[1];
+      ev.loaded = arrays[2];
+      ((void (*)(void *, const YSubdocsEvent *))cd->cb)(cd->state, &ev);
+      for (int k = 0; k < 3; ++k) {
+        for (uint32_t i = 0; i < lens[k]; ++i) {
+          if (arrays[k][i]) {
+            Py_DECREF(arrays[k][i]->obj);
+            delete arrays[k][i];
+          }
+        }
+        delete[] arrays[k];
+      }
+      break;
+    }
+    case CB_CLEAR: {
+      PyObject *doc = nullptr;
+      if (!PyArg_ParseTuple(args, "O", &doc)) return nullptr;
+      YDoc handle{doc};
+      ((void (*)(void *, YDoc *))cd->cb)(cd->state, &handle);
+      break;
+    }
+    case CB_UNDO: {
+      int kind = 0;
+      PyObject *origin = nullptr, *item = nullptr;
+      if (!PyArg_ParseTuple(args, "iOO", &kind, &origin, &item))
+        return nullptr;
+      YUndoEvent ev{};
+      ev.kind = (char)kind;
+      const char *obuf = nullptr;
+      Py_ssize_t olen = 0;
+      if (origin != Py_None && PyBytes_Check(origin)) {
+        PyBytes_AsStringAndSize(origin, (char **)&obuf, &olen);
+      }
+      ev.origin = obuf;
+      ev.origin_len = (uint32_t)olen;
+      PyObject *meta = support_call("undo_item_meta", "(O)", item);
+      ev.meta = meta ? (void *)(intptr_t)PyLong_AsLongLong(meta) : nullptr;
+      Py_XDECREF(meta);
+      ((void (*)(void *, YUndoEvent *))cd->cb)(cd->state, &ev);
+      PyObject *r = support_call("undo_item_set_meta", "(OL)", item,
+                                 (long long)(intptr_t)ev.meta);
+      Py_XDECREF(r);
+      break;
+    }
+  }
+  Py_RETURN_NONE;
+}
+
+static PyMethodDef g_typed_trampoline_def = {
+    "_ytpu_typed_observer", typed_trampoline, METH_VARARGS, nullptr};
+
+/* Register through a support-module function whose last arg is the python
+ * callback; `fmt_head` describes the leading args. */
+static YSubscription *typed_observe(int kind, void *state, void *cb,
+                                    const char *support_fn, PyObject *target,
+                                    int extra_int, bool has_extra) {
+  Gil gil;
+  if (!gil.ok || !target || !cb) return nullptr;
+  TypedCbData *cd = new TypedCbData{state, cb, kind};
+  PyObject *capsule = PyCapsule_New(cd, "ytpu.typed_callback",
+                                    typed_capsule_free);
+  if (!capsule) {
+    delete cd;
+    set_err_py();
+    return nullptr;
+  }
+  PyObject *fn = PyCFunction_New(&g_typed_trampoline_def, capsule);
+  Py_DECREF(capsule);
+  if (!fn) {
+    set_err_py();
+    return nullptr;
+  }
+  PyObject *unobserve =
+      has_extra ? support_call(support_fn, "(OiO)", target, extra_int, fn)
+                : support_call(support_fn, "(OO)", target, fn);
+  if (!unobserve) {
+    Py_DECREF(fn);
+    return nullptr;
+  }
+  return new YSubscription{unobserve, fn};
+}
+
+extern "C" YSubscription *ytext_observe(Branch *txt, void *state,
+                                        void (*cb)(void *,
+                                                   const YEvent *)) {
+  return typed_observe(CB_EVENT, state, (void *)cb, "observe_type",
+                       txt ? txt->obj : nullptr, 0, false);
+}
+extern "C" YSubscription *yarray_observe(Branch *array, void *state,
+                                         void (*cb)(void *,
+                                                    const YEvent *)) {
+  return typed_observe(CB_EVENT, state, (void *)cb, "observe_type",
+                       array ? array->obj : nullptr, 0, false);
+}
+extern "C" YSubscription *ymap_observe(Branch *map, void *state,
+                                       void (*cb)(void *, const YEvent *)) {
+  return typed_observe(CB_EVENT, state, (void *)cb, "observe_type",
+                       map ? map->obj : nullptr, 0, false);
+}
+extern "C" YSubscription *yxmlelem_observe(Branch *xml, void *state,
+                                           void (*cb)(void *,
+                                                      const YEvent *)) {
+  return typed_observe(CB_EVENT, state, (void *)cb, "observe_type",
+                       xml ? xml->obj : nullptr, 0, false);
+}
+extern "C" YSubscription *yxmltext_observe(Branch *xml, void *state,
+                                           void (*cb)(void *,
+                                                      const YEvent *)) {
+  return typed_observe(CB_EVENT, state, (void *)cb, "observe_type",
+                       xml ? xml->obj : nullptr, 0, false);
+}
+extern "C" YSubscription *yweak_observe(Branch *weak, void *state,
+                                        void (*cb)(void *,
+                                                   const YEvent *)) {
+  return typed_observe(CB_EVENT, state, (void *)cb, "observe_type",
+                       weak ? weak->obj : nullptr, 0, false);
+}
+extern "C" YSubscription *yobserve_deep(Branch *ytype, void *state,
+                                        void (*cb)(void *, uint32_t,
+                                                   const YEvent *const *)) {
+  return typed_observe(CB_DEEP, state, (void *)cb, "observe_deep_type",
+                       ytype ? ytype->obj : nullptr, 0, false);
+}
+extern "C" YSubscription *ydoc_observe_subdocs(
+    YDoc *doc, void *state, void (*cb)(void *, const YSubdocsEvent *)) {
+  return typed_observe(CB_SUBDOCS, state, (void *)cb, "observe_subdocs",
+                       doc ? doc->obj : nullptr, 0, false);
+}
+extern "C" YSubscription *ydoc_observe_clear(YDoc *doc, void *state,
+                                             void (*cb)(void *, YDoc *)) {
+  return typed_observe(CB_CLEAR, state, (void *)cb, "observe_clear",
+                       doc ? doc->obj : nullptr, 0, false);
+}
+extern "C" YSubscription *yundo_manager_observe_added(
+    YUndoManager *mgr, void *state, void (*cb)(void *, YUndoEvent *)) {
+  return typed_observe(CB_UNDO, state, (void *)cb, "undo_observe",
+                       mgr ? mgr->obj : nullptr, 0, true);
+}
+extern "C" YSubscription *yundo_manager_observe_popped(
+    YUndoManager *mgr, void *state, void (*cb)(void *, YUndoEvent *)) {
+  return typed_observe(CB_UNDO, state, (void *)cb, "undo_observe",
+                       mgr ? mgr->obj : nullptr, 1, true);
+}
+
+/* ---- event accessors ----------------------------------------------------- */
+extern "C" int8_t yevent_kind(const YEvent *e) {
+  Gil gil;
+  if (!gil.ok || !e) return Y_JSON_UNDEF;
+  PyObject *r = support_call("event_kind", "(O)", e->obj);
+  if (!r) return Y_JSON_UNDEF;
+  int8_t kind = (int8_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return kind;
+}
+
+static Branch *event_target(const YEvent *e) {
+  Gil gil;
+  if (!gil.ok || !e) return nullptr;
+  return wrap_branch(support_call("event_target", "(O)", e->obj));
+}
+extern "C" Branch *ytext_event_target(const YEvent *e) {
+  return event_target(e);
+}
+extern "C" Branch *yarray_event_target(const YEvent *e) {
+  return event_target(e);
+}
+extern "C" Branch *ymap_event_target(const YEvent *e) {
+  return event_target(e);
+}
+extern "C" Branch *yxmlelem_event_target(const YEvent *e) {
+  return event_target(e);
+}
+extern "C" Branch *yxmltext_event_target(const YEvent *e) {
+  return event_target(e);
+}
+
+static YPathSegment *event_path(const YEvent *e, uint32_t *len) {
+  if (len) *len = 0;
+  Gil gil;
+  if (!gil.ok || !e || !len) return nullptr;
+  PyObject *path = support_call("event_path", "(O)", e->obj);
+  if (!path) return nullptr;
+  Py_ssize_t n = PySequence_Length(path);
+  YPathSegment *out =
+      (YPathSegment *)calloc(n > 0 ? (size_t)n : 1, sizeof(YPathSegment));
+  for (Py_ssize_t i = 0; i < n && out; ++i) {
+    PyObject *seg = PySequence_GetItem(path, i);
+    if (!seg) break;
+    if (PyUnicode_Check(seg)) {
+      out[i].tag = Y_EVENT_PATH_KEY;
+      const char *s = PyUnicode_AsUTF8(seg);
+      out[i].value.key = dup_str(s ? s : "");
+    } else {
+      out[i].tag = Y_EVENT_PATH_INDEX;
+      out[i].value.index = (uint32_t)PyLong_AsUnsignedLong(seg);
+    }
+    Py_DECREF(seg);
+  }
+  Py_DECREF(path);
+  *len = (uint32_t)(n > 0 ? n : 0);
+  return out;
+}
+extern "C" YPathSegment *ytext_event_path(const YEvent *e, uint32_t *len) {
+  return event_path(e, len);
+}
+extern "C" YPathSegment *yarray_event_path(const YEvent *e, uint32_t *len) {
+  return event_path(e, len);
+}
+extern "C" YPathSegment *ymap_event_path(const YEvent *e, uint32_t *len) {
+  return event_path(e, len);
+}
+extern "C" YPathSegment *yxmlelem_event_path(const YEvent *e, uint32_t *len) {
+  return event_path(e, len);
+}
+extern "C" YPathSegment *yxmltext_event_path(const YEvent *e, uint32_t *len) {
+  return event_path(e, len);
+}
+extern "C" void ypath_destroy(YPathSegment *path, uint32_t len) {
+  if (!path) return;
+  for (uint32_t i = 0; i < len; ++i) {
+    if (path[i].tag == Y_EVENT_PATH_KEY) free(path[i].value.key);
+  }
+  free(path);
+}
+
+static YDelta *event_delta_text(const YEvent *e, uint32_t *len) {
+  if (len) *len = 0;
+  Gil gil;
+  if (!gil.ok || !e || !len) return nullptr;
+  PyObject *rows = support_call("event_delta_text", "(O)", e->obj);
+  if (!rows) return nullptr;
+  Py_ssize_t n = PySequence_Length(rows);
+  YDelta *out = (YDelta *)calloc(n > 0 ? (size_t)n : 1, sizeof(YDelta));
+  for (Py_ssize_t i = 0; i < n && out; ++i) {
+    PyObject *row = PySequence_GetItem(rows, i); /* (tag,len,ins,attrs) */
+    if (!row) break;
+    int tag = 0;
+    unsigned length = 0;
+    PyObject *insert = nullptr, *attrs = nullptr;
+    if (PyArg_ParseTuple(row, "iIOO", &tag, &length, &insert, &attrs)) {
+      out[i].tag = (char)tag;
+      out[i].len = length;
+      if (insert != Py_None) {
+        Py_INCREF(insert);
+        out[i].insert = wrap_output(insert);
+      }
+      if (attrs != Py_None) {
+        Py_ssize_t an = PySequence_Length(attrs);
+        out[i].attributes =
+            (YDeltaAttr *)calloc(an > 0 ? (size_t)an : 1, sizeof(YDeltaAttr));
+        out[i].attributes_len = (uint32_t)(an > 0 ? an : 0);
+        for (Py_ssize_t a = 0; a < an && out[i].attributes; ++a) {
+          PyObject *pair = PySequence_GetItem(attrs, a);
+          const char *k = nullptr;
+          PyObject *v = nullptr;
+          if (pair && PyArg_ParseTuple(pair, "sO", &k, &v)) {
+            out[i].attributes[a].key = dup_str(k);
+            Py_INCREF(v);
+            out[i].attributes[a].value_json =
+                py_to_cstr(support_call("output_json", "(N)", v));
+          }
+          Py_XDECREF(pair);
+        }
+      }
+    }
+    Py_DECREF(row);
+  }
+  Py_DECREF(rows);
+  *len = (uint32_t)(n > 0 ? n : 0);
+  return out;
+}
+extern "C" YDelta *ytext_event_delta(const YEvent *e, uint32_t *len) {
+  return event_delta_text(e, len);
+}
+extern "C" YDelta *yxmltext_event_delta(const YEvent *e, uint32_t *len) {
+  return event_delta_text(e, len);
+}
+extern "C" void ytext_delta_destroy(YDelta *delta, uint32_t len) {
+  if (!delta) return;
+  for (uint32_t i = 0; i < len; ++i) {
+    if (delta[i].insert) youtput_destroy(delta[i].insert);
+    for (uint32_t a = 0; a < delta[i].attributes_len; ++a) {
+      free(delta[i].attributes[a].key);
+      free(delta[i].attributes[a].value_json);
+    }
+    free(delta[i].attributes);
+  }
+  free(delta);
+}
+
+static YEventChange *event_delta_seq(const YEvent *e, uint32_t *len) {
+  if (len) *len = 0;
+  Gil gil;
+  if (!gil.ok || !e || !len) return nullptr;
+  PyObject *rows = support_call("event_delta_seq", "(O)", e->obj);
+  if (!rows) return nullptr;
+  Py_ssize_t n = PySequence_Length(rows);
+  YEventChange *out =
+      (YEventChange *)calloc(n > 0 ? (size_t)n : 1, sizeof(YEventChange));
+  for (Py_ssize_t i = 0; i < n && out; ++i) {
+    PyObject *row = PySequence_GetItem(rows, i); /* (tag, len, values) */
+    if (!row) break;
+    int tag = 0;
+    unsigned length = 0;
+    PyObject *values = nullptr;
+    if (PyArg_ParseTuple(row, "iIO", &tag, &length, &values)) {
+      out[i].tag = (char)tag;
+      out[i].len = length;
+      if (values != Py_None) {
+        Py_ssize_t vn = PySequence_Length(values);
+        out[i].values =
+            (YOutput **)calloc(vn > 0 ? (size_t)vn : 1, sizeof(YOutput *));
+        for (Py_ssize_t v = 0; v < vn && out[i].values; ++v) {
+          PyObject *item = PySequence_GetItem(values, v);
+          out[i].values[v] = item ? new YOutput{item} : nullptr;
+        }
+      }
+    }
+    Py_DECREF(row);
+  }
+  Py_DECREF(rows);
+  *len = (uint32_t)(n > 0 ? n : 0);
+  return out;
+}
+extern "C" YEventChange *yarray_event_delta(const YEvent *e, uint32_t *len) {
+  return event_delta_seq(e, len);
+}
+extern "C" YEventChange *yxmlelem_event_delta(const YEvent *e,
+                                              uint32_t *len) {
+  return event_delta_seq(e, len);
+}
+extern "C" void yevent_delta_destroy(YEventChange *delta, uint32_t len) {
+  if (!delta) return;
+  for (uint32_t i = 0; i < len; ++i) {
+    if (delta[i].values) {
+      for (uint32_t v = 0; v < delta[i].len; ++v) {
+        if (delta[i].values[v]) youtput_destroy(delta[i].values[v]);
+      }
+      free(delta[i].values);
+    }
+  }
+  free(delta);
+}
+
+static YEventKeyChange *event_keys(const YEvent *e, uint32_t *len) {
+  if (len) *len = 0;
+  Gil gil;
+  if (!gil.ok || !e || !len) return nullptr;
+  PyObject *rows = support_call("event_keys", "(O)", e->obj);
+  if (!rows) return nullptr;
+  Py_ssize_t n = PySequence_Length(rows);
+  YEventKeyChange *out = (YEventKeyChange *)calloc(
+      n > 0 ? (size_t)n : 1, sizeof(YEventKeyChange));
+  for (Py_ssize_t i = 0; i < n && out; ++i) {
+    PyObject *row = PySequence_GetItem(rows, i); /* (key, tag, old, new) */
+    if (!row) break;
+    const char *key = nullptr;
+    int tag = 0;
+    PyObject *oldv = nullptr, *newv = nullptr;
+    if (PyArg_ParseTuple(row, "siOO", &key, &tag, &oldv, &newv)) {
+      out[i].key = dup_str(key);
+      out[i].tag = (char)tag;
+      if (oldv != Py_None) {
+        Py_INCREF(oldv);
+        out[i].old_value = wrap_output(oldv);
+      }
+      if (newv != Py_None) {
+        Py_INCREF(newv);
+        out[i].new_value = wrap_output(newv);
+      }
+    }
+    Py_DECREF(row);
+  }
+  Py_DECREF(rows);
+  *len = (uint32_t)(n > 0 ? n : 0);
+  return out;
+}
+extern "C" YEventKeyChange *ymap_event_keys(const YEvent *e, uint32_t *len) {
+  return event_keys(e, len);
+}
+extern "C" YEventKeyChange *yxmlelem_event_keys(const YEvent *e,
+                                                uint32_t *len) {
+  return event_keys(e, len);
+}
+extern "C" YEventKeyChange *yxmltext_event_keys(const YEvent *e,
+                                                uint32_t *len) {
+  return event_keys(e, len);
+}
+extern "C" void yevent_keys_destroy(YEventKeyChange *keys, uint32_t len) {
+  if (!keys) return;
+  for (uint32_t i = 0; i < len; ++i) {
+    free(keys[i].key);
+    if (keys[i].old_value) youtput_destroy(keys[i].old_value);
+    if (keys[i].new_value) youtput_destroy(keys[i].new_value);
+  }
+  free(keys);
+}
+
 extern "C" void yunobserve(YSubscription *subscription) {
   if (!subscription) return;
   Gil gil;
@@ -1341,4 +1854,553 @@ extern "C" void yunobserve(YSubscription *subscription) {
     Py_DECREF(subscription->callback);
   }
   delete subscription;
+}
+
+/* ---- default options (yffi: yoptions) ------------------------------------ */
+extern "C" YOptions yoptions(void) {
+  YOptions o{};
+  o.id = 0;
+  o.guid = nullptr;
+  o.collection_id = nullptr;
+  o.encoding = Y_OFFSET_UTF16;
+  o.skip_gc = 0;
+  o.auto_load = 0;
+  o.should_load = 1;
+  return o;
+}
+
+/* ---- YInput constructors (yffi: yinput_*) -------------------------------- */
+extern "C" YInput yinput_null(void) {
+  YInput i{};
+  i.tag = Y_JSON_NULL;
+  return i;
+}
+extern "C" YInput yinput_undefined(void) {
+  YInput i{};
+  i.tag = Y_JSON_UNDEF;
+  return i;
+}
+extern "C" YInput yinput_bool(uint8_t flag) {
+  YInput i{};
+  i.tag = Y_JSON_BOOL;
+  i.value.flag = flag;
+  return i;
+}
+extern "C" YInput yinput_float(double num) {
+  YInput i{};
+  i.tag = Y_JSON_NUM;
+  i.value.num = num;
+  return i;
+}
+extern "C" YInput yinput_long(int64_t integer) {
+  YInput i{};
+  i.tag = Y_JSON_INT;
+  i.value.integer = integer;
+  return i;
+}
+extern "C" YInput yinput_string(const char *str) {
+  YInput i{};
+  i.tag = Y_JSON_STR;
+  i.value.str = str;
+  return i;
+}
+extern "C" YInput yinput_binary(const uint8_t *buf, uint32_t len) {
+  YInput i{};
+  i.tag = Y_JSON_BUF;
+  i.value.buf.data = buf;
+  i.value.buf.len = len;
+  return i;
+}
+extern "C" YInput yinput_json_array(const char *json) {
+  YInput i{};
+  i.tag = Y_JSON_ARR;
+  i.value.str = json;
+  return i;
+}
+extern "C" YInput yinput_json_map(const char *json) {
+  YInput i{};
+  i.tag = Y_JSON_MAP;
+  i.value.str = json;
+  return i;
+}
+extern "C" YInput yinput_ytext(const char *init) {
+  YInput i{};
+  i.tag = Y_TEXT;
+  i.value.str = init;
+  return i;
+}
+extern "C" YInput yinput_yarray(const char *init_json) {
+  YInput i{};
+  i.tag = Y_ARRAY;
+  i.value.str = init_json;
+  return i;
+}
+extern "C" YInput yinput_ymap(const char *init_json) {
+  YInput i{};
+  i.tag = Y_MAP;
+  i.value.str = init_json;
+  return i;
+}
+extern "C" YInput yinput_yxmlelem(const char *name) {
+  YInput i{};
+  i.tag = Y_XML_ELEM;
+  i.value.str = name;
+  return i;
+}
+extern "C" YInput yinput_yxmltext(const char *init) {
+  YInput i{};
+  i.tag = Y_XML_TEXT;
+  i.value.str = init;
+  return i;
+}
+extern "C" YInput yinput_ydoc(YDoc *doc) {
+  YInput i{};
+  i.tag = Y_DOC;
+  i.value.doc = doc;
+  return i;
+}
+extern "C" YInput yinput_weak(const YWeak *weak) {
+  YInput i{};
+  i.tag = Y_WEAK_LINK;
+  i.value.weak = weak;
+  return i;
+}
+
+/* ---- YOutput collection readers ------------------------------------------ */
+extern "C" YOutput **youtput_read_json_array(YOutput *val, uint32_t *len) {
+  if (len) *len = 0;
+  Gil gil;
+  if (!gil.ok || !val || !len || !PyList_Check(val->obj)) return nullptr;
+  Py_ssize_t n = PyList_Size(val->obj);
+  YOutput **out =
+      (YOutput **)calloc(n > 0 ? (size_t)n : 1, sizeof(YOutput *));
+  for (Py_ssize_t i = 0; i < n && out; ++i) {
+    PyObject *item = PyList_GetItem(val->obj, i); /* borrowed */
+    if (item) {
+      Py_INCREF(item);
+      out[i] = new YOutput{item};
+    }
+  }
+  *len = (uint32_t)(n > 0 ? n : 0);
+  return out;
+}
+
+extern "C" YMapEntry **youtput_read_json_map(YOutput *val, uint32_t *len) {
+  if (len) *len = 0;
+  Gil gil;
+  if (!gil.ok || !val || !len || !PyDict_Check(val->obj)) return nullptr;
+  Py_ssize_t n = PyDict_Size(val->obj);
+  YMapEntry **out =
+      (YMapEntry **)calloc(n > 0 ? (size_t)n : 1, sizeof(YMapEntry *));
+  Py_ssize_t pos = 0, i = 0;
+  PyObject *key = nullptr, *value = nullptr;
+  while (out && PyDict_Next(val->obj, &pos, &key, &value) && i < n) {
+    const char *k = PyUnicode_Check(key) ? PyUnicode_AsUTF8(key) : nullptr;
+    Py_INCREF(value);
+    out[i] = new YMapEntry{dup_str(k ? k : ""), wrap_output(value)};
+    ++i;
+  }
+  *len = (uint32_t)(n > 0 ? n : 0);
+  return out;
+}
+
+extern "C" Branch *youtput_read_yweak(YOutput *val) {
+  Gil gil;
+  if (!gil.ok || !val) return nullptr;
+  PyObject *r = support_call("output_tag", "(O)", val->obj);
+  if (!r) return nullptr;
+  int8_t tag = (int8_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  if (tag != Y_WEAK_LINK) return nullptr;
+  Py_INCREF(val->obj);
+  return new Branch{val->obj};
+}
+
+/* ---- doc clear / subdocs -------------------------------------------------- */
+extern "C" void ydoc_clear(YDoc *doc, YTransaction *parent_txn) {
+  (void)parent_txn;
+  Gil gil;
+  if (!gil.ok || !doc) return;
+  PyObject *r = support_call("doc_clear", "(O)", doc->obj);
+  Py_XDECREF(r);
+}
+
+extern "C" YDoc **ytransaction_subdocs(YTransaction *txn, uint32_t *len) {
+  if (len) *len = 0;
+  Gil gil;
+  if (!gil.ok || !txn || !len) return nullptr;
+  PyObject *docs = support_call("txn_subdocs", "(O)", txn->obj);
+  if (!docs) return nullptr;
+  Py_ssize_t n = PySequence_Length(docs);
+  YDoc **out = (YDoc **)calloc(n > 0 ? (size_t)n : 1, sizeof(YDoc *));
+  for (Py_ssize_t i = 0; i < n && out; ++i) {
+    PyObject *d = PySequence_GetItem(docs, i); /* new ref */
+    out[i] = d ? new YDoc{d} : nullptr;
+  }
+  Py_DECREF(docs);
+  *len = (uint32_t)(n > 0 ? n : 0);
+  return out;
+}
+
+/* ---- pending introspection ------------------------------------------------ */
+extern "C" YPendingUpdate *ytransaction_pending_update(YTransaction *txn) {
+  Gil gil;
+  if (!gil.ok || !txn) return nullptr;
+  PyObject *r = support_call("txn_pending_update", "(O)", txn->obj);
+  if (!r) return nullptr;
+  if (r == Py_None) {
+    Py_DECREF(r);
+    return nullptr;
+  }
+  PyObject *missing = PyTuple_GetItem(r, 0); /* borrowed */
+  PyObject *update = PyTuple_GetItem(r, 1);  /* borrowed */
+  if (!missing || !update) {
+    Py_DECREF(r);
+    set_err_py();
+    return nullptr;
+  }
+  YPendingUpdate *out = new YPendingUpdate{};
+  Py_INCREF(missing);
+  out->missing = py_to_binary(missing);
+  Py_INCREF(update);
+  out->update_v1 = py_to_binary(update);
+  Py_DECREF(r);
+  return out;
+}
+
+extern "C" void ypending_update_destroy(YPendingUpdate *update) {
+  if (!update) return;
+  free(update->missing.data);
+  free(update->update_v1.data);
+  delete update;
+}
+
+extern "C" YDeleteSet *ytransaction_pending_ds(YTransaction *txn) {
+  Gil gil;
+  if (!gil.ok || !txn) return nullptr;
+  PyObject *r = support_call("txn_pending_ds", "(O)", txn->obj);
+  if (!r) return nullptr;
+  if (r == Py_None) {
+    Py_DECREF(r);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Length(r);
+  YDeleteSet *ds = new YDeleteSet{};
+  ds->entries_len = (uint32_t)(n > 0 ? n : 0);
+  ds->client_ids =
+      (uint64_t *)calloc(n > 0 ? (size_t)n : 1, sizeof(uint64_t));
+  ds->ranges =
+      (YIdRangeSeq *)calloc(n > 0 ? (size_t)n : 1, sizeof(YIdRangeSeq));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *entry = PySequence_GetItem(r, i); /* (client, [(s,l)...]) */
+    unsigned long long client = 0;
+    PyObject *ranges = nullptr;
+    if (entry && PyArg_ParseTuple(entry, "KO", &client, &ranges)) {
+      ds->client_ids[i] = client;
+      Py_ssize_t rn = PySequence_Length(ranges);
+      ds->ranges[i].len = (uint32_t)(rn > 0 ? rn : 0);
+      ds->ranges[i].seq =
+          (YIdRange *)calloc(rn > 0 ? (size_t)rn : 1, sizeof(YIdRange));
+      for (Py_ssize_t j = 0; j < rn; ++j) {
+        PyObject *pair = PySequence_GetItem(ranges, j);
+        unsigned start = 0, rlen = 0;
+        if (pair && PyArg_ParseTuple(pair, "II", &start, &rlen)) {
+          ds->ranges[i].seq[j].start = start;
+          ds->ranges[i].seq[j].len = rlen;
+        }
+        Py_XDECREF(pair);
+      }
+    }
+    Py_XDECREF(entry);
+  }
+  Py_DECREF(r);
+  return ds;
+}
+
+extern "C" void ydelete_set_destroy(YDeleteSet *ds) {
+  if (!ds) return;
+  for (uint32_t i = 0; i < ds->entries_len; ++i) free(ds->ranges[i].seq);
+  free(ds->ranges);
+  free(ds->client_ids);
+  delete ds;
+}
+
+/* ---- logical branch ids --------------------------------------------------- */
+extern "C" YBranchId ybranch_id(Branch *branch) {
+  YBranchId id{};
+  id.client_or_len = 0;
+  Gil gil;
+  if (!gil.ok || !branch) return id;
+  PyObject *r = support_call("branch_id", "(O)", branch->obj);
+  if (!r) return id;
+  int nested = 0;
+  if (PyTuple_Size(r) == 3) {
+    unsigned long long client = 0;
+    unsigned clock = 0;
+    if (PyArg_ParseTuple(r, "iKI", &nested, &client, &clock)) {
+      id.client_or_len = (int64_t)client;
+      id.variant.clock = clock;
+    }
+  } else {
+    PyObject *name = nullptr;
+    if (PyArg_ParseTuple(r, "iO", &nested, &name) && name != Py_None) {
+      const char *s = PyUnicode_AsUTF8(name);
+      if (s) {
+        id.client_or_len = -(int64_t)strlen(s);
+        id.variant.name = (const uint8_t *)dup_str(s);
+      }
+    }
+  }
+  Py_DECREF(r);
+  return id;
+}
+
+extern "C" Branch *ybranch_get(const YBranchId *branch_id,
+                               YTransaction *txn) {
+  Gil gil;
+  if (!gil.ok || !branch_id || !txn) return nullptr;
+  if (branch_id->client_or_len >= 0) {
+    return wrap_branch(support_call(
+        "branch_get", "(OiKIz)", txn->obj, 1,
+        (unsigned long long)branch_id->client_or_len,
+        (unsigned)branch_id->variant.clock, (const char *)nullptr));
+  }
+  size_t nlen = (size_t)(-branch_id->client_or_len);
+  std::string name((const char *)branch_id->variant.name, nlen);
+  return wrap_branch(support_call("branch_get", "(OiKIs)", txn->obj, 0, 0ULL,
+                                  0u, name.c_str()));
+}
+
+extern "C" Branch *ytype_get(YTransaction *txn, const char *name) {
+  Gil gil;
+  if (!gil.ok || !txn || !name) return nullptr;
+  return wrap_branch(support_call("type_get", "(Os)", txn->obj, name));
+}
+
+/* ---- weak links / quotations ---------------------------------------------- */
+static YWeak *quote_common(Branch *seq, YTransaction *txn, uint32_t start,
+                           uint32_t end, int8_t start_excl, int8_t end_excl) {
+  Gil gil;
+  if (!gil.ok || !seq || !txn) return nullptr;
+  PyObject *obj =
+      support_call("quote", "(OOIIii)", txn->obj, seq->obj, (unsigned)start,
+                   (unsigned)end, (int)start_excl, (int)end_excl);
+  if (!obj) return nullptr;
+  return new YWeak{obj};
+}
+
+extern "C" YWeak *ytext_quote(Branch *text, YTransaction *txn,
+                              uint32_t start_index, uint32_t end_index,
+                              int8_t start_exclusive, int8_t end_exclusive) {
+  return quote_common(text, txn, start_index, end_index, start_exclusive,
+                      end_exclusive);
+}
+
+extern "C" YWeak *yarray_quote(Branch *array, YTransaction *txn,
+                               uint32_t start_index, uint32_t end_index,
+                               int8_t start_exclusive, int8_t end_exclusive) {
+  return quote_common(array, txn, start_index, end_index, start_exclusive,
+                      end_exclusive);
+}
+
+extern "C" YWeak *ymap_link(Branch *map, YTransaction *txn, const char *key) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !map || !key) return nullptr;
+  PyObject *obj = support_call("map_link", "(Os)", map->obj, key);
+  if (!obj || obj == Py_None) {
+    Py_XDECREF(obj);
+    return nullptr;
+  }
+  return new YWeak{obj};
+}
+
+extern "C" void yweak_destroy(YWeak *weak) {
+  if (!weak) return;
+  Gil gil;
+  if (gil.ok) Py_DECREF(weak->obj);
+  delete weak;
+}
+
+extern "C" YOutput *yweak_deref(Branch *map_link, YTransaction *txn) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !map_link) return nullptr;
+  return wrap_output(support_call("weak_deref", "(O)", map_link->obj));
+}
+
+extern "C" YWeakIter *yweak_iter(Branch *array_link, YTransaction *txn) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !array_link) return nullptr;
+  PyObject *values = support_call("weak_unquote", "(O)", array_link->obj);
+  if (!values) return nullptr;
+  PyObject *it = PyObject_GetIter(values);
+  Py_DECREF(values);
+  if (!it) {
+    set_err_py();
+    return nullptr;
+  }
+  return new YWeakIter{it};
+}
+
+extern "C" YOutput *yweak_iter_next(YWeakIter *iter) {
+  Gil gil;
+  if (!gil.ok || !iter) return nullptr;
+  PyObject *v = PyIter_Next(iter->iter);
+  if (!v) {
+    if (PyErr_Occurred()) set_err_py();
+    return nullptr;
+  }
+  return wrap_output(v);
+}
+
+extern "C" void yweak_iter_destroy(YWeakIter *iter) {
+  if (!iter) return;
+  Gil gil;
+  if (gil.ok) Py_DECREF(iter->iter);
+  delete iter;
+}
+
+extern "C" char *yweak_string(Branch *text_link, YTransaction *txn) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !text_link) return nullptr;
+  return py_to_cstr(support_call("weak_string", "(O)", text_link->obj));
+}
+
+extern "C" char *yweak_xml_string(Branch *xml_text_link, YTransaction *txn) {
+  (void)txn;
+  Gil gil;
+  if (!gil.ok || !xml_text_link) return nullptr;
+  return py_to_cstr(
+      support_call("weak_xml_string", "(O)", xml_text_link->obj));
+}
+
+/* ---- text chunks ----------------------------------------------------------- */
+extern "C" YChunk *ytext_chunks(Branch *txt, YTransaction *txn,
+                                uint32_t *chunks_len) {
+  (void)txn;
+  if (chunks_len) *chunks_len = 0;
+  Gil gil;
+  if (!gil.ok || !txt || !chunks_len) return nullptr;
+  PyObject *rows = support_call("text_chunks", "(O)", txt->obj);
+  if (!rows) return nullptr;
+  Py_ssize_t n = PySequence_Length(rows);
+  YChunk *out = (YChunk *)calloc(n > 0 ? (size_t)n : 1, sizeof(YChunk));
+  for (Py_ssize_t i = 0; i < n && out; ++i) {
+    PyObject *row = PySequence_GetItem(rows, i); /* (value, attrs_items) */
+    PyObject *value = nullptr, *attrs = nullptr;
+    if (row && PyArg_ParseTuple(row, "OO", &value, &attrs)) {
+      Py_INCREF(value);
+      out[i].data = wrap_output(value);
+      Py_ssize_t an = PySequence_Length(attrs);
+      out[i].fmt_len = (uint32_t)(an > 0 ? an : 0);
+      out[i].fmt =
+          (YMapEntry *)calloc(an > 0 ? (size_t)an : 1, sizeof(YMapEntry));
+      for (Py_ssize_t a = 0; a < an && out[i].fmt; ++a) {
+        PyObject *pair = PySequence_GetItem(attrs, a);
+        const char *k = nullptr;
+        PyObject *v = nullptr;
+        if (pair && PyArg_ParseTuple(pair, "sO", &k, &v)) {
+          out[i].fmt[a].key = dup_str(k);
+          Py_INCREF(v);
+          out[i].fmt[a].value = wrap_output(v);
+        }
+        Py_XDECREF(pair);
+      }
+    }
+    Py_XDECREF(row);
+  }
+  Py_DECREF(rows);
+  *chunks_len = (uint32_t)(n > 0 ? n : 0);
+  return out;
+}
+
+extern "C" void ychunks_destroy(YChunk *chunks, uint32_t len) {
+  if (!chunks) return;
+  for (uint32_t i = 0; i < len; ++i) {
+    if (chunks[i].data) youtput_destroy(chunks[i].data);
+    for (uint32_t a = 0; a < chunks[i].fmt_len; ++a) {
+      free(chunks[i].fmt[a].key);
+      if (chunks[i].fmt[a].value) youtput_destroy(chunks[i].fmt[a].value);
+    }
+    free(chunks[i].fmt);
+  }
+  free(chunks);
+}
+
+/* ---- xml attribute iteration / tree ---------------------------------------- */
+static YXmlAttrIter *attr_iter_common(Branch *xml) {
+  Gil gil;
+  if (!gil.ok || !xml) return nullptr;
+  PyObject *pairs = support_call("xml_attrs", "(O)", xml->obj);
+  if (!pairs) return nullptr;
+  PyObject *it = PyObject_GetIter(pairs);
+  Py_DECREF(pairs);
+  if (!it) {
+    set_err_py();
+    return nullptr;
+  }
+  return new YXmlAttrIter{it};
+}
+
+extern "C" YXmlAttrIter *yxmlelem_attr_iter(Branch *xml, YTransaction *txn) {
+  (void)txn;
+  return attr_iter_common(xml);
+}
+
+extern "C" YXmlAttrIter *yxmltext_attr_iter(Branch *xml, YTransaction *txn) {
+  (void)txn;
+  return attr_iter_common(xml);
+}
+
+extern "C" YXmlAttr *yxmlattr_iter_next(YXmlAttrIter *iterator) {
+  Gil gil;
+  if (!gil.ok || !iterator) return nullptr;
+  PyObject *pair = PyIter_Next(iterator->iter);
+  if (!pair) {
+    if (PyErr_Occurred()) set_err_py();
+    return nullptr;
+  }
+  const char *name = nullptr, *value = nullptr;
+  YXmlAttr *attr = nullptr;
+  if (PyArg_ParseTuple(pair, "ss", &name, &value)) {
+    attr = new YXmlAttr{dup_str(name), dup_str(value)};
+  } else {
+    set_err_py();
+  }
+  Py_DECREF(pair);
+  return attr;
+}
+
+extern "C" void yxmlattr_destroy(YXmlAttr *attr) {
+  if (!attr) return;
+  free(attr->name);
+  free(attr->value);
+  delete attr;
+}
+
+extern "C" void yxmlattr_iter_destroy(YXmlAttrIter *iterator) {
+  if (!iterator) return;
+  Gil gil;
+  if (gil.ok) Py_DECREF(iterator->iter);
+  delete iterator;
+}
+
+extern "C" Branch *yxmlelem_parent(Branch *xml) {
+  Gil gil;
+  if (!gil.ok || !xml) return nullptr;
+  return wrap_branch(support_call("xml_parent", "(O)", xml->obj));
+}
+
+extern "C" void yxmltext_remove_attr(Branch *xml, YTransaction *txn,
+                                     const char *attr_name) {
+  yxmlelem_remove_attr(xml, txn, attr_name);
+}
+
+extern "C" void yxmltext_insert_embed(Branch *xml, YTransaction *txn,
+                                      uint32_t index, const YInput *content,
+                                      const char *attrs_json) {
+  ytext_insert_embed(xml, txn, index, content, attrs_json);
 }

@@ -66,6 +66,18 @@ typedef struct YXmlTreeWalker YXmlTreeWalker;
 #define Y_ASSOC_BEFORE (-1)
 #define Y_ASSOC_AFTER 0
 
+/* ---- event tags (libyrs.h: Y_KIND_* / Y_EVENT_*) ------------------------ */
+#define Y_KIND_UNDO 0
+#define Y_KIND_REDO 1
+#define Y_EVENT_PATH_KEY 1
+#define Y_EVENT_PATH_INDEX 2
+#define Y_EVENT_CHANGE_ADD 1
+#define Y_EVENT_CHANGE_DELETE 2
+#define Y_EVENT_CHANGE_RETAIN 3
+#define Y_EVENT_KEY_CHANGE_ADD 4
+#define Y_EVENT_KEY_CHANGE_DELETE 5
+#define Y_EVENT_KEY_CHANGE_UPDATE 6
+
 /* ---- plain data -------------------------------------------------------- */
 typedef struct YOptions {
   uint64_t id;               /* 0 = random client id */
@@ -92,7 +104,9 @@ typedef struct YInput {
     struct {
       const uint8_t *data;
       uint64_t len;
-    } buf; /* Y_JSON_BUF */
+    } buf;                  /* Y_JSON_BUF */
+    struct YDoc *doc;       /* Y_DOC (nested subdocument) */
+    const struct YWeak *weak; /* Y_WEAK_LINK (from ytext_quote/ymap_link) */
   } value;
 } YInput;
 
@@ -100,6 +114,133 @@ typedef struct YMapEntry {
   char *key;      /* released with the entry */
   YOutput *value; /* released with the entry */
 } YMapEntry;
+
+/* ---- events (yffi: YEvent family) ----------------------------------------
+ * An event handle is valid ONLY for the duration of the observer callback
+ * (same contract as yffi). All typed event aliases share one opaque struct;
+ * accessors check nothing — calling a map accessor on a text event simply
+ * yields an empty result. */
+typedef struct YEvent YEvent;
+typedef YEvent YTextEvent;
+typedef YEvent YArrayEvent;
+typedef YEvent YMapEvent;
+typedef YEvent YXmlEvent;
+typedef YEvent YXmlTextEvent;
+typedef YEvent YWeakLinkEvent;
+
+typedef struct YPathSegment {
+  char tag; /* Y_EVENT_PATH_KEY | Y_EVENT_PATH_INDEX */
+  union {
+    char *key;      /* owned by the segment array */
+    uint32_t index;
+  } value;
+} YPathSegment;
+
+/* Sequence change (yffi YEventChange). Unlike libyrs.h, `values` is an
+ * array of YOutput handles (our YOutput is opaque), released with the
+ * delta. */
+typedef struct YEventChange {
+  char tag; /* Y_EVENT_CHANGE_* */
+  uint32_t len;
+  YOutput **values; /* ADD only; len entries */
+} YEventChange;
+
+/* Text delta (yffi YDelta). `insert` is a single YOutput (string run or
+ * one embed); attribute values ride as JSON strings. */
+typedef struct YDeltaAttr {
+  char *key;
+  char *value_json;
+} YDeltaAttr;
+
+typedef struct YDelta {
+  char tag; /* Y_EVENT_CHANGE_* */
+  uint32_t len;
+  YOutput *insert; /* ADD only */
+  uint32_t attributes_len;
+  YDeltaAttr *attributes;
+} YDelta;
+
+/* Map / attribute change (yffi YEventKeyChange). */
+typedef struct YEventKeyChange {
+  char *key;
+  char tag; /* Y_EVENT_KEY_CHANGE_* */
+  YOutput *old_value; /* NULL for ADD */
+  YOutput *new_value; /* NULL for DELETE */
+} YEventKeyChange;
+
+/* ---- weak links (yffi: Weak / YWeakIter) -------------------------------- */
+typedef struct YWeak YWeak; /* a prelim link, input for yinput_weak */
+typedef struct YWeakIter YWeakIter;
+
+/* ---- xml attributes (yffi: YXmlAttr / YXmlAttrIter) --------------------- */
+typedef struct YXmlAttr {
+  char *name;
+  char *value;
+} YXmlAttr;
+typedef struct YXmlAttrIter YXmlAttrIter;
+
+/* ---- text chunks (yffi: YChunk) ----------------------------------------- */
+typedef struct YChunk {
+  YOutput *data; /* string run, embed or nested type */
+  uint32_t fmt_len;
+  YMapEntry *fmt; /* formatting attributes */
+} YChunk;
+
+/* ---- delete sets / pending updates (yffi shapes) ------------------------ */
+typedef struct YIdRange {
+  uint32_t start;
+  uint32_t len;
+} YIdRange;
+
+typedef struct YIdRangeSeq {
+  uint32_t len;
+  YIdRange *seq;
+} YIdRangeSeq;
+
+typedef struct YDeleteSet {
+  uint32_t entries_len;
+  uint64_t *client_ids;
+  YIdRangeSeq *ranges;
+} YDeleteSet;
+
+/* Unapplied (stashed) update data. `missing` is a lib0-v1 state vector
+ * describing the clocks the stash is waiting for (yffi YPendingUpdate,
+ * which carries the same two payloads). */
+typedef struct YPendingUpdate {
+  YBinary missing;
+  YBinary update_v1;
+} YPendingUpdate;
+
+/* ---- subdocs event (yffi YSubdocsEvent) --------------------------------- */
+typedef struct YSubdocsEvent {
+  uint32_t added_len;
+  uint32_t removed_len;
+  uint32_t loaded_len;
+  YDoc **added;   /* handles valid only during the callback */
+  YDoc **removed;
+  YDoc **loaded;
+} YSubdocsEvent;
+
+/* ---- undo event (yffi YUndoEvent) --------------------------------------- */
+typedef struct YUndoEvent {
+  char kind; /* Y_KIND_UNDO | Y_KIND_REDO */
+  const char *origin; /* valid during callback */
+  uint32_t origin_len;
+  /* Round-trips between observe_added and observe_popped callbacks for the
+   * same stack item; starts NULL, user-managed (yffi contract). */
+  void *meta;
+} YUndoEvent;
+
+/* ---- logical branch id (yffi YBranchId) --------------------------------- */
+typedef struct YBranchId {
+  /* >= 0: nested type, value is the client id (use .clock);
+   * < 0: root type, -value is the name length (use .name). */
+  int64_t client_or_len;
+  union {
+    uint32_t clock;
+    const uint8_t *name; /* NOT nul-terminated; length = -client_or_len */
+  } variant;
+} YBranchId;
 
 /* ---- runtime / errors --------------------------------------------------- */
 /* Last error message for this thread, or NULL. Owned by the library. */
@@ -285,6 +426,154 @@ YSubscription *ydoc_observe_updates_v2(YDoc *doc, void *state,
 YSubscription *ydoc_observe_after_transaction(YDoc *doc, void *state,
                                               ytpu_observe_cb cb);
 void yunobserve(YSubscription *subscription);
+
+/* ---- default options (yffi: yoptions) ----------------------------------- */
+YOptions yoptions(void);
+
+/* ---- YInput constructors (yffi: yinput_*) --------------------------------
+ * Pure struct builders; no allocation, no ownership taken (yffi contract).
+ * JSON arrays/maps and prelim initializers take JSON strings — the header's
+ * documented flat-YInput simplification. */
+YInput yinput_null(void);
+YInput yinput_undefined(void);
+YInput yinput_bool(uint8_t flag);
+YInput yinput_float(double num);
+YInput yinput_long(int64_t integer);
+YInput yinput_string(const char *str);
+YInput yinput_binary(const uint8_t *buf, uint32_t len);
+YInput yinput_json_array(const char *json);
+YInput yinput_json_map(const char *json);
+YInput yinput_ytext(const char *init);
+YInput yinput_yarray(const char *init_json);
+YInput yinput_ymap(const char *init_json);
+YInput yinput_yxmlelem(const char *name);
+YInput yinput_yxmltext(const char *init);
+YInput yinput_ydoc(YDoc *doc);
+YInput yinput_weak(const YWeak *weak);
+
+/* ---- YOutput collection readers ------------------------------------------
+ * For a Y_JSON_ARR output: array of new YOutput handles (each released with
+ * youtput_destroy; the array itself with free()). For a Y_JSON_MAP output:
+ * array of YMapEntry pointers (each released with ymap_entry_destroy; the
+ * array with free()). */
+YOutput **youtput_read_json_array(YOutput *val, uint32_t *len);
+YMapEntry **youtput_read_json_map(YOutput *val, uint32_t *len);
+Branch *youtput_read_yweak(YOutput *val);
+
+/* ---- doc clear / subdocs (yffi: ydoc_clear / ytransaction_subdocs) ------- */
+/* Destroys the document's observer state, firing clear observers. The txn
+ * parameter mirrors yffi's shape and may be NULL. */
+void ydoc_clear(YDoc *doc, YTransaction *parent_txn);
+YSubscription *ydoc_observe_clear(YDoc *doc, void *state,
+                                  void (*cb)(void *, YDoc *));
+YSubscription *ydoc_observe_subdocs(YDoc *doc, void *state,
+                                    void (*cb)(void *,
+                                               const YSubdocsEvent *));
+/* Array of subdoc handles; each must be ydoc_destroy'd, array free()'d. */
+YDoc **ytransaction_subdocs(YTransaction *txn, uint32_t *len);
+
+/* ---- pending introspection (yffi: ytransaction_pending_*) ---------------- */
+YPendingUpdate *ytransaction_pending_update(YTransaction *txn);
+void ypending_update_destroy(YPendingUpdate *update);
+YDeleteSet *ytransaction_pending_ds(YTransaction *txn);
+void ydelete_set_destroy(YDeleteSet *ds);
+
+/* ---- logical branch ids (yffi: ybranch_id / ybranch_get / ytype_get) -----
+ * For root types, id.variant.name is an owned nul-terminated copy —
+ * release with ystring_destroy((char *)id.variant.name). */
+YBranchId ybranch_id(Branch *branch);
+Branch *ybranch_get(const YBranchId *branch_id, YTransaction *txn);
+/* Root-type lookup WITHOUT creating; NULL if the name was never defined. */
+Branch *ytype_get(YTransaction *txn, const char *name);
+
+/* ---- per-type event observers (yffi: y*_observe / yobserve_deep) --------- */
+YSubscription *ytext_observe(Branch *txt, void *state,
+                             void (*cb)(void *, const YTextEvent *));
+YSubscription *yarray_observe(Branch *array, void *state,
+                              void (*cb)(void *, const YArrayEvent *));
+YSubscription *ymap_observe(Branch *map, void *state,
+                            void (*cb)(void *, const YMapEvent *));
+YSubscription *yxmlelem_observe(Branch *xml, void *state,
+                                void (*cb)(void *, const YXmlEvent *));
+YSubscription *yxmltext_observe(Branch *xml, void *state,
+                                void (*cb)(void *, const YXmlTextEvent *));
+YSubscription *yweak_observe(Branch *weak, void *state,
+                             void (*cb)(void *, const YWeakLinkEvent *));
+/* Deep observer: events arrive as an array of YEvent pointers (libyrs.h
+ * passes YEvent structs by value; ours are opaque, hence the indirection). */
+YSubscription *yobserve_deep(Branch *ytype, void *state,
+                             void (*cb)(void *, uint32_t,
+                                        const YEvent *const *));
+/* Which shared type emitted this event: Y_TEXT/Y_ARRAY/Y_MAP/Y_XML_*. */
+int8_t yevent_kind(const YEvent *e);
+
+/* ---- event accessors (valid only inside the observer callback) ----------- */
+Branch *ytext_event_target(const YTextEvent *e);
+Branch *yarray_event_target(const YArrayEvent *e);
+Branch *ymap_event_target(const YMapEvent *e);
+Branch *yxmlelem_event_target(const YXmlEvent *e);
+Branch *yxmltext_event_target(const YXmlTextEvent *e);
+
+YPathSegment *ytext_event_path(const YTextEvent *e, uint32_t *len);
+YPathSegment *yarray_event_path(const YArrayEvent *e, uint32_t *len);
+YPathSegment *ymap_event_path(const YMapEvent *e, uint32_t *len);
+YPathSegment *yxmlelem_event_path(const YXmlEvent *e, uint32_t *len);
+YPathSegment *yxmltext_event_path(const YXmlTextEvent *e, uint32_t *len);
+void ypath_destroy(YPathSegment *path, uint32_t len);
+
+YDelta *ytext_event_delta(const YTextEvent *e, uint32_t *len);
+YDelta *yxmltext_event_delta(const YXmlTextEvent *e, uint32_t *len);
+void ytext_delta_destroy(YDelta *delta, uint32_t len);
+
+YEventChange *yarray_event_delta(const YArrayEvent *e, uint32_t *len);
+YEventChange *yxmlelem_event_delta(const YXmlEvent *e, uint32_t *len);
+void yevent_delta_destroy(YEventChange *delta, uint32_t len);
+
+YEventKeyChange *ymap_event_keys(const YMapEvent *e, uint32_t *len);
+YEventKeyChange *yxmlelem_event_keys(const YXmlEvent *e, uint32_t *len);
+YEventKeyChange *yxmltext_event_keys(const YXmlTextEvent *e, uint32_t *len);
+void yevent_keys_destroy(YEventKeyChange *keys, uint32_t len);
+
+/* ---- weak links / quotations (yffi: y*_quote / ymap_link / yweak_*) ------ */
+YWeak *ytext_quote(Branch *text, YTransaction *txn, uint32_t start_index,
+                   uint32_t end_index, int8_t start_exclusive,
+                   int8_t end_exclusive);
+YWeak *yarray_quote(Branch *array, YTransaction *txn, uint32_t start_index,
+                    uint32_t end_index, int8_t start_exclusive,
+                    int8_t end_exclusive);
+YWeak *ymap_link(Branch *map, YTransaction *txn, const char *key);
+void yweak_destroy(YWeak *weak);
+YOutput *yweak_deref(Branch *map_link, YTransaction *txn);
+YWeakIter *yweak_iter(Branch *array_link, YTransaction *txn);
+YOutput *yweak_iter_next(YWeakIter *iter); /* NULL at end */
+void yweak_iter_destroy(YWeakIter *iter);
+char *yweak_string(Branch *text_link, YTransaction *txn);
+char *yweak_xml_string(Branch *xml_text_link, YTransaction *txn);
+
+/* ---- text chunks (yffi: ytext_chunks) ------------------------------------ */
+YChunk *ytext_chunks(Branch *txt, YTransaction *txn, uint32_t *chunks_len);
+void ychunks_destroy(YChunk *chunks, uint32_t len);
+
+/* ---- xml attribute iteration / tree (yffi: yxml*_attr_iter &c.) ---------- */
+YXmlAttrIter *yxmlelem_attr_iter(Branch *xml, YTransaction *txn);
+YXmlAttrIter *yxmltext_attr_iter(Branch *xml, YTransaction *txn);
+YXmlAttr *yxmlattr_iter_next(YXmlAttrIter *iterator); /* NULL at end */
+void yxmlattr_destroy(YXmlAttr *attr);
+void yxmlattr_iter_destroy(YXmlAttrIter *iterator);
+Branch *yxmlelem_parent(Branch *xml); /* NULL at root fragment */
+void yxmltext_remove_attr(Branch *xml, YTransaction *txn,
+                          const char *attr_name);
+void yxmltext_insert_embed(Branch *xml, YTransaction *txn, uint32_t index,
+                           const YInput *content, const char *attrs_json);
+
+/* ---- undo observers (yffi: yundo_manager_observe_*) ----------------------
+ * The event's `meta` pointer round-trips between added/popped callbacks of
+ * the same stack item (yffi contract): write it in one callback, read it in
+ * the other. */
+YSubscription *yundo_manager_observe_added(YUndoManager *mgr, void *state,
+                                           void (*cb)(void *, YUndoEvent *));
+YSubscription *yundo_manager_observe_popped(YUndoManager *mgr, void *state,
+                                            void (*cb)(void *, YUndoEvent *));
 
 #ifdef __cplusplus
 } /* extern "C" */

@@ -203,6 +203,10 @@ def input_to_value(tag: int, payload: Any) -> Any:
         return MapPrelim(json.loads(payload) if payload else {})
     if tag == Y_XML_ELEM:
         return XmlElementPrelim(payload or "UNDEFINED")
+    if tag == Y_DOC:
+        return payload  # a Doc instance → ContentDoc on insertion
+    if tag == Y_WEAK_LINK:
+        return payload  # a WeakPrelim from quote()/map_link()
     raise ValueError(f"unsupported YInput tag {tag}")
 
 
@@ -356,3 +360,290 @@ def observe(doc: Doc, kind: int, cb) -> Any:
     if kind == 2:
         return doc.observe_after_transaction(lambda txn: cb(b""))
     raise ValueError(f"unsupported observer kind {kind}")
+
+
+def observe_clear(doc: Doc, cb) -> Any:
+    """yffi ydoc_observe_clear: fired when the doc is destroyed."""
+    return doc.observe_destroy(lambda d: cb(d))
+
+
+def observe_subdocs(doc: Doc, cb) -> Any:
+    """yffi ydoc_observe_subdocs: cb(added_docs, removed_docs, loaded_docs)."""
+
+    def fire(txn, added, removed, loaded):
+        cb(list(added.values()), list(removed.values()), list(loaded.values()))
+
+    return doc.observe_subdocs(fire)
+
+
+def doc_clear(doc: Doc) -> None:
+    doc.destroy()
+
+
+# --- branch handles / logical ids (yffi: ybranch_id / ybranch_get) ----------
+
+def shared_from_branch(branch) -> SharedType:
+    from ytpu.types import wrap_branch
+
+    return wrap_branch(branch)
+
+
+def type_get(txn, name: str) -> Optional[SharedType]:
+    """Root type lookup WITHOUT creating (yffi ytype_get, lib.rs ytype_get)."""
+    branch = txn.doc.store.types.get(name)
+    return shared_from_branch(branch) if branch is not None else None
+
+
+def branch_id(shared: SharedType):
+    """(1, client, clock) for nested branches; (0, root_name) for roots
+    (parity: branch.rs BranchID :926)."""
+    branch = shared.branch
+    if branch.item is not None:
+        return (1, branch.item.id.client, branch.item.id.clock)
+    store = branch.store
+    name = branch.type_name if branch.type_name else None
+    if store is not None:
+        for root_name, root in store.types.items():
+            if root is branch:
+                name = root_name
+                break
+    return (0, name)
+
+
+def branch_get(txn, nested: int, client: int, clock: int, name: Optional[str]):
+    store = txn.doc.store
+    if nested:
+        item = store.blocks.get_item(ID(client, clock))
+        if item is None:
+            return None
+        from ytpu.core.content import ContentType
+
+        if not isinstance(item.content, ContentType):
+            return None
+        return shared_from_branch(item.content.branch)
+    branch = store.types.get(name) if name is not None else None
+    return shared_from_branch(branch) if branch is not None else None
+
+
+# --- pending introspection (yffi: ytransaction_pending_update/_ds) ----------
+
+def txn_pending_update(txn):
+    """(missing_sv_v1, update_v1) or None (parity: store.rs:42-50)."""
+    pending = txn.doc.store.pending
+    if pending is None:
+        return None
+    return (pending.missing.encode_v1(), pending.update.encode_v1())
+
+
+def txn_pending_ds(txn):
+    """[(client, [(start, len), ...]), ...] or None."""
+    ds = txn.doc.store.pending_ds
+    if ds is None or not ds.clients:
+        return None
+    out = []
+    for client in sorted(ds.clients, reverse=True):
+        ranges = [(r.start, r.end - r.start) for r in ds.clients[client]]
+        out.append((client, ranges))
+    return out
+
+
+# --- subdocuments ------------------------------------------------------------
+
+def txn_subdocs(txn) -> list:
+    return list(txn.doc.store.subdocs.values())
+
+
+# --- per-type event observers (yffi: ytext_observe & co.) --------------------
+
+def observe_type(shared: SharedType, fn) -> Any:
+    """fn receives the engine Event; valid only during the callback."""
+    return shared.observe(lambda txn, event: fn(event))
+
+
+def observe_deep_type(shared: SharedType, fn) -> Any:
+    """fn receives the list of bubbled Events (yffi yobserve_deep)."""
+    return shared.observe_deep(lambda txn, events: fn(list(events)))
+
+
+def event_target(event) -> SharedType:
+    return shared_from_branch(event.target)
+
+
+def event_kind(event) -> int:
+    return output_tag(shared_from_branch(event.target))
+
+
+def event_path(event) -> list:
+    return event.path()
+
+
+def event_delta_seq(event) -> list:
+    """Sequence delta as (tag, len, values|None) rows; tags mirror
+    Y_EVENT_CHANGE_ADD/DELETE/RETAIN = 1/2/3 (yffi YEventChange)."""
+    rows = []
+    for ch in event.delta():
+        if ch.kind == "insert":
+            rows.append((1, ch.len, list(ch.values or [])))
+        elif ch.kind == "delete":
+            rows.append((2, ch.len, None))
+        else:
+            rows.append((3, ch.len, None))
+    return rows
+
+
+def event_delta_text(event) -> list:
+    """Text delta as (tag, len, insert|None, attrs_items|None) rows; string
+    runs are joined; an embed/branch insert stays a single value
+    (yffi YDelta; parity: types/text.rs:1213-1305)."""
+    rows = []
+    for ch in event.delta():
+        attrs = list(ch.attributes.items()) if ch.attributes else None
+        if ch.kind == "insert":
+            # group consecutive string values into one run; embeds/branches
+            # stay single-value rows (yffi YDelta: one string run OR one embed)
+            run: list = []
+            for v in ch.values or []:
+                if isinstance(v, str):
+                    run.append(v)
+                    continue
+                if run:
+                    text = "".join(run)
+                    rows.append((1, len(text), text, attrs))
+                    run = []
+                rows.append((1, 1, v, attrs))
+            if run:
+                text = "".join(run)
+                rows.append((1, len(text), text, attrs))
+        elif ch.kind == "delete":
+            rows.append((2, ch.len, None, None))
+        else:
+            rows.append((3, ch.len, None, attrs))
+    return rows
+
+
+def event_keys(event) -> list:
+    """Map/attribute delta as (key, tag, old, new) rows; tags mirror
+    Y_EVENT_KEY_CHANGE_ADD/DELETE/UPDATE = 4/5/6 (yffi YEventKeyChange)."""
+    tag_of = {"add": 4, "remove": 5, "update": 6}
+    rows = []
+    for key, change in event.keys().items():
+        rows.append((key, tag_of[change.action], change.old_value, change.new_value))
+    return rows
+
+
+# --- weak links / quotations (yffi: ytext_quote / yarray_quote / ymap_link) --
+
+def quote(txn, shared: SharedType, start: int, end: int,
+          start_exclusive: int, end_exclusive: int):
+    """Quote [start..end] (inclusive bounds, yffi shape) as a weak prelim."""
+    from ytpu.types.weak import quote_range
+
+    lo = start + (1 if start_exclusive else 0)
+    hi = end - (1 if end_exclusive else 0)
+    return quote_range(shared, txn, lo, hi - lo + 1)
+
+
+def map_link(m, key: str):
+    from ytpu.types.weak import map_link as _map_link
+
+    return _map_link(m, key)
+
+
+def weak_deref(weak: SharedType):
+    return weak.try_deref()
+
+
+def weak_unquote(weak: SharedType) -> list:
+    return weak.unquote()
+
+
+def weak_string(weak: SharedType) -> str:
+    return "".join(v for v in weak.unquote() if isinstance(v, str))
+
+
+def weak_xml_string(weak: SharedType) -> str:
+    """Quoted range rendered with formatting markup, the same XML-ish tag
+    scheme as XmlText::get_string (yffi yweak_xml_string)."""
+    from ytpu.core.content import ContentFormat, ContentString
+
+    store = weak.branch.store
+    src = weak.source
+    if store is None or src is None or src.quote_start.id is None:
+        return ""
+    item = store.blocks.get_item(src.quote_start.id)
+    end_id = src.quote_end.id
+    out, open_tags = [], []
+    while item is not None:
+        if not item.deleted:
+            content = item.content
+            if isinstance(content, ContentString):
+                out.append(content.text)
+            elif isinstance(content, ContentFormat):
+                if content.value is None:
+                    if content.key in open_tags:
+                        open_tags.remove(content.key)
+                        out.append(f"</{content.key}>")
+                else:
+                    open_tags.append(content.key)
+                    out.append(f"<{content.key}>")
+        if end_id is not None and (
+            item.contains(end_id)
+            or (item.id.client == end_id.client and item.id.clock >= end_id.clock)
+        ):
+            break
+        item = item.right
+    for tag in reversed(open_tags):
+        out.append(f"</{tag}>")
+    return "".join(out)
+
+
+# --- text chunks (yffi: ytext_chunks) ----------------------------------------
+
+def text_chunks(text) -> list:
+    """[(value, attrs_items), ...] — formatted runs (yffi YChunk)."""
+    return [
+        (d.insert, list(d.attributes.items()) if d.attributes else [])
+        for d in text.diff()
+    ]
+
+
+# --- xml helpers -------------------------------------------------------------
+
+def xml_parent(x):
+    node = x.parent()
+    return node if node is not None else None
+
+
+# --- undo observers (yffi: yundo_manager_observe_added/_popped) --------------
+
+def undo_observe(mgr: UndoManager, which: int, fn) -> Any:
+    """which: 0=added 1=popped. fn(kind_int, origin_bytes_or_None, stack_item);
+    kind mirrors Y_KIND_UNDO=0 / Y_KIND_REDO=1."""
+    if which == 0:
+
+        def on_added(txn, item, kind):
+            origin = txn.origin
+            if origin is not None and not isinstance(origin, (bytes, bytearray)):
+                origin = str(origin).encode()
+            # Parity: undo.rs:229-233 — the added-event kind is Undo only
+            # when captured DURING an undo (item lands on the redo stack);
+            # a normal edit fires Redo. `kind` here names the target stack.
+            fn(1 if kind == "undo" else 0, origin, item)
+
+        mgr.on_added_subs.append(on_added)
+        return lambda: mgr.on_added_subs.remove(on_added)
+
+    def on_popped(item, kind):
+        fn(0 if kind == "undo" else 1, None, item)
+
+    mgr.on_popped_subs.append(on_popped)
+    return lambda: mgr.on_popped_subs.remove(on_popped)
+
+
+def undo_item_meta(item) -> int:
+    meta = getattr(item, "meta", None)
+    return int(meta) if isinstance(meta, int) else 0
+
+
+def undo_item_set_meta(item, ptr: int) -> None:
+    item.meta = ptr if ptr else None
