@@ -12,9 +12,11 @@ from .batch_doc import (
     BlockCols,
     ClientInterner,
     DocStateBatch,
+    KeyInterner,
     PayloadStore,
     UpdateBatch,
     apply_update_batch,
+    get_map,
     get_string,
     get_values,
     init_state,
@@ -29,9 +31,11 @@ __all__ = [
     "BlockCols",
     "ClientInterner",
     "DocStateBatch",
+    "KeyInterner",
     "PayloadStore",
     "UpdateBatch",
     "apply_update_batch",
+    "get_map",
     "get_string",
     "get_values",
     "init_state",

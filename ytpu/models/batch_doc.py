@@ -17,10 +17,12 @@ This is the TPU-native re-design of the reference's per-doc mutable store
   payloads stay in host side-buffers addressed by (content_ref, offset, len)
   columns — the device never touches variable-length data.
 
-Round-1 device scope: the root sequence component (YText/YArray flagship
-configs). Map/XML branch tables ride the host oracle until the multi-branch
-device engine lands; semantic parity is enforced against `ytpu.core` in
-tests/test_batch_parity.py.
+Device scope: the root branch's sequence component (YText/YArray flagship
+configs) AND its map component (YMap / XML-attribute shape) — map rows are
+per-key chains with LWW tails keyed by an interned `parent_sub` column.
+Nested branch trees (full XML hierarchies) ride the host oracle until the
+multi-branch device engine lands; semantic parity is enforced against
+`ytpu.core` in tests/test_batch_device.py and tests/test_batch_map.py.
 """
 
 from __future__ import annotations
@@ -49,9 +51,11 @@ __all__ = [
     "init_state",
     "apply_update_batch",
     "ClientInterner",
+    "KeyInterner",
     "PayloadStore",
     "BatchEncoder",
     "get_string",
+    "get_map",
     "state_vectors",
 ]
 
@@ -75,6 +79,7 @@ class BlockCols(NamedTuple):
     kind: jax.Array  # [*, B] i32 content kind
     content_ref: jax.Array  # [*, B] i32 host payload id
     content_off: jax.Array  # [*, B] i32 offset into payload (clock units)
+    key: jax.Array  # [*, B] i32 interned parent_sub (-1 = sequence item)
 
 
 class DocStateBatch(NamedTuple):
@@ -97,6 +102,7 @@ class UpdateBatch(NamedTuple):
     kind: jax.Array  # [*, U] i32 (BLOCK_GC for GC carriers)
     content_ref: jax.Array  # [*, U] i32
     content_off: jax.Array  # [*, U] i32
+    key: jax.Array  # [*, U] i32 interned parent_sub (-1 = sequence row)
     valid: jax.Array  # [*, U] bool
     del_client: jax.Array  # [*, R] i32
     del_start: jax.Array  # [*, R] i32
@@ -130,6 +136,7 @@ def init_state(n_docs: int, capacity: int) -> DocStateBatch:
         kind=full(shape, 0),
         content_ref=full(shape, -1),
         content_off=full(shape, 0),
+        key=full(shape, -1),
     )
     return DocStateBatch(
         blocks=blocks,
@@ -213,6 +220,7 @@ def _split(state: DocStateBatch, i: jax.Array, off: jax.Array):
         kind=_set(bl.kind, wj, bl.kind[safe_i]),
         content_ref=_set(bl.content_ref, wj, bl.content_ref[safe_i]),
         content_off=_set(bl.content_off, wj, bl.content_off[safe_i] + off),
+        key=_set(bl.key, wj, bl.key[safe_i]),
     )
     state = DocStateBatch(
         blocks=new_bl,
@@ -264,6 +272,7 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array) -> DocStat
         r_kind,
         r_ref,
         r_off,
+        r_key,
         r_valid,
     ) = row
     bl = state.blocks
@@ -302,8 +311,26 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array) -> DocStat
     missing = missing | anchor_missing
     linkable = linkable & ~anchor_missing
 
-    # --- conflict scan (parity: block.rs:537-602) ---
     safe = lambda idx: jnp.maximum(idx, 0)
+
+    # the wire omits parent_sub when an origin is present — inherit the key
+    # from the resolved left (else right) anchor (parity: block.rs:604-612)
+    left_key = jnp.where(left_idx >= 0, bl.key[safe(left_idx)], -1)
+    right_key = jnp.where(right_idx >= 0, bl.key[safe(right_idx)], -1)
+    r_key = jnp.where(r_key >= 0, r_key, jnp.where(left_key >= 0, left_key, right_key))
+
+    # map rows (parent_sub set) anchor on their key chain, not the sequence:
+    # the no-left entry point is the chain's leftmost item (parity:
+    # block.rs:541-551 — walk parent.map[sub] to the leftmost sibling)
+    is_map = r_key >= 0
+    slots = jnp.arange(_capacity(bl), dtype=I32)
+    chain_mask = (
+        (slots < state.n_blocks) & (bl.key == r_key) & (bl.left == -1) & is_map
+    )
+    chain_head = jnp.where(jnp.any(chain_mask), jnp.argmax(chain_mask).astype(I32), -1)
+    anchor0 = jnp.where(is_map, chain_head, state.start)
+
+    # --- conflict scan (parity: block.rs:537-602) ---
     right_left = jnp.where(right_idx >= 0, bl.left[safe(right_idx)], -1)
     need_scan = linkable & (
         ((left_idx < 0) & ((right_idx < 0) | (right_left >= 0)))
@@ -312,7 +339,7 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array) -> DocStat
     o0 = jnp.where(
         left_idx >= 0,
         bl.right[safe(left_idx)],
-        state.start,
+        anchor0,
     )
     o0 = jnp.where(need_scan, o0, -1)
 
@@ -374,12 +401,13 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array) -> DocStat
 
     has_left = linkable & (left_idx >= 0)
     right_final = jnp.where(
-        has_left, bl.right[safe(left_idx)], jnp.where(linkable, state.start, -1)
+        has_left, bl.right[safe(left_idx)], jnp.where(linkable, anchor0, -1)
     )
-    # left.right = j ; start = j when no left
+    # left.right = j ; start = j when no left (sequence rows only — map rows
+    # never touch the sequence head, parity: block.rs:618-632)
     w_left = jnp.where(has_left, left_idx, B)
     new_right_col = _set(bl.right, w_left, j)
-    new_start = jnp.where(linkable & ~has_left, j, state.start)
+    new_start = jnp.where(linkable & ~has_left & ~is_map, j, state.start)
     # right.left = j
     w_right = jnp.where(linkable & (right_final >= 0), right_final, B)
     new_left_col = _set(bl.left, w_right, j)
@@ -402,7 +430,14 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array) -> DocStat
         kind=_set(bl.kind, wj, r_kind),
         content_ref=_set(bl.content_ref, wj, r_ref),
         content_off=_set(bl.content_off, wj, c_off),
+        key=_set(bl.key, wj, r_key),
     )
+    # a map row that became its chain's tail is the key's new live value;
+    # the previous winner — its immediate left — gets tombstoned (parity:
+    # block.rs:637-659 "this is the current attribute value ... delete")
+    new_tail = linkable & is_map & (right_final < 0)
+    w_prev = jnp.where(new_tail & has_left, left_idx, B)
+    new_bl = new_bl._replace(deleted=_set(new_bl.deleted, w_prev, True))
     error = (
         state.error
         | jnp.where(overflow, ERR_CAPACITY, 0)
@@ -461,6 +496,7 @@ def _apply_update_one_doc(
             batch.kind[i],
             batch.content_ref[i],
             batch.content_off[i],
+            batch.key[i],
             batch.valid[i],
         )
         # padding rows skip all work; with a broadcast (unbatched) update the
@@ -616,16 +652,25 @@ def _encode_device_row(out, bl, r, off, real_client, enc: "BatchEncoder") -> Non
     if off > 0:
         oc, ok = int(bl.client[r]), clock + off - 1
     has_o, has_r = oc >= 0, rc >= 0
-    info = kind | (0x80 if has_o else 0) | (0x40 if has_r else 0)
+    key = int(bl.key[r])
+    has_sub = key >= 0
+    info = (
+        kind
+        | (0x80 if has_o else 0)
+        | (0x40 if has_r else 0)
+        | (0x20 if has_sub else 0)  # HAS_PARENT_SUB (parity: block.rs:868-908)
+    )
     out.write_info(info)
     if has_o:
         out.write_left_id(ID(enc.interner.from_idx[oc], ok))
     if has_r:
         out.write_right_id(ID(enc.interner.from_idx[rc], rk))
     if not has_o and not has_r:
-        # round-1 device scope: single root sequence named "text"
+        # device scope: a single root branch (enc.root_name)
         out.write_parent_info(True)
         out.write_string(enc.root_name)
+        if has_sub:
+            out.write_string(enc.keys.names[key])
     ref = int(bl.content_ref[r])
     c_off = int(bl.content_off[r]) + off
     length = int(bl.length[r]) - off
@@ -689,6 +734,25 @@ class ClientInterner:
         return len(self.from_idx)
 
 
+class KeyInterner:
+    """Dense interning of map keys (parent_sub strings) to i32 ids."""
+
+    def __init__(self):
+        self.ids: Dict[str, int] = {}
+        self.names: Dict[int, str] = {}
+
+    def intern(self, key: str) -> int:
+        kid = self.ids.get(key)
+        if kid is None:
+            kid = len(self.ids)
+            self.ids[key] = kid
+            self.names[kid] = key
+        return kid
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+
 class PayloadStore:
     """Host side-buffers for variable-length content, addressed by i32 refs.
 
@@ -717,44 +781,91 @@ class BatchEncoder:
 
     def __init__(self, root_name: str = "text"):
         self.interner = ClientInterner()
+        self.keys = KeyInterner()
         self.payloads = PayloadStore()
         self.root_name = root_name  # root branch of the device sequence
 
+    def _ordered_carriers(self, update: Update) -> list:
+        """Carriers in dependency order — the host half of the reference's
+        integration stack machine (update.rs:169-308): clients descending,
+        but a block whose origin/right-origin points into another client's
+        not-yet-emitted range defers until that range lands. Dependencies
+        below each client's first in-update clock are assumed present in
+        device state (the device flags them otherwise)."""
+        queues = {
+            c: [x for x in update.blocks[c] if not isinstance(x, SkipRange)]
+            for c in sorted(update.blocks.keys(), reverse=True)
+        }
+        queues = {c: q for c, q in queues.items() if q}
+        base = {c: q[0].id.clock for c, q in queues.items()}
+        emitted = dict(base)
+        heads = {c: 0 for c in queues}
+
+        def satisfied(dep) -> bool:
+            if dep is None:
+                return True
+            if dep.client not in base:
+                return True  # not part of this update → device-state lookup
+            return dep.clock < emitted[dep.client]
+
+        out = []
+        progress = True
+        while progress:
+            progress = False
+            for c, q in queues.items():
+                while heads[c] < len(q):
+                    carrier = q[heads[c]]
+                    if isinstance(carrier, Item) and not (
+                        satisfied(carrier.origin) and satisfied(carrier.right_origin)
+                    ):
+                        break
+                    out.append(carrier)
+                    emitted[c] = carrier.id.clock + carrier.len
+                    heads[c] += 1
+                    progress = True
+        for c, q in queues.items():  # unsatisfiable leftovers: device flags
+            out.extend(q[heads[c] :])
+        return out
+
     def rows_from_update(self, update: Update) -> Tuple[list, list]:
         rows = []
-        # mirror the reference's descending-client integration order
-        for client in sorted(update.blocks.keys(), reverse=True):
-            for carrier in update.blocks[client]:
-                if isinstance(carrier, SkipRange):
-                    continue
-                c = self.interner.intern(carrier.id.client)
-                if isinstance(carrier, GCRange):
-                    rows.append(
-                        (c, carrier.id.clock, carrier.len, -1, 0, -1, 0, BLOCK_GC, -1, 0)
-                    )
-                    continue
-                item: Item = carrier
-                kind = item.content.kind
-                if kind == CONTENT_STRING:
-                    ref = self.payloads.add(
-                        kind, item.content.text.encode("utf-16-le")
-                    )
-                elif kind in (CONTENT_ANY,):
-                    ref = self.payloads.add(kind, list(item.content.items))
-                elif kind == CONTENT_DELETED:
-                    ref = -1
-                else:
-                    # embed/format/type/doc payloads: stash the content object
-                    ref = self.payloads.add(kind, item.content)
-                oc = self.interner.intern(item.origin.client) if item.origin else -1
-                ok = item.origin.clock if item.origin else 0
-                rc = (
-                    self.interner.intern(item.right_origin.client)
-                    if item.right_origin
-                    else -1
+        for carrier in self._ordered_carriers(update):
+            c = self.interner.intern(carrier.id.client)
+            if isinstance(carrier, GCRange):
+                rows.append(
+                    (c, carrier.id.clock, carrier.len, -1, 0, -1, 0,
+                     BLOCK_GC, -1, 0, -1)
                 )
-                rk = item.right_origin.clock if item.right_origin else 0
-                rows.append((c, item.id.clock, item.len, oc, ok, rc, rk, kind, ref, 0))
+                continue
+            item: Item = carrier
+            kind = item.content.kind
+            if kind == CONTENT_STRING:
+                ref = self.payloads.add(
+                    kind, item.content.text.encode("utf-16-le")
+                )
+            elif kind in (CONTENT_ANY,):
+                ref = self.payloads.add(kind, list(item.content.items))
+            elif kind == CONTENT_DELETED:
+                ref = -1
+            else:
+                # embed/format/type/doc payloads: stash the content object
+                ref = self.payloads.add(kind, item.content)
+            oc = self.interner.intern(item.origin.client) if item.origin else -1
+            ok = item.origin.clock if item.origin else 0
+            rc = (
+                self.interner.intern(item.right_origin.client)
+                if item.right_origin
+                else -1
+            )
+            rk = item.right_origin.clock if item.right_origin else 0
+            key = (
+                self.keys.intern(item.parent_sub)
+                if item.parent_sub is not None
+                else -1
+            )
+            rows.append(
+                (c, item.id.clock, item.len, oc, ok, rc, rk, kind, ref, 0, key)
+            )
         dels = []
         for client, ranges in update.delete_set.clients.items():
             c = self.interner.intern(client)
@@ -784,7 +895,8 @@ class BatchEncoder:
         D = len(updates)
 
         def pad_rows():
-            out = np.zeros((D, U, 10), dtype=np.int32)
+            out = np.zeros((D, U, 11), dtype=np.int32)
+            out[:, :, 10] = -1  # key padding must read as "sequence row"
             valid = np.zeros((D, U), dtype=bool)
             for d, rows in enumerate(all_rows):
                 for i, row in enumerate(rows):
@@ -814,6 +926,7 @@ class BatchEncoder:
             kind=jnp.asarray(rows[:, :, 7]),
             content_ref=jnp.asarray(rows[:, :, 8]),
             content_off=jnp.asarray(rows[:, :, 9]),
+            key=jnp.asarray(rows[:, :, 10]),
             valid=jnp.asarray(rows_valid),
             del_client=jnp.asarray(dels[:, :, 0]),
             del_start=jnp.asarray(dels[:, :, 1]),
@@ -830,7 +943,8 @@ class BatchEncoder:
                 f"update needs {len(rows)} rows/{len(dels)} dels, "
                 f"buckets are {n_rows}/{n_dels}"
             )
-        row_arr = np.zeros((n_rows, 10), dtype=np.int32)
+        row_arr = np.zeros((n_rows, 11), dtype=np.int32)
+        row_arr[:, 10] = -1
         row_valid = np.zeros(n_rows, dtype=bool)
         for i, row in enumerate(rows):
             row_arr[i] = row
@@ -851,6 +965,7 @@ class BatchEncoder:
             kind=jnp.asarray(row_arr[:, 7]),
             content_ref=jnp.asarray(row_arr[:, 8]),
             content_off=jnp.asarray(row_arr[:, 9]),
+            key=jnp.asarray(row_arr[:, 10]),
             valid=jnp.asarray(row_valid),
             del_client=jnp.asarray(del_arr[:, 0]),
             del_start=jnp.asarray(del_arr[:, 1]),
@@ -885,6 +1000,48 @@ def get_string(state: DocStateBatch, doc: int, payloads: PayloadStore) -> str:
     if steps > limit:
         raise RuntimeError(f"cycle detected in doc {doc} sequence")
     return "".join(out)
+
+
+def get_map(
+    state: DocStateBatch, doc: int, payloads: PayloadStore, keys: KeyInterner
+) -> dict:
+    """Host assembly of a doc's visible map component.
+
+    The live value of key k is the *tail* of k's item chain — the row with
+    key==k and right==-1 (parity: map entry = parent.map[sub] maintained at
+    block.rs:637-642; a deleted tail means the key is absent, map.rs:285).
+    Value = the content's last element (parity: ItemContent::get_last).
+    """
+    bl = jax.tree.map(lambda a: np.asarray(a[doc]), state.blocks)
+    n = int(state.n_blocks[doc])
+    out: dict = {}
+    for i in range(n):
+        kid = int(bl.key[i])
+        if kid < 0 or int(bl.right[i]) != -1 or bl.deleted[i]:
+            continue
+        name = keys.names.get(kid)
+        if name is None:
+            continue
+        kind = int(bl.kind[i])
+        ref = int(bl.content_ref[i])
+        off = int(bl.content_off[i])
+        ln = int(bl.length[i])
+        if kind == CONTENT_ANY:
+            vals = payloads.slice_values(ref, off, ln)
+            if vals:
+                out[name] = vals[-1]
+        elif kind == CONTENT_STRING:
+            out[name] = payloads.slice_text(ref, off, ln)
+        elif ref >= 0:
+            # binary/embed/json/type payloads stash the host content object;
+            # its last element is the map value (ItemContent::get_last).
+            # Nested shared types come back as their Branch (host-side
+            # rendering applies).
+            payload = payloads.items[ref][1]
+            vals = payload.values() if hasattr(payload, "values") else None
+            if vals:
+                out[name] = vals[-1]
+    return out
 
 
 def get_values(state: DocStateBatch, doc: int, payloads: PayloadStore) -> list:

@@ -55,8 +55,9 @@ I32 = jnp.int32
     KD,  # content kind
     RF,  # content ref
     OF,  # content offset
-) = range(14)
-NC = 14
+    KY,  # interned parent_sub key (-1 = sequence item)
+) = range(15)
+NC = 15
 
 # meta columns in the packed [D, 8] array (padded to a TPU-friendly lane dim)
 M_START, M_NBLOCKS, M_ERROR = 0, 1, 2
@@ -84,6 +85,7 @@ def pack_state(state: DocStateBatch) -> Tuple[jax.Array, jax.Array]:
             bl.kind,
             bl.content_ref,
             bl.content_off,
+            bl.key,
         ]
     )  # [NC, D, C]
     D = state.start.shape[0]
@@ -110,6 +112,7 @@ def unpack_state(cols: jax.Array, meta: jax.Array) -> DocStateBatch:
         kind=cols[KD],
         content_ref=cols[RF],
         content_off=cols[OF],
+        key=cols[KY],
     )
     return DocStateBatch(
         blocks=blocks,
@@ -120,7 +123,7 @@ def unpack_state(cols: jax.Array, meta: jax.Array) -> DocStateBatch:
 
 
 def pack_stream(stream: UpdateBatch) -> Tuple[jax.Array, jax.Array]:
-    """Stacked doc-axis-free stream → rows [S, U, 11] / dels [S, R, 4] i32."""
+    """Stacked doc-axis-free stream → rows [S, U, 12] / dels [S, R, 4] i32."""
     rows = jnp.stack(
         [
             stream.client,
@@ -133,10 +136,11 @@ def pack_stream(stream: UpdateBatch) -> Tuple[jax.Array, jax.Array]:
             stream.kind,
             stream.content_ref,
             stream.content_off,
+            stream.key,
             stream.valid.astype(I32),
         ],
         axis=-1,
-    )  # [S, U, 11]
+    )  # [S, U, 12]
     dels = jnp.stack(
         [
             stream.del_client,
@@ -153,7 +157,7 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
     """One doc tile: integrate the whole stream in VMEM.
 
     cols_ref: [NC, DB, C] out-ref aliased to the input (holds the state),
-    meta_ref: [DB, 8] aliased; rows_ref: [S, U, 11], dels_ref: [S, R, 4],
+    meta_ref: [DB, 8] aliased; rows_ref: [S, U, 12], dels_ref: [S, R, 4],
     rank_ref: [1, K]. The plain in-refs are shadows of the aliased buffers
     and are unused.
     """
@@ -232,6 +236,7 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         put(KD, j, gather(KD, i_idx, 0), do)
         put(RF, j, gather(RF, i_idx, -1), do)
         put(OF, j, gather(OF, i_idx, 0) + off, do)
+        put(KY, j, gather(KY, i_idx, -1), do)
         # fix left half + old right neighbor
         put(LN, i_idx, off, do)
         put(RT, i_idx, j, do)
@@ -263,6 +268,8 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         r_kind = rows_ref[s, u, 7]
         r_ref = rows_ref[s, u, 8]
         r_off = rows_ref[s, u, 9]
+        r_key = rows_ref[s, u, 10]  # carried through; the fused kernel is
+        # sequence-only — map rows (key >= 0) must take the XLA path
 
         local = client_clock(r_client)  # (DB,)
         applicable = local >= r_clock
@@ -392,6 +399,7 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         put(KD, j, jnp.full((DB,), r_kind, I32), do)
         put(RF, j, jnp.full((DB,), r_ref, I32), do)
         put(OF, j, c_off, do)
+        put(KY, j, jnp.full((DB,), r_key, I32), do)
         meta_ref[:, M_NBLOCKS] = n_blocks() + do.astype(I32)
         meta_ref[:, M_ERROR] = (
             meta_ref[:, M_ERROR]
@@ -427,7 +435,7 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
 
     def step(s, _):
         def row_body(u, __):
-            @pl.when(rows_ref[s, u, 10] == 1)
+            @pl.when(rows_ref[s, u, 11] == 1)
             def _():
                 integrate_row(s, u)
 
@@ -485,7 +493,13 @@ def apply_update_stream_fused(
     d_block: int = 32,
     interpret: bool = False,
 ) -> DocStateBatch:
-    """Fused-replay drop-in for `apply_update_stream` (same semantics)."""
+    """Fused-replay drop-in for `apply_update_stream` (same semantics for
+    sequence streams; map rows are not supported in the fused kernel)."""
+    if bool(jnp.any(stream.key >= 0)):
+        raise NotImplementedError(
+            "apply_update_stream_fused integrates sequence rows only; "
+            "streams with map rows (parent_sub) must take apply_update_stream"
+        )
     cols, meta = pack_state(state)
     D = cols.shape[1]
     if D % d_block != 0:
