@@ -120,9 +120,10 @@ def device_replay(log, expect: str):
     stream = BatchEncoder.stack_steps(steps)
     rank = enc.interner.rank_table()
 
+    assert not enc.saw_map_or_nested  # text trace: fused path is valid
     # warmup / compile (donated arg: rebuild state afterwards)
     state = init_state(N_DOCS, CAPACITY)
-    state = apply_update_stream_fused(state, stream, rank, d_block=D_BLOCK)
+    state = apply_update_stream_fused(state, stream, rank, d_block=D_BLOCK, guard=False)
     err = int(np.asarray(state.error).max())
     if err != 0:
         raise RuntimeError(f"device error flag {err}")
@@ -137,7 +138,7 @@ def device_replay(log, expect: str):
     state = init_state(N_DOCS, CAPACITY)
     np.asarray(state.n_blocks)
     t0 = time.perf_counter()
-    state = apply_update_stream_fused(state, stream, rank, d_block=D_BLOCK)
+    state = apply_update_stream_fused(state, stream, rank, d_block=D_BLOCK, guard=False)
     np.asarray(state.n_blocks)
     return time.perf_counter() - t0
 

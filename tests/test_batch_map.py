@@ -179,3 +179,48 @@ def test_map_device_encode_roundtrip():
     dst = Doc(client_id=6)
     dst.apply_update_v1(payload)
     assert dst.get_map("m").to_json() == {"a": 42, "b": "two"}
+
+
+def test_map_loser_row_tombstoned_on_device():
+    """A losing concurrent map write integrates dead-on-arrival (parity:
+    block.rs:751-765), so device-encoded diffs ship its tombstone."""
+    import numpy as np
+    import jax
+
+    from ytpu.models.batch_doc import encode_diff_batch, finish_encode_diff
+
+    a = Doc(client_id=10)
+    b = Doc(client_id=20)
+    for d, v in ((a, "loser"), (b, "winner")):
+        with d.transact() as txn:
+            d.get_map("m").insert(txn, "k", v)
+    ua, ub = a.encode_state_as_update_v1(), b.encode_state_as_update_v1()
+
+    enc = BatchEncoder(root_name="m")
+    state = init_state(1, 16)
+    # winner arrives first; the loser then lands mid-chain (right != None)
+    for payload in (ub, ua):
+        batch = enc.build_batch([Update.decode_v1(payload)])
+        state = apply_update_batch(state, batch, enc.interner.rank_table())
+    assert int(state.error[0]) == 0
+    assert get_map(state, 0, enc.payloads, enc.keys) == {"k": "winner"}
+
+    bl = jax.tree.map(lambda x: np.asarray(x[0]), state.blocks)
+    n = int(state.n_blocks[0])
+    loser_rows = [
+        i for i in range(n)
+        if enc.interner.from_idx[int(bl.client[i])] == 10
+    ]
+    assert loser_rows and all(bl.deleted[i] for i in loser_rows)
+
+    # the tombstone ships on the wire: fresh host doc agrees it is deleted
+    n_clients = len(enc.interner)
+    remote = jax.numpy.zeros((1, n_clients), jax.numpy.int32)
+    ship, offsets, _, deleted = map(
+        np.asarray, encode_diff_batch(state, remote, n_clients)
+    )
+    payload = finish_encode_diff(state, 0, ship, offsets, deleted, enc)
+    fresh = Doc(client_id=99)
+    fresh.apply_update_v1(payload)
+    assert fresh.get_map("m").to_json() == {"k": "winner"}
+    assert fresh.state_vector().get(10) == 1  # loser block known + dead

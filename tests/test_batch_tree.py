@@ -1,0 +1,158 @@
+"""Nested branch trees on the device engine vs the host oracle.
+
+Nested shared types live in the same block table: a ContentType row owns a
+child sequence through its `head` column; children reference it through the
+`parent` column (parity: block.rs:503-523 TypePtr resolution + the Branch
+projections of branch.rs:173-215).
+"""
+
+import random
+
+import pytest
+
+from ytpu.core import Doc, Update
+from ytpu.models.batch_doc import (
+    BatchEncoder,
+    apply_update_batch,
+    get_tree,
+    init_state,
+)
+from ytpu.types.shared import ArrayPrelim, MapPrelim, TextPrelim
+
+
+def device_tree_from_docs(docs, root="r", capacity=256):
+    enc = BatchEncoder(root_name=root)
+    updates = [Update.decode_v1(d.encode_state_as_update_v1()) for d in docs]
+    batch = enc.build_batch(updates)
+    state = init_state(len(docs), capacity)
+    state = apply_update_batch(state, batch, enc.interner.rank_table())
+    return state, enc
+
+
+def test_nested_types_in_array():
+    doc = Doc(client_id=1)
+    arr = doc.get_array("r")
+    with doc.transact() as txn:
+        arr.insert_range(txn, 0, [1, "s"])
+        arr.insert(txn, 2, TextPrelim("ab"))
+        arr.insert(txn, 3, MapPrelim({"x": 5}))
+        arr.insert(txn, 4, ArrayPrelim([2, 3]))
+
+    state, enc = device_tree_from_docs([doc])
+    assert int(state.error[0]) == 0
+    tree = get_tree(state, 0, enc.payloads, enc.keys)
+    assert tree["seq"] == [1, "s", "ab", {"x": 5}, [2, 3]]
+    assert tree["map"] == {}
+    assert doc.get_array("r").to_json() == [1, "s", "ab", {"x": 5}, [2, 3]]
+
+
+def test_nested_edits_after_creation():
+    """Edits to a nested text/map arrive as separate updates whose parents
+    are branch ids — the device resolves them through the parent column."""
+    doc = Doc(client_id=1)
+    arr = doc.get_array("r")
+    with doc.transact() as txn:
+        arr.insert(txn, 0, TextPrelim("base"))
+        arr.insert(txn, 1, MapPrelim({}))
+    with doc.transact() as txn:
+        nested_text = arr.get(0)
+        nested_text.insert(txn, 4, "-tail")
+        nested_map = arr.get(1)
+        nested_map.insert(txn, "k", 9)
+        nested_map.insert(txn, "k", 10)  # overwrite inside nested map
+
+    state, enc = device_tree_from_docs([doc])
+    assert int(state.error[0]) == 0
+    tree = get_tree(state, 0, enc.payloads, enc.keys)
+    assert tree["seq"] == ["base-tail", {"k": 10}]
+    assert doc.get_array("r").to_json() == ["base-tail", {"k": 10}]
+
+
+def test_nested_concurrent_edits():
+    """Two clients edit the same nested text concurrently."""
+    a = Doc(client_id=1)
+    with a.transact() as txn:
+        a.get_array("r").insert(txn, 0, TextPrelim("mid"))
+    b = Doc(client_id=2)
+    b.apply_update_v1(a.encode_state_as_update_v1())
+
+    with a.transact() as txn:
+        a.get_array("r").get(0).insert(txn, 0, "L-")
+    with b.transact() as txn:
+        b.get_array("r").get(0).insert(txn, 3, "-R")
+    ua, ub = a.encode_state_as_update_v1(), b.encode_state_as_update_v1()
+    a.apply_update_v1(ub)
+    b.apply_update_v1(ua)
+    expected = a.get_array("r").to_json()
+    assert b.get_array("r").to_json() == expected
+    assert expected == ["L-mid-R"]
+
+    state, enc = device_tree_from_docs([a, b])
+    for d in range(2):
+        assert int(state.error[d]) == 0
+        assert get_tree(state, d, enc.payloads, enc.keys)["seq"] == expected
+
+
+def test_deleted_nested_type_not_rendered():
+    doc = Doc(client_id=1)
+    arr = doc.get_array("r")
+    with doc.transact() as txn:
+        arr.insert(txn, 0, TextPrelim("gone"))
+        arr.insert(txn, 1, 42)
+    with doc.transact() as txn:
+        arr.remove(txn, 0)
+
+    state, enc = device_tree_from_docs([doc])
+    assert int(state.error[0]) == 0
+    assert get_tree(state, 0, enc.payloads, enc.keys)["seq"] == [42]
+
+
+@pytest.mark.parametrize("seed", [7, 8, 9])
+def test_tree_fuzz_parity(seed):
+    """Random nested edits across 2 clients with partial syncs."""
+    rng = random.Random(seed)
+    docs = [Doc(client_id=10 + i) for i in range(2)]
+    # both start from a shared skeleton: [text, map]
+    with docs[0].transact() as txn:
+        docs[0].get_array("r").insert(txn, 0, TextPrelim("seed"))
+        docs[0].get_array("r").insert(txn, 1, MapPrelim({}))
+    docs[1].apply_update_v1(docs[0].encode_state_as_update_v1())
+
+    from ytpu.types.map import Map
+    from ytpu.types.text import Text
+
+    def find(arr, cls):
+        for i in range(len(arr.to_json())):
+            v = arr.get(i)
+            if isinstance(v, cls):
+                return v
+        return None
+
+    for step in range(14):
+        d = rng.choice(docs)
+        arr = d.get_array("r")
+        with d.transact() as txn:
+            roll = rng.random()
+            t = find(arr, Text)
+            m = find(arr, Map)
+            if roll < 0.4 and t is not None:
+                t.insert(txn, rng.randrange(t.branch.content_len + 1), "x")
+            elif roll < 0.7 and m is not None:
+                m.insert(txn, rng.choice("ab"), rng.randrange(100))
+            else:
+                arr.insert(txn, rng.randrange(len(arr.to_json()) + 1), step)
+        if rng.random() < 0.5:
+            x, y = rng.sample(docs, 2)
+            y.apply_update_v1(x.encode_state_as_update_v1(y.state_vector()))
+
+    for x in docs:
+        for y in docs:
+            if x is not y:
+                y.apply_update_v1(x.encode_state_as_update_v1(y.state_vector()))
+    expected = docs[0].get_array("r").to_json()
+    assert docs[1].get_array("r").to_json() == expected
+
+    state, enc = device_tree_from_docs(docs)
+    for d in range(2):
+        assert int(state.error[d]) == 0, f"doc {d} error {int(state.error[d])}"
+        assert get_tree(state, d, enc.payloads, enc.keys)["seq"] == expected

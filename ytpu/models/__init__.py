@@ -18,6 +18,7 @@ from .batch_doc import (
     apply_update_batch,
     get_map,
     get_string,
+    get_tree,
     get_values,
     init_state,
     state_vectors,
@@ -37,6 +38,7 @@ __all__ = [
     "apply_update_batch",
     "get_map",
     "get_string",
+    "get_tree",
     "get_values",
     "init_state",
     "state_vectors",

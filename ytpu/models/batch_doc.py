@@ -17,12 +17,12 @@ This is the TPU-native re-design of the reference's per-doc mutable store
   payloads stay in host side-buffers addressed by (content_ref, offset, len)
   columns — the device never touches variable-length data.
 
-Device scope: the root branch's sequence component (YText/YArray flagship
-configs) AND its map component (YMap / XML-attribute shape) — map rows are
-per-key chains with LWW tails keyed by an interned `parent_sub` column.
-Nested branch trees (full XML hierarchies) ride the host oracle until the
-multi-branch device engine lands; semantic parity is enforced against
-`ytpu.core` in tests/test_batch_device.py and tests/test_batch_map.py.
+Device scope: full branch trees. Sequence components (YText/YArray), map
+components (YMap / XML attributes; per-key chains with LWW tails keyed by an
+interned `parent_sub` column), and nested shared types (a ContentType row
+owns a child sequence through its `head` column; children point back through
+the `parent` column). Semantic parity is enforced against `ytpu.core` in
+tests/test_batch_device.py, test_batch_map.py and test_batch_tree.py.
 """
 
 from __future__ import annotations
@@ -36,6 +36,7 @@ import numpy as np
 
 from ytpu.core import Doc, Update
 from ytpu.core.block import GCRange, Item, SkipRange
+from ytpu.core.ids import ID
 from ytpu.core.content import (
     BLOCK_GC,
     CONTENT_ANY,
@@ -56,6 +57,7 @@ __all__ = [
     "BatchEncoder",
     "get_string",
     "get_map",
+    "get_tree",
     "state_vectors",
 ]
 
@@ -80,6 +82,8 @@ class BlockCols(NamedTuple):
     content_ref: jax.Array  # [*, B] i32 host payload id
     content_off: jax.Array  # [*, B] i32 offset into payload (clock units)
     key: jax.Array  # [*, B] i32 interned parent_sub (-1 = sequence item)
+    parent: jax.Array  # [*, B] i32 row of the parent ContentType (-1 = root)
+    head: jax.Array  # [*, B] i32 child-sequence head for ContentType rows
 
 
 class DocStateBatch(NamedTuple):
@@ -103,6 +107,9 @@ class UpdateBatch(NamedTuple):
     content_ref: jax.Array  # [*, U] i32
     content_off: jax.Array  # [*, U] i32
     key: jax.Array  # [*, U] i32 interned parent_sub (-1 = sequence row)
+    p_tag: jax.Array  # [*, U] i32 parent form: 0 inherit, 1 root, 2 branch id
+    p_client: jax.Array  # [*, U] i32 branch-id parent (p_tag == 2)
+    p_clock: jax.Array  # [*, U] i32
     valid: jax.Array  # [*, U] bool
     del_client: jax.Array  # [*, R] i32
     del_start: jax.Array  # [*, R] i32
@@ -137,6 +144,8 @@ def init_state(n_docs: int, capacity: int) -> DocStateBatch:
         content_ref=full(shape, -1),
         content_off=full(shape, 0),
         key=full(shape, -1),
+        parent=full(shape, -1),
+        head=full(shape, -1),
     )
     return DocStateBatch(
         blocks=blocks,
@@ -221,6 +230,8 @@ def _split(state: DocStateBatch, i: jax.Array, off: jax.Array):
         content_ref=_set(bl.content_ref, wj, bl.content_ref[safe_i]),
         content_off=_set(bl.content_off, wj, bl.content_off[safe_i] + off),
         key=_set(bl.key, wj, bl.key[safe_i]),
+        parent=_set(bl.parent, wj, bl.parent[safe_i]),
+        head=_set(bl.head, wj, -1),  # type rows (len 1) never split
     )
     state = DocStateBatch(
         blocks=new_bl,
@@ -273,6 +284,9 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array) -> DocStat
         r_ref,
         r_off,
         r_key,
+        r_ptag,
+        r_pclient,
+        r_pclock,
         r_valid,
     ) = row
     bl = state.blocks
@@ -313,6 +327,22 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array) -> DocStat
 
     safe = lambda idx: jnp.maximum(idx, 0)
 
+    # resolve the parent branch (parity: block.rs:503-523 TypePtr handling):
+    # p_tag 2 = a nested branch, addressed by its ContentType item's id;
+    # p_tag 1 = the root branch; p_tag 0 = omitted on the wire (an origin is
+    # present) — inherit from the resolved left (else right) anchor
+    parent_probe = jnp.where(linkable & (r_ptag == 2), r_pclient, -2)
+    parent_slot = _find_slot(bl, state.n_blocks, parent_probe, r_pclock)
+    left_parent = jnp.where(left_idx >= 0, bl.parent[safe(left_idx)], -1)
+    right_parent = jnp.where(right_idx >= 0, bl.parent[safe(right_idx)], -1)
+    inherited_parent = jnp.where(left_idx >= 0, left_parent, right_parent)
+    parent_row = jnp.where(
+        r_ptag == 2, parent_slot, jnp.where(r_ptag == 1, -1, inherited_parent)
+    )
+    parent_missing = linkable & (r_ptag == 2) & (parent_slot < 0)
+    missing = missing | parent_missing
+    linkable = linkable & ~parent_missing
+
     # the wire omits parent_sub when an origin is present — inherit the key
     # from the resolved left (else right) anchor (parity: block.rs:604-612)
     left_key = jnp.where(left_idx >= 0, bl.key[safe(left_idx)], -1)
@@ -321,14 +351,23 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array) -> DocStat
 
     # map rows (parent_sub set) anchor on their key chain, not the sequence:
     # the no-left entry point is the chain's leftmost item (parity:
-    # block.rs:541-551 — walk parent.map[sub] to the leftmost sibling)
+    # block.rs:541-551 — walk parent.map[sub] to the leftmost sibling).
+    # Chains are scoped per (parent branch, key).
     is_map = r_key >= 0
     slots = jnp.arange(_capacity(bl), dtype=I32)
     chain_mask = (
-        (slots < state.n_blocks) & (bl.key == r_key) & (bl.left == -1) & is_map
+        (slots < state.n_blocks)
+        & (bl.key == r_key)
+        & (bl.parent == parent_row)
+        & (bl.left == -1)
+        & is_map
     )
     chain_head = jnp.where(jnp.any(chain_mask), jnp.argmax(chain_mask).astype(I32), -1)
-    anchor0 = jnp.where(is_map, chain_head, state.start)
+    # the no-left sequence entry point is the parent branch's head
+    seq_head = jnp.where(
+        parent_row >= 0, bl.head[safe(parent_row)], state.start
+    )
+    anchor0 = jnp.where(is_map, chain_head, seq_head)
 
     # --- conflict scan (parity: block.rs:537-602) ---
     right_left = jnp.where(right_idx >= 0, bl.left[safe(right_idx)], -1)
@@ -403,16 +442,26 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array) -> DocStat
     right_final = jnp.where(
         has_left, bl.right[safe(left_idx)], jnp.where(linkable, anchor0, -1)
     )
-    # left.right = j ; start = j when no left (sequence rows only — map rows
-    # never touch the sequence head, parity: block.rs:618-632)
+    # left.right = j ; branch head = j when no left (sequence rows only —
+    # map rows never touch the head, parity: block.rs:618-632)
     w_left = jnp.where(has_left, left_idx, B)
     new_right_col = _set(bl.right, w_left, j)
-    new_start = jnp.where(linkable & ~has_left & ~is_map, j, state.start)
+    new_head = linkable & ~has_left & ~is_map
+    new_start = jnp.where(new_head & (parent_row < 0), j, state.start)
+    w_head = jnp.where(new_head & (parent_row >= 0), parent_row, B)
+    new_head_col = _set(bl.head, w_head, j)
     # right.left = j
     w_right = jnp.where(linkable & (right_final >= 0), right_final, B)
     new_left_col = _set(bl.left, w_right, j)
 
-    row_deleted = is_gc | (r_kind == CONTENT_DELETED)
+    # self-delete on arrival (parity: block.rs:751-765): a row whose parent
+    # branch item is tombstoned, or a map row that lands with a right
+    # neighbor (a losing concurrent write), integrates directly as deleted
+    parent_deleted = (parent_row >= 0) & bl.deleted[safe(parent_row)]
+    dead_on_arrival = linkable & (
+        parent_deleted | (is_map & (right_final >= 0))
+    )
+    row_deleted = is_gc | (r_kind == CONTENT_DELETED) | dead_on_arrival
     row_countable = ~row_deleted & (r_kind != CONTENT_FORMAT)
 
     new_bl = BlockCols(
@@ -431,6 +480,8 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array) -> DocStat
         content_ref=_set(bl.content_ref, wj, r_ref),
         content_off=_set(bl.content_off, wj, c_off),
         key=_set(bl.key, wj, r_key),
+        parent=_set(bl.parent, wj, parent_row),
+        head=_set(new_head_col, wj, -1),
     )
     # a map row that became its chain's tail is the key's new live value;
     # the previous winner — its immediate left — gets tombstoned (parity:
@@ -497,6 +548,9 @@ def _apply_update_one_doc(
             batch.content_ref[i],
             batch.content_off[i],
             batch.key[i],
+            batch.p_tag[i],
+            batch.p_client[i],
+            batch.p_clock[i],
             batch.valid[i],
         )
         # padding rows skip all work; with a broadcast (unbatched) update the
@@ -639,7 +693,6 @@ def _encode_device_row(out, bl, r, off, real_client, enc: "BatchEncoder") -> Non
         BLOCK_SKIP,
         CONTENT_DELETED,
     )
-    from ytpu.core.ids import ID
 
     kind = int(bl.kind[r])
     if kind == BLOCK_GC:
@@ -666,9 +719,19 @@ def _encode_device_row(out, bl, r, off, real_client, enc: "BatchEncoder") -> Non
     if has_r:
         out.write_right_id(ID(enc.interner.from_idx[rc], rk))
     if not has_o and not has_r:
-        # device scope: a single root branch (enc.root_name)
-        out.write_parent_info(True)
-        out.write_string(enc.root_name)
+        parent_row = int(bl.parent[r])
+        if parent_row >= 0:
+            # nested branch: parent is the ContentType item's id
+            out.write_parent_info(False)
+            out.write_left_id(
+                ID(
+                    enc.interner.from_idx[int(bl.client[parent_row])],
+                    int(bl.clock[parent_row]),
+                )
+            )
+        else:
+            out.write_parent_info(True)
+            out.write_string(enc.root_name)
         if has_sub:
             out.write_string(enc.keys.names[key])
     ref = int(bl.content_ref[r])
@@ -784,6 +847,9 @@ class BatchEncoder:
         self.keys = KeyInterner()
         self.payloads = PayloadStore()
         self.root_name = root_name  # root branch of the device sequence
+        # True once any encoded row was a map row or had a branch-id parent
+        # (streams with such rows cannot take the fused Pallas path)
+        self.saw_map_or_nested = False
 
     def _ordered_carriers(self, update: Update) -> list:
         """Carriers in dependency order — the host half of the reference's
@@ -816,7 +882,13 @@ class BatchEncoder:
                 while heads[c] < len(q):
                     carrier = q[heads[c]]
                     if isinstance(carrier, Item) and not (
-                        satisfied(carrier.origin) and satisfied(carrier.right_origin)
+                        satisfied(carrier.origin)
+                        and satisfied(carrier.right_origin)
+                        and satisfied(
+                            carrier.parent
+                            if isinstance(carrier.parent, ID)
+                            else None
+                        )
                     ):
                         break
                     out.append(carrier)
@@ -834,7 +906,7 @@ class BatchEncoder:
             if isinstance(carrier, GCRange):
                 rows.append(
                     (c, carrier.id.clock, carrier.len, -1, 0, -1, 0,
-                     BLOCK_GC, -1, 0, -1)
+                     BLOCK_GC, -1, 0, -1, 0, -1, 0)
                 )
                 continue
             item: Item = carrier
@@ -863,8 +935,19 @@ class BatchEncoder:
                 if item.parent_sub is not None
                 else -1
             )
+            parent = item.parent
+            if isinstance(parent, ID):
+                p_tag = 2
+                pc, pk = self.interner.intern(parent.client), parent.clock
+            elif parent is not None:  # named root (single-root device scope)
+                p_tag, pc, pk = 1, -1, 0
+            else:  # omitted on the wire: inherit from the resolved anchor
+                p_tag, pc, pk = 0, -1, 0
+            if key >= 0 or p_tag == 2:
+                self.saw_map_or_nested = True
             rows.append(
-                (c, item.id.clock, item.len, oc, ok, rc, rk, kind, ref, 0, key)
+                (c, item.id.clock, item.len, oc, ok, rc, rk, kind, ref, 0,
+                 key, p_tag, pc, pk)
             )
         dels = []
         for client, ranges in update.delete_set.clients.items():
@@ -895,8 +978,9 @@ class BatchEncoder:
         D = len(updates)
 
         def pad_rows():
-            out = np.zeros((D, U, 11), dtype=np.int32)
+            out = np.zeros((D, U, 14), dtype=np.int32)
             out[:, :, 10] = -1  # key padding must read as "sequence row"
+            out[:, :, 12] = -1  # p_client padding
             valid = np.zeros((D, U), dtype=bool)
             for d, rows in enumerate(all_rows):
                 for i, row in enumerate(rows):
@@ -927,6 +1011,9 @@ class BatchEncoder:
             content_ref=jnp.asarray(rows[:, :, 8]),
             content_off=jnp.asarray(rows[:, :, 9]),
             key=jnp.asarray(rows[:, :, 10]),
+            p_tag=jnp.asarray(rows[:, :, 11]),
+            p_client=jnp.asarray(rows[:, :, 12]),
+            p_clock=jnp.asarray(rows[:, :, 13]),
             valid=jnp.asarray(rows_valid),
             del_client=jnp.asarray(dels[:, :, 0]),
             del_start=jnp.asarray(dels[:, :, 1]),
@@ -943,8 +1030,9 @@ class BatchEncoder:
                 f"update needs {len(rows)} rows/{len(dels)} dels, "
                 f"buckets are {n_rows}/{n_dels}"
             )
-        row_arr = np.zeros((n_rows, 11), dtype=np.int32)
+        row_arr = np.zeros((n_rows, 14), dtype=np.int32)
         row_arr[:, 10] = -1
+        row_arr[:, 12] = -1
         row_valid = np.zeros(n_rows, dtype=bool)
         for i, row in enumerate(rows):
             row_arr[i] = row
@@ -966,6 +1054,9 @@ class BatchEncoder:
             content_ref=jnp.asarray(row_arr[:, 8]),
             content_off=jnp.asarray(row_arr[:, 9]),
             key=jnp.asarray(row_arr[:, 10]),
+            p_tag=jnp.asarray(row_arr[:, 11]),
+            p_client=jnp.asarray(row_arr[:, 12]),
+            p_clock=jnp.asarray(row_arr[:, 13]),
             valid=jnp.asarray(row_valid),
             del_client=jnp.asarray(del_arr[:, 0]),
             del_start=jnp.asarray(del_arr[:, 1]),
@@ -1005,43 +1096,88 @@ def get_string(state: DocStateBatch, doc: int, payloads: PayloadStore) -> str:
 def get_map(
     state: DocStateBatch, doc: int, payloads: PayloadStore, keys: KeyInterner
 ) -> dict:
-    """Host assembly of a doc's visible map component.
+    """Host assembly of the root branch's visible map component.
 
     The live value of key k is the *tail* of k's item chain — the row with
     key==k and right==-1 (parity: map entry = parent.map[sub] maintained at
     block.rs:637-642; a deleted tail means the key is absent, map.rs:285).
-    Value = the content's last element (parity: ItemContent::get_last).
+    One rendering path with get_tree — this is its root "map" component.
     """
+    return get_tree(state, doc, payloads, keys)["map"]
+
+
+def get_tree(
+    state: DocStateBatch, doc: int, payloads: PayloadStore, keys: KeyInterner
+) -> dict:
+    """Host assembly of a doc's full branch tree: the root's sequence and map
+    components, with nested shared types rendered recursively by their
+    TypeRef (text -> str, map -> dict, array/xml -> list).
+
+    Nested branches live in the same block table: a ContentType row owns a
+    child sequence via its `head` column, and child map chains reference it
+    through the `parent` column (parity: the Branch projections of
+    branch.rs:173-215 over the device columns).
+    """
+    from ytpu.core.branch import TYPE_MAP, TYPE_TEXT, TYPE_XML_TEXT
+    from ytpu.core.content import CONTENT_TYPE
+
     bl = jax.tree.map(lambda a: np.asarray(a[doc]), state.blocks)
     n = int(state.n_blocks[doc])
-    out: dict = {}
-    for i in range(n):
-        kid = int(bl.key[i])
-        if kid < 0 or int(bl.right[i]) != -1 or bl.deleted[i]:
-            continue
-        name = keys.names.get(kid)
-        if name is None:
-            continue
+    limit = n + 1
+
+    def render_type(i: int):
+        content = payloads.items[int(bl.content_ref[i])][1]
+        tr = content.branch.type_ref
+        seq, mp = render_branch(int(bl.head[i]), i)
+        if tr in (TYPE_TEXT, TYPE_XML_TEXT):
+            return "".join(v for v in seq if isinstance(v, str))
+        if tr == TYPE_MAP:
+            return mp
+        return seq
+
+    def render_row_values(i: int) -> list:
         kind = int(bl.kind[i])
         ref = int(bl.content_ref[i])
         off = int(bl.content_off[i])
         ln = int(bl.length[i])
+        if kind == CONTENT_STRING:
+            return list(payloads.slice_text(ref, off, ln))
         if kind == CONTENT_ANY:
-            vals = payloads.slice_values(ref, off, ln)
-            if vals:
-                out[name] = vals[-1]
-        elif kind == CONTENT_STRING:
-            out[name] = payloads.slice_text(ref, off, ln)
-        elif ref >= 0:
-            # binary/embed/json/type payloads stash the host content object;
-            # its last element is the map value (ItemContent::get_last).
-            # Nested shared types come back as their Branch (host-side
-            # rendering applies).
+            return payloads.slice_values(ref, off, ln)
+        if kind == CONTENT_TYPE:
+            return [render_type(i)]
+        if ref >= 0:
             payload = payloads.items[ref][1]
-            vals = payload.values() if hasattr(payload, "values") else None
-            if vals:
-                out[name] = vals[-1]
-    return out
+            if hasattr(payload, "values"):
+                return list(payload.values())
+        return []
+
+    def render_branch(head: int, parent_row: int):
+        seq: list = []
+        idx, steps = head, 0
+        while idx >= 0 and steps <= limit:
+            if not bl.deleted[idx] and bl.countable[idx] and bl.key[idx] < 0:
+                seq.extend(render_row_values(idx))
+            idx = int(bl.right[idx])
+            steps += 1
+        if steps > limit:
+            raise RuntimeError(f"cycle detected in doc {doc} branch tree")
+        mp: dict = {}
+        for i in range(n):
+            if (
+                int(bl.key[i]) >= 0
+                and int(bl.parent[i]) == parent_row
+                and int(bl.right[i]) == -1
+                and not bl.deleted[i]
+            ):
+                name = keys.names.get(int(bl.key[i]))
+                vals = render_row_values(i)
+                if name is not None and vals:
+                    mp[name] = vals[-1]
+        return seq, mp
+
+    seq, mp = render_branch(int(state.start[doc]), -1)
+    return {"seq": seq, "map": mp}
 
 
 def get_values(state: DocStateBatch, doc: int, payloads: PayloadStore) -> list:

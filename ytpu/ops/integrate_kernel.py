@@ -56,8 +56,10 @@ I32 = jnp.int32
     RF,  # content ref
     OF,  # content offset
     KY,  # interned parent_sub key (-1 = sequence item)
-) = range(15)
-NC = 15
+    PR,  # parent ContentType row (-1 = root)
+    HD,  # child-sequence head (ContentType rows)
+) = range(17)
+NC = 17
 
 # meta columns in the packed [D, 8] array (padded to a TPU-friendly lane dim)
 M_START, M_NBLOCKS, M_ERROR = 0, 1, 2
@@ -86,6 +88,8 @@ def pack_state(state: DocStateBatch) -> Tuple[jax.Array, jax.Array]:
             bl.content_ref,
             bl.content_off,
             bl.key,
+            bl.parent,
+            bl.head,
         ]
     )  # [NC, D, C]
     D = state.start.shape[0]
@@ -113,6 +117,8 @@ def unpack_state(cols: jax.Array, meta: jax.Array) -> DocStateBatch:
         content_ref=cols[RF],
         content_off=cols[OF],
         key=cols[KY],
+        parent=cols[PR],
+        head=cols[HD],
     )
     return DocStateBatch(
         blocks=blocks,
@@ -123,7 +129,7 @@ def unpack_state(cols: jax.Array, meta: jax.Array) -> DocStateBatch:
 
 
 def pack_stream(stream: UpdateBatch) -> Tuple[jax.Array, jax.Array]:
-    """Stacked doc-axis-free stream → rows [S, U, 12] / dels [S, R, 4] i32."""
+    """Stacked doc-axis-free stream → rows [S, U, 15] / dels [S, R, 4] i32."""
     rows = jnp.stack(
         [
             stream.client,
@@ -137,10 +143,13 @@ def pack_stream(stream: UpdateBatch) -> Tuple[jax.Array, jax.Array]:
             stream.content_ref,
             stream.content_off,
             stream.key,
+            stream.p_tag,
+            stream.p_client,
+            stream.p_clock,
             stream.valid.astype(I32),
         ],
         axis=-1,
-    )  # [S, U, 12]
+    )  # [S, U, 15]
     dels = jnp.stack(
         [
             stream.del_client,
@@ -157,7 +166,7 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
     """One doc tile: integrate the whole stream in VMEM.
 
     cols_ref: [NC, DB, C] out-ref aliased to the input (holds the state),
-    meta_ref: [DB, 8] aliased; rows_ref: [S, U, 12], dels_ref: [S, R, 4],
+    meta_ref: [DB, 8] aliased; rows_ref: [S, U, 15], dels_ref: [S, R, 4],
     rank_ref: [1, K]. The plain in-refs are shadows of the aliased buffers
     and are unused.
     """
@@ -237,6 +246,8 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         put(RF, j, gather(RF, i_idx, -1), do)
         put(OF, j, gather(OF, i_idx, 0) + off, do)
         put(KY, j, gather(KY, i_idx, -1), do)
+        put(PR, j, gather(PR, i_idx, -1), do)
+        put(HD, j, jnp.full((DB,), -1, I32), do)
         # fix left half + old right neighbor
         put(LN, i_idx, off, do)
         put(RT, i_idx, j, do)
@@ -400,6 +411,8 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         put(RF, j, jnp.full((DB,), r_ref, I32), do)
         put(OF, j, c_off, do)
         put(KY, j, jnp.full((DB,), r_key, I32), do)
+        put(PR, j, jnp.full((DB,), -1, I32), do)  # fused path: root-only
+        put(HD, j, jnp.full((DB,), -1, I32), do)
         meta_ref[:, M_NBLOCKS] = n_blocks() + do.astype(I32)
         meta_ref[:, M_ERROR] = (
             meta_ref[:, M_ERROR]
@@ -435,7 +448,7 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
 
     def step(s, _):
         def row_body(u, __):
-            @pl.when(rows_ref[s, u, 11] == 1)
+            @pl.when(rows_ref[s, u, 14] == 1)
             def _():
                 integrate_row(s, u)
 
@@ -492,13 +505,21 @@ def apply_update_stream_fused(
     client_rank: jax.Array,
     d_block: int = 32,
     interpret: bool = False,
+    guard: bool = True,
 ) -> DocStateBatch:
     """Fused-replay drop-in for `apply_update_stream` (same semantics for
-    sequence streams; map rows are not supported in the fused kernel)."""
-    if bool(jnp.any(stream.key >= 0)):
+    sequence streams; map rows are not supported in the fused kernel).
+
+    Callers that built the stream through a `BatchEncoder` should check the
+    encoder's `saw_map_or_nested` flag and pass `guard=False` — the default
+    device-side guard costs a host-device sync before launch."""
+    if guard and bool(
+        jnp.any((stream.key >= 0) | ((stream.p_tag == 2) & stream.valid))
+    ):
         raise NotImplementedError(
-            "apply_update_stream_fused integrates sequence rows only; "
-            "streams with map rows (parent_sub) must take apply_update_stream"
+            "apply_update_stream_fused integrates root sequence rows only; "
+            "streams with map rows (parent_sub) or nested-branch parents "
+            "must take apply_update_stream"
         )
     cols, meta = pack_state(state)
     D = cols.shape[1]
