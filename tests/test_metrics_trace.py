@@ -1,0 +1,76 @@
+"""Metrics + tracing subsystem (SURVEY §5.1/§5.5 greenfield additions)."""
+
+import json
+
+from ytpu.utils import MetricsRegistry, Tracer
+
+
+def test_counter_and_histogram():
+    reg = MetricsRegistry()
+    c = reg.counter("ops")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+
+    h = reg.histogram("lat")
+    for ms in [1, 1, 2, 2, 3, 100]:
+        h.observe(ms / 1000)
+    assert h.count == 6
+    assert 0.0005 < h.p50_s < 0.01
+    assert h.p99_s >= 0.05  # dominated by the 100ms outlier
+    snap = reg.snapshot()
+    assert snap["ops"] == 5
+    assert snap["lat.count"] == 6
+
+
+def test_histogram_timer():
+    reg = MetricsRegistry()
+    h = reg.histogram("t")
+    with h.time():
+        pass
+    assert h.count == 1
+    assert h.p99_s < 0.1
+
+
+def test_tracer_chrome_export(tmp_path):
+    tr = Tracer(enabled=True)
+    with tr.span("decode", n=3):
+        with tr.span("inner"):
+            pass
+    payload = json.loads(tr.export_chrome_trace())
+    names = [e["name"] for e in payload["traceEvents"]]
+    assert names == ["inner", "decode"]  # completion order
+    assert payload["traceEvents"][1]["args"] == {"n": 3}
+
+    path = tmp_path / "trace.json"
+    tr.export_chrome_trace(str(path))
+    assert json.loads(path.read_text())["traceEvents"]
+
+
+def test_tracer_disabled_is_noop():
+    tr = Tracer(enabled=False)
+    with tr.span("x"):
+        pass
+    assert json.loads(tr.export_chrome_trace())["traceEvents"] == []
+
+
+def test_server_records_apply_metrics():
+    from ytpu.core import Doc
+    from ytpu.sync.server import SyncServer
+    from ytpu.sync.protocol import Message, SyncMessage
+    from ytpu.utils import metrics
+
+    metrics.reset()
+    server = SyncServer()
+    s1, _hello = server.connect("room")
+    peer = Doc(client_id=7)
+    with peer.transact() as txn:
+        peer.get_text("t").insert(txn, 0, "hi")
+    update = peer.encode_state_as_update_v1()
+    server.receive(s1, Message.sync(SyncMessage.update(update)).encode_v1())
+
+    snap = metrics.snapshot()
+    assert snap["sync.updates_applied"] == 1
+    assert snap["sync.apply_update.count"] == 1
+    assert snap["sync.apply_update.p99_s"] > 0
+    assert server.doc("room").get_text("t").get_string() == "hi"

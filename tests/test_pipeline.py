@@ -1,0 +1,76 @@
+"""Decode->integrate pipeline parity (PP axis; SURVEY §2 parallelism table)."""
+
+import random
+
+from ytpu.core import Doc, Update
+from ytpu.models.batch_doc import (
+    BatchEncoder,
+    apply_update_batch,
+    get_string,
+    get_tree,
+    init_state,
+)
+from ytpu.models.pipeline import UpdatePipeline
+
+
+def make_payload_stream(n_txns=40, seed=5):
+    """A realistic per-transaction update stream from two host clients."""
+    rng = random.Random(seed)
+    a, b = Doc(client_id=1), Doc(client_id=2)
+    payloads = []
+    for d in (a, b):
+        d.observe_update_v1(lambda p, origin, txn: payloads.append(p))
+    for i in range(n_txns):
+        d = rng.choice((a, b))
+        t = d.get_text("t")
+        with d.transact() as txn:
+            pos = rng.randrange(t.branch.content_len + 1)
+            if rng.random() < 0.8 or t.branch.content_len == 0:
+                t.insert(txn, pos, f"w{i} ")
+            else:
+                t.remove_range(txn, 0, min(2, t.branch.content_len))
+        # immediate full sync keeps both clients' updates causally ordered
+        other = b if d is a else a
+        other.apply_update_v1(d.encode_state_as_update_v1(other.state_vector()))
+    assert a.get_text("t").get_string() == b.get_text("t").get_string()
+    return payloads, a.get_text("t").get_string()
+
+
+def test_pipeline_matches_direct_path():
+    payloads, expected = make_payload_stream()
+    enc = BatchEncoder(root_name="t")
+    pipe = UpdatePipeline(enc, n_rows=8, n_dels=4, chunk_steps=8)
+    state, chunks = pipe.run(init_state(4, 512), payloads)
+    assert chunks >= len(payloads) // 8
+    assert int(max(state.error.tolist())) == 0
+    for d in range(4):
+        assert get_string(state, d, enc.payloads) == expected
+
+    # same result as the one-batch-at-a-time direct path
+    enc2 = BatchEncoder(root_name="t")
+    state2 = init_state(4, 512)
+    for p in payloads:
+        batch = enc2.build_batch([Update.decode_v1(p)] * 4)
+        state2 = apply_update_batch(state2, batch, enc2.interner.rank_table())
+    for d in range(4):
+        assert get_string(state2, d, enc2.payloads) == expected
+
+
+def test_pipeline_tail_chunk_padding():
+    """Payload count not divisible by chunk_steps still integrates fully."""
+    payloads, expected = make_payload_stream(n_txns=13, seed=6)
+    enc = BatchEncoder(root_name="t")
+    pipe = UpdatePipeline(enc, n_rows=8, n_dels=4, chunk_steps=5)
+    state, chunks = pipe.run(init_state(2, 256), payloads)
+    assert chunks == (len(payloads) + 4) // 5
+    assert int(max(state.error.tolist())) == 0
+    assert get_string(state, 0, enc.payloads) == expected
+
+
+def test_pipeline_decode_error_surfaces():
+    enc = BatchEncoder(root_name="t")
+    pipe = UpdatePipeline(enc, n_rows=8, n_dels=4, chunk_steps=4)
+    import pytest
+
+    with pytest.raises(Exception):
+        pipe.run(init_state(1, 64), [b"\xff\xff\xff garbage"])

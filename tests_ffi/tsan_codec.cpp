@@ -1,0 +1,54 @@
+// ThreadSanitizer runner for the native lib0 codec (SURVEY §5.2: the C++
+// host layer runs under TSAN in CI). Four threads concurrently decode the
+// same v1 update buffer through the ytpu_decode_update_v1 C ABI; the codec
+// must be reentrant with no shared mutable state.
+//
+// Build: g++ -O1 -g -fsanitize=thread -std=c++17 \
+//          tests_ffi/tsan_codec.cpp ytpu/native/lib0_codec.cpp -o tsan_codec
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void *ytpu_decode_update_v1(const uint8_t *data, size_t len);
+int ytpu_columns_error(void *handle);
+size_t ytpu_columns_n_blocks(void *handle);
+const int64_t *ytpu_col_client(void *handle);
+void ytpu_columns_free(void *handle);
+size_t ytpu_decode_var_uints(const uint8_t *data, size_t len, uint64_t *out,
+                             size_t max_out);
+}
+
+// one-block v1 update: client 3 inserts "hi" into root text "text"
+static const uint8_t kUpdate[] = {0x01, 0x01, 0x03, 0x00, 0x04, 0x01, 0x04,
+                                  0x74, 0x65, 0x78, 0x74, 0x02, 0x68, 0x69,
+                                  0x00};
+
+int main() {
+  int failures = 0;
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 4; ++t) {
+    threads.emplace_back([&failures]() {
+      for (int i = 0; i < 500; ++i) {
+        void *cols = ytpu_decode_update_v1(kUpdate, sizeof(kUpdate));
+        if (!cols || ytpu_columns_error(cols) != 0 ||
+            ytpu_columns_n_blocks(cols) != 1 || ytpu_col_client(cols)[0] != 3) {
+          __atomic_fetch_add(&failures, 1, __ATOMIC_SEQ_CST);
+        }
+        if (cols) ytpu_columns_free(cols);
+        uint64_t out[4];
+        const uint8_t varints[] = {0x05, 0xac, 0x02};  // 5, 300
+        if (ytpu_decode_var_uints(varints, sizeof(varints), out, 4) != 2 ||
+            out[0] != 5 || out[1] != 300) {
+          __atomic_fetch_add(&failures, 1, __ATOMIC_SEQ_CST);
+        }
+      }
+    });
+  }
+  for (auto &t : threads) t.join();
+  std::printf(failures == 0 ? "TSAN codec OK\n" : "TSAN codec FAILED (%d)\n",
+              failures);
+  return failures == 0 ? 0 : 1;
+}

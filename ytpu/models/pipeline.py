@@ -1,0 +1,142 @@
+"""Pipeline-parallel update ingestion: host decode overlapped with device
+integration.
+
+This is the PP axis of SURVEY.md §2's parallelism table: the reference's
+integration driver interleaves decode and integrate on one thread
+(update.rs:169-308 after decode_v1); here the two stages run as a two-deep
+pipeline — a decode worker turns raw lib0 payloads into `UpdateBatch`
+micro-chunks while the device integrates the previous chunk. JAX's async
+dispatch means the main thread only *launches* device work; the decode
+worker owns the Python-side cost (varint decode, row building, padding), so
+wall-clock approaches max(decode, integrate) instead of their sum.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, List, Optional, Tuple
+
+import jax
+
+from ytpu.core import Update
+from ytpu.models.batch_doc import (
+    BatchEncoder,
+    DocStateBatch,
+    UpdateBatch,
+    apply_update_stream,
+)
+
+__all__ = ["UpdatePipeline"]
+
+
+class UpdatePipeline:
+    """Two-stage decode→integrate pipeline over update payload streams.
+
+    Chunks are `chunk_steps` updates stacked into one `[S, ...]` stream
+    (each step broadcast to every doc slot, the multi-tenant replay shape);
+    one `lax.scan` program integrates a whole chunk per dispatch.
+    """
+
+    def __init__(
+        self,
+        enc: BatchEncoder,
+        n_rows: int,
+        n_dels: int,
+        chunk_steps: int = 64,
+        depth: int = 2,
+        decode_v2: bool = False,
+    ):
+        self.enc = enc
+        self.n_rows = n_rows
+        self.n_dels = n_dels
+        self.chunk_steps = chunk_steps
+        self.depth = depth
+        self.decode_v2 = decode_v2
+
+    def _chunks(self, payloads: Iterable[bytes]):
+        """Decode + build padded micro-chunks (runs on the worker thread)."""
+        steps: List[UpdateBatch] = []
+        for p in payloads:
+            u = Update.decode_v2(p) if self.decode_v2 else Update.decode_v1(p)
+            steps.append(self.enc.build_step(u, self.n_rows, self.n_dels))
+            if len(steps) == self.chunk_steps:
+                yield BatchEncoder.stack_steps(steps)
+                steps = []
+        if steps:
+            # pad the tail chunk to the same S so one compiled program serves
+            # every chunk (padding steps carry valid=False rows only)
+            pad = steps[-1]._replace(
+                valid=jax.numpy.zeros_like(steps[-1].valid),
+                del_valid=jax.numpy.zeros_like(steps[-1].del_valid),
+            )
+            while len(steps) < self.chunk_steps:
+                steps.append(pad)
+            yield BatchEncoder.stack_steps(steps)
+
+    def run(
+        self,
+        state: DocStateBatch,
+        payloads: Iterable[bytes],
+        client_rank: Optional[jax.Array] = None,
+    ) -> Tuple[DocStateBatch, int]:
+        """Integrate every payload; returns (state, chunks_dispatched).
+
+        The decode worker stays `depth` chunks ahead at most (bounded queue
+        = backpressure), the main thread dispatches device work and
+        immediately returns to pull the next chunk.
+        """
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        SENTINEL = object()
+        err: List[BaseException] = []
+        stop = threading.Event()
+
+        def worker():
+            try:
+                for chunk in self._chunks(payloads):
+                    # bounded put, re-checked so a dying consumer (see the
+                    # finally below) can never strand this thread
+                    while not stop.is_set():
+                        try:
+                            q.put(chunk, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:  # surface decode errors on the caller
+                err.append(e)
+            finally:
+                try:
+                    q.put_nowait(SENTINEL)
+                except queue.Full:
+                    pass  # consumer is draining; stop flag ends it
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        n = 0
+        rank = client_rank
+        rank_clients = -1
+        try:
+            while True:
+                chunk = q.get()
+                if chunk is SENTINEL:
+                    break
+                if client_rank is None and len(self.enc.interner) != rank_clients:
+                    # rebuilt only when a new client appeared; power-of-two
+                    # padding keeps the compiled program stable meanwhile
+                    rank_clients = len(self.enc.interner)
+                    rank = self.enc.interner.rank_table()
+                state = apply_update_stream(state, chunk, rank)
+                n += 1
+        finally:
+            stop.set()
+            while True:  # unblock the worker if it is mid-put
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join()
+        if err:
+            raise err[0]
+        return state, n

@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ytpu.core import Doc
+from ytpu.utils import trace_span
 
 from .awareness import Awareness
 from .protocol import Message, Protocol, SyncMessage, message_reader
@@ -45,10 +46,14 @@ class _Tenant:
 
 class SyncServer:
     def __init__(self, protocol: Optional[Protocol] = None, doc_factory=None):
+        from ytpu.utils import metrics
+
         self.protocol = protocol or Protocol()
         self.tenants: Dict[str, _Tenant] = {}
         self._doc_factory = doc_factory or (lambda name: Doc())
         self._next_session = 0
+        self._apply_hist = metrics.histogram("sync.apply_update")
+        self._applied = metrics.counter("sync.updates_applied")
 
     # --- tenant / doc management ----------------------------------------------
 
@@ -91,16 +96,25 @@ class SyncServer:
 
     def receive(self, session: Session, data: bytes) -> bytes:
         """Process incoming frames; returns direct reply bytes. Broadcasts to
-        other sessions land in their `outbox`."""
+        other sessions land in their `outbox`.
+
+        Observability (SURVEY §5.5): every applied update is counted and its
+        apply latency lands in the `sync.apply_update` histogram — the p99 of
+        this series is the BASELINE SLO metric."""
         t = self.tenant(session.tenant)
         replies: List[bytes] = []
+        hist = self._apply_hist
+        applied = self._applied
         for msg in message_reader(data):
-            if msg.kind == 0 and msg.body.tag == 2:  # Sync/Update
+            if msg.kind == 0 and msg.body.tag in (1, 2):  # SyncStep2 / Update
                 # apply with the session as origin so we don't echo it back
-                t.awareness.doc.apply_update_v1(msg.body.payload, origin=session)
-                continue
-            if msg.kind == 0 and msg.body.tag == 1:  # SyncStep2
-                t.awareness.doc.apply_update_v1(msg.body.payload, origin=session)
+                with hist.time(), trace_span(
+                    "apply_update", tenant=session.tenant
+                ):
+                    t.awareness.doc.apply_update_v1(
+                        msg.body.payload, origin=session
+                    )
+                applied.inc()
                 continue
             if msg.kind == 1:  # Awareness: apply + broadcast to others
                 t.awareness.apply_update(msg.body)

@@ -1,0 +1,14 @@
+"""Host-side utilities: metrics and tracing (SURVEY §5.1/§5.5 greenfield)."""
+
+from .metrics import Counter, Histogram, MetricsRegistry, metrics
+from .trace import Tracer, trace_span, tracer
+
+__all__ = [
+    "Counter",
+    "Histogram",
+    "MetricsRegistry",
+    "metrics",
+    "Tracer",
+    "trace_span",
+    "tracer",
+]

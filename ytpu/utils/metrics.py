@@ -1,0 +1,152 @@
+"""Lightweight metrics: counters + log-bucketed latency histograms.
+
+The reference has no metrics framework (SURVEY §5.5 — its observability
+surface is the event system); the TPU build adds real metrics because its
+BASELINE targets are throughput (updates integrated/sec) and p99
+apply_update latency. Thread-safe, allocation-free on the hot path.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+__all__ = ["Counter", "Histogram", "MetricsRegistry", "metrics"]
+
+
+class Counter:
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Histogram:
+    """Log-scale bucketed histogram (2 buckets per octave, 1us..~137s).
+
+    Quantiles come from bucket interpolation — adequate for p50/p99 SLO
+    tracking at zero per-sample allocation.
+    """
+
+    BUCKETS_PER_OCTAVE = 2
+    MIN_US = 1.0
+    N_BUCKETS = 2 * 28  # up to ~2^28 us ≈ 268s
+
+    __slots__ = ("name", "_counts", "_sum_us", "_n", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._counts = [0] * self.N_BUCKETS
+        self._sum_us = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def _bucket(self, us: float) -> int:
+        if us <= self.MIN_US:
+            return 0
+        b = int(self.BUCKETS_PER_OCTAVE * math.log2(us))
+        return min(max(b, 0), self.N_BUCKETS - 1)
+
+    def observe(self, seconds: float) -> None:
+        us = seconds * 1e6
+        b = self._bucket(us)
+        with self._lock:
+            self._counts[b] += 1
+            self._sum_us += us
+            self._n += 1
+
+    @contextmanager
+    def time(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0)
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    @property
+    def mean_s(self) -> float:
+        return (self._sum_us / self._n) / 1e6 if self._n else 0.0
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile in seconds (upper bucket bound interp)."""
+        with self._lock:
+            n = self._n
+            if n == 0:
+                return 0.0
+            target = q * n
+            acc = 0
+            for b, c in enumerate(self._counts):
+                acc += c
+                if acc >= target:
+                    upper_us = 2 ** ((b + 1) / self.BUCKETS_PER_OCTAVE)
+                    return upper_us / 1e6
+            return 2 ** (self.N_BUCKETS / self.BUCKETS_PER_OCTAVE) / 1e6
+
+    @property
+    def p50_s(self) -> float:
+        return self.quantile(0.50)
+
+    @property
+    def p99_s(self) -> float:
+        return self.quantile(0.99)
+
+
+class MetricsRegistry:
+    """Process-wide named metrics; `snapshot()` renders a flat dict."""
+
+    def __init__(self):
+        self._counters: Dict[str, Counter] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name)
+            return h
+
+    def snapshot(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for name, c in self._counters.items():
+            out[name] = c.value
+        for name, h in self._histograms.items():
+            out[f"{name}.count"] = h.count
+            out[f"{name}.mean_s"] = h.mean_s
+            out[f"{name}.p50_s"] = h.p50_s
+            out[f"{name}.p99_s"] = h.p99_s
+        return out
+
+    def reset(self) -> None:
+        """Test-only: metric objects cached by holders keep working but
+        drop out of future snapshot() results."""
+        with self._lock:
+            self._counters.clear()
+            self._histograms.clear()
+
+
+metrics = MetricsRegistry()

@@ -1,0 +1,75 @@
+"""Host span tracing with Chrome-trace export (SURVEY §5.1 greenfield).
+
+The reference's only introspection is Debug/Display dumps; here spans wrap
+the host stages (decode, dispatch, encode, commit) and export to the
+chrome://tracing / Perfetto JSON format. Device-side profiling remains
+jax.profiler's job — `trace_span` nests correctly under its host annotations
+because both use wall-clock.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from typing import List, Optional
+
+__all__ = ["Tracer", "trace_span", "tracer"]
+
+
+class Tracer:
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+        self._t0 = time.perf_counter()
+
+    @contextmanager
+    def span(self, name: str, **args):
+        if not self.enabled:
+            yield
+            return
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            end = time.perf_counter()
+            ev = {
+                "name": name,
+                "ph": "X",  # complete event
+                "ts": (start - self._t0) * 1e6,
+                "dur": (end - start) * 1e6,
+                "pid": 0,
+                "tid": threading.get_ident() % 1_000_000,
+            }
+            if args:
+                ev["args"] = args
+            with self._lock:
+                self._events.append(ev)
+
+    def export_chrome_trace(self, path: Optional[str] = None) -> str:
+        payload = json.dumps({"traceEvents": list(self._events)})
+        if path:
+            with open(path, "w") as f:
+                f.write(payload)
+        return payload
+
+
+tracer = Tracer()
+
+
+def trace_span(name: str, **args):
+    """Span on the process-wide tracer (no-op unless tracer.enable())."""
+    return tracer.span(name, **args)
