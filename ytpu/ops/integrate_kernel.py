@@ -55,11 +55,12 @@ I32 = jnp.int32
     KD,  # content kind
     RF,  # content ref
     OF,  # content offset
-    KY,  # interned parent_sub key (-1 = sequence item)
-    PR,  # parent ContentType row (-1 = root)
-    HD,  # child-sequence head (ContentType rows)
-) = range(17)
-NC = 17
+) = range(14)
+NC = 14
+# key/parent/head columns are NOT packed: the fused kernel is root-sequence
+# only (guarded below), where every row's key/parent/head is -1 forever —
+# the state's original columns pass through unchanged (split/new rows land
+# in slots init_state pre-filled with -1).
 
 # meta columns in the packed [D, 8] array (padded to a TPU-friendly lane dim)
 M_START, M_NBLOCKS, M_ERROR = 0, 1, 2
@@ -87,9 +88,6 @@ def pack_state(state: DocStateBatch) -> Tuple[jax.Array, jax.Array]:
             bl.kind,
             bl.content_ref,
             bl.content_off,
-            bl.key,
-            bl.parent,
-            bl.head,
         ]
     )  # [NC, D, C]
     D = state.start.shape[0]
@@ -100,7 +98,11 @@ def pack_state(state: DocStateBatch) -> Tuple[jax.Array, jax.Array]:
     return cols, meta
 
 
-def unpack_state(cols: jax.Array, meta: jax.Array) -> DocStateBatch:
+def unpack_state(
+    cols: jax.Array, meta: jax.Array, state: DocStateBatch
+) -> DocStateBatch:
+    """Rebuild state from kernel outputs; key/parent/head pass through from
+    the pre-kernel `state` (constant -1 on the fused root-sequence path)."""
     blocks = BlockCols(
         client=cols[CL],
         clock=cols[CK],
@@ -116,9 +118,9 @@ def unpack_state(cols: jax.Array, meta: jax.Array) -> DocStateBatch:
         kind=cols[KD],
         content_ref=cols[RF],
         content_off=cols[OF],
-        key=cols[KY],
-        parent=cols[PR],
-        head=cols[HD],
+        key=state.blocks.key,
+        parent=state.blocks.parent,
+        head=state.blocks.head,
     )
     return DocStateBatch(
         blocks=blocks,
@@ -129,7 +131,7 @@ def unpack_state(cols: jax.Array, meta: jax.Array) -> DocStateBatch:
 
 
 def pack_stream(stream: UpdateBatch) -> Tuple[jax.Array, jax.Array]:
-    """Stacked doc-axis-free stream → rows [S, U, 15] / dels [S, R, 4] i32."""
+    """Stacked doc-axis-free stream → rows [S, U, 11] / dels [S, R, 4] i32."""
     rows = jnp.stack(
         [
             stream.client,
@@ -142,14 +144,10 @@ def pack_stream(stream: UpdateBatch) -> Tuple[jax.Array, jax.Array]:
             stream.kind,
             stream.content_ref,
             stream.content_off,
-            stream.key,
-            stream.p_tag,
-            stream.p_client,
-            stream.p_clock,
             stream.valid.astype(I32),
         ],
         axis=-1,
-    )  # [S, U, 15]
+    )  # [S, U, 11]
     dels = jnp.stack(
         [
             stream.del_client,
@@ -166,7 +164,7 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
     """One doc tile: integrate the whole stream in VMEM.
 
     cols_ref: [NC, DB, C] out-ref aliased to the input (holds the state),
-    meta_ref: [DB, 8] aliased; rows_ref: [S, U, 15], dels_ref: [S, R, 4],
+    meta_ref: [DB, 8] aliased; rows_ref: [S, U, 11], dels_ref: [S, R, 4],
     rank_ref: [1, K]. The plain in-refs are shadows of the aliased buffers
     and are unused.
     """
@@ -245,9 +243,6 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         put(KD, j, gather(KD, i_idx, 0), do)
         put(RF, j, gather(RF, i_idx, -1), do)
         put(OF, j, gather(OF, i_idx, 0) + off, do)
-        put(KY, j, gather(KY, i_idx, -1), do)
-        put(PR, j, gather(PR, i_idx, -1), do)
-        put(HD, j, jnp.full((DB,), -1, I32), do)
         # fix left half + old right neighbor
         put(LN, i_idx, off, do)
         put(RT, i_idx, j, do)
@@ -279,8 +274,6 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         r_kind = rows_ref[s, u, 7]
         r_ref = rows_ref[s, u, 8]
         r_off = rows_ref[s, u, 9]
-        r_key = rows_ref[s, u, 10]  # carried through; the fused kernel is
-        # sequence-only — map rows (key >= 0) must take the XLA path
 
         local = client_clock(r_client)  # (DB,)
         applicable = local >= r_clock
@@ -410,9 +403,6 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         put(KD, j, jnp.full((DB,), r_kind, I32), do)
         put(RF, j, jnp.full((DB,), r_ref, I32), do)
         put(OF, j, c_off, do)
-        put(KY, j, jnp.full((DB,), r_key, I32), do)
-        put(PR, j, jnp.full((DB,), -1, I32), do)  # fused path: root-only
-        put(HD, j, jnp.full((DB,), -1, I32), do)
         meta_ref[:, M_NBLOCKS] = n_blocks() + do.astype(I32)
         meta_ref[:, M_ERROR] = (
             meta_ref[:, M_ERROR]
@@ -448,7 +438,7 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
 
     def step(s, _):
         def row_body(u, __):
-            @pl.when(rows_ref[s, u, 14] == 1)
+            @pl.when(rows_ref[s, u, 10] == 1)
             def _():
                 integrate_row(s, u)
 
@@ -510,16 +500,22 @@ def apply_update_stream_fused(
     """Fused-replay drop-in for `apply_update_stream` (same semantics for
     sequence streams; map rows are not supported in the fused kernel).
 
-    Callers that built the stream through a `BatchEncoder` should check the
-    encoder's `saw_map_or_nested` flag and pass `guard=False` — the default
-    device-side guard costs a host-device sync before launch."""
+    Precondition: both the stream AND the current state are root-sequence
+    only (key/parent == -1 everywhere) — splits in the fused kernel do not
+    carry key/parent, so a mixed state would silently lose that linkage.
+    Callers that built everything through one `BatchEncoder` from
+    `init_state` should check the encoder's `saw_map_or_nested` flag and
+    pass `guard=False` — the default device-side guard costs one
+    host-device sync before launch."""
     if guard and bool(
         jnp.any((stream.key >= 0) | ((stream.p_tag == 2) & stream.valid))
+        | jnp.any(state.blocks.key >= 0)
+        | jnp.any(state.blocks.parent >= 0)
     ):
         raise NotImplementedError(
-            "apply_update_stream_fused integrates root sequence rows only; "
-            "streams with map rows (parent_sub) or nested-branch parents "
-            "must take apply_update_stream"
+            "apply_update_stream_fused integrates root-sequence-only "
+            "streams over root-sequence-only states; map rows (parent_sub) "
+            "or nested-branch parents must take apply_update_stream"
         )
     cols, meta = pack_state(state)
     D = cols.shape[1]
@@ -527,4 +523,4 @@ def apply_update_stream_fused(
         raise ValueError(f"n_docs {D} must be a multiple of d_block {d_block}")
     rows, dels = pack_stream(stream)
     cols, meta = _run(cols, meta, (rows, dels, client_rank), d_block, interpret)
-    return unpack_state(cols, meta)
+    return unpack_state(cols, meta, state)
