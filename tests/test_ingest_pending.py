@@ -1,0 +1,136 @@
+"""Batch-level pending-update semantics (SURVEY §7 hard part: a doc whose
+update goes pending must not stall its batch; parity: transaction.rs:675-727
+stash-and-retry, update.rs:289-299)."""
+
+from ytpu.core import Doc
+from ytpu.models.batch_doc import get_map, get_string, get_tree
+from ytpu.models.ingest import BatchIngestor
+
+
+def txn_payloads(client_id, edits):
+    """One payload per transaction from a fresh host doc."""
+    doc = Doc(client_id=client_id)
+    out = []
+    doc.observe_update_v1(lambda p, o, t: out.append(p))
+    for fn in edits:
+        with doc.transact() as txn:
+            fn(doc, txn)
+    return doc, out
+
+
+def test_out_of_order_update_goes_pending_then_applies():
+    doc, payloads = txn_payloads(
+        7,
+        [
+            lambda d, t: d.get_text("text").insert(t, 0, "first"),
+            lambda d, t: d.get_text("text").insert(t, 5, "-second"),
+        ],
+    )
+    ing = BatchIngestor(n_docs=2, capacity=64)
+    # doc slot 0 receives txn2 BEFORE txn1; slot 1 receives them in order
+    ing.apply([payloads[1], payloads[0]])
+    assert int(ing.state.error.max()) == 0
+    assert ing.pending_update(0) is not None  # stashed, not integrated
+    assert get_string(ing.state, 0, ing.enc.payloads) == ""
+    assert get_string(ing.state, 1, ing.enc.payloads) == "first"
+
+    ing.apply([payloads[0], payloads[1]])  # the missing base arrives
+    assert int(ing.state.error.max()) == 0
+    assert ing.pending_update(0) is None  # stash drained
+    for d in range(2):
+        assert get_string(ing.state, d, ing.enc.payloads) == "first-second"
+
+
+def test_pending_doc_does_not_stall_batch():
+    doc_a, pa = txn_payloads(1, [lambda d, t: d.get_text("text").insert(t, 0, "a0"),
+                                 lambda d, t: d.get_text("text").insert(t, 2, "a1")])
+    doc_b, pb = txn_payloads(2, [lambda d, t: d.get_text("text").insert(t, 0, "b0")])
+    ing = BatchIngestor(n_docs=2, capacity=64)
+    # slot 0 gets a dependent update with no base (pending); slot 1 is normal
+    ing.apply([pa[1], pb[0]])
+    assert int(ing.state.error.max()) == 0
+    assert get_string(ing.state, 1, ing.enc.payloads) == "b0"  # not stalled
+    assert ing.pending_update(0) is not None
+
+
+def test_pending_delete_set_defers_and_applies():
+    doc, payloads = txn_payloads(
+        3,
+        [
+            lambda d, t: d.get_text("text").insert(t, 0, "abcdef"),
+            lambda d, t: d.get_text("text").remove_range(t, 1, 3),
+        ],
+    )
+    ing = BatchIngestor(n_docs=1, capacity=64)
+    ing.apply([payloads[1]])  # delete arrives before the content
+    assert ing.pending_ds(0) is not None
+    assert get_string(ing.state, 0, ing.enc.payloads) == ""
+    ing.apply([payloads[0]])
+    assert int(ing.state.error.max()) == 0
+    assert ing.pending_ds(0) is None
+    assert get_string(ing.state, 0, ing.enc.payloads) == doc.get_text("text").get_string() == "aef"
+
+
+def test_interleaved_multi_client_catchup():
+    """Cross-client deps: client B quotes A's content; B's update arrives
+    first, then A's — both integrate once the stash drains."""
+    a = Doc(client_id=10)
+    with a.transact() as txn:
+        a.get_text("text").insert(txn, 0, "base")
+    ua = a.encode_state_as_update_v1()
+    b = Doc(client_id=20)
+    b.apply_update_v1(ua)
+    captured = []
+    b.observe_update_v1(lambda p, o, t: captured.append(p))
+    with b.transact() as txn:
+        b.get_text("text").insert(txn, 4, "-tail")  # origin in A's range
+    ub = captured[0]
+
+    ing = BatchIngestor(n_docs=1, capacity=64)
+    ing.apply([ub])  # depends on A's blocks → pending
+    assert get_string(ing.state, 0, ing.enc.payloads) == ""
+    ing.apply([ua])
+    assert int(ing.state.error.max()) == 0
+    assert get_string(ing.state, 0, ing.enc.payloads) == "base-tail"
+    assert ing.pending_update(0) is None
+
+
+def test_map_and_tree_through_ingestor():
+    doc, payloads = txn_payloads(
+        5,
+        [
+            lambda d, t: d.get_map("text").insert(t, "k", 1),
+            lambda d, t: d.get_map("text").insert(t, "k", 2),
+        ],
+    )
+    ing = BatchIngestor(n_docs=1, capacity=64)
+    ing.apply([payloads[1]])  # overwrite before base -> pending
+    ing.apply([payloads[0]])
+    assert int(ing.state.error.max()) == 0
+    assert get_map(ing.state, 0, ing.enc.payloads, ing.enc.keys) == {"k": 2}
+
+
+def test_redelivery_does_not_grow_stash():
+    """Exact re-sends of a stuck update dedupe instead of accumulating."""
+    doc, payloads = txn_payloads(
+        9,
+        [
+            lambda d, t: d.get_text("text").insert(t, 0, "base"),
+            lambda d, t: d.get_text("text").insert(t, 4, "-dep"),
+        ],
+    )
+    ing = BatchIngestor(n_docs=1, capacity=64)
+    for _ in range(4):  # same dependent payload redelivered 4x
+        ing.apply([payloads[1]])
+    stash = ing.pending_update(0)
+    assert stash is not None
+    assert sum(len(q) for q in stash.blocks.values()) == 1  # deduped
+    n_payload_entries = len(ing.enc.payloads.items)
+
+    ing.apply([payloads[0]])
+    assert get_string(ing.state, 0, ing.enc.payloads) == "base-dep"
+    assert ing.pending_update(0) is None
+    # already-applied redelivery is dropped host-side, not re-stashed
+    ing.apply([payloads[1]])
+    assert ing.pending_update(0) is None
+    assert get_string(ing.state, 0, ing.enc.payloads) == "base-dep"

@@ -43,3 +43,8 @@ __all__ = [
     "init_state",
     "state_vectors",
 ]
+
+from .ingest import BatchIngestor  # noqa: E402
+from .pipeline import UpdatePipeline  # noqa: E402
+
+__all__ += ["BatchIngestor", "UpdatePipeline"]

@@ -851,27 +851,37 @@ class BatchEncoder:
         # (streams with such rows cannot take the fused Pallas path)
         self.saw_map_or_nested = False
 
-    def _ordered_carriers(self, update: Update) -> list:
-        """Carriers in dependency order — the host half of the reference's
-        integration stack machine (update.rs:169-308): clients descending,
-        but a block whose origin/right-origin points into another client's
-        not-yet-emitted range defers until that range lands. Dependencies
-        below each client's first in-update clock are assumed present in
-        device state (the device flags them otherwise)."""
+    def partition_carriers(self, update: Update, local_sv=None):
+        """(applicable, leftover) carriers — the host half of the reference's
+        integration stack machine (update.rs:169-308 + missing() :310-385):
+        clients descending, but a block whose origin/right-origin/parent
+        points into a not-yet-emitted range defers until that range lands.
+
+        With `local_sv` (a StateVector mirror of the target doc) the check
+        is exact: dependencies must be covered by the mirror or by already
+        emitted in-update rows, and each client's rows must be clock-
+        contiguous with the mirror — anything else lands in `leftover` (the
+        PendingUpdate stash semantics of transaction.rs:675-727). Without
+        it, out-of-update dependencies are assumed present in device state
+        (the device flags them otherwise)."""
         queues = {
             c: [x for x in update.blocks[c] if not isinstance(x, SkipRange)]
             for c in sorted(update.blocks.keys(), reverse=True)
         }
         queues = {c: q for c, q in queues.items() if q}
-        base = {c: q[0].id.clock for c, q in queues.items()}
-        emitted = dict(base)
+        if local_sv is None:
+            emitted = {c: q[0].id.clock for c, q in queues.items()}
+        else:
+            emitted = {c: local_sv.get(c) for c in queues}
         heads = {c: 0 for c in queues}
 
         def satisfied(dep) -> bool:
             if dep is None:
                 return True
-            if dep.client not in base:
-                return True  # not part of this update → device-state lookup
+            if dep.client not in emitted:
+                if local_sv is None:
+                    return True  # assumed in device state; device flags
+                return dep.clock < local_sv.get(dep.client)
             return dep.clock < emitted[dep.client]
 
         out = []
@@ -881,6 +891,8 @@ class BatchEncoder:
             for c, q in queues.items():
                 while heads[c] < len(q):
                     carrier = q[heads[c]]
+                    if local_sv is not None and carrier.id.clock > emitted[c]:
+                        break  # clock gap within this client → pending
                     if isinstance(carrier, Item) and not (
                         satisfied(carrier.origin)
                         and satisfied(carrier.right_origin)
@@ -892,16 +904,34 @@ class BatchEncoder:
                     ):
                         break
                     out.append(carrier)
-                    emitted[c] = carrier.id.clock + carrier.len
+                    emitted[c] = max(emitted[c], carrier.id.clock + carrier.len)
                     heads[c] += 1
                     progress = True
-        for c, q in queues.items():  # unsatisfiable leftovers: device flags
-            out.extend(q[heads[c] :])
-        return out
+        leftover = []
+        for c, q in queues.items():
+            leftover.extend(q[heads[c] :])
+        if local_sv is None:
+            # single-pass mode: emit everything; device flags true misses
+            return out + leftover, []
+        return out, leftover
+
+    def _ordered_carriers(self, update: Update) -> list:
+        ordered, _ = self.partition_carriers(update)
+        return ordered
 
     def rows_from_update(self, update: Update) -> Tuple[list, list]:
+        rows = self.rows_from_carriers(self._ordered_carriers(update))
+        dels = []
+        for client, ranges in update.delete_set.clients.items():
+            c = self.interner.intern(client)
+            for s, e in ranges:
+                dels.append((c, s, e))
+        return rows, dels
+
+    def rows_from_carriers(self, carriers: list) -> list:
+        """Row tuples for already-ordered carriers (see partition_carriers)."""
         rows = []
-        for carrier in self._ordered_carriers(update):
+        for carrier in carriers:
             c = self.interner.intern(carrier.id.client)
             if isinstance(carrier, GCRange):
                 rows.append(
@@ -949,12 +979,7 @@ class BatchEncoder:
                 (c, item.id.clock, item.len, oc, ok, rc, rk, kind, ref, 0,
                  key, p_tag, pc, pk)
             )
-        dels = []
-        for client, ranges in update.delete_set.clients.items():
-            c = self.interner.intern(client)
-            for s, e in ranges:
-                dels.append((c, s, e))
-        return rows, dels
+        return rows
 
     def build_batch(
         self,
@@ -973,9 +998,19 @@ class BatchEncoder:
                 r, d = self.rows_from_update(u)
                 all_rows.append(r)
                 all_dels.append(d)
+        return self.batch_from_rows(all_rows, all_dels, n_rows, n_dels)
+
+    def batch_from_rows(
+        self,
+        all_rows: List[list],
+        all_dels: List[list],
+        n_rows: Optional[int] = None,
+        n_dels: Optional[int] = None,
+    ) -> UpdateBatch:
+        """Pad per-doc row/del tuple lists into one [D, U] / [D, R] batch."""
         U = n_rows or max(1, max(len(r) for r in all_rows))
         R = n_dels or max(1, max(len(d) for d in all_dels))
-        D = len(updates)
+        D = len(all_rows)
 
         def pad_rows():
             out = np.zeros((D, U, 14), dtype=np.int32)

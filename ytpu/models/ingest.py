@@ -1,0 +1,148 @@
+"""Batched ingestion with exact pending-update semantics.
+
+The reference stashes an update whose dependencies are unmet and retries it
+when the missing clocks arrive (transaction.rs:675-727, update.rs:289-299
+PendingUpdate; pending delete-sets store.rs:42-50). `BatchIngestor` lifts
+that contract to the batch engine — the SURVEY §7 hard-part "a doc whose
+update goes pending must not stall its batch":
+
+- per doc slot, a host-side `StateVector` mirror tracks exactly what the
+  device holds (rows are planned host-side, so the mirror is exact);
+- each incoming update is partitioned against the mirror
+  (`BatchEncoder.partition_carriers`): the applicable prefix ships in this
+  step's batch, the remainder is stashed per doc;
+- delete ranges beyond the mirror stash into a per-doc pending delete set;
+- every later step re-merges the stash with new arrivals, so blocks
+  integrate the moment their dependencies land — other doc slots in the
+  batch are never stalled, and the device never sees a missing-dep row
+  (`ERR_MISSING_DEP` stays 0 by construction).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ytpu.core import Update
+from ytpu.core.id_set import DeleteSet
+from ytpu.core.state_vector import StateVector
+from ytpu.models.batch_doc import (
+    BatchEncoder,
+    DocStateBatch,
+    apply_update_batch,
+    init_state,
+)
+
+__all__ = ["BatchIngestor"]
+
+
+class BatchIngestor:
+    def __init__(
+        self,
+        n_docs: int,
+        capacity: int,
+        enc: Optional[BatchEncoder] = None,
+    ):
+        self.enc = enc or BatchEncoder()
+        self.n_docs = n_docs
+        self.state: DocStateBatch = init_state(n_docs, capacity)
+        self.svs: List[StateVector] = [StateVector() for _ in range(n_docs)]
+        # per-doc stash: carriers waiting for dependencies + deferred deletes
+        self._pending: List[Dict[int, list]] = [{} for _ in range(n_docs)]
+        self._pending_ds: List[DeleteSet] = [DeleteSet() for _ in range(n_docs)]
+
+    # --- introspection (parity: ytransaction_pending_update/_ds shape) -------
+
+    def pending_update(self, doc: int) -> Optional[Update]:
+        blocks = self._pending[doc]
+        if not blocks:
+            return None
+        return Update({c: list(q) for c, q in blocks.items()}, DeleteSet())
+
+    def pending_ds(self, doc: int) -> Optional[DeleteSet]:
+        ds = self._pending_ds[doc]
+        return None if ds.is_empty() else ds
+
+    # --- ingestion -------------------------------------------------------------
+
+    def _merge_with_stash(self, doc: int, incoming: Optional[Update]) -> Update:
+        blocks: Dict[int, list] = {
+            c: list(q) for c, q in self._pending[doc].items()
+        }
+        ds = DeleteSet({c: list(rs) for c, rs in self._pending_ds[doc].clients.items()})
+        if incoming is not None:
+            for c, q in incoming.blocks.items():
+                blocks.setdefault(c, []).extend(q)
+            for c, ranges in incoming.delete_set.clients.items():
+                for s, e in ranges:
+                    ds.insert_range(c, s, e)
+        sv = self.svs[doc]
+        for c in blocks:
+            blocks[c].sort(key=lambda carrier: carrier.id.clock)
+            # redelivery dedup: drop exact re-sends (same start clock; the
+            # device's offset check handles partial overlaps) and carriers
+            # already fully covered by the mirror
+            seen = set()
+            kept = []
+            for carrier in blocks[c]:
+                if carrier.id.clock in seen:
+                    continue
+                if carrier.id.clock + carrier.len <= sv.get(c):
+                    continue
+                seen.add(carrier.id.clock)
+                kept.append(carrier)
+            blocks[c] = kept
+        blocks = {c: q for c, q in blocks.items() if q}
+        self._pending[doc] = {}
+        self._pending_ds[doc] = DeleteSet()
+        return Update(blocks, ds)
+
+    def _plan_doc(self, doc: int, incoming: Optional[Update]) -> Tuple[list, list]:
+        """(rows, dels) applicable now; the rest returns to the stash."""
+        if incoming is None:
+            # a stuck stash cannot progress without new data for this doc:
+            # its mirror SV only advances through its own incoming updates
+            return [], []
+        merged = self._merge_with_stash(doc, incoming)
+        sv = self.svs[doc]
+        applicable, leftover = self.enc.partition_carriers(merged, sv)
+        for carrier in applicable:
+            sv.set_max(carrier.id.client, carrier.id.clock + carrier.len)
+        for carrier in leftover:
+            self._pending[doc].setdefault(carrier.id.client, []).append(carrier)
+
+        dels: list = []
+        for client, ranges in merged.delete_set.clients.items():
+            covered = sv.get(client)
+            c = self.enc.interner.intern(client)
+            for start, end in ranges:
+                if end <= covered:
+                    dels.append((c, start, end))
+                elif start >= covered:
+                    self._pending_ds[doc].insert_range(client, start, end)
+                else:  # split: tombstone what exists, defer the tail
+                    dels.append((c, start, covered))
+                    self._pending_ds[doc].insert_range(client, covered, end)
+        return self.enc.rows_from_carriers(applicable), dels
+
+    def apply(
+        self, payloads: List[Optional[bytes]], v2: bool = False
+    ) -> DocStateBatch:
+        """One batched step: per-doc update payloads (None = no-op slot)."""
+        updates = [
+            None
+            if p is None
+            else (Update.decode_v2(p) if v2 else Update.decode_v1(p))
+            for p in payloads
+        ]
+        if len(updates) != self.n_docs:
+            raise ValueError(f"expected {self.n_docs} payload slots")
+        all_rows, all_dels = [], []
+        for d, u in enumerate(updates):
+            rows, dels = self._plan_doc(d, u)
+            all_rows.append(rows)
+            all_dels.append(dels)
+        batch = self.enc.batch_from_rows(all_rows, all_dels)
+        self.state = apply_update_batch(
+            self.state, batch, self.enc.interner.rank_table()
+        )
+        return self.state
