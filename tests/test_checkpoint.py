@@ -1,0 +1,100 @@
+"""Device-state checkpoint/resume (SURVEY §5.4 TPU-native addition)."""
+
+from ytpu.core import Doc, Update
+from ytpu.models.batch_doc import (
+    BatchEncoder,
+    apply_update_batch,
+    get_string,
+    get_tree,
+    init_state,
+)
+from ytpu.models.checkpoint import (
+    load_ingestor,
+    load_state,
+    save_ingestor,
+    save_state,
+)
+from ytpu.models.ingest import BatchIngestor
+from ytpu.types.shared import MapPrelim, TextPrelim
+
+
+def test_state_roundtrip_and_resume(tmp_path):
+    doc = Doc(client_id=1)
+    with doc.transact() as txn:
+        r = doc.get_array("r")
+        r.insert(txn, 0, TextPrelim("hello"))
+        r.insert(txn, 1, MapPrelim({"v": 1}))
+    enc = BatchEncoder(root_name="r")
+    state = init_state(2, 128)
+    u = Update.decode_v1(doc.encode_state_as_update_v1())
+    state = apply_update_batch(state, enc.build_batch([u, u]), enc.interner.rank_table())
+
+    save_state(str(tmp_path / "ckpt"), state, enc)
+    state2, enc2 = load_state(str(tmp_path / "ckpt"))
+    for d in range(2):
+        assert get_tree(state2, d, enc2.payloads, enc2.keys)["seq"] == ["hello", {"v": 1}]
+
+    # resume: apply MORE updates onto the restored state
+    with doc.transact() as txn:
+        doc.get_array("r").get(0).insert(txn, 5, "!")
+    diff = Update.decode_v1(doc.encode_state_as_update_v1())
+    state2 = apply_update_batch(
+        state2, enc2.build_batch([diff, diff]), enc2.interner.rank_table()
+    )
+    assert int(state2.error.max()) == 0
+    for d in range(2):
+        assert get_tree(state2, d, enc2.payloads, enc2.keys)["seq"] == ["hello!", {"v": 1}]
+
+
+def test_ingestor_roundtrip_with_pending(tmp_path):
+    src = Doc(client_id=9)
+    payloads = []
+    src.observe_update_v1(lambda p, o, t: payloads.append(p))
+    with src.transact() as txn:
+        src.get_text("text").insert(txn, 0, "base")
+    with src.transact() as txn:
+        src.get_text("text").insert(txn, 4, "-tail")
+
+    ing = BatchIngestor(n_docs=1, capacity=64)
+    ing.apply([payloads[1]])  # dependent first -> pending
+    assert ing.pending_update(0) is not None
+
+    save_ingestor(str(tmp_path / "ing"), ing)
+    restored = load_ingestor(str(tmp_path / "ing"))
+    assert restored.pending_update(0) is not None
+    assert get_string(restored.state, 0, restored.enc.payloads) == ""
+
+    restored.apply([payloads[0]])  # stash drains after restore
+    assert int(restored.state.error.max()) == 0
+    assert restored.pending_update(0) is None
+    assert get_string(restored.state, 0, restored.enc.payloads) == "base-tail"
+
+
+def test_checkpoint_refuses_unknown_format(tmp_path):
+    import pickle
+
+    import pytest
+
+    path = tmp_path / "bad"
+    path.mkdir()
+    with open(path / "host.pkl", "wb") as f:
+        pickle.dump({"format": 999}, f)
+    with pytest.raises(ValueError):
+        load_state(str(path))
+
+
+def test_periodic_save_to_fixed_path_overwrites(tmp_path):
+    doc = Doc(client_id=4)
+    enc = BatchEncoder(root_name="text")
+    state = init_state(1, 64)
+    path = str(tmp_path / "fixed")
+    for i in range(3):  # periodic checkpoint loop to one path
+        with doc.transact() as txn:
+            doc.get_text("text").insert(txn, 0, f"{i}")
+        u = Update.decode_v1(doc.encode_state_as_update_v1(StateVector()))
+        save_state(path, state, enc)
+    state2, enc2 = load_state(path)
+    assert get_string(state2, 0, enc2.payloads) == get_string(state, 0, enc.payloads)
+
+
+from ytpu.core import StateVector  # noqa: E402

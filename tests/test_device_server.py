@@ -1,0 +1,116 @@
+"""Device-backed sync server: protocol tenants mirrored into batch slots."""
+
+from ytpu.core import Doc
+from ytpu.sync.device_server import DeviceSyncServer
+from ytpu.sync.protocol import Message, SyncMessage
+
+
+def push(server, session, peer_doc):
+    sv = server.doc(session.tenant).state_vector()
+    diff = peer_doc.encode_state_as_update_v1(sv)
+    server.receive(session, Message.sync(SyncMessage.update(diff)).encode_v1())
+
+
+def test_tenants_fan_into_device_slots():
+    server = DeviceSyncServer(n_docs=4, capacity=256)
+    s_pad, _ = server.connect("pad")
+    s_doc, _ = server.connect("docs")
+
+    alice = Doc(client_id=1)
+    with alice.transact() as txn:
+        alice.get_text("text").insert(txn, 0, "alice writes")
+    push(server, s_pad, alice)
+
+    bob = Doc(client_id=2)
+    with bob.transact() as txn:
+        bob.get_text("text").insert(txn, 0, "bob too")
+    push(server, s_doc, bob)
+
+    assert server.pending_device_updates() == 2
+    steps = server.flush_device()
+    assert steps == 1  # both tenants ship in ONE batch step
+    assert server.pending_device_updates() == 0
+    assert int(server.ingestor.state.error.max()) == 0
+    assert server.device_text("pad") == "alice writes" == server.doc("pad").get_text("text").get_string()
+    assert server.device_text("docs") == "bob too"
+
+
+def test_chatty_tenant_does_not_block_quiet_one():
+    server = DeviceSyncServer(n_docs=2, capacity=512)
+    s_a, _ = server.connect("chatty")
+    peer = Doc(client_id=5)
+    for i in range(6):
+        with peer.transact() as txn:
+            t = peer.get_text("text")
+            t.insert(txn, t.branch.content_len, f"{i}")
+        push(server, s_a, peer)
+
+    s_b, _ = server.connect("quiet")
+    other = Doc(client_id=6)
+    with other.transact() as txn:
+        other.get_text("text").insert(txn, 0, "q")
+    push(server, s_b, other)
+
+    steps = server.flush_device()
+    assert steps >= 1
+    assert server.device_text("chatty") == "012345"
+    assert server.device_text("quiet") == "q"
+    assert int(server.ingestor.state.error.max()) == 0
+
+
+def test_concurrent_sessions_converge_on_device():
+    server = DeviceSyncServer(n_docs=1, capacity=512)
+    s1, _ = server.connect("room")
+    s2, _ = server.connect("room")
+    a, b = Doc(client_id=11), Doc(client_id=22)
+    for d, text in ((a, "left "), (b, "right ")):
+        with d.transact() as txn:
+            d.get_text("text").insert(txn, 0, text)
+    push(server, s1, a)
+    push(server, s2, b)
+    server.flush_device()
+    assert server.device_text("room") == server.doc("room").get_text("text").get_string()
+
+
+def test_slot_exhaustion_raises():
+    import pytest
+
+    server = DeviceSyncServer(n_docs=1, capacity=64)
+    server.connect("one")
+    with pytest.raises(RuntimeError):
+        server.connect("two")
+
+
+def test_slot_exhaustion_retry_still_raises_and_leaves_no_ghost():
+    import pytest
+
+    server = DeviceSyncServer(n_docs=1, capacity=64)
+    server.connect("one")
+    with pytest.raises(RuntimeError):
+        server.connect("two")
+    assert "two" not in server.tenants  # no ghost tenant registered
+    with pytest.raises(RuntimeError):
+        server.connect("two")  # retry fails identically
+
+
+def test_unknown_tenant_read_raises_instead_of_allocating():
+    import pytest
+
+    server = DeviceSyncServer(n_docs=2, capacity=64)
+    server.connect("pad")
+    with pytest.raises(KeyError):
+        server.device_text("padd")  # typo: no silent slot allocation
+    assert len(server._slot_of) == 1
+
+
+def test_ingestor_is_slot_authority():
+    from ytpu.models.ingest import BatchIngestor
+
+    ing = BatchIngestor(3, 64)
+    server = DeviceSyncServer(ingestor=ing)  # n_docs not needed
+    for name in ("a", "b", "c"):
+        server.connect(name)
+    import pytest
+
+    with pytest.raises(RuntimeError):
+        server.connect("d")

@@ -1,0 +1,174 @@
+"""Checkpoint / resume for the batched device engine (SURVEY §5.4).
+
+The reference's three host mechanisms (full-state update re-apply,
+incremental update logs, Snapshot+skip_gc time travel) are all available in
+`ytpu.core`; this module adds the TPU-native fourth: persisting the device
+block tensors themselves, so a multi-tenant engine restarts without
+replaying history.
+
+Layout: a checkpoint directory holds
+- `arrays/` — the DocStateBatch pytree via orbax (sharding-aware; restores
+  onto whatever mesh the arrays carried), or `arrays.npz` when orbax is
+  unavailable;
+- `host.pkl` — the host sidecars that give the tensors meaning: the
+  encoder's client interner, key interner, payload store and root name,
+  plus (for a BatchIngestor) the per-doc state-vector mirrors and pending
+  stashes.
+
+A checkpoint round-trips the FULL ingest contract: wire encode/decode,
+pending retry and reads behave identically after `load_ingestor`.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ytpu.models.batch_doc import BatchEncoder, BlockCols, DocStateBatch
+from ytpu.models.ingest import BatchIngestor
+
+__all__ = ["save_state", "load_state", "save_ingestor", "load_ingestor"]
+
+_FORMAT = 1
+
+
+def _state_to_numpy(state: DocStateBatch) -> dict:
+    flat = {f"blocks.{k}": np.asarray(v) for k, v in state.blocks._asdict().items()}
+    flat["start"] = np.asarray(state.start)
+    flat["n_blocks"] = np.asarray(state.n_blocks)
+    flat["error"] = np.asarray(state.error)
+    return flat
+
+
+def _state_from_numpy(flat: dict) -> DocStateBatch:
+    blocks = BlockCols(
+        **{
+            k.split(".", 1)[1]: jnp.asarray(v)
+            for k, v in flat.items()
+            if k.startswith("blocks.")
+        }
+    )
+    return DocStateBatch(
+        blocks=blocks,
+        start=jnp.asarray(flat["start"]),
+        n_blocks=jnp.asarray(flat["n_blocks"]),
+        error=jnp.asarray(flat["error"]),
+    )
+
+
+def _enc_sidecar(enc: BatchEncoder) -> dict:
+    return {
+        "root_name": enc.root_name,
+        "interner_from_idx": list(enc.interner.from_idx),
+        "key_names": dict(enc.keys.names),
+        "payload_items": list(enc.payloads.items),
+        "saw_map_or_nested": enc.saw_map_or_nested,
+    }
+
+
+def _enc_restore(side: dict) -> BatchEncoder:
+    enc = BatchEncoder(root_name=side["root_name"])
+    for client in side["interner_from_idx"]:
+        enc.interner.intern(client)
+    for kid in sorted(side["key_names"]):
+        got = enc.keys.intern(side["key_names"][kid])
+        assert got == kid
+    enc.payloads.items = list(side["payload_items"])
+    enc.saw_map_or_nested = side["saw_map_or_nested"]
+    return enc
+
+
+def save_state(path: str, state: DocStateBatch, enc: BatchEncoder) -> None:
+    """Persist a device state + its host sidecars under `path` (a dir)."""
+    _save(path, state, {"format": _FORMAT, "enc": _enc_sidecar(enc)})
+
+
+def load_state(path: str) -> Tuple[DocStateBatch, BatchEncoder]:
+    state, side = _load(path)
+    return state, _enc_restore(side["enc"])
+
+
+def save_ingestor(path: str, ing: BatchIngestor) -> None:
+    """Persist a BatchIngestor: device state + encoder + pending stashes."""
+    side = {
+        "format": _FORMAT,
+        "enc": _enc_sidecar(ing.enc),
+        "n_docs": ing.n_docs,
+        "svs": [dict(sv.clocks) for sv in ing.svs],
+        "pending": [
+            {c: list(q) for c, q in stash.items()} for stash in ing._pending
+        ],
+        "pending_ds": [
+            {c: list(rs) for c, rs in ds.clients.items()}
+            for ds in ing._pending_ds
+        ],
+    }
+    _save(path, ing.state, side)
+
+
+def load_ingestor(path: str) -> BatchIngestor:
+    from ytpu.core.id_set import DeleteSet
+    from ytpu.core.state_vector import StateVector
+
+    state, side = _load(path)
+    ing = BatchIngestor.__new__(BatchIngestor)
+    ing.enc = _enc_restore(side["enc"])
+    ing.n_docs = side["n_docs"]
+    ing.state = state
+    ing.svs = [StateVector(dict(c)) for c in side["svs"]]
+    ing._pending = [dict(p) for p in side["pending"]]
+    ing._pending_ds = [DeleteSet(dict(d)) for d in side["pending_ds"]]
+    return ing
+
+
+# --- storage backends ---------------------------------------------------------
+
+
+def _save(path: str, state: DocStateBatch, sidecar: dict) -> None:
+    """Idempotent overwrite in both backends — periodic checkpointing to a
+    fixed path must behave the same with and without orbax."""
+    import shutil
+
+    os.makedirs(path, exist_ok=True)
+    flat = _state_to_numpy(state)
+    arrays_dir = os.path.join(path, "arrays")
+    npz_path = os.path.join(path, "arrays.npz")
+    if os.path.exists(arrays_dir):
+        shutil.rmtree(arrays_dir)
+    if os.path.exists(npz_path):
+        os.remove(npz_path)
+    saved_with = "npz"
+    try:
+        import orbax.checkpoint as ocp
+
+        ckpt = ocp.PyTreeCheckpointer()
+        ckpt.save(arrays_dir, {k: jnp.asarray(v) for k, v in flat.items()})
+        saved_with = "orbax"
+    except Exception:
+        shutil.rmtree(arrays_dir, ignore_errors=True)  # partial orbax dir
+        np.savez_compressed(npz_path, **flat)
+    sidecar = dict(sidecar)
+    sidecar["saved_with"] = saved_with
+    with open(os.path.join(path, "host.pkl"), "wb") as f:
+        pickle.dump(sidecar, f)
+
+
+def _load(path: str) -> Tuple[DocStateBatch, dict]:
+    with open(os.path.join(path, "host.pkl"), "rb") as f:
+        side = pickle.load(f)
+    if side.get("format") != _FORMAT:
+        raise ValueError(f"unsupported checkpoint format {side.get('format')}")
+    if side.get("saved_with") == "orbax":
+        import orbax.checkpoint as ocp
+
+        ckpt = ocp.PyTreeCheckpointer()
+        flat = ckpt.restore(os.path.join(path, "arrays"))
+    else:
+        with np.load(os.path.join(path, "arrays.npz"), allow_pickle=False) as z:
+            flat = {k: z[k] for k in z.files}
+    return _state_from_numpy(flat), side

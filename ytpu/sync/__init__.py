@@ -11,6 +11,16 @@ from .protocol import (
 )
 from .server import Session, SyncServer
 
+
+def __getattr__(name: str):
+    # lazy: DeviceSyncServer pulls jax + the batch engine; the host-only
+    # control plane (protocol, Awareness, SyncServer) must import without it
+    if name == "DeviceSyncServer":
+        from .device_server import DeviceSyncServer
+
+        return DeviceSyncServer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __all__ = [
     "Awareness",
     "AwarenessUpdate",
@@ -22,5 +32,6 @@ __all__ = [
     "PermissionDenied",
     "UnsupportedMessage",
     "SyncServer",
+    "DeviceSyncServer",
     "Session",
 ]

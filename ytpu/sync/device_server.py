@@ -1,0 +1,128 @@
+"""Device-backed sync server: y-sync tenants fanned into batch engine slots.
+
+This closes the north-star loop (SURVEY §0 / BASELINE): clients speak the
+y-sync protocol to `SyncServer`; every update a tenant doc applies is also
+queued for its device slot and shipped to the batched engine through
+`BatchIngestor` — one `apply_update_batch` dispatch integrates one queued
+update per tenant. The host tenant docs remain the protocol endpoints
+(diffs, awareness, observers); the device batch is the scalable compute
+plane over the same wire bytes, with the ingestor's pending semantics
+absorbing out-of-order arrival per slot without stalling the batch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ytpu.models.ingest import BatchIngestor
+from ytpu.sync.server import SyncServer
+
+__all__ = ["DeviceSyncServer"]
+
+
+class DeviceSyncServer(SyncServer):
+    """A SyncServer whose tenants mirror into device doc slots.
+
+    `n_docs` bounds the tenant count (one slot per tenant, assigned on
+    first touch). Updates accumulate per slot and ship on `flush_device()`
+    — call it per request batch, on a timer, or from the serving loop.
+    Flagship scope: single-root tenants (the batch encoder maps named
+    roots onto one device root branch).
+    """
+
+    def __init__(
+        self,
+        n_docs: Optional[int] = None,
+        capacity: int = 2048,
+        ingestor: Optional[BatchIngestor] = None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        if ingestor is None:
+            if n_docs is None:
+                raise ValueError("pass n_docs or an ingestor")
+            ingestor = BatchIngestor(n_docs, capacity)
+        # the ingestor is the single source of truth for the slot count
+        self.ingestor = ingestor
+        self._slot_of: Dict[str, int] = {}
+        self._queues: List[List[bytes]] = [
+            [] for _ in range(ingestor.n_docs)
+        ]
+
+    # --- slot management -------------------------------------------------------
+
+    def slot_of(self, tenant_name: str) -> int:
+        """The device slot of an EXISTING tenant (KeyError otherwise)."""
+        slot = self._slot_of.get(tenant_name)
+        if slot is None:
+            raise KeyError(f"tenant {tenant_name!r} has no device slot")
+        return slot
+
+    def _assign_slot(self, tenant_name: str) -> int:
+        slot = self._slot_of.get(tenant_name)
+        if slot is None:
+            if len(self._slot_of) >= self.ingestor.n_docs:
+                raise RuntimeError(
+                    f"device batch is full ({self.ingestor.n_docs} tenant slots)"
+                )
+            slot = len(self._slot_of)
+            self._slot_of[tenant_name] = slot
+        return slot
+
+    def tenant(self, name: str):
+        first_touch = name not in self.tenants
+        if first_touch:
+            # reserve the slot FIRST: exhaustion must fail before the tenant
+            # registers, or retries would create an unmirrored ghost tenant
+            slot = self._assign_slot(name)
+        t = super().tenant(name)
+        if first_touch:
+
+            def mirror(payload: bytes, origin, txn, _slot=slot):
+                self._queues[_slot].append(payload)
+
+            t.awareness.doc.observe_update_v1(mirror)
+        return t
+
+    # --- device dispatch -------------------------------------------------------
+
+    def pending_device_updates(self) -> int:
+        return sum(len(q) for q in self._queues)
+
+    def flush_device(self, max_steps: Optional[int] = None) -> int:
+        """Ship queued updates to the device; one update per slot per step.
+
+        Returns the number of batch steps dispatched. Slots with deeper
+        queues keep shipping while others ride as no-ops (the engine's
+        padding rows), so a chatty tenant never blocks a quiet one.
+        """
+        steps = 0
+        while any(self._queues) and (max_steps is None or steps < max_steps):
+            # peek, apply, THEN pop — a failing step must not drop the other
+            # slots' already-dequeued updates
+            payloads = [q[0] if q else None for q in self._queues]
+            self.ingestor.apply(payloads)
+            for q in self._queues:
+                if q:
+                    q.pop(0)
+            steps += 1
+        return steps
+
+    def device_text(self, tenant_name: str) -> str:
+        """The device-side rendering of a tenant's root text (for parity
+        checks and serving reads off the batch)."""
+        from ytpu.models.batch_doc import get_string
+
+        slot = self.slot_of(tenant_name)
+        return get_string(self.ingestor.state, slot, self.ingestor.enc.payloads)
+
+    def device_tree(self, tenant_name: str) -> dict:
+        from ytpu.models.batch_doc import get_tree
+
+        slot = self.slot_of(tenant_name)
+        return get_tree(
+            self.ingestor.state,
+            slot,
+            self.ingestor.enc.payloads,
+            self.ingestor.enc.keys,
+        )
