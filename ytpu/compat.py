@@ -1,0 +1,172 @@
+"""Yjs-shaped convenience API (the ywasm binding-surface parity layer).
+
+The reference ships a wasm/JS binding whose free functions mirror Yjs
+(`ywasm/src/lib.rs:80-448`: encodeStateVector, applyUpdate, snapshot,
+sticky-index helpers, …). ytpu's binding surface is Python; this module
+provides the same function names over `ytpu.core` so code written against
+the Yjs API shape ports line for line. All byte formats are wire-compatible
+(lib0 v1/v2), so payloads interoperate with Yjs/Yrs peers directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ytpu.core import Doc, Snapshot, StateVector, Update
+from ytpu.core.moving import StickyIndex
+
+__all__ = [
+    "encode_state_vector",
+    "encode_state_as_update",
+    "encode_state_as_update_v2",
+    "apply_update",
+    "apply_update_v2",
+    "merge_updates",
+    "merge_updates_v2",
+    "diff_updates",
+    "diff_updates_v2",
+    "encode_state_vector_from_update",
+    "encode_state_vector_from_update_v2",
+    "debug_update_v1",
+    "debug_update_v2",
+    "snapshot",
+    "equal_snapshots",
+    "encode_snapshot_v1",
+    "encode_snapshot_v2",
+    "decode_snapshot_v1",
+    "decode_snapshot_v2",
+    "encode_state_from_snapshot_v1",
+    "encode_state_from_snapshot_v2",
+    "create_sticky_index_from_type",
+    "create_offset_from_sticky_index",
+    "encode_sticky_index",
+    "decode_sticky_index",
+]
+
+
+# --- sync primitives (ywasm lib.rs: encodeStateVector / applyUpdate) ---------
+
+def encode_state_vector(doc: Doc) -> bytes:
+    return doc.state_vector().encode_v1()
+
+
+def encode_state_as_update(doc: Doc, vector: Optional[bytes] = None) -> bytes:
+    remote = StateVector.decode_v1(vector) if vector else StateVector()
+    return doc.encode_state_as_update_v1(remote)
+
+
+def encode_state_as_update_v2(doc: Doc, vector: Optional[bytes] = None) -> bytes:
+    remote = StateVector.decode_v1(vector) if vector else StateVector()
+    return doc.encode_state_as_update_v2(remote)
+
+
+def apply_update(doc: Doc, update: bytes, origin=None) -> None:
+    doc.apply_update_v1(update, origin=origin)
+
+
+def apply_update_v2(doc: Doc, update: bytes, origin=None) -> None:
+    doc.apply_update_v2(update, origin=origin)
+
+
+# --- doc-less update utilities (alt.rs parity, exposed Yjs-style) ------------
+
+def merge_updates(*updates: bytes) -> bytes:
+    from ytpu.core.update import merge_updates_v1 as _merge
+
+    return _merge(list(updates))
+
+
+def merge_updates_v2(*updates: bytes) -> bytes:
+    from ytpu.core.update import merge_updates_v2 as _merge
+
+    return _merge(list(updates))
+
+
+def diff_updates(update: bytes, vector: bytes) -> bytes:
+    from ytpu.core.update import diff_updates_v1 as _diff
+
+    return _diff(update, vector)
+
+
+def diff_updates_v2(update: bytes, vector: bytes) -> bytes:
+    from ytpu.core.update import diff_updates_v2 as _diff
+
+    return _diff(update, vector)
+
+
+def encode_state_vector_from_update(update: bytes) -> bytes:
+    from ytpu.core.update import encode_state_vector_from_update_v1 as _sv
+
+    return _sv(update)
+
+
+def encode_state_vector_from_update_v2(update: bytes) -> bytes:
+    from ytpu.core.update import encode_state_vector_from_update_v2 as _sv
+
+    return _sv(update)
+
+
+def debug_update_v1(update: bytes) -> str:
+    return repr(Update.decode_v1(update))
+
+
+def debug_update_v2(update: bytes) -> str:
+    return repr(Update.decode_v2(update))
+
+
+# --- snapshots (ywasm lib.rs: snapshot / equalSnapshots / …) -----------------
+
+def snapshot(doc: Doc) -> Snapshot:
+    return doc.snapshot()
+
+
+def equal_snapshots(a: Snapshot, b: Snapshot) -> bool:
+    return a.state_vector.clocks == b.state_vector.clocks and (
+        a.delete_set.clients == b.delete_set.clients
+    )
+
+
+def encode_snapshot_v1(s: Snapshot) -> bytes:
+    return s.encode_v1()
+
+
+def encode_snapshot_v2(s: Snapshot) -> bytes:
+    return s.encode_v2()
+
+
+def decode_snapshot_v1(data: bytes) -> Snapshot:
+    return Snapshot.decode_v1(data)
+
+
+def decode_snapshot_v2(data: bytes) -> Snapshot:
+    return Snapshot.decode_v2(data)
+
+
+def encode_state_from_snapshot_v1(doc: Doc, s: Snapshot) -> bytes:
+    return doc.encode_state_from_snapshot(s)
+
+
+def encode_state_from_snapshot_v2(doc: Doc, s: Snapshot) -> bytes:
+    return Update.decode_v1(doc.encode_state_from_snapshot(s)).encode_v2()
+
+
+# --- sticky indices (ywasm lib.rs: createStickyIndexFromType / …) ------------
+
+def create_sticky_index_from_type(txn, shared_type, index: int, assoc: int = 0):
+    return shared_type.sticky_index(index, assoc)
+
+
+def create_offset_from_sticky_index(txn, sticky: StickyIndex) -> Optional[int]:
+    resolved = sticky.get_offset(txn.store)
+    if resolved is None:
+        return None
+    _branch, offset = resolved
+    return offset
+
+
+def encode_sticky_index(sticky: StickyIndex) -> bytes:
+    return sticky.encode_v1()
+
+
+def decode_sticky_index(data: bytes) -> StickyIndex:
+    return StickyIndex.decode_v1(data)
