@@ -1,0 +1,78 @@
+"""Sweep fused-kernel tile size (d_block) on the real chip.
+
+Usage: python benches/dblock_sweep.py [--docs 4096] [--updates 600]
+Prints one line per d_block: rate + speedup over the first entry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+import bench as B
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--docs", type=int, default=B.N_DOCS)
+    ap.add_argument("--updates", type=int, default=B.N_UPDATES)
+    ap.add_argument("--blocks", type=int, nargs="*", default=[8, 16, 32, 64])
+    ap.add_argument("--capacity", type=int, default=B.CAPACITY)
+    args = ap.parse_args()
+
+    import os
+
+    if os.path.exists(B.TRACE_PATH):
+        ops = B.load_b4_ops(args.updates)
+    else:
+        ops = B.synthetic_ops(args.updates)
+    log, expect = B.build_updates(ops)
+
+    from ytpu.core import Update
+    from ytpu.models.batch_doc import BatchEncoder, get_string, init_state
+    from ytpu.ops.integrate_kernel import apply_update_stream_fused
+
+    enc = BatchEncoder()
+    steps = [
+        enc.build_step(Update.decode_v1(p), B.ROWS_PER_STEP, B.DELS_PER_STEP)
+        for p in log
+    ]
+    stream = BatchEncoder.stack_steps(steps)
+    rank = enc.interner.rank_table()
+
+    base = None
+    for db in args.blocks:
+        if args.docs % db:
+            continue
+        # compile + correctness
+        state = init_state(args.docs, args.capacity)
+        state = apply_update_stream_fused(state, stream, rank, d_block=db, guard=False)
+        assert int(np.asarray(state.error).max()) == 0
+        assert get_string(state, 0, enc.payloads) == expect
+        # timed
+        best = float("inf")
+        for _ in range(2):
+            state = init_state(args.docs, args.capacity)
+            np.asarray(state.n_blocks)
+            t0 = time.perf_counter()
+            state = apply_update_stream_fused(
+                state, stream, rank, d_block=db, guard=False
+            )
+            np.asarray(state.n_blocks)
+            best = min(best, time.perf_counter() - t0)
+        rate = len(log) * args.docs / best
+        if base is None:
+            base = rate
+        print(
+            f"d_block={db:4d}  {best*1e3:8.1f} ms  {rate/1e6:8.2f} M updates/s"
+            f"  x{rate/base:.2f}"
+        )
+
+
+if __name__ == "__main__":
+    main()

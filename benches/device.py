@@ -1,0 +1,243 @@
+"""Device benchmarks for the remaining north-star configs (BASELINE.md §2).
+
+Config #3 — YArray, 256-client concurrent insert/delete, randomized
+  interleaving, replayed over an N-doc batch (CPU analogue B2.x/B3.4).
+Config #4 — mixed YMap + nested YXmlFragment edits over a 4k-tenant batch
+  (CPU analogue B3.1-B3.3; map rows force the XLA scan path).
+Config #5 — D-doc x C-client state-vector diff + encode_diff_batch device
+  selection (sync steps 1/2; CPU analogue store.rs:204-232).
+
+Each config prints one JSON line: device rate, host-oracle rate measured
+here, and the ratio. Usage: python benches/device.py [--config 3|4|5|all]
+[--docs N].
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from ytpu.core import Doc, Update
+
+
+def capture(doc):
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    return log
+
+
+def timed_host_replay(log):
+    doc = Doc(client_id=0xBEEF)
+    t0 = time.perf_counter()
+    for p in log:
+        doc.apply_update_v1(p)
+    return time.perf_counter() - t0, doc
+
+
+def stream_workload_array(n_clients: int, ops_per_client: int, seed=11):
+    """Config #3 generator: n_clients peers concurrently edit one array,
+    exchanging through a relay doc so every op becomes one wire update."""
+    rng = random.Random(seed)
+    relay = Doc(client_id=0xFFFF)
+    log = capture(relay)
+    peers = [Doc(client_id=i + 1) for i in range(n_clients)]
+    order = [i for i in range(n_clients) for _ in range(ops_per_client)]
+    rng.shuffle(order)
+    for i in order:
+        peer = peers[i]
+        arr = peer.get_array("a")
+        n = len(arr)
+        with peer.transact() as txn:
+            if n > 4 and rng.random() < 0.3:
+                arr.remove_range(txn, rng.randrange(n), 1)
+            else:
+                arr.insert(txn, rng.randrange(n + 1), [rng.randrange(1000)])
+        upd = peer.encode_state_as_update_v1(relay.state_vector())
+        relay.apply_update_v1(upd)
+        # relay fans back out so peers stay roughly in sync
+        if rng.random() < 0.5:
+            back = relay.encode_state_as_update_v1(peer.state_vector())
+            peer.apply_update_v1(back)
+    return log, relay.get_array("a").to_json()
+
+
+def stream_workload_map_xml(n_steps: int, seed=13):
+    """Config #4 generator: one tenant's YMap + nested XML edit stream."""
+    rng = random.Random(seed)
+    doc = Doc(client_id=1)
+    log = capture(doc)
+    m = doc.get_map("m")
+    frag = doc.get_xml_fragment("x")
+    from ytpu.types import XmlElementPrelim
+
+    for s in range(n_steps):
+        with doc.transact() as txn:
+            r = rng.random()
+            if r < 0.5:
+                m.insert(txn, f"k{rng.randrange(32)}", rng.randrange(1000))
+            elif r < 0.7 and len(m) > 0:
+                key = next(iter(m.keys()))
+                m.remove(txn, key)
+            else:
+                frag.insert(
+                    txn,
+                    len(frag),
+                    XmlElementPrelim(f"div{s % 7}", attributes={"i": str(s)}),
+                )
+    return log
+
+
+def bench_config3(n_docs: int):
+    from ytpu.models.batch_doc import (
+        BatchEncoder,
+        apply_update_stream,
+        get_values,
+        init_state,
+    )
+
+    log, expect = stream_workload_array(n_clients=256, ops_per_client=2)
+    host_dt, host_doc = timed_host_replay(log)
+    assert host_doc.get_array("a").to_json() == expect
+
+    enc = BatchEncoder(root_name="a")
+    steps = [enc.build_step(Update.decode_v1(p), 8, 4) for p in log]
+    stream = BatchEncoder.stack_steps(steps)
+    rank = enc.interner.rank_table()
+    state = init_state(n_docs, 2048)
+    state = apply_update_stream(state, stream, rank)  # compile + warm
+    assert int(np.asarray(state.error).max()) == 0
+    assert get_values(state, 0, enc.payloads) == expect
+    state = init_state(n_docs, 2048)
+    np.asarray(state.n_blocks)
+    t0 = time.perf_counter()
+    state = apply_update_stream(state, stream, rank)
+    np.asarray(state.n_blocks)
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "config3_array_256client_updates_per_sec",
+        "value": round(len(log) * n_docs / dt, 1),
+        "unit": f"updates/s over {n_docs}-doc batch (256-client concurrent array)",
+        "vs_baseline": round((len(log) * n_docs / dt) / (len(log) / host_dt), 2),
+    }
+
+
+def bench_config4(n_docs: int):
+    from ytpu.models.batch_doc import (
+        BatchEncoder,
+        apply_update_stream,
+        get_tree,
+        init_state,
+    )
+
+    log = stream_workload_map_xml(n_steps=300)
+    host_dt, host_doc = timed_host_replay(log)
+
+    enc = BatchEncoder(root_name="m")
+    steps = [enc.build_step(Update.decode_v1(p), 6, 4) for p in log]
+    stream = BatchEncoder.stack_steps(steps)
+    rank = enc.interner.rank_table()
+    state = init_state(n_docs, 2048)
+    state = apply_update_stream(state, stream, rank)  # compile + warm
+    assert int(np.asarray(state.error).max()) == 0
+    got = get_tree(state, 0, enc.payloads, enc.keys)["map"]
+    assert got == host_doc.get_map("m").to_json()
+    state = init_state(n_docs, 2048)
+    np.asarray(state.n_blocks)
+    t0 = time.perf_counter()
+    state = apply_update_stream(state, stream, rank)
+    np.asarray(state.n_blocks)
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "config4_map_xml_updates_per_sec",
+        "value": round(len(log) * n_docs / dt, 1),
+        "unit": f"updates/s over {n_docs}-doc batch (map+xml tenants)",
+        "vs_baseline": round((len(log) * n_docs / dt) / (len(log) / host_dt), 2),
+    }
+
+
+def bench_config5(n_docs: int, n_clients: int = 64):
+    """Batched sync-step diff selection: D docs x C clients."""
+    import jax
+
+    from ytpu.models.batch_doc import (
+        BatchEncoder,
+        apply_update_stream,
+        encode_diff_batch,
+        init_state,
+    )
+
+    # seed every doc with a small multi-client history
+    docs = [Doc(client_id=c + 1) for c in range(n_clients)]
+    log = []
+    relay = Doc(client_id=0xFFFF)
+    relay.observe_update_v1(lambda p, o, t: log.append(p))
+    for c, d in enumerate(docs):
+        t = d.get_text("text")
+        with d.transact() as txn:
+            t.insert(txn, 0, f"client-{c} ")
+        relay.apply_update_v1(d.encode_state_as_update_v1(relay.state_vector()))
+    enc = BatchEncoder()
+    steps = [enc.build_step(Update.decode_v1(p), 4, 2) for p in log]
+    stream = BatchEncoder.stack_steps(steps)
+    rank = enc.interner.rank_table()
+    state = init_state(n_docs, 1024)
+    state = apply_update_stream(state, stream, rank)
+    assert int(np.asarray(state.error).max()) == 0
+
+    C = max(8, len(enc.interner))
+    rng = np.random.default_rng(5)
+    remote = rng.integers(0, 12, size=(n_docs, C), dtype=np.int32)
+
+    # host oracle: one encode_state_as_update per remote SV
+    from ytpu.core import StateVector
+
+    host_n = min(64, n_docs)
+    t0 = time.perf_counter()
+    for d in range(host_n):
+        sv = StateVector(
+            {
+                enc.interner.from_idx[c]: int(remote[d, c])
+                for c in range(len(enc.interner))
+                if remote[d, c] > 0
+            }
+        )
+        relay.encode_state_as_update_v1(sv)
+    host_dt = (time.perf_counter() - t0) / host_n
+
+    fn = lambda: jax.tree_util.tree_map(
+        np.asarray, encode_diff_batch(state, remote, C)
+    )
+    fn()  # compile + warm
+    t0 = time.perf_counter()
+    out = fn()
+    dt = time.perf_counter() - t0
+    assert out[0].shape == (n_docs, 1024)
+    return {
+        "metric": "config5_encode_diff_batch_docs_per_sec",
+        "value": round(n_docs / dt, 1),
+        "unit": f"doc-diffs/s over {n_docs} docs x {C} clients (device selection)",
+        "vs_baseline": round((n_docs / dt) / (1.0 / host_dt), 2),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="all", choices=["3", "4", "5", "all"])
+    ap.add_argument("--docs", type=int, default=4096)
+    args = ap.parse_args()
+    runners = {"3": bench_config3, "4": bench_config4, "5": bench_config5}
+    chosen = ["3", "4", "5"] if args.config == "all" else [args.config]
+    for key in chosen:
+        n_docs = args.docs if key != "4" else min(args.docs, 4096)
+        print(json.dumps(runners[key](n_docs)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,251 @@
+"""Device-engine parity for move ranges (ContentMove).
+
+Scenarios build update streams with host docs (YArray move_to /
+move_range_to, reference moving.rs:149-227), then apply the same stream to
+(a) a fresh host doc and (b) the batched device engine, and compare the
+visible sequences. Covers: collapsed moves, range moves, concurrent moves
+with priority reconciliation (both arrival orders), inserts into a moved
+range (moved-flag inheritance + conflict recompute), and deletion of a move
+item (range release / shadowed-move reintegration via the recompute pass).
+"""
+
+import numpy as np
+import pytest
+
+from ytpu.core import Doc, Update
+from ytpu.models.batch_doc import (
+    BatchEncoder,
+    apply_update_batch,
+    get_values,
+    init_state,
+)
+
+
+def capture(doc: Doc):
+    log = []
+    doc.observe_update_v1(lambda payload, origin, txn: log.append(payload))
+    return log
+
+
+def device_replay(update_stream, capacity=128):
+    enc = BatchEncoder(root_name="a")
+    state = init_state(1, capacity)
+    for payload in update_stream:
+        u = Update.decode_v1(payload)
+        batch = enc.build_batch([u])
+        state = apply_update_batch(state, batch, enc.interner.rank_table())
+    return state, enc
+
+
+def host_replay(update_stream) -> Doc:
+    doc = Doc(client_id=0xDEAD)
+    for payload in update_stream:
+        doc.apply_update_v1(payload)
+    return doc
+
+
+def assert_parity(update_stream, capacity=128):
+    host = host_replay(update_stream)
+    state, enc = device_replay(update_stream, capacity=capacity)
+    assert int(state.error[0]) == 0, f"device error flag {int(state.error[0])}"
+    expect = host.get_array("a").to_json()
+    got = get_values(state, 0, enc.payloads)
+    assert got == expect, f"device {got!r} != host {expect!r}"
+    assert host.store.pending is None
+    return host, state, enc
+
+
+def seeded_array(values, client_id=1):
+    doc = Doc(client_id=client_id)
+    log = capture(doc)
+    arr = doc.get_array("a")
+    with doc.transact() as txn:
+        for v in values:
+            arr.push_back(txn, v)
+    return doc, arr, log
+
+
+def test_collapsed_move_to():
+    doc, arr, log = seeded_array([0, 1, 2, 3, 4])
+    with doc.transact() as txn:
+        arr.move_to(txn, 1, 4)  # [0, 2, 3, 1, 4]
+    assert arr.to_json() == [0, 2, 3, 1, 4]
+    assert_parity(log)
+
+
+def test_move_range_backward():
+    doc, arr, log = seeded_array(list(range(6)))
+    with doc.transact() as txn:
+        arr.move_range_to(txn, 3, 4, 1)  # [0, 3, 4, 1, 2, 5]
+    assert arr.to_json() == [0, 3, 4, 1, 2, 5]
+    assert_parity(log)
+
+
+def test_move_then_edit_inside_range():
+    """An insert landing inside a moved range inherits its owner."""
+    doc, arr, log = seeded_array(list(range(5)))
+    with doc.transact() as txn:
+        arr.move_range_to(txn, 2, 3, 0)
+    with doc.transact() as txn:
+        arr.insert(txn, 2, ["x"])  # inside the moved destination
+    state_json = arr.to_json()
+    assert_parity(log)
+    assert host_replay(log).get_array("a").to_json() == state_json
+
+
+def test_concurrent_moves_both_orders():
+    """Two peers move the same element; priority reconciliation must
+    converge to the host-oracle result for both arrival orders."""
+    a, arr_a, log_a = seeded_array([0, 1, 2, 3, 4], client_id=1)
+    seed = list(log_a)
+    b = Doc(client_id=2)
+    log_b = capture(b)
+    for p in seed:
+        b.apply_update_v1(p)
+    with a.transact() as txn:
+        arr_a.move_to(txn, 1, 4)
+    mv_a = log_a[-1]
+    arr_b = b.get_array("a")
+    with b.transact() as txn:
+        arr_b.move_to(txn, 1, 3)
+    mv_b = log_b[-1]
+    for order in ([mv_a, mv_b], [mv_b, mv_a]):
+        stream = seed + order
+        host = host_replay(stream)
+        state, enc = device_replay(stream)
+        assert int(state.error[0]) == 0
+        assert get_values(state, 0, enc.payloads) == host.get_array("a").to_json()
+
+
+def test_concurrent_insert_into_moved_range():
+    """Peer B inserts into a range peer A moved — the conflict case of the
+    moved-flag inheritance (block.rs:677-702) lands in the recompute."""
+    a, arr_a, log_a = seeded_array(list(range(5)), client_id=1)
+    seed = list(log_a)
+    b = Doc(client_id=2)
+    log_b = capture(b)
+    for p in seed:
+        b.apply_update_v1(p)
+    with a.transact() as txn:
+        arr_a.move_range_to(txn, 1, 3, 5)
+    mv_a = log_a[-1]
+    arr_b = b.get_array("a")
+    with b.transact() as txn:
+        arr_b.insert(txn, 2, ["x"])  # between items 1 and 2 (pre-move coords)
+    ins_b = log_b[-1]
+    for order in ([mv_a, ins_b], [ins_b, mv_a]):
+        stream = seed + order
+        host = host_replay(stream)
+        state, enc = device_replay(stream)
+        assert int(state.error[0]) == 0
+        assert get_values(state, 0, enc.payloads) == host.get_array("a").to_json()
+
+
+def test_move_undo_releases_range():
+    """Undoing a move deletes the ContentMove item: its range must release
+    (and the array render in original order again)."""
+    from ytpu.undo import UndoManager
+
+    doc, arr, log = seeded_array(list(range(5)))
+    mgr = UndoManager(doc, arr)
+    with doc.transact() as txn:
+        arr.move_to(txn, 0, 5)  # [1, 2, 3, 4, 0]
+    assert arr.to_json() == [1, 2, 3, 4, 0]
+    mgr.undo()
+    assert arr.to_json() == [0, 1, 2, 3, 4]
+    assert_parity(log)
+
+
+def test_shadowed_move_reintegrates_after_undo():
+    """A losing concurrent move must win again once the winner is undone
+    (override reintegration, moving.rs:229-280)."""
+    from ytpu.undo import UndoManager
+
+    a, arr_a, log_a = seeded_array([0, 1, 2, 3, 4], client_id=1)
+    seed = list(log_a)
+    b = Doc(client_id=2)
+    log_b = capture(b)
+    for p in seed:
+        b.apply_update_v1(p)
+    arr_b = b.get_array("a")
+    with b.transact() as txn:
+        arr_b.move_to(txn, 1, 4)
+    mv_b = log_b[-1]
+    a.apply_update_v1(mv_b)
+    mgr = UndoManager(a, arr_a)
+    with a.transact() as txn:
+        arr_a.move_to(txn, 1, 3)  # shadows b's move (adapted priority)
+    mv_a = log_a[-1]
+    mgr.undo()  # a's move dies; b's should own the element again
+    undo_upd = log_a[-1]
+    stream = seed + [mv_b, mv_a, undo_upd]
+    host = host_replay(stream)
+    state, enc = device_replay(stream)
+    assert int(state.error[0]) == 0
+    assert get_values(state, 0, enc.payloads) == host.get_array("a").to_json()
+
+
+def test_collapsed_loser_is_tombstoned():
+    """A claim that beats a *collapsed* move tombstones it on the spot
+    (_delete_as_cleanup, moving.rs:190-196): after the winner is undone,
+    the dead loser must NOT re-claim its element."""
+    from ytpu.undo import UndoManager
+
+    a, arr_a, log_a = seeded_array([0, 1, 2, 3, 4], client_id=1)
+    seed = list(log_a)
+    b = Doc(client_id=2)
+    log_b = capture(b)
+    for p in seed:
+        b.apply_update_v1(p)
+    with a.transact() as txn:
+        arr_a.move_to(txn, 1, 4)  # collapsed loser (smaller client id)
+    mv_a = log_a[-1]
+    arr_b = b.get_array("a")
+    mgr = UndoManager(b, arr_b)
+    with b.transact() as txn:
+        arr_b.move_to(txn, 1, 3)  # collapsed winner
+    mv_b = log_b[-1]
+    mgr.undo()  # winner dies; loser was tombstoned when beaten
+    undo_b = log_b[-1]
+    stream = seed + [mv_a, mv_b, undo_b]
+    host = host_replay(stream)
+    state, enc = device_replay(stream)
+    assert int(state.error[0]) == 0
+    got = get_values(state, 0, enc.payloads)
+    expect = host.get_array("a").to_json()
+    assert got == expect, f"device {got} != host {expect}"
+    assert expect == [0, 1, 2, 3, 4]
+
+
+def test_fuzz_random_moves_parity():
+    import random
+
+    rng = random.Random(1234)
+    for round_ in range(6):
+        doc, arr, log = seeded_array(list(range(8)))
+        for _ in range(10):
+            n = len(arr)
+            op = rng.random()
+            with doc.transact() as txn:
+                if op < 0.45 and n >= 2:
+                    s = rng.randrange(n)
+                    t = rng.randrange(n + 1)
+                    arr.move_to(txn, s, t)
+                elif op < 0.6 and n >= 3:
+                    s = rng.randrange(n - 1)
+                    e = rng.randrange(s, n - 1)
+                    t = rng.choice(
+                        [x for x in range(n + 1) if x < s or x > e + 1]
+                        or [n]
+                    )
+                    arr.move_range_to(txn, s, e, t)
+                elif op < 0.8:
+                    arr.insert(txn, rng.randrange(n + 1), [rng.randrange(100)])
+                elif n > 1:
+                    arr.remove_range(txn, rng.randrange(n), 1)
+        host = host_replay(log)
+        state, enc = device_replay(log, capacity=256)
+        assert int(state.error[0]) == 0, f"round {round_}"
+        got = get_values(state, 0, enc.payloads)
+        expect = host.get_array("a").to_json()
+        assert got == expect, f"round {round_}: {got} != {expect}"

@@ -36,14 +36,16 @@ import numpy as np
 
 from ytpu.core import Doc, Update
 from ytpu.core.block import GCRange, Item, SkipRange
-from ytpu.core.ids import ID
 from ytpu.core.content import (
     BLOCK_GC,
     CONTENT_ANY,
     CONTENT_DELETED,
     CONTENT_FORMAT,
+    CONTENT_MOVE,
     CONTENT_STRING,
+    ContentMove,
 )
+from ytpu.core.ids import ID
 
 __all__ = [
     "BlockCols",
@@ -84,6 +86,14 @@ class BlockCols(NamedTuple):
     key: jax.Array  # [*, B] i32 interned parent_sub (-1 = sequence item)
     parent: jax.Array  # [*, B] i32 row of the parent ContentType (-1 = root)
     head: jax.Array  # [*, B] i32 child-sequence head for ContentType rows
+    moved: jax.Array  # [*, B] i32 slot of the move item owning this row (-1)
+    mv_sc: jax.Array  # [*, B] i32 move rows: range-start id client (-1 n/a)
+    mv_sk: jax.Array  # [*, B] i32 move rows: range-start id clock
+    mv_sa: jax.Array  # [*, B] i32 move rows: start assoc (0 after, -1 before)
+    mv_ec: jax.Array  # [*, B] i32 move rows: range-end id client (-1 n/a)
+    mv_ek: jax.Array  # [*, B] i32 move rows: range-end id clock
+    mv_ea: jax.Array  # [*, B] i32 move rows: end assoc
+    mv_prio: jax.Array  # [*, B] i32 move rows: conflict priority
 
 
 class DocStateBatch(NamedTuple):
@@ -110,6 +120,13 @@ class UpdateBatch(NamedTuple):
     p_tag: jax.Array  # [*, U] i32 parent form: 0 inherit, 1 root, 2 branch id
     p_client: jax.Array  # [*, U] i32 branch-id parent (p_tag == 2)
     p_clock: jax.Array  # [*, U] i32
+    mv_sc: jax.Array  # [*, U] i32 move rows: range-start id client (-1 n/a)
+    mv_sk: jax.Array  # [*, U] i32
+    mv_sa: jax.Array  # [*, U] i32 start assoc (0 after, -1 before)
+    mv_ec: jax.Array  # [*, U] i32 range-end id client (-1 n/a)
+    mv_ek: jax.Array  # [*, U] i32
+    mv_ea: jax.Array  # [*, U] i32 end assoc
+    mv_prio: jax.Array  # [*, U] i32 conflict priority
     valid: jax.Array  # [*, U] bool
     del_client: jax.Array  # [*, R] i32
     del_start: jax.Array  # [*, R] i32
@@ -146,6 +163,14 @@ def init_state(n_docs: int, capacity: int) -> DocStateBatch:
         key=full(shape, -1),
         parent=full(shape, -1),
         head=full(shape, -1),
+        moved=full(shape, -1),
+        mv_sc=full(shape, -1),
+        mv_sk=full(shape, 0),
+        mv_sa=full(shape, 0),
+        mv_ec=full(shape, -1),
+        mv_ek=full(shape, 0),
+        mv_ea=full(shape, 0),
+        mv_prio=full(shape, -1),
     )
     return DocStateBatch(
         blocks=blocks,
@@ -232,6 +257,14 @@ def _split(state: DocStateBatch, i: jax.Array, off: jax.Array):
         key=_set(bl.key, wj, bl.key[safe_i]),
         parent=_set(bl.parent, wj, bl.parent[safe_i]),
         head=_set(bl.head, wj, -1),  # type rows (len 1) never split
+        moved=_set(bl.moved, wj, bl.moved[safe_i]),  # parity: block.rs splice
+        mv_sc=_set(bl.mv_sc, wj, -1),  # move rows (len 1) never split
+        mv_sk=_set(bl.mv_sk, wj, 0),
+        mv_sa=_set(bl.mv_sa, wj, 0),
+        mv_ec=_set(bl.mv_ec, wj, -1),
+        mv_ek=_set(bl.mv_ek, wj, 0),
+        mv_ea=_set(bl.mv_ea, wj, 0),
+        mv_prio=_set(bl.mv_prio, wj, -1),
     )
     state = DocStateBatch(
         blocks=new_bl,
@@ -265,12 +298,16 @@ def _origins_equal(ha, ca, ka, hb, cb, kb):
     return both_none | both_same
 
 
-def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array) -> DocStateBatch:
+def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array):
     """Integrate one incoming block row (YATA; parity: block.rs:482-769).
 
     `client_rank[c]` is the rank of interned client c in *real client id*
     order — the YATA tie-break (block.rs:571-580) is defined on real ids,
     which interning does not preserve.
+
+    Returns (state, moves_dirty): dirty is True when move ownership must be
+    recomputed (a move row arrived, or an insert landed between rows owned
+    by *different* moves — the reconciliation case of block.rs:677-702).
     """
     (
         r_client,
@@ -287,6 +324,13 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array) -> DocStat
         r_ptag,
         r_pclient,
         r_pclock,
+        r_mv_sc,
+        r_mv_sk,
+        r_mv_sa,
+        r_mv_ec,
+        r_mv_ek,
+        r_mv_ea,
+        r_mv_prio,
         r_valid,
     ) = row
     bl = state.blocks
@@ -462,7 +506,21 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array) -> DocStat
         parent_deleted | (is_map & (right_final >= 0))
     )
     row_deleted = is_gc | (r_kind == CONTENT_DELETED) | dead_on_arrival
-    row_countable = ~row_deleted & (r_kind != CONTENT_FORMAT)
+    row_countable = (
+        ~row_deleted & (r_kind != CONTENT_FORMAT) & (r_kind != CONTENT_MOVE)
+    )
+
+    # moved-range inheritance (parity: block.rs:677-702 / store.py): an
+    # insert between two rows owned by the same move inherits its owner; a
+    # mismatch defers to the end-of-update recompute pass (moves_dirty)
+    left_moved = jnp.where(
+        has_left, bl.moved[safe(left_idx)], -1
+    )
+    right_moved = jnp.where(right_final >= 0, bl.moved[safe(right_final)], -1)
+    inherit_moved = jnp.where(left_moved == right_moved, left_moved, -1)
+    moved_conflict = linkable & (left_moved != right_moved)
+    is_move_row = r_valid & (r_kind == CONTENT_MOVE)
+    moves_dirty = moved_conflict | is_move_row
 
     new_bl = BlockCols(
         client=_set(bl.client, wj, r_client),
@@ -482,6 +540,14 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array) -> DocStat
         key=_set(bl.key, wj, r_key),
         parent=_set(bl.parent, wj, parent_row),
         head=_set(new_head_col, wj, -1),
+        moved=_set(bl.moved, wj, jnp.where(linkable, inherit_moved, -1)),
+        mv_sc=_set(bl.mv_sc, wj, jnp.where(is_move_row, r_mv_sc, -1)),
+        mv_sk=_set(bl.mv_sk, wj, jnp.where(is_move_row, r_mv_sk, 0)),
+        mv_sa=_set(bl.mv_sa, wj, jnp.where(is_move_row, r_mv_sa, 0)),
+        mv_ec=_set(bl.mv_ec, wj, jnp.where(is_move_row, r_mv_ec, -1)),
+        mv_ek=_set(bl.mv_ek, wj, jnp.where(is_move_row, r_mv_ek, 0)),
+        mv_ea=_set(bl.mv_ea, wj, jnp.where(is_move_row, r_mv_ea, 0)),
+        mv_prio=_set(bl.mv_prio, wj, jnp.where(is_move_row, r_mv_prio, -1)),
     )
     # a map row that became its chain's tail is the key's new live value;
     # the previous winner — its immediate left — gets tombstoned (parity:
@@ -494,16 +560,20 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array) -> DocStat
         | jnp.where(overflow, ERR_CAPACITY, 0)
         | jnp.where(missing, ERR_MISSING_DEP, 0)
     )
-    return DocStateBatch(
+    out = DocStateBatch(
         blocks=new_bl,
         start=new_start,
         n_blocks=state.n_blocks + do.astype(I32),
         error=error,
     )
+    return out, moves_dirty
 
 
-def _apply_delete_range(state: DocStateBatch, client, start, end, valid) -> DocStateBatch:
-    """Tombstone [start, end) of `client` (parity: transaction.rs:472-575)."""
+def _apply_delete_range(state: DocStateBatch, client, start, end, valid):
+    """Tombstone [start, end) of `client` (parity: transaction.rs:472-575).
+
+    Returns (state, hit_move): hit_move is True when the range tombstoned a
+    ContentMove row (its claims must then be released by the recompute)."""
     probe = jnp.where(valid, client, -2)
     # split the head block at `start` (only non-deleted blocks get split)
     i = _find_slot(state.blocks, state.n_blocks, probe, start)
@@ -526,7 +596,151 @@ def _apply_delete_range(state: DocStateBatch, client, start, end, valid) -> DocS
         & (bl.clock >= start)
         & (bl.clock + bl.length <= end)
     )
-    return state._replace(blocks=bl._replace(deleted=bl.deleted | mask))
+    hit_move = jnp.any(mask & (bl.kind == CONTENT_MOVE) & ~bl.deleted)
+    state = state._replace(blocks=bl._replace(deleted=bl.deleted | mask))
+    return state, hit_move
+
+
+def _resolve_move_ptr(state: DocStateBatch, c, k, assoc, enable):
+    """Sticky (client, clock, assoc) -> first in-range slot.
+
+    assoc After (>= 0): the item starting at the id (split to a clean
+    start); assoc Before: the right neighbor of the item *ending* at the id
+    — the exclusive-bound convention of moving.rs:100-111.
+    """
+    after = assoc >= 0
+    probe_a = jnp.where(enable & after, c, -2)
+    state, i_a = _clean_start(state, probe_a, k)
+    probe_b = jnp.where(enable & ~after, c, -2)
+    state, i_b = _clean_end(state, probe_b, k)
+    right_b = jnp.where(i_b >= 0, state.blocks.right[jnp.maximum(i_b, 0)], -1)
+    found = jnp.where(after, i_a >= 0, i_b >= 0)
+    return state, jnp.where(after, i_a, right_b), found
+
+
+def _claim_move(state: DocStateBatch, s, enable, client_rank: jax.Array):
+    """Walk move row `s`'s range, claiming rows it beats.
+
+    Parity: Move::integrate_block (moving.rs:149-227). The 'takes'
+    comparison is the total order (priority, real client id, clock) — ties
+    on priority fall to the move item's id, so one claim pass per active
+    move in any order converges to the reference fixpoint. find_move_loop
+    cleanup (nested move cycles, moving.rs:113-141) is host-oracle-only.
+    """
+    bl = state.blocks
+    safe_s = jnp.maximum(s, 0)
+    state, start, s_found = _resolve_move_ptr(
+        state, bl.mv_sc[safe_s], bl.mv_sk[safe_s], bl.mv_sa[safe_s], enable
+    )
+    state, endp, e_found = _resolve_move_ptr(
+        state, bl.mv_ec[safe_s], bl.mv_ek[safe_s], bl.mv_ea[safe_s], enable
+    )
+    bl = state.blocks  # re-read: resolution may have split blocks
+    # a move whose range bounds aren't materialized yet must fail loudly —
+    # the host stash (partition_carriers) defers such rows, so reaching
+    # here with an unresolved id-scoped bound is a missing dependency
+    unresolved = enable & (
+        ((bl.mv_sc[safe_s] >= 0) & ~s_found)
+        | ((bl.mv_ec[safe_s] >= 0) & ~e_found)
+    )
+    state = state._replace(
+        error=state.error | jnp.where(unresolved, ERR_MISSING_DEP, 0)
+    )
+    enable = enable & ~unresolved  # an unresolved end would read as "tail"
+    B = _capacity(bl)
+    prio_s = bl.mv_prio[safe_s]
+    rank_s = client_rank[jnp.maximum(bl.client[safe_s], 0)]
+    clock_s = bl.clock[safe_s]
+
+    def cond(carry):
+        moved_col, deleted_col, cur, n = carry
+        return enable & (cur >= 0) & (cur != endp) & (n <= B)
+
+    def body(carry):
+        moved_col, deleted_col, cur, n = carry
+        sc = jnp.maximum(cur, 0)
+        m = moved_col[sc]
+        sm = jnp.maximum(m, 0)
+        prev_prio = jnp.where(m >= 0, bl.mv_prio[sm], -1)
+        prev_rank = client_rank[jnp.maximum(bl.client[sm], 0)]
+        prev_clock = bl.clock[sm]
+        takes = (prev_prio < prio_s) | (
+            (prev_prio == prio_s)
+            & (m >= 0)
+            & (
+                (prev_rank < rank_s)
+                | ((prev_rank == rank_s) & (prev_clock < clock_s))
+            )
+        )
+        # a beaten *collapsed* move is tombstoned on the spot (parity:
+        # _delete_as_cleanup at moving.rs:190-196; the recompute pass
+        # replays claims in slot = arrival order, so this side effect
+        # matches the oracle's arrival-order behavior)
+        m_collapsed = (
+            (m >= 0)
+            & (bl.mv_sc[sm] >= 0)
+            & (bl.mv_sc[sm] == bl.mv_ec[sm])
+            & (bl.mv_sk[sm] == bl.mv_ek[sm])
+        )
+        deleted_col = deleted_col.at[sm].set(
+            (takes & m_collapsed) | deleted_col[sm]
+        )
+        moved_col = moved_col.at[sc].set(jnp.where(takes, s, m))
+        return moved_col, deleted_col, bl.right[sc], n + 1
+
+    moved_col, deleted_col, _, _ = jax.lax.while_loop(
+        cond, body, (bl.moved, bl.deleted, start, jnp.zeros((), I32))
+    )
+    return state._replace(
+        blocks=bl._replace(moved=moved_col, deleted=deleted_col)
+    )
+
+
+def _recompute_moves(
+    state: DocStateBatch, dirty, client_rank: jax.Array
+) -> DocStateBatch:
+    """Recompute move ownership from scratch for a dirty doc.
+
+    Releases every claim, then runs one claim pass per live move row. The
+    result is the reference steady state (owner of a row = the maximal
+    (priority, client, clock) non-deleted move whose resolved range covers
+    it): Move::integrate_block's incremental claims and its delete-time
+    override reintegration (moving.rs:229-280) both converge to that same
+    argmax, because each pairwise 'takes' keeps the maximum. Clean docs
+    (`dirty` False) exit the loop without iterating.
+    """
+    bl = state.blocks
+    B = _capacity(bl)
+    slots = jnp.arange(B, dtype=I32)
+    state = state._replace(
+        blocks=bl._replace(moved=jnp.where(dirty, -1, bl.moved))
+    )
+
+    def active_moves(st, done):
+        return (
+            (slots < st.n_blocks)
+            & (st.blocks.kind == CONTENT_MOVE)
+            & ~st.blocks.deleted
+            & ~done
+        )
+
+    def cond(carry):
+        st, done = carry
+        return dirty & jnp.any(active_moves(st, done))
+
+    def body(carry):
+        st, done = carry
+        am = active_moves(st, done)
+        exists = jnp.any(am)
+        s = jnp.where(exists, jnp.argmax(am).astype(I32), -1)
+        st = _claim_move(st, s, dirty & exists, client_rank)
+        done = done.at[jnp.maximum(s, 0)].set(
+            exists | done[jnp.maximum(s, 0)]
+        )
+        return st, done
+
+    state, _ = jax.lax.while_loop(cond, body, (state, jnp.zeros((B,), bool)))
+    return state
 
 
 def _apply_update_one_doc(
@@ -535,7 +749,8 @@ def _apply_update_one_doc(
     U = batch.client.shape[-1]
     R = batch.del_client.shape[-1]
 
-    def blk_body(i, st):
+    def blk_body(i, carry):
+        st, dirty = carry
         row = (
             batch.client[i],
             batch.clock[i],
@@ -551,21 +766,32 @@ def _apply_update_one_doc(
             batch.p_tag[i],
             batch.p_client[i],
             batch.p_clock[i],
+            batch.mv_sc[i],
+            batch.mv_sk[i],
+            batch.mv_sa[i],
+            batch.mv_ec[i],
+            batch.mv_ek[i],
+            batch.mv_ea[i],
+            batch.mv_prio[i],
             batch.valid[i],
         )
         # padding rows skip all work; with a broadcast (unbatched) update the
         # predicate is scalar, so XLA executes only one branch
-        return jax.lax.cond(
+        st, d = jax.lax.cond(
             batch.valid[i],
             lambda s: _integrate_row(s, row, client_rank),
-            lambda s: s,
+            lambda s: (s, jnp.array(False)),
             st,
         )
+        return st, dirty | d
 
-    state = jax.lax.fori_loop(0, U, blk_body, state)
+    state, moves_dirty = jax.lax.fori_loop(
+        0, U, blk_body, (state, jnp.array(False))
+    )
 
-    def del_body(r, st):
-        return jax.lax.cond(
+    def del_body(r, carry):
+        st, dirty = carry
+        st, hit_move = jax.lax.cond(
             batch.del_valid[r],
             lambda s: _apply_delete_range(
                 s,
@@ -574,11 +800,17 @@ def _apply_update_one_doc(
                 batch.del_end[r],
                 batch.del_valid[r],
             ),
-            lambda s: s,
+            lambda s: (s, jnp.array(False)),
             st,
         )
+        return st, dirty | hit_move
 
-    return jax.lax.fori_loop(0, R, del_body, state)
+    # a tombstoned move row must release its range (and let shadowed moves
+    # win again — the override-reintegration of moving.rs:229-280)
+    state, moves_dirty = jax.lax.fori_loop(
+        0, R, del_body, (state, moves_dirty)
+    )
+    return _recompute_moves(state, moves_dirty, client_rank)
 
 
 @jax.jit
@@ -850,6 +1082,8 @@ class BatchEncoder:
         # True once any encoded row was a map row or had a branch-id parent
         # (streams with such rows cannot take the fused Pallas path)
         self.saw_map_or_nested = False
+        # True once any encoded row was a ContentMove (also fused-path-unsafe)
+        self.saw_move = False
 
     def partition_carriers(self, update: Update, local_sv=None):
         """(applicable, leftover) carriers — the host half of the reference's
@@ -893,16 +1127,22 @@ class BatchEncoder:
                     carrier = q[heads[c]]
                     if local_sv is not None and carrier.id.clock > emitted[c]:
                         break  # clock gap within this client → pending
-                    if isinstance(carrier, Item) and not (
-                        satisfied(carrier.origin)
-                        and satisfied(carrier.right_origin)
-                        and satisfied(
+                    if isinstance(carrier, Item):
+                        deps = [
+                            carrier.origin,
+                            carrier.right_origin,
                             carrier.parent
                             if isinstance(carrier.parent, ID)
-                            else None
-                        )
-                    ):
-                        break
+                            else None,
+                        ]
+                        # a move row depends on its range bounds too
+                        # (parity: Update::missing, update.rs:310-385)
+                        content = carrier.content
+                        if isinstance(content, ContentMove):
+                            deps.append(content.move.start.id)
+                            deps.append(content.move.end.id)
+                        if not all(satisfied(d) for d in deps):
+                            break
                     out.append(carrier)
                     emitted[c] = max(emitted[c], carrier.id.clock + carrier.len)
                     heads[c] += 1
@@ -930,13 +1170,14 @@ class BatchEncoder:
 
     def rows_from_carriers(self, carriers: list) -> list:
         """Row tuples for already-ordered carriers (see partition_carriers)."""
+        no_move = (-1, 0, 0, -1, 0, 0, -1)  # mv_sc..mv_prio padding
         rows = []
         for carrier in carriers:
             c = self.interner.intern(carrier.id.client)
             if isinstance(carrier, GCRange):
                 rows.append(
                     (c, carrier.id.clock, carrier.len, -1, 0, -1, 0,
-                     BLOCK_GC, -1, 0, -1, 0, -1, 0)
+                     BLOCK_GC, -1, 0, -1, 0, -1, 0) + no_move
                 )
                 continue
             item: Item = carrier
@@ -975,9 +1216,26 @@ class BatchEncoder:
                 p_tag, pc, pk = 0, -1, 0
             if key >= 0 or p_tag == 2:
                 self.saw_map_or_nested = True
+            mv = no_move
+            if kind == CONTENT_MOVE:
+                self.saw_move = True
+                move = item.content.move
+                if move.start.id is not None and move.end.id is not None:
+                    mv = (
+                        self.interner.intern(move.start.id.client),
+                        move.start.id.clock,
+                        move.start.assoc,
+                        self.interner.intern(move.end.id.client),
+                        move.end.id.clock,
+                        move.end.assoc,
+                        max(move.priority, 0),
+                    )
+                # branch-scoped sticky bounds (no item id) have no device
+                # form — the row integrates but claims nothing; such docs
+                # should stay on the host oracle
             rows.append(
                 (c, item.id.clock, item.len, oc, ok, rc, rk, kind, ref, 0,
-                 key, p_tag, pc, pk)
+                 key, p_tag, pc, pk) + mv
             )
         return rows
 
@@ -1013,9 +1271,12 @@ class BatchEncoder:
         D = len(all_rows)
 
         def pad_rows():
-            out = np.zeros((D, U, 14), dtype=np.int32)
+            out = np.zeros((D, U, 21), dtype=np.int32)
             out[:, :, 10] = -1  # key padding must read as "sequence row"
             out[:, :, 12] = -1  # p_client padding
+            out[:, :, 14] = -1  # mv_sc padding
+            out[:, :, 17] = -1  # mv_ec padding
+            out[:, :, 20] = -1  # mv_prio padding
             valid = np.zeros((D, U), dtype=bool)
             for d, rows in enumerate(all_rows):
                 for i, row in enumerate(rows):
@@ -1049,6 +1310,13 @@ class BatchEncoder:
             p_tag=jnp.asarray(rows[:, :, 11]),
             p_client=jnp.asarray(rows[:, :, 12]),
             p_clock=jnp.asarray(rows[:, :, 13]),
+            mv_sc=jnp.asarray(rows[:, :, 14]),
+            mv_sk=jnp.asarray(rows[:, :, 15]),
+            mv_sa=jnp.asarray(rows[:, :, 16]),
+            mv_ec=jnp.asarray(rows[:, :, 17]),
+            mv_ek=jnp.asarray(rows[:, :, 18]),
+            mv_ea=jnp.asarray(rows[:, :, 19]),
+            mv_prio=jnp.asarray(rows[:, :, 20]),
             valid=jnp.asarray(rows_valid),
             del_client=jnp.asarray(dels[:, :, 0]),
             del_start=jnp.asarray(dels[:, :, 1]),
@@ -1065,9 +1333,12 @@ class BatchEncoder:
                 f"update needs {len(rows)} rows/{len(dels)} dels, "
                 f"buckets are {n_rows}/{n_dels}"
             )
-        row_arr = np.zeros((n_rows, 14), dtype=np.int32)
+        row_arr = np.zeros((n_rows, 21), dtype=np.int32)
         row_arr[:, 10] = -1
         row_arr[:, 12] = -1
+        row_arr[:, 14] = -1
+        row_arr[:, 17] = -1
+        row_arr[:, 20] = -1
         row_valid = np.zeros(n_rows, dtype=bool)
         for i, row in enumerate(rows):
             row_arr[i] = row
@@ -1092,6 +1363,13 @@ class BatchEncoder:
             p_tag=jnp.asarray(row_arr[:, 11]),
             p_client=jnp.asarray(row_arr[:, 12]),
             p_clock=jnp.asarray(row_arr[:, 13]),
+            mv_sc=jnp.asarray(row_arr[:, 14]),
+            mv_sk=jnp.asarray(row_arr[:, 15]),
+            mv_sa=jnp.asarray(row_arr[:, 16]),
+            mv_ec=jnp.asarray(row_arr[:, 17]),
+            mv_ek=jnp.asarray(row_arr[:, 18]),
+            mv_ea=jnp.asarray(row_arr[:, 19]),
+            mv_prio=jnp.asarray(row_arr[:, 20]),
             valid=jnp.asarray(row_valid),
             del_client=jnp.asarray(del_arr[:, 0]),
             del_start=jnp.asarray(del_arr[:, 1]),
@@ -1104,15 +1382,78 @@ class BatchEncoder:
         """Stack per-step batches into [S, ...] leaves for lax.scan."""
         return jax.tree.map(lambda *xs: jnp.stack(xs), *steps)
 
+def _move_bounds(bl, n: int, s: int):
+    """Host resolution of move row s's (start, end) slots.
+
+    Mirrors `_resolve_move_ptr`: assoc After -> the slot starting at the
+    sticky id; assoc Before -> the right neighbor of the slot ending at it.
+    Claim passes split at the bounds, so covering slots land exactly."""
+
+    def covering(c: int, k: int) -> int:
+        m = np.nonzero(
+            (bl.client[:n] == c)
+            & (bl.clock[:n] <= k)
+            & (k < bl.clock[:n] + bl.length[:n])
+        )[0]
+        return int(m[0]) if len(m) else -1
+
+    i = covering(int(bl.mv_sc[s]), int(bl.mv_sk[s]))
+    if int(bl.mv_sa[s]) < 0:  # assoc Before: exclusive left bound
+        i = int(bl.right[i]) if i >= 0 else -1
+    j = covering(int(bl.mv_ec[s]), int(bl.mv_ek[s]))
+    if int(bl.mv_ea[s]) >= 0:
+        pass  # assoc After: the end slot itself is the exclusive bound
+    else:
+        j = int(bl.right[j]) if j >= 0 else -1
+    return i, j
+
+
+def _visible_walk(bl, n: int, start: int):
+    """Yield slots in *visible* order, honoring move ranges.
+
+    Host mirror of `ytpu.types.shared.visible_items` (reference MoveIter,
+    iter.rs:46-116) over device block columns: a row whose `moved` owner
+    differs from the current scope is skipped (it renders at its
+    destination); a live ContentMove row descends into its range. Callers
+    apply their own deleted/countable filters."""
+    stack: List[Tuple[int, int, int]] = []
+    cur, scope, scope_end = start, -1, -1
+    # every live move row re-scans its physical span, so the walk bound
+    # must scale with the live-move count, not just the row count
+    n_moves = int(
+        np.sum((bl.kind[:n] == CONTENT_MOVE) & ~bl.deleted[:n])
+    )
+    steps, limit = 0, (n + 2) * (n_moves + 2)
+    while True:
+        if cur < 0 or (scope_end >= 0 and cur == scope_end):
+            if stack:
+                cur, scope, scope_end = stack.pop()
+                continue
+            break
+        steps += 1
+        if steps > limit:
+            raise RuntimeError("cycle detected in move-aware walk")
+        kind = int(bl.kind[cur])
+        if (
+            kind == CONTENT_MOVE
+            and not bl.deleted[cur]
+            and int(bl.moved[cur]) == scope
+        ):
+            s_ptr, e_ptr = _move_bounds(bl, n, cur)
+            stack.append((int(bl.right[cur]), scope, scope_end))
+            scope, scope_end = cur, e_ptr
+            cur = s_ptr
+            continue
+        if int(bl.moved[cur]) == scope and kind != CONTENT_MOVE:
+            yield cur
+        cur = int(bl.right[cur])
+
+
 def get_string(state: DocStateBatch, doc: int, payloads: PayloadStore) -> str:
     """Host assembly of a doc's visible text (device gather + host concat)."""
     bl = jax.tree.map(lambda a: np.asarray(a[doc]), state.blocks)
-    start = int(state.start[doc])
     out: List[str] = []
-    idx = start
-    steps = 0
-    limit = int(state.n_blocks[doc]) + 1
-    while idx >= 0 and steps <= limit:
+    for idx in _visible_walk(bl, int(state.n_blocks[doc]), int(state.start[doc])):
         if not bl.deleted[idx] and bl.kind[idx] == CONTENT_STRING:
             out.append(
                 payloads.slice_text(
@@ -1121,10 +1462,6 @@ def get_string(state: DocStateBatch, doc: int, payloads: PayloadStore) -> str:
                     int(bl.length[idx]),
                 )
             )
-        idx = int(bl.right[idx])
-        steps += 1
-    if steps > limit:
-        raise RuntimeError(f"cycle detected in doc {doc} sequence")
     return "".join(out)
 
 
@@ -1158,7 +1495,6 @@ def get_tree(
 
     bl = jax.tree.map(lambda a: np.asarray(a[doc]), state.blocks)
     n = int(state.n_blocks[doc])
-    limit = n + 1
 
     def render_type(i: int):
         content = payloads.items[int(bl.content_ref[i])][1]
@@ -1189,14 +1525,9 @@ def get_tree(
 
     def render_branch(head: int, parent_row: int):
         seq: list = []
-        idx, steps = head, 0
-        while idx >= 0 and steps <= limit:
+        for idx in _visible_walk(bl, n, head):
             if not bl.deleted[idx] and bl.countable[idx] and bl.key[idx] < 0:
                 seq.extend(render_row_values(idx))
-            idx = int(bl.right[idx])
-            steps += 1
-        if steps > limit:
-            raise RuntimeError(f"cycle detected in doc {doc} branch tree")
         mp: dict = {}
         for i in range(n):
             if (
@@ -1218,11 +1549,8 @@ def get_tree(
 def get_values(state: DocStateBatch, doc: int, payloads: PayloadStore) -> list:
     """Host assembly of a doc's visible sequence values (Array flagship)."""
     bl = jax.tree.map(lambda a: np.asarray(a[doc]), state.blocks)
-    idx = int(state.start[doc])
     out: list = []
-    steps = 0
-    limit = int(state.n_blocks[doc]) + 1
-    while idx >= 0 and steps <= limit:
+    for idx in _visible_walk(bl, int(state.n_blocks[doc]), int(state.start[doc])):
         if not bl.deleted[idx] and bl.countable[idx]:
             kind = int(bl.kind[idx])
             ref = int(bl.content_ref[idx])
@@ -1232,8 +1560,4 @@ def get_values(state: DocStateBatch, doc: int, payloads: PayloadStore) -> list:
                 out.extend(payloads.slice_text(ref, off, ln))
             elif kind == CONTENT_ANY:
                 out.extend(payloads.slice_values(ref, off, ln))
-        idx = int(bl.right[idx])
-        steps += 1
-    if steps > limit:
-        raise RuntimeError(f"cycle detected in doc {doc} sequence")
     return out

@@ -34,7 +34,9 @@ from ytpu.models.ingest import BatchIngestor
 
 __all__ = ["save_state", "load_state", "save_ingestor", "load_ingestor"]
 
-_FORMAT = 1
+# 2: BlockCols gained move columns (moved, mv_sc..mv_prio) and the encoder
+#    sidecar gained saw_move — format-1 checkpoints cannot be restored
+_FORMAT = 2
 
 
 def _state_to_numpy(state: DocStateBatch) -> dict:
@@ -68,6 +70,7 @@ def _enc_sidecar(enc: BatchEncoder) -> dict:
         "key_names": dict(enc.keys.names),
         "payload_items": list(enc.payloads.items),
         "saw_map_or_nested": enc.saw_map_or_nested,
+        "saw_move": enc.saw_move,
     }
 
 
@@ -80,6 +83,7 @@ def _enc_restore(side: dict) -> BatchEncoder:
         assert got == kid
     enc.payloads.items = list(side["payload_items"])
     enc.saw_map_or_nested = side["saw_map_or_nested"]
+    enc.saw_move = side["saw_move"]
     return enc
 
 

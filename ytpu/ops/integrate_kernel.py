@@ -26,8 +26,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-from ytpu.core.content import BLOCK_GC, CONTENT_DELETED, CONTENT_FORMAT
+from ytpu.core.content import (
+    BLOCK_GC,
+    CONTENT_DELETED,
+    CONTENT_FORMAT,
+    CONTENT_MOVE,
+)
 from ytpu.models.batch_doc import BlockCols, DocStateBatch, UpdateBatch
 
 __all__ = [
@@ -121,6 +127,14 @@ def unpack_state(
         key=state.blocks.key,
         parent=state.blocks.parent,
         head=state.blocks.head,
+        moved=state.blocks.moved,
+        mv_sc=state.blocks.mv_sc,
+        mv_sk=state.blocks.mv_sk,
+        mv_sa=state.blocks.mv_sa,
+        mv_ec=state.blocks.mv_ec,
+        mv_ek=state.blocks.mv_ek,
+        mv_ea=state.blocks.mv_ea,
+        mv_prio=state.blocks.mv_prio,
     )
     return DocStateBatch(
         blocks=blocks,
@@ -188,6 +202,14 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         mask = (iota_c == idx[:, None]) & active[:, None] & (idx >= 0)[:, None]
         cols_ref[i] = jnp.where(mask, val[:, None], col(i))
 
+    def put_many(idx, active, writes):
+        """Write several columns at one slot, computing the mask once.
+
+        `writes` is [(col_idx, val_vector), ...]; same semantics as `put`."""
+        mask = (iota_c == idx[:, None]) & active[:, None] & (idx >= 0)[:, None]
+        for i, val in writes:
+            cols_ref[i] = jnp.where(mask, val[:, None], col(i))
+
     def n_blocks():
         return meta_ref[:, M_NBLOCKS]
 
@@ -221,34 +243,51 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         return jnp.max(jnp.where(m, col(CK) + col(LN), 0), axis=1)
 
     def split(i_idx, off, want):
-        """Split block i at off (per doc); returns right-half slot (or i)."""
+        """Split block i at off (per doc); returns right-half slot (or i).
+
+        The whole write phase sits behind `pl.when(any(do))`: the hot replay
+        case (appends, whole-block deletes) needs no split in *any* doc of
+        the tile, so the ~30 [DB, C] sweeps below are skipped entirely."""
         length_i = gather(LN, i_idx, 0)
         do = want & (i_idx >= 0) & (off > 0) & (off < length_i)
         j = n_blocks()
         overflow = do & (j >= C)
         do = do & (j < C)
-        right_i = gather(RT, i_idx, -1)
-        # new row j = right half
-        put(CL, j, gather(CL, i_idx, -1), do)
-        put(CK, j, gather(CK, i_idx, 0) + off, do)
-        put(LN, j, length_i - off, do)
-        put(OC, j, gather(CL, i_idx, -1), do)
-        put(OK, j, gather(CK, i_idx, 0) + off - 1, do)
-        put(RC, j, gather(RC, i_idx, -1), do)
-        put(RK, j, gather(RK, i_idx, 0), do)
-        put(LT, j, i_idx, do)
-        put(RT, j, right_i, do)
-        put(DL, j, gather(DL, i_idx, 0), do)
-        put(CN, j, gather(CN, i_idx, 0), do)
-        put(KD, j, gather(KD, i_idx, 0), do)
-        put(RF, j, gather(RF, i_idx, -1), do)
-        put(OF, j, gather(OF, i_idx, 0) + off, do)
-        # fix left half + old right neighbor
-        put(LN, i_idx, off, do)
-        put(RT, i_idx, j, do)
-        put(LT, right_i, j, do & (right_i >= 0))
-        meta_ref[:, M_NBLOCKS] = n_blocks() + do.astype(I32)
-        meta_ref[:, M_ERROR] = meta_ref[:, M_ERROR] | jnp.where(overflow, ERR_CAPACITY, 0)
+        # the error record must not sit behind the lazy write phase: a tile
+        # where every needed split overflows has all-False `do`
+        meta_ref[:, M_ERROR] = meta_ref[:, M_ERROR] | jnp.where(
+            overflow, ERR_CAPACITY, 0
+        )
+
+        @pl.when(jnp.any(do))
+        def _():
+            right_i = gather(RT, i_idx, -1)
+            # new row j = right half
+            put_many(
+                j,
+                do,
+                [
+                    (CL, gather(CL, i_idx, -1)),
+                    (CK, gather(CK, i_idx, 0) + off),
+                    (LN, length_i - off),
+                    (OC, gather(CL, i_idx, -1)),
+                    (OK, gather(CK, i_idx, 0) + off - 1),
+                    (RC, gather(RC, i_idx, -1)),
+                    (RK, gather(RK, i_idx, 0)),
+                    (LT, i_idx),
+                    (RT, right_i),
+                    (DL, gather(DL, i_idx, 0)),
+                    (CN, gather(CN, i_idx, 0)),
+                    (KD, gather(KD, i_idx, 0)),
+                    (RF, gather(RF, i_idx, -1)),
+                    (OF, gather(OF, i_idx, 0) + off),
+                ],
+            )
+            # fix left half + old right neighbor
+            put_many(i_idx, do, [(LN, off), (RT, j)])
+            put(LT, right_i, j, do & (right_i >= 0))
+            meta_ref[:, M_NBLOCKS] = n_blocks() + do.astype(I32)
+
         return jnp.where(do, j, i_idx)
 
     def clean_end(client_s, clock_v, enable):
@@ -389,20 +428,26 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         row_deleted = is_gc | (r_kind == CONTENT_DELETED)
         row_countable = ~row_deleted & (r_kind != CONTENT_FORMAT)
 
-        put(CL, j, jnp.full((DB,), r_client, I32), do)
-        put(CK, j, clock, do)
-        put(LN, j, length, do)
-        put(OC, j, jnp.where(has_origin, origin_client, -1), do)
-        put(OK, j, jnp.where(has_origin, origin_clock, 0), do)
-        put(RC, j, jnp.full((DB,), jnp.where(has_ror, r_rc, -1), I32), do)
-        put(RK, j, jnp.full((DB,), jnp.where(has_ror, r_rk, 0), I32), do)
-        put(LT, j, jnp.where(linkable, left_idx, -1), do)
-        put(RT, j, jnp.where(linkable, right_final, -1), do)
-        put(DL, j, jnp.full((DB,), row_deleted.astype(I32), I32), do)
-        put(CN, j, jnp.full((DB,), row_countable.astype(I32), I32), do)
-        put(KD, j, jnp.full((DB,), r_kind, I32), do)
-        put(RF, j, jnp.full((DB,), r_ref, I32), do)
-        put(OF, j, c_off, do)
+        put_many(
+            j,
+            do,
+            [
+                (CL, jnp.full((DB,), r_client, I32)),
+                (CK, clock),
+                (LN, length),
+                (OC, jnp.where(has_origin, origin_client, -1)),
+                (OK, jnp.where(has_origin, origin_clock, 0)),
+                (RC, jnp.full((DB,), jnp.where(has_ror, r_rc, -1), I32)),
+                (RK, jnp.full((DB,), jnp.where(has_ror, r_rk, 0), I32)),
+                (LT, jnp.where(linkable, left_idx, -1)),
+                (RT, jnp.where(linkable, right_final, -1)),
+                (DL, jnp.full((DB,), row_deleted.astype(I32), I32)),
+                (CN, jnp.full((DB,), row_countable.astype(I32), I32)),
+                (KD, jnp.full((DB,), r_kind, I32)),
+                (RF, jnp.full((DB,), r_ref, I32)),
+                (OF, c_off),
+            ],
+        )
         meta_ref[:, M_NBLOCKS] = n_blocks() + do.astype(I32)
         meta_ref[:, M_ERROR] = (
             meta_ref[:, M_ERROR]
@@ -485,6 +530,12 @@ def _run(cols, meta, packed, d_block: int, interpret: bool):
         ],
         input_output_aliases={3: 0, 4: 1},
         interpret=interpret,
+        # the doc tile ([NC, d_block, C] i32) is the dominant VMEM tenant;
+        # the default 16MB scoped limit caps d_block at 32 for C=2048 —
+        # v5e/v6e cores have 128MB VMEM, so let tiles use up to half
+        compiler_params=None
+        if interpret
+        else pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024),
     )(rows, dels, rank, cols, meta)
     return out
 
@@ -508,14 +559,23 @@ def apply_update_stream_fused(
     pass `guard=False` — the default device-side guard costs one
     host-device sync before launch."""
     if guard and bool(
-        jnp.any((stream.key >= 0) | ((stream.p_tag == 2) & stream.valid))
+        jnp.any(
+            (
+                (stream.key >= 0)
+                | (stream.p_tag == 2)
+                | (stream.kind == CONTENT_MOVE)
+            )
+            & stream.valid
+        )
         | jnp.any(state.blocks.key >= 0)
         | jnp.any(state.blocks.parent >= 0)
+        | jnp.any(state.blocks.kind == CONTENT_MOVE)
     ):
         raise NotImplementedError(
             "apply_update_stream_fused integrates root-sequence-only "
-            "streams over root-sequence-only states; map rows (parent_sub) "
-            "or nested-branch parents must take apply_update_stream"
+            "streams over root-sequence-only states; map rows (parent_sub), "
+            "nested-branch parents, and move ranges must take "
+            "apply_update_stream"
         )
     cols, meta = pack_state(state)
     D = cols.shape[1]
