@@ -106,12 +106,25 @@ def encode_state_vector_from_update_v2(update: bytes) -> bytes:
     return _sv(update)
 
 
+def _format_update(u: Update) -> str:
+    """Readable structure dump (the ywasm debug-dump surface,
+    ywasm/src/lib.rs:91-103 / yffi ytransaction_writeable update dumps)."""
+    lines = []
+    for client in sorted(u.blocks.keys(), reverse=True):
+        lines.append(f"client {client}:")
+        for carrier in u.blocks[client]:
+            lines.append(f"  {carrier!r}")
+    if u.delete_set.clients:
+        lines.append(f"delete set: {dict(u.delete_set.clients)!r}")
+    return "\n".join(lines) if lines else "<empty update>"
+
+
 def debug_update_v1(update: bytes) -> str:
-    return repr(Update.decode_v1(update))
+    return _format_update(Update.decode_v1(update))
 
 
 def debug_update_v2(update: bytes) -> str:
-    return repr(Update.decode_v2(update))
+    return _format_update(Update.decode_v2(update))
 
 
 # --- snapshots (ywasm lib.rs: snapshot / equalSnapshots / …) -----------------

@@ -148,3 +148,24 @@ class Snapshot:
         ds = DeleteSet.decode(dec)
         sv = StateVector.decode(dec.cur)
         return cls(sv, ds)
+
+    def encode_v2(self) -> bytes:
+        """Same layout through the v2 columnar codec (parity:
+        Snapshot::encode_v2, state_vector.rs)."""
+        from ytpu.encoding.codec import EncoderV2
+
+        enc = EncoderV2()
+        self.delete_set.encode(enc)
+        self.state_vector.encode(enc.rest)
+        return enc.to_bytes()
+
+    @classmethod
+    def decode_v2(cls, data: bytes) -> "Snapshot":
+        from ytpu.encoding.codec import DecoderV2
+
+        from .id_set import DeleteSet
+
+        dec = DecoderV2(data)
+        ds = DeleteSet.decode(dec)
+        sv = StateVector.decode(dec.rest)
+        return cls(sv, ds)

@@ -282,16 +282,23 @@ class Update:
                     out.append(c)
                     current_end = start + length
                     continue
-                if start > current_end:
-                    # hole: synthesize a skip
-                    out.append(SkipRange(ID(client, current_end), start - current_end))
+                if start >= current_end:
+                    if start > current_end:
+                        # hole: synthesize a skip
+                        out.append(
+                            SkipRange(ID(client, current_end), start - current_end)
+                        )
+                    # contiguous (or after the skip): emit the carrier whole —
+                    # splitting at offset 0 would rewrite its origin to
+                    # (client, clock-1), which only coincides with the true
+                    # origin for append-only streams
                     out.append(c)
                     current_end = start + length
                 elif start + length <= current_end:
                     continue  # fully covered
                 else:
                     # partial overlap: emit only the uncovered suffix
-                    overlap = current_end - start
+                    overlap = current_end - start  # > 0 here
                     if c.is_skip:
                         out.append(SkipRange(ID(client, current_end), length - overlap))
                     elif isinstance(c, GCRange):

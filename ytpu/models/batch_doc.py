@@ -137,41 +137,51 @@ class UpdateBatch(NamedTuple):
 ERR_CAPACITY = 1
 ERR_MISSING_DEP = 2
 
+# empty-slot value per BlockCols field — the single source of truth for
+# init_state, compaction's defrag fills, and grow_state's padding
+COL_DEFAULTS: Dict[str, object] = {
+    "client": -1,
+    "clock": 0,
+    "length": 0,
+    "origin_client": -1,
+    "origin_clock": 0,
+    "ror_client": -1,
+    "ror_clock": 0,
+    "left": -1,
+    "right": -1,
+    "deleted": False,
+    "countable": False,
+    "kind": 0,
+    "content_ref": -1,
+    "content_off": 0,
+    "key": -1,
+    "parent": -1,
+    "head": -1,
+    "moved": -1,
+    "mv_sc": -1,
+    "mv_sk": 0,
+    "mv_sa": 0,
+    "mv_ec": -1,
+    "mv_ek": 0,
+    "mv_ea": 0,
+    "mv_prio": -1,
+}
+assert tuple(COL_DEFAULTS) == BlockCols._fields
+
 
 def init_state(n_docs: int, capacity: int) -> DocStateBatch:
     """Allocate an empty batch of docs with `capacity` block slots each."""
+    shape = (n_docs, capacity)
+    blocks = BlockCols(
+        **{
+            name: jnp.full(shape, fill, dtype=bool if isinstance(fill, bool) else I32)
+            for name, fill in COL_DEFAULTS.items()
+        }
+    )
 
     def full(shape, v, dtype=I32):
         return jnp.full(shape, v, dtype=dtype)
 
-    shape = (n_docs, capacity)
-    blocks = BlockCols(
-        client=full(shape, -1),
-        clock=full(shape, 0),
-        length=full(shape, 0),
-        origin_client=full(shape, -1),
-        origin_clock=full(shape, 0),
-        ror_client=full(shape, -1),
-        ror_clock=full(shape, 0),
-        left=full(shape, -1),
-        right=full(shape, -1),
-        deleted=jnp.zeros(shape, bool),
-        countable=jnp.zeros(shape, bool),
-        kind=full(shape, 0),
-        content_ref=full(shape, -1),
-        content_off=full(shape, 0),
-        key=full(shape, -1),
-        parent=full(shape, -1),
-        head=full(shape, -1),
-        moved=full(shape, -1),
-        mv_sc=full(shape, -1),
-        mv_sk=full(shape, 0),
-        mv_sa=full(shape, 0),
-        mv_ec=full(shape, -1),
-        mv_ek=full(shape, 0),
-        mv_ea=full(shape, 0),
-        mv_prio=full(shape, -1),
-    )
     return DocStateBatch(
         blocks=blocks,
         start=full((n_docs,), -1),

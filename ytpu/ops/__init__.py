@@ -1,5 +1,6 @@
 """Device kernels: batched state-vector math, sequence ops, codec helpers."""
 
+from .compaction import compact_state, grow_state
 from .state_vector import (
     diff_start_clocks,
     sv_contains_all,
@@ -14,4 +15,6 @@ __all__ = [
     "sv_diff_mask",
     "sv_from_blocks",
     "diff_start_clocks",
+    "compact_state",
+    "grow_state",
 ]

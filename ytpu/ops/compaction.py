@@ -1,0 +1,205 @@
+"""Device compaction: commit-time block squash + GC collapse, vmapped.
+
+The reference compacts continuously at commit: `Item::try_squash` merges a
+block into its clock-contiguous right neighbor (block.rs:775-799,
+squash_left at block_store.rs:243), and the GC collector replaces deleted
+non-kept items with content-free GC ranges (gc.rs:11-65). The device engine
+appends rows forever, so long-lived docs fill their capacity with 1-element
+blocks; this pass is the batched equivalent, run as one jitted program:
+
+1. **GC conversion** — tombstoned value rows (string/any/binary/json/
+   embed/format) drop their payload reference and become CONTENT_DELETED
+   rows, exactly like the host oracle's collector: the item (with its
+   origin/right-origin anchors) stays in the graph so wire encodes remain
+   integrable by fresh replicas; only the payload is discarded. Structural
+   rows (type/move/doc) are preserved.
+2. **Squash** — a row merges into its sequence-right neighbor under the
+   exact try_squash conditions (same client, contiguous clocks, the
+   neighbor's origin is the row's last id, equal right-origins, equal
+   deleted/moved/key/parent, mergeable content: same payload ref with
+   contiguous offsets for string/any, unconditionally for GC/deleted).
+   Chains collapse in one pass via pointer doubling + segment sums.
+3. **Defragmentation** — surviving rows are packed to the front (slot
+   order preserved), every index column (left/right/parent/head/moved,
+   sequence starts) remapped, and n_blocks shrinks accordingly.
+
+Semantics parity is testable: replay -> compact -> keep replaying must
+match the host oracle exactly (tests/test_compaction.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ytpu.core.content import (
+    BLOCK_GC,
+    CONTENT_ANY,
+    CONTENT_BINARY,
+    CONTENT_DELETED,
+    CONTENT_EMBED,
+    CONTENT_FORMAT,
+    CONTENT_JSON,
+    CONTENT_STRING,
+)
+from ytpu.models.batch_doc import COL_DEFAULTS, BlockCols, DocStateBatch
+
+__all__ = ["compact_state", "grow_state"]
+
+I32 = jnp.int32
+
+# kinds whose tombstones GC to content-free deleted rows (value content;
+# the reference's ItemContent::gc drops these payloads outright)
+_GCABLE = (
+    CONTENT_JSON,
+    CONTENT_BINARY,
+    CONTENT_STRING,
+    CONTENT_EMBED,
+    CONTENT_FORMAT,
+    CONTENT_ANY,
+)
+# content kinds mergeable under try_squash when payload refs are contiguous
+_SPLICEABLE = (CONTENT_STRING, CONTENT_ANY)
+
+
+def _compact_one(state: DocStateBatch) -> DocStateBatch:
+    bl = state.blocks
+    B = bl.client.shape[-1]
+    slots = jnp.arange(B, dtype=I32)
+    n = state.n_blocks
+    active = slots < n
+
+    # --- 1. GC conversion (gc.rs:11-65) ------------------------------------
+    gcable = jnp.zeros((B,), bool)
+    for k in _GCABLE:
+        gcable = gcable | (bl.kind == k)
+    convert = active & bl.deleted & gcable
+    kind = jnp.where(convert, CONTENT_DELETED, bl.kind)
+    content_ref = jnp.where(convert, -1, bl.content_ref)
+    content_off = jnp.where(convert, 0, bl.content_off)
+    bl = bl._replace(kind=kind, content_ref=content_ref, content_off=content_off)
+
+    # --- 2. squash eligibility a -> b = right[a] (block.rs:775-799) --------
+    b = bl.right
+    sb = jnp.maximum(b, 0)
+
+    def g(col):
+        return col[sb]
+
+    ror_eq = (bl.ror_client == g(bl.ror_client)) & (
+        (bl.ror_client < 0) | (bl.ror_clock == g(bl.ror_clock))
+    )
+    origin_chain = (g(bl.origin_client) == bl.client) & (
+        g(bl.origin_clock) == bl.clock + bl.length - 1
+    )
+    spliceable = jnp.zeros((B,), bool)
+    for k in _SPLICEABLE:
+        spliceable = spliceable | (bl.kind == k)
+    content_ok = (bl.kind == g(bl.kind)) & (
+        (bl.kind == BLOCK_GC)
+        | (bl.kind == CONTENT_DELETED)
+        | (
+            spliceable
+            & (bl.content_ref == g(bl.content_ref))
+            & (g(bl.content_off) == bl.content_off + bl.length)
+        )
+    )
+    elig = (
+        active
+        & (b >= 0)
+        & (b < n)
+        & (bl.client == g(bl.client))
+        & (g(bl.clock) == bl.clock + bl.length)
+        & origin_chain
+        & ror_eq
+        & (bl.deleted == g(bl.deleted))
+        & (bl.moved == g(bl.moved))
+        & (bl.key == g(bl.key))
+        & (bl.parent == g(bl.parent))
+        & (g(bl.left) == slots)  # well-formed adjacency both ways
+        & content_ok
+    )
+
+    # a row is absorbed into its chain head iff its left neighbor merges
+    # rightward into it
+    sl = jnp.maximum(bl.left, 0)
+    merged_away = active & (bl.left >= 0) & elig[sl]
+
+    # chain representative via pointer doubling: parent = left when absorbed
+    rep = jnp.where(merged_away, bl.left, slots)
+    n_doubling = max(1, B.bit_length())
+    for _ in range(n_doubling):
+        rep = rep[jnp.maximum(rep, 0)]
+
+    # per-chain aggregates (segment id = chain head slot)
+    seg_len = jax.ops.segment_sum(
+        jnp.where(active, bl.length, 0), jnp.maximum(rep, 0), num_segments=B
+    )
+    # the chain tail (the row that does NOT merge rightward) donates its
+    # right pointer to the head
+    tail = active & ~elig
+    tail_w = jnp.where(tail, rep, B)
+    chain_right = jnp.full((B,), -1, I32).at[tail_w].set(bl.right, mode="drop")
+
+    keep = active & ~merged_away
+    # heads take the aggregated length + the tail's right pointer
+    length = jnp.where(keep, seg_len, bl.length)
+    right = jnp.where(keep, chain_right, bl.right)
+    bl = bl._replace(length=length, right=right)
+
+    # --- 3. defragment: pack kept rows, remap index columns ----------------
+    new_idx = jnp.cumsum(keep.astype(I32)) - 1
+    # pointers into absorbed rows redirect to their chain head
+    old2new = jnp.where(keep, new_idx, new_idx[jnp.maximum(rep, 0)])
+
+    def remap(col):
+        return jnp.where(col >= 0, old2new[jnp.maximum(col, 0)], -1)
+
+    bl = bl._replace(
+        left=remap(bl.left),
+        right=remap(bl.right),
+        parent=remap(bl.parent),
+        head=remap(bl.head),
+        moved=remap(bl.moved),
+    )
+    n_new = jnp.sum(keep.astype(I32))
+    # kept rows first (slot order preserved), dropped rows after
+    order = jnp.argsort(jnp.where(keep, slots, B + slots))
+    blank = slots >= n_new
+
+    packed = BlockCols(
+        **{
+            name: jnp.where(blank, fill, getattr(bl, name)[order])
+            for name, fill in COL_DEFAULTS.items()
+        }
+    )
+    start = jnp.where(
+        state.start >= 0, old2new[jnp.maximum(state.start, 0)], -1
+    )
+    return DocStateBatch(
+        blocks=packed, start=start, n_blocks=n_new, error=state.error
+    )
+
+
+@jax.jit
+def compact_state(state: DocStateBatch) -> DocStateBatch:
+    """Squash + GC + defragment every doc in the batch (one compiled pass)."""
+    return jax.vmap(_compact_one)(state)
+
+
+def grow_state(state: DocStateBatch, new_capacity: int) -> DocStateBatch:
+    """Widen every doc's block capacity (host-side repad; index columns are
+    slot-based so they survive unchanged)."""
+    B = state.blocks.client.shape[-1]
+    if new_capacity < B:
+        raise ValueError(f"cannot shrink capacity {B} -> {new_capacity}")
+    if new_capacity == B:
+        return state
+    pad = new_capacity - B
+
+    cols = {}
+    for name, fill in COL_DEFAULTS.items():
+        col = getattr(state.blocks, name)
+        ext = jnp.full(col.shape[:-1] + (pad,), fill, dtype=col.dtype)
+        cols[name] = jnp.concatenate([col, ext], axis=-1)
+    return state._replace(blocks=BlockCols(**cols))
