@@ -105,32 +105,54 @@ def host_replay(log):
 
 
 def device_replay(log, expect: str):
-    import jax
+    """Wire bytes → device. The host's only work is a memcpy into the padded
+    byte matrix; varint/structure decode (`decode_updates_v1`) and YATA
+    integration (fused Pallas kernel) both run on the TPU — the north-star
+    "ship raw update bytes to HBM" path (SURVEY §7 step 8)."""
+    from functools import partial
 
+    import jax
+    import jax.numpy as jnp
     import numpy as np
 
-    from ytpu.core import Update
-    from ytpu.models.batch_doc import BatchEncoder, get_string, init_state
+    from ytpu.models.batch_doc import get_string, init_state
+    from ytpu.ops.decode_kernel import (
+        FLAG_ERRORS,
+        RawPayloadView,
+        decode_updates_v1,
+        identity_rank,
+        pack_updates,
+    )
     from ytpu.ops.integrate_kernel import apply_update_stream_fused
 
-    enc = BatchEncoder()
-    steps = [
-        enc.build_step(Update.decode_v1(p), ROWS_PER_STEP, DELS_PER_STEP) for p in log
-    ]
-    stream = BatchEncoder.stack_steps(steps)
-    rank = enc.interner.rank_table()
+    buf_np, lens_np = pack_updates(log)
+    decode = jax.jit(
+        partial(decode_updates_v1, max_rows=ROWS_PER_STEP, max_dels=DELS_PER_STEP)
+    )
+    rank = identity_rank(256)
 
-    assert not (enc.saw_map_or_nested or enc.saw_move)  # fused path is valid
+    def run(state):
+        buf = jnp.asarray(buf_np)  # host→device: raw wire bytes, nothing else
+        lens = jnp.asarray(lens_np)
+        stream, flags = decode(buf, lens)
+        state = apply_update_stream_fused(
+            state, stream, rank, d_block=D_BLOCK, guard=False
+        )
+        return state, flags
+
     # warmup / compile (donated arg: rebuild state afterwards)
-    state = init_state(N_DOCS, CAPACITY)
-    state = apply_update_stream_fused(state, stream, rank, d_block=D_BLOCK, guard=False)
+    state, flags = run(init_state(N_DOCS, CAPACITY))
+    f = np.asarray(flags)
+    if (f & FLAG_ERRORS).any():
+        raise RuntimeError(f"device decode flagged updates: {f[f != 0][:8]}")
     err = int(np.asarray(state.error).max())
     if err != 0:
         raise RuntimeError(f"device error flag {err}")
-    got = get_string(state, 0, enc.payloads)
+    view = RawPayloadView(buf_np)
+    got = get_string(state, 0, view)
     if got != expect:
         raise RuntimeError(f"device text mismatch: {got[:60]!r} != {expect[:60]!r}")
-    if get_string(state, N_DOCS - 1, enc.payloads) != expect:
+    if get_string(state, N_DOCS - 1, view) != expect:
         raise RuntimeError("device text mismatch in last doc slot")
 
     # timed run (force a device->host readback: block_until_ready alone has
@@ -138,7 +160,7 @@ def device_replay(log, expect: str):
     state = init_state(N_DOCS, CAPACITY)
     np.asarray(state.n_blocks)
     t0 = time.perf_counter()
-    state = apply_update_stream_fused(state, stream, rank, d_block=D_BLOCK, guard=False)
+    state, _ = run(state)
     np.asarray(state.n_blocks)
     return time.perf_counter() - t0
 

@@ -1,0 +1,305 @@
+"""Device-side V1 update decoding (ytpu/ops/decode_kernel.py).
+
+Oracle: `ytpu.core.Update.decode_v1` — every decoded row/delete-range must
+match the host decoder field-for-field (raw client ids), and replaying the
+device-decoded stream through the XLA integrate path must reproduce the
+host doc byte-for-byte (reference semantics: update.rs:714-749, :433-488).
+"""
+
+import numpy as np
+import pytest
+
+from ytpu.core import Doc, Update
+from ytpu.core.block import GCRange, Item, SkipRange
+from ytpu.core.content import BLOCK_GC, CONTENT_DELETED, CONTENT_STRING
+from ytpu.models.batch_doc import apply_update_stream, get_string, init_state
+from ytpu.ops.decode_kernel import (
+    FLAG_BIG_CLIENT,
+    FLAG_ERRORS,
+    FLAG_MALFORMED,
+    FLAG_MULTI_CLIENT,
+    FLAG_OVERFLOW,
+    FLAG_UNSUPPORTED,
+    RawPayloadView,
+    decode_updates_v1,
+    identity_rank,
+    pack_updates,
+)
+
+
+def _edit_log(ops, client_id=1):
+    """Wire updates from replaying (tag, pos, arg) text ops on a host doc."""
+    doc = Doc(client_id=client_id)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    txt = doc.get_text("text")
+    for tag, pos, arg in ops:
+        with doc.transact() as txn:
+            if tag == "i":
+                txt.insert(txn, pos, arg)
+            else:
+                txt.remove_range(txn, pos, arg)
+    return log, txt.get_string()
+
+
+def _expected_rows_dels(payload):
+    """Wire-order (client, clock, len, oc, ok, rc, rk, kind, text) rows from
+    the host decoder, plus (client, start, end) delete ranges."""
+    u = Update.decode_v1(payload)
+    rows = []
+    for client, blocks in u.blocks.items():
+        for carrier in blocks:
+            if isinstance(carrier, SkipRange):
+                continue
+            if isinstance(carrier, GCRange):
+                rows.append((client, carrier.id.clock, carrier.len, -1, 0, -1, 0,
+                             BLOCK_GC, None))
+                continue
+            item: Item = carrier
+            oc = item.origin.client if item.origin else -1
+            ok = item.origin.clock if item.origin else 0
+            rc = item.right_origin.client if item.right_origin else -1
+            rk = item.right_origin.clock if item.right_origin else 0
+            kind = item.content.kind
+            text = item.content.text if kind == CONTENT_STRING else None
+            rows.append((client, item.id.clock, item.len, oc, ok, rc, rk, kind, text))
+    dels = []
+    for client, ranges in u.delete_set.clients.items():
+        for s, e in ranges:
+            dels.append((client, s, e))
+    return rows, dels
+
+
+def _decode(log, U=4, R=8):
+    buf, lens = pack_updates(log)
+    stream, flags = decode_updates_v1(buf, lens, U, R)
+    return buf, stream, np.asarray(flags)
+
+
+def _check_field_parity(log, U=4, R=8):
+    buf, stream, flags = _decode(log, U, R)
+    view = RawPayloadView(buf)
+    L = buf.shape[1]
+    st = {k: np.asarray(v) for k, v in stream._asdict().items()}
+    for s, payload in enumerate(log):
+        assert flags[s] & FLAG_ERRORS == 0, f"update {s} flagged {flags[s]}"
+        rows, dels = _expected_rows_dels(payload)
+        got_n = int(st["valid"][s].sum())
+        assert got_n == len(rows), (s, got_n, len(rows))
+        for i, (client, clock, ln, oc, ok, rc, rk, kind, text) in enumerate(rows):
+            assert st["client"][s, i] == client
+            assert st["clock"][s, i] == clock
+            assert st["length"][s, i] == ln
+            assert st["origin_client"][s, i] == oc
+            assert st["origin_clock"][s, i] == ok
+            assert st["ror_client"][s, i] == rc
+            assert st["ror_clock"][s, i] == rk
+            assert st["kind"][s, i] == kind
+            if text is not None:
+                ref = int(st["content_ref"][s, i])
+                assert ref // L == s
+                assert view.slice_text(ref, 0, ln) == text
+        got_d = int(st["del_valid"][s].sum())
+        assert got_d == len(dels), (s, got_d, len(dels))
+        for i, (client, start, end) in enumerate(dels):
+            assert st["del_client"][s, i] == client
+            assert st["del_start"][s, i] == start
+            assert st["del_end"][s, i] == end
+    return buf, stream, flags
+
+
+def test_insert_delete_field_parity():
+    ops = [
+        ("i", 0, "hello"),
+        ("i", 5, " world"),
+        ("i", 3, "xyz"),
+        ("d", 2, 4),
+        ("i", 0, "A"),
+        ("d", 0, 1),
+        ("i", 7, "tail"),
+    ]
+    log, _ = _edit_log(ops)
+    _check_field_parity(log)
+
+
+def test_unicode_utf16_lengths():
+    ops = [
+        ("i", 0, "héllo"),  # 2-byte
+        ("i", 2, "日本語"),  # 3-byte
+        ("i", 1, "🙂🙃"),  # 4-byte → surrogate pairs, u16 len 4
+        ("d", 1, 3),
+    ]
+    log, _ = _edit_log(ops)
+    buf, stream, flags = _check_field_parity(log)
+    # the astral insert must count UTF-16 units (2 per emoji)
+    u = Update.decode_v1(log[2])
+    (blocks,) = u.blocks.values()
+    assert blocks[0].len == 4
+
+
+def test_end_to_end_replay_matches_host():
+    import random
+
+    rng = random.Random(3)
+    ops = []
+    length = 0
+    for _ in range(120):
+        if length > 10 and rng.random() < 0.3:
+            pos = rng.randint(0, length - 3)
+            n = rng.randint(1, 3)
+            ops.append(("d", pos, n))
+            length -= n
+        else:
+            word = "".join(rng.choice("abcdefg håπ🙂") for _ in range(rng.randint(1, 6)))
+            ops.append(("i", rng.randint(0, length), word))
+            length += len(word)
+    log, expect = _edit_log(ops)
+    buf, stream, flags = _decode(log, U=4, R=8)
+    assert (flags & FLAG_ERRORS == 0).all()
+
+    n_docs = 4
+    state = init_state(n_docs, 1024)
+    state = apply_update_stream(state, stream, identity_rank(256))
+    assert int(np.asarray(state.error).max()) == 0
+    view = RawPayloadView(buf)
+    assert get_string(state, 0, view) == expect
+    assert get_string(state, n_docs - 1, view) == expect
+
+
+def test_merged_update_multi_block():
+    """merge_updates produces one update with many blocks per client."""
+    from ytpu.core.update import merge_updates_v1
+
+    ops = [("i", 0, "abc"), ("i", 3, "def"), ("i", 2, "XY"), ("d", 1, 2)]
+    log, expect = _edit_log(ops)
+    merged = merge_updates_v1(log)
+    _check_field_parity([merged], U=8, R=8)
+
+    buf, stream, flags = _decode([merged], U=8, R=8)
+    state = init_state(2, 256)
+    state = apply_update_stream(state, stream, identity_rank(256))
+    assert int(np.asarray(state.error).max()) == 0
+    assert get_string(state, 0, RawPayloadView(buf)) == expect
+
+
+def test_multi_client_flagged_informational():
+    d1 = Doc(client_id=1)
+    d2 = Doc(client_id=2)
+    with d1.transact() as txn:
+        d1.get_text("text").insert(txn, 0, "aa")
+    u1 = d1.encode_state_as_update_v1()
+    d2.apply_update_v1(u1)
+    with d2.transact() as txn:
+        d2.get_text("text").insert(txn, 2, "bb")
+    full = d2.encode_state_as_update_v1()
+
+    buf, stream, flags = _decode([full], U=4, R=4)
+    assert flags[0] & FLAG_MULTI_CLIENT
+    assert flags[0] & FLAG_ERRORS == 0
+    rows, _ = _expected_rows_dels(full)
+    assert int(np.asarray(stream.valid)[0].sum()) == len(rows)
+
+
+def test_unsupported_content_flags_for_host_fallback():
+    doc = Doc(client_id=5)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    arr = doc.get_array("text")  # array content → ContentAny rows
+    with doc.transact() as txn:
+        arr.insert_range(txn, 0, [1, 2, 3])
+    _, _, flags = _decode(log, U=4, R=4)
+    assert flags[0] & FLAG_UNSUPPORTED
+
+
+def test_map_parent_sub_flags_for_host_fallback():
+    doc = Doc(client_id=5)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    m = doc.get_map("m")
+    with doc.transact() as txn:
+        m.insert(txn, "key", "value")
+    _, _, flags = _decode(log, U=4, R=4)
+    assert flags[0] & FLAG_UNSUPPORTED
+
+
+def test_big_client_id_flags():
+    doc = Doc(client_id=2**40)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    with doc.transact() as txn:
+        doc.get_text("text").insert(txn, 0, "x")
+    _, _, flags = _decode(log, U=4, R=4)
+    assert flags[0] & FLAG_BIG_CLIENT
+
+
+def test_truncated_update_flags_malformed():
+    log, _ = _edit_log([("i", 0, "hello world")])
+    truncated = log[0][: len(log[0]) - 4]
+    buf, lens = pack_updates([truncated])
+    _, flags = decode_updates_v1(buf, lens, 4, 4)
+    assert np.asarray(flags)[0] & FLAG_MALFORMED
+
+
+def test_row_overflow_flags():
+    from ytpu.core.update import merge_updates_v1
+
+    ops = [("i", 0, "a"), ("i", 0, "b"), ("i", 0, "c"), ("i", 0, "d")]
+    log, _ = _edit_log(ops)
+    merged = merge_updates_v1(log)
+    _, _, flags = _decode([merged], U=2, R=2)
+    assert flags[0] & FLAG_OVERFLOW
+
+
+def test_mixed_batch_bad_lane_emits_nothing():
+    """A flagged lane's partial rows must be masked out of the stream."""
+    good, expect = _edit_log([("i", 0, "ok")])
+    doc = Doc(client_id=7)
+    bad_log = []
+    doc.observe_update_v1(lambda p, o, t: bad_log.append(p))
+    with doc.transact() as txn:
+        doc.get_map("m").insert(txn, "k", 1)
+    log = [good[0], bad_log[0]]
+    buf, stream, flags = _decode(log, U=4, R=4)
+    assert flags[0] & FLAG_ERRORS == 0
+    assert flags[1] & FLAG_ERRORS != 0
+    v = np.asarray(stream.valid)
+    assert v[0].sum() == 1
+    assert v[1].sum() == 0
+    assert np.asarray(stream.del_valid)[1].sum() == 0
+
+
+def test_gc_rows_decode():
+    """GC carriers (info byte 0 + len) decode as BLOCK_GC rows."""
+    from collections import deque
+
+    from ytpu.core.block import ID
+    from ytpu.core.content import ContentString
+
+    gc = GCRange(ID(3, 0), 4)
+    item = Item(ID(3, 4), None, ID(3, 3), None, None, "text", None,
+                ContentString("tail"))
+    u = Update(blocks={3: deque([gc, item])})
+    payload = u.encode_v1()
+    rows, _ = _expected_rows_dels(payload)
+    assert any(r[7] == BLOCK_GC for r in rows)
+    _check_field_parity([payload], U=8, R=8)
+
+
+def test_huge_string_length_varint_flags_malformed():
+    """Regression: a string-length varint near 2^31 wrapped the cursor
+    advance negative and bypassed the bounds check (flags stayed 0)."""
+    from ytpu.encoding.lib0 import Writer
+
+    w = Writer()
+    w.write_var_uint(1)  # n_clients
+    w.write_var_uint(1)  # n_blocks
+    w.write_var_uint(7)  # client
+    w.write_var_uint(0)  # clock
+    w.write_u8(0x04 | 0x80)  # String content, has-origin
+    w.write_var_uint(7)
+    w.write_var_uint(0)  # origin id
+    w.write_var_uint(2**31 - 16)  # absurd string byte length
+    payload = w.to_bytes()
+    buf, lens = pack_updates([payload])
+    _, flags = decode_updates_v1(buf, lens, 4, 4)
+    assert np.asarray(flags)[0] & FLAG_MALFORMED

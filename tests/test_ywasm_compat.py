@@ -187,3 +187,28 @@ def test_sticky_index_helpers():
         t.insert(txn, 0, "++")
     with doc.transact() as txn:
         assert compat.create_offset_from_sticky_index(txn, back) == 5
+
+
+def test_merge_partial_overlap_does_not_mutate_inputs():
+    """Regression: the partial-overlap path split carriers of the *input*
+    updates in place, so re-encoding an input after merge() dropped bytes."""
+    from ytpu.core.update import Update
+
+    doc = Doc(client_id=9)
+    t = doc.get_text("t")
+    with doc.transact() as txn:
+        t.insert(txn, 0, "abcde")
+    full = doc.encode_state_as_update_v1()  # one block [9, 0..5)
+    u_prefix = Update.decode_v1(full)
+    # truncate manually: keep clocks [0..2) by splitting a decoded copy
+    blocks = next(iter(u_prefix.blocks.values()))
+    item = blocks[0]
+    item.split(2)
+    u_a = Update(blocks={9: type(blocks)([item])})
+    u_full = Update.decode_v1(full)
+    before = u_full.encode_v1()
+    merged = Update.merge([u_a, u_full])
+    assert u_full.encode_v1() == before  # inputs untouched
+    replica = Doc(client_id=10)
+    replica.apply_update_v1(merged.encode_v1())
+    assert replica.get_text("t").get_string() == "abcde"

@@ -304,10 +304,18 @@ class Update:
                     elif isinstance(c, GCRange):
                         out.append(GCRange(ID(client, current_end), length - overlap))
                     else:
-                        right = c.split(overlap)
-                        # split() wires left/right refs; carriers must stay detached
+                        # split a detached clone — merge() must never mutate
+                        # its input updates (their carriers stay re-encodable)
+                        clone = Item(
+                            c.id, None, c.origin, None, c.right_origin,
+                            c.parent, c.parent_sub, c.content.copy(),
+                        )
+                        clone.deleted = c.deleted
+                        clone.keep = c.keep
+                        clone.moved = c.moved
+                        clone.redone = c.redone
+                        right = clone.split(overlap)
                         right.left = None
-                        c.right = None
                         out.append(right)
                     current_end = start + length
             # drop trailing skips: they carry no information

@@ -1,0 +1,573 @@
+"""Device-side lib0/V1 update decoding — raw wire bytes in HBM → block rows.
+
+The north-star fusion (SURVEY §2 #1, §7 step 8): hosts ship raw Yjs V1
+update payloads to the device as a padded ``[S, L]`` byte matrix; the
+device turns them into the columnar ``UpdateBatch`` stream the integrate
+kernels consume. No host-side parsing, interning, or payload copying —
+string payloads stay inside the wire buffer and are addressed by linear
+byte offsets (``content_ref = s * L + byte_start``).
+
+Algorithm: a vectorized field-at-a-time state machine. Every iteration
+decodes one lib0 varint (or one info byte / one string skip) *in every
+update lane simultaneously* — the per-lane parse is sequential (the wire
+grammar is), but all S updates advance in lockstep as [S]-wide vector
+ops, and UTF-16 lengths of string payloads come from prefix sums over
+byte-class masks (the Stream-VByte-style trick: continuation-bit masks +
+cumulative sums instead of byte loops).
+
+Grammar decoded here (reference: update.rs:714-749 + :433-488,
+block.rs:1786-1835, id_set.rs decode):
+
+    update   := n_clients:var ( n_blocks:var client:var clock:var block* )*
+                delete_set
+    block    := info:u8
+                [ origin:id ]       if info & 0x80
+                [ r_origin:id ]     if info & 0x40
+                [ parent ]          if info & 0xC0 == 0
+                [ parent_sub:str ]  if info & 0xC0 == 0 and info & 0x20
+                content
+    content  := GC len:var | Skip len:var | Deleted len:var | String str
+                (other kinds → host fallback, flagged)
+    delete_set := n_clients:var ( client:var n_ranges:var (clock:var len:var)* )*
+
+Supported on-device: GC / Skip / Deleted / String blocks with root or
+ID parents — i.e. the entire live text-editing data plane. Anything else
+(map rows with parent_sub, embeds, Any payloads, moves, subdocs) flags
+the update for the host decoder (`ytpu.core.Update.decode_v1`); flagged
+updates lose nothing — they take the exact host path they take today.
+
+Client ids are kept *raw* (no interning): YATA's tie-break is monotone
+in the client id itself, so with raw ids the rank table for the fused
+kernel is the identity (`identity_rank`). Ids ≥ 2^31 flag the update.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ytpu.core.content import (
+    BLOCK_GC,
+    BLOCK_SKIP,
+    CONTENT_DELETED,
+    CONTENT_STRING,
+)
+from ytpu.models.batch_doc import UpdateBatch
+
+__all__ = [
+    "pack_updates",
+    "decode_updates_v1",
+    "identity_rank",
+    "RawPayloadView",
+    "FLAG_UNSUPPORTED",
+    "FLAG_OVERFLOW",
+    "FLAG_MALFORMED",
+    "FLAG_BIG_CLIENT",
+    "FLAG_MULTI_CLIENT",
+]
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+# --- per-update flag bits ----------------------------------------------------
+FLAG_UNSUPPORTED = 1  # content kind / parent_sub the device cannot decode
+FLAG_OVERFLOW = 2  # more blocks / delete ranges than the U/R buckets
+FLAG_MALFORMED = 4  # ran past the buffer or did not reach DONE in T steps
+FLAG_BIG_CLIENT = 8  # a client id >= 2^31 (needs host interning)
+FLAG_MULTI_CLIENT = 16  # informational: >1 client section (wire order may
+#                         not be a valid integration order for cross-client
+#                         origins inside one update; single-client updates —
+#                         the live-editing case — are always ordered)
+
+FLAG_ERRORS = FLAG_UNSUPPORTED | FLAG_OVERFLOW | FLAG_MALFORMED | FLAG_BIG_CLIENT
+
+# --- parser states -----------------------------------------------------------
+(
+    ST_NCLIENTS,
+    ST_NBLOCKS,
+    ST_CLIENT,
+    ST_CLOCK,
+    ST_INFO,
+    ST_ORIGIN_C,
+    ST_ORIGIN_K,
+    ST_ROR_C,
+    ST_ROR_K,
+    ST_PARENT_INFO,
+    ST_PARENT_NAME,
+    ST_PARENT_ID_C,
+    ST_PARENT_ID_K,
+    ST_PARENT_SUB,
+    ST_DEL_LEN,
+    ST_GC_LEN,
+    ST_SKIP_LEN,
+    ST_STR,
+    ST_DS_NCLIENTS,
+    ST_DS_CLIENT,
+    ST_DS_NRANGES,
+    ST_DS_CLOCK,
+    ST_DS_LEN,
+    ST_DONE,
+    ST_ERR,
+) = range(25)
+
+_PAD = 16  # gather guard past the longest update
+
+
+def pack_updates(
+    payloads: List[bytes], pad_to: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad raw V1 update byte strings into an ``[S, L] uint8`` matrix.
+
+    This is the *only* host work on the device-decode path — a memcpy.
+    """
+    lens = np.array([len(p) for p in payloads], dtype=np.int32)
+    L = max(int(lens.max()) + _PAD if len(payloads) else _PAD, pad_to or 0)
+    buf = np.zeros((len(payloads), L), dtype=np.uint8)
+    for i, p in enumerate(payloads):
+        buf[i, : len(p)] = np.frombuffer(p, dtype=np.uint8)
+    return buf, lens
+
+
+def identity_rank(k: int) -> jax.Array:
+    """Rank table for raw-client-id streams: rank(c) = c."""
+    return jnp.arange(k, dtype=I32)
+
+
+def default_steps(max_rows: int, max_dels: int) -> int:
+    """Safe iteration budget: fields per block ≤ 10 (+3/client header),
+    2 per delete range (+2/ds client), +4 frame fields."""
+    return 4 + 13 * max_rows + 4 * max_dels
+
+
+def decode_updates_v1(
+    buf: jax.Array,
+    lens: jax.Array,
+    max_rows: int,
+    max_dels: int,
+    n_steps: Optional[int] = None,
+) -> Tuple[UpdateBatch, jax.Array]:
+    """Decode S updates into an ``[S, U] / [S, R]`` UpdateBatch stream.
+
+    Returns ``(stream, flags)``; lanes with ``flags & FLAG_ERRORS`` decoded
+    incompletely and must be re-decoded on host (their emitted rows are
+    marked invalid so a mixed batch stays safe to apply).
+    """
+    S, L = buf.shape
+    U, R = max_rows, max_dels
+    T = n_steps or default_steps(U, R)
+    b = buf.astype(I32)
+    lens = lens.astype(I32)
+
+    # UTF-16 length prefix sums: a UTF-8 head byte (not 0b10xxxxxx) is one
+    # code point; a 4-byte lead (>= 0xF0) is a surrogate pair, one extra.
+    head = ((b & 0xC0) != 0x80).astype(I32)
+    lead4 = (b >= 0xF0).astype(I32)
+    zero = jnp.zeros((S, 1), I32)
+    u16_psum = jnp.concatenate([zero, jnp.cumsum(head + lead4, axis=1)], axis=1)
+
+    iota_u = jax.lax.broadcasted_iota(I32, (S, U), 1)
+    iota_r = jax.lax.broadcasted_iota(I32, (S, R), 1)
+    row_ids = jnp.arange(S, dtype=I32)
+
+    def u16_span(a, bnd):
+        """UTF-16 code units of bytes [a, b) per lane."""
+        a = jnp.clip(a, 0, L)
+        bnd = jnp.clip(bnd, 0, L)
+        pa = jnp.take_along_axis(u16_psum, a[:, None], axis=1)[:, 0]
+        pb = jnp.take_along_axis(u16_psum, bnd[:, None], axis=1)[:, 0]
+        return pb - pa
+
+    def init_carry():
+        regs = dict(
+            pos=jnp.zeros((S,), I32),
+            st=jnp.full((S,), ST_NCLIENTS, I32),
+            flags=jnp.zeros((S,), I32),
+            clients_left=jnp.zeros((S,), I32),
+            blocks_left=jnp.zeros((S,), I32),
+            client=jnp.zeros((S,), I32),
+            clock=jnp.zeros((S,), I32),
+            info=jnp.zeros((S,), I32),
+            oc=jnp.full((S,), -1, I32),
+            ok=jnp.zeros((S,), I32),
+            rc=jnp.full((S,), -1, I32),
+            rk=jnp.zeros((S,), I32),
+            ptag=jnp.zeros((S,), I32),
+            pc=jnp.full((S,), -1, I32),
+            pk=jnp.zeros((S,), I32),
+            ds_clients_left=jnp.zeros((S,), I32),
+            ds_ranges_left=jnp.zeros((S,), I32),
+            ds_client=jnp.zeros((S,), I32),
+            ds_clock=jnp.zeros((S,), I32),
+            n_rows=jnp.zeros((S,), I32),
+            n_dels=jnp.zeros((S,), I32),
+        )
+        rows = dict(
+            client=jnp.zeros((S, U), I32),
+            clock=jnp.zeros((S, U), I32),
+            length=jnp.zeros((S, U), I32),
+            oc=jnp.full((S, U), -1, I32),
+            ok=jnp.zeros((S, U), I32),
+            rc=jnp.full((S, U), -1, I32),
+            rk=jnp.zeros((S, U), I32),
+            kind=jnp.zeros((S, U), I32),
+            ref=jnp.full((S, U), -1, I32),
+            ptag=jnp.zeros((S, U), I32),
+            pc=jnp.full((S, U), -1, I32),
+            pk=jnp.zeros((S, U), I32),
+            valid=jnp.zeros((S, U), bool),
+        )
+        dels = dict(
+            client=jnp.zeros((S, R), I32),
+            start=jnp.zeros((S, R), I32),
+            end=jnp.zeros((S, R), I32),
+            valid=jnp.zeros((S, R), bool),
+        )
+        return regs, rows, dels
+
+    def step(_, carry):
+        regs, rows, dels = carry
+        pos, st = regs["pos"], regs["st"]
+        active = (st != ST_DONE) & (st != ST_ERR)
+
+        # --- one varint (or u8) at the cursor, all lanes at once ---------
+        idx = jnp.clip(pos[:, None] + jnp.arange(10, dtype=I32)[None, :], 0, L - 1)
+        in_buf = (pos[:, None] + jnp.arange(10, dtype=I32)[None, :]) < lens[:, None]
+        bytes10 = jnp.where(in_buf, jnp.take_along_axis(b, idx, axis=1), 0)
+        cont = bytes10 >= 0x80
+        inb = jnp.concatenate(
+            [jnp.ones((S, 1), I32), jnp.cumprod(cont[:, :9].astype(I32), axis=1)],
+            axis=1,
+        )  # inb[:, i] = byte i belongs to the varint
+        nbytes = jnp.sum(inb, axis=1)
+        shifts = (7 * jnp.arange(5, dtype=I32))[None, :]
+        val = jnp.sum(
+            jnp.where(
+                inb[:, :5] == 1,
+                (bytes10[:, :5].astype(U32) & 0x7F) << shifts.astype(U32),
+                jnp.zeros((S, 5), U32),
+            ),
+            axis=1,
+        ).astype(I32)
+        ovf = (nbytes > 5) | ((nbytes == 5) & ((bytes10[:, 4] & 0x7F) >= 8))
+
+        is_info = st == ST_INFO
+        v = jnp.where(is_info, bytes10[:, 0], val)
+        consumed = jnp.where(is_info, 1, nbytes)
+
+        # string states consume the payload bytes too
+        is_str_skip = (st == ST_PARENT_NAME) | (st == ST_PARENT_SUB)
+        is_str = st == ST_STR
+        str_start = pos + nbytes
+        consumed = consumed + jnp.where(is_str_skip | is_str, v, 0)
+
+        pos_after = pos + consumed
+        is_client_st = (
+            (st == ST_CLIENT) | (st == ST_ORIGIN_C) | (st == ST_ROR_C)
+            | (st == ST_PARENT_ID_C) | (st == ST_DS_CLIENT)
+        )
+        big_client = active & ovf & is_client_st
+        bad = active & (
+            (pos_after > lens)
+            # a string length > L would wrap `pos + v` past int32 and slip
+            # under the pos_after bound; no real payload exceeds its buffer
+            | ((is_str_skip | is_str) & (v > L))
+            | (ovf & ~is_info & ~is_client_st)
+            | ((st == ST_NCLIENTS) & (v > U + 1))  # absurd header: garbage
+        )
+        act = active & ~bad & ~big_client
+
+        def on(s):
+            return act & (st == s)
+
+        def upd(reg, cond, new):
+            return jnp.where(cond, new, reg)
+
+        # --- end-of-block / end-of-ds-range shared bookkeeping -----------
+        emit_row_st = on(ST_DEL_LEN) | on(ST_GC_LEN) | on(ST_SKIP_LEN) | on(ST_STR)
+        str_len16 = u16_span(str_start, str_start + v)
+        blk_len = jnp.where(is_str, str_len16, v)
+        blocks_left2 = upd(regs["blocks_left"], emit_row_st, regs["blocks_left"] - 1)
+        # a client section with zero blocks (never produced by our encoders,
+        # but legal wire) also closes at ST_CLOCK
+        empty_client = on(ST_CLOCK) & (regs["blocks_left"] == 0)
+        client_done = (emit_row_st & (blocks_left2 == 0)) | empty_client
+        clients_left2 = upd(regs["clients_left"], client_done, regs["clients_left"] - 1)
+        after_block = jnp.where(
+            blocks_left2 > 0,
+            ST_INFO,
+            jnp.where(clients_left2 > 0, ST_NBLOCKS, ST_DS_NCLIENTS),
+        )
+
+        ds_done_range = on(ST_DS_LEN)
+        ds_ranges_left2 = upd(
+            regs["ds_ranges_left"], ds_done_range, regs["ds_ranges_left"] - 1
+        )
+        # DS_NRANGES with 0 ranges also closes the ds-client section
+        ds_client_done = (ds_done_range & (ds_ranges_left2 == 0)) | (
+            on(ST_DS_NRANGES) & (v == 0)
+        )
+        ds_clients_left2 = upd(
+            regs["ds_clients_left"], ds_client_done, regs["ds_clients_left"] - 1
+        )
+        after_ds_range = jnp.where(
+            ds_ranges_left2 > 0,
+            ST_DS_CLOCK,
+            jnp.where(ds_clients_left2 > 0, ST_DS_CLIENT, ST_DONE),
+        )
+
+        # --- content dispatch after the last pre-content field -----------
+        kind4 = regs["info"] & 0b1111
+        content_st = jnp.where(
+            kind4 == CONTENT_DELETED,
+            ST_DEL_LEN,
+            jnp.where(kind4 == CONTENT_STRING, ST_STR, ST_ERR),
+        )
+        content_unsupported = content_st == ST_ERR
+        has_psub = ((regs["info"] & 0xC0) == 0) & ((regs["info"] & 0x20) != 0)
+        after_parent = jnp.where(has_psub, ST_PARENT_SUB, content_st)
+
+        # --- next state -----------------------------------------------------
+        nclients_hdr = on(ST_NCLIENTS)
+        info_gc = on(ST_INFO) & (v == BLOCK_GC)
+        info_skip = on(ST_INFO) & (v == BLOCK_SKIP)
+        info_item = on(ST_INFO) & ~info_gc & ~info_skip
+        item_next = jnp.where(
+            (v & 0x80) != 0,
+            ST_ORIGIN_C,
+            jnp.where((v & 0x40) != 0, ST_ROR_C, ST_PARENT_INFO),
+        )
+
+        st2 = st
+        st2 = upd(st2, nclients_hdr, jnp.where(v > 0, ST_NBLOCKS, ST_DS_NCLIENTS))
+        st2 = upd(st2, on(ST_NBLOCKS), ST_CLIENT)
+        st2 = upd(st2, on(ST_CLIENT), ST_CLOCK)
+        st2 = upd(
+            st2,
+            on(ST_CLOCK),
+            jnp.where(
+                regs["blocks_left"] > 0,
+                ST_INFO,
+                jnp.where(clients_left2 > 0, ST_NBLOCKS, ST_DS_NCLIENTS),
+            ),
+        )
+        st2 = upd(st2, info_gc, ST_GC_LEN)
+        st2 = upd(st2, info_skip, ST_SKIP_LEN)
+        st2 = upd(st2, info_item, item_next)
+        st2 = upd(st2, on(ST_ORIGIN_C), ST_ORIGIN_K)
+        st2 = upd(
+            st2,
+            on(ST_ORIGIN_K),
+            jnp.where((regs["info"] & 0x40) != 0, ST_ROR_C, content_st),
+        )
+        st2 = upd(st2, on(ST_ROR_C), ST_ROR_K)
+        st2 = upd(st2, on(ST_ROR_K), content_st)
+        st2 = upd(
+            st2, on(ST_PARENT_INFO), jnp.where(v == 1, ST_PARENT_NAME, ST_PARENT_ID_C)
+        )
+        st2 = upd(st2, on(ST_PARENT_NAME), after_parent)
+        st2 = upd(st2, on(ST_PARENT_ID_C), ST_PARENT_ID_K)
+        st2 = upd(st2, on(ST_PARENT_ID_K), after_parent)
+        st2 = upd(st2, on(ST_PARENT_SUB), content_st)
+        st2 = upd(st2, emit_row_st, after_block)
+        st2 = upd(st2, on(ST_DS_NCLIENTS), jnp.where(v > 0, ST_DS_CLIENT, ST_DONE))
+        st2 = upd(st2, on(ST_DS_CLIENT), ST_DS_NRANGES)
+        st2 = upd(
+            st2,
+            on(ST_DS_NRANGES),
+            jnp.where(
+                v > 0,
+                ST_DS_CLOCK,
+                jnp.where(ds_clients_left2 > 0, ST_DS_CLIENT, ST_DONE),
+            ),
+        )
+        st2 = upd(st2, on(ST_DS_CLOCK), ST_DS_LEN)
+        st2 = upd(st2, ds_done_range, after_ds_range)
+
+        # unsupported content discovered at a dispatch point
+        unsupported = (
+            (on(ST_ORIGIN_K) & ((regs["info"] & 0x40) == 0) & content_unsupported)
+            | (on(ST_ROR_K) & content_unsupported)
+            | ((on(ST_PARENT_NAME) | on(ST_PARENT_ID_K)) & ~has_psub & content_unsupported)
+            | (on(ST_PARENT_SUB))  # map rows need host key interning
+        )
+        # item with neither origin flag whose dispatch happens after parent
+        st2 = upd(st2, unsupported, ST_ERR)
+        st2 = upd(st2, bad, ST_ERR)
+        st2 = upd(st2, big_client, ST_ERR)
+
+        # --- registers ------------------------------------------------------
+        regs2 = dict(regs)
+        regs2["pos"] = jnp.where(act, pos_after, pos)
+        regs2["st"] = st2
+        regs2["clients_left"] = upd(clients_left2, nclients_hdr, v)
+        regs2["blocks_left"] = upd(blocks_left2, on(ST_NBLOCKS), v)
+        regs2["client"] = upd(regs["client"], on(ST_CLIENT), v)
+        clock2 = upd(regs["clock"], on(ST_CLOCK), v)
+        regs2["clock"] = upd(clock2, emit_row_st, clock2 + blk_len)
+        regs2["info"] = upd(regs["info"], on(ST_INFO), v)
+        # reset per-item registers when a new info byte arrives
+        fresh = on(ST_INFO)
+        regs2["oc"] = upd(upd(regs["oc"], fresh, -1), on(ST_ORIGIN_C), v)
+        regs2["ok"] = upd(upd(regs["ok"], fresh, 0), on(ST_ORIGIN_K), v)
+        regs2["rc"] = upd(upd(regs["rc"], fresh, -1), on(ST_ROR_C), v)
+        regs2["rk"] = upd(upd(regs["rk"], fresh, 0), on(ST_ROR_K), v)
+        ptag2 = upd(regs["ptag"], fresh, 0)
+        regs2["ptag"] = upd(ptag2, on(ST_PARENT_INFO), jnp.where(v == 1, 1, 2))
+        regs2["pc"] = upd(upd(regs["pc"], fresh, -1), on(ST_PARENT_ID_C), v)
+        regs2["pk"] = upd(upd(regs["pk"], fresh, 0), on(ST_PARENT_ID_K), v)
+        regs2["ds_clients_left"] = upd(ds_clients_left2, on(ST_DS_NCLIENTS), v)
+        regs2["ds_ranges_left"] = upd(ds_ranges_left2, on(ST_DS_NRANGES), v)
+        regs2["ds_client"] = upd(regs["ds_client"], on(ST_DS_CLIENT), v)
+        regs2["ds_clock"] = upd(regs["ds_clock"], on(ST_DS_CLOCK), v)
+
+        flags2 = (
+            regs["flags"]
+            | jnp.where(bad, FLAG_MALFORMED, 0)
+            | jnp.where(big_client, FLAG_BIG_CLIENT, 0)
+            | jnp.where(unsupported, FLAG_UNSUPPORTED, 0)
+            | jnp.where(nclients_hdr & (v > 1), FLAG_MULTI_CLIENT, 0)
+        )
+
+        # --- row / delete-range emission -----------------------------------
+        emit = emit_row_st & ~on(ST_SKIP_LEN) & (blk_len > 0)
+        row_ovf = emit & (regs["n_rows"] >= U)
+        emit = emit & ~row_ovf
+        oh = (iota_u == regs["n_rows"][:, None]) & emit[:, None]
+
+        def put_row(name, vec):
+            rows[name] = jnp.where(oh, vec[:, None], rows[name])
+
+        is_gc_row = on(ST_GC_LEN)
+        row_kind = jnp.where(
+            is_gc_row,
+            BLOCK_GC,
+            jnp.where(is_str, CONTENT_STRING, CONTENT_DELETED),
+        )
+        put_row("client", regs["client"])
+        put_row("clock", regs["clock"])
+        put_row("length", blk_len)
+        put_row("oc", jnp.where(is_gc_row, -1, regs["oc"]))
+        put_row("ok", jnp.where(is_gc_row, 0, regs["ok"]))
+        put_row("rc", jnp.where(is_gc_row, -1, regs["rc"]))
+        put_row("rk", jnp.where(is_gc_row, 0, regs["rk"]))
+        put_row("kind", row_kind)
+        put_row("ref", jnp.where(is_str, row_ids * L + str_start, -1))
+        put_row("ptag", jnp.where(is_gc_row, 0, regs["ptag"]))
+        put_row("pc", jnp.where(is_gc_row, -1, regs["pc"]))
+        put_row("pk", jnp.where(is_gc_row, 0, regs["pk"]))
+        rows["valid"] = rows["valid"] | oh
+        regs2["n_rows"] = regs["n_rows"] + emit.astype(I32)
+
+        emit_d = ds_done_range & (v > 0)
+        del_ovf = emit_d & (regs["n_dels"] >= R)
+        emit_d = emit_d & ~del_ovf
+        ohd = (iota_r == regs["n_dels"][:, None]) & emit_d[:, None]
+        dels["client"] = jnp.where(ohd, regs["ds_client"][:, None], dels["client"])
+        dels["start"] = jnp.where(ohd, regs["ds_clock"][:, None], dels["start"])
+        dels["end"] = jnp.where(
+            ohd, (regs["ds_clock"] + v)[:, None], dels["end"]
+        )
+        dels["valid"] = dels["valid"] | ohd
+        regs2["n_dels"] = regs["n_dels"] + emit_d.astype(I32)
+
+        regs2["flags"] = flags2 | jnp.where(row_ovf | del_ovf, FLAG_OVERFLOW, 0)
+        return regs2, rows, dels
+
+    regs, rows, dels = jax.lax.fori_loop(0, T, step, init_carry())
+    flags = regs["flags"] | jnp.where(regs["st"] != ST_DONE, FLAG_MALFORMED, 0)
+
+    # lanes that errored out must not contribute partial rows
+    lane_ok = (flags & FLAG_ERRORS) == 0
+    valid = rows["valid"] & lane_ok[:, None]
+    dvalid = dels["valid"] & lane_ok[:, None]
+    z_u = jnp.zeros((S, U), I32)
+    neg_u = jnp.full((S, U), -1, I32)
+    stream = UpdateBatch(
+        client=rows["client"],
+        clock=rows["clock"],
+        length=rows["length"],
+        origin_client=rows["oc"],
+        origin_clock=rows["ok"],
+        ror_client=rows["rc"],
+        ror_clock=rows["rk"],
+        kind=rows["kind"],
+        content_ref=rows["ref"],
+        content_off=z_u,
+        key=neg_u,
+        p_tag=rows["ptag"],
+        p_client=rows["pc"],
+        p_clock=rows["pk"],
+        mv_sc=neg_u,
+        mv_sk=z_u,
+        mv_sa=z_u,
+        mv_ec=neg_u,
+        mv_ek=z_u,
+        mv_ea=z_u,
+        mv_prio=neg_u,
+        valid=valid,
+        del_client=dels["client"],
+        del_start=dels["start"],
+        del_end=dels["end"],
+        del_valid=dvalid,
+    )
+    return stream, flags
+
+
+class RawPayloadView:
+    """PayloadStore-shaped reader over the raw wire-byte matrix.
+
+    Device-decoded rows address string payloads by ``ref = s * L +
+    byte_start`` with ``(off, len)`` in UTF-16 code units; slicing decodes
+    UTF-8 forward from the string start (splits keep offsets in units, so
+    the walk is exact — `SplittableString` parity, block.rs:1386-1502).
+    """
+
+    def __init__(self, buf: np.ndarray):
+        self.buf = np.ascontiguousarray(buf, dtype=np.uint8).reshape(-1)
+
+    def slice_text(self, ref: int, off: int, length: int) -> str:
+        i = int(ref)
+        buf = self.buf
+
+        def unit_at(i):
+            b0 = buf[i]
+            if b0 < 0x80:
+                return 1, 1
+            if b0 < 0xE0:
+                return 2, 1
+            if b0 < 0xF0:
+                return 3, 1
+            return 4, 2
+
+        out = []
+        u = 0
+        while u < off:
+            nb, nu = unit_at(i)
+            i += nb
+            u += nu
+        need = length
+        if u > off:
+            # the slice starts inside a surrogate pair: its severed low
+            # half renders as U+FFFD (split_str_utf16 / block.rs:1852-1860)
+            out.append("�")
+            need -= u - off
+        start = i
+        while need > 0:
+            nb, nu = unit_at(i)
+            if nu > need:
+                # ends inside a pair: severed high half renders as U+FFFD
+                out.append(
+                    bytes(buf[start:i]).decode("utf-8", errors="surrogatepass")
+                )
+                out.append("�")
+                return "".join(out)
+            i += nb
+            need -= nu
+        out.append(bytes(buf[start:i]).decode("utf-8", errors="surrogatepass"))
+        return "".join(out)
+
+    def slice_values(self, ref: int, off: int, length: int) -> list:
+        return list(self.slice_text(ref, off, length))
