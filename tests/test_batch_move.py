@@ -249,3 +249,107 @@ def test_fuzz_random_moves_parity():
         got = get_values(state, 0, enc.payloads)
         expect = host.get_array("a").to_json()
         assert got == expect, f"round {round_}: {got} != {expect}"
+
+
+def test_move_from_index_zero_branch_scoped_start():
+    """A range starting at index 0 has a branch-scoped (no-id) start bound
+    (IndexScope::Relative) — the device claim walk must read it as the
+    sequence head, not as 'claims nothing'."""
+    doc, arr, log = seeded_array(list(range(5)))
+    with doc.transact() as txn:
+        arr.move_range_to(txn, 0, 1, 4)
+    assert_parity(log)
+
+
+def test_move_to_index_zero():
+    doc, arr, log = seeded_array(list(range(5)))
+    with doc.transact() as txn:
+        arr.move_to(txn, 3, 0)
+    assert_parity(log)
+
+
+def test_move_whole_sequence():
+    """Both bounds branch-scoped: range [0, len) moved (degenerate but
+    wire-legal)."""
+    doc, arr, log = seeded_array(list(range(4)))
+    with doc.transact() as txn:
+        arr.move_range_to(txn, 0, 3, 4)
+    assert_parity(log)
+
+
+def test_concurrent_cross_moves_cycle_cleanup():
+    """Two clients move overlapping ranges into each other — the losing
+    move can close an ownership cycle; find_move_loop parity deletes it
+    (moving.rs:113-141). Both arrival orders must converge with the host."""
+    base_doc, _, base_log = seeded_array(list(range(6)), client_id=1)
+    base = base_doc.encode_state_as_update_v1()
+
+    d1 = Doc(client_id=2)
+    d1.apply_update_v1(base)
+    log1 = capture(d1)
+    with d1.transact() as txn:
+        d1.get_array("a").move_range_to(txn, 0, 2, 5)
+
+    d2 = Doc(client_id=3)
+    d2.apply_update_v1(base)
+    log2 = capture(d2)
+    with d2.transact() as txn:
+        d2.get_array("a").move_range_to(txn, 3, 4, 1)
+
+    assert_parity([base] + log1 + log2)
+    assert_parity([base] + log2 + log1)
+
+
+def test_nested_move_cycle_via_collapsed_moves():
+    """Concurrent collapsed moves that shuttle each other's items."""
+    base_doc, _, base_log = seeded_array(list(range(4)), client_id=1)
+    base = base_doc.encode_state_as_update_v1()
+
+    d1 = Doc(client_id=2)
+    d1.apply_update_v1(base)
+    log1 = capture(d1)
+    with d1.transact() as txn:
+        d1.get_array("a").move_to(txn, 0, 3)
+        d1.get_array("a").move_to(txn, 2, 1)
+
+    d2 = Doc(client_id=3)
+    d2.apply_update_v1(base)
+    log2 = capture(d2)
+    with d2.transact() as txn:
+        d2.get_array("a").move_to(txn, 3, 1)
+        d2.get_array("a").move_to(txn, 1, 3)
+
+    assert_parity([base] + log1 + log2)
+    assert_parity([base] + log2 + log1)
+
+
+def test_nested_branch_scoped_move():
+    """A branch-scoped (index-0) move inside a NESTED array must claim from
+    that branch's head, not the root sequence head."""
+    from ytpu.models.batch_doc import get_tree
+    from ytpu.types.shared import ArrayPrelim
+
+    doc = Doc(client_id=1)
+    log = capture(doc)
+    root = doc.get_array("a")
+    with doc.transact() as txn:
+        root.push_back(txn, "keep")
+        root.push_back(txn, ArrayPrelim([10, 11, 12, 13]))
+    with doc.transact() as txn:
+        nested = root.get(1)
+        nested.move_range_to(txn, 0, 1, 4)  # branch-scoped start bound
+    expect = doc.get_array("a").to_json()
+    assert expect[1] == [12, 13, 10, 11]
+
+    host = host_replay(log)
+    assert host.get_array("a").to_json() == expect
+
+    enc = BatchEncoder(root_name="a")
+    state = init_state(1, 128)
+    for payload in log:
+        u = Update.decode_v1(payload)
+        batch = enc.build_batch([u])
+        state = apply_update_batch(state, batch, enc.interner.rank_table())
+    assert int(state.error[0]) == 0
+    tree = get_tree(state, 0, enc.payloads, enc.keys)
+    assert tree["seq"] == expect, f"device {tree['seq']!r} != host {expect!r}"

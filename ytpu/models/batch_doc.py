@@ -646,6 +646,13 @@ def _claim_move(state: DocStateBatch, s, enable, client_rank: jax.Array):
         state, bl.mv_ec[safe_s], bl.mv_ek[safe_s], bl.mv_ea[safe_s], enable
     )
     bl = state.blocks  # re-read: resolution may have split blocks
+    # branch-scoped bounds (id client -1): sequence head / tail of the MOVE
+    # ROW'S OWN branch (moving.rs get_coords' None-bound convention) — the
+    # root start for root rows, the parent's head column for nested ones
+    par = bl.parent[safe_s]
+    seq_head = jnp.where(par < 0, state.start, bl.head[jnp.maximum(par, 0)])
+    start = jnp.where(bl.mv_sc[safe_s] < 0, seq_head, start)
+    endp = jnp.where(bl.mv_ec[safe_s] < 0, -1, endp)
     # a move whose range bounds aren't materialized yet must fail loudly —
     # the host stash (partition_carriers) defers such rows, so reaching
     # here with an unresolved id-scoped bound is a missing dependency
@@ -706,6 +713,37 @@ def _claim_move(state: DocStateBatch, s, enable, client_rank: jax.Array):
     )
 
 
+def _move_cycle(state: DocStateBatch, s) -> jax.Array:
+    """Is move row `s` inside an ownership cycle after its claim pass?
+
+    Device analogue of `find_move_loop` (moving.rs:113-141): ownership is
+    single-parent (each row has one `moved` owner), so a cycle reachable
+    from `s` must contain `s` — i.e. `s` appears among its own
+    move-descendants. Computed as a monotone reachability fixpoint.
+    """
+    bl = state.blocks
+    B = _capacity(bl)
+    slots = jnp.arange(B, dtype=I32)
+    live_move = (
+        (slots < state.n_blocks) & (bl.kind == CONTENT_MOVE) & ~bl.deleted
+    )
+    owner = jnp.maximum(bl.moved, 0)
+    has_owner = bl.moved >= 0
+    d0 = live_move & (bl.moved == s)
+
+    def cond(carry):
+        _, changed = carry
+        return changed
+
+    def body(carry):
+        d, _ = carry
+        d2 = d | (live_move & has_owner & d[owner])
+        return d2, jnp.any(d2 != d)
+
+    d, _ = jax.lax.while_loop(cond, body, (d0, jnp.any(d0)))
+    return d[jnp.maximum(s, 0)] & (s >= 0)
+
+
 def _recompute_moves(
     state: DocStateBatch, dirty, client_rank: jax.Array
 ) -> DocStateBatch:
@@ -718,6 +756,11 @@ def _recompute_moves(
     override reintegration (moving.rs:229-280) both converge to that same
     argmax, because each pairwise 'takes' keeps the maximum. Clean docs
     (`dirty` False) exit the loop without iterating.
+
+    A claim that closes an ownership cycle tombstones its move row and
+    restarts the recompute without it (`_delete_as_cleanup` parity,
+    moving.rs:190-196 via find_move_loop): each restart permanently
+    removes one move, so the loop terminates.
     """
     bl = state.blocks
     B = _capacity(bl)
@@ -744,8 +787,22 @@ def _recompute_moves(
         exists = jnp.any(am)
         s = jnp.where(exists, jnp.argmax(am).astype(I32), -1)
         st = _claim_move(st, s, dirty & exists, client_rank)
-        done = done.at[jnp.maximum(s, 0)].set(
-            exists | done[jnp.maximum(s, 0)]
+        cyc = _move_cycle(st, s) & exists & dirty
+        bl2 = st.blocks
+        safe_s = jnp.maximum(s, 0)
+        st = st._replace(
+            blocks=bl2._replace(
+                deleted=bl2.deleted.at[safe_s].set(
+                    cyc | bl2.deleted[safe_s]
+                ),
+                # cycle: release EVERY claim and replay without s
+                moved=jnp.where(cyc, -1, bl2.moved),
+            )
+        )
+        done = jnp.where(
+            cyc,
+            jnp.zeros((B,), bool),
+            done.at[safe_s].set(exists | done[safe_s]),
         )
         return st, done
 
@@ -895,13 +952,18 @@ def finish_encode_diff(
     offsets: np.ndarray,
     deleted: np.ndarray,
     enc: "BatchEncoder",
+    payloads=None,
 ) -> bytes:
     """Host finisher: selected device rows -> a v1 update payload.
 
     Emits the same wire layout as the host oracle (clients descending,
     clock-contiguous runs, first block offset-trimmed) from the device block
-    columns + payload side-buffers.
+    columns + payload side-buffers. Pass `payloads` (e.g. a BatchIngestor's
+    `ChunkedWirePayloads`) when the state holds device-decoded rows whose
+    refs live in the chunked (<= -2) space; defaults to `enc.payloads`.
     """
+    if payloads is None:
+        payloads = enc.payloads
     from ytpu.encoding.codec import EncoderV1
     from ytpu.core.id_set import DeleteSet
 
@@ -921,7 +983,7 @@ def finish_encode_diff(
         out.write_var(int(bl.clock[slots[0]]) + first_off)
         for pos, r in enumerate(slots):
             off = first_off if pos == 0 else 0
-            _encode_device_row(out, bl, r, off, real_client, enc)
+            _encode_device_row(out, bl, r, off, real_client, enc, payloads)
     ds = DeleteSet()
     for r in np.nonzero(deleted[doc])[0]:
         real_client = enc.interner.from_idx[int(bl.client[r])]
@@ -930,7 +992,11 @@ def finish_encode_diff(
     return out.to_bytes()
 
 
-def _encode_device_row(out, bl, r, off, real_client, enc: "BatchEncoder") -> None:
+def _encode_device_row(
+    out, bl, r, off, real_client, enc: "BatchEncoder", payloads=None
+) -> None:
+    if payloads is None:
+        payloads = enc.payloads
     from ytpu.core.content import (
         BLOCK_SKIP,
         CONTENT_DELETED,
@@ -980,16 +1046,16 @@ def _encode_device_row(out, bl, r, off, real_client, enc: "BatchEncoder") -> Non
     c_off = int(bl.content_off[r]) + off
     length = int(bl.length[r]) - off
     if kind == CONTENT_STRING:
-        out.write_string(enc.payloads.slice_text(ref, c_off, length))
+        out.write_string(payloads.slice_text(ref, c_off, length))
     elif kind == CONTENT_ANY:
         out.write_len(length)
-        for v in enc.payloads.slice_values(ref, c_off, length):
+        for v in payloads.slice_values(ref, c_off, length):
             out.write_any(v)
     elif kind == CONTENT_DELETED:
         out.write_len(length)
     else:
         # other payload kinds stash the host content object directly
-        content = enc.payloads.items[ref][1]
+        content = payloads.items[ref][1]
         content.encode(out)
 
 
@@ -1074,7 +1140,11 @@ class PayloadStore:
 
     def slice_text(self, ref: int, off: int, length: int) -> str:
         kind, payload = self.items[ref]
-        return payload[2 * off : 2 * (off + length)].decode("utf-16-le")
+        # a slice boundary inside a surrogate pair renders the severed half
+        # as U+FFFD — split_str_utf16 parity (block.rs:1852-1860)
+        return payload[2 * off : 2 * (off + length)].decode(
+            "utf-16-le", errors="replace"
+        )
 
     def slice_values(self, ref: int, off: int, length: int) -> list:
         kind, payload = self.items[ref]
@@ -1230,19 +1300,19 @@ class BatchEncoder:
             if kind == CONTENT_MOVE:
                 self.saw_move = True
                 move = item.content.move
-                if move.start.id is not None and move.end.id is not None:
-                    mv = (
-                        self.interner.intern(move.start.id.client),
-                        move.start.id.clock,
-                        move.start.assoc,
-                        self.interner.intern(move.end.id.client),
-                        move.end.id.clock,
-                        move.end.assoc,
-                        max(move.priority, 0),
-                    )
-                # branch-scoped sticky bounds (no item id) have no device
-                # form — the row integrates but claims nothing; such docs
-                # should stay on the host oracle
+                # branch-scoped sticky bounds (no item id — e.g. a range
+                # starting at index 0, IndexScope::Relative) encode as -1:
+                # the claim walk reads -1 as "sequence head" / "sequence
+                # tail" (moving.rs get_coords' None-bound convention)
+                sc, sk, sa = -1, 0, move.start.assoc
+                if move.start.id is not None:
+                    sc = self.interner.intern(move.start.id.client)
+                    sk = move.start.id.clock
+                ec, ek, ea = -1, 0, move.end.assoc
+                if move.end.id is not None:
+                    ec = self.interner.intern(move.end.id.client)
+                    ek = move.end.id.clock
+                mv = (sc, sk, sa, ec, ek, ea, max(move.priority, 0))
             rows.append(
                 (c, item.id.clock, item.len, oc, ok, rc, rk, kind, ref, 0,
                  key, p_tag, pc, pk) + mv
@@ -1392,12 +1462,13 @@ class BatchEncoder:
         """Stack per-step batches into [S, ...] leaves for lax.scan."""
         return jax.tree.map(lambda *xs: jnp.stack(xs), *steps)
 
-def _move_bounds(bl, n: int, s: int):
+def _move_bounds(bl, n: int, s: int, doc_start: int = -1):
     """Host resolution of move row s's (start, end) slots.
 
     Mirrors `_resolve_move_ptr`: assoc After -> the slot starting at the
     sticky id; assoc Before -> the right neighbor of the slot ending at it.
-    Claim passes split at the bounds, so covering slots land exactly."""
+    Claim passes split at the bounds, so covering slots land exactly.
+    Branch-scoped bounds (id client -1) read as sequence head / tail."""
 
     def covering(c: int, k: int) -> int:
         m = np.nonzero(
@@ -1407,14 +1478,20 @@ def _move_bounds(bl, n: int, s: int):
         )[0]
         return int(m[0]) if len(m) else -1
 
-    i = covering(int(bl.mv_sc[s]), int(bl.mv_sk[s]))
-    if int(bl.mv_sa[s]) < 0:  # assoc Before: exclusive left bound
-        i = int(bl.right[i]) if i >= 0 else -1
-    j = covering(int(bl.mv_ec[s]), int(bl.mv_ek[s]))
-    if int(bl.mv_ea[s]) >= 0:
-        pass  # assoc After: the end slot itself is the exclusive bound
+    if int(bl.mv_sc[s]) < 0:
+        i = doc_start
     else:
-        j = int(bl.right[j]) if j >= 0 else -1
+        i = covering(int(bl.mv_sc[s]), int(bl.mv_sk[s]))
+        if int(bl.mv_sa[s]) < 0:  # assoc Before: exclusive left bound
+            i = int(bl.right[i]) if i >= 0 else -1
+    if int(bl.mv_ec[s]) < 0:
+        j = -1  # walk to the sequence tail
+    else:
+        j = covering(int(bl.mv_ec[s]), int(bl.mv_ek[s]))
+        if int(bl.mv_ea[s]) >= 0:
+            pass  # assoc After: the end slot itself is the exclusive bound
+        else:
+            j = int(bl.right[j]) if j >= 0 else -1
     return i, j
 
 
@@ -1449,7 +1526,7 @@ def _visible_walk(bl, n: int, start: int):
             and not bl.deleted[cur]
             and int(bl.moved[cur]) == scope
         ):
-            s_ptr, e_ptr = _move_bounds(bl, n, cur)
+            s_ptr, e_ptr = _move_bounds(bl, n, cur, doc_start=start)
             stack.append((int(bl.right[cur]), scope, scope_end))
             scope, scope_end = cur, e_ptr
             cur = s_ptr

@@ -111,6 +111,12 @@ def save_ingestor(path: str, ing: BatchIngestor) -> None:
             {c: list(rs) for c, rs in ds.clients.items()}
             for ds in ing._pending_ds
         ],
+        # fast-lane sidecar: retained wire chunks resolve device-decoded
+        # string refs (<= -2) after resume
+        "wire_chunks": [
+            (base, flat.tobytes()) for base, flat in ing.payloads._chunks
+        ],
+        "wire_total": ing.payloads.total_bytes,
     }
     _save(path, ing.state, side)
 
@@ -118,6 +124,8 @@ def save_ingestor(path: str, ing: BatchIngestor) -> None:
 def load_ingestor(path: str) -> BatchIngestor:
     from ytpu.core.id_set import DeleteSet
     from ytpu.core.state_vector import StateVector
+
+    from ytpu.ops.decode_kernel import ChunkedWirePayloads
 
     state, side = _load(path)
     ing = BatchIngestor.__new__(BatchIngestor)
@@ -127,6 +135,14 @@ def load_ingestor(path: str) -> BatchIngestor:
     ing.svs = [StateVector(dict(c)) for c in side["svs"]]
     ing._pending = [dict(p) for p in side["pending"]]
     ing._pending_ds = [DeleteSet(dict(d)) for d in side["pending_ds"]]
+    ing.payloads = ChunkedWirePayloads(ing.enc.payloads)
+    ing.payloads._chunks = [
+        (base, np.frombuffer(raw, dtype=np.uint8))
+        for base, raw in side.get("wire_chunks", [])
+    ]
+    ing.payloads.total_bytes = side.get("wire_total", 0)
+    ing.fast_docs = 0
+    ing.slow_docs = 0
     return ing
 
 

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ytpu.core import Update
 from ytpu.core.id_set import DeleteSet
 from ytpu.core.state_vector import StateVector
@@ -31,8 +33,13 @@ from ytpu.models.batch_doc import (
     apply_update_batch,
     init_state,
 )
+from ytpu.ops.decode_kernel import ChunkedWirePayloads
 
 __all__ = ["BatchIngestor"]
+
+# content kinds the device decoder handles (GC, Deleted, String, Skip)
+_FAST_KINDS = frozenset((0, 1, 4, 10))
+_I32_MAX = 2**31 - 1
 
 
 class BatchIngestor:
@@ -49,6 +56,12 @@ class BatchIngestor:
         # per-doc stash: carriers waiting for dependencies + deferred deletes
         self._pending: List[Dict[int, list]] = [{} for _ in range(n_docs)]
         self._pending_ds: List[DeleteSet] = [DeleteSet() for _ in range(n_docs)]
+        # fast-lane payload resolution: PayloadStore refs (>= 0) for host-
+        # planned rows + retained wire chunks (<= -2) for device-decoded rows
+        self.payloads = ChunkedWirePayloads(self.enc.payloads)
+        # fast-lane stats (observability; tests assert the lane actually ran)
+        self.fast_docs = 0
+        self.slow_docs = 0
 
     # --- introspection (parity: ytransaction_pending_update/_ds shape) -------
 
@@ -146,3 +159,196 @@ class BatchIngestor:
             self.state, batch, self.enc.interner.rank_table()
         )
         return self.state
+
+    # --- raw-bytes fast lane ---------------------------------------------------
+
+    def _fast_eligible(self, doc: int, cols) -> bool:
+        """Can this update's wire bytes go straight to the device?
+
+        The native columns (C++ `lib0_codec`) are the control plane: they
+        prove, before anything ships, that integrating the blocks in wire
+        order needs no stash/retry and no host-only feature — so the device
+        decode cannot flag and the device integrate cannot miss a
+        dependency (the exactness the slow lane gets from
+        `partition_carriers`)."""
+        if cols.error or self._pending[doc] or not self._pending_ds[doc].is_empty():
+            return False
+        n = cols.n_blocks
+        sv = self.svs[doc]
+        covered: Dict[int, int] = {}
+
+        def cov(c: int) -> int:
+            return covered.get(c, sv.get(c))
+
+        for i in range(n):
+            kind = int(cols.kind[i])
+            if kind not in _FAST_KINDS:
+                return False
+            if int(cols.parent_kind[i]) == 2 or int(cols.parent_sub_start[i]) >= 0:
+                return False  # branch-id parents / map rows: host lane
+            c = int(cols.client[i])
+            ck = int(cols.clock[i])
+            ln = int(cols.length[i])
+            if c > _I32_MAX or ck + ln > _I32_MAX:
+                return False
+            if ck > cov(c):
+                return False  # clock gap → pending semantics needed
+            if kind != 10:  # Skip advances no state
+                ok = int(cols.origin_clock[i])
+                if ok >= 0:
+                    oc = int(cols.origin_client[i])
+                    if oc > _I32_MAX or ok >= cov(oc):
+                        return False
+                rk = int(cols.ror_clock[i])
+                if rk >= 0:
+                    rc = int(cols.ror_client[i])
+                    if rc > _I32_MAX or rk >= cov(rc):
+                        return False
+                covered[c] = max(cov(c), ck + ln)
+        for i in range(cols.n_dels):
+            c = int(cols.del_client[i])
+            if c > _I32_MAX or int(cols.del_end[i]) > cov(c):
+                return False
+        return True
+
+    def _client_table(self):
+        """Device intern table: (sorted raw ids, perm to interned idx).
+
+        Ids above int32 (random 53-bit Yjs clients) are excluded — the
+        fast lane never references them (`_fast_eligible` routes such
+        updates to the host lane), and including them would overflow the
+        i32 table."""
+        import jax.numpy as jnp
+
+        ids = sorted(
+            c for c in self.enc.interner.to_idx if 0 <= c <= _I32_MAX
+        )
+        sorted_ids = jnp.asarray(np.asarray(ids, dtype=np.int32))
+        perm = jnp.asarray(
+            np.asarray(
+                [self.enc.interner.to_idx[c] for c in ids], dtype=np.int32
+            )
+        )
+        return sorted_ids, perm
+
+    def apply_bytes(self, payloads: List[Optional[bytes]]) -> DocStateBatch:
+        """One batched step straight from V1 wire bytes.
+
+        Eligible docs (no stash, in-order, device-decodable content) ship
+        raw bytes to HBM and decode on device; the rest take the exact
+        host lane (`_plan_doc`). Both lanes merge into one
+        `apply_update_batch` dispatch, so mixed batches cost one step.
+        """
+        if len(payloads) != self.n_docs:
+            raise ValueError(f"expected {self.n_docs} payload slots")
+        from ytpu.native import available, decode_update_columns
+
+        native = available()
+        fast_idx: List[int] = []
+        fast_payloads: List[bytes] = []
+        slow_updates: List[Optional[Update]] = [None] * self.n_docs
+        max_fast_rows, max_fast_dels = 0, 0
+        for d, p in enumerate(payloads):
+            if p is None:
+                continue
+            cols = decode_update_columns(p) if native else None
+            if cols is not None and self._fast_eligible(d, cols):
+                fast_idx.append(d)
+                fast_payloads.append(p)
+                sv = self.svs[d]
+                rows_here = 0
+                for i in range(cols.n_blocks):
+                    kind = int(cols.kind[i])
+                    if kind == 10:
+                        continue
+                    c = int(cols.client[i])
+                    self.enc.interner.intern(c)
+                    for arr, clk in (
+                        (cols.origin_client, cols.origin_clock),
+                        (cols.ror_client, cols.ror_clock),
+                    ):
+                        if int(clk[i]) >= 0:
+                            self.enc.interner.intern(int(arr[i]))
+                    sv.set_max(c, int(cols.clock[i]) + int(cols.length[i]))
+                    if int(cols.length[i]) > 0:
+                        rows_here += 1
+                for i in range(cols.n_dels):
+                    self.enc.interner.intern(int(cols.del_client[i]))
+                max_fast_rows = max(max_fast_rows, rows_here)
+                max_fast_dels = max(max_fast_dels, cols.n_dels)
+            else:
+                slow_updates[d] = Update.decode_v1(p)
+        self.fast_docs += len(fast_idx)
+        self.slow_docs += sum(1 for u in slow_updates if u is not None)
+
+        all_rows, all_dels = [], []
+        for d, u in enumerate(slow_updates):
+            rows, dels = self._plan_doc(d, u)
+            all_rows.append(rows)
+            all_dels.append(dels)
+        n_rows = max(max_fast_rows, 1, max(len(r) for r in all_rows))
+        n_dels = max(max_fast_dels, 1, max(len(d_) for d_ in all_dels))
+        batch = self.enc.batch_from_rows(all_rows, all_dels, n_rows, n_dels)
+
+        flags = None
+        if fast_idx:
+            batch, flags = self._merge_fast_lane(
+                batch, fast_idx, fast_payloads, n_rows, n_dels
+            )
+        self.state = apply_update_batch(
+            self.state, batch, self.enc.interner.rank_table()
+        )
+        if flags is not None:
+            # `_fast_eligible` proved these lanes decode clean; a flag here
+            # is an invariant violation and the mirror SV has already
+            # advanced, so fail loudly rather than diverge silently. (The
+            # readback overlaps the already-dispatched integrate step.)
+            from ytpu.ops.decode_kernel import FLAG_ERRORS
+
+            f = np.asarray(flags)
+            if (f & FLAG_ERRORS).any():
+                bad = [fast_idx[i] for i in np.nonzero(f & FLAG_ERRORS)[0]]
+                raise RuntimeError(
+                    f"fast-lane decode flagged validated docs {bad}: "
+                    f"{f[f != 0][:8]} — device/host decoder disagreement"
+                )
+        return self.state
+
+    def _merge_fast_lane(self, batch, fast_idx, fast_payloads, n_rows, n_dels):
+        import jax
+        import jax.numpy as jnp
+
+        from ytpu.ops.decode_kernel import (
+            decode_updates_v1,
+            pack_updates,
+        )
+
+        buf, lens = pack_updates(fast_payloads)
+        S, L = buf.shape
+        # retain only the real wire bytes (lens-trimmed, concatenated) —
+        # refs are rebased from the padded s*L layout onto the compact one
+        compact = b"".join(fast_payloads)
+        prefix = np.zeros(S, dtype=np.int64)
+        prefix[1:] = np.cumsum(lens[:-1])
+        base = self.payloads.add_chunk(np.frombuffer(compact, dtype=np.uint8))
+        stream, flags = decode_updates_v1(
+            jnp.asarray(buf),
+            jnp.asarray(lens),
+            n_rows,
+            n_dels,
+            client_table=self._client_table(),
+        )
+        is_str_ref = stream.valid & (stream.content_ref >= 0)
+        lane = jnp.arange(S, dtype=jnp.int32)[:, None]
+        local = stream.content_ref - lane * L
+        compact_ref = jnp.asarray(prefix.astype(np.int32))[:, None] + local
+        stream = stream._replace(
+            content_ref=jnp.where(
+                is_str_ref, -2 - base - compact_ref, stream.content_ref
+            )
+        )
+        idx = jnp.asarray(np.asarray(fast_idx, dtype=np.int32))
+        merged = jax.tree.map(
+            lambda full, fast: full.at[idx].set(fast), batch, stream
+        )
+        return merged, flags

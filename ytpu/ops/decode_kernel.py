@@ -61,12 +61,15 @@ __all__ = [
     "pack_updates",
     "decode_updates_v1",
     "identity_rank",
+    "utf8_slice_u16",
     "RawPayloadView",
+    "ChunkedWirePayloads",
     "FLAG_UNSUPPORTED",
     "FLAG_OVERFLOW",
     "FLAG_MALFORMED",
     "FLAG_BIG_CLIENT",
     "FLAG_MULTI_CLIENT",
+    "FLAG_UNKNOWN_CLIENT",
 ]
 
 I32 = jnp.int32
@@ -81,8 +84,15 @@ FLAG_MULTI_CLIENT = 16  # informational: >1 client section (wire order may
 #                         not be a valid integration order for cross-client
 #                         origins inside one update; single-client updates —
 #                         the live-editing case — are always ordered)
+FLAG_UNKNOWN_CLIENT = 32  # a client id absent from the supplied intern table
 
-FLAG_ERRORS = FLAG_UNSUPPORTED | FLAG_OVERFLOW | FLAG_MALFORMED | FLAG_BIG_CLIENT
+FLAG_ERRORS = (
+    FLAG_UNSUPPORTED
+    | FLAG_OVERFLOW
+    | FLAG_MALFORMED
+    | FLAG_BIG_CLIENT
+    | FLAG_UNKNOWN_CLIENT
+)
 
 # --- parser states -----------------------------------------------------------
 (
@@ -148,12 +158,19 @@ def decode_updates_v1(
     max_rows: int,
     max_dels: int,
     n_steps: Optional[int] = None,
+    client_table: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[UpdateBatch, jax.Array]:
     """Decode S updates into an ``[S, U] / [S, R]`` UpdateBatch stream.
 
     Returns ``(stream, flags)``; lanes with ``flags & FLAG_ERRORS`` decoded
     incompletely and must be re-decoded on host (their emitted rows are
     marked invalid so a mixed batch stays safe to apply).
+
+    ``client_table=(sorted_ids, perm)`` maps raw client ids to interned
+    indices on device (``perm[j]`` is the interned index of ``sorted_ids
+    [j]``), so decoded streams can mix with host-encoded batches that use
+    a `ClientInterner`. Lanes mentioning an id outside the table flag
+    ``FLAG_UNKNOWN_CLIENT`` (host fallback interns it for the next step).
     """
     S, L = buf.shape
     U, R = max_rows, max_dels
@@ -479,6 +496,37 @@ def decode_updates_v1(
     regs, rows, dels = jax.lax.fori_loop(0, T, step, init_carry())
     flags = regs["flags"] | jnp.where(regs["st"] != ST_DONE, FLAG_MALFORMED, 0)
 
+    if client_table is not None:
+        sorted_ids, perm = client_table
+        K = sorted_ids.shape[0]
+        if K == 0:
+            any_rows = jnp.any(rows["valid"], axis=1) | jnp.any(
+                dels["valid"], axis=1
+            )
+            flags = flags | jnp.where(any_rows, FLAG_UNKNOWN_CLIENT, 0)
+            client_table = None
+
+    if client_table is not None:
+
+        def map_ids(arr, used):
+            j = jnp.clip(jnp.searchsorted(sorted_ids, arr), 0, max(K - 1, 0))
+            hit = (sorted_ids[j] == arr) & (arr >= 0)
+            unknown = used & (arr >= 0) & ~hit
+            return jnp.where(hit, perm[j], -1), jnp.any(unknown, axis=1)
+
+        unk = jnp.zeros((S,), bool)
+        for name, used in (
+            ("client", rows["valid"]),
+            ("oc", rows["valid"]),
+            ("rc", rows["valid"]),
+            ("pc", rows["valid"]),
+        ):
+            rows[name], u = map_ids(rows[name], used)
+            unk = unk | u
+        dels["client"], u = map_ids(dels["client"], dels["valid"])
+        unk = unk | u
+        flags = flags | jnp.where(unk, FLAG_UNKNOWN_CLIENT, 0)
+
     # lanes that errored out must not contribute partial rows
     lane_ok = (flags & FLAG_ERRORS) == 0
     valid = rows["valid"] & lane_ok[:, None]
@@ -516,58 +564,114 @@ def decode_updates_v1(
     return stream, flags
 
 
+def utf8_slice_u16(buf: np.ndarray, start: int, off: int, length: int) -> str:
+    """Slice ``length`` UTF-16 units at unit-offset ``off`` from the UTF-8
+    string starting at byte ``start`` of ``buf``.
+
+    Offsets landing inside a surrogate pair render the severed half as
+    U+FFFD — exact `split_str_utf16` / SplittableString parity
+    (block.rs:1386-1502, :1852-1860).
+    """
+    i = int(start)
+
+    def unit_at(i):
+        b0 = buf[i]
+        if b0 < 0x80:
+            return 1, 1
+        if b0 < 0xE0:
+            return 2, 1
+        if b0 < 0xF0:
+            return 3, 1
+        return 4, 2
+
+    out = []
+    u = 0
+    while u < off:
+        nb, nu = unit_at(i)
+        i += nb
+        u += nu
+    need = length
+    if u > off:
+        # the slice starts inside a surrogate pair: its severed low
+        # half renders as U+FFFD
+        out.append("�")
+        need -= u - off
+    s = i
+    while need > 0:
+        nb, nu = unit_at(i)
+        if nu > need:
+            # ends inside a pair: severed high half renders as U+FFFD
+            out.append(bytes(buf[s:i]).decode("utf-8", errors="surrogatepass"))
+            out.append("�")
+            return "".join(out)
+        i += nb
+        need -= nu
+    out.append(bytes(buf[s:i]).decode("utf-8", errors="surrogatepass"))
+    return "".join(out)
+
+
 class RawPayloadView:
     """PayloadStore-shaped reader over the raw wire-byte matrix.
 
     Device-decoded rows address string payloads by ``ref = s * L +
     byte_start`` with ``(off, len)`` in UTF-16 code units; slicing decodes
     UTF-8 forward from the string start (splits keep offsets in units, so
-    the walk is exact — `SplittableString` parity, block.rs:1386-1502).
+    the walk is exact).
     """
 
     def __init__(self, buf: np.ndarray):
         self.buf = np.ascontiguousarray(buf, dtype=np.uint8).reshape(-1)
 
     def slice_text(self, ref: int, off: int, length: int) -> str:
-        i = int(ref)
-        buf = self.buf
-
-        def unit_at(i):
-            b0 = buf[i]
-            if b0 < 0x80:
-                return 1, 1
-            if b0 < 0xE0:
-                return 2, 1
-            if b0 < 0xF0:
-                return 3, 1
-            return 4, 2
-
-        out = []
-        u = 0
-        while u < off:
-            nb, nu = unit_at(i)
-            i += nb
-            u += nu
-        need = length
-        if u > off:
-            # the slice starts inside a surrogate pair: its severed low
-            # half renders as U+FFFD (split_str_utf16 / block.rs:1852-1860)
-            out.append("�")
-            need -= u - off
-        start = i
-        while need > 0:
-            nb, nu = unit_at(i)
-            if nu > need:
-                # ends inside a pair: severed high half renders as U+FFFD
-                out.append(
-                    bytes(buf[start:i]).decode("utf-8", errors="surrogatepass")
-                )
-                out.append("�")
-                return "".join(out)
-            i += nb
-            need -= nu
-        out.append(bytes(buf[start:i]).decode("utf-8", errors="surrogatepass"))
-        return "".join(out)
+        return utf8_slice_u16(self.buf, int(ref), off, length)
 
     def slice_values(self, ref: int, off: int, length: int) -> list:
+        return list(self.slice_text(ref, off, length))
+
+
+class ChunkedWirePayloads:
+    """PayloadStore-compatible resolver over a host `PayloadStore` PLUS
+    retained wire-byte chunks from device-decoded steps.
+
+    Ref space: ``ref >= 0`` → the PayloadStore (host-encoded rows);
+    ``ref <= -2`` → wire chunk byte offset ``-(ref + 2)`` (device-decoded
+    rows; the ingestor rebases each step's ``s * L + start`` refs by the
+    running total of retained bytes). ``-1`` stays "no payload".
+    """
+
+    def __init__(self, store):
+        self.store = store
+        self._chunks: List[Tuple[int, np.ndarray]] = []  # (base, flat bytes)
+        self.total_bytes = 0
+
+    @property
+    def items(self):
+        return self.store.items
+
+    def add_chunk(self, buf: np.ndarray) -> int:
+        """Retain a step's byte matrix; returns the base offset its
+        ``s * L + start`` refs must be rebased by."""
+        flat = np.ascontiguousarray(buf, dtype=np.uint8).reshape(-1)
+        base = self.total_bytes
+        self._chunks.append((base, flat))
+        self.total_bytes += flat.size
+        return base
+
+    def _locate(self, ref: int) -> Tuple[np.ndarray, int]:
+        off = -(int(ref) + 2)
+        import bisect
+
+        k = bisect.bisect_right([b for b, _ in self._chunks], off) - 1
+        base, flat = self._chunks[k]
+        return flat, off - base
+
+    def slice_text(self, ref: int, off: int, length: int) -> str:
+        if int(ref) >= 0:
+            return self.store.slice_text(ref, off, length)
+        flat, start = self._locate(ref)
+        return utf8_slice_u16(flat, start, off, length)
+
+    def slice_values(self, ref: int, off: int, length: int) -> list:
+        if int(ref) >= 0:
+            return self.store.slice_values(ref, off, length)
         return list(self.slice_text(ref, off, length))

@@ -101,7 +101,7 @@ class DeviceSyncServer(SyncServer):
             # peek, apply, THEN pop — a failing step must not drop the other
             # slots' already-dequeued updates
             payloads = [q[0] if q else None for q in self._queues]
-            self.ingestor.apply(payloads)
+            self.ingestor.apply_bytes(payloads)
             for q in self._queues:
                 if q:
                     q.pop(0)
@@ -114,7 +114,7 @@ class DeviceSyncServer(SyncServer):
         from ytpu.models.batch_doc import get_string
 
         slot = self.slot_of(tenant_name)
-        return get_string(self.ingestor.state, slot, self.ingestor.enc.payloads)
+        return get_string(self.ingestor.state, slot, self.ingestor.payloads)
 
     def device_tree(self, tenant_name: str) -> dict:
         from ytpu.models.batch_doc import get_tree
@@ -123,6 +123,6 @@ class DeviceSyncServer(SyncServer):
         return get_tree(
             self.ingestor.state,
             slot,
-            self.ingestor.enc.payloads,
+            self.ingestor.payloads,
             self.ingestor.enc.keys,
         )
