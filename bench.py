@@ -33,7 +33,7 @@ import time
 N_DOCS = 4096
 N_UPDATES = 600
 CAPACITY = 2048
-D_BLOCK = 64  # [14, 64, 2048] i32 tile = 28MB VMEM (kernel raises the scoped limit)
+D_BLOCK = 128  # [14, 128, 2048] i32 tile = 14MB + scan temps (~56MB scoped)
 ROWS_PER_STEP = 4
 DELS_PER_STEP = 8
 

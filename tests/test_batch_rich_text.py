@@ -1,0 +1,128 @@
+"""Device-engine parity for formatted text: `get_diff` runs vs Text.diff().
+
+Formatting marks (ContentFormat), attributed inserts, format toggles and
+removals, embeds, and concurrent formatting from two clients must render
+identically from device block columns and from the host oracle
+(reference types/text.rs:534- DiffIterator)."""
+
+import numpy as np
+
+from ytpu.core import Doc, Update
+from ytpu.models.batch_doc import (
+    BatchEncoder,
+    apply_update_batch,
+    get_diff,
+    init_state,
+)
+
+
+def capture(doc):
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    return log
+
+
+def device_state(log, capacity=256):
+    enc = BatchEncoder(root_name="t")
+    state = init_state(1, capacity)
+    for payload in log:
+        u = Update.decode_v1(payload)
+        batch = enc.build_batch([u])
+        state = apply_update_batch(state, batch, enc.interner.rank_table())
+    assert int(state.error[0]) == 0
+    return state, enc
+
+
+def assert_diff_parity(log):
+    host = Doc(client_id=0xBEEF)
+    for p in log:
+        host.apply_update_v1(p)
+    expect = host.get_text("t").diff()
+    state, enc = device_state(log)
+    got = get_diff(state, 0, enc.payloads)
+    assert got == expect, f"device {got!r} != host {expect!r}"
+    return expect
+
+
+def test_attributed_insert_runs():
+    doc = Doc(client_id=1)
+    log = capture(doc)
+    t = doc.get_text("t")
+    with doc.transact() as txn:
+        t.insert(txn, 0, "plain ")
+        t.insert_with_attributes(txn, 6, "bold", {"b": True})
+        t.insert(txn, 10, " tail")
+    runs = assert_diff_parity(log)
+    assert any(r.attributes == {"b": True} for r in runs)
+
+
+def test_format_range_and_unformat():
+    doc = Doc(client_id=2)
+    log = capture(doc)
+    t = doc.get_text("t")
+    with doc.transact() as txn:
+        t.insert(txn, 0, "abcdefgh")
+    with doc.transact() as txn:
+        t.format(txn, 2, 4, {"i": True})
+    assert_diff_parity(log)
+    with doc.transact() as txn:
+        t.format(txn, 2, 4, {"i": None})  # remove the mark
+    assert_diff_parity(log)
+
+
+def test_overlapping_formats():
+    doc = Doc(client_id=3)
+    log = capture(doc)
+    t = doc.get_text("t")
+    with doc.transact() as txn:
+        t.insert(txn, 0, "0123456789")
+    with doc.transact() as txn:
+        t.format(txn, 0, 6, {"b": True})
+    with doc.transact() as txn:
+        t.format(txn, 3, 6, {"i": 1})
+    runs = assert_diff_parity(log)
+    assert any(r.attributes == {"b": True, "i": 1} for r in runs)
+
+
+def test_concurrent_formatting_two_clients():
+    d1 = Doc(client_id=4)
+    log1 = capture(d1)
+    with d1.transact() as txn:
+        d1.get_text("t").insert(txn, 0, "shared text")
+    base = d1.encode_state_as_update_v1()
+
+    d2 = Doc(client_id=5)
+    d2.apply_update_v1(base)
+    log2 = capture(d2)
+    with d2.transact() as txn:
+        d2.get_text("t").format(txn, 0, 6, {"u": True})
+    with d1.transact() as txn:
+        d1.get_text("t").format(txn, 4, 7, {"b": True})
+
+    full = log1 + log2
+    assert_diff_parity(full)
+    assert_diff_parity(log1[:1] + log2 + log1[1:])
+
+
+def test_embed_run():
+    doc = Doc(client_id=6)
+    log = capture(doc)
+    t = doc.get_text("t")
+    with doc.transact() as txn:
+        t.insert(txn, 0, "pre")
+        t.insert_embed(txn, 3, {"img": "x.png"})
+        t.insert(txn, 4, "post")
+    runs = assert_diff_parity(log)
+    assert any(r.insert == {"img": "x.png"} for r in runs)
+
+
+def test_deleted_formatted_text():
+    doc = Doc(client_id=7)
+    log = capture(doc)
+    t = doc.get_text("t")
+    with doc.transact() as txn:
+        t.insert_with_attributes(txn, 0, "deleteme", {"b": True})
+        t.insert(txn, 8, " keep")
+    with doc.transact() as txn:
+        t.remove_range(txn, 0, 8)
+    assert_diff_parity(log)

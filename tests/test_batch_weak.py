@@ -1,0 +1,86 @@
+"""Device-engine rendering of weak links: get_tree resolves WeakRef quotes
+(unquote projection, reference weak.rs:303-372) over device block columns."""
+
+import numpy as np
+
+from ytpu.core import Doc, Update
+from ytpu.types.weak import quote_range
+from ytpu.models.batch_doc import (
+    BatchEncoder,
+    apply_update_batch,
+    get_tree,
+    init_state,
+)
+
+
+def capture(doc):
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    return log
+
+
+def device_tree(log, capacity=256, root="a"):
+    enc = BatchEncoder(root_name=root)
+    state = init_state(1, capacity)
+    for payload in log:
+        u = Update.decode_v1(payload)
+        batch = enc.build_batch([u])
+        state = apply_update_batch(state, batch, enc.interner.rank_table())
+    assert int(state.error[0]) == 0
+    return get_tree(state, 0, enc.payloads, enc.keys, interner=enc.interner)
+
+
+def test_array_quote_renders_from_device():
+    doc = Doc(client_id=1)
+    log = capture(doc)
+    arr = doc.get_array("a")
+    with doc.transact() as txn:
+        for v in [10, 20, 30, 40, 50]:
+            arr.push_back(txn, v)
+    with doc.transact() as txn:
+        link = quote_range(arr, txn, 1, 3)  # quote [20, 30, 40]
+        arr.push_back(txn, link)
+    weak = doc.get_array("a").get(5)
+    expect = weak.unquote()
+    assert expect == [20, 30, 40]
+
+    tree = device_tree(log)
+    assert tree["seq"][:5] == [10, 20, 30, 40, 50]
+    assert tree["seq"][5] == expect
+
+
+def test_quote_tracks_deletions():
+    doc = Doc(client_id=2)
+    log = capture(doc)
+    arr = doc.get_array("a")
+    with doc.transact() as txn:
+        for v in ["a", "b", "c", "d"]:
+            arr.push_back(txn, v)
+    with doc.transact() as txn:
+        link = quote_range(arr, txn, 0, 3)
+        arr.push_back(txn, link)
+    with doc.transact() as txn:
+        arr.remove_range(txn, 1, 1)  # delete "b" from inside the quote
+    weak = doc.get_array("a").get(3)
+    expect = weak.unquote()
+    tree = device_tree(log)
+    assert tree["seq"][-1] == expect
+
+
+def test_quote_end_in_out_of_order_block():
+    """The quote-end match must not fire on a later-clock block that merely
+    precedes the end block in document order (prepend after append)."""
+    doc = Doc(client_id=3)
+    log = capture(doc)
+    arr = doc.get_array("a")
+    with doc.transact() as txn:
+        arr.push_back(txn, "B")  # clock 0
+    with doc.transact() as txn:
+        arr.insert(txn, 0, "A")  # clock 1, document order [A, B]
+    with doc.transact() as txn:
+        link = quote_range(arr, txn, 0, 2)  # quote [A, B]; end id = (3, 0)
+        arr.push_back(txn, link)
+    expect = doc.get_array("a").get(2).unquote()
+    assert expect == ["A", "B"]
+    tree = device_tree(log)
+    assert tree["seq"][2] == expect

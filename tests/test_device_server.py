@@ -114,3 +114,19 @@ def test_ingestor_is_slot_authority():
 
     with pytest.raises(RuntimeError):
         server.connect("d")
+
+
+def test_device_diff_formatted_tenant():
+    from ytpu.sync.device_server import DeviceSyncServer
+
+    srv = DeviceSyncServer(n_docs=2, capacity=256)
+    t = srv.tenant("doc")
+    doc = t.awareness.doc
+    txt = doc.get_text("text")
+    with doc.transact() as txn:
+        txt.insert(txn, 0, "plain ")
+    with doc.transact() as txn:
+        txt.insert_with_attributes(txn, 6, "bold", {"b": True})
+    srv.flush_device()
+    got = srv.device_diff("doc")
+    assert got == txt.diff(), f"{got!r} != {txt.diff()!r}"

@@ -216,3 +216,42 @@ def test_encode_diff_after_fast_lane_roundtrips():
     fresh = Doc(client_id=77)
     fresh.apply_update_v1(payload)
     assert fresh.get_text("text").get_string() == expect
+
+
+@needs_native
+def test_get_diff_over_mixed_lane_state():
+    """Formatted text ingested via both lanes renders correct diff runs:
+    format marks ride the slow lane (store refs), plain inserts ride the
+    fast lane (chunked refs) — get_diff must resolve both."""
+    from ytpu.models.batch_doc import get_diff
+
+    doc = Doc(client_id=3)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    t = doc.get_text("text")
+    with doc.transact() as txn:
+        t.insert(txn, 0, "plain ")           # fast lane
+    with doc.transact() as txn:
+        t.insert_with_attributes(txn, 6, "bold", {"b": True})  # slow lane
+    with doc.transact() as txn:
+        t.insert(txn, 10, " tail")           # fast lane
+
+    ing = BatchIngestor(n_docs=1, capacity=256)
+    for p in log:
+        ing.apply_bytes([p])
+    assert ing.fast_docs >= 2 and ing.slow_docs >= 1
+    expect = doc.get_text("text").diff()
+    got = get_diff(ing.state, 0, ing.payloads)
+    assert got == expect, f"{got!r} != {expect!r}"
+
+
+@needs_native
+def test_delete_only_steps_retain_no_wire_bytes():
+    log, _ = _edit_log([("i", 0, "abcdef"), ("d", 1, 3), ("d", 0, 2)])
+    ing = BatchIngestor(n_docs=1, capacity=128)
+    ing.apply_bytes([log[0]])
+    after_insert = ing.payloads.total_bytes
+    assert after_insert > 0
+    ing.apply_bytes([log[1]])  # delete-only update: no string refs
+    ing.apply_bytes([log[2]])
+    assert ing.payloads.total_bytes == after_insert

@@ -17,6 +17,7 @@ from .batch_doc import (
     UpdateBatch,
     apply_update_batch,
     get_map,
+    get_diff,
     get_string,
     get_tree,
     get_values,
@@ -37,6 +38,7 @@ __all__ = [
     "UpdateBatch",
     "apply_update_batch",
     "get_map",
+    "get_diff",
     "get_string",
     "get_tree",
     "get_values",

@@ -1552,6 +1552,66 @@ def get_string(state: DocStateBatch, doc: int, payloads: PayloadStore) -> str:
     return "".join(out)
 
 
+def get_diff(state: DocStateBatch, doc: int, payloads) -> list:
+    """Host assembly of a doc's visible text as *formatted runs* — the
+    device-state analogue of `Text.diff()` (reference types/text.rs:534-:
+    runs of string content annotated with the formatting attributes in
+    force, ContentFormat toggles flushing runs, embeds/types as their own
+    single-value runs). Returns `ytpu.types.text.Diff` objects so results
+    compare directly against the host oracle's.
+    """
+    from ytpu.core.content import (
+        CONTENT_EMBED,
+        CONTENT_FORMAT,
+        CONTENT_TYPE,
+    )
+    from ytpu.types.text import Diff
+
+    bl = jax.tree.map(lambda a: np.asarray(a[doc]), state.blocks)
+    n = int(state.n_blocks[doc])
+    runs: list = []
+    attrs: dict = {}
+    buf: List[str] = []
+
+    def flush():
+        if buf:
+            runs.append(Diff("".join(buf), dict(attrs) if attrs else None))
+            buf.clear()
+
+    for i in _visible_walk(bl, n, int(state.start[doc])):
+        if bl.deleted[i]:
+            continue
+        kind = int(bl.kind[i])
+        ref = int(bl.content_ref[i])
+        if kind == CONTENT_STRING:
+            buf.append(
+                payloads.slice_text(ref, int(bl.content_off[i]), int(bl.length[i]))
+            )
+        elif kind == CONTENT_FORMAT:
+            fmt = payloads.items[ref][1]
+            if attrs.get(fmt.key) != fmt.value:
+                flush()
+            if fmt.value is None:
+                attrs.pop(fmt.key, None)
+            else:
+                attrs[fmt.key] = fmt.value
+        elif kind in (CONTENT_EMBED, CONTENT_TYPE):
+            flush()
+            payload = payloads.items[ref][1]
+            if kind == CONTENT_EMBED:
+                value = payload.value
+            else:
+                # a user-facing SharedType view, like the host's
+                # out_value -> wrap_branch (the branch is the decoded
+                # wire object: a detached view, not the live host one)
+                from ytpu.types import wrap_branch
+
+                value = wrap_branch(payload.branch)
+            runs.append(Diff(value, dict(attrs) if attrs else None))
+    flush()
+    return runs
+
+
 def get_map(
     state: DocStateBatch, doc: int, payloads: PayloadStore, keys: KeyInterner
 ) -> dict:
@@ -1566,7 +1626,11 @@ def get_map(
 
 
 def get_tree(
-    state: DocStateBatch, doc: int, payloads: PayloadStore, keys: KeyInterner
+    state: DocStateBatch,
+    doc: int,
+    payloads: PayloadStore,
+    keys: KeyInterner,
+    interner=None,
 ) -> dict:
     """Host assembly of a doc's full branch tree: the root's sequence and map
     components, with nested shared types rendered recursively by their
@@ -1575,9 +1639,12 @@ def get_tree(
     Nested branches live in the same block table: a ContentType row owns a
     child sequence via its `head` column, and child map chains reference it
     through the `parent` column (parity: the Branch projections of
-    branch.rs:173-215 over the device columns).
+    branch.rs:173-215 over the device columns). With the `ClientInterner`
+    supplied, WeakRef branches render as their quoted values (the
+    `unquote` projection, weak.rs:303-372) resolved over the device
+    columns; without it they render as empty sequences.
     """
-    from ytpu.core.branch import TYPE_MAP, TYPE_TEXT, TYPE_XML_TEXT
+    from ytpu.core.branch import TYPE_MAP, TYPE_TEXT, TYPE_WEAK, TYPE_XML_TEXT
     from ytpu.core.content import CONTENT_TYPE
 
     bl = jax.tree.map(lambda a: np.asarray(a[doc]), state.blocks)
@@ -1586,12 +1653,71 @@ def get_tree(
     def render_type(i: int):
         content = payloads.items[int(bl.content_ref[i])][1]
         tr = content.branch.type_ref
+        if tr == TYPE_WEAK:
+            return render_weak(content)
         seq, mp = render_branch(int(bl.head[i]), i)
         if tr in (TYPE_TEXT, TYPE_XML_TEXT):
             return "".join(v for v in seq if isinstance(v, str))
         if tr == TYPE_MAP:
             return mp
         return seq
+
+    def render_weak(content):
+        """Quoted-range values from device columns (unquote parity:
+        weak.rs:303-372 — whole covering blocks, stop at the end id)."""
+        src = getattr(content.branch, "link_source", None)
+        if interner is None or src is None or src.quote_start.id is None:
+            return []
+        sc = interner.to_idx.get(src.quote_start.id.client)
+        if sc is None:
+            return []
+        sk = src.quote_start.id.clock
+        m = np.nonzero(
+            (bl.client[:n] == sc)
+            & (bl.clock[:n] <= sk)
+            & (sk < bl.clock[:n] + bl.length[:n])
+        )[0]
+        if not len(m):
+            return []
+        i = int(m[0])
+        eid = src.quote_end.id
+        ec = interner.to_idx.get(eid.client) if eid is not None else None
+        from ytpu.core.moving import ASSOC_BEFORE
+
+        out: list = []
+        steps = 0
+        first = True
+        while i >= 0 and steps <= n:
+            steps += 1
+            ck, ln = int(bl.clock[i]), int(bl.length[i])
+            same_client = (
+                eid is not None and ec is not None and int(bl.client[i]) == ec
+            )
+            # stop only at the block actually containing the end id — a
+            # clock comparison fires early on out-of-order blocks
+            # (weak.rs RangeIter parity)
+            contains_end = same_client and ck <= eid.clock < ck + ln
+            if not bl.deleted[i] and bl.countable[i]:
+                vals = render_row_values(i)
+                # trim to the quoted units only where a bound id falls
+                # INSIDE the block: host blocks are split at the quote
+                # bounds at creation time, device blocks are not
+                a = 0
+                if first and int(bl.client[i]) == sc and ck <= sk < ck + ln:
+                    a = sk - ck
+                    if src.quote_start.assoc == ASSOC_BEFORE:
+                        a += 1
+                b = len(vals)
+                if contains_end:
+                    b = eid.clock - ck
+                    if src.quote_end.assoc != ASSOC_BEFORE:
+                        b += 1
+                out.extend(vals[a:b])
+            first = False
+            if contains_end:
+                break
+            i = int(bl.right[i])
+        return out
 
     def render_row_values(i: int) -> list:
         kind = int(bl.kind[i])

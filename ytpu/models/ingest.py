@@ -248,6 +248,7 @@ class BatchIngestor:
         fast_payloads: List[bytes] = []
         slow_updates: List[Optional[Update]] = [None] * self.n_docs
         max_fast_rows, max_fast_dels = 0, 0
+        n_str_rows = 0  # fast-lane string rows (host count: no device sync)
         for d, p in enumerate(payloads):
             if p is None:
                 continue
@@ -261,6 +262,8 @@ class BatchIngestor:
                     kind = int(cols.kind[i])
                     if kind == 10:
                         continue
+                    if kind == 4 and int(cols.length[i]) > 0:
+                        n_str_rows += 1
                     c = int(cols.client[i])
                     self.enc.interner.intern(c)
                     for arr, clk in (
@@ -291,13 +294,17 @@ class BatchIngestor:
         batch = self.enc.batch_from_rows(all_rows, all_dels, n_rows, n_dels)
 
         flags = None
+        chunk_base = None
         if fast_idx:
-            batch, flags = self._merge_fast_lane(
+            batch, flags, chunk_base = self._merge_fast_lane(
                 batch, fast_idx, fast_payloads, n_rows, n_dels
             )
         self.state = apply_update_batch(
             self.state, batch, self.enc.interner.rank_table()
         )
+        if chunk_base is not None and n_str_rows == 0:
+            # delete/GC-only step: nothing references the retained bytes
+            self.payloads.drop_if_unreferenced(chunk_base)
         if flags is not None:
             # `_fast_eligible` proved these lanes decode clean; a flag here
             # is an invariant violation and the mirror SV has already
@@ -351,4 +358,4 @@ class BatchIngestor:
         merged = jax.tree.map(
             lambda full, fast: full.at[idx].set(fast), batch, stream
         )
-        return merged, flags
+        return merged, flags, base

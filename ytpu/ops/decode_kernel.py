@@ -657,6 +657,13 @@ class ChunkedWirePayloads:
         self.total_bytes += flat.size
         return base
 
+    def drop_if_unreferenced(self, base: int) -> None:
+        """Release the most recent chunk (it turned out to hold no string
+        refs — e.g. a delete-only step); only the latest can be dropped."""
+        if self._chunks and self._chunks[-1][0] == base:
+            _, flat = self._chunks.pop()
+            self.total_bytes = base
+
     def _locate(self, ref: int) -> Tuple[np.ndarray, int]:
         off = -(int(ref) + 2)
         import bisect

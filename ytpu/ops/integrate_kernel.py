@@ -530,9 +530,12 @@ def _run(cols, meta, packed, d_block: int, interpret: bool):
         ],
         input_output_aliases={3: 0, 4: 1},
         interpret=interpret,
-        # the doc tile ([NC, d_block, C] i32) is the dominant VMEM tenant;
-        # the default 16MB scoped limit caps d_block at 32 for C=2048 —
-        # v5e/v6e cores have 128MB VMEM, so let tiles use up to half
+        # the doc tile ([NC, d_block, C] i32) plus the conflict-scan's
+        # [d_block, C] temporaries are the VMEM tenants; the default 16MB
+        # scoped limit caps d_block at 32 for C=2048 — v5e/v6e cores have
+        # 128MB VMEM, so let tiles use up to half (d_block=128, the
+        # measured sweet spot, needs ~56MB; 256 fits only with a ~118MB
+        # limit and compiles pathologically slowly — not worth it)
         compiler_params=None
         if interpret
         else pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024),

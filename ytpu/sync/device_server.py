@@ -116,6 +116,14 @@ class DeviceSyncServer(SyncServer):
         slot = self.slot_of(tenant_name)
         return get_string(self.ingestor.state, slot, self.ingestor.payloads)
 
+    def device_diff(self, tenant_name: str) -> list:
+        """Formatted-run rendering (Text.diff() shape) of a tenant's root
+        text straight from the device block columns."""
+        from ytpu.models.batch_doc import get_diff
+
+        slot = self.slot_of(tenant_name)
+        return get_diff(self.ingestor.state, slot, self.ingestor.payloads)
+
     def device_tree(self, tenant_name: str) -> dict:
         from ytpu.models.batch_doc import get_tree
 
@@ -125,4 +133,5 @@ class DeviceSyncServer(SyncServer):
             slot,
             self.ingestor.payloads,
             self.ingestor.enc.keys,
+            interner=self.ingestor.enc.interner,
         )

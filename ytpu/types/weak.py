@@ -55,9 +55,10 @@ def materialize_link(store, branch: Branch) -> None:
     while item is not None:
         item.linked = True
         store.linked_by.setdefault(item, set()).add(branch)
+        # stop only at the block containing the end id — a clock
+        # comparison fires early on out-of-order blocks (same fix as
+        # unquote; a prepend carries a HIGHER clock than the quote end)
         if end_id is not None and item.contains(end_id):
-            break
-        if end_id is not None and item.id.client == end_id.client and item.id.clock > end_id.clock:
             break
         item = item.right
 
@@ -102,10 +103,10 @@ class WeakRef(SharedType):
             if not item.deleted and item.countable:
                 for i in range(item.len):
                     out.append(out_value(item, i))
-            if end_id is not None and (
-                item.contains(end_id)
-                or (item.id.client == end_id.client and item.id.clock >= end_id.clock)
-            ):
+            # stop only at the block actually containing the end id — a
+            # clock comparison would fire early on out-of-order blocks
+            # (a prepend carries a HIGHER clock than the quote end)
+            if end_id is not None and item.contains(end_id):
                 break
             item = item.right
         return out
