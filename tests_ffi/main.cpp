@@ -144,9 +144,7 @@ static void test_map() {
   str.tag = Y_JSON_STR;
   str.value.str = "value";
   ymap_insert(map, txn, "key", &str);
-  YInput arr{};
-  arr.tag = Y_JSON_ARR;
-  arr.value.str = "[1,2,3]";
+  YInput arr = yinput_json_array_str("[1,2,3]");
   ymap_insert(map, txn, "list", &arr);
   ytransaction_commit(txn);
 
@@ -258,9 +256,7 @@ static void test_nested_types() {
   nested_text.tag = Y_TEXT;
   nested_text.value.str = "inner";
   ymap_insert(map, txn, "text", &nested_text);
-  YInput nested_arr{};
-  nested_arr.tag = Y_ARRAY;
-  nested_arr.value.str = "[1,2]";
+  YInput nested_arr = yinput_yarray_str("[1,2]");
   ymap_insert(map, txn, "arr", &nested_arr);
   ytransaction_commit(txn);
 
@@ -1073,7 +1069,7 @@ static void test_branch_ids() {
 
   // nested branch → (client, clock) id
   YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
-  YInput nested = yinput_yarray(nullptr);
+  YInput nested = yinput_yarray(nullptr, 0);
   ymap_insert(map, txn, "list", &nested);
   ytransaction_commit(txn);
   YOutput *out = ymap_get(map, nullptr, "list");
@@ -1228,9 +1224,11 @@ static void test_json_outputs() {
   YDoc *doc = ydoc_new();
   Branch *map = ymap(doc, "m");
   YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
-  YInput arr = yinput_json_array("[1, \"two\", 3.5]");
+  // recursive yffi form for the array, *_str extension for the map
+  YInput elems[3] = {yinput_long(1), yinput_string("two"), yinput_float(3.5)};
+  YInput arr = yinput_json_array(elems, 3);
   ymap_insert(map, txn, "list", &arr);
-  YInput obj = yinput_json_map("{\"a\": 1, \"b\": \"bee\"}");
+  YInput obj = yinput_json_map_str("{\"a\": 1, \"b\": \"bee\"}");
   ymap_insert(map, txn, "obj", &obj);
   ytransaction_commit(txn);
 
@@ -1277,6 +1275,98 @@ static void test_json_outputs() {
   ydoc_destroy(doc);
 }
 
+// --- recursive YInput (yffi parity) ------------------------------------------
+static void test_recursive_yinput() {
+  YDoc *doc = ydoc_new();
+  Branch *map = ymap(doc, "m");
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+
+  // json array containing a json map containing a json array
+  YInput inner_arr_elems[2] = {yinput_long(7), yinput_long(8)};
+  YInput inner_map_vals[2];
+  inner_map_vals[0] = yinput_json_array(inner_arr_elems, 2);
+  inner_map_vals[1] = yinput_string("deep");
+  const char *inner_keys_storage[2] = {"nums", "tag"};
+  char *inner_keys[2] = {(char *)inner_keys_storage[0],
+                         (char *)inner_keys_storage[1]};
+  YInput outer_elems[2];
+  outer_elems[0] = yinput_json_map(inner_keys, inner_map_vals, 2);
+  outer_elems[1] = yinput_bool(1);
+  YInput outer = yinput_json_array(outer_elems, 2);
+  ymap_insert(map, txn, "deep", &outer);
+
+  // a YArray prelim seeded with recursive elements
+  YInput prelim_elems[3] = {yinput_long(1), yinput_long(2),
+                            yinput_string("three")};
+  YInput prelim = yinput_yarray(prelim_elems, 3);
+  ymap_insert(map, txn, "list", &prelim);
+
+  // a YMap prelim seeded with recursive entries
+  YInput mp_vals[1] = {yinput_float(2.5)};
+  char *mp_keys[1] = {(char *)"pi-ish"};
+  YInput mprelim = yinput_ymap(mp_keys, mp_vals, 1);
+  ymap_insert(map, txn, "dict", &mprelim);
+  ytransaction_commit(txn);
+
+  // verify the deep json value
+  YOutput *out = ymap_get(map, nullptr, "deep");
+  CHECK(out != nullptr);
+  CHECK(youtput_tag(out) == Y_JSON_ARR);
+  uint32_t n = 0;
+  YOutput **items = youtput_read_json_array(out, &n);
+  CHECK(n == 2);
+  if (items && n == 2) {
+    CHECK(youtput_tag(items[0]) == Y_JSON_MAP);
+    uint32_t m = 0;
+    YMapEntry **entries = youtput_read_json_map(items[0], &m);
+    CHECK(m == 2);
+    bool saw_nums = false;
+    if (entries) {
+      for (uint32_t i = 0; i < m; ++i) {
+        if (!entries[i]) continue;
+        if (std::string(entries[i]->key) == "nums") {
+          saw_nums = true;
+          uint32_t k = 0;
+          YOutput **nums = youtput_read_json_array(entries[i]->value, &k);
+          CHECK(k == 2);
+          if (nums && k == 2) {
+            CHECK(youtput_read_long(nums[0]) == 7);
+            CHECK(youtput_read_long(nums[1]) == 8);
+            for (uint32_t j = 0; j < k; ++j) youtput_destroy(nums[j]);
+          }
+          free(nums);
+        }
+        ymap_entry_destroy(entries[i]);
+      }
+    }
+    free(entries);
+    CHECK(saw_nums);
+    CHECK(youtput_read_bool(items[1]) == 1);
+    for (uint32_t i = 0; i < n; ++i) youtput_destroy(items[i]);
+  }
+  free(items);
+  youtput_destroy(out);
+
+  // the YArray prelim became a live shared array
+  out = ymap_get(map, nullptr, "list");
+  Branch *list = out ? youtput_read_yarray(out) : nullptr;
+  CHECK(list != nullptr);
+  CHECK(yarray_len(list) == 3);
+  youtput_destroy(out);
+
+  // the YMap prelim became a live shared map
+  out = ymap_get(map, nullptr, "dict");
+  Branch *dict = out ? youtput_read_ymap(out) : nullptr;
+  CHECK(dict != nullptr);
+  YOutput *pv = ymap_get(dict, nullptr, "pi-ish");
+  CHECK(pv != nullptr && youtput_read_float(pv) == 2.5);
+  youtput_destroy(pv);
+  youtput_destroy(out);
+
+  ybranch_destroy(map);
+  ydoc_destroy(doc);
+}
+
 int main() {
   test_doc_lifecycle();
   test_text_basic();
@@ -1305,6 +1395,7 @@ int main() {
   test_xml_attrs_and_parent();
   test_undo_observers();
   test_json_outputs();
+  test_recursive_yinput();
 
   std::printf("%d checks, %d failures\n", g_checks, g_failures);
   return g_failures == 0 ? 0 : 1;

@@ -294,17 +294,15 @@ class BatchIngestor:
         batch = self.enc.batch_from_rows(all_rows, all_dels, n_rows, n_dels)
 
         flags = None
-        chunk_base = None
         if fast_idx:
-            batch, flags, chunk_base = self._merge_fast_lane(
-                batch, fast_idx, fast_payloads, n_rows, n_dels
+            # delete/GC-only steps (no string rows) retain no wire bytes
+            batch, flags = self._merge_fast_lane(
+                batch, fast_idx, fast_payloads, n_rows, n_dels,
+                retain=n_str_rows > 0,
             )
         self.state = apply_update_batch(
             self.state, batch, self.enc.interner.rank_table()
         )
-        if chunk_base is not None and n_str_rows == 0:
-            # delete/GC-only step: nothing references the retained bytes
-            self.payloads.drop_if_unreferenced(chunk_base)
         if flags is not None:
             # `_fast_eligible` proved these lanes decode clean; a flag here
             # is an invariant violation and the mirror SV has already
@@ -321,7 +319,9 @@ class BatchIngestor:
                 )
         return self.state
 
-    def _merge_fast_lane(self, batch, fast_idx, fast_payloads, n_rows, n_dels):
+    def _merge_fast_lane(
+        self, batch, fast_idx, fast_payloads, n_rows, n_dels, retain=True
+    ):
         import jax
         import jax.numpy as jnp
 
@@ -333,11 +333,16 @@ class BatchIngestor:
         buf, lens = pack_updates(fast_payloads)
         S, L = buf.shape
         # retain only the real wire bytes (lens-trimmed, concatenated) —
-        # refs are rebased from the padded s*L layout onto the compact one
-        compact = b"".join(fast_payloads)
+        # refs are rebased from the padded s*L layout onto the compact one.
+        # `retain=False` (no string rows in the step) skips the copy.
         prefix = np.zeros(S, dtype=np.int64)
         prefix[1:] = np.cumsum(lens[:-1])
-        base = self.payloads.add_chunk(np.frombuffer(compact, dtype=np.uint8))
+        base = 0
+        if retain:
+            compact = b"".join(fast_payloads)
+            base = self.payloads.add_chunk(
+                np.frombuffer(compact, dtype=np.uint8)
+            )
         stream, flags = decode_updates_v1(
             jnp.asarray(buf),
             jnp.asarray(lens),
@@ -358,4 +363,4 @@ class BatchIngestor:
         merged = jax.tree.map(
             lambda full, fast: full.at[idx].set(fast), batch, stream
         )
-        return merged, flags, base
+        return merged, flags

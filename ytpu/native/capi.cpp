@@ -259,6 +259,11 @@ static YBinary py_to_binary(PyObject *obj) { /* consumes obj */
 }
 
 /* (tag, payload) pair for support.input_to_value. Returns new ref payload. */
+/* YInput.len sentinel marking the `*_str` JSON-string constructor forms */
+#define YINPUT_STR_FORM UINT32_MAX
+
+static PyObject *input_to_value(const YInput *input);
+
 static PyObject *input_payload(const YInput *input) {
   if (!input) Py_RETURN_NONE;
   switch (input->tag) {
@@ -268,14 +273,46 @@ static PyObject *input_payload(const YInput *input) {
       return PyFloat_FromDouble(input->value.num);
     case Y_JSON_INT:
       return PyLong_FromLongLong(input->value.integer);
-    case Y_JSON_STR:
     case Y_JSON_ARR:
+    case Y_ARRAY:
+      if (input->len != YINPUT_STR_FORM) {
+        /* yffi recursive form: convert each element (prelims included) */
+        PyObject *list = PyList_New((Py_ssize_t)input->len);
+        if (!list) return nullptr;
+        for (uint32_t k = 0; k < input->len; k++) {
+          PyObject *v = input_to_value(&input->value.values[k]);
+          if (!v) {
+            Py_DECREF(list);
+            return nullptr;
+          }
+          PyList_SET_ITEM(list, (Py_ssize_t)k, v);
+        }
+        return list;
+      }
+      if (input->value.str) return PyUnicode_FromString(input->value.str);
+      Py_RETURN_NONE;
     case Y_JSON_MAP:
+    case Y_MAP:
+      if (input->len != YINPUT_STR_FORM) {
+        PyObject *dict = PyDict_New();
+        if (!dict) return nullptr;
+        for (uint32_t k = 0; k < input->len; k++) {
+          PyObject *v = input_to_value(&input->value.map.values[k]);
+          if (!v || PyDict_SetItemString(dict, input->value.map.keys[k], v) < 0) {
+            Py_XDECREF(v);
+            Py_DECREF(dict);
+            return nullptr;
+          }
+          Py_DECREF(v);
+        }
+        return dict;
+      }
+      if (input->value.str) return PyUnicode_FromString(input->value.str);
+      Py_RETURN_NONE;
+    case Y_JSON_STR:
     case Y_TEXT:
     case Y_XML_TEXT:
     case Y_XML_ELEM:
-    case Y_ARRAY:
-    case Y_MAP:
       if (input->value.str) return PyUnicode_FromString(input->value.str);
       Py_RETURN_NONE;
     case Y_JSON_BUF:
@@ -1872,72 +1909,114 @@ extern "C" YOptions yoptions(void) {
 /* ---- YInput constructors (yffi: yinput_*) -------------------------------- */
 extern "C" YInput yinput_null(void) {
   YInput i{};
+  i.len = 1;
   i.tag = Y_JSON_NULL;
   return i;
 }
 extern "C" YInput yinput_undefined(void) {
   YInput i{};
+  i.len = 1;
   i.tag = Y_JSON_UNDEF;
   return i;
 }
 extern "C" YInput yinput_bool(uint8_t flag) {
   YInput i{};
+  i.len = 1;
   i.tag = Y_JSON_BOOL;
   i.value.flag = flag;
   return i;
 }
 extern "C" YInput yinput_float(double num) {
   YInput i{};
+  i.len = 1;
   i.tag = Y_JSON_NUM;
   i.value.num = num;
   return i;
 }
 extern "C" YInput yinput_long(int64_t integer) {
   YInput i{};
+  i.len = 1;
   i.tag = Y_JSON_INT;
   i.value.integer = integer;
   return i;
 }
 extern "C" YInput yinput_string(const char *str) {
   YInput i{};
+  i.len = 1;
   i.tag = Y_JSON_STR;
   i.value.str = str;
   return i;
 }
 extern "C" YInput yinput_binary(const uint8_t *buf, uint32_t len) {
   YInput i{};
+  i.len = 1;
   i.tag = Y_JSON_BUF;
   i.value.buf.data = buf;
   i.value.buf.len = len;
   return i;
 }
-extern "C" YInput yinput_json_array(const char *json) {
+extern "C" YInput yinput_json_array(YInput *values, uint32_t len) {
   YInput i{};
   i.tag = Y_JSON_ARR;
+  i.len = len;
+  i.value.values = values;
+  return i;
+}
+extern "C" YInput yinput_json_map(char **keys, YInput *values, uint32_t len) {
+  YInput i{};
+  i.tag = Y_JSON_MAP;
+  i.len = len;
+  i.value.map.keys = keys;
+  i.value.map.values = values;
+  return i;
+}
+extern "C" YInput yinput_json_array_str(const char *json) {
+  YInput i{};
+  i.tag = Y_JSON_ARR;
+  i.len = YINPUT_STR_FORM;
   i.value.str = json;
   return i;
 }
-extern "C" YInput yinput_json_map(const char *json) {
+extern "C" YInput yinput_json_map_str(const char *json) {
   YInput i{};
   i.tag = Y_JSON_MAP;
+  i.len = YINPUT_STR_FORM;
   i.value.str = json;
   return i;
 }
 extern "C" YInput yinput_ytext(const char *init) {
   YInput i{};
   i.tag = Y_TEXT;
+  i.len = YINPUT_STR_FORM;
   i.value.str = init;
   return i;
 }
-extern "C" YInput yinput_yarray(const char *init_json) {
+extern "C" YInput yinput_yarray(YInput *values, uint32_t len) {
   YInput i{};
   i.tag = Y_ARRAY;
+  i.len = len;
+  i.value.values = values;
+  return i;
+}
+extern "C" YInput yinput_ymap(char **keys, YInput *values, uint32_t len) {
+  YInput i{};
+  i.tag = Y_MAP;
+  i.len = len;
+  i.value.map.keys = keys;
+  i.value.map.values = values;
+  return i;
+}
+extern "C" YInput yinput_yarray_str(const char *init_json) {
+  YInput i{};
+  i.tag = Y_ARRAY;
+  i.len = YINPUT_STR_FORM;
   i.value.str = init_json;
   return i;
 }
-extern "C" YInput yinput_ymap(const char *init_json) {
+extern "C" YInput yinput_ymap_str(const char *init_json) {
   YInput i{};
   i.tag = Y_MAP;
+  i.len = YINPUT_STR_FORM;
   i.value.str = init_json;
   return i;
 }
@@ -1955,12 +2034,14 @@ extern "C" YInput yinput_yxmltext(const char *init) {
 }
 extern "C" YInput yinput_ydoc(YDoc *doc) {
   YInput i{};
+  i.len = 1;
   i.tag = Y_DOC;
   i.value.doc = doc;
   return i;
 }
 extern "C" YInput yinput_weak(const YWeak *weak) {
   YInput i{};
+  i.len = 1;
   i.tag = Y_WEAK_LINK;
   i.value.weak = weak;
   return i;

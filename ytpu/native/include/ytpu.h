@@ -7,9 +7,10 @@
  * ports mechanically. Tag constants match yffi/src/lib.rs:32-100.
  *
  * Differences from libyrs.h (documented, deliberate):
- *  - YInput is a flat tagged scalar; JSON arrays/maps and nested-type
- *    initializers are passed as JSON strings instead of recursive YInput
- *    arrays (value.str).
+ *  - YInput supports yffi's recursive form (value.values / value.map with
+ *    a top-level len, built by yinput_json_array/yinput_json_map/
+ *    yinput_yarray/yinput_ymap) plus `*_str` extension constructors that
+ *    take JSON strings for convenience.
  *  - YOutput is an opaque handle with youtput_* accessors instead of a
  *    by-value tagged union.
  *  - Binary results come back as YBinary {data,len} released with
@@ -96,17 +97,26 @@ typedef struct YBinary {
 
 typedef struct YInput {
   int8_t tag; /* Y_JSON_* scalar, or Y_TEXT/Y_ARRAY/Y_MAP/Y_XML_* prelim */
+  /* element count for recursive ARR/MAP forms; 1 for scalars;
+   * UINT32_MAX marks the `*_str` JSON-string forms */
+  uint32_t len;
   union {
     uint8_t flag;    /* Y_JSON_BOOL */
     double num;      /* Y_JSON_NUM */
     int64_t integer; /* Y_JSON_INT */
-    const char *str; /* Y_JSON_STR; JSON for ARR/MAP; init for prelims */
+    const char *str; /* Y_JSON_STR; JSON/init payload for `*_str` forms */
     struct {
       const uint8_t *data;
       uint64_t len;
-    } buf;                  /* Y_JSON_BUF */
-    struct YDoc *doc;       /* Y_DOC (nested subdocument) */
-    const struct YWeak *weak; /* Y_WEAK_LINK (from ytext_quote/ymap_link) */
+    } buf;                       /* Y_JSON_BUF */
+    struct YInput *values;       /* Y_JSON_ARR / Y_ARRAY (recursive, `len`
+                                    elements; yffi contract: borrowed) */
+    struct {
+      char **keys;               /* `len` keys... */
+      struct YInput *values;     /* ...paired with `len` nested inputs */
+    } map;                       /* Y_JSON_MAP / Y_MAP (recursive) */
+    struct YDoc *doc;            /* Y_DOC (nested subdocument) */
+    const struct YWeak *weak;    /* Y_WEAK_LINK (ytext_quote/ymap_link) */
   } value;
 } YInput;
 
@@ -432,8 +442,9 @@ YOptions yoptions(void);
 
 /* ---- YInput constructors (yffi: yinput_*) --------------------------------
  * Pure struct builders; no allocation, no ownership taken (yffi contract).
- * JSON arrays/maps and prelim initializers take JSON strings — the header's
- * documented flat-YInput simplification. */
+ * The array/map constructors take recursive YInput element arrays (borrowed
+ * for the duration of the call that consumes them), exactly like yffi; the
+ * `*_str` extensions accept JSON strings instead. */
 YInput yinput_null(void);
 YInput yinput_undefined(void);
 YInput yinput_bool(uint8_t flag);
@@ -441,15 +452,20 @@ YInput yinput_float(double num);
 YInput yinput_long(int64_t integer);
 YInput yinput_string(const char *str);
 YInput yinput_binary(const uint8_t *buf, uint32_t len);
-YInput yinput_json_array(const char *json);
-YInput yinput_json_map(const char *json);
+YInput yinput_json_array(YInput *values, uint32_t len);
+YInput yinput_json_map(char **keys, YInput *values, uint32_t len);
 YInput yinput_ytext(const char *init);
-YInput yinput_yarray(const char *init_json);
-YInput yinput_ymap(const char *init_json);
+YInput yinput_yarray(YInput *values, uint32_t len);
+YInput yinput_ymap(char **keys, YInput *values, uint32_t len);
 YInput yinput_yxmlelem(const char *name);
 YInput yinput_yxmltext(const char *init);
 YInput yinput_ydoc(YDoc *doc);
 YInput yinput_weak(const YWeak *weak);
+/* extensions: JSON-string forms of the four constructors above */
+YInput yinput_json_array_str(const char *json);
+YInput yinput_json_map_str(const char *json);
+YInput yinput_yarray_str(const char *init_json);
+YInput yinput_ymap_str(const char *init_json);
 
 /* ---- YOutput collection readers ------------------------------------------
  * For a Y_JSON_ARR output: array of new YOutput handles (each released with

@@ -177,11 +177,12 @@ def update_debug(update: bytes, v2: bool) -> str:
 # --- values (YInput / YOutput) ---------------------------------------------
 
 def input_to_value(tag: int, payload: Any) -> Any:
-    """Convert a (tag, scalar-payload) pair from the C layer to an engine value.
+    """Convert a (tag, payload) pair from the C layer to an engine value.
 
-    For Y_JSON_ARR/Y_JSON_MAP the payload is a JSON string (the C API's
-    simplification of yffi's recursive YInput arrays); for nested shared
-    types it is a JSON string used as the prelim's initial content.
+    Payloads arrive either already structured (list/dict built by the C
+    layer from recursive YInput arrays — the yffi-parity path; elements
+    are themselves converted values, so nested prelims pass through) or
+    as JSON strings (the `yinput_*_str` extension constructors).
     """
     if tag == Y_JSON_NULL:
         return None
@@ -190,16 +191,20 @@ def input_to_value(tag: int, payload: Any) -> Any:
     if tag in (Y_JSON_BOOL, Y_JSON_NUM, Y_JSON_INT, Y_JSON_STR, Y_JSON_BUF):
         return payload
     if tag == Y_JSON_ARR:
-        return json.loads(payload)
+        return payload if isinstance(payload, list) else json.loads(payload)
     if tag == Y_JSON_MAP:
-        return json.loads(payload)
+        return payload if isinstance(payload, dict) else json.loads(payload)
     if tag == Y_TEXT:
         return TextPrelim(payload or "")
     if tag == Y_XML_TEXT:
         return XmlTextPrelim(payload or "")
     if tag == Y_ARRAY:
+        if isinstance(payload, list):
+            return ArrayPrelim(payload)
         return ArrayPrelim(json.loads(payload) if payload else [])
     if tag == Y_MAP:
+        if isinstance(payload, dict):
+            return MapPrelim(payload)
         return MapPrelim(json.loads(payload) if payload else {})
     if tag == Y_XML_ELEM:
         return XmlElementPrelim(payload or "UNDEFINED")

@@ -661,7 +661,7 @@ class ChunkedWirePayloads:
         """Release the most recent chunk (it turned out to hold no string
         refs — e.g. a delete-only step); only the latest can be dropped."""
         if self._chunks and self._chunks[-1][0] == base:
-            _, flat = self._chunks.pop()
+            self._chunks.pop()
             self.total_bytes = base
 
     def _locate(self, ref: int) -> Tuple[np.ndarray, int]:
