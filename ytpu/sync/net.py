@@ -1,0 +1,234 @@
+"""TCP transport for the y-sync protocol (SURVEY §5.8).
+
+The reference keeps sockets out of the core crate (ecosystem providers —
+yrs-warp etc. — supply transports over the transport-agnostic `Protocol`,
+sync/protocol.rs:8-31). ytpu ships one batteries-included transport so the
+multi-tenant server is usable end to end without extra dependencies:
+asyncio TCP with lib0-style framing.
+
+Wire format per connection:
+- client → server, first frame: the tenant/room name (UTF-8);
+- every frame after that, both directions: one y-sync / Awareness message
+  exactly as `Protocol` encodes it;
+- a frame is a lib0 var-uint length followed by that many bytes (the same
+  `write_buf` layout the protocol messages use internally).
+
+One `SyncServer` (or `DeviceSyncServer`) instance serves all connections;
+each connection becomes a `Session`. Replies go straight back; broadcasts
+land in the other sessions' outboxes and are flushed to their sockets
+after every processed frame. With a `DeviceSyncServer`, `flush_every`
+controls how often queued updates ship to the device batch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional, Tuple
+
+from ytpu.encoding.lib0 import EncodingError, Writer
+from ytpu.sync.protocol import (
+    Message,
+    PermissionDenied,
+    SyncMessage,
+    UnsupportedMessage,
+    message_reader,
+)
+from ytpu.sync.server import SyncServer
+
+# protocol-level garbage from a peer tears the connection down quietly
+_PEER_ERRORS = (
+    asyncio.IncompleteReadError,
+    ConnectionError,
+    EncodingError,
+    UnsupportedMessage,
+    PermissionDenied,
+    UnicodeDecodeError,
+    ValueError,
+)
+
+__all__ = ["serve", "SyncClient", "read_frame", "write_frame"]
+
+_MAX_FRAME = 64 * 1024 * 1024
+
+
+async def read_frame(
+    reader: asyncio.StreamReader, first_byte_timeout: Optional[float] = None
+) -> Optional[bytes]:
+    """One varint-length-prefixed frame; None on clean EOF or first-byte
+    timeout.
+
+    The timeout applies ONLY to the first byte: once a frame has started,
+    the read runs to completion — cancelling mid-frame would leave
+    consumed bytes behind and desync the stream."""
+    first = reader.read(1)
+    if first_byte_timeout is not None:
+        try:
+            b = await asyncio.wait_for(first, first_byte_timeout)
+        except asyncio.TimeoutError:
+            return None
+    else:
+        b = await first
+    shift = 0
+    size = 0
+    while True:
+        if not b:
+            return None
+        size |= (b[0] & 0x7F) << shift
+        shift += 7
+        if b[0] < 0x80:
+            break
+        if shift > 63:
+            raise ConnectionError("oversized frame varint")
+        b = await reader.read(1)
+    if size > _MAX_FRAME:
+        raise ConnectionError(f"frame of {size} bytes exceeds limit")
+    return await reader.readexactly(size)
+
+
+def write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
+    w = Writer()
+    w.write_buf(payload)
+    writer.write(w.to_bytes())
+
+
+def _frames(data: bytes) -> list:
+    """Concatenated protocol bytes → one re-encoded frame per message."""
+    if not data:
+        return []
+    return [m.encode_v1() for m in message_reader(data)]
+
+
+async def serve(
+    server: SyncServer,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    flush_every: int = 1,
+) -> Tuple[asyncio.AbstractServer, int]:
+    """Start serving; returns (asyncio server, bound port)."""
+    writers: Dict[int, asyncio.StreamWriter] = {}
+
+    async def handle(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        session = None
+        frames_seen = 0
+        try:
+            hello = await read_frame(reader)
+            if hello is None:
+                return
+            tenant = hello.decode("utf-8")
+            session, greeting = server.connect(tenant)
+            writers[session.id] = writer
+            for frame in _frames(greeting):
+                write_frame(writer, frame)
+            await writer.drain()
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    break
+                for f in server.receive_frames(session, frame):
+                    write_frame(writer, f)
+                frames_seen += 1
+                if flush_every and frames_seen % flush_every == 0:
+                    flush = getattr(server, "flush_device", None)
+                    if flush is not None:
+                        flush()
+                # fan broadcasts out to every session of this tenant
+                # (snapshot the list: a concurrent disconnect mutates it)
+                stale = []
+                for other in list(server.tenant(tenant).sessions):
+                    w = writer if other is session else writers.get(other.id)
+                    if w is None:
+                        continue  # in-process session: keep its outbox
+                    try:
+                        for payload in server.drain(other):
+                            write_frame(w, payload)
+                        if w is not writer:
+                            await w.drain()
+                    except (ConnectionError, RuntimeError):
+                        stale.append(other)
+                for other in stale:
+                    writers.pop(other.id, None)
+                    server.disconnect(other)
+                await writer.drain()
+        except _PEER_ERRORS:
+            pass
+        finally:
+            if session is not None:
+                writers.pop(session.id, None)
+                server.disconnect(session)
+            writer.close()
+
+    srv = await asyncio.start_server(handle, host, port)
+    bound = srv.sockets[0].getsockname()[1]
+    return srv, bound
+
+
+class SyncClient:
+    """Minimal asyncio client: sync a local `Doc` with a served tenant.
+
+    The client half of the handshake (sync/protocol.rs default handlers):
+    send SyncStep1, answer the server's SyncStep1 with SyncStep2, apply
+    its SyncStep2/Update messages, and push local edits as Updates.
+    """
+
+    def __init__(self, doc):
+        self.doc = doc
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self._unsub = None
+
+    async def connect(self, host: str, port: int, tenant: str) -> None:
+        self.reader, self.writer = await asyncio.open_connection(host, port)
+        write_frame(self.writer, tenant.encode("utf-8"))
+        write_frame(
+            self.writer,
+            Message.sync(SyncMessage.step1(self.doc.state_vector())).encode_v1(),
+        )
+        await self.writer.drain()
+
+        def on_update(payload: bytes, origin, txn) -> None:
+            if origin == "net":
+                return  # do not echo remote updates back
+            write_frame(
+                self.writer,
+                Message.sync(SyncMessage.update(payload)).encode_v1(),
+            )
+
+        self._unsub = self.doc.observe_update_v1(on_update)
+
+    async def pump(self, max_frames: int = 1, timeout: float = 2.0) -> int:
+        """Process up to `max_frames` inbound frames; returns the count."""
+        n = 0
+        while n < max_frames:
+            frame = await read_frame(self.reader, first_byte_timeout=timeout)
+            if frame is None:
+                break
+            for msg in message_reader(frame):
+                if msg.kind != 0:
+                    continue  # presence et al. — not this client's concern
+                body = msg.body
+                if body.tag == 0:  # server's SyncStep1 → reply SyncStep2
+                    diff = self.doc.encode_state_as_update_v1(body.payload)
+                    write_frame(
+                        self.writer,
+                        Message.sync(SyncMessage.step2(diff)).encode_v1(),
+                    )
+                    await self.writer.drain()
+                else:  # SyncStep2 / Update → apply
+                    self.doc.apply_update_v1(body.payload, origin="net")
+            n += 1
+        return n
+
+    async def flush(self) -> None:
+        if self.writer is not None:
+            await self.writer.drain()
+
+    async def close(self) -> None:
+        if self._unsub is not None:
+            self._unsub()
+            self._unsub = None
+        if self.writer is not None:
+            self.writer.close()
+            try:
+                await self.writer.wait_closed()
+            except Exception:
+                pass

@@ -95,8 +95,14 @@ class SyncServer:
     # --- message pumping --------------------------------------------------------
 
     def receive(self, session: Session, data: bytes) -> bytes:
-        """Process incoming frames; returns direct reply bytes. Broadcasts to
-        other sessions land in their `outbox`.
+        """Process incoming frames; returns direct reply bytes (concatenated).
+
+        Broadcasts to other sessions land in their `outbox`."""
+        return b"".join(self.receive_frames(session, data))
+
+    def receive_frames(self, session: Session, data: bytes) -> List[bytes]:
+        """Like `receive`, but one bytes object per reply message — framed
+        transports (sync/net.py) forward these without re-parsing.
 
         Observability (SURVEY §5.5): every applied update is counted and its
         apply latency lands in the `sync.apply_update` histogram — the p99 of
@@ -126,7 +132,7 @@ class SyncServer:
             reply = self.protocol.handle_message(t.awareness, msg)
             if reply is not None:
                 replies.append(reply.encode_v1())
-        return b"".join(replies)
+        return replies
 
     def drain(self, session: Session) -> List[bytes]:
         out = session.outbox
