@@ -84,3 +84,88 @@ def test_trace_friendsforever_prefix():
 def test_trace_sveltecomponent_full():
     doc, txt, data = _replay_trace("sveltecomponent")
     assert txt.get_string() == data["endContent"]
+
+
+@requires_assets
+def test_concurrent_trace_friendsforever_prefix():
+    """Replay the CONCURRENT friendsforever trace (2 agents, parents DAG):
+    each transaction forks from the merge of its parents' states, edits,
+    and re-encodes; all heads must merge to one convergent document
+    (format: assets/editing-traces/concurrent_traces/README.md)."""
+    path = f"{ASSETS}/editing-traces/concurrent_traces/friendsforever.json.gz"
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    txns = data["txns"][:400]
+
+    # last index that needs each state, so memory stays bounded
+    last_use = {}
+    for i, t in enumerate(txns):
+        for p in t["parents"]:
+            if p < len(txns):
+                last_use[p] = i
+
+    states = {}
+    for i, t in enumerate(txns):
+        doc = Doc(client_id=int(t["agent"]) + 1)
+        for p in t["parents"]:
+            doc.apply_update_v1(states[p])
+        txt = doc.get_text("text")
+        with doc.transact() as txn:
+            for pos, del_len, ins in t["patches"]:
+                if del_len:
+                    txt.remove_range(txn, pos, del_len)
+                if ins:
+                    txt.insert(txn, pos, ins)
+        states[i] = doc.encode_state_as_update_v1()
+        for p in t["parents"]:
+            if last_use.get(p) == i:
+                states.pop(p, None)
+
+    heads = [i for i in range(len(txns)) if i in states]
+    final = Doc(client_id=0xF00D)
+    for h in heads:
+        final.apply_update_v1(states[h])
+    s = final.get_text("text").get_string()
+    # a replica applying the same heads in reverse converges identically
+    replica = Doc(client_id=0xBEEF)
+    for h in reversed(heads):
+        replica.apply_update_v1(states[h])
+    assert replica.get_text("text").get_string() == s
+    assert len(s) > 0
+    assert final.store.pending is None
+
+
+@requires_assets
+def test_concurrent_trace_full_end_content():
+    """Full concurrent replay: the merge of all heads equals endContent."""
+    path = f"{ASSETS}/editing-traces/concurrent_traces/friendsforever.json.gz"
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    txns = data["txns"]
+
+    last_use = {}
+    for i, t in enumerate(txns):
+        for p in t["parents"]:
+            last_use[p] = i
+
+    states = {}
+    for i, t in enumerate(txns):
+        doc = Doc(client_id=int(t["agent"]) + 1)
+        for p in t["parents"]:
+            doc.apply_update_v1(states[p])
+        txt = doc.get_text("text")
+        with doc.transact() as txn:
+            for pos, del_len, ins in t["patches"]:
+                if del_len:
+                    txt.remove_range(txn, pos, del_len)
+                if ins:
+                    txt.insert(txn, pos, ins)
+        states[i] = doc.encode_state_as_update_v1()
+        for p in t["parents"]:
+            if last_use.get(p) == i:
+                states.pop(p, None)
+
+    final = Doc(client_id=0xF00D)
+    for i in sorted(states):
+        final.apply_update_v1(states[i])
+    assert final.get_text("text").get_string() == data["endContent"]

@@ -170,3 +170,30 @@ def test_v2_update_event_payload():
     d2 = Doc(client_id=2)
     d2.apply_update_v2(payload)
     assert d2.get_text("t").get_string() == "v2 event"
+
+
+def test_nested_maps_arrays_v2_roundtrip():
+    """Port of the reference's negative_zero_decoding_v2 regression
+    (compatibility_tests.rs:394-425): nested map/array prelims through a
+    full v2 state encode must re-apply to an identical tree (the original
+    bug was IntDiffOptRle emitting a negative-zero run)."""
+    from ytpu.types.shared import ArrayPrelim, MapPrelim
+
+    doc = Doc(client_id=1)
+    root = doc.get_map("root")
+    with doc.transact() as txn:
+        root.insert(txn, "sequence", MapPrelim({}))
+    seq = root.get("sequence")
+    with doc.transact() as txn:
+        seq.insert(txn, "id", "V9Uk9pxUKZIrW6cOkC0Rg")
+        seq.insert(txn, "cuts", ArrayPrelim([]))
+        seq.insert(txn, "name", "new sequence")
+        root.insert(txn, "__version__", 1)
+        root.insert(txn, "face_expressions", ArrayPrelim([]))
+        root.insert(txn, "characters", ArrayPrelim([]))
+    expected = root.to_json()
+
+    buffer = doc.encode_state_as_update_v2()
+    doc2 = Doc(client_id=2)
+    doc2.apply_update_v2(buffer)
+    assert doc2.get_map("root").to_json() == expected

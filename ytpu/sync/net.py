@@ -68,11 +68,11 @@ async def read_frame(
             return None
     else:
         b = await first
+    if not b:
+        return None  # clean EOF between frames
     shift = 0
     size = 0
     while True:
-        if not b:
-            return None
         size |= (b[0] & 0x7F) << shift
         shift += 7
         if b[0] < 0x80:
@@ -80,6 +80,9 @@ async def read_frame(
         if shift > 63:
             raise ConnectionError("oversized frame varint")
         b = await reader.read(1)
+        if not b:
+            # EOF inside a length prefix is truncation, not a clean close
+            raise ConnectionError("eof inside frame header")
     if size > _MAX_FRAME:
         raise ConnectionError(f"frame of {size} bytes exceeds limit")
     return await reader.readexactly(size)
@@ -89,13 +92,6 @@ def write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
     w = Writer()
     w.write_buf(payload)
     writer.write(w.to_bytes())
-
-
-def _frames(data: bytes) -> list:
-    """Concatenated protocol bytes → one re-encoded frame per message."""
-    if not data:
-        return []
-    return [m.encode_v1() for m in message_reader(data)]
 
 
 async def serve(
@@ -115,9 +111,12 @@ async def serve(
             if hello is None:
                 return
             tenant = hello.decode("utf-8")
-            session, greeting = server.connect(tenant)
+            try:
+                session, greeting = server.connect_frames(tenant)
+            except RuntimeError:
+                return  # e.g. device batch full: reject quietly
             writers[session.id] = writer
-            for frame in _frames(greeting):
+            for frame in greeting:
                 write_frame(writer, frame)
             await writer.drain()
             while True:

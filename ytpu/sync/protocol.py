@@ -201,11 +201,15 @@ class Protocol:
 
     def start(self, awareness: Awareness) -> bytes:
         """Connection opening: SyncStep1(local sv) + awareness snapshot."""
-        w = Writer()
+        return b"".join(self.start_messages(awareness))
+
+    def start_messages(self, awareness: Awareness) -> List[bytes]:
+        """`start`, one bytes object per message (for framed transports)."""
         sv = awareness.doc.state_vector()
-        Message.sync(SyncMessage.step1(sv)).encode(w)
-        Message.awareness(awareness.update()).encode(w)
-        return w.to_bytes()
+        return [
+            Message.sync(SyncMessage.step1(sv)).encode_v1(),
+            Message.awareness(awareness.update()).encode_v1(),
+        ]
 
     def handle_sync_step1(
         self, awareness: Awareness, sv: StateVector

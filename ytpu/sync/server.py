@@ -80,12 +80,16 @@ class SyncServer:
 
     def connect(self, tenant_name: str) -> Tuple[Session, bytes]:
         """Open a session; returns (session, greeting bytes to send)."""
+        session, frames = self.connect_frames(tenant_name)
+        return session, b"".join(frames)
+
+    def connect_frames(self, tenant_name: str) -> Tuple[Session, List[bytes]]:
+        """Like `connect`, but one bytes object per greeting message."""
         t = self.tenant(tenant_name)
         self._next_session += 1
         session = Session(self._next_session, tenant_name, self)
         t.sessions.append(session)
-        greeting = self.protocol.start(t.awareness)
-        return session, greeting
+        return session, self.protocol.start_messages(t.awareness)
 
     def disconnect(self, session: Session) -> None:
         t = self.tenants.get(session.tenant)
