@@ -148,3 +148,118 @@ def test_undo_survives_sync_roundtrip():
     mgr.redo()
     peer.apply_update_v1(doc.encode_state_as_update_v1(peer.state_vector()))
     assert peer.get_text("t").get_string() == "abc"
+
+
+def test_double_undo_then_insert():
+    """Scenario parity: undo.rs double_undo — two undos of two grouped-out
+    inserts, then a fresh insert lands at the right position."""
+    doc = Doc(client_id=1)
+    txt = doc.get_text("test")
+    with doc.transact() as txn:
+        txt.insert(txn, 0, "1221")
+    mgr = UndoManager(doc, txt)
+    with doc.transact() as txn:
+        txt.insert(txn, 2, "3")
+    with doc.transact() as txn:
+        txt.insert(txn, 3, "3")
+    mgr.undo()
+    mgr.undo()
+    with doc.transact() as txn:
+        txt.insert(txn, 2, "3")
+    assert txt.get_string() == "12321"
+
+
+def test_consecutive_undo_redo_ladder():
+    """Scenario parity: undo.rs consecutive_redo_bug (yjs#355) — reset()
+    boundaries create a ladder of stack items; undo steps down through
+    every state to null, redo climbs all the way back."""
+    from ytpu.types.shared import MapPrelim
+
+    doc = Doc(client_id=1)
+    root = doc.get_map("root")
+    mgr = UndoManager(doc, root)
+
+    with doc.transact() as txn:
+        root.insert(txn, "a", MapPrelim({"x": 0, "y": 0}))
+    point = root.get("a")
+    mgr.reset()
+    for v in (100, 200, 300):
+        with doc.transact() as txn:
+            point.insert(txn, "x", v)
+            point.insert(txn, "y", v)
+        mgr.reset()
+    assert point.to_json() == {"x": 300, "y": 300}
+
+    for v in (200, 100, 0):
+        mgr.undo()
+        assert root.get("a").to_json() == {"x": v, "y": v}, v
+    mgr.undo()
+    assert root.get("a") is None
+    for v in (0, 100, 200, 300):
+        mgr.redo()
+        assert root.get("a").to_json() == {"x": v, "y": v}, v
+
+
+def test_undo_delete_restores_text_format():
+    """Scenario parity: undo.rs undo_delete_text_format (yjs#392) — undoing
+    a format-removal restores the bold run on both peers."""
+    d1 = Doc(client_id=1)
+    t1 = d1.get_text("test")
+    with d1.transact() as txn:
+        t1.insert(txn, 0, "Attack ships on fire off the shoulder of Orion.")
+    d2 = Doc(client_id=2)
+    d2.apply_update_v1(d1.encode_state_as_update_v1())
+
+    mgr = UndoManager(d1, t1)
+    with d1.transact() as txn:
+        t1.format(txn, 13, 7, {"bold": True})
+    mgr.reset()
+    d2.apply_update_v1(d1.encode_state_as_update_v1(d2.state_vector()))
+
+    with d1.transact() as txn:
+        t1.format(txn, 16, 4, {"bold": None})
+    mgr.reset()
+    d2.apply_update_v1(d1.encode_state_as_update_v1(d2.state_vector()))
+
+    mgr.undo()
+    d2.apply_update_v1(d1.encode_state_as_update_v1(d2.state_vector()))
+
+    def runs(doc):
+        return [
+            (r.insert, r.attributes)
+            for r in doc.get_text("test").diff()
+        ]
+
+    expect = [
+        ("Attack ships ", None),
+        ("on fire", {"bold": True}),
+        (" off the shoulder of Orion.", None),
+    ]
+    assert runs(d1) == expect, runs(d1)
+    assert runs(d2) == expect, runs(d2)
+
+
+def test_special_deletion_case_xml():
+    """Scenario parity: undo.rs special_deletion_case (yjs#447) — an
+    origin-scoped txn edits an attribute AND deletes the node; undo must
+    resurrect the node with its ORIGINAL attributes."""
+    from ytpu.types.shared import XmlElementPrelim
+
+    doc = Doc(client_id=1)
+    f = doc.get_xml_fragment("test")
+    mgr = UndoManager(doc, f, UndoOptions(tracked_origins={"undoable"}))
+    with doc.transact() as txn:
+        f.insert(txn, 0, XmlElementPrelim("test"))
+        e = f.get(0)
+        e.insert_attribute(txn, "a", "1")
+        e.insert_attribute(txn, "b", "2")
+    s = f.get_string()
+    assert s in ('<test a="1" b="2"></test>', '<test b="2" a="1"></test>')
+    with doc.transact(origin="undoable") as txn:
+        e = f.get(0)
+        e.insert_attribute(txn, "b", "3")
+        f.remove_range(txn, 0, 1)
+    assert f.get_string() == ""
+    mgr.undo()
+    s = f.get_string()
+    assert s in ('<test a="1" b="2"></test>', '<test b="2" a="1"></test>'), s

@@ -17,7 +17,11 @@ from typing import Dict, List, Optional
 from ytpu.models.ingest import BatchIngestor
 from ytpu.sync.server import SyncServer
 
-__all__ = ["DeviceSyncServer"]
+__all__ = ["DeviceBatchFull", "DeviceSyncServer"]
+
+
+class DeviceBatchFull(RuntimeError):
+    """All tenant slots of the device batch are assigned."""
 
 
 class DeviceSyncServer(SyncServer):
@@ -62,7 +66,7 @@ class DeviceSyncServer(SyncServer):
         slot = self._slot_of.get(tenant_name)
         if slot is None:
             if len(self._slot_of) >= self.ingestor.n_docs:
-                raise RuntimeError(
+                raise DeviceBatchFull(
                     f"device batch is full ({self.ingestor.n_docs} tenant slots)"
                 )
             slot = len(self._slot_of)

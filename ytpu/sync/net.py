@@ -113,8 +113,12 @@ async def serve(
             tenant = hello.decode("utf-8")
             try:
                 session, greeting = server.connect_frames(tenant)
-            except RuntimeError:
-                return  # e.g. device batch full: reject quietly
+            except Exception as e:
+                from ytpu.sync.device_server import DeviceBatchFull
+
+                if isinstance(e, DeviceBatchFull):
+                    return  # capacity: reject quietly
+                raise
             writers[session.id] = writer
             for frame in greeting:
                 write_frame(writer, frame)

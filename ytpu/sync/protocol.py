@@ -204,7 +204,13 @@ class Protocol:
         return b"".join(self.start_messages(awareness))
 
     def start_messages(self, awareness: Awareness) -> List[bytes]:
-        """`start`, one bytes object per message (for framed transports)."""
+        """`start`, one bytes object per message (for framed transports).
+
+        Subclasses overriding `start()` (the historical hook) still take
+        effect: their concatenated greeting ships as one frame —
+        `message_reader` on the receiving side handles both shapes."""
+        if type(self).start is not Protocol.start:
+            return [self.start(awareness)]
         sv = awareness.doc.state_vector()
         return [
             Message.sync(SyncMessage.step1(sv)).encode_v1(),
