@@ -173,8 +173,6 @@ def test_consecutive_undo_redo_ladder():
     """Scenario parity: undo.rs consecutive_redo_bug (yjs#355) — reset()
     boundaries create a ladder of stack items; undo steps down through
     every state to null, redo climbs all the way back."""
-    from ytpu.types.shared import MapPrelim
-
     doc = Doc(client_id=1)
     root = doc.get_map("root")
     mgr = UndoManager(doc, root)
@@ -243,7 +241,7 @@ def test_special_deletion_case_xml():
     """Scenario parity: undo.rs special_deletion_case (yjs#447) — an
     origin-scoped txn edits an attribute AND deletes the node; undo must
     resurrect the node with its ORIGINAL attributes."""
-    from ytpu.types.shared import XmlElementPrelim
+    from ytpu.types import XmlElementPrelim
 
     doc = Doc(client_id=1)
     f = doc.get_xml_fragment("test")

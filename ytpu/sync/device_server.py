@@ -15,13 +15,9 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from ytpu.models.ingest import BatchIngestor
-from ytpu.sync.server import SyncServer
+from ytpu.sync.server import DeviceBatchFull, SyncServer
 
 __all__ = ["DeviceBatchFull", "DeviceSyncServer"]
-
-
-class DeviceBatchFull(RuntimeError):
-    """All tenant slots of the device batch are assigned."""
 
 
 class DeviceSyncServer(SyncServer):

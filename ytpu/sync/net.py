@@ -33,7 +33,7 @@ from ytpu.sync.protocol import (
     UnsupportedMessage,
     message_reader,
 )
-from ytpu.sync.server import SyncServer
+from ytpu.sync.server import DeviceBatchFull, SyncServer
 
 # protocol-level garbage from a peer tears the connection down quietly
 _PEER_ERRORS = (
@@ -113,12 +113,8 @@ async def serve(
             tenant = hello.decode("utf-8")
             try:
                 session, greeting = server.connect_frames(tenant)
-            except Exception as e:
-                from ytpu.sync.device_server import DeviceBatchFull
-
-                if isinstance(e, DeviceBatchFull):
-                    return  # capacity: reject quietly
-                raise
+            except DeviceBatchFull:
+                return  # capacity: reject quietly
             writers[session.id] = writer
             for frame in greeting:
                 write_frame(writer, frame)

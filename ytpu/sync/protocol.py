@@ -208,9 +208,17 @@ class Protocol:
 
         Subclasses overriding `start()` (the historical hook) still take
         effect: their concatenated greeting ships as one frame —
-        `message_reader` on the receiving side handles both shapes."""
-        if type(self).start is not Protocol.start:
-            return [self.start(awareness)]
+        `message_reader` on the receiving side handles both shapes. The
+        `_in_start` guard keeps `super().start()` delegation from
+        recursing (base `start` itself routes through this method)."""
+        if type(self).start is not Protocol.start and not getattr(
+            self, "_in_start", False
+        ):
+            self._in_start = True
+            try:
+                return [self.start(awareness)]
+            finally:
+                self._in_start = False
         sv = awareness.doc.state_vector()
         return [
             Message.sync(SyncMessage.step1(sv)).encode_v1(),

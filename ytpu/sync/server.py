@@ -23,7 +23,11 @@ from ytpu.utils import trace_span
 from .awareness import Awareness
 from .protocol import Message, Protocol, SyncMessage, message_reader
 
-__all__ = ["SyncServer", "Session"]
+__all__ = ["DeviceBatchFull", "SyncServer", "Session"]
+
+
+class DeviceBatchFull(RuntimeError):
+    """All tenant slots of a device-backed server's batch are assigned."""
 
 
 class Session:
