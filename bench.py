@@ -19,25 +19,49 @@ this machine (the reference publishes no absolute numbers, BASELINE.md §1).
 Correctness is asserted: the final text of the first and last doc slots must
 equal the host replay's text.
 
-Prints ONE JSON line.
+Robustness contract (this script is driver-captured; it must never hang and
+must always print exactly ONE JSON line):
+
+- The parent process NEVER imports jax. On this image the accelerator
+  plugin can block `import jax` indefinitely when the device tunnel is
+  down, so everything that touches jax runs in a child process under a
+  hard wall-clock timeout (`YTPU_BENCH_DEVICE_TIMEOUT`, default 600s; a
+  quick `jax.devices()` probe under `YTPU_BENCH_PROBE_TIMEOUT`, default
+  240s, runs first so a dead backend fails in minutes, not the full
+  budget). One retry on probe/run failure.
+- On any device failure the JSON line still carries the host-oracle
+  number plus an "error" field, so a round always records a measurement.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import pickle
 import random
 import string
+import subprocess
+import sys
+import tempfile
 import time
 
-N_DOCS = 4096
-N_UPDATES = 600
+N_DOCS = int(os.environ.get("YTPU_BENCH_DOCS", "4096"))
+N_UPDATES = int(os.environ.get("YTPU_BENCH_UPDATES", "600"))
 CAPACITY = 2048
-D_BLOCK = 128  # [14, 128, 2048] i32 tile = 14MB + scan temps (~56MB scoped)
+D_BLOCK = min(128, N_DOCS)  # [14, 128, 2048] i32 tile = 14MB + scan temps
 ROWS_PER_STEP = 4
 DELS_PER_STEP = 8
 
 TRACE_PATH = "/root/reference/assets/bench-input/b4-editing-trace.bin"
+
+PROBE_TIMEOUT = float(os.environ.get("YTPU_BENCH_PROBE_TIMEOUT", "240"))
+DEVICE_TIMEOUT = float(os.environ.get("YTPU_BENCH_DEVICE_TIMEOUT", "600"))
+
+_PROBE_SRC = (
+    "import jax, json, sys; d = jax.devices(); "
+    "print(json.dumps({'n': len(d), 'kind': d[0].device_kind, "
+    "'platform': d[0].platform}))"
+)
 
 
 def load_b4_ops(limit: int):
@@ -104,6 +128,25 @@ def host_replay(log):
     return dt, doc.get_text("text").get_string()
 
 
+def native_replay(log):
+    """C++ single-doc replay (`ytpu/native/engine.cpp`, scalar YATA) — the
+    native-speed baseline the ≥50x target is defined against (the Python
+    oracle alone overstates the device ratio). Returns None when the
+    native library isn't built or the stream needs host-only features."""
+    try:
+        from ytpu.native import engine_available, native_replay_v1
+
+        if not engine_available():
+            return None
+        t0 = time.perf_counter()
+        text = native_replay_v1(log)
+        dt = time.perf_counter() - t0
+        return dt, text
+    except Exception:
+        # never let the optional baseline break the measurement contract
+        return None
+
+
 def device_replay(log, expect: str):
     """Wire bytes → device. The host's only work is a memcpy into the padded
     byte matrix; varint/structure decode (`decode_updates_v1`) and YATA
@@ -125,6 +168,10 @@ def device_replay(log, expect: str):
     )
     from ytpu.ops.integrate_kernel import apply_update_stream_fused
 
+    # Pallas compiles natively on TPU; on CPU (verification runs) it only
+    # works in interpret mode.
+    interpret = jax.devices()[0].platform == "cpu"
+
     buf_np, lens_np = pack_updates(log)
     decode = jax.jit(
         partial(decode_updates_v1, max_rows=ROWS_PER_STEP, max_dels=DELS_PER_STEP)
@@ -136,7 +183,7 @@ def device_replay(log, expect: str):
         lens = jnp.asarray(lens_np)
         stream, flags = decode(buf, lens)
         state = apply_update_stream_fused(
-            state, stream, rank, d_block=D_BLOCK, guard=False
+            state, stream, rank, d_block=D_BLOCK, guard=False, interpret=interpret
         )
         return state, flags
 
@@ -165,6 +212,69 @@ def device_replay(log, expect: str):
     return time.perf_counter() - t0
 
 
+def _device_phase_child(in_path: str, out_path: str) -> None:
+    """Child entry: the only process that imports jax."""
+    with open(in_path, "rb") as f:
+        job = pickle.load(f)
+    dt = device_replay(job["log"], job["expect"])
+    with open(out_path, "w") as f:
+        json.dump({"device_dt": dt}, f)
+
+
+def _probe_device() -> dict | None:
+    """jax.devices() in a throwaway child under a hard timeout."""
+    try:
+        res = subprocess.run(
+            [sys.executable, "-u", "-c", _PROBE_SRC],
+            capture_output=True,
+            text=True,
+            timeout=PROBE_TIMEOUT,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if res.returncode != 0:
+        return None
+    try:
+        return json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return None
+
+
+def _run_device_phase(log, expect):
+    """Spawn the device child; returns (device_dt, None) or (None, error)."""
+    with tempfile.TemporaryDirectory() as tmp:
+        in_path = os.path.join(tmp, "job.pkl")
+        out_path = os.path.join(tmp, "result.json")
+        with open(in_path, "wb") as f:
+            pickle.dump({"log": log, "expect": expect}, f)
+        try:
+            res = subprocess.run(
+                [
+                    sys.executable,
+                    "-u",
+                    os.path.abspath(__file__),
+                    "--device-phase",
+                    in_path,
+                    out_path,
+                ],
+                capture_output=True,
+                text=True,
+                timeout=DEVICE_TIMEOUT,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            return None, f"device phase timed out after {DEVICE_TIMEOUT:.0f}s"
+        if res.returncode != 0:
+            tail = (res.stderr or res.stdout or "").strip().splitlines()[-3:]
+            return None, f"device phase rc={res.returncode}: {' | '.join(tail)}"
+        try:
+            with open(out_path) as f:
+                return json.load(f)["device_dt"], None
+        except (OSError, ValueError, KeyError) as e:
+            return None, f"device phase wrote no result: {e}"
+
+
 def main():
     if os.path.exists(TRACE_PATH):
         ops = load_b4_ops(N_UPDATES)
@@ -175,21 +285,53 @@ def main():
     log, expect = build_updates(ops)
     host_dt, host_text = host_replay(log)
     assert host_text == expect
-    device_dt = device_replay(log, expect)
-
     host_rate = len(log) / host_dt
-    device_rate = len(log) * N_DOCS / device_dt
-    print(
-        json.dumps(
-            {
-                "metric": "updates_integrated_per_sec_batched",
-                "value": round(device_rate, 1),
-                "unit": f"updates/s over {N_DOCS}-doc batch ({trace})",
-                "vs_baseline": round(device_rate / host_rate, 2),
-            }
+
+    native = native_replay(log)
+    native_rate = None
+    if native is not None:
+        native_dt, native_text = native
+        if native_text == expect:
+            native_rate = len(log) / native_dt
+        # on mismatch: drop the native baseline, keep the run alive
+
+    # Device phase: probe fail-fast, then run; one retry on either failure.
+    device_dt, err = None, "device probe failed/timed out"
+    for _ in range(2):
+        if _probe_device() is None:
+            continue
+        device_dt, err = _run_device_phase(log, expect)
+        if device_dt is not None:
+            break
+
+    out = {
+        "metric": "updates_integrated_per_sec_batched",
+        "host_oracle_updates_per_sec": round(host_rate, 1),
+    }
+    if native_rate is not None:
+        out["native_updates_per_sec"] = round(native_rate, 1)
+    if device_dt is not None:
+        device_rate = len(log) * N_DOCS / device_dt
+        out["value"] = round(device_rate, 1)
+        out["unit"] = f"updates/s over {N_DOCS}-doc batch ({trace})"
+        out["vs_baseline"] = round(
+            device_rate / (native_rate if native_rate else host_rate), 2
         )
-    )
+        out["vs_py_oracle"] = round(device_rate / host_rate, 2)
+        if native_rate is not None:
+            out["vs_native"] = round(device_rate / native_rate, 2)
+    else:
+        # Always emit a measurement: host (or native) number + error.
+        best = native_rate if native_rate else host_rate
+        out["value"] = round(best, 1)
+        out["unit"] = f"updates/s single-doc host fallback ({trace})"
+        out["vs_baseline"] = 1.0
+        out["error"] = err
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) == 4 and sys.argv[1] == "--device-phase":
+        _device_phase_child(sys.argv[2], sys.argv[3])
+    else:
+        main()

@@ -255,3 +255,84 @@ def test_delete_only_steps_retain_no_wire_bytes():
     ing.apply_bytes([log[1]])  # delete-only update: no string refs
     ing.apply_bytes([log[2]])
     assert ing.payloads.total_bytes == after_insert
+
+
+@needs_native
+def test_degenerate_wire_shapes_no_wedge():
+    """Wire-legal degenerate updates (many empty ds-client sections; many
+    client sections holding only Skip runs) must not wedge the batch —
+    they either route to the slow lane or decode clean on device with a
+    section-aware step budget (ADVICE r1, medium)."""
+    from ytpu.encoding.lib0 import Writer
+
+    # (a) zero block sections + 40 empty ds-client sections → slow lane
+    w = Writer()
+    w.write_var_uint(0)
+    w.write_var_uint(40)
+    for c in range(40):
+        w.write_var_uint(c + 1)
+        w.write_var_uint(0)
+    empty_ds = w.to_bytes()
+
+    # (b) 30 client sections, each a single Skip run → fast lane, but the
+    # section count exceeds the emitted-row count (0) by far
+    w = Writer()
+    w.write_var_uint(30)
+    for c in range(30):
+        w.write_var_uint(1)
+        w.write_var_uint(c + 100)
+        w.write_var_uint(0)
+        w.write_u8(10)  # BLOCK_SKIP
+        w.write_var_uint(5)
+    w.write_var_uint(0)
+    skip_heavy = w.to_bytes()
+
+    ing = BatchIngestor(n_docs=1, capacity=128)
+    ing.apply_bytes([empty_ds])
+    assert _flags_clean(ing)
+    ing.apply_bytes([skip_heavy])
+    assert _flags_clean(ing)
+    assert int(np.asarray(ing.state.error).max()) == 0
+
+    # the engine still works afterwards
+    log, expect = _edit_log([("i", 0, "still alive")])
+    for p in log:
+        ing.apply_bytes([p])
+    assert get_string(ing.state, 0, ing.payloads) == expect
+
+
+@needs_native
+def test_fast_lane_flag_recovery(monkeypatch):
+    """If the device decoder flags a lane the host pre-scan validated, the
+    ingestor must rewind the mirror SV and replay that doc through the
+    host lane — converging instead of raising (ADVICE r1, medium)."""
+    import jax.numpy as jnp
+
+    from ytpu.ops import decode_kernel as dk
+
+    real = dk.decode_updates_v1
+    hits = {"n": 0}
+
+    def sabotage(buf, lens, max_rows, max_dels, **kw):
+        stream, flags = real(buf, lens, max_rows, max_dels, **kw)
+        if hits["n"] == 0:
+            hits["n"] = 1
+            flags = flags | jnp.full_like(flags, dk.FLAG_MALFORMED)
+            stream = stream._replace(
+                valid=jnp.zeros_like(stream.valid),
+                del_valid=jnp.zeros_like(stream.del_valid),
+            )
+        return stream, flags
+
+    monkeypatch.setattr(dk, "decode_updates_v1", sabotage)
+    log, expect = _edit_log([("i", 0, "hello"), ("i", 5, " world")])
+    ing = BatchIngestor(n_docs=1, capacity=128)
+    for p in log:
+        ing.apply_bytes([p])
+    assert hits["n"] == 1
+    assert ing.fast_recoveries == 1
+    assert get_string(ing.state, 0, ing.payloads) == expect
+    u = Doc(client_id=9)
+    for p in log:
+        u.apply_update_v1(p)
+    assert dict(ing.svs[0].clocks) == dict(u.state_vector().clocks)

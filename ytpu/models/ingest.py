@@ -33,7 +33,7 @@ from ytpu.models.batch_doc import (
     apply_update_batch,
     init_state,
 )
-from ytpu.ops.decode_kernel import ChunkedWirePayloads
+from ytpu.ops.decode_kernel import ChunkedWirePayloads, exact_steps
 
 __all__ = ["BatchIngestor"]
 
@@ -62,6 +62,8 @@ class BatchIngestor:
         # fast-lane stats (observability; tests assert the lane actually ran)
         self.fast_docs = 0
         self.slow_docs = 0
+        self.fast_recoveries = 0  # flagged fast lanes replayed via host lane
+        self._last_fast_flags: Optional[np.ndarray] = None
 
     # --- introspection (parity: ytransaction_pending_update/_ds shape) -------
 
@@ -173,6 +175,14 @@ class BatchIngestor:
         `partition_carriers`)."""
         if cols.error or self._pending[doc] or not self._pending_ds[doc].is_empty():
             return False
+        # Degenerate-but-legal wire shapes (many client sections holding only
+        # covered Skip runs, many empty ds-client sections) are correct on
+        # the fast lane only if the decode budget covers them; bound the
+        # blow-up so one doc can't balloon the whole step's T.
+        if cols.n_client_sections > cols.n_blocks + 16:
+            return False
+        if cols.n_ds_sections > cols.n_dels + 16:
+            return False
         n = cols.n_blocks
         sv = self.svs[doc]
         covered: Dict[int, int] = {}
@@ -241,14 +251,19 @@ class BatchIngestor:
         """
         if len(payloads) != self.n_docs:
             raise ValueError(f"expected {self.n_docs} payload slots")
+        self._last_fast_flags = None
         from ytpu.native import available, decode_update_columns
 
         native = available()
         fast_idx: List[int] = []
         fast_payloads: List[bytes] = []
+        # recovery support: per fast doc, first-touch (client -> pre-step
+        # clock) deltas — cheaper than copying whole SVs on the hot path
+        fast_sv_deltas: Dict[int, Dict[int, int]] = {}
+        fast_has_str: List[bool] = []
         slow_updates: List[Optional[Update]] = [None] * self.n_docs
         max_fast_rows, max_fast_dels = 0, 0
-        n_str_rows = 0  # fast-lane string rows (host count: no device sync)
+        max_sections, max_steps = 0, 0
         for d, p in enumerate(payloads):
             if p is None:
                 continue
@@ -257,13 +272,19 @@ class BatchIngestor:
                 fast_idx.append(d)
                 fast_payloads.append(p)
                 sv = self.svs[d]
+                deltas = fast_sv_deltas[d] = {}
                 rows_here = 0
+                str_here = 0
+                n_skip_gc = 0
                 for i in range(cols.n_blocks):
                     kind = int(cols.kind[i])
                     if kind == 10:
+                        n_skip_gc += 1
                         continue
+                    if kind == 0:
+                        n_skip_gc += 1
                     if kind == 4 and int(cols.length[i]) > 0:
-                        n_str_rows += 1
+                        str_here += 1
                     c = int(cols.client[i])
                     self.enc.interner.intern(c)
                     for arr, clk in (
@@ -272,13 +293,28 @@ class BatchIngestor:
                     ):
                         if int(clk[i]) >= 0:
                             self.enc.interner.intern(int(arr[i]))
+                    deltas.setdefault(c, sv.get(c))
                     sv.set_max(c, int(cols.clock[i]) + int(cols.length[i]))
                     if int(cols.length[i]) > 0:
                         rows_here += 1
                 for i in range(cols.n_dels):
                     self.enc.interner.intern(int(cols.del_client[i]))
+                fast_has_str.append(str_here > 0)
                 max_fast_rows = max(max_fast_rows, rows_here)
                 max_fast_dels = max(max_fast_dels, cols.n_dels)
+                max_sections = max(max_sections, cols.n_client_sections)
+                max_steps = max(
+                    max_steps,
+                    exact_steps(
+                        cols.n_client_sections,
+                        # zero-length blocks are dropped from the columns
+                        # but still cost parse steps on device
+                        cols.n_blocks - n_skip_gc + cols.n_zero_len_blocks,
+                        n_skip_gc,
+                        cols.n_ds_sections,
+                        cols.n_dels,
+                    ),
+                )
             else:
                 slow_updates[d] = Update.decode_v1(p)
         self.fast_docs += len(fast_idx)
@@ -294,33 +330,75 @@ class BatchIngestor:
         batch = self.enc.batch_from_rows(all_rows, all_dels, n_rows, n_dels)
 
         flags = None
+        chunk_base = None
         if fast_idx:
-            # delete/GC-only steps (no string rows) retain no wire bytes
-            batch, flags = self._merge_fast_lane(
+            # retain wire bytes only for lanes that actually emitted string
+            # rows (delete/GC-only payloads hold no device-referenced spans)
+            batch, flags, chunk_base = self._merge_fast_lane(
                 batch, fast_idx, fast_payloads, n_rows, n_dels,
-                retain=n_str_rows > 0,
+                retain_lanes=fast_has_str,
+                n_steps=max_steps or None,
+                max_sections=max_sections or None,
             )
         self.state = apply_update_batch(
             self.state, batch, self.enc.interner.rank_table()
         )
         if flags is not None:
-            # `_fast_eligible` proved these lanes decode clean; a flag here
-            # is an invariant violation and the mirror SV has already
-            # advanced, so fail loudly rather than diverge silently. (The
-            # readback overlaps the already-dispatched integrate step.)
+            # `_fast_eligible` proved these lanes decode clean, and flagged
+            # lanes integrate nothing (their rows are marked invalid), so a
+            # flag here means the device saw something the host pre-scan
+            # did not. Recover exactly: rewind the mirror SV and re-route
+            # the payload through the host lane in one follow-up step.
+            # (The readback overlaps the already-dispatched integrate step.)
             from ytpu.ops.decode_kernel import FLAG_ERRORS
 
             f = np.asarray(flags)
             if (f & FLAG_ERRORS).any():
-                bad = [fast_idx[i] for i in np.nonzero(f & FLAG_ERRORS)[0]]
-                raise RuntimeError(
-                    f"fast-lane decode flagged validated docs {bad}: "
-                    f"{f[f != 0][:8]} — device/host decoder disagreement"
+                bad_lanes = set(np.nonzero(f & FLAG_ERRORS)[0].tolist())
+                bad = [fast_idx[i] for i in bad_lanes]
+                self.fast_recoveries += len(bad)
+                # release the retained wire chunk if every string-bearing
+                # lane in it was flagged (their refs never went live); a
+                # partially-flagged chunk keeps the surviving lanes' bytes
+                # (the flagged lanes' share is stranded — rare, bounded by
+                # decoder-disagreement frequency)
+                if chunk_base is not None and all(
+                    i in bad_lanes
+                    for i, has in enumerate(fast_has_str)
+                    if has
+                ):
+                    self.payloads.drop_if_unreferenced(chunk_base)
+                recovery: List[Optional[Update]] = [None] * self.n_docs
+                for d in bad:
+                    clocks = self.svs[d].clocks
+                    for c, old in fast_sv_deltas[d].items():
+                        if old == 0:
+                            clocks.pop(c, None)
+                        else:
+                            clocks[c] = old
+                    recovery[d] = Update.decode_v1(payloads[d])
+                r_rows, r_dels = [], []
+                for d, u in enumerate(recovery):
+                    rows, dels = self._plan_doc(d, u)
+                    r_rows.append(rows)
+                    r_dels.append(dels)
+                rbatch = self.enc.batch_from_rows(r_rows, r_dels)
+                self.state = apply_update_batch(
+                    self.state, rbatch, self.enc.interner.rank_table()
                 )
+            self._last_fast_flags = f
         return self.state
 
     def _merge_fast_lane(
-        self, batch, fast_idx, fast_payloads, n_rows, n_dels, retain=True
+        self,
+        batch,
+        fast_idx,
+        fast_payloads,
+        n_rows,
+        n_dels,
+        retain_lanes=None,
+        n_steps=None,
+        max_sections=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -332,14 +410,23 @@ class BatchIngestor:
 
         buf, lens = pack_updates(fast_payloads)
         S, L = buf.shape
-        # retain only the real wire bytes (lens-trimmed, concatenated) —
-        # refs are rebased from the padded s*L layout onto the compact one.
-        # `retain=False` (no string rows in the step) skips the copy.
+        # Retain only the wire bytes of lanes that emitted string rows
+        # (lens-trimmed, concatenated) — refs are rebased from the padded
+        # s*L layout onto the compact one. Lanes without string rows have
+        # no device-referenced spans, so their bytes are never kept.
+        keep = (
+            np.ones(S, dtype=bool)
+            if retain_lanes is None
+            else np.asarray(retain_lanes, dtype=bool)
+        )
+        kept_lens = np.where(keep, lens, 0).astype(np.int64)
         prefix = np.zeros(S, dtype=np.int64)
-        prefix[1:] = np.cumsum(lens[:-1])
+        prefix[1:] = np.cumsum(kept_lens[:-1])
         base = 0
-        if retain:
-            compact = b"".join(fast_payloads)
+        if keep.any():
+            compact = b"".join(
+                p for p, k in zip(fast_payloads, keep) if k
+            )
             base = self.payloads.add_chunk(
                 np.frombuffer(compact, dtype=np.uint8)
             )
@@ -348,7 +435,9 @@ class BatchIngestor:
             jnp.asarray(lens),
             n_rows,
             n_dels,
+            n_steps=n_steps,
             client_table=self._client_table(),
+            max_sections=max_sections,
         )
         is_str_ref = stream.valid & (stream.content_ref >= 0)
         lane = jnp.arange(S, dtype=jnp.int32)[:, None]
@@ -363,4 +452,4 @@ class BatchIngestor:
         merged = jax.tree.map(
             lambda full, fast: full.at[idx].set(fast), batch, stream
         )
-        return merged, flags
+        return merged, flags, (base if keep.any() else None)

@@ -20,10 +20,15 @@ __all__ = [
     "NativeColumns",
     "decode_update_columns",
     "build_capi",
+    "NativeEngine",
+    "NativeUnsupported",
+    "engine_available",
+    "native_replay_v1",
 ]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "lib0_codec.cpp")
+_ENGINE_SRC = os.path.join(_HERE, "engine.cpp")
 _LIB = os.path.join(_HERE, "_libytpu.so")
 
 _lock = threading.Lock()
@@ -62,6 +67,7 @@ def _build() -> bool:
                 "-fPIC",
                 "-std=c++17",
                 _SRC,
+                _ENGINE_SRC,
                 "-o",
                 _LIB,
             ],
@@ -132,7 +138,8 @@ def load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+        newest_src = max(os.path.getmtime(_SRC), os.path.getmtime(_ENGINE_SRC))
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < newest_src:
             if not _build():
                 return None
         try:
@@ -147,6 +154,12 @@ def load() -> Optional[ctypes.CDLL]:
         lib.ytpu_columns_n_blocks.argtypes = [ctypes.c_void_p]
         lib.ytpu_columns_n_dels.restype = ctypes.c_size_t
         lib.ytpu_columns_n_dels.argtypes = [ctypes.c_void_p]
+        lib.ytpu_columns_n_client_sections.restype = ctypes.c_size_t
+        lib.ytpu_columns_n_client_sections.argtypes = [ctypes.c_void_p]
+        lib.ytpu_columns_n_ds_sections.restype = ctypes.c_size_t
+        lib.ytpu_columns_n_ds_sections.argtypes = [ctypes.c_void_p]
+        lib.ytpu_columns_n_zero_len_blocks.restype = ctypes.c_size_t
+        lib.ytpu_columns_n_zero_len_blocks.argtypes = [ctypes.c_void_p]
         lib.ytpu_columns_free.argtypes = [ctypes.c_void_p]
         for name in _COLUMNS + _DEL_COLUMNS:
             fn = getattr(lib, f"ytpu_col_{name}")
@@ -159,6 +172,19 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_size_t,
         ]
+        lib.ytpu_engine_new.restype = ctypes.c_void_p
+        lib.ytpu_engine_free.argtypes = [ctypes.c_void_p]
+        lib.ytpu_engine_apply.restype = ctypes.c_int
+        lib.ytpu_engine_apply.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        lib.ytpu_engine_text.restype = ctypes.c_void_p  # freed manually
+        lib.ytpu_engine_text.argtypes = [ctypes.c_void_p]
+        lib.ytpu_engine_str_free.argtypes = [ctypes.c_void_p]
+        lib.ytpu_engine_n_items.restype = ctypes.c_size_t
+        lib.ytpu_engine_n_items.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -177,6 +203,9 @@ class NativeColumns:
         self.error = bool(lib.ytpu_columns_error(handle))
         self.n_blocks = int(lib.ytpu_columns_n_blocks(handle))
         self.n_dels = int(lib.ytpu_columns_n_dels(handle))
+        self.n_client_sections = int(lib.ytpu_columns_n_client_sections(handle))
+        self.n_ds_sections = int(lib.ytpu_columns_n_ds_sections(handle))
+        self.n_zero_len_blocks = int(lib.ytpu_columns_n_zero_len_blocks(handle))
         import numpy as np
 
         def grab(name: str, count: int):
@@ -219,3 +248,72 @@ def decode_update_columns(payload: bytes) -> Optional[NativeColumns]:
         return None
     handle = lib.ytpu_decode_update_v1(payload, len(payload))
     return NativeColumns(lib, handle, payload)
+
+
+class NativeUnsupported(RuntimeError):
+    """The C++ engine hit a feature outside its scope (map keys, nested
+    parents, GC ranges, non-text content) — use the host oracle."""
+
+
+class NativeEngine:
+    """Scalar single-doc YATA engine in C++ (`engine.cpp`).
+
+    The native-speed performance baseline: reference-equivalent integrate
+    / apply_delete semantics for root-text update streams. Raises
+    `NativeUnsupported` for out-of-scope features.
+    """
+
+    def __init__(self):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._handle = lib.ytpu_engine_new()
+
+    def apply_update_v1(self, payload: bytes) -> None:
+        rc = self._lib.ytpu_engine_apply(self._handle, payload, len(payload))
+        if rc == 2:
+            raise NativeUnsupported("update outside native engine scope")
+        if rc != 0:
+            raise RuntimeError(f"native engine apply failed (rc={rc})")
+
+    def text(self) -> str:
+        ptr = self._lib.ytpu_engine_text(self._handle)
+        if not ptr:
+            raise MemoryError("ytpu_engine_text")
+        try:
+            return ctypes.string_at(ptr).decode("utf-8")
+        finally:
+            self._lib.ytpu_engine_str_free(ptr)
+
+    @property
+    def n_items(self) -> int:
+        return int(self._lib.ytpu_engine_n_items(self._handle))
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.ytpu_engine_free(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def engine_available() -> bool:
+    return available()
+
+
+def native_replay_v1(payloads) -> str:
+    """Replay a V1 update stream through the C++ engine; returns the final
+    root text. Raises `NativeUnsupported` when the stream needs features
+    beyond the engine's scope (caller falls back to the host oracle)."""
+    eng = NativeEngine()
+    try:
+        for p in payloads:
+            eng.apply_update_v1(p)
+        return eng.text()
+    finally:
+        eng.close()

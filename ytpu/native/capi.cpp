@@ -276,6 +276,22 @@ static PyObject *input_payload(const YInput *input) {
     case Y_JSON_ARR:
     case Y_ARRAY:
       if (input->len != YINPUT_STR_FORM) {
+        /* Migration guard: a hand-built `{tag, value.str = json}` with
+         * len left 0 is indistinguishable from an empty recursive array
+         * that passes a non-null (unused) pointer; reading the pointee to
+         * disambiguate would be out-of-bounds for a one-past-end pointer.
+         * Reject the ambiguous shape outright: empty arrays pass
+         * values=NULL (what yinput_json_array(NULL, 0) builds); JSON
+         * strings use yinput_json_array_str (len = YINPUT_STR_FORM). */
+        if (input->len == 0 && input->value.values) {
+          PyErr_SetString(
+              PyExc_ValueError,
+              "ambiguous YInput: len==0 with a non-NULL payload pointer; "
+              "pass values=NULL for an empty array, or use "
+              "yinput_json_array_str / len=YINPUT_STR_FORM for the "
+              "JSON-string form");
+          return nullptr;
+        }
         /* yffi recursive form: convert each element (prelims included) */
         PyObject *list = PyList_New((Py_ssize_t)input->len);
         if (!list) return nullptr;
@@ -294,6 +310,16 @@ static PyObject *input_payload(const YInput *input) {
     case Y_JSON_MAP:
     case Y_MAP:
       if (input->len != YINPUT_STR_FORM) {
+        /* same migration guard as the array case above */
+        if (input->len == 0 && input->value.map.keys) {
+          PyErr_SetString(
+              PyExc_ValueError,
+              "ambiguous YInput: len==0 with a non-NULL payload pointer; "
+              "pass keys=NULL for an empty map, or use "
+              "yinput_json_map_str / len=YINPUT_STR_FORM for the "
+              "JSON-string form");
+          return nullptr;
+        }
         PyObject *dict = PyDict_New();
         if (!dict) return nullptr;
         for (uint32_t k = 0; k < input->len; k++) {

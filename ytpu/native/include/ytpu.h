@@ -10,7 +10,13 @@
  *  - YInput supports yffi's recursive form (value.values / value.map with
  *    a top-level len, built by yinput_json_array/yinput_json_map/
  *    yinput_yarray/yinput_ymap) plus `*_str` extension constructors that
- *    take JSON strings for convenience.
+ *    take JSON strings for convenience. MIGRATION NOTE: the `*_str` forms
+ *    mark themselves with len = UINT32_MAX; a hand-built array/map YInput
+ *    with len = 0 and a non-NULL payload pointer is rejected as ambiguous
+ *    (it could be either an empty recursive array or a mis-built
+ *    JSON-string form). Pass NULL for empty arrays/maps, or build
+ *    string-form inputs with yinput_json_array_str / yinput_json_map_str /
+ *    yinput_yarray_str / yinput_ymap_str.
  *  - YOutput is an opaque handle with youtput_* accessors instead of a
  *    by-value tagged union.
  *  - Binary results come back as YBinary {data,len} released with

@@ -183,6 +183,15 @@ struct Columns {
   std::vector<int64_t> content_start, content_len_bytes;  // payload span
   // delete set rows
   std::vector<int64_t> del_client, del_start, del_end;
+  // wire-section counts (header values, not emitted-row counts): the
+  // device decoder's step budget and header guard must cover sections
+  // that emit zero rows (covered Skip runs, empty ds-client sections)
+  int64_t n_client_sections = 0;
+  int64_t n_ds_sections = 0;
+  // item blocks with zero CRDT length, dropped from the columns
+  // (update.rs:737-742) but still present on the wire: the device
+  // decoder spends parse steps on them, so budgets must count them
+  int64_t n_zero_len_blocks = 0;
   int error = 0;
 };
 
@@ -281,6 +290,7 @@ Columns* decode_update(const uint8_t* data, size_t n) {
   auto* out = new Columns();
   Cursor c{data, n, 0, false};
   uint64_t n_clients = c.var_uint();
+  out->n_client_sections = (int64_t)n_clients;
   for (uint64_t ci = 0; ci < n_clients && !c.error; ci++) {
     uint64_t n_blocks = c.var_uint();
     uint64_t client = c.var_uint();
@@ -358,6 +368,7 @@ Columns* decode_update(const uint8_t* data, size_t n) {
       int64_t crdt_len = read_content(c, info, *out);
       if (crdt_len == 0) {
         // historical empty blocks have no effect (parity: update.rs:737-742)
+        out->n_zero_len_blocks++;
         out->client.pop_back();
         out->clock.pop_back();
         out->kind.pop_back();
@@ -383,6 +394,7 @@ Columns* decode_update(const uint8_t* data, size_t n) {
   // delete set
   if (!c.error) {
     uint64_t ds_clients = c.var_uint();
+    out->n_ds_sections = (int64_t)ds_clients;
     for (uint64_t i = 0; i < ds_clients && !c.error; i++) {
       uint64_t client = c.var_uint();
       uint64_t n_ranges = c.var_uint();
@@ -417,6 +429,18 @@ size_t ytpu_columns_n_blocks(void* handle) {
 
 size_t ytpu_columns_n_dels(void* handle) {
   return static_cast<Columns*>(handle)->del_client.size();
+}
+
+size_t ytpu_columns_n_client_sections(void* handle) {
+  return (size_t)static_cast<Columns*>(handle)->n_client_sections;
+}
+
+size_t ytpu_columns_n_ds_sections(void* handle) {
+  return (size_t)static_cast<Columns*>(handle)->n_ds_sections;
+}
+
+size_t ytpu_columns_n_zero_len_blocks(void* handle) {
+  return (size_t)static_cast<Columns*>(handle)->n_zero_len_blocks;
 }
 
 // column accessors: return pointers into the Columns arrays

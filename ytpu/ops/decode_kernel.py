@@ -60,6 +60,8 @@ from ytpu.models.batch_doc import UpdateBatch
 __all__ = [
     "pack_updates",
     "decode_updates_v1",
+    "default_steps",
+    "exact_steps",
     "identity_rank",
     "utf8_slice_u16",
     "RawPayloadView",
@@ -152,6 +154,27 @@ def default_steps(max_rows: int, max_dels: int) -> int:
     return 4 + 13 * max_rows + 4 * max_dels
 
 
+def exact_steps(
+    n_client_sections: int,
+    n_item_blocks: int,
+    n_skip_gc_blocks: int,
+    n_ds_sections: int,
+    n_del_ranges: int,
+) -> int:
+    """Step budget for one update whose wire-section counts are known
+    (native pre-scan): item blocks cost ≤ 10 fields, GC/Skip blocks 2,
+    each client section 3 (n_blocks/client/clock), each ds section 2
+    (client/n_ranges), each range 2 (clock/len), + 2 frame headers."""
+    return (
+        2
+        + 3 * n_client_sections
+        + 10 * n_item_blocks
+        + 2 * n_skip_gc_blocks
+        + 2 * n_ds_sections
+        + 2 * n_del_ranges
+    )
+
+
 def decode_updates_v1(
     buf: jax.Array,
     lens: jax.Array,
@@ -159,6 +182,7 @@ def decode_updates_v1(
     max_dels: int,
     n_steps: Optional[int] = None,
     client_table: Optional[Tuple[jax.Array, jax.Array]] = None,
+    max_sections: Optional[int] = None,
 ) -> Tuple[UpdateBatch, jax.Array]:
     """Decode S updates into an ``[S, U] / [S, R]`` UpdateBatch stream.
 
@@ -171,10 +195,19 @@ def decode_updates_v1(
     [j]``), so decoded streams can mix with host-encoded batches that use
     a `ClientInterner`. Lanes mentioning an id outside the table flag
     ``FLAG_UNKNOWN_CLIENT`` (host fallback interns it for the next step).
+
+    ``max_sections`` bounds the client-section header (default ``max_rows
+    + 1``). Wire-legal updates can carry more sections than emitted rows
+    (e.g. sections holding only already-covered Skip runs); callers that
+    pre-scan the wire (native columns) pass the real count so such
+    updates don't trip the garbage-header guard. Pair it with an
+    ``n_steps`` budget that covers the extra section fields
+    (`exact_steps`).
     """
     S, L = buf.shape
     U, R = max_rows, max_dels
     T = n_steps or default_steps(U, R)
+    max_sec = max_sections if max_sections is not None else U + 1
     b = buf.astype(I32)
     lens = lens.astype(I32)
 
@@ -292,7 +325,7 @@ def decode_updates_v1(
             # under the pos_after bound; no real payload exceeds its buffer
             | ((is_str_skip | is_str) & (v > L))
             | (ovf & ~is_info & ~is_client_st)
-            | ((st == ST_NCLIENTS) & (v > U + 1))  # absurd header: garbage
+            | ((st == ST_NCLIENTS) & (v > max_sec))  # absurd header: garbage
         )
         act = active & ~bad & ~big_client
 
