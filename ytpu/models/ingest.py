@@ -33,7 +33,7 @@ from ytpu.models.batch_doc import (
     apply_update_batch,
     init_state,
 )
-from ytpu.ops.decode_kernel import ChunkedWirePayloads, exact_steps
+from ytpu.ops.decode_kernel import ChunkedWirePayloads, steps_for_columns
 
 __all__ = ["BatchIngestor"]
 
@@ -275,14 +275,10 @@ class BatchIngestor:
                 deltas = fast_sv_deltas[d] = {}
                 rows_here = 0
                 str_here = 0
-                n_skip_gc = 0
                 for i in range(cols.n_blocks):
                     kind = int(cols.kind[i])
                     if kind == 10:
-                        n_skip_gc += 1
                         continue
-                    if kind == 0:
-                        n_skip_gc += 1
                     if kind == 4 and int(cols.length[i]) > 0:
                         str_here += 1
                     c = int(cols.client[i])
@@ -303,18 +299,7 @@ class BatchIngestor:
                 max_fast_rows = max(max_fast_rows, rows_here)
                 max_fast_dels = max(max_fast_dels, cols.n_dels)
                 max_sections = max(max_sections, cols.n_client_sections)
-                max_steps = max(
-                    max_steps,
-                    exact_steps(
-                        cols.n_client_sections,
-                        # zero-length blocks are dropped from the columns
-                        # but still cost parse steps on device
-                        cols.n_blocks - n_skip_gc + cols.n_zero_len_blocks,
-                        n_skip_gc,
-                        cols.n_ds_sections,
-                        cols.n_dels,
-                    ),
-                )
+                max_steps = max(max_steps, steps_for_columns(cols))
             else:
                 slow_updates[d] = Update.decode_v1(p)
         self.fast_docs += len(fast_idx)

@@ -1,0 +1,371 @@
+"""Full-trace fused replay: long update streams on a doc batch, with
+capacity growth and commit-style compaction in the loop.
+
+This is the north-star B4 workload (BASELINE.md config #2) at full length:
+the round-1 bench replayed a 600-op prefix into a fixed-capacity state;
+this driver sustains the whole 259,778-op editing trace (or any V1 update
+stream) by running the engine the way a long-lived server would:
+
+- the stream is decoded on device in chunks (`decode_updates_v1`) and
+  integrated by the fused Pallas kernel (`integrate_kernel._run`), with
+  the state kept in the kernel's packed [NC, D, C] layout between chunks
+  (no per-chunk pack/unpack);
+- string content is addressed by **global UTF-16 unit offsets** (a host
+  pre-scan over the native columns assigns them), so sequential typing
+  runs from different updates are byte-adjacent in a virtual content
+  arena and `compact_packed(unit_refs=True)` re-merges them the way the
+  reference's `try_squash` concatenates strings (block.rs:775-799);
+- tombstones collapse to origin-free GC ranges
+  (`compact_packed(gc_ranges=True)`), the reference's default-GC behavior
+  (gc.rs, block_store.rs:155-235);
+- compaction fires at a high-water mark, and when even the compacted
+  state approaches capacity the state grows in place (`grow_packed`) —
+  host-driven, exactly like a server reacting to tenant growth.
+
+Host work per update is bounded and small: the native columnar pre-scan
+(the same control plane the ingest fast lane uses) plus a memcpy into the
+padded chunk buffer. Decode, integrate, squash, and GC all run on device.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["ReplayPlan", "UnitArenaView", "plan_replay", "FusedReplay"]
+
+
+@dataclass
+class ReplayPlan:
+    """Host pre-scan of an update stream (native columns, one pass)."""
+
+    n_updates: int
+    max_rows: int  # U bucket
+    max_dels: int  # R bucket
+    max_len: int  # longest update in bytes
+    max_steps: int  # decode step budget
+    max_sections: int
+    # per (update, row-slot): absolute UTF-16 unit offset of the row's
+    # string content (-1 for non-string rows), assigned in wire order
+    unit_refs: np.ndarray  # [S, U] i32
+    # unit -> byte-start of its character within `arena` (both units of a
+    # surrogate pair share the char start); sentinel entry = len(arena)
+    unit_byte: np.ndarray  # [total_units + 1] i64
+    arena: bytes  # concatenated string payload bytes (UTF-8)
+    # worst-case state rows each update can add (rows x 3 for the row +
+    # two splits, delete ranges x 2 splits) — drives the high-water check
+    adds: np.ndarray = None  # [S] i32
+
+
+def plan_replay(payloads: List[bytes]) -> ReplayPlan:
+    from ytpu.native import decode_update_columns
+    from ytpu.ops.decode_kernel import steps_for_columns
+
+    S = len(payloads)
+    max_rows = max_dels = max_len = max_steps = max_sections = 0
+    adds = np.zeros(S, dtype=np.int32)
+    rows_per: List[List[int]] = []
+    arena_parts: List[bytes] = []
+    unit_byte: List[int] = []
+    total_bytes = 0
+    for p in payloads:
+        cols = decode_update_columns(p)
+        if cols is None:
+            raise RuntimeError("native codec unavailable (required for plan)")
+        if cols.error:
+            raise ValueError("malformed update in stream")
+        max_len = max(max_len, len(p))
+        max_sections = max(max_sections, cols.n_client_sections)
+        refs_here: List[int] = []
+        for i in range(cols.n_blocks):
+            kind = int(cols.kind[i])
+            if kind == 10:
+                continue
+            if int(cols.length[i]) <= 0:
+                continue
+            if kind == 4:
+                # strip the varint length prefix from the content span
+                span = cols.content_bytes(i)
+                j, blen, shift = 0, 0, 0
+                while True:
+                    b = span[j]
+                    blen |= (b & 0x7F) << shift
+                    shift += 7
+                    j += 1
+                    if b < 0x80:
+                        break
+                sbytes = span[j : j + blen]
+                refs_here.append(len(unit_byte))
+                # per-unit char starts (surrogate pairs take two entries)
+                k = 0
+                while k < len(sbytes):
+                    b0 = sbytes[k]
+                    w = 1 if b0 < 0x80 else 2 if b0 < 0xE0 else 3 if b0 < 0xF0 else 4
+                    unit_byte.append(total_bytes + k)
+                    if w == 4:
+                        unit_byte.append(total_bytes + k)
+                    k += w
+                arena_parts.append(sbytes)
+                total_bytes += len(sbytes)
+            else:
+                refs_here.append(-1)
+        rows_per.append(refs_here)
+        adds[len(rows_per) - 1] = 3 * len(refs_here) + 2 * cols.n_dels
+        max_rows = max(max_rows, len(refs_here))
+        max_dels = max(max_dels, cols.n_dels)
+        max_steps = max(max_steps, steps_for_columns(cols))
+    U = max(1, max_rows)
+    refs = np.full((S, U), -1, dtype=np.int32)
+    for s, rr in enumerate(rows_per):
+        for u, r in enumerate(rr):
+            refs[s, u] = r
+    unit_byte.append(total_bytes)
+    return ReplayPlan(
+        n_updates=S,
+        max_rows=U,
+        max_dels=max(1, max_dels),
+        max_len=max_len,
+        max_steps=max_steps,
+        max_sections=max(1, max_sections),
+        unit_refs=refs,
+        unit_byte=np.asarray(unit_byte, dtype=np.int64),
+        arena=b"".join(arena_parts),
+        adds=adds,
+    )
+
+
+class UnitArenaView:
+    """PayloadStore-shaped resolver over unit-addressed arena content.
+
+    Rows carry ``ref`` = absolute UTF-16 unit offset of their content
+    start and ``off``/``len`` in units; splits that land inside a
+    surrogate pair render U+FFFD halves, matching the host's
+    `split_str_utf16` (content.py)."""
+
+    def __init__(self, unit_byte: np.ndarray, arena: bytes):
+        self.unit_byte = unit_byte
+        self.arena = arena
+
+    def _is_second_half(self, u: int) -> bool:
+        return u > 0 and self.unit_byte[u] == self.unit_byte[u - 1] and (
+            u >= len(self.unit_byte) - 1 or self.unit_byte[u + 1] != self.unit_byte[u]
+        )
+
+    def slice_text(self, ref: int, off: int, length: int) -> str:
+        p = int(ref) + int(off)
+        q = p + int(length)
+        if length <= 0:
+            return ""
+        prefix = suffix = ""
+        if self._is_second_half(p):
+            prefix = "�"
+            p += 1
+        end_mid = q < len(self.unit_byte) - 1 and self._is_second_half(q)
+        b0 = int(self.unit_byte[p])
+        b1 = int(self.unit_byte[q])
+        if end_mid:
+            suffix = "�"
+        return prefix + self.arena[b0:b1].decode("utf-8") + suffix
+
+    def slice_values(self, ref: int, off: int, length: int) -> list:
+        return list(self.slice_text(ref, off, length))
+
+
+@dataclass
+class ReplayStats:
+    chunks: int = 0
+    compactions: int = 0
+    growths: int = 0
+    capacity: int = 0
+    peak_blocks: int = 0
+    final_blocks: int = 0
+    chunk_seconds: List[float] = field(default_factory=list)
+
+
+class FusedReplay:
+    """Chunked fused replay of one shared update stream over a doc batch.
+
+    Capacity management: after each chunk the high-water block count is
+    read back; if the next chunk might not fit, the state compacts
+    (`compact_packed`), and if compaction alone can't make room it grows
+    (`grow_packed`). `margin` is the worst-case rows a chunk can add
+    (rows + 2 splits per delete range)."""
+
+    def __init__(
+        self,
+        n_docs: int,
+        plan: ReplayPlan,
+        capacity: int = 4096,
+        max_capacity: int = 1 << 17,
+        d_block: int = 8,
+        chunk: int = 8192,
+        interpret: bool = False,
+    ):
+        import jax.numpy as jnp
+
+        from ytpu.models.batch_doc import init_state
+        from ytpu.ops.integrate_kernel import pack_state
+
+        self.plan = plan
+        self.n_docs = n_docs
+        self.d_block = d_block
+        self.chunk = chunk
+        self.interpret = interpret
+        self.max_capacity = max_capacity
+        self.cols, self.meta = pack_state(init_state(n_docs, capacity))
+        self.stats = ReplayStats(capacity=capacity)
+        self._jnp = jnp
+
+    def _capacity(self) -> int:
+        return self.cols.shape[2]
+
+    def run(self, payloads: List[bytes], client_rank=None) -> ReplayStats:
+        import jax
+        import jax.numpy as jnp
+
+        from ytpu.ops.compaction import compact_packed, grow_packed
+        from ytpu.ops.decode_kernel import (
+            FLAG_ERRORS,
+            decode_updates_v1,
+            identity_rank,
+            pack_updates,
+        )
+        from ytpu.ops.integrate_kernel import _run
+
+        from ytpu.ops.integrate_kernel import M_ERROR, M_NBLOCKS
+
+        plan = self.plan
+        rank = client_rank if client_rank is not None else identity_rank(256)
+        decode = jax.jit(
+            partial(
+                decode_updates_v1,
+                max_rows=plan.max_rows,
+                max_dels=plan.max_dels,
+                n_steps=plan.max_steps,
+                max_sections=plan.max_sections,
+            )
+        )
+        S = len(payloads)
+        pos = 0
+        hi = 0  # high-water block count from the previous chunk's readback
+        while pos < S:
+            t0 = time.perf_counter()
+            end = min(pos + self.chunk, S)
+            # worst-case state rows this chunk can add: compact/grow BEFORE
+            # integrating so ERR_CAPACITY (which corrupts the tile) cannot
+            # fire mid-chunk
+            margin = int(plan.adds[pos:end].sum()) + 8
+            if hi + margin > self._capacity():
+                self.cols, self.meta = compact_packed(
+                    self.cols, self.meta, unit_refs=True, gc_ranges=True
+                )
+                self.stats.compactions += 1
+                hi = int(np.asarray(self.meta)[:, M_NBLOCKS].max())
+                while hi + margin > self._capacity():
+                    new_cap = min(self._capacity() * 2, self.max_capacity)
+                    if new_cap == self._capacity():
+                        raise RuntimeError(
+                            f"state full at max capacity {new_cap}"
+                        )
+                    self.cols, self.meta = grow_packed(
+                        self.cols, self.meta, new_cap
+                    )
+                    self.stats.growths += 1
+            batch = payloads[pos:end]
+            if len(batch) < self.chunk:
+                batch = batch + [b"\x00\x00"] * (self.chunk - len(batch))
+            buf, lens = pack_updates(batch, pad_to=plan.max_len + 16)
+            stream, flags = decode(jnp.asarray(buf), jnp.asarray(lens))
+            # rebase string refs onto global arena unit offsets
+            refs_np = plan.unit_refs[pos:end]
+            if refs_np.shape[0] < self.chunk:
+                refs_np = np.pad(
+                    refs_np,
+                    ((0, self.chunk - refs_np.shape[0]), (0, 0)),
+                    constant_values=-1,
+                )
+            refs_c = jnp.asarray(refs_np)
+            stream = stream._replace(
+                content_ref=jnp.where(refs_c >= 0, refs_c, stream.content_ref)
+            )
+            f = np.asarray(flags)
+            if (f[: end - pos] & FLAG_ERRORS).any():
+                raise RuntimeError(
+                    f"device decode flagged updates in chunk at {pos}: "
+                    f"{f[f != 0][:8]}"
+                )
+            from ytpu.ops.integrate_kernel import pack_stream
+
+            rows, dels = pack_stream(stream)
+            self.cols, self.meta = _run(
+                self.cols,
+                self.meta,
+                (rows, dels, rank),
+                self.d_block,
+                self.interpret,
+            )
+            # high-water check (forces the step to complete: the readback
+            # doubles as the per-chunk latency fence)
+            meta_np = np.asarray(self.meta)
+            if (meta_np[:, M_ERROR] != 0).any():
+                raise RuntimeError(
+                    f"device error flags "
+                    f"{meta_np[meta_np[:, M_ERROR] != 0][:4]}"
+                )
+            hi = int(meta_np[:, M_NBLOCKS].max())
+            self.stats.peak_blocks = max(self.stats.peak_blocks, hi)
+            self.stats.chunk_seconds.append(time.perf_counter() - t0)
+            self.stats.chunks += 1
+            pos = end
+        self.stats.capacity = self._capacity()
+        self.stats.final_blocks = int(np.asarray(self.meta)[:, M_NBLOCKS].max())
+        return self.stats
+
+    def compact(self) -> int:
+        """Force a commit-style compaction; returns the high-water block
+        count afterwards."""
+        from ytpu.ops.compaction import compact_packed
+        from ytpu.ops.integrate_kernel import M_NBLOCKS
+
+        self.cols, self.meta = compact_packed(
+            self.cols, self.meta, unit_refs=True, gc_ranges=True
+        )
+        self.stats.compactions += 1
+        return int(np.asarray(self.meta)[:, M_NBLOCKS].max())
+
+    def get_string(self, doc: int) -> str:
+        """Final text of one doc slot (host walk over the readback rows)."""
+        from ytpu.ops.integrate_kernel import (
+            CN,
+            DL,
+            LN,
+            M_NBLOCKS,
+            M_START,
+            OF,
+            RF,
+            RT,
+        )
+
+        cols = np.asarray(self.cols[:, doc, :])
+        meta = np.asarray(self.meta[doc])
+        view = UnitArenaView(self.plan.unit_byte, self.plan.arena)
+        out: List[str] = []
+        i = int(meta[M_START])
+        hops = 0
+        limit = int(meta[M_NBLOCKS]) + 2
+        while i >= 0 and hops <= limit:
+            if cols[DL, i] == 0 and cols[CN, i] == 1 and cols[RF, i] >= 0:
+                out.append(
+                    view.slice_text(
+                        int(cols[RF, i]), int(cols[OF, i]), int(cols[LN, i])
+                    )
+                )
+            i = int(cols[RT, i])
+            hops += 1
+        if hops > limit:
+            raise RuntimeError("cycle in sequence links")
+        return "".join(out)

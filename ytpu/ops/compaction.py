@@ -46,7 +46,7 @@ from ytpu.core.content import (
 )
 from ytpu.models.batch_doc import COL_DEFAULTS, BlockCols, DocStateBatch
 
-__all__ = ["compact_state", "grow_state"]
+__all__ = ["compact_state", "grow_state", "compact_packed", "grow_packed"]
 
 I32 = jnp.int32
 
@@ -191,6 +191,196 @@ def compact_state(state: DocStateBatch) -> DocStateBatch:
     near capacity, so holding two copies of the block columns would double
     HBM at the worst possible moment."""
     return jax.vmap(_compact_one)(state)
+
+
+def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
+    """Squash + GC one doc in the fused kernel's packed domain.
+
+    `cols` is the kernel's [NC, C] column stack (root-sequence domain: no
+    key/parent/move linkage by construction), `meta` its [M_PAD] row.
+
+    Two rules beyond `_compact_one`:
+    - `gc_ranges`: tombstones become origin-free BLOCK_GC ranges and merge
+      under clock contiguity + sequence adjacency alone — the reference's
+      default-GC behavior (gc.rs:11-65 drops the item wholesale;
+      squash_left_range_compaction block_store.rs:155-235 collapses runs),
+      vs the softer skip_gc-style CONTENT_DELETED conversion.
+    - `unit_refs`: string content refs are absolute UTF-16-unit offsets
+      into a content arena, so runs from *different* updates merge when
+      `b.ref + b.off == a.ref + a.off + a.len` — the device equivalent of
+      the reference's string concat in try_squash (block.rs:775-799).
+    """
+    from ytpu.ops.integrate_kernel import (
+        CK,
+        CL,
+        CN,
+        DL,
+        KD,
+        LN,
+        LT,
+        M_NBLOCKS,
+        M_START,
+        OC,
+        OF,
+        OK,
+        RC,
+        RF,
+        RK,
+        RT,
+    )
+
+    C = cols.shape[1]
+    slots = jnp.arange(C, dtype=I32)
+    n = meta[M_NBLOCKS]
+    active = slots < n
+
+    deleted = cols[DL] == 1
+    if gc_ranges:
+        convert = active & deleted & (cols[KD] != BLOCK_GC)
+    else:
+        gcable = jnp.zeros((C,), bool)
+        for k in _GCABLE:
+            gcable = gcable | (cols[KD] == k)
+        convert = active & deleted & gcable
+    new_kind = I32(BLOCK_GC) if gc_ranges else I32(CONTENT_DELETED)
+    kind = jnp.where(convert, new_kind, cols[KD])
+    rf = jnp.where(convert, -1, cols[RF])
+    of = jnp.where(convert, 0, cols[OF])
+    oc = jnp.where(convert & gc_ranges, -1, cols[OC])
+    ok = jnp.where(convert & gc_ranges, 0, cols[OK])
+    rc = jnp.where(convert & gc_ranges, -1, cols[RC])
+    rk = jnp.where(convert & gc_ranges, 0, cols[RK])
+
+    cl, ck, ln, lt, rt = cols[CL], cols[CK], cols[LN], cols[LT], cols[RT]
+
+    # --- squash eligibility a -> b = right[a] ------------------------------
+    b = rt
+    sb = jnp.maximum(b, 0)
+
+    def g(col):
+        return col[sb]
+
+    base = (
+        active
+        & (b >= 0)
+        & (b < n)
+        & (cl == g(cl))
+        & (g(ck) == ck + ln)
+        & (g(lt) == slots)
+        & (deleted == g(deleted))
+    )
+    gcish = kind == BLOCK_GC
+    gc_merge = base & gcish & g(gcish)
+
+    origin_chain = (g(oc) == cl) & (g(ok) == ck + ln - 1)
+    ror_eq = (rc == g(rc)) & ((rc < 0) | (rk == g(rk)))
+    if unit_refs:
+        content_contig = (g(rf) >= 0) & (rf >= 0) & (
+            g(rf) + g(of) == rf + of + ln
+        )
+    else:
+        content_contig = (rf == g(rf)) & (g(of) == of + ln)
+    spliceable = jnp.zeros((C,), bool)
+    for k in _SPLICEABLE:
+        spliceable = spliceable | (kind == k)
+    live_merge = (
+        base
+        & ~deleted
+        & spliceable
+        & (kind == g(kind))
+        & origin_chain
+        & ror_eq
+        & content_contig
+    )
+    dead_merge = (
+        base
+        & (kind == CONTENT_DELETED)
+        & (g(kind) == CONTENT_DELETED)
+        & origin_chain
+        & ror_eq
+    )
+    elig = gc_merge | live_merge | dead_merge
+
+    sl = jnp.maximum(lt, 0)
+    merged_away = active & (lt >= 0) & elig[sl]
+
+    rep = jnp.where(merged_away, lt, slots)
+    for _ in range(max(1, C.bit_length())):
+        rep = rep[jnp.maximum(rep, 0)]
+
+    seg_len = jax.ops.segment_sum(
+        jnp.where(active, ln, 0), jnp.maximum(rep, 0), num_segments=C
+    )
+    tail = active & ~elig
+    tail_w = jnp.where(tail, rep, C)
+    chain_right = jnp.full((C,), -1, I32).at[tail_w].set(rt, mode="drop")
+
+    keep = active & ~merged_away
+    length = jnp.where(keep, seg_len, ln)
+    right = jnp.where(keep, chain_right, rt)
+
+    # --- defragment --------------------------------------------------------
+    new_idx = jnp.cumsum(keep.astype(I32)) - 1
+    old2new = jnp.where(keep, new_idx, new_idx[jnp.maximum(rep, 0)])
+
+    def remap(col):
+        return jnp.where(col >= 0, old2new[jnp.maximum(col, 0)], -1)
+
+    n_new = jnp.sum(keep.astype(I32))
+    order = jnp.argsort(jnp.where(keep, slots, C + slots))
+    blank = slots >= n_new
+
+    def pack(col, fill):
+        return jnp.where(blank, fill, col[order])
+
+    out = jnp.stack(
+        [
+            pack(cl, -1),  # CL
+            pack(ck, 0),  # CK
+            pack(length, 0),  # LN
+            pack(oc, -1),  # OC
+            pack(ok, 0),  # OK
+            pack(rc, -1),  # RC
+            pack(rk, 0),  # RK
+            pack(remap(lt), -1),  # LT
+            pack(remap(right), -1),  # RT
+            pack(cols[DL], 0),  # DL
+            pack(jnp.where(convert, 0, cols[CN]), 0),  # CN
+            pack(kind, 0),  # KD
+            pack(rf, -1),  # RF
+            pack(of, 0),  # OF
+        ]
+    )
+    start = meta[M_START]
+    start = jnp.where(start >= 0, old2new[jnp.maximum(start, 0)], -1)
+    meta = meta.at[M_START].set(start).at[M_NBLOCKS].set(n_new)
+    return out, meta
+
+
+@partial(jax.jit, static_argnums=(2, 3), donate_argnums=(0, 1))
+def compact_packed(cols, meta, unit_refs: bool = False, gc_ranges: bool = False):
+    """Squash + GC + defragment a packed [NC, D, C] state (fused-kernel
+    domain) without materializing the 25-column unpacked schema — the
+    full-trace replay compacts at high-water marks where holding both
+    layouts would double HBM."""
+    f = partial(_compact_packed_one, unit_refs=unit_refs, gc_ranges=gc_ranges)
+    return jax.vmap(f, in_axes=(1, 0), out_axes=(1, 0))(cols, meta)
+
+
+def grow_packed(cols, meta, new_capacity: int):
+    """Widen a packed state's capacity (slot indices survive unchanged)."""
+    from ytpu.ops.integrate_kernel import CL, OC, RC, LT, RT, RF
+
+    NC_, D, C = cols.shape
+    if new_capacity < C:
+        raise ValueError(f"cannot shrink capacity {C} -> {new_capacity}")
+    if new_capacity == C:
+        return cols, meta
+    pad = jnp.zeros((NC_, D, new_capacity - C), I32)
+    # -1-filled columns: client/origin/ror clients, links, content ref
+    neg = jnp.zeros((NC_,), I32).at[jnp.array([CL, OC, RC, LT, RT, RF])].set(-1)
+    pad = pad + neg[:, None, None]
+    return jnp.concatenate([cols, pad], axis=2), meta
 
 
 def grow_state(state: DocStateBatch, new_capacity: int) -> DocStateBatch:

@@ -62,6 +62,7 @@ __all__ = [
     "decode_updates_v1",
     "default_steps",
     "exact_steps",
+    "steps_for_columns",
     "identity_rank",
     "utf8_slice_u16",
     "RawPayloadView",
@@ -172,6 +173,22 @@ def exact_steps(
         + 2 * n_skip_gc_blocks
         + 2 * n_ds_sections
         + 2 * n_del_ranges
+    )
+
+
+def steps_for_columns(cols) -> int:
+    """Exact decode step budget for one update from its native pre-scan
+    (`ytpu.native.NativeColumns`) — the single cost model shared by the
+    ingest fast lane and the full-trace replay planner."""
+    import numpy as np
+
+    n_skip_gc = int(np.count_nonzero((cols.kind == 10) | (cols.kind == 0)))
+    return exact_steps(
+        cols.n_client_sections,
+        cols.n_blocks - n_skip_gc + cols.n_zero_len_blocks,
+        n_skip_gc,
+        cols.n_ds_sections,
+        cols.n_dels,
     )
 
 
