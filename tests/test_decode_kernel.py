@@ -200,18 +200,37 @@ def test_multi_client_flagged_informational():
     assert int(np.asarray(stream.valid)[0].sum()) == len(rows)
 
 
-def test_unsupported_content_flags_for_host_fallback():
+def test_content_any_scalars_decode_clean():
+    """ContentAny scalar lists decode on device (one step per value)."""
     doc = Doc(client_id=5)
     log = []
     doc.observe_update_v1(lambda p, o, t: log.append(p))
-    arr = doc.get_array("text")  # array content → ContentAny rows
+    arr = doc.get_array("text")
     with doc.transact() as txn:
-        arr.insert_range(txn, 0, [1, 2, 3])
+        arr.insert_range(txn, 0, [1, 2.5, "three", True, None])
+    _, stream, flags = _decode(log, U=4, R=4)
+    assert flags[0] & FLAG_ERRORS == 0
+    valid = np.asarray(stream.valid)[0]
+    assert valid.sum() == 1
+    from ytpu.core.content import CONTENT_ANY
+
+    assert int(np.asarray(stream.kind)[0][valid][0]) == CONTENT_ANY
+    assert int(np.asarray(stream.length)[0][valid][0]) == 5
+
+
+def test_recursive_any_flags_unsupported():
+    """Nested array/map Any values exceed the one-step-per-value model."""
+    doc = Doc(client_id=5)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    arr = doc.get_array("text")
+    with doc.transact() as txn:
+        arr.insert(txn, 0, {"nested": [1, 2]})
     _, _, flags = _decode(log, U=4, R=4)
     assert flags[0] & FLAG_UNSUPPORTED
 
 
-def test_map_parent_sub_flags_for_host_fallback():
+def test_map_parent_sub_without_table_flags_unknown_key():
     doc = Doc(client_id=5)
     log = []
     doc.observe_update_v1(lambda p, o, t: log.append(p))
@@ -219,7 +238,39 @@ def test_map_parent_sub_flags_for_host_fallback():
     with doc.transact() as txn:
         m.insert(txn, "key", "value")
     _, _, flags = _decode(log, U=4, R=4)
-    assert flags[0] & FLAG_UNSUPPORTED
+    from ytpu.ops.decode_kernel import FLAG_UNKNOWN_KEY
+
+    assert flags[0] & FLAG_UNKNOWN_KEY
+
+
+def test_map_parent_sub_with_key_table_decodes():
+    import jax.numpy as jnp
+
+    from ytpu.ops.decode_kernel import key_hash_host, pack_updates
+
+    doc = Doc(client_id=5)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    m = doc.get_map("m")
+    with doc.transact() as txn:
+        m.insert(txn, "title", "hello")
+    buf, lens = pack_updates(log)
+    h = key_hash_host(b"title")
+    stream, flags = decode_updates_v1(
+        jnp.asarray(buf),
+        jnp.asarray(lens),
+        4,
+        4,
+        key_table=(
+            jnp.asarray(np.array([h], dtype=np.int32)),
+            jnp.asarray(np.array([17], dtype=np.int32)),
+        ),
+    )
+    flags = np.asarray(flags)
+    assert flags[0] & FLAG_ERRORS == 0
+    valid = np.asarray(stream.valid)[0]
+    assert valid.sum() == 1
+    assert int(np.asarray(stream.key)[0][valid][0]) == 17
 
 
 def test_big_client_id_flags():

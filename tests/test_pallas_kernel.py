@@ -112,3 +112,108 @@ def test_fused_concurrent_clients():
     xla_state, fused_state = run_both(stream, rank)
     assert_same_state(xla_state, fused_state)
     assert get_string(fused_state, 0, enc.payloads) == expect
+
+
+def test_fused_map_lww_chains():
+    """Map rows (parent_sub key chains) now integrate in-VMEM: per-key LWW
+    with chain anchoring and previous-winner tombstoning (block.rs:637-659)."""
+
+    def ops(doc):
+        m = doc.get_map("m")
+        with doc.transact() as txn:
+            m.insert(txn, "a", "1")
+        with doc.transact() as txn:
+            m.insert(txn, "b", "2")
+        with doc.transact() as txn:
+            m.insert(txn, "a", "3")  # overwrite: previous winner tombstones
+        with doc.transact() as txn:
+            m.remove(txn, "b")
+        with doc.transact() as txn:
+            m.insert(txn, "b", "4")
+
+    stream, rank, enc, _ = build_stream(ops)
+    xla_state, fused_state = run_both(stream, rank)
+    assert_same_state(xla_state, fused_state)
+    assert int(np.asarray(fused_state.error).max()) == 0
+    from ytpu.models.batch_doc import get_map
+
+    got = get_map(fused_state, 0, enc.payloads, enc.keys)
+    assert got == {"a": "3", "b": "4"}
+
+
+def test_fused_nested_branches():
+    """Nested shared types (p_tag == 2 branch-id parents, child-sequence
+    heads on ContentType rows) through the fused kernel."""
+
+    def ops(doc):
+        from ytpu.types.shared import ArrayPrelim, MapPrelim
+
+        m = doc.get_map("m")
+        with doc.transact() as txn:
+            m.insert(txn, "list", ArrayPrelim(["x"]))
+        with doc.transact() as txn:
+            inner = m.get("list")
+            inner.push_back(txn, "y")
+        with doc.transact() as txn:
+            inner = m.get("list")
+            inner.insert(txn, 0, "w")
+        with doc.transact() as txn:
+            m.insert(txn, "meta", MapPrelim({"k": "v"}))
+
+    stream, rank, enc, _ = build_stream(ops)
+    xla_state, fused_state = run_both(stream, rank)
+    assert_same_state(xla_state, fused_state)
+    assert int(np.asarray(fused_state.error).max()) == 0
+
+
+def test_fused_text_in_deleted_parent_and_formats():
+    """Formats (uncountable rows) and writes under a tombstoned nested
+    parent (dead-on-arrival, block.rs:751-765) through the fused kernel."""
+
+    def ops(doc):
+        from ytpu.types.shared import TextPrelim
+
+        m = doc.get_map("m")
+        with doc.transact() as txn:
+            m.insert(txn, "t", TextPrelim("ab"))
+        with doc.transact() as txn:
+            t = m.get("t")
+            t.insert_with_attributes(txn, 1, "B", {"bold": True})
+        with doc.transact() as txn:
+            m.remove(txn, "t")  # tombstone the nested text
+
+    stream, rank, enc, _ = build_stream(ops)
+    xla_state, fused_state = run_both(stream, rank)
+    assert_same_state(xla_state, fused_state)
+    assert int(np.asarray(fused_state.error).max()) == 0
+
+
+def test_fused_concurrent_map_writes_two_clients():
+    """Concurrent same-key writes from two clients: the chain scan + rank
+    tie-break must pick the same winner as the XLA path and host oracle."""
+    a, b = Doc(client_id=5), Doc(client_id=9)
+    log = []
+    a.observe_update_v1(lambda p, o, t: log.append(p))
+    b.observe_update_v1(lambda p, o, t: log.append(p))
+    ma, mb = a.get_map("m"), b.get_map("m")
+    with a.transact() as txn:
+        ma.insert(txn, "k", "from-a")
+    with b.transact() as txn:
+        mb.insert(txn, "k", "from-b")
+    # exchange so both end converged (higher client id wins: lib.rs:427-430)
+    pa, pb = log[0], log[1]
+    b.apply_update_v1(pa)
+    a.apply_update_v1(pb)
+    assert ma.get("k") == mb.get("k")
+
+    enc = BatchEncoder()
+    steps = [enc.build_step(Update.decode_v1(p), 4, 4) for p in (pa, pb)]
+    stream = BatchEncoder.stack_steps(steps)
+    rank = enc.interner.rank_table()
+    xla_state, fused_state = run_both(stream, rank)
+    assert_same_state(xla_state, fused_state)
+    from ytpu.models.batch_doc import get_map
+
+    expect_val = ma.get("k")
+    got = get_map(fused_state, 0, enc.payloads, enc.keys)
+    assert got == {"k": expect_val}

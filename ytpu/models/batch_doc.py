@@ -1045,6 +1045,12 @@ def _encode_device_row(
     ref = int(bl.content_ref[r])
     c_off = int(bl.content_off[r]) + off
     length = int(bl.length[r]) - off
+    from ytpu.core.content import (
+        CONTENT_BINARY,
+        CONTENT_EMBED,
+        CONTENT_JSON,
+    )
+
     if kind == CONTENT_STRING:
         out.write_string(payloads.slice_text(ref, c_off, length))
     elif kind == CONTENT_ANY:
@@ -1053,6 +1059,19 @@ def _encode_device_row(
             out.write_any(v)
     elif kind == CONTENT_DELETED:
         out.write_len(length)
+    elif ref < 0 and kind == CONTENT_FORMAT:
+        fkey, fval = payloads.format_kv(ref)
+        out.write_key(fkey)
+        out.write_json(fval)
+    elif ref < 0 and kind == CONTENT_EMBED:
+        out.write_json(payloads.embed_value(ref))
+    elif ref < 0 and kind == CONTENT_BINARY:
+        out.write_buf(payloads.binary_value(ref))
+    elif ref < 0 and kind == CONTENT_JSON:
+        raw = payloads.json_raw(ref, c_off, length)
+        out.write_len(len(raw))
+        for s in raw:
+            out.write_string(s)
     else:
         # other payload kinds stash the host content object directly
         content = payloads.items[ref][1]
@@ -1149,6 +1168,26 @@ class PayloadStore:
     def slice_values(self, ref: int, off: int, length: int) -> list:
         kind, payload = self.items[ref]
         return payload[off : off + length]
+
+    # kind-specific accessors, shape-compatible with the wire-ref
+    # resolvers (decode_kernel.RawPayloadView / ChunkedWirePayloads)
+
+    def json_values(self, ref: int, off: int, length: int) -> list:
+        kind, payload = self.items[ref]  # a ContentJSON object
+        return payload.values()[off : off + length]
+
+    def json_raw(self, ref: int, off: int, length: int) -> list:
+        return self.items[ref][1].raw[off : off + length]
+
+    def embed_value(self, ref: int):
+        return self.items[ref][1].value  # ContentEmbed
+
+    def binary_value(self, ref: int) -> bytes:
+        return self.items[ref][1].data  # ContentBinary
+
+    def format_kv(self, ref: int):
+        fmt = self.items[ref][1]  # ContentFormat
+        return fmt.key, fmt.value
 
 
 class BatchEncoder:
@@ -1588,19 +1627,19 @@ def get_diff(state: DocStateBatch, doc: int, payloads) -> list:
                 payloads.slice_text(ref, int(bl.content_off[i]), int(bl.length[i]))
             )
         elif kind == CONTENT_FORMAT:
-            fmt = payloads.items[ref][1]
-            if attrs.get(fmt.key) != fmt.value:
+            fkey, fval = payloads.format_kv(ref)
+            if attrs.get(fkey) != fval:
                 flush()
-            if fmt.value is None:
-                attrs.pop(fmt.key, None)
+            if fval is None:
+                attrs.pop(fkey, None)
             else:
-                attrs[fmt.key] = fmt.value
+                attrs[fkey] = fval
         elif kind in (CONTENT_EMBED, CONTENT_TYPE):
             flush()
-            payload = payloads.items[ref][1]
             if kind == CONTENT_EMBED:
-                value = payload.value
+                value = payloads.embed_value(ref)
             else:
+                payload = payloads.items[ref][1]
                 # a user-facing SharedType view, like the host's
                 # out_value -> wrap_branch (the branch is the decoded
                 # wire object: a detached view, not the live host one)
@@ -1730,6 +1769,14 @@ def get_tree(
             return payloads.slice_values(ref, off, ln)
         if kind == CONTENT_TYPE:
             return [render_type(i)]
+        from ytpu.core.content import CONTENT_BINARY, CONTENT_JSON
+
+        if kind == CONTENT_JSON:
+            return payloads.json_values(ref, off, ln)
+        if kind == CONTENT_EMBED:
+            return [payloads.embed_value(ref)]
+        if kind == CONTENT_BINARY:
+            return [payloads.binary_value(ref)]
         if ref >= 0:
             payload = payloads.items[ref][1]
             if hasattr(payload, "values"):

@@ -143,6 +143,13 @@ def load_ingestor(path: str) -> BatchIngestor:
     ing.payloads.total_bytes = side.get("wire_total", 0)
     ing.fast_docs = 0
     ing.slow_docs = 0
+    ing.fast_recoveries = 0
+    ing._last_fast_flags = None
+    # rebuild the device key-hash table from the restored interner
+    ing._key_hashes = {}
+    ing._key_collisions = set()
+    for key in ing.enc.keys.ids:
+        ing._register_key(key)
     return ing
 
 

@@ -37,8 +37,11 @@ from ytpu.ops.decode_kernel import ChunkedWirePayloads, steps_for_columns
 
 __all__ = ["BatchIngestor"]
 
-# content kinds the device decoder handles (GC, Deleted, String, Skip)
-_FAST_KINDS = frozenset((0, 1, 4, 10))
+# content kinds the device decoder handles: GC, Deleted, Json, Binary,
+# String, Embed, Format, Any(scalar), Skip
+_FAST_KINDS = frozenset((0, 1, 2, 3, 4, 5, 6, 8, 10))
+# kinds whose rows keep content refs into the retained wire bytes
+_WIRE_REF_KINDS = frozenset((2, 3, 4, 5, 6, 8))
 _I32_MAX = 2**31 - 1
 
 
@@ -64,6 +67,10 @@ class BatchIngestor:
         self.slow_docs = 0
         self.fast_recoveries = 0  # flagged fast lanes replayed via host lane
         self._last_fast_flags: Optional[np.ndarray] = None
+        # device key hashing (map rows on the fast lane): hash -> key idx;
+        # keys whose hash collides with a different key take the host lane
+        self._key_hashes: Dict[int, int] = {}
+        self._key_collisions: set = set()
 
     # --- introspection (parity: ytransaction_pending_update/_ds shape) -------
 
@@ -190,12 +197,29 @@ class BatchIngestor:
         def cov(c: int) -> int:
             return covered.get(c, sv.get(c))
 
+        if cols.n_complex_any > 0:
+            return False  # recursive Any values: host lane
+        from ytpu.ops.decode_kernel import KEY_HASH_BYTES
+
         for i in range(n):
             kind = int(cols.kind[i])
             if kind not in _FAST_KINDS:
                 return False
-            if int(cols.parent_kind[i]) == 2 or int(cols.parent_sub_start[i]) >= 0:
-                return False  # branch-id parents / map rows: host lane
+            psl = int(cols.parent_sub_len[i])
+            if psl > KEY_HASH_BYTES:
+                return False  # key exceeds the device hash window
+            if psl >= 0:
+                key = cols.parent_sub(i)
+                if not self._register_key(key):
+                    return False  # hash collision: host lane
+            if int(cols.parent_kind[i]) == 2:
+                # nested-branch parent: the ContentType item must already
+                # be covered (the device resolves it by id)
+                pic, pik = int(cols.parent_id_client[i]), int(
+                    cols.parent_id_clock[i]
+                )
+                if pic > _I32_MAX or pik >= cov(pic):
+                    return False
             c = int(cols.client[i])
             ck = int(cols.clock[i])
             ln = int(cols.length[i])
@@ -220,6 +244,37 @@ class BatchIngestor:
             if c > _I32_MAX or int(cols.del_end[i]) > cov(c):
                 return False
         return True
+
+    def _register_key(self, key: str) -> bool:
+        """Intern `key` and record its device hash; False on collision."""
+        from ytpu.ops.decode_kernel import key_hash_host
+
+        if key in self._key_collisions:
+            return False
+        kid = self.enc.keys.intern(key)
+        h = key_hash_host(key.encode("utf-8"))
+        prev = self._key_hashes.get(h)
+        if prev is not None and prev != kid:
+            # two distinct keys share a hash: neither may use the device
+            # table (the resolution would be ambiguous)
+            self._key_collisions.add(key)
+            self._key_collisions.add(self.enc.keys.names[prev])
+            del self._key_hashes[h]
+            return False
+        self._key_hashes[h] = kid
+        return True
+
+    def _key_table(self):
+        """Device key table: (sorted hashes, interned key idx perm)."""
+        import jax.numpy as jnp
+
+        hs = sorted(self._key_hashes)
+        return (
+            jnp.asarray(np.asarray(hs, dtype=np.int32)),
+            jnp.asarray(
+                np.asarray([self._key_hashes[h] for h in hs], dtype=np.int32)
+            ),
+        )
 
     def _client_table(self):
         """Device intern table: (sorted raw ids, perm to interned idx).
@@ -279,7 +334,7 @@ class BatchIngestor:
                     kind = int(cols.kind[i])
                     if kind == 10:
                         continue
-                    if kind == 4 and int(cols.length[i]) > 0:
+                    if kind in _WIRE_REF_KINDS and int(cols.length[i]) > 0:
                         str_here += 1
                     c = int(cols.client[i])
                     self.enc.interner.intern(c)
@@ -423,6 +478,7 @@ class BatchIngestor:
             n_steps=n_steps,
             client_table=self._client_table(),
             max_sections=max_sections,
+            key_table=self._key_table(),
         )
         is_str_ref = stream.valid & (stream.content_ref >= 0)
         lane = jnp.arange(S, dtype=jnp.int32)[:, None]

@@ -49,6 +49,7 @@ class ReplayPlan:
     max_len: int  # longest update in bytes
     max_steps: int  # decode step budget
     max_sections: int
+    max_client: int  # largest raw client id in the stream
     # per (update, row-slot): absolute UTF-16 unit offset of the row's
     # string content (-1 for non-string rows), assigned in wire order
     unit_refs: np.ndarray  # [S, U] i32
@@ -67,6 +68,7 @@ def plan_replay(payloads: List[bytes]) -> ReplayPlan:
 
     S = len(payloads)
     max_rows = max_dels = max_len = max_steps = max_sections = 0
+    max_client = 0
     adds = np.zeros(S, dtype=np.int32)
     rows_per: List[List[int]] = []
     arena_parts: List[bytes] = []
@@ -85,6 +87,16 @@ def plan_replay(payloads: List[bytes]) -> ReplayPlan:
             kind = int(cols.kind[i])
             if kind == 10:
                 continue
+            # the unit-ref arena covers text streams; other content kinds
+            # would leave refs into the transient chunk buffer — reject
+            # loudly rather than corrupt silently
+            if kind not in (0, 1, 4):
+                raise ValueError(
+                    f"replay plan supports text streams only (GC/Deleted/"
+                    f"String); update carries content kind {kind} — use "
+                    "BatchIngestor.apply_bytes for mixed-content streams"
+                )
+            max_client = max(max_client, int(cols.client[i]))
             if int(cols.length[i]) <= 0:
                 continue
             if kind == 4:
@@ -131,6 +143,7 @@ def plan_replay(payloads: List[bytes]) -> ReplayPlan:
         max_len=max_len,
         max_steps=max_steps,
         max_sections=max(1, max_sections),
+        max_client=max_client,
         unit_refs=refs,
         unit_byte=np.asarray(unit_byte, dtype=np.int64),
         arena=b"".join(arena_parts),
@@ -234,12 +247,20 @@ class FusedReplay:
             identity_rank,
             pack_updates,
         )
-        from ytpu.ops.integrate_kernel import _run
-
-        from ytpu.ops.integrate_kernel import M_ERROR, M_NBLOCKS
+        from ytpu.ops.integrate_kernel import M_ERROR, M_NBLOCKS, _run, pack_stream
 
         plan = self.plan
-        rank = client_rank if client_rank is not None else identity_rank(256)
+        if client_rank is None:
+            # raw ids double as ranks only while they fit the identity
+            # table; beyond that the YATA tie-break would silently read
+            # rank 0 for every client
+            if plan.max_client >= 256:
+                raise ValueError(
+                    f"stream contains client id {plan.max_client}; pass an "
+                    "explicit client_rank table"
+                )
+            client_rank = identity_rank(256)
+        rank = client_rank
         decode = jax.jit(
             partial(
                 decode_updates_v1,
@@ -292,14 +313,13 @@ class FusedReplay:
             stream = stream._replace(
                 content_ref=jnp.where(refs_c >= 0, refs_c, stream.content_ref)
             )
-            f = np.asarray(flags)
-            if (f[: end - pos] & FLAG_ERRORS).any():
+            f = np.asarray(flags)[: end - pos] & FLAG_ERRORS
+            if f.any():
+                bad = np.nonzero(f)[0]
                 raise RuntimeError(
-                    f"device decode flagged updates in chunk at {pos}: "
-                    f"{f[f != 0][:8]}"
+                    f"device decode flagged updates "
+                    f"{(pos + bad[:8]).tolist()}: flags {f[bad[:8]].tolist()}"
                 )
-            from ytpu.ops.integrate_kernel import pack_stream
-
             rows, dels = pack_stream(stream)
             self.cols, self.meta = _run(
                 self.cols,

@@ -160,6 +160,10 @@ def load() -> Optional[ctypes.CDLL]:
         lib.ytpu_columns_n_ds_sections.argtypes = [ctypes.c_void_p]
         lib.ytpu_columns_n_zero_len_blocks.restype = ctypes.c_size_t
         lib.ytpu_columns_n_zero_len_blocks.argtypes = [ctypes.c_void_p]
+        lib.ytpu_columns_n_value_steps.restype = ctypes.c_size_t
+        lib.ytpu_columns_n_value_steps.argtypes = [ctypes.c_void_p]
+        lib.ytpu_columns_n_complex_any.restype = ctypes.c_size_t
+        lib.ytpu_columns_n_complex_any.argtypes = [ctypes.c_void_p]
         lib.ytpu_columns_free.argtypes = [ctypes.c_void_p]
         for name in _COLUMNS + _DEL_COLUMNS:
             fn = getattr(lib, f"ytpu_col_{name}")
@@ -206,6 +210,8 @@ class NativeColumns:
         self.n_client_sections = int(lib.ytpu_columns_n_client_sections(handle))
         self.n_ds_sections = int(lib.ytpu_columns_n_ds_sections(handle))
         self.n_zero_len_blocks = int(lib.ytpu_columns_n_zero_len_blocks(handle))
+        self.n_value_steps = int(lib.ytpu_columns_n_value_steps(handle))
+        self.n_complex_any = int(lib.ytpu_columns_n_complex_any(handle))
         import numpy as np
 
         def grab(name: str, count: int):

@@ -145,6 +145,29 @@ struct Cursor {
         return;
     }
   }
+
+  // skip one Any value counting device decode tokens (one step per
+  // scalar or array header; maps/unknown tags report as complex)
+  void skip_any_tokens(int64_t* tokens, int64_t* complex_vals) {
+    if (pos < len) {
+      uint8_t tag = buf[pos];
+      if (tag == 118 || tag < 116) {
+        (*complex_vals)++;
+      } else if (tag == 117) {
+        // array header consumes one token; children count themselves
+        size_t save = pos;
+        pos++;  // tag
+        uint64_t n = var_uint();
+        (*tokens)++;
+        for (uint64_t i = 0; i < n && !error; i++)
+          skip_any_tokens(tokens, complex_vals);
+        (void)save;
+        return;
+      }
+    }
+    (*tokens)++;
+    skip_any();
+  }
 };
 
 // UTF-16 code-unit length of a UTF-8 byte span (the Yjs clock unit).
@@ -192,6 +215,11 @@ struct Columns {
   // (update.rs:737-742) but still present on the wire: the device
   // decoder spends parse steps on them, so budgets must count them
   int64_t n_zero_len_blocks = 0;
+  // extra device decode steps for value-list content (one per Any/Json
+  // value, one per Format key) and the count of Any values the device
+  // cannot parse (recursive map/array tags)
+  int64_t n_value_steps = 0;
+  int64_t n_complex_any = 0;
   int error = 0;
 };
 
@@ -212,6 +240,7 @@ int64_t read_content(Cursor& c, uint8_t info, Columns& out) {
         c.skip((size_t)k);
       }
       crdt_len = (int64_t)n;
+      out.n_value_steps += (int64_t)n;
       break;
     }
     case CONTENT_BINARY: {
@@ -237,6 +266,7 @@ int64_t read_content(Cursor& c, uint8_t info, Columns& out) {
       c.skip((size_t)k);
       uint64_t v = c.var_uint();
       c.skip((size_t)v);
+      out.n_value_steps += 1;  // device: key step + value step
       break;
     }
     case CONTENT_TYPE: {
@@ -257,8 +287,14 @@ int64_t read_content(Cursor& c, uint8_t info, Columns& out) {
     }
     case CONTENT_ANY: {
       uint64_t n = c.var_uint();
-      for (uint64_t i = 0; i < n && !c.error; i++) c.skip_any();
+      int64_t tokens = 0;
+      for (uint64_t i = 0; i < n && !c.error; i++) {
+        // one device step per scalar/array-header token; map values and
+        // unknown tags exceed the device model (complex -> host lane)
+        c.skip_any_tokens(&tokens, &out.n_complex_any);
+      }
       crdt_len = (int64_t)n;
+      out.n_value_steps += tokens;
       break;
     }
     case CONTENT_DOC: {
@@ -441,6 +477,14 @@ size_t ytpu_columns_n_ds_sections(void* handle) {
 
 size_t ytpu_columns_n_zero_len_blocks(void* handle) {
   return (size_t)static_cast<Columns*>(handle)->n_zero_len_blocks;
+}
+
+size_t ytpu_columns_n_value_steps(void* handle) {
+  return (size_t)static_cast<Columns*>(handle)->n_value_steps;
+}
+
+size_t ytpu_columns_n_complex_any(void* handle) {
+  return (size_t)static_cast<Columns*>(handle)->n_complex_any;
 }
 
 // column accessors: return pointers into the Columns arrays

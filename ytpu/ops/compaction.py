@@ -215,7 +215,9 @@ def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
         CL,
         CN,
         DL,
+        HD,
         KD,
+        KEY,
         LN,
         LT,
         M_NBLOCKS,
@@ -223,6 +225,7 @@ def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
         OC,
         OF,
         OK,
+        PA,
         RC,
         RF,
         RK,
@@ -260,6 +263,7 @@ def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
     def g(col):
         return col[sb]
 
+    key_c, pa_c = cols[KEY], cols[PA]
     base = (
         active
         & (b >= 0)
@@ -268,9 +272,14 @@ def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
         & (g(ck) == ck + ln)
         & (g(lt) == slots)
         & (deleted == g(deleted))
+        & (key_c == g(key_c))
+        & (pa_c == g(pa_c))
     )
     gcish = kind == BLOCK_GC
-    gc_merge = base & gcish & g(gcish)
+    # ContentType rows carry live child-sequence heads even when deleted;
+    # never merge them away
+    no_head = (cols[HD] < 0) & (g(cols[HD]) < 0)
+    gc_merge = base & gcish & g(gcish) & no_head
 
     origin_chain = (g(oc) == cl) & (g(ok) == ck + ln - 1)
     ror_eq = (rc == g(rc)) & ((rc < 0) | (rk == g(rk)))
@@ -349,6 +358,9 @@ def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
             pack(kind, 0),  # KD
             pack(rf, -1),  # RF
             pack(of, 0),  # OF
+            pack(key_c, -1),  # KEY
+            pack(remap(pa_c), -1),  # PA
+            pack(remap(cols[HD]), -1),  # HD
         ]
     )
     start = meta[M_START]
@@ -369,7 +381,7 @@ def compact_packed(cols, meta, unit_refs: bool = False, gc_ranges: bool = False)
 
 def grow_packed(cols, meta, new_capacity: int):
     """Widen a packed state's capacity (slot indices survive unchanged)."""
-    from ytpu.ops.integrate_kernel import CL, OC, RC, LT, RT, RF
+    from ytpu.ops.integrate_kernel import CL, HD, KEY, LT, OC, PA, RC, RF, RT
 
     NC_, D, C = cols.shape
     if new_capacity < C:
@@ -378,7 +390,11 @@ def grow_packed(cols, meta, new_capacity: int):
         return cols, meta
     pad = jnp.zeros((NC_, D, new_capacity - C), I32)
     # -1-filled columns: client/origin/ror clients, links, content ref
-    neg = jnp.zeros((NC_,), I32).at[jnp.array([CL, OC, RC, LT, RT, RF])].set(-1)
+    neg = (
+        jnp.zeros((NC_,), I32)
+        .at[jnp.array([CL, OC, RC, LT, RT, RF, KEY, PA, HD])]
+        .set(-1)
+    )
     pad = pad + neg[:, None, None]
     return jnp.concatenate([cols, pad], axis=2), meta
 

@@ -52,7 +52,12 @@ import numpy as np
 from ytpu.core.content import (
     BLOCK_GC,
     BLOCK_SKIP,
+    CONTENT_ANY,
+    CONTENT_BINARY,
     CONTENT_DELETED,
+    CONTENT_EMBED,
+    CONTENT_FORMAT,
+    CONTENT_JSON,
     CONTENT_STRING,
 )
 from ytpu.models.batch_doc import UpdateBatch
@@ -88,6 +93,7 @@ FLAG_MULTI_CLIENT = 16  # informational: >1 client section (wire order may
 #                         origins inside one update; single-client updates —
 #                         the live-editing case — are always ordered)
 FLAG_UNKNOWN_CLIENT = 32  # a client id absent from the supplied intern table
+FLAG_UNKNOWN_KEY = 64  # a parent_sub hash absent from the supplied key table
 
 FLAG_ERRORS = (
     FLAG_UNSUPPORTED
@@ -95,6 +101,7 @@ FLAG_ERRORS = (
     | FLAG_MALFORMED
     | FLAG_BIG_CLIENT
     | FLAG_UNKNOWN_CLIENT
+    | FLAG_UNKNOWN_KEY
 )
 
 # --- parser states -----------------------------------------------------------
@@ -122,9 +129,19 @@ FLAG_ERRORS = (
     ST_DS_NRANGES,
     ST_DS_CLOCK,
     ST_DS_LEN,
+    ST_ANY_COUNT,  # ContentAny: value count
+    ST_ANY_VAL,  # ContentAny: one scalar value per step
+    ST_JSON_COUNT,  # ContentJson: string count
+    ST_JSON_VAL,  # ContentJson: one length-prefixed string per step
+    ST_SPAN1,  # ContentEmbed/Binary: one length-prefixed span, len 1
+    ST_FMT_KEY,  # ContentFormat: key string
+    ST_FMT_VAL,  # ContentFormat: one Any value
     ST_DONE,
     ST_ERR,
-) = range(25)
+) = range(32)
+
+# key-hash window: parent_sub keys longer than this take the host lane
+KEY_HASH_BYTES = 32
 
 _PAD = 16  # gather guard past the longest update
 
@@ -151,8 +168,19 @@ def identity_rank(k: int) -> jax.Array:
 
 def default_steps(max_rows: int, max_dels: int) -> int:
     """Safe iteration budget: fields per block ≤ 10 (+3/client header),
-    2 per delete range (+2/ds client), +4 frame fields."""
+    2 per delete range (+2/ds client), +4 frame fields. Covers scalar
+    content only — value-list content (Any/Json) costs one extra step per
+    value; callers with a native pre-scan pass an exact ``n_steps``."""
     return 4 + 13 * max_rows + 4 * max_dels
+
+
+def key_hash_host(key: bytes) -> int:
+    """The device key hash, host side (must match the kernel's mixing)."""
+    h = 0
+    for i, byte in enumerate(key[:KEY_HASH_BYTES]):
+        h = (h + byte * pow(31, i, 1 << 32)) & 0xFFFFFFFF
+    h ^= (len(key) * 2654435761) & 0xFFFFFFFF
+    return h & 0x7FFFFFFF
 
 
 def exact_steps(
@@ -161,11 +189,14 @@ def exact_steps(
     n_skip_gc_blocks: int,
     n_ds_sections: int,
     n_del_ranges: int,
+    n_value_steps: int = 0,
 ) -> int:
     """Step budget for one update whose wire-section counts are known
     (native pre-scan): item blocks cost ≤ 10 fields, GC/Skip blocks 2,
     each client section 3 (n_blocks/client/clock), each ds section 2
-    (client/n_ranges), each range 2 (clock/len), + 2 frame headers."""
+    (client/n_ranges), each range 2 (clock/len), + 2 frame headers.
+    ``n_value_steps`` covers value-list content: one step per Any/Json
+    value plus one for a Format key."""
     return (
         2
         + 3 * n_client_sections
@@ -173,6 +204,7 @@ def exact_steps(
         + 2 * n_skip_gc_blocks
         + 2 * n_ds_sections
         + 2 * n_del_ranges
+        + n_value_steps
     )
 
 
@@ -189,6 +221,7 @@ def steps_for_columns(cols) -> int:
         n_skip_gc,
         cols.n_ds_sections,
         cols.n_dels,
+        getattr(cols, "n_value_steps", 0),
     )
 
 
@@ -200,6 +233,7 @@ def decode_updates_v1(
     n_steps: Optional[int] = None,
     client_table: Optional[Tuple[jax.Array, jax.Array]] = None,
     max_sections: Optional[int] = None,
+    key_table: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[UpdateBatch, jax.Array]:
     """Decode S updates into an ``[S, U] / [S, R]`` UpdateBatch stream.
 
@@ -212,6 +246,13 @@ def decode_updates_v1(
     [j]``), so decoded streams can mix with host-encoded batches that use
     a `ClientInterner`. Lanes mentioning an id outside the table flag
     ``FLAG_UNKNOWN_CLIENT`` (host fallback interns it for the next step).
+
+    ``key_table=(sorted_hashes, perm)`` maps parent_sub key hashes (see
+    `key_hash_host`) to interned key indices, enabling map rows on
+    device; the host pre-scan guarantees every key in the step is in the
+    table and collision-free (collisions route to the host lane). Lanes
+    with a map row but no table — or a hash miss — flag
+    ``FLAG_UNKNOWN_KEY``.
 
     ``max_sections`` bounds the client-section header (default ``max_rows
     + 1``). Wire-legal updates can carry more sections than emitted rows
@@ -270,6 +311,10 @@ def decode_updates_v1(
             ds_clock=jnp.zeros((S,), I32),
             n_rows=jnp.zeros((S,), I32),
             n_dels=jnp.zeros((S,), I32),
+            keyh=jnp.full((S,), -1, I32),  # parent_sub hash (-1 = none)
+            vals_left=jnp.zeros((S,), I32),  # Any/Json values remaining
+            vals_n=jnp.zeros((S,), I32),  # total value count (clock len)
+            cref=jnp.full((S,), -1, I32),  # content span start byte
         )
         rows = dict(
             client=jnp.zeros((S, U), I32),
@@ -284,6 +329,7 @@ def decode_updates_v1(
             ptag=jnp.zeros((S, U), I32),
             pc=jnp.full((S, U), -1, I32),
             pk=jnp.zeros((S, U), I32),
+            keyh=jnp.full((S, U), -1, I32),
             valid=jnp.zeros((S, U), bool),
         )
         dels = dict(
@@ -325,10 +371,86 @@ def decode_updates_v1(
         consumed = jnp.where(is_info, 1, nbytes)
 
         # string states consume the payload bytes too
-        is_str_skip = (st == ST_PARENT_NAME) | (st == ST_PARENT_SUB)
+        is_str_skip = (
+            (st == ST_PARENT_NAME)
+            | (st == ST_PARENT_SUB)
+            | (st == ST_JSON_VAL)
+            | (st == ST_FMT_KEY)
+            | (st == ST_FMT_VAL)  # format values are JSON strings on wire
+            | (st == ST_SPAN1)
+        )
         is_str = st == ST_STR
         str_start = pos + nbytes
         consumed = consumed + jnp.where(is_str_skip | is_str, v, 0)
+
+        # --- one lib0 Any value (ST_ANY_VAL / ST_FMT_VAL): tag byte at
+        # pos, then a tag-dependent payload. A second varint extraction
+        # over the window shifted by one covers int/string/buffer tags.
+        is_any_val = st == ST_ANY_VAL
+        tag = bytes10[:, 0]
+        cont2 = bytes10[:, 1:] >= 0x80
+        inb2 = jnp.concatenate(
+            [jnp.ones((S, 1), I32), jnp.cumprod(cont2[:, :8].astype(I32), axis=1)],
+            axis=1,
+        )
+        nb2 = jnp.sum(inb2, axis=1)
+        val2 = jnp.sum(
+            jnp.where(
+                inb2[:, :5] == 1,
+                (bytes10[:, 1:6].astype(U32) & 0x7F) << shifts.astype(U32),
+                jnp.zeros((S, 5), U32),
+            ),
+            axis=1,
+        ).astype(I32)
+        any_extra = jnp.where(
+            (tag == 127) | (tag == 126) | (tag == 121) | (tag == 120),
+            0,
+            jnp.where(
+                tag == 125,  # integer: signed varint
+                nb2,
+                jnp.where(
+                    tag == 124,  # float32
+                    4,
+                    jnp.where(
+                        (tag == 123) | (tag == 122),  # float64 / bigint
+                        8,
+                        jnp.where(
+                            (tag == 119) | (tag == 116),  # string / buffer
+                            nb2 + val2,
+                            jnp.where(tag == 117, nb2, 0),  # array header
+                        ),
+                    ),
+                ),
+            ),
+        )
+        # map values / unknown tags fall back to the host lane (arrays are
+        # handled as header tokens: children enqueue on the value counter)
+        any_bad_tag = is_any_val & ((tag == 118) | (tag < 116))
+        consumed = jnp.where(is_any_val, 1 + any_extra, consumed)
+
+        # --- parent_sub key hash (device map rows): mix the key bytes so
+        # the host-built (hash -> interned key) table resolves them
+        kh_idx = jnp.clip(
+            str_start[:, None] + jnp.arange(KEY_HASH_BYTES, dtype=I32)[None, :],
+            0,
+            L - 1,
+        )
+        kh_bytes = jnp.take_along_axis(b, kh_idx, axis=1).astype(U32)
+        kh_mask = jnp.arange(KEY_HASH_BYTES, dtype=I32)[None, :] < v[:, None]
+        pow31 = jnp.asarray(
+            np.array(
+                [pow(31, i, 1 << 32) for i in range(KEY_HASH_BYTES)],
+                dtype=np.uint32,
+            )
+        )
+        khash = jnp.sum(
+            jnp.where(kh_mask, kh_bytes * pow31[None, :], 0).astype(U32), axis=1
+        )
+        khash = (
+            (khash ^ (v.astype(U32) * jnp.uint32(2654435761)))
+            & jnp.uint32(0x7FFFFFFF)
+        ).astype(I32)
+        key_too_long = (st == ST_PARENT_SUB) & (v > KEY_HASH_BYTES)
 
         pos_after = pos + consumed
         is_client_st = (
@@ -341,7 +463,8 @@ def decode_updates_v1(
             # a string length > L would wrap `pos + v` past int32 and slip
             # under the pos_after bound; no real payload exceeds its buffer
             | ((is_str_skip | is_str) & (v > L))
-            | (ovf & ~is_info & ~is_client_st)
+            | (is_any_val & ((tag == 119) | (tag == 116)) & (val2 > L))
+            | (ovf & ~is_info & ~is_client_st & ~is_any_val)
             | ((st == ST_NCLIENTS) & (v > max_sec))  # absurd header: garbage
         )
         act = active & ~bad & ~big_client
@@ -353,14 +476,44 @@ def decode_updates_v1(
             return jnp.where(cond, new, reg)
 
         # --- end-of-block / end-of-ds-range shared bookkeeping -----------
-        emit_row_st = on(ST_DEL_LEN) | on(ST_GC_LEN) | on(ST_SKIP_LEN) | on(ST_STR)
+        # one token consumed per value step; an array header enqueues its
+        # children onto the counter
+        any_children = jnp.where((st == ST_ANY_VAL) & (tag == 117), val2, 0)
+        vals_left2 = upd(
+            regs["vals_left"],
+            on(ST_ANY_VAL) | on(ST_JSON_VAL),
+            regs["vals_left"] - 1 + any_children,
+        )
+        # states that finish a block this step (zero-count value lists
+        # finish immediately and emit nothing)
+        empty_list = (on(ST_ANY_COUNT) | on(ST_JSON_COUNT)) & (v == 0)
+        list_done = (on(ST_ANY_VAL) | on(ST_JSON_VAL)) & (vals_left2 == 0)
+        emit_row_st = (
+            on(ST_DEL_LEN)
+            | on(ST_GC_LEN)
+            | on(ST_SKIP_LEN)
+            | on(ST_STR)
+            | list_done
+            | on(ST_SPAN1)
+            | on(ST_FMT_VAL)
+        )
         str_len16 = u16_span(str_start, str_start + v)
-        blk_len = jnp.where(is_str, str_len16, v)
-        blocks_left2 = upd(regs["blocks_left"], emit_row_st, regs["blocks_left"] - 1)
+        is_list_done = list_done
+        blk_len = jnp.where(
+            is_str,
+            str_len16,
+            jnp.where(
+                is_list_done,
+                regs["vals_n"],
+                jnp.where(on(ST_SPAN1) | on(ST_FMT_VAL), 1, v),
+            ),
+        )
+        block_end = emit_row_st | empty_list
+        blocks_left2 = upd(regs["blocks_left"], block_end, regs["blocks_left"] - 1)
         # a client section with zero blocks (never produced by our encoders,
         # but legal wire) also closes at ST_CLOCK
         empty_client = on(ST_CLOCK) & (regs["blocks_left"] == 0)
-        client_done = (emit_row_st & (blocks_left2 == 0)) | empty_client
+        client_done = (block_end & (blocks_left2 == 0)) | empty_client
         clients_left2 = upd(regs["clients_left"], client_done, regs["clients_left"] - 1)
         after_block = jnp.where(
             blocks_left2 > 0,
@@ -390,7 +543,25 @@ def decode_updates_v1(
         content_st = jnp.where(
             kind4 == CONTENT_DELETED,
             ST_DEL_LEN,
-            jnp.where(kind4 == CONTENT_STRING, ST_STR, ST_ERR),
+            jnp.where(
+                kind4 == CONTENT_STRING,
+                ST_STR,
+                jnp.where(
+                    kind4 == CONTENT_ANY,
+                    ST_ANY_COUNT,
+                    jnp.where(
+                        kind4 == CONTENT_JSON,
+                        ST_JSON_COUNT,
+                        jnp.where(
+                            (kind4 == CONTENT_EMBED) | (kind4 == CONTENT_BINARY),
+                            ST_SPAN1,
+                            jnp.where(
+                                kind4 == CONTENT_FORMAT, ST_FMT_KEY, ST_ERR
+                            ),
+                        ),
+                    ),
+                ),
+            ),
         )
         content_unsupported = content_st == ST_ERR
         has_psub = ((regs["info"] & 0xC0) == 0) & ((regs["info"] & 0x20) != 0)
@@ -438,7 +609,10 @@ def decode_updates_v1(
         st2 = upd(st2, on(ST_PARENT_ID_C), ST_PARENT_ID_K)
         st2 = upd(st2, on(ST_PARENT_ID_K), after_parent)
         st2 = upd(st2, on(ST_PARENT_SUB), content_st)
-        st2 = upd(st2, emit_row_st, after_block)
+        st2 = upd(st2, on(ST_ANY_COUNT) & (v > 0), ST_ANY_VAL)
+        st2 = upd(st2, on(ST_JSON_COUNT) & (v > 0), ST_JSON_VAL)
+        st2 = upd(st2, on(ST_FMT_KEY), ST_FMT_VAL)
+        st2 = upd(st2, block_end, after_block)
         st2 = upd(st2, on(ST_DS_NCLIENTS), jnp.where(v > 0, ST_DS_CLIENT, ST_DONE))
         st2 = upd(st2, on(ST_DS_CLIENT), ST_DS_NRANGES)
         st2 = upd(
@@ -458,7 +632,9 @@ def decode_updates_v1(
             (on(ST_ORIGIN_K) & ((regs["info"] & 0x40) == 0) & content_unsupported)
             | (on(ST_ROR_K) & content_unsupported)
             | ((on(ST_PARENT_NAME) | on(ST_PARENT_ID_K)) & ~has_psub & content_unsupported)
-            | (on(ST_PARENT_SUB))  # map rows need host key interning
+            | (on(ST_PARENT_SUB) & content_unsupported)
+            | (act & key_too_long)  # key exceeds the hash window
+            | (act & any_bad_tag)  # recursive/unknown Any value
         )
         # item with neither origin flag whose dispatch happens after parent
         st2 = upd(st2, unsupported, ST_ERR)
@@ -473,7 +649,16 @@ def decode_updates_v1(
         regs2["blocks_left"] = upd(blocks_left2, on(ST_NBLOCKS), v)
         regs2["client"] = upd(regs["client"], on(ST_CLIENT), v)
         clock2 = upd(regs["clock"], on(ST_CLOCK), v)
-        regs2["clock"] = upd(clock2, emit_row_st, clock2 + blk_len)
+        regs2["clock"] = upd(clock2, block_end, clock2 + blk_len)
+        regs2["keyh"] = upd(
+            upd(regs["keyh"], on(ST_INFO), -1), on(ST_PARENT_SUB), khash
+        )
+        count_st = on(ST_ANY_COUNT) | on(ST_JSON_COUNT)
+        regs2["vals_n"] = upd(regs["vals_n"], count_st, v)
+        regs2["vals_left"] = upd(vals_left2, count_st, v)
+        regs2["cref"] = upd(
+            regs["cref"], count_st | on(ST_FMT_KEY), pos
+        )
         regs2["info"] = upd(regs["info"], on(ST_INFO), v)
         # reset per-item registers when a new info byte arrives
         fresh = on(ST_INFO)
@@ -508,10 +693,21 @@ def decode_updates_v1(
             rows[name] = jnp.where(oh, vec[:, None], rows[name])
 
         is_gc_row = on(ST_GC_LEN)
+        # the info register still holds the block's content kind for every
+        # content-terminal state (Any/Json/Embed/Binary/Format/Deleted)
         row_kind = jnp.where(
             is_gc_row,
             BLOCK_GC,
-            jnp.where(is_str, CONTENT_STRING, CONTENT_DELETED),
+            jnp.where(is_str, CONTENT_STRING, kind4),
+        )
+        row_ref = jnp.where(
+            is_str,
+            row_ids * L + str_start,
+            jnp.where(
+                is_list_done | on(ST_FMT_VAL),
+                row_ids * L + regs["cref"],
+                jnp.where(on(ST_SPAN1), row_ids * L + pos, -1),
+            ),
         )
         put_row("client", regs["client"])
         put_row("clock", regs["clock"])
@@ -521,10 +717,11 @@ def decode_updates_v1(
         put_row("rc", jnp.where(is_gc_row, -1, regs["rc"]))
         put_row("rk", jnp.where(is_gc_row, 0, regs["rk"]))
         put_row("kind", row_kind)
-        put_row("ref", jnp.where(is_str, row_ids * L + str_start, -1))
+        put_row("ref", row_ref)
         put_row("ptag", jnp.where(is_gc_row, 0, regs["ptag"]))
         put_row("pc", jnp.where(is_gc_row, -1, regs["pc"]))
         put_row("pk", jnp.where(is_gc_row, 0, regs["pk"]))
+        put_row("keyh", jnp.where(is_gc_row, -1, regs["keyh"]))
         rows["valid"] = rows["valid"] | oh
         regs2["n_rows"] = regs["n_rows"] + emit.astype(I32)
 
@@ -577,6 +774,22 @@ def decode_updates_v1(
         unk = unk | u
         flags = flags | jnp.where(unk, FLAG_UNKNOWN_CLIENT, 0)
 
+    # parent_sub key hashes -> interned key indices (map rows on device)
+    has_key = rows["valid"] & (rows["keyh"] >= 0)
+    key_col = jnp.full((S, U), -1, I32)
+    key_miss = has_key
+    if key_table is not None:
+        khashes, kperm = key_table
+        K2 = khashes.shape[0]
+        if K2 > 0:
+            kj = jnp.clip(jnp.searchsorted(khashes, rows["keyh"]), 0, K2 - 1)
+            khit = has_key & (khashes[kj] == rows["keyh"])
+            key_col = jnp.where(khit, kperm[kj], -1)
+            key_miss = has_key & ~khit
+    flags = flags | jnp.where(
+        jnp.any(key_miss, axis=1), FLAG_UNKNOWN_KEY, 0
+    )
+
     # lanes that errored out must not contribute partial rows
     lane_ok = (flags & FLAG_ERRORS) == 0
     valid = rows["valid"] & lane_ok[:, None]
@@ -594,7 +807,7 @@ def decode_updates_v1(
         kind=rows["kind"],
         content_ref=rows["ref"],
         content_off=z_u,
-        key=neg_u,
+        key=key_col,
         p_tag=rows["ptag"],
         p_client=rows["pc"],
         p_clock=rows["pk"],
@@ -660,13 +873,82 @@ def utf8_slice_u16(buf: np.ndarray, start: int, off: int, length: int) -> str:
     return "".join(out)
 
 
+def _wire_any_values(flat: np.ndarray, start: int, off: int, length: int) -> list:
+    """ContentAny at wire offset `start`: count varint then Any values."""
+    from ytpu.encoding.lib0 import Cursor, read_any
+
+    cur = Cursor(bytes(flat[start:]))
+    n = cur.read_var_uint()
+    out = []
+    for i in range(min(n, off + length)):
+        v = read_any(cur)
+        if i >= off:
+            out.append(v)
+    return out
+
+
+def _wire_json_values(flat: np.ndarray, start: int, off: int, length: int) -> list:
+    """ContentJson at `start`: count then JSON strings (parsed, None on
+    parse failure — ContentJSON.values parity)."""
+    import json as _json
+
+    from ytpu.encoding.lib0 import Cursor
+
+    cur = Cursor(bytes(flat[start:]))
+    n = cur.read_var_uint()
+    out = []
+    for i in range(min(n, off + length)):
+        s = cur.read_string()
+        if i >= off:
+            try:
+                out.append(_json.loads(s))
+            except (ValueError, TypeError):
+                out.append(None)
+    return out
+
+
+def _wire_json_raw(flat: np.ndarray, start: int, off: int, length: int) -> list:
+    """ContentJson raw strings (re-encode path: byte-exact round trips)."""
+    from ytpu.encoding.lib0 import Cursor
+
+    cur = Cursor(bytes(flat[start:]))
+    n = cur.read_var_uint()
+    out = []
+    for i in range(min(n, off + length)):
+        s = cur.read_string()
+        if i >= off:
+            out.append(s)
+    return out
+
+
+def _wire_embed_value(flat: np.ndarray, start: int):
+    from ytpu.encoding.lib0 import Cursor, any_from_json
+
+    return any_from_json(Cursor(bytes(flat[start:])).read_string())
+
+
+def _wire_binary_value(flat: np.ndarray, start: int) -> bytes:
+    from ytpu.encoding.lib0 import Cursor
+
+    return Cursor(bytes(flat[start:])).read_buf()
+
+
+def _wire_format_kv(flat: np.ndarray, start: int):
+    from ytpu.encoding.lib0 import Cursor, any_from_json
+
+    cur = Cursor(bytes(flat[start:]))
+    key = cur.read_string()
+    return key, any_from_json(cur.read_string())
+
+
 class RawPayloadView:
     """PayloadStore-shaped reader over the raw wire-byte matrix.
 
-    Device-decoded rows address string payloads by ``ref = s * L +
-    byte_start`` with ``(off, len)`` in UTF-16 code units; slicing decodes
-    UTF-8 forward from the string start (splits keep offsets in units, so
-    the walk is exact).
+    Device-decoded rows address content payloads by ``ref = s * L +
+    byte_start``. String refs point at the UTF-8 bytes with ``(off, len)``
+    in UTF-16 code units; Any/Json refs at their count varint with
+    ``(off, len)`` in values; Embed/Binary/Format refs at their span
+    start.
     """
 
     def __init__(self, buf: np.ndarray):
@@ -676,7 +958,22 @@ class RawPayloadView:
         return utf8_slice_u16(self.buf, int(ref), off, length)
 
     def slice_values(self, ref: int, off: int, length: int) -> list:
-        return list(self.slice_text(ref, off, length))
+        return _wire_any_values(self.buf, int(ref), off, length)
+
+    def json_values(self, ref: int, off: int, length: int) -> list:
+        return _wire_json_values(self.buf, int(ref), off, length)
+
+    def json_raw(self, ref: int, off: int, length: int) -> list:
+        return _wire_json_raw(self.buf, int(ref), off, length)
+
+    def embed_value(self, ref: int):
+        return _wire_embed_value(self.buf, int(ref))
+
+    def binary_value(self, ref: int) -> bytes:
+        return _wire_binary_value(self.buf, int(ref))
+
+    def format_kv(self, ref: int):
+        return _wire_format_kv(self.buf, int(ref))
 
 
 class ChunkedWirePayloads:
@@ -731,4 +1028,35 @@ class ChunkedWirePayloads:
     def slice_values(self, ref: int, off: int, length: int) -> list:
         if int(ref) >= 0:
             return self.store.slice_values(ref, off, length)
-        return list(self.slice_text(ref, off, length))
+        flat, start = self._locate(ref)
+        return _wire_any_values(flat, start, off, length)
+
+    def json_values(self, ref: int, off: int, length: int) -> list:
+        if int(ref) >= 0:
+            return self.store.json_values(ref, off, length)
+        flat, start = self._locate(ref)
+        return _wire_json_values(flat, start, off, length)
+
+    def json_raw(self, ref: int, off: int, length: int) -> list:
+        if int(ref) >= 0:
+            return self.store.json_raw(ref, off, length)
+        flat, start = self._locate(ref)
+        return _wire_json_raw(flat, start, off, length)
+
+    def embed_value(self, ref: int):
+        if int(ref) >= 0:
+            return self.store.embed_value(ref)
+        flat, start = self._locate(ref)
+        return _wire_embed_value(flat, start)
+
+    def binary_value(self, ref: int) -> bytes:
+        if int(ref) >= 0:
+            return self.store.binary_value(ref)
+        flat, start = self._locate(ref)
+        return _wire_binary_value(flat, start)
+
+    def format_kv(self, ref: int):
+        if int(ref) >= 0:
+            return self.store.format_kv(ref)
+        flat, start = self._locate(ref)
+        return _wire_format_kv(flat, start)

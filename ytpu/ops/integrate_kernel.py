@@ -61,12 +61,14 @@ I32 = jnp.int32
     KD,  # content kind
     RF,  # content ref
     OF,  # content offset
-) = range(14)
-NC = 14
-# key/parent/head columns are NOT packed: the fused kernel is root-sequence
-# only (guarded below), where every row's key/parent/head is -1 forever —
-# the state's original columns pass through unchanged (split/new rows land
-# in slots init_state pre-filled with -1).
+    KEY,  # interned parent_sub (-1 = sequence item)
+    PA,  # parent ContentType row (-1 = root)
+    HD,  # child-sequence head (ContentType rows)
+) = range(17)
+NC = 17
+# move columns are NOT packed: the fused kernel excludes move rows
+# (guarded below) — move ownership needs the end-of-update recompute pass
+# that only the XLA path runs; moved/mv_* pass through unchanged.
 
 # meta columns in the packed [D, 8] array (padded to a TPU-friendly lane dim)
 M_START, M_NBLOCKS, M_ERROR = 0, 1, 2
@@ -94,6 +96,9 @@ def pack_state(state: DocStateBatch) -> Tuple[jax.Array, jax.Array]:
             bl.kind,
             bl.content_ref,
             bl.content_off,
+            bl.key,
+            bl.parent,
+            bl.head,
         ]
     )  # [NC, D, C]
     D = state.start.shape[0]
@@ -107,8 +112,8 @@ def pack_state(state: DocStateBatch) -> Tuple[jax.Array, jax.Array]:
 def unpack_state(
     cols: jax.Array, meta: jax.Array, state: DocStateBatch
 ) -> DocStateBatch:
-    """Rebuild state from kernel outputs; key/parent/head pass through from
-    the pre-kernel `state` (constant -1 on the fused root-sequence path)."""
+    """Rebuild state from kernel outputs; move columns pass through from
+    the pre-kernel `state` (move rows are excluded from the fused path)."""
     blocks = BlockCols(
         client=cols[CL],
         clock=cols[CK],
@@ -124,9 +129,9 @@ def unpack_state(
         kind=cols[KD],
         content_ref=cols[RF],
         content_off=cols[OF],
-        key=state.blocks.key,
-        parent=state.blocks.parent,
-        head=state.blocks.head,
+        key=cols[KEY],
+        parent=cols[PA],
+        head=cols[HD],
         moved=state.blocks.moved,
         mv_sc=state.blocks.mv_sc,
         mv_sk=state.blocks.mv_sk,
@@ -145,7 +150,7 @@ def unpack_state(
 
 
 def pack_stream(stream: UpdateBatch) -> Tuple[jax.Array, jax.Array]:
-    """Stacked doc-axis-free stream → rows [S, U, 11] / dels [S, R, 4] i32."""
+    """Stacked doc-axis-free stream → rows [S, U, 15] / dels [S, R, 4] i32."""
     rows = jnp.stack(
         [
             stream.client,
@@ -158,10 +163,14 @@ def pack_stream(stream: UpdateBatch) -> Tuple[jax.Array, jax.Array]:
             stream.kind,
             stream.content_ref,
             stream.content_off,
+            stream.key,
+            stream.p_tag,
+            stream.p_client,
+            stream.p_clock,
             stream.valid.astype(I32),
         ],
         axis=-1,
-    )  # [S, U, 11]
+    )  # [S, U, 15]
     dels = jnp.stack(
         [
             stream.del_client,
@@ -281,6 +290,9 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
                     (KD, gather(KD, i_idx, 0)),
                     (RF, gather(RF, i_idx, -1)),
                     (OF, gather(OF, i_idx, 0) + off),
+                    (KEY, gather(KEY, i_idx, -1)),
+                    (PA, gather(PA, i_idx, -1)),
+                    (HD, gather(HD, i_idx, -1)),
                 ],
             )
             # fix left half + old right neighbor
@@ -313,6 +325,10 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         r_kind = rows_ref[s, u, 7]
         r_ref = rows_ref[s, u, 8]
         r_off = rows_ref[s, u, 9]
+        r_key = rows_ref[s, u, 10]
+        r_ptag = rows_ref[s, u, 11]
+        r_pclient = rows_ref[s, u, 12]
+        r_pclock = rows_ref[s, u, 13]
 
         local = client_clock(r_client)  # (DB,)
         applicable = local >= r_clock
@@ -348,12 +364,60 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         missing = missing | anchor_missing
         linkable = linkable & ~anchor_missing
 
+        # parent branch (parity: block.rs:503-523): p_tag 2 = nested branch
+        # by ContentType item id; 1 = root; 0 = inherit from the resolved
+        # left (else right) anchor
+        parent_slot, _pfound = find_slot(
+            jnp.full((DB,), r_pclient, I32),
+            jnp.full((DB,), r_pclock, I32),
+            linkable & (r_ptag == 2),
+        )
+        left_parent = gather(PA, left_idx, -1)
+        right_parent = gather(PA, right_idx, -1)
+        inherited_parent = jnp.where(left_idx >= 0, left_parent, right_parent)
+        parent_row = jnp.where(
+            r_ptag == 2,
+            parent_slot,
+            jnp.where(r_ptag == 1, -1, inherited_parent),
+        )
+        parent_missing = linkable & (r_ptag == 2) & (parent_slot < 0)
+        missing = missing | parent_missing
+        linkable = linkable & ~parent_missing
+
+        # parent_sub: inherited from the anchors when omitted on the wire
+        # (parity: block.rs:604-612)
+        left_key = gather(KEY, left_idx, -1)
+        right_key = gather(KEY, right_idx, -1)
+        key_v = jnp.where(
+            r_key >= 0,
+            jnp.full((DB,), r_key, I32),
+            jnp.where(left_key >= 0, left_key, right_key),
+        )
+        is_map = key_v >= 0
+
+        # map rows anchor on their (parent, key) chain's leftmost item
+        # (parity: block.rs:541-551); sequence rows on the parent's head
+        valid_slots = iota_c < n_blocks()[:, None]
+        chain_mask = (
+            valid_slots
+            & (col(KEY) == key_v[:, None])
+            & (col(PA) == parent_row[:, None])
+            & (col(LT) == -1)
+            & is_map[:, None]
+        )
+        chain_idx = jnp.min(jnp.where(chain_mask, iota_c, C), axis=1).astype(I32)
+        chain_head = jnp.where(chain_idx < C, chain_idx, -1)
+        seq_head = jnp.where(
+            parent_row >= 0, gather(HD, parent_row, -1), meta_ref[:, M_START]
+        )
+        anchor0_base = jnp.where(is_map, chain_head, seq_head)
+
         right_left = gather(LT, right_idx, -1)
         need_scan = linkable & (
             ((left_idx < 0) & ((right_idx < 0) | (right_left >= 0)))
             | ((left_idx >= 0) & (gather(RT, left_idx, -1) != right_idx))
         )
-        o0 = jnp.where(left_idx >= 0, gather(RT, left_idx, -1), meta_ref[:, M_START])
+        o0 = jnp.where(left_idx >= 0, gather(RT, left_idx, -1), anchor0_base)
         o0 = jnp.where(need_scan, o0, -1)
 
         def origins_equal(ha, ca, ka, hb, cb, kb):
@@ -419,14 +483,29 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
 
         has_left = linkable & (left_idx >= 0)
         right_final = jnp.where(
-            has_left, gather(RT, left_idx, -1), jnp.where(linkable, meta_ref[:, M_START], -1)
+            has_left, gather(RT, left_idx, -1), jnp.where(linkable, anchor0_base, -1)
         )
         put(RT, left_idx, j, has_left)
-        meta_ref[:, M_START] = jnp.where(linkable & ~has_left, j, meta_ref[:, M_START])
+        # sequence rows with no left become the head: the root start, or
+        # the parent branch's head column (map rows never touch the head)
+        new_head = linkable & ~has_left & ~is_map
+        meta_ref[:, M_START] = jnp.where(
+            new_head & (parent_row < 0), j, meta_ref[:, M_START]
+        )
+        put(HD, parent_row, j, new_head & (parent_row >= 0))
         put(LT, right_final, j, linkable & (right_final >= 0))
 
-        row_deleted = is_gc | (r_kind == CONTENT_DELETED)
-        row_countable = ~row_deleted & (r_kind != CONTENT_FORMAT)
+        # self-delete on arrival (parity: block.rs:751-765): a row under a
+        # tombstoned parent, or a map row landing with a right neighbor (a
+        # losing concurrent write), integrates directly as deleted
+        parent_deleted = (parent_row >= 0) & (gather(DL, parent_row, 0) == 1)
+        dead_on_arrival = linkable & (
+            parent_deleted | (is_map & (right_final >= 0))
+        )
+        row_deleted = is_gc | (r_kind == CONTENT_DELETED) | dead_on_arrival
+        row_countable = (
+            ~row_deleted & (r_kind != CONTENT_FORMAT) & (r_kind != CONTENT_MOVE)
+        )
 
         put_many(
             j,
@@ -441,13 +520,21 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
                 (RK, jnp.full((DB,), jnp.where(has_ror, r_rk, 0), I32)),
                 (LT, jnp.where(linkable, left_idx, -1)),
                 (RT, jnp.where(linkable, right_final, -1)),
-                (DL, jnp.full((DB,), row_deleted.astype(I32), I32)),
-                (CN, jnp.full((DB,), row_countable.astype(I32), I32)),
+                (DL, row_deleted.astype(I32)),
+                (CN, row_countable.astype(I32)),
                 (KD, jnp.full((DB,), r_kind, I32)),
                 (RF, jnp.full((DB,), r_ref, I32)),
                 (OF, c_off),
+                (KEY, key_v),
+                (PA, parent_row),
+                (HD, jnp.full((DB,), -1, I32)),
             ],
         )
+        # a map row that became its chain's tail is the key's live value;
+        # the previous winner — its immediate left — gets tombstoned
+        # (parity: block.rs:637-659)
+        new_tail = linkable & is_map & (right_final < 0)
+        put(DL, left_idx, jnp.ones((DB,), I32), new_tail & has_left)
         meta_ref[:, M_NBLOCKS] = n_blocks() + do.astype(I32)
         meta_ref[:, M_ERROR] = (
             meta_ref[:, M_ERROR]
@@ -483,7 +570,7 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
 
     def step(s, _):
         def row_body(u, __):
-            @pl.when(rows_ref[s, u, 10] == 1)
+            @pl.when(rows_ref[s, u, 14] == 1)
             def _():
                 integrate_row(s, u)
 
@@ -551,34 +638,22 @@ def apply_update_stream_fused(
     interpret: bool = False,
     guard: bool = True,
 ) -> DocStateBatch:
-    """Fused-replay drop-in for `apply_update_stream` (same semantics for
-    sequence streams; map rows are not supported in the fused kernel).
+    """Fused-replay drop-in for `apply_update_stream`: sequence rows, map
+    rows (per-key LWW chains), and nested-branch parents all integrate
+    in-VMEM. Only move rows are excluded — move-ownership recomputation is
+    the XLA path's end-of-update pass.
 
-    Precondition: both the stream AND the current state are root-sequence
-    only (key/parent == -1 everywhere) — splits in the fused kernel do not
-    carry key/parent, so a mixed state would silently lose that linkage.
-    Callers that built everything through one `BatchEncoder` from
-    `init_state` should check the encoder's `saw_map_or_nested` flag and
-    pass `guard=False` — the default device-side guard costs one
-    host-device sync before launch."""
+    Callers that built everything through one `BatchEncoder` can check the
+    encoder's stream for moves host-side and pass `guard=False` — the
+    default device-side guard costs one host-device sync before launch."""
     if guard and bool(
-        jnp.any(
-            (
-                (stream.key >= 0)
-                | (stream.p_tag == 2)
-                | (stream.kind == CONTENT_MOVE)
-            )
-            & stream.valid
-        )
-        | jnp.any(state.blocks.key >= 0)
-        | jnp.any(state.blocks.parent >= 0)
+        jnp.any((stream.kind == CONTENT_MOVE) & stream.valid)
         | jnp.any(state.blocks.kind == CONTENT_MOVE)
     ):
         raise NotImplementedError(
-            "apply_update_stream_fused integrates root-sequence-only "
-            "streams over root-sequence-only states; map rows (parent_sub), "
-            "nested-branch parents, and move ranges must take "
-            "apply_update_stream"
+            "apply_update_stream_fused excludes move ranges (move claims "
+            "need the XLA path's recompute pass); use apply_update_stream "
+            "for streams containing ContentMove"
         )
     cols, meta = pack_state(state)
     D = cols.shape[1]
