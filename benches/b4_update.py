@@ -1,0 +1,111 @@
+"""B4.2 — real-world snapshot apply (reference benches.rs:456-477).
+
+Applies the 400,972-byte `b4-update.bin` (the automerge-paper session's
+final document as ONE update) through three lanes:
+
+- host oracle: one `Doc.apply_update_v1` (the reference-shaped path);
+- native C++ engine: same single apply via `ytpu.native.NativeEngine`;
+- device lane: the update split into row-bounded pieces
+  (`ytpu.compat.split_update`) streamed through the raw-bytes fast lane
+  (`BatchIngestor.apply_bytes`) — decode + integrate on device, with the
+  53-bit Yjs client id resolving through the varint-hash table.
+
+Usage: python benches/b4_update.py [n_docs] [piece_blocks]
+Prints one JSON line per lane.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ASSETS = os.environ.get("YTPU_ASSETS", "/root/reference/assets")
+B4_UPDATE = f"{ASSETS}/bench-input/b4-update.bin"
+
+
+def main():
+    n_docs = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    piece_blocks = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+
+    with open(B4_UPDATE, "rb") as f:
+        payload = f.read()
+
+    from ytpu.core import Doc
+
+    doc = Doc(client_id=99)
+    t0 = time.perf_counter()
+    doc.apply_update_v1(payload)
+    host_dt = time.perf_counter() - t0
+    expect = doc.get_text("text").get_string()
+    print(
+        json.dumps(
+            {
+                "lane": "host",
+                "seconds": round(host_dt, 3),
+                "bytes_per_sec": round(len(payload) / host_dt, 1),
+                "text_len": len(expect),
+            }
+        )
+    )
+
+    try:
+        from ytpu.native import NativeEngine, engine_available
+
+        if engine_available():
+            eng = NativeEngine()
+            t0 = time.perf_counter()
+            eng.apply_update_v1(payload)
+            native_dt = time.perf_counter() - t0
+            ok = eng.text() == expect
+            print(
+                json.dumps(
+                    {
+                        "lane": "native",
+                        "seconds": round(native_dt, 3),
+                        "bytes_per_sec": round(len(payload) / native_dt, 1),
+                        "match": ok,
+                    }
+                )
+            )
+            eng.close()
+    except Exception as e:
+        print(json.dumps({"lane": "native", "error": str(e)[:200]}))
+
+    try:
+        from ytpu.compat import split_update
+        from ytpu.models.batch_doc import get_string
+        from ytpu.models.ingest import BatchIngestor
+
+        pieces = split_update(payload, piece_blocks)
+        ing = BatchIngestor(n_docs=n_docs, capacity=1 << 15)
+        t0 = time.perf_counter()
+        for p in pieces:
+            ing.apply_bytes([p] * n_docs)
+        dev_dt = time.perf_counter() - t0
+        ok = get_string(ing.state, 0, ing.payloads) == expect
+        print(
+            json.dumps(
+                {
+                    "lane": "device",
+                    "seconds": round(dev_dt, 3),
+                    "pieces": len(pieces),
+                    "n_docs": n_docs,
+                    "fast_docs": ing.fast_docs,
+                    "slow_docs": ing.slow_docs,
+                    "doc_bytes_per_sec": round(
+                        len(payload) * n_docs / dev_dt, 1
+                    ),
+                    "match": ok,
+                }
+            )
+        )
+    except Exception as e:
+        print(json.dumps({"lane": "device", "error": str(e)[:200]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -169,3 +169,107 @@ def test_concurrent_trace_full_end_content():
     for i in sorted(states):
         final.apply_update_v1(states[i])
     assert final.get_text("text").get_string() == data["endContent"]
+
+
+run_slow = pytest.mark.skipif(
+    not os.environ.get("YTPU_RUN_SLOW"),
+    reason="full-trace replay (minutes); set YTPU_RUN_SLOW=1",
+)
+
+
+def _end_content(name: str) -> str:
+    path = f"{ASSETS}/editing-traces/sequential_traces/{name}.json.gz"
+    with gzip.open(path, "rt") as f:
+        return json.load(f)["endContent"]
+
+
+# --- full sequential trace replays (edit_traces_tests.rs:1-60) --------------
+# sveltecomponent runs in the default suite (above); the long traces run
+# end-to-end under YTPU_RUN_SLOW (CI's scheduled job / judge runs).
+
+
+@requires_assets
+@run_slow
+def test_trace_friendsforever_full():
+    doc, txt, data = _replay_trace("friendsforever_flat")
+    assert txt.get_string() == data["endContent"]
+
+
+@requires_assets
+@run_slow
+def test_trace_automerge_paper_full():
+    doc, txt, data = _replay_trace("automerge-paper")
+    assert txt.get_string() == data["endContent"]
+
+
+@requires_assets
+@run_slow
+def test_trace_seph_blog1_full():
+    doc, txt, data = _replay_trace("seph-blog1")
+    assert txt.get_string() == data["endContent"]
+
+
+@requires_assets
+@run_slow
+def test_trace_rustcode_full():
+    doc, txt, data = _replay_trace("rustcode")
+    assert txt.get_string() == data["endContent"]
+
+
+# --- B4.2: real-world snapshot apply (benches.rs:456-477) -------------------
+
+
+@requires_assets
+def test_b4_update_snapshot_apply_host():
+    """Apply the 400,972-byte b4-update.bin in one host apply_update; the
+    result is the automerge-paper editing session's final document."""
+    with open(f"{ASSETS}/bench-input/b4-update.bin", "rb") as f:
+        payload = f.read()
+    doc = Doc(client_id=99)
+    doc.apply_update_v1(payload)
+    s = doc.get_text("text").get_string()
+    assert len(s) == 104852
+    assert s == _end_content("automerge-paper")
+    assert doc.store.pending is None
+
+
+@requires_assets
+def test_b4_update_split_roundtrip():
+    """split_update pieces applied in order reproduce the original state
+    (the streaming-ingest decomposition of one huge snapshot update)."""
+    from ytpu.compat import split_update
+
+    with open(f"{ASSETS}/bench-input/b4-update.bin", "rb") as f:
+        payload = f.read()
+    pieces = split_update(payload, 4096)
+    assert len(pieces) >= 4
+    doc = Doc(client_id=7)
+    for p in pieces:
+        doc.apply_update_v1(p)
+    assert doc.get_text("text").get_string() == _end_content("automerge-paper")
+    assert doc.store.pending is None
+
+
+@requires_assets
+def test_b4_update_device_decode_lane_prefix():
+    """A prefix of the B4.2 snapshot's pieces flows through the raw-bytes
+    device lane; the device state must equal a host doc fed the same
+    pieces (full-scale device run: benches/b4_update.py on TPU)."""
+    from ytpu.compat import split_update
+    from ytpu.models.batch_doc import get_string
+    from ytpu.models.ingest import BatchIngestor
+    from ytpu.native import available as native_available
+
+    if not native_available():
+        pytest.skip("native codec unavailable")
+    with open(f"{ASSETS}/bench-input/b4-update.bin", "rb") as f:
+        payload = f.read()
+    pieces = split_update(payload, 64)[:8]
+    ing = BatchIngestor(n_docs=1, capacity=1024)
+    oracle = Doc(client_id=42)
+    for p in pieces:
+        ing.apply_bytes([p])
+        oracle.apply_update_v1(p)
+    assert ing.fast_docs == len(pieces), "B4.2 pieces fell off the fast lane"
+    got = get_string(ing.state, 0, ing.payloads)
+    assert got == oracle.get_text("text").get_string()

@@ -169,12 +169,22 @@ def test_equivalence_with_host_lane():
 
 
 @needs_native
-def test_big_client_id_takes_slow_lane():
-    log, expect = _edit_log([("i", 0, "big")], client_id=2**40)
+def test_big_client_id_rides_fast_lane():
+    """Real Yjs client ids (random 53-bit) resolve through the device
+    varint-byte hash table — no host fallback (VERDICT r1: B4.2 lane)."""
+    log, expect = _edit_log(
+        [("i", 0, "big"), ("i", 3, " ids"), ("d", 0, 1)], client_id=2**40 + 7
+    )
     ing = BatchIngestor(n_docs=1, capacity=128)
-    ing.apply_bytes([log[0]])
-    assert ing.fast_docs == 0 and ing.slow_docs == 1
+    for p in log:
+        ing.apply_bytes([p])
+        assert _flags_clean(ing)
+    assert ing.fast_docs == len(log) and ing.slow_docs == 0
     assert get_string(ing.state, 0, ing.payloads) == expect
+    u = Doc(client_id=1)
+    for p in log:
+        u.apply_update_v1(p)
+    assert dict(ing.svs[0].clocks) == dict(u.state_vector().clocks)
 
 
 @needs_native

@@ -10,7 +10,7 @@ the Yjs API shape ports line for line. All byte formats are wire-compatible
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, List, Optional
 
 from ytpu.core import Doc, Snapshot, StateVector, Update
 from ytpu.core.moving import StickyIndex
@@ -23,6 +23,7 @@ __all__ = [
     "apply_update_v2",
     "merge_updates",
     "merge_updates_v2",
+    "split_update",
     "diff_updates",
     "diff_updates_v2",
     "encode_state_vector_from_update",
@@ -80,6 +81,46 @@ def merge_updates_v2(*updates: bytes) -> bytes:
     from ytpu.core.update import merge_updates_v2 as _merge
 
     return _merge(list(updates))
+
+
+def split_update(update: bytes, max_blocks: int) -> List[bytes]:
+    """Split one V1 update into a causal sequence of smaller updates of at
+    most `max_blocks` block carriers each (the delete set rides on the
+    last piece — deletes must follow the content they tombstone).
+
+    The inverse of `merge_updates` for streaming-ingest purposes: a huge
+    snapshot update (e.g. the 400KB B4.2 input, benches.rs:456-477) can be
+    fed through row-bounded batch steps; applying the pieces in order is
+    equivalent to applying the original (out-of-order cross-client
+    references fall back to the engine's pending stash, exactly like
+    partial delivery)."""
+    from ytpu.core.update import Update as _U
+
+    u = Update.decode_v1(update)
+    pieces: List[bytes] = []
+    chunk: Dict[int, list] = {}
+    count = 0
+
+    def flush():
+        nonlocal chunk, count
+        if count:
+            pieces.append(_U({c: list(q) for c, q in chunk.items()}).encode_v1())
+        chunk = {}
+        count = 0
+
+    # wire convention: higher client ids first (store.rs:161-163)
+    for client in sorted(u.blocks, reverse=True):
+        for carrier in u.blocks[client]:
+            chunk.setdefault(client, []).append(carrier)
+            count += 1
+            if count >= max_blocks:
+                flush()
+    flush()
+    if not u.delete_set.is_empty():
+        pieces.append(_U({}, u.delete_set).encode_v1())
+    if not pieces:
+        pieces.append(_U().encode_v1())
+    return pieces
 
 
 def diff_updates(update: bytes, vector: bytes) -> bytes:

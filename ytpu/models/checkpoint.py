@@ -145,11 +145,16 @@ def load_ingestor(path: str) -> BatchIngestor:
     ing.slow_docs = 0
     ing.fast_recoveries = 0
     ing._last_fast_flags = None
-    # rebuild the device key-hash table from the restored interner
+    # rebuild the device hash tables from the restored interners
     ing._key_hashes = {}
     ing._key_collisions = set()
     for key in ing.enc.keys.ids:
         ing._register_key(key)
+    ing._client_hashes = {}
+    ing._client_id_collisions = set()
+    for cid in ing.enc.interner.from_idx:
+        if cid > 2**31 - 1:
+            ing._register_big_client(cid)
     return ing
 
 

@@ -37,6 +37,18 @@ from ytpu.ops.decode_kernel import ChunkedWirePayloads, steps_for_columns
 
 __all__ = ["BatchIngestor"]
 
+
+def _sorted_table(mapping: Dict[int, int]):
+    """(sorted keys, value perm) as device i32 arrays — the shape every
+    device lookup table (clients, key hashes, client hashes) shares."""
+    import jax.numpy as jnp
+
+    ks = sorted(mapping)
+    return (
+        jnp.asarray(np.asarray(ks, dtype=np.int32)),
+        jnp.asarray(np.asarray([mapping[k] for k in ks], dtype=np.int32)),
+    )
+
 # content kinds the device decoder handles: GC, Deleted, Json, Binary,
 # String, Embed, Format, Any(scalar), Skip
 _FAST_KINDS = frozenset((0, 1, 2, 3, 4, 5, 6, 8, 10))
@@ -71,6 +83,10 @@ class BatchIngestor:
         # keys whose hash collides with a different key take the host lane
         self._key_hashes: Dict[int, int] = {}
         self._key_collisions: set = set()
+        # device big-client hashing (ids beyond i32): varint-byte hash ->
+        # interned idx; colliding ids take the host lane
+        self._client_hashes: Dict[int, int] = {}
+        self._client_id_collisions: set = set()
 
     # --- introspection (parity: ytransaction_pending_update/_ds shape) -------
 
@@ -218,12 +234,12 @@ class BatchIngestor:
                 pic, pik = int(cols.parent_id_client[i]), int(
                     cols.parent_id_clock[i]
                 )
-                if pic > _I32_MAX or pik >= cov(pic):
+                if not self._client_ok(pic) or pik >= cov(pic):
                     return False
             c = int(cols.client[i])
             ck = int(cols.clock[i])
             ln = int(cols.length[i])
-            if c > _I32_MAX or ck + ln > _I32_MAX:
+            if not self._client_ok(c) or ck + ln > _I32_MAX:
                 return False
             if ck > cov(c):
                 return False  # clock gap → pending semantics needed
@@ -231,19 +247,48 @@ class BatchIngestor:
                 ok = int(cols.origin_clock[i])
                 if ok >= 0:
                     oc = int(cols.origin_client[i])
-                    if oc > _I32_MAX or ok >= cov(oc):
+                    if not self._client_ok(oc) or ok >= cov(oc):
                         return False
                 rk = int(cols.ror_clock[i])
                 if rk >= 0:
                     rc = int(cols.ror_client[i])
-                    if rc > _I32_MAX or rk >= cov(rc):
+                    if not self._client_ok(rc) or rk >= cov(rc):
                         return False
                 covered[c] = max(cov(c), ck + ln)
         for i in range(cols.n_dels):
             c = int(cols.del_client[i])
-            if c > _I32_MAX or int(cols.del_end[i]) > cov(c):
+            if not self._client_ok(c) or int(cols.del_end[i]) > cov(c):
                 return False
         return True
+
+    def _client_ok(self, client: int) -> bool:
+        """Small ids ride raw; ids beyond i32 (real Yjs clients) must
+        resolve through the device hash table — register, reject on
+        collision (host lane)."""
+        if client <= _I32_MAX:
+            return True
+        return self._register_big_client(client)
+
+    def _register_big_client(self, client: int) -> bool:
+        from ytpu.ops.decode_kernel import client_hash_host
+
+        if client in self._client_id_collisions:
+            return False
+        idx = self.enc.interner.intern(client)
+        h = client_hash_host(client)
+        prev = self._client_hashes.get(h)
+        if prev is not None and prev != idx:
+            self._client_id_collisions.add(client)
+            self._client_id_collisions.add(self.enc.interner.from_idx[prev])
+            del self._client_hashes[h]
+            return False
+        self._client_hashes[h] = idx
+        return True
+
+    def _client_hash_table(self):
+        """Device big-client table: (sorted varint-byte hashes, interned
+        idx perm)."""
+        return _sorted_table(self._client_hashes)
 
     def _register_key(self, key: str) -> bool:
         """Intern `key` and record its device hash; False on collision."""
@@ -266,35 +311,22 @@ class BatchIngestor:
 
     def _key_table(self):
         """Device key table: (sorted hashes, interned key idx perm)."""
-        import jax.numpy as jnp
-
-        hs = sorted(self._key_hashes)
-        return (
-            jnp.asarray(np.asarray(hs, dtype=np.int32)),
-            jnp.asarray(
-                np.asarray([self._key_hashes[h] for h in hs], dtype=np.int32)
-            ),
-        )
+        return _sorted_table(self._key_hashes)
 
     def _client_table(self):
         """Device intern table: (sorted raw ids, perm to interned idx).
 
-        Ids above int32 (random 53-bit Yjs clients) are excluded — the
-        fast lane never references them (`_fast_eligible` routes such
-        updates to the host lane), and including them would overflow the
-        i32 table."""
+        Ids above int32 (random 53-bit Yjs clients) are excluded here —
+        they resolve through the varint-byte hash table instead
+        (`_client_hash_table`)."""
         import jax.numpy as jnp
 
         ids = sorted(
             c for c in self.enc.interner.to_idx if 0 <= c <= _I32_MAX
         )
-        sorted_ids = jnp.asarray(np.asarray(ids, dtype=np.int32))
-        perm = jnp.asarray(
-            np.asarray(
-                [self.enc.interner.to_idx[c] for c in ids], dtype=np.int32
-            )
+        return _sorted_table(
+            {c: self.enc.interner.to_idx[c] for c in ids}
         )
-        return sorted_ids, perm
 
     def apply_bytes(self, payloads: List[Optional[bytes]]) -> DocStateBatch:
         """One batched step straight from V1 wire bytes.
@@ -479,6 +511,7 @@ class BatchIngestor:
             client_table=self._client_table(),
             max_sections=max_sections,
             key_table=self._key_table(),
+            client_hash_table=self._client_hash_table(),
         )
         is_str_ref = stream.valid & (stream.content_ref >= 0)
         lane = jnp.arange(S, dtype=jnp.int32)[:, None]

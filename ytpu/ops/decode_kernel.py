@@ -27,18 +27,23 @@ block.rs:1786-1835, id_set.rs decode):
                 [ parent_sub:str ]  if info & 0xC0 == 0 and info & 0x20
                 content
     content  := GC len:var | Skip len:var | Deleted len:var | String str
-                (other kinds → host fallback, flagged)
+                | Any n:var value{token}* | Json n:var str* | Embed str
+                | Binary buf | Format key:str value:str
+                (ContentType / Doc / Move → host fallback, flagged)
     delete_set := n_clients:var ( client:var n_ranges:var (clock:var len:var)* )*
 
-Supported on-device: GC / Skip / Deleted / String blocks with root or
-ID parents — i.e. the entire live text-editing data plane. Anything else
-(map rows with parent_sub, embeds, Any payloads, moves, subdocs) flags
-the update for the host decoder (`ytpu.core.Update.decode_v1`); flagged
-updates lose nothing — they take the exact host path they take today.
+Supported on-device: GC / Skip / Deleted / String / scalar+array Any /
+Json / Embed / Binary / Format blocks with root, ID, or nested parents,
+including map rows — parent_sub keys resolve through a host-verified
+hash table (`key_table`), and client ids beyond i32 (real 53-bit Yjs
+ids) through a varint-byte hash table (`client_hash_table`). The
+remaining host-lane shapes: map-valued Any, oversized keys, ContentType
+/ Doc / Move. Flagged updates lose nothing — they take the exact host
+path they take today.
 
-Client ids are kept *raw* (no interning): YATA's tie-break is monotone
-in the client id itself, so with raw ids the rank table for the fused
-kernel is the identity (`identity_rank`). Ids ≥ 2^31 flag the update.
+Without tables, client ids are kept *raw*: YATA's tie-break is monotone
+in the client id itself, so the rank table for the fused kernel is the
+identity (`identity_rank`).
 """
 
 from __future__ import annotations
@@ -183,6 +188,26 @@ def key_hash_host(key: bytes) -> int:
     return h & 0x7FFFFFFF
 
 
+def client_hash_host(client: int) -> int:
+    """Hash of a client id's varint wire bytes — how the device refers to
+    ids beyond i32 (real Yjs clients are random 53-bit). Must match the
+    kernel's in-window mixing; results live in [0, 2^30)."""
+    h = 0
+    i = 0
+    v = client
+    while True:
+        byte = v & 0x7F
+        v >>= 7
+        if v:
+            byte |= 0x80
+        h = (h + byte * pow(31, i, 1 << 32)) & 0xFFFFFFFF
+        i += 1
+        if not v:
+            break
+    h ^= (i * 2654435761) & 0xFFFFFFFF
+    return h & 0x3FFFFFFF
+
+
 def exact_steps(
     n_client_sections: int,
     n_item_blocks: int,
@@ -234,6 +259,7 @@ def decode_updates_v1(
     client_table: Optional[Tuple[jax.Array, jax.Array]] = None,
     max_sections: Optional[int] = None,
     key_table: Optional[Tuple[jax.Array, jax.Array]] = None,
+    client_hash_table: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[UpdateBatch, jax.Array]:
     """Decode S updates into an ``[S, U] / [S, R]`` UpdateBatch stream.
 
@@ -253,6 +279,12 @@ def decode_updates_v1(
     table and collision-free (collisions route to the host lane). Lanes
     with a map row but no table — or a hash miss — flag
     ``FLAG_UNKNOWN_KEY``.
+
+    ``client_hash_table=(sorted_hashes, perm)`` resolves client ids
+    beyond i32 (real Yjs ids are random 53-bit): the kernel hashes the
+    id's varint bytes in-window (`client_hash_host`) and the table maps
+    hash -> interned index. Without the table such lanes flag
+    ``FLAG_BIG_CLIENT``; a miss flags ``FLAG_UNKNOWN_CLIENT``.
 
     ``max_sections`` bounds the client-section header (default ``max_rows
     + 1``). Wire-legal updates can carry more sections than emitted rows
@@ -457,7 +489,24 @@ def decode_updates_v1(
             (st == ST_CLIENT) | (st == ST_ORIGIN_C) | (st == ST_ROR_C)
             | (st == ST_PARENT_ID_C) | (st == ST_DS_CLIENT)
         )
-        big_client = active & ovf & is_client_st
+        # client ids beyond i32 (ovf at a client state) are represented by
+        # a hash of their varint bytes, encoded as -2 - hash (< -1); the
+        # post-loop table lookup resolves them to interned indices
+        cmask = jnp.arange(10, dtype=I32)[None, :] < nbytes[:, None]
+        pow31_10 = jnp.asarray(
+            np.array([pow(31, i, 1 << 32) for i in range(10)], dtype=np.uint32)
+        )
+        chash = jnp.sum(
+            jnp.where(cmask, bytes10.astype(U32) * pow31_10[None, :], 0).astype(
+                U32
+            ),
+            axis=1,
+        )
+        chash = (
+            (chash ^ (nbytes.astype(U32) * jnp.uint32(2654435761)))
+            & jnp.uint32(0x3FFFFFFF)
+        ).astype(I32)
+        vc = jnp.where(is_client_st & ovf, -2 - chash, v)
         bad = active & (
             (pos_after > lens)
             # a string length > L would wrap `pos + v` past int32 and slip
@@ -467,7 +516,7 @@ def decode_updates_v1(
             | (ovf & ~is_info & ~is_client_st & ~is_any_val)
             | ((st == ST_NCLIENTS) & (v > max_sec))  # absurd header: garbage
         )
-        act = active & ~bad & ~big_client
+        act = active & ~bad
 
         def on(s):
             return act & (st == s)
@@ -639,7 +688,6 @@ def decode_updates_v1(
         # item with neither origin flag whose dispatch happens after parent
         st2 = upd(st2, unsupported, ST_ERR)
         st2 = upd(st2, bad, ST_ERR)
-        st2 = upd(st2, big_client, ST_ERR)
 
         # --- registers ------------------------------------------------------
         regs2 = dict(regs)
@@ -647,7 +695,7 @@ def decode_updates_v1(
         regs2["st"] = st2
         regs2["clients_left"] = upd(clients_left2, nclients_hdr, v)
         regs2["blocks_left"] = upd(blocks_left2, on(ST_NBLOCKS), v)
-        regs2["client"] = upd(regs["client"], on(ST_CLIENT), v)
+        regs2["client"] = upd(regs["client"], on(ST_CLIENT), vc)
         clock2 = upd(regs["clock"], on(ST_CLOCK), v)
         regs2["clock"] = upd(clock2, block_end, clock2 + blk_len)
         regs2["keyh"] = upd(
@@ -662,23 +710,22 @@ def decode_updates_v1(
         regs2["info"] = upd(regs["info"], on(ST_INFO), v)
         # reset per-item registers when a new info byte arrives
         fresh = on(ST_INFO)
-        regs2["oc"] = upd(upd(regs["oc"], fresh, -1), on(ST_ORIGIN_C), v)
+        regs2["oc"] = upd(upd(regs["oc"], fresh, -1), on(ST_ORIGIN_C), vc)
         regs2["ok"] = upd(upd(regs["ok"], fresh, 0), on(ST_ORIGIN_K), v)
-        regs2["rc"] = upd(upd(regs["rc"], fresh, -1), on(ST_ROR_C), v)
+        regs2["rc"] = upd(upd(regs["rc"], fresh, -1), on(ST_ROR_C), vc)
         regs2["rk"] = upd(upd(regs["rk"], fresh, 0), on(ST_ROR_K), v)
         ptag2 = upd(regs["ptag"], fresh, 0)
         regs2["ptag"] = upd(ptag2, on(ST_PARENT_INFO), jnp.where(v == 1, 1, 2))
-        regs2["pc"] = upd(upd(regs["pc"], fresh, -1), on(ST_PARENT_ID_C), v)
+        regs2["pc"] = upd(upd(regs["pc"], fresh, -1), on(ST_PARENT_ID_C), vc)
         regs2["pk"] = upd(upd(regs["pk"], fresh, 0), on(ST_PARENT_ID_K), v)
         regs2["ds_clients_left"] = upd(ds_clients_left2, on(ST_DS_NCLIENTS), v)
         regs2["ds_ranges_left"] = upd(ds_ranges_left2, on(ST_DS_NRANGES), v)
-        regs2["ds_client"] = upd(regs["ds_client"], on(ST_DS_CLIENT), v)
+        regs2["ds_client"] = upd(regs["ds_client"], on(ST_DS_CLIENT), vc)
         regs2["ds_clock"] = upd(regs["ds_clock"], on(ST_DS_CLOCK), v)
 
         flags2 = (
             regs["flags"]
             | jnp.where(bad, FLAG_MALFORMED, 0)
-            | jnp.where(big_client, FLAG_BIG_CLIENT, 0)
             | jnp.where(unsupported, FLAG_UNSUPPORTED, 0)
             | jnp.where(nclients_hdr & (v > 1), FLAG_MULTI_CLIENT, 0)
         )
@@ -747,10 +794,20 @@ def decode_updates_v1(
         sorted_ids, perm = client_table
         K = sorted_ids.shape[0]
         if K == 0:
-            any_rows = jnp.any(rows["valid"], axis=1) | jnp.any(
-                dels["valid"], axis=1
+            # empty raw table: only lanes using RAW (>= 0) ids are unknown
+            # — hashed big-client entries (<= -2) resolve below
+            raw_used = jnp.zeros((S,), bool)
+            for name, used in (
+                ("client", rows["valid"]),
+                ("oc", rows["valid"]),
+                ("rc", rows["valid"]),
+                ("pc", rows["valid"]),
+            ):
+                raw_used = raw_used | jnp.any(used & (rows[name] >= 0), axis=1)
+            raw_used = raw_used | jnp.any(
+                dels["valid"] & (dels["client"] >= 0), axis=1
             )
-            flags = flags | jnp.where(any_rows, FLAG_UNKNOWN_CLIENT, 0)
+            flags = flags | jnp.where(raw_used, FLAG_UNKNOWN_CLIENT, 0)
             client_table = None
 
     if client_table is not None:
@@ -759,7 +816,10 @@ def decode_updates_v1(
             j = jnp.clip(jnp.searchsorted(sorted_ids, arr), 0, max(K - 1, 0))
             hit = (sorted_ids[j] == arr) & (arr >= 0)
             unknown = used & (arr >= 0) & ~hit
-            return jnp.where(hit, perm[j], -1), jnp.any(unknown, axis=1)
+            # hashed big-client entries (<= -2) pass through to the hash
+            # resolution below
+            out = jnp.where(hit, perm[j], jnp.where(arr <= -2, arr, -1))
+            return out, jnp.any(unknown, axis=1)
 
         unk = jnp.zeros((S,), bool)
         for name, used in (
@@ -773,6 +833,45 @@ def decode_updates_v1(
         dels["client"], u = map_ids(dels["client"], dels["valid"])
         unk = unk | u
         flags = flags | jnp.where(unk, FLAG_UNKNOWN_CLIENT, 0)
+
+    # big-client hash entries -> interned indices (client_hash_table), or
+    # FLAG_BIG_CLIENT when no table can resolve them
+    cht = client_hash_table
+    if cht is not None and cht[0].shape[0] == 0:
+        cht = None
+
+    def map_hashed(arr, used):
+        hashed = arr <= -2
+        if cht is None:
+            return arr, jnp.any(used & hashed, axis=1), jnp.zeros((S,), bool)
+        hh, hperm = cht
+        KH = hh.shape[0]
+        hv = -2 - arr
+        j = jnp.clip(jnp.searchsorted(hh, hv), 0, KH - 1)
+        hit = hashed & (hh[j] == hv)
+        out = jnp.where(hit, hperm[j], arr)
+        miss = jnp.any(used & hashed & ~hit, axis=1)
+        return out, jnp.zeros((S,), bool), miss
+
+    bigf = jnp.zeros((S,), bool)
+    unkh = jnp.zeros((S,), bool)
+    for name, used in (
+        ("client", rows["valid"]),
+        ("oc", rows["valid"]),
+        ("rc", rows["valid"]),
+        ("pc", rows["valid"]),
+    ):
+        rows[name], b, m = map_hashed(rows[name], used)
+        bigf = bigf | b
+        unkh = unkh | m
+    dels["client"], b, m = map_hashed(dels["client"], dels["valid"])
+    bigf = bigf | b
+    unkh = unkh | m
+    flags = (
+        flags
+        | jnp.where(bigf, FLAG_BIG_CLIENT, 0)
+        | jnp.where(unkh, FLAG_UNKNOWN_CLIENT, 0)
+    )
 
     # parent_sub key hashes -> interned key indices (map rows on device)
     has_key = rows["valid"] & (rows["keyh"] >= 0)

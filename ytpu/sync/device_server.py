@@ -1,27 +1,50 @@
 """Device-backed sync server: y-sync tenants fanned into batch engine slots.
 
 This closes the north-star loop (SURVEY §0 / BASELINE): clients speak the
-y-sync protocol to `SyncServer`; every update a tenant doc applies is also
-queued for its device slot and shipped to the batched engine through
+y-sync protocol to `SyncServer`; updates land in the batched engine through
 `BatchIngestor` — one `apply_update_batch` dispatch integrates one queued
-update per tenant. The host tenant docs remain the protocol endpoints
-(diffs, awareness, observers); the device batch is the scalable compute
-plane over the same wire bytes, with the ingestor's pending semantics
-absorbing out-of-order arrival per slot without stalling the batch.
+update per tenant, with the ingestor's pending semantics absorbing
+out-of-order arrival per slot without stalling the batch.
+
+Two serving modes:
+
+- mirrored (default, round-1 behavior): host tenant docs remain the
+  protocol endpoints (diffs via `Doc.encode_state_as_update_v1`); the
+  device batch shadows them. Every update integrates twice — useful when
+  host-side observers/types must stay live, but the host is the
+  bottleneck.
+- **device-authoritative** (`device_authoritative=True`): the device
+  batch IS the document store. SyncStep1 is answered from device state
+  via `encode_diff_batch` + `finish_encode_diff` (store.rs:204-248
+  semantics over block columns), incoming updates are queued straight to
+  the slot without a host apply, and the host tenant doc is demoted to
+  an awareness/metadata anchor that never sees document content. This is
+  the serving loop where the batch engine adds capacity instead of
+  shadowing the host (VERDICT r1 #7).
 """
 
 from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import numpy as np
+
+from ytpu.core.state_vector import StateVector
 from ytpu.models.ingest import BatchIngestor
-from ytpu.sync.server import DeviceBatchFull, SyncServer
+from ytpu.sync.protocol import (
+    MSG_SYNC,
+    MSG_SYNC_STEP_1,
+    Message,
+    SyncMessage,
+    message_reader,
+)
+from ytpu.sync.server import DeviceBatchFull, Session, SyncServer
 
 __all__ = ["DeviceBatchFull", "DeviceSyncServer"]
 
 
 class DeviceSyncServer(SyncServer):
-    """A SyncServer whose tenants mirror into device doc slots.
+    """A SyncServer whose tenants live in device doc slots.
 
     `n_docs` bounds the tenant count (one slot per tenant, assigned on
     first touch). Updates accumulate per slot and ship on `flush_device()`
@@ -35,6 +58,7 @@ class DeviceSyncServer(SyncServer):
         n_docs: Optional[int] = None,
         capacity: int = 2048,
         ingestor: Optional[BatchIngestor] = None,
+        device_authoritative: bool = False,
         **kwargs,
     ):
         super().__init__(**kwargs)
@@ -44,6 +68,7 @@ class DeviceSyncServer(SyncServer):
             ingestor = BatchIngestor(n_docs, capacity)
         # the ingestor is the single source of truth for the slot count
         self.ingestor = ingestor
+        self.device_authoritative = device_authoritative
         self._slot_of: Dict[str, int] = {}
         self._queues: List[List[bytes]] = [
             [] for _ in range(ingestor.n_docs)
@@ -76,13 +101,121 @@ class DeviceSyncServer(SyncServer):
             # registers, or retries would create an unmirrored ghost tenant
             slot = self._assign_slot(name)
         t = super().tenant(name)
-        if first_touch:
-
+        if first_touch and not self.device_authoritative:
+            # mirrored mode: shadow every host apply into the device queue
+            # (device-authoritative tenants queue in receive_frames and
+            # never touch the host doc)
             def mirror(payload: bytes, origin, txn, _slot=slot):
                 self._queues[_slot].append(payload)
 
             t.awareness.doc.observe_update_v1(mirror)
         return t
+
+    # --- device-authoritative protocol path ------------------------------------
+
+    def connect_frames(self, tenant_name: str):
+        if not self.device_authoritative:
+            return super().connect_frames(tenant_name)
+        t = self.tenant(tenant_name)
+        self._next_session += 1
+        session = Session(self._next_session, tenant_name, self)
+        t.sessions.append(session)
+        # greeting SyncStep1 carries the DEVICE state vector (flush first
+        # so queued updates are reflected in the mirror)
+        self.flush_device()
+        sv = self.device_state_vector(tenant_name)
+        return session, [
+            Message.sync(SyncMessage.step1(sv)).encode_v1(),
+            Message.awareness(t.awareness.update()).encode_v1(),
+        ]
+
+    def receive_frames(self, session: Session, data: bytes) -> List[bytes]:
+        if not self.device_authoritative:
+            return super().receive_frames(session, data)
+        t = self.tenant(session.tenant)
+        slot = self.slot_of(session.tenant)
+        replies: List[bytes] = []
+        for msg in message_reader(data):
+            if msg.kind == MSG_SYNC:
+                sub: SyncMessage = msg.body
+                if sub.tag == MSG_SYNC_STEP_1:
+                    diff = self.device_encode_diff(session.tenant, sub.payload)
+                    replies.append(
+                        Message.sync(SyncMessage.step2(diff)).encode_v1()
+                    )
+                else:  # SyncStep2 / Update: straight to the device slot
+                    self._queues[slot].append(sub.payload)
+                    self._applied.inc()
+                    # broadcast at-least-once (idempotent CRDT updates;
+                    # the host path dedups via observer events, the device
+                    # path trades that for never touching a host doc)
+                    frame = Message.sync(
+                        SyncMessage.update(sub.payload)
+                    ).encode_v1()
+                    for other in t.sessions:
+                        if other is not session:
+                            other.outbox.append(frame)
+                continue
+            reply = self.protocol.handle_message(t.awareness, msg)
+            if reply is not None:
+                replies.append(reply.encode_v1())
+        return replies
+
+    def device_state_vector(self, tenant_name: str) -> StateVector:
+        """The device mirror's state vector for one tenant (real ids)."""
+        slot = self.slot_of(tenant_name)
+        return StateVector(dict(self.ingestor.svs[slot].clocks))
+
+    def device_encode_diff(
+        self, tenant_name: str, remote_sv: StateVector
+    ) -> bytes:
+        """Sync step 2 answered from device state: `encode_diff_batch`
+        masks/offsets on device, the host finisher emits wire bytes from
+        the block columns + payload buffers, and any pending stash folds
+        in exactly like the reference's merge_pending (transaction.rs:
+        247-263)."""
+        import jax.numpy as jnp
+
+        from ytpu.models.batch_doc import encode_diff_batch, finish_encode_diff
+
+        self.flush_device()
+        ing = self.ingestor
+        slot = self.slot_of(tenant_name)
+        interner = ing.enc.interner
+        n_clients = 1
+        while n_clients < max(2, len(interner)):
+            n_clients *= 2
+        remote = np.zeros((ing.n_docs, n_clients), dtype=np.int32)
+        for client, clock in remote_sv:
+            idx = interner.to_idx.get(client)
+            if idx is not None and idx < n_clients:
+                remote[slot, idx] = clock
+        ship, offsets, _local, deleted = encode_diff_batch(
+            ing.state, jnp.asarray(remote), n_clients
+        )
+        payload = finish_encode_diff(
+            ing.state,
+            slot,
+            np.asarray(ship),
+            np.asarray(offsets),
+            np.asarray(deleted),
+            ing.enc,
+            payloads=ing.payloads,
+        )
+        pending = ing.pending_update(slot)
+        pending_ds = ing.pending_ds(slot)
+        if pending is not None or pending_ds is not None:
+            from ytpu.compat import merge_updates
+            from ytpu.core.update import Update as _U
+
+            extras = []
+            if pending is not None:
+                extras.append(pending.encode_v1())
+            if pending_ds is not None:
+                # stashed delete ranges must reach fresh replicas too
+                extras.append(_U({}, pending_ds).encode_v1())
+            payload = merge_updates(payload, *extras)
+        return payload
 
     # --- device dispatch -------------------------------------------------------
 
@@ -99,9 +232,11 @@ class DeviceSyncServer(SyncServer):
         steps = 0
         while any(self._queues) and (max_steps is None or steps < max_steps):
             # peek, apply, THEN pop — a failing step must not drop the other
-            # slots' already-dequeued updates
+            # slots' already-dequeued updates. The apply histogram times the
+            # real device step here (the SLO metric), not the enqueue.
             payloads = [q[0] if q else None for q in self._queues]
-            self.ingestor.apply_bytes(payloads)
+            with self._apply_hist.time():
+                self.ingestor.apply_bytes(payloads)
             for q in self._queues:
                 if q:
                     q.pop(0)
