@@ -1367,6 +1367,65 @@ static void test_recursive_yinput() {
   ydoc_destroy(doc);
 }
 
+// --- by-value YOutput (yffi ABI-shape parity) --------------------------------
+static void test_byvalue_youtput() {
+  YDoc *doc = ydoc_new();
+  Branch *map = ymap(doc, "bv");
+  YTransaction *txn = ydoc_write_transaction(doc, 0, nullptr);
+  YInput elems[4] = {yinput_long(7), yinput_string("str"), yinput_bool(1),
+                     yinput_null()};
+  YInput arr = yinput_json_array(elems, 4);
+  ymap_insert(map, txn, "list", &arr);
+  YInput name = yinput_string("ada");
+  ymap_insert(map, txn, "name", &name);
+  YInput num = yinput_float(2.25);
+  ymap_insert(map, txn, "score", &num);
+  ytransaction_commit(txn);
+
+  YOutput *out = ymap_get(map, nullptr, "list");
+  CHECK(out != nullptr);
+  YOutputValue v = youtput_unwrap(out);
+  CHECK(v.tag == Y_JSON_ARR);
+  CHECK(v.len == 4);
+  if (v.tag == Y_JSON_ARR && v.len == 4 && v.value.array) {
+    CHECK(v.value.array[0].tag == Y_JSON_INT);
+    CHECK(v.value.array[0].value.integer == 7);
+    CHECK(v.value.array[1].tag == Y_JSON_STR);
+    CHECK_STR(strdup(v.value.array[1].value.str), "str");  // dup: destroy frees the tree
+    CHECK(v.value.array[2].tag == Y_JSON_BOOL);
+    CHECK(v.value.array[2].value.flag == 1);
+    CHECK(v.value.array[3].tag == Y_JSON_NULL);
+  }
+  youtput_value_destroy(v);
+  youtput_destroy(out);
+
+  out = ymap_get(map, nullptr, "score");
+  CHECK(out != nullptr);
+  v = youtput_unwrap(out);
+  CHECK(v.tag == Y_JSON_NUM);
+  CHECK(v.len == 1);
+  CHECK(v.value.num == 2.25);
+  youtput_value_destroy(v);
+  youtput_destroy(out);
+
+  // a shared-type leaf comes back as a usable opaque Branch handle
+  YInput nested = yinput_ytext("hello");
+  txn = ydoc_write_transaction(doc, 0, nullptr);
+  ymap_insert(map, txn, "t", &nested);
+  ytransaction_commit(txn);
+  out = ymap_get(map, nullptr, "t");
+  CHECK(out != nullptr);
+  v = youtput_unwrap(out);
+  CHECK(v.tag == Y_TEXT);
+  if (v.tag == Y_TEXT && v.value.y_type) {
+    char *s = ytext_string(v.value.y_type, nullptr);
+    CHECK_STR(s, "hello");
+  }
+  youtput_value_destroy(v);
+  youtput_destroy(out);
+  ydoc_destroy(doc);
+}
+
 int main() {
   test_doc_lifecycle();
   test_text_basic();
@@ -1396,6 +1455,7 @@ int main() {
   test_undo_observers();
   test_json_outputs();
   test_recursive_yinput();
+  test_byvalue_youtput();
 
   std::printf("%d checks, %d failures\n", g_checks, g_failures);
   return g_failures == 0 ? 0 : 1;

@@ -771,6 +771,140 @@ extern "C" void youtput_destroy(YOutput *val) {
   delete val;
 }
 
+/* ---- by-value YOutput (yffi ABI-shape parity) ------------------------------ */
+
+static YOutputValue py_to_output_value(PyObject *obj);
+
+static YOutputValue output_value_tagged(int8_t tag) {
+  YOutputValue v;
+  memset(&v, 0, sizeof(v));
+  v.tag = tag;
+  v.len = 0;
+  return v;
+}
+
+static YOutputValue py_to_output_value(PyObject *obj) {
+  if (!obj || obj == Py_None) return output_value_tagged(Y_JSON_NULL);
+  YOutputValue v = output_value_tagged(Y_JSON_UNDEF);
+  if (PyBool_Check(obj)) {
+    v.tag = Y_JSON_BOOL;
+    v.len = 1;
+    v.value.flag = obj == Py_True ? 1 : 0;
+    return v;
+  }
+  if (PyLong_Check(obj)) {
+    v.tag = Y_JSON_INT;
+    v.len = 1;
+    v.value.integer = PyLong_AsLongLong(obj);
+    return v;
+  }
+  if (PyFloat_Check(obj)) {
+    v.tag = Y_JSON_NUM;
+    v.len = 1;
+    v.value.num = PyFloat_AsDouble(obj);
+    return v;
+  }
+  if (PyUnicode_Check(obj)) {
+    v.tag = Y_JSON_STR;
+    v.len = 1;
+    const char *c = PyUnicode_AsUTF8(obj);
+    v.value.str = dup_str(c ? c : "");
+    return v;
+  }
+  if (PyBytes_Check(obj)) {
+    v.tag = Y_JSON_BUF;
+    Py_ssize_t n = PyBytes_GET_SIZE(obj);
+    v.len = (uint32_t)n;
+    v.value.buf = (uint8_t *)malloc(n ? (size_t)n : 1);
+    if (v.value.buf && n) memcpy(v.value.buf, PyBytes_AS_STRING(obj), (size_t)n);
+    return v;
+  }
+  if (PyList_Check(obj)) {
+    v.tag = Y_JSON_ARR;
+    Py_ssize_t n = PyList_GET_SIZE(obj);
+    v.len = (uint32_t)n;
+    v.value.array =
+        (YOutputValue *)calloc(n ? (size_t)n : 1, sizeof(YOutputValue));
+    for (Py_ssize_t i = 0; i < n && v.value.array; i++)
+      v.value.array[i] = py_to_output_value(PyList_GET_ITEM(obj, i));
+    return v;
+  }
+  if (PyDict_Check(obj)) {
+    v.tag = Y_JSON_MAP;
+    Py_ssize_t n = PyDict_Size(obj);
+    v.len = (uint32_t)n;
+    v.value.map =
+        (YMapEntryValue *)calloc(n ? (size_t)n : 1, sizeof(YMapEntryValue));
+    PyObject *key, *value;
+    Py_ssize_t pos = 0, i = 0;
+    while (v.value.map && PyDict_Next(obj, &pos, &key, &value) && i < n) {
+      const char *k = PyUnicode_Check(key) ? PyUnicode_AsUTF8(key) : nullptr;
+      v.value.map[i].key = dup_str(k ? k : "");
+      v.value.map[i].value = py_to_output_value(value);
+      i++;
+    }
+    return v;
+  }
+  /* shared types / nested docs: wrap the same opaque handles the rest of
+   * the API uses */
+  PyObject *r = support_call("output_tag", "(O)", obj);
+  int8_t tag = Y_JSON_UNDEF;
+  if (r) {
+    tag = (int8_t)PyLong_AsLong(r);
+    Py_DECREF(r);
+  }
+  v.tag = tag;
+  if (tag == Y_DOC) {
+    Py_INCREF(obj);
+    v.len = 1;
+    v.value.y_doc = new YDoc{obj};
+  } else if (tag > 0) {  /* Y_ARRAY..Y_WEAK_LINK: a Branch view */
+    Py_INCREF(obj);
+    v.len = 1;
+    v.value.y_type = new Branch{obj};
+  }
+  return v;
+}
+
+extern "C" YOutputValue youtput_unwrap(const YOutput *val) {
+  Gil gil;
+  if (!gil.ok || !val) return output_value_tagged(Y_JSON_UNDEF);
+  return py_to_output_value(val->obj);
+}
+
+extern "C" void youtput_value_destroy(YOutputValue val) {
+  switch (val.tag) {
+    case Y_JSON_STR:
+      free(val.value.str);
+      return;
+    case Y_JSON_BUF:
+      free(val.value.buf);
+      return;
+    case Y_JSON_ARR:
+      if (val.value.array) {
+        for (uint32_t i = 0; i < val.len; i++)
+          youtput_value_destroy(val.value.array[i]);
+        free(val.value.array);
+      }
+      return;
+    case Y_JSON_MAP:
+      if (val.value.map) {
+        for (uint32_t i = 0; i < val.len; i++) {
+          free(val.value.map[i].key);
+          youtput_value_destroy(val.value.map[i].value);
+        }
+        free(val.value.map);
+      }
+      return;
+    case Y_DOC:
+      ydoc_destroy(val.value.y_doc);
+      return;
+    default:
+      if (val.tag > 0 && val.value.y_type) ybranch_destroy(val.value.y_type);
+      return;
+  }
+}
+
 /* ---- YText ------------------------------------------------------------------ */
 extern "C" uint32_t ytext_len(Branch *txt, YTransaction *txn) {
   (void)txn;

@@ -329,6 +329,42 @@ Branch *youtput_read_yxmltext(YOutput *val);
 YDoc *youtput_read_ydoc(YOutput *val);
 void youtput_destroy(YOutput *val);
 
+/* ---- by-value YOutput (yffi ABI-shape parity) ---------------------------
+ * The opaque-handle accessors above are the primary surface; this by-value
+ * form mirrors libyrs.h's `YOutput` tagged union (tag / len /
+ * YOutputContent) for consumers written against that shape.
+ * `youtput_unwrap` materializes a handle into the union — deep: array and
+ * map contents become malloc'd element buffers of further by-value cells —
+ * and `youtput_value_destroy` releases the whole tree. Shared-type / doc
+ * leaves come back as the same opaque Branch* / YDoc* handles used by the
+ * rest of this API (release with ybranch_destroy / ydoc_destroy; the
+ * destroy helper does this for untouched leaves).
+ * `len` semantics match libyrs.h: buffer byte length for Y_JSON_BUF,
+ * element count for Y_JSON_ARR / Y_JSON_MAP, 0 for null/undefined,
+ * otherwise 1. */
+typedef struct YMapEntryValue YMapEntryValue;
+typedef struct YOutputValue {
+  int8_t tag;
+  uint32_t len;
+  union YOutputValueContent {
+    uint8_t flag;
+    double num;
+    int64_t integer;
+    char *str;          /* malloc'd, NUL-terminated */
+    uint8_t *buf;       /* malloc'd, len bytes */
+    struct YOutputValue *array;
+    YMapEntryValue *map;
+    Branch *y_type;
+    YDoc *y_doc;
+  } value;
+} YOutputValue;
+struct YMapEntryValue {
+  char *key; /* malloc'd, NUL-terminated */
+  YOutputValue value;
+};
+YOutputValue youtput_unwrap(const YOutput *val);
+void youtput_value_destroy(YOutputValue val);
+
 /* ---- YText (yffi: ytext_*) ---------------------------------------------- */
 uint32_t ytext_len(Branch *txt, YTransaction *txn);
 char *ytext_string(Branch *txt, YTransaction *txn);
