@@ -39,10 +39,14 @@ from ytpu.core.block import GCRange, Item, SkipRange
 from ytpu.core.content import (
     BLOCK_GC,
     CONTENT_ANY,
+    CONTENT_BINARY,
     CONTENT_DELETED,
+    CONTENT_EMBED,
     CONTENT_FORMAT,
+    CONTENT_JSON,
     CONTENT_MOVE,
     CONTENT_STRING,
+    CONTENT_TYPE,
     ContentMove,
 )
 from ytpu.core.ids import ID
@@ -997,10 +1001,6 @@ def _encode_device_row(
 ) -> None:
     if payloads is None:
         payloads = enc.payloads
-    from ytpu.core.content import (
-        BLOCK_SKIP,
-        CONTENT_DELETED,
-    )
 
     kind = int(bl.kind[r])
     if kind == BLOCK_GC:
@@ -1045,12 +1045,6 @@ def _encode_device_row(
     ref = int(bl.content_ref[r])
     c_off = int(bl.content_off[r]) + off
     length = int(bl.length[r]) - off
-    from ytpu.core.content import (
-        CONTENT_BINARY,
-        CONTENT_EMBED,
-        CONTENT_JSON,
-    )
-
     if kind == CONTENT_STRING:
         out.write_string(payloads.slice_text(ref, c_off, length))
     elif kind == CONTENT_ANY:
@@ -1599,11 +1593,6 @@ def get_diff(state: DocStateBatch, doc: int, payloads) -> list:
     single-value runs). Returns `ytpu.types.text.Diff` objects so results
     compare directly against the host oracle's.
     """
-    from ytpu.core.content import (
-        CONTENT_EMBED,
-        CONTENT_FORMAT,
-        CONTENT_TYPE,
-    )
     from ytpu.types.text import Diff
 
     bl = jax.tree.map(lambda a: np.asarray(a[doc]), state.blocks)
@@ -1684,7 +1673,6 @@ def get_tree(
     columns; without it they render as empty sequences.
     """
     from ytpu.core.branch import TYPE_MAP, TYPE_TEXT, TYPE_WEAK, TYPE_XML_TEXT
-    from ytpu.core.content import CONTENT_TYPE
 
     bl = jax.tree.map(lambda a: np.asarray(a[doc]), state.blocks)
     n = int(state.n_blocks[doc])
@@ -1769,8 +1757,6 @@ def get_tree(
             return payloads.slice_values(ref, off, ln)
         if kind == CONTENT_TYPE:
             return [render_type(i)]
-        from ytpu.core.content import CONTENT_BINARY, CONTENT_JSON
-
         if kind == CONTENT_JSON:
             return payloads.json_values(ref, off, ln)
         if kind == CONTENT_EMBED:
