@@ -24,11 +24,16 @@ must always print exactly ONE JSON line):
 
 - The parent process NEVER imports jax. On this image the accelerator
   plugin can block `import jax` indefinitely when the device tunnel is
-  down, so everything that touches jax runs in a child process under a
-  hard wall-clock timeout (`YTPU_BENCH_DEVICE_TIMEOUT`, default 600s; a
-  quick `jax.devices()` probe under `YTPU_BENCH_PROBE_TIMEOUT`, default
-  240s, runs first so a dead backend fails in minutes, not the full
-  budget). One retry on probe/run failure.
+  down, so everything that touches jax runs in ONE child process with the
+  entire wall-clock budget (`YTPU_BENCH_DEVICE_TIMEOUT`, default 2400s —
+  device init alone has been observed to take >540s on the tunneled
+  backend, so there is no separate fail-fast probe gate any more; the
+  probe is phase 0 *inside* the child and its timings flush to disk, so
+  a timeout kill still tells us how far init got).
+- The child's stderr goes to a file; its tail is embedded in the JSON on
+  failure so a tunnel-down round is distinguishable from a broken kernel.
+- After the B4 phases the same child runs the north-star configs #3-#5
+  (benches/device.py) and their JSON rides along under "configs".
 - On any device failure the JSON line still carries the host-oracle
   number plus an "error" field, so a round always records a measurement.
 """
@@ -67,14 +72,9 @@ LOG_CACHE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "benches", "data", "b4_log.pkl.gz"
 )
 
-PROBE_TIMEOUT = float(os.environ.get("YTPU_BENCH_PROBE_TIMEOUT", "240"))
-DEVICE_TIMEOUT = float(os.environ.get("YTPU_BENCH_DEVICE_TIMEOUT", "900"))
-
-_PROBE_SRC = (
-    "import jax, json, sys; d = jax.devices(); "
-    "print(json.dumps({'n': len(d), 'kind': d[0].device_kind, "
-    "'platform': d[0].platform}))"
-)
+DEVICE_TIMEOUT = float(os.environ.get("YTPU_BENCH_DEVICE_TIMEOUT", "2400"))
+CFG_DOCS = int(os.environ.get("YTPU_BENCH_CFG_DOCS", "2048"))
+CFG5_DOCS = int(os.environ.get("YTPU_BENCH_CFG5_DOCS", "10240"))
 
 
 def load_b4_ops(limit: int):
@@ -326,17 +326,73 @@ def device_replay_full(log, expect):
     raise RuntimeError(f"full replay failed: {last_err}")
 
 
+def _device_configs(result: dict, flush) -> None:
+    """North-star configs #3-#5 (benches/device.py), run inside the same
+    child so their compile/measure cost shares the single device budget.
+    Each config flushes as it lands so a timeout keeps earlier results."""
+    import importlib.util
+
+    cfgs = result.setdefault("configs", {})
+    try:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benches", "device.py"
+        )
+        spec = importlib.util.spec_from_file_location("ytpu_bench_device", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    except Exception as e:
+        cfgs["error"] = f"load benches/device.py: {type(e).__name__}: {e}"[:300]
+        flush()
+        return
+    for key, fn, docs in (
+        ("config3", mod.bench_config3, CFG_DOCS),
+        ("config4", mod.bench_config4, CFG_DOCS),
+        ("config5", mod.bench_config5, CFG5_DOCS),
+    ):
+        try:
+            cfgs[key] = fn(docs)
+        except Exception as e:
+            cfgs[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        flush()
+
+
 def _device_phase_child(in_path: str, out_path: str) -> None:
     """Child entry: the only process that imports jax. Results are written
-    progressively so a timeout kill keeps whatever phases finished."""
+    progressively so a timeout kill keeps whatever phases finished —
+    including phase 0 (backend init), whose timings tell a timed-out round
+    exactly how far device bring-up got."""
     with open(in_path, "rb") as f:
         job = pickle.load(f)
     result = {}
+    t_start = time.perf_counter()
 
     def flush():
         with open(out_path + ".tmp", "w") as f:
             json.dump(result, f)
         os.replace(out_path + ".tmp", out_path)
+
+    # Phase 0 — backend probe with breadcrumbs. If the process dies mid-
+    # init, the last flushed stage names the culprit.
+    result["probe_stage"] = "import_jax"
+    flush()
+    import jax
+
+    result["import_jax_s"] = round(time.perf_counter() - t_start, 1)
+    result["probe_stage"] = "jax_devices"
+    flush()
+    devs = jax.devices()
+    result["devices_s"] = round(time.perf_counter() - t_start, 1)
+    result["platform"] = devs[0].platform
+    result["device_kind"] = devs[0].device_kind
+    result["n_devices"] = len(devs)
+    result["probe_stage"] = "first_op"
+    flush()
+    import jax.numpy as jnp
+
+    jnp.zeros((8, 128), jnp.int32).block_until_ready()
+    result["first_op_s"] = round(time.perf_counter() - t_start, 1)
+    result["probe_stage"] = "done"
+    flush()
 
     try:
         result["quick_dt"] = device_replay(job["quick_log"], job["quick_expect"])
@@ -348,57 +404,49 @@ def _device_phase_child(in_path: str, out_path: str) -> None:
     except Exception as e:
         result["full_error"] = f"{type(e).__name__}: {e}"[:300]
     flush()
+    _device_configs(result, flush)
 
 
-def _probe_device() -> dict | None:
-    """jax.devices() in a throwaway child under a hard timeout."""
-    try:
-        res = subprocess.run(
-            [sys.executable, "-u", "-c", _PROBE_SRC],
-            capture_output=True,
-            text=True,
-            timeout=PROBE_TIMEOUT,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-    except subprocess.TimeoutExpired:
-        return None
-    if res.returncode != 0:
-        return None
-    try:
-        return json.loads(res.stdout.strip().splitlines()[-1])
-    except (ValueError, IndexError):
-        return None
-
-
-def _run_device_phase(job: dict):
-    """Spawn the device child; returns (result_dict, error). Partial
-    results survive a timeout (the child flushes after each phase)."""
+def _run_device_phase(job: dict, timeout: float = DEVICE_TIMEOUT):
+    """Spawn the device child with the whole budget; returns
+    (result_dict_or_None, error_or_None). Partial results survive a
+    timeout (the child flushes after each phase); the child's stderr tail
+    always comes back so failures are diagnosable from the JSON alone."""
     with tempfile.TemporaryDirectory() as tmp:
         in_path = os.path.join(tmp, "job.pkl")
         out_path = os.path.join(tmp, "result.json")
+        err_path = os.path.join(tmp, "stderr.log")
         with open(in_path, "wb") as f:
             pickle.dump(job, f)
         err = None
-        try:
-            res = subprocess.run(
-                [
-                    sys.executable,
-                    "-u",
-                    os.path.abspath(__file__),
-                    "--device-phase",
-                    in_path,
-                    out_path,
-                ],
-                capture_output=True,
-                text=True,
-                timeout=DEVICE_TIMEOUT,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-            )
-            if res.returncode != 0:
-                tail = (res.stderr or res.stdout or "").strip().splitlines()[-3:]
-                err = f"device phase rc={res.returncode}: {' | '.join(tail)}"
-        except subprocess.TimeoutExpired:
-            err = f"device phase timed out after {DEVICE_TIMEOUT:.0f}s"
+        with open(err_path, "w") as ef:
+            try:
+                res = subprocess.run(
+                    [
+                        sys.executable,
+                        "-u",
+                        os.path.abspath(__file__),
+                        "--device-phase",
+                        in_path,
+                        out_path,
+                    ],
+                    stdout=subprocess.DEVNULL,
+                    stderr=ef,
+                    timeout=timeout,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                )
+                if res.returncode != 0:
+                    err = f"device phase rc={res.returncode}"
+            except subprocess.TimeoutExpired:
+                err = f"device phase timed out after {timeout:.0f}s"
+        if err:
+            try:
+                with open(err_path) as f:
+                    tail = [ln.strip() for ln in f.read().splitlines() if ln.strip()]
+                if tail:
+                    err += ": " + " | ".join(tail[-4:])[:500]
+            except OSError:
+                pass
         try:
             with open(out_path) as f:
                 return json.load(f), err
@@ -439,18 +487,21 @@ def main():
         "quick_expect": quick_expect,
     }
 
-    # Device phase: probe fail-fast, then run; one retry on either failure.
-    # Attempts merge (best result wins) so a failed retry can never clobber
-    # an earlier partial measurement.
-    res, err = None, "device probe failed/timed out"
-    for _ in range(2):
-        if _probe_device() is None:
-            continue
-        attempt, err = _run_device_phase(job)
+    # Device phase: one child with the whole budget (no fail-fast probe —
+    # device init alone can exceed 540s on the tunneled backend). Retry
+    # once only if the first attempt crashed early without producing any
+    # measurement; attempts merge so a retry can't clobber partials.
+    t_dev = time.perf_counter()
+    res, err = _run_device_phase(job)
+    crashed_early = (
+        res is None or "quick_dt" not in res and "full_dt" not in res
+    ) and time.perf_counter() - t_dev < 0.25 * DEVICE_TIMEOUT
+    if crashed_early and "timed out" not in (err or ""):
+        remaining = max(60.0, DEVICE_TIMEOUT - (time.perf_counter() - t_dev))
+        attempt, err2 = _run_device_phase(job, timeout=remaining)
         if attempt is not None:
-            res = {**(res or {}), **attempt} if res else attempt
-        if res is not None and "full_dt" in res:
-            break
+            res = {**(res or {}), **attempt}
+            err = err2
 
     baseline = native_rate if native_rate else host_rate
     out = {
@@ -459,6 +510,19 @@ def main():
     }
     if native_rate is not None:
         out["native_updates_per_sec"] = round(native_rate, 1)
+    if res:
+        for k in ("platform", "device_kind", "n_devices"):
+            if k in res:
+                out[k] = res[k]
+        probe = {
+            k: res[k]
+            for k in ("probe_stage", "import_jax_s", "devices_s", "first_op_s")
+            if k in res
+        }
+        if probe.get("probe_stage") != "done" or err:
+            out["probe"] = probe
+        if "configs" in res:
+            out["configs"] = res["configs"]
     if res and "quick_dt" in res:
         quick_rate = len(quick_log) * N_DOCS / res["quick_dt"]
         out["quick_updates_per_sec"] = round(quick_rate, 1)
@@ -503,6 +567,10 @@ def main():
         out["unit"] = f"updates/s single-doc host fallback ({trace})"
         out["vs_baseline"] = 1.0
         out["error"] = (res or {}).get("full_error") or err
+    if err and "error" not in out:
+        # the measurement landed but the child still died later (e.g. in
+        # the configs stage) — never swallow that
+        out["device_phase_error"] = err
     if cache_note:
         out["note"] = cache_note
     print(json.dumps(out))
