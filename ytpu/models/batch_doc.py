@@ -312,6 +312,90 @@ def _origins_equal(ha, ca, ka, hb, cb, kb):
     return both_none | both_same
 
 
+def _conflict_scan(
+    state: DocStateBatch,
+    client_rank: jax.Array,
+    r_client,
+    has_origin,
+    origin_client,
+    origin_clock,
+    has_ror,
+    ror_client,
+    ror_clock,
+    right_idx,
+    o0,
+    left_idx,
+):
+    """The YATA conflict scan (parity: block.rs:537-602), shared by the
+    batched engine and the sequence-parallel engine (`sharded_doc`).
+
+    Walks candidates from `o0` toward `right_idx` (or the sequence tail),
+    resolving the final left neighbor: same-origin candidates tie-break on
+    real client rank (case 1); candidates anchored inside the scanned
+    region fold per the before/conflicting set rules (case 2). Returns the
+    scanned left slot (callers apply it only where their `need_scan`
+    predicate held)."""
+    bl = state.blocks
+    B = _capacity(bl)
+    safe = lambda idx: jnp.maximum(idx, 0)
+
+    def scan_cond(carry):
+        o, left, conflicting, before, brk = carry
+        return (o >= 0) & (o != right_idx) & ~brk
+
+    def scan_body(carry):
+        o, left, conflicting, before, brk = carry
+        so = safe(o)
+        before = before.at[so].set(True)
+        conflicting = conflicting.at[so].set(True)
+        same_origin = _origins_equal(
+            has_origin,
+            origin_client,
+            origin_clock,
+            bl.origin_client[so] >= 0,
+            bl.origin_client[so],
+            bl.origin_clock[so],
+        )
+        same_ror = _origins_equal(
+            has_ror,
+            ror_client,
+            ror_clock,
+            bl.ror_client[so] >= 0,
+            bl.ror_client[so],
+            bl.ror_clock[so],
+        )
+        # case 1: same left anchor — (real) client id breaks the tie
+        case1_take = same_origin & (
+            client_rank[safe(bl.client[so])] < client_rank[safe(r_client)]
+        )
+        case1_break = same_origin & ~case1_take & same_ror
+        # case 2: o anchors somewhere inside the scanned region. A slot
+        # that fails to resolve (-1, e.g. a non-local origin on a shard)
+        # reads as "origin precedes the scanned region" — the break case.
+        o_has_origin = bl.origin_client[so] >= 0
+        o_origin_idx = _find_slot(
+            bl, state.n_blocks, bl.origin_client[so], bl.origin_clock[so]
+        )
+        o_origin_known = o_has_origin & (o_origin_idx >= 0)
+        in_before = o_origin_known & before[safe(o_origin_idx)]
+        in_conflicting = o_origin_known & conflicting[safe(o_origin_idx)]
+        case2_take = ~same_origin & in_before & ~in_conflicting
+        case2_break = ~same_origin & ~in_before
+
+        take = case1_take | case2_take
+        left = jnp.where(take, o, left)
+        conflicting = jnp.where(take, jnp.zeros_like(conflicting), conflicting)
+        brk = case1_break | case2_break
+        o = jnp.where(brk, o, bl.right[so])
+        return (o, left, conflicting, before, brk)
+
+    zeros = jnp.zeros((B,), bool)
+    _, left_scanned, _, _, _ = jax.lax.while_loop(
+        scan_cond, scan_body, (o0, left_idx, zeros, zeros, jnp.array(False))
+    )
+    return left_scanned
+
+
 def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array):
     """Integrate one incoming block row (YATA; parity: block.rs:482-769).
 
@@ -439,53 +523,19 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array):
         anchor0,
     )
     o0 = jnp.where(need_scan, o0, -1)
-
-    def scan_cond(carry):
-        o, left, conflicting, before, brk = carry
-        return (o >= 0) & (o != right_idx) & ~brk
-
-    def scan_body(carry):
-        o, left, conflicting, before, brk = carry
-        so = safe(o)
-        before = before.at[so].set(True)
-        conflicting = conflicting.at[so].set(True)
-        same_origin = _origins_equal(
-            has_origin,
-            origin_client,
-            origin_clock,
-            bl.origin_client[so] >= 0,
-            bl.origin_client[so],
-            bl.origin_clock[so],
-        )
-        same_ror = _origins_equal(
-            has_ror, r_rc, r_rk, bl.ror_client[so] >= 0, bl.ror_client[so], bl.ror_clock[so]
-        )
-        # case 1: same left anchor — (real) client id breaks the tie
-        case1_take = same_origin & (
-            client_rank[safe(bl.client[so])] < client_rank[safe(r_client)]
-        )
-        case1_break = same_origin & ~case1_take & same_ror
-        # case 2: o anchors somewhere inside the scanned region
-        o_has_origin = bl.origin_client[so] >= 0
-        o_origin_idx = _find_slot(
-            bl, state.n_blocks, bl.origin_client[so], bl.origin_clock[so]
-        )
-        o_origin_known = o_has_origin & (o_origin_idx >= 0)
-        in_before = o_origin_known & before[safe(o_origin_idx)]
-        in_conflicting = o_origin_known & conflicting[safe(o_origin_idx)]
-        case2_take = ~same_origin & in_before & ~in_conflicting
-        case2_break = ~same_origin & ~in_before
-
-        take = case1_take | case2_take
-        left = jnp.where(take, o, left)
-        conflicting = jnp.where(take, jnp.zeros_like(conflicting), conflicting)
-        brk = case1_break | case2_break
-        o = jnp.where(brk, o, bl.right[so])
-        return (o, left, conflicting, before, brk)
-
-    zeros = jnp.zeros((B,), bool)
-    _, left_scanned, _, _, _ = jax.lax.while_loop(
-        scan_cond, scan_body, (o0, left_idx, zeros, zeros, jnp.array(False))
+    left_scanned = _conflict_scan(
+        state,
+        client_rank,
+        r_client,
+        has_origin,
+        origin_client,
+        origin_clock,
+        has_ror,
+        r_rc,
+        r_rk,
+        right_idx,
+        o0,
+        left_idx,
     )
     left_idx = jnp.where(need_scan, left_scanned, left_idx)
 

@@ -9,13 +9,16 @@ from .mesh import (
     shard_state,
     sv_sharding,
 )
+from .sharded_doc import AXIS_SP, ShardedDoc
 
 __all__ = [
     "AXIS_DP",
     "AXIS_TP",
+    "AXIS_SP",
     "make_mesh",
     "doc_sharding",
     "sv_sharding",
     "shard_state",
     "shard_batch",
+    "ShardedDoc",
 ]

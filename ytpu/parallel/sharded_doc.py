@@ -1,0 +1,1295 @@
+"""Sequence-parallel CRDT: ONE document's block columns sharded across the
+``sp`` mesh axis — the real answer to SURVEY §5.7 (VERDICT r2 task #3).
+
+The reference stores a doc as a single linked list; its long-document pain
+is the O(items) `find_position` walk (/root/reference/yrs/src/types/
+text.rs:734, acknowledged TODO at block.rs:723) and the single-arena memory
+ceiling. Here the *item sequence itself* is partitioned into S contiguous
+segments, one per shard slot along ``sp``:
+
+- Each shard holds real block columns (client/clock/origin/right-origin/
+  left/right/deleted/content) in the `batch_doc.BlockCols` schema — ids,
+  origins and tombstones all live on the sharded axis, and integration is
+  the same YATA conflict scan (`block.rs:537-602`) the unsharded engine
+  runs, executed per shard under `vmap`/`pjit`.
+- Document order is the concatenation of the segments. A host router
+  assigns every incoming wire block to the shard owning its **left origin**
+  (a clock-interval directory), which keeps each YATA conflict scan local
+  to one shard: any item between an origin O and a right-origin R resides
+  in O's shard (items chain into the segment of their leftmost anchor).
+- Cross-boundary anchors are the *halo* cases: a right-origin living in a
+  later shard is anchored as this segment's tail when it is exactly the
+  next non-empty shard's first item (provably equivalent — see
+  `_route_row`), otherwise the row takes the **boundary-resolution path**:
+  the host walks the pulled boundary columns with the reference scan rules
+  and re-issues the row with exact local anchors.
+- Index→position resolution is a prefix-sum over per-shard visible
+  lengths (`visible_lengths` + `find_position`) — O(S) + O(local) instead
+  of the reference's O(doc) walk, and the device half is one reduction.
+- `rebalance()` re-partitions the segments evenly (the bulk halo
+  exchange): pull → re-cut in doc order → push, rebuilding the directory.
+
+Storage vs anchors: every row stores its TRUE origin/right-origin ids
+(wire parity — `encode_state_as_update_v1` must re-emit them byte-exactly)
+while anchoring on host-localized ids; the two coincide except at segment
+boundaries.
+
+Scope (round 3): root-sequence documents (YText / YArray shapes — string,
+Any, deleted and format runs). Map components, nested branches, moves and
+GC-range carriers raise; sharded docs keep tombstones (the `skip_gc`
+regime of the reference, store.rs:139-151).
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_right
+from collections import deque
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ytpu.core import Doc, Update
+from ytpu.core.block import GCRange, Item, SkipRange
+from ytpu.core.content import (
+    CONTENT_ANY,
+    CONTENT_DELETED,
+    CONTENT_FORMAT,
+    CONTENT_STRING,
+    ContentAny,
+    ContentDeleted,
+    ContentFormat,
+    ContentString,
+)
+from ytpu.core.id_set import DeleteSet
+from ytpu.core.ids import ID
+from ytpu.core.state_vector import StateVector
+from ytpu.models.batch_doc import (
+    COL_DEFAULTS,
+    ERR_MISSING_DEP,
+    BatchEncoder,
+    BlockCols,
+    DocStateBatch,
+    _apply_delete_range,
+    _capacity,
+    _clean_end,
+    _clean_start,
+    _conflict_scan,
+    _set,
+    init_state,
+)
+
+I32 = jnp.int32
+AXIS_SP = "sp"
+
+
+def _same_ror_items(a: "Item", b: "Item") -> bool:
+    if a.right_origin is None or b.right_origin is None:
+        return a.right_origin is None and b.right_origin is None
+    return (
+        a.right_origin.client == b.right_origin.client
+        and a.right_origin.clock == b.right_origin.clock
+    )
+
+__all__ = ["ShardedDoc", "SpStep", "apply_step_sharded", "AXIS_SP"]
+
+
+class SpStep(NamedTuple):
+    """One routed batch of rows/deletes, padded per shard ([S, U] / [S, R]).
+
+    `s_*` columns are the stored (wire-true) origins; `a_*` columns are the
+    host-localized anchors the device links against."""
+
+    client: jax.Array
+    clock: jax.Array
+    length: jax.Array
+    s_oc: jax.Array
+    s_ok: jax.Array
+    s_rc: jax.Array
+    s_rk: jax.Array
+    a_oc: jax.Array
+    a_ok: jax.Array
+    a_rc: jax.Array
+    a_rk: jax.Array
+    kind: jax.Array
+    content_ref: jax.Array
+    content_off: jax.Array
+    valid: jax.Array  # bool
+    del_client: jax.Array
+    del_start: jax.Array
+    del_end: jax.Array
+    del_valid: jax.Array  # bool
+
+
+def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
+    """One routed row into one shard (YATA; parity: block.rs:482-769).
+
+    Differences from `batch_doc._integrate_row`: the host router has
+    already dedup/trimmed against the global state vector (so there is no
+    local-clock applicability check — a shard's local clocks are NOT the
+    doc's), anchors come pre-localized in the `a_*` fields, and the stored
+    origin/right-origin are the wire-true `s_*` ids."""
+    (
+        r_client,
+        r_clock,
+        r_len,
+        s_oc,
+        s_ok,
+        s_rc,
+        s_rk,
+        a_oc,
+        a_ok,
+        a_rc,
+        a_rk,
+        r_kind,
+        r_ref,
+        r_off,
+        r_valid,
+    ) = row
+    bl = state.blocks
+    B = _capacity(bl)
+
+    do = r_valid
+    has_origin = s_oc >= 0
+    has_ror = s_rc >= 0
+    linkable = do
+
+    # resolve local anchors (repair; parity: block.rs:1287-1300)
+    probe_oc = jnp.where(linkable & (a_oc >= 0), a_oc, -2)
+    state, left_idx = _clean_end(state, probe_oc, a_ok)
+    probe_rc = jnp.where(linkable & (a_rc >= 0), a_rc, -2)
+    state, right_idx = _clean_start(state, probe_rc, a_rk)
+    bl = state.blocks
+
+    anchor_missing = (linkable & (a_oc >= 0) & (left_idx < 0)) | (
+        linkable & (a_rc >= 0) & (right_idx < 0)
+    )
+    missing = anchor_missing
+    linkable = linkable & ~anchor_missing
+
+    safe = lambda idx: jnp.maximum(idx, 0)
+    anchor0 = state.start
+
+    # --- conflict scan (parity: block.rs:537-602) ---
+    right_left = jnp.where(right_idx >= 0, bl.left[safe(right_idx)], -1)
+    need_scan = linkable & (
+        ((left_idx < 0) & ((right_idx < 0) | (right_left >= 0)))
+        | ((left_idx >= 0) & (bl.right[safe(left_idx)] != right_idx))
+    )
+    o0 = jnp.where(left_idx >= 0, bl.right[safe(left_idx)], anchor0)
+    o0 = jnp.where(need_scan, o0, -1)
+    # shared YATA scan; a candidate's non-local origin resolves to -1
+    # there, which reads as "origin precedes the scanned region" — exactly
+    # right for an origin living in an earlier segment
+    left_scanned = _conflict_scan(
+        state,
+        client_rank,
+        r_client,
+        has_origin,
+        s_oc,
+        s_ok,
+        has_ror,
+        s_rc,
+        s_rk,
+        right_idx,
+        o0,
+        left_idx,
+    )
+    left_idx = jnp.where(need_scan, left_scanned, left_idx)
+
+    # --- link in (parity: block.rs:614-659) ---
+    j = state.n_blocks
+    from ytpu.models.batch_doc import ERR_CAPACITY
+
+    overflow = do & (j >= B)
+    do = do & (j < B)
+    linkable = linkable & (j < B)
+    wj = jnp.where(do, j, B)
+
+    has_left = linkable & (left_idx >= 0)
+    right_final = jnp.where(
+        has_left, bl.right[safe(left_idx)], jnp.where(linkable, anchor0, -1)
+    )
+    w_left = jnp.where(has_left, left_idx, B)
+    new_right_col = _set(bl.right, w_left, j)
+    new_start = jnp.where(linkable & ~has_left, j, state.start)
+    w_right = jnp.where(linkable & (right_final >= 0), right_final, B)
+    new_left_col = _set(bl.left, w_right, j)
+
+    row_deleted = r_kind == CONTENT_DELETED
+    row_countable = ~row_deleted & (r_kind != CONTENT_FORMAT)
+
+    new_bl = BlockCols(
+        client=_set(bl.client, wj, r_client),
+        clock=_set(bl.clock, wj, r_clock),
+        length=_set(bl.length, wj, r_len),
+        origin_client=_set(bl.origin_client, wj, jnp.where(has_origin, s_oc, -1)),
+        origin_clock=_set(bl.origin_clock, wj, jnp.where(has_origin, s_ok, 0)),
+        ror_client=_set(bl.ror_client, wj, jnp.where(has_ror, s_rc, -1)),
+        ror_clock=_set(bl.ror_clock, wj, jnp.where(has_ror, s_rk, 0)),
+        left=_set(new_left_col, wj, jnp.where(linkable, left_idx, -1)),
+        right=_set(new_right_col, wj, jnp.where(linkable, right_final, -1)),
+        deleted=_set(bl.deleted, wj, row_deleted),
+        countable=_set(bl.countable, wj, row_countable),
+        kind=_set(bl.kind, wj, r_kind),
+        content_ref=_set(bl.content_ref, wj, r_ref),
+        content_off=_set(bl.content_off, wj, r_off),
+        key=_set(bl.key, wj, -1),
+        parent=_set(bl.parent, wj, -1),
+        head=_set(bl.head, wj, -1),
+        moved=_set(bl.moved, wj, -1),
+        mv_sc=bl.mv_sc,
+        mv_sk=bl.mv_sk,
+        mv_sa=bl.mv_sa,
+        mv_ec=bl.mv_ec,
+        mv_ek=bl.mv_ek,
+        mv_ea=bl.mv_ea,
+        mv_prio=bl.mv_prio,
+    )
+    error = (
+        state.error
+        | jnp.where(overflow, ERR_CAPACITY, 0)
+        | jnp.where(missing, ERR_MISSING_DEP, 0)
+    )
+    return DocStateBatch(
+        blocks=new_bl,
+        start=new_start,
+        n_blocks=state.n_blocks + do.astype(I32),
+        error=error,
+    )
+
+
+def _apply_step_one_shard(
+    state: DocStateBatch, step: SpStep, client_rank: jax.Array
+) -> DocStateBatch:
+    U = step.client.shape[-1]
+    R = step.del_client.shape[-1]
+
+    def blk_body(i, st):
+        row = (
+            step.client[i],
+            step.clock[i],
+            step.length[i],
+            step.s_oc[i],
+            step.s_ok[i],
+            step.s_rc[i],
+            step.s_rk[i],
+            step.a_oc[i],
+            step.a_ok[i],
+            step.a_rc[i],
+            step.a_rk[i],
+            step.kind[i],
+            step.content_ref[i],
+            step.content_off[i],
+            step.valid[i],
+        )
+        return jax.lax.cond(
+            step.valid[i],
+            lambda s: _integrate_row_sp(s, row, client_rank),
+            lambda s: s,
+            st,
+        )
+
+    state = jax.lax.fori_loop(0, U, blk_body, state)
+
+    def del_body(r, st):
+        st, _ = jax.lax.cond(
+            step.del_valid[r],
+            lambda s: _apply_delete_range(
+                s,
+                step.del_client[r],
+                step.del_start[r],
+                step.del_end[r],
+                step.del_valid[r],
+            ),
+            lambda s: (s, jnp.array(False)),
+            st,
+        )
+        return st
+
+    return jax.lax.fori_loop(0, R, del_body, state)
+
+
+@jax.jit
+def apply_step_sharded(
+    state: DocStateBatch, step: SpStep, client_rank: jax.Array
+) -> DocStateBatch:
+    """All shards integrate their routed rows in parallel (the sp axis).
+
+    Rows routed to different shards are independent by construction (every
+    anchor is shard-local), so per-shard `fori_loop`s run concurrently
+    under `vmap`; with the leading axis sharded over a mesh's ``sp`` axis
+    this partitions across devices with zero collectives in the data path.
+    """
+    return jax.vmap(_apply_step_one_shard, in_axes=(0, 0, None))(
+        state, step, client_rank
+    )
+
+
+@jax.jit
+def visible_lengths(state: DocStateBatch) -> jax.Array:
+    """[S] visible clock-units per shard — the device half of the prefix-
+    sum position lookup (vs the reference's O(items) find_position,
+    types/text.rs:734)."""
+    bl = state.blocks
+    B = _capacity(bl)
+    slots = jnp.arange(B, dtype=I32)
+    live = (slots[None, :] < state.n_blocks[:, None]) & bl.countable & ~bl.deleted
+    return jnp.sum(jnp.where(live, bl.length, 0), axis=-1)
+
+
+class _Directory:
+    """client → sorted disjoint [start, end) → shard, the routing table.
+
+    A parallel sorted starts list per client keeps `owner` at O(log n)
+    and `add` at amortized O(1) for the dominant append/extend pattern
+    (a client's clocks grow monotonically)."""
+
+    def __init__(self):
+        self.by_client: Dict[int, List[Tuple[int, int, int]]] = {}
+        self._starts: Dict[int, List[int]] = {}
+
+    def add(self, client: int, start: int, end: int, shard: int) -> None:
+        ivs = self.by_client.setdefault(client, [])
+        starts = self._starts.setdefault(client, [])
+        i = bisect_right(starts, start)
+        if i > 0 and ivs[i - 1][1] == start and ivs[i - 1][2] == shard:
+            s0, _, sh = ivs[i - 1]
+            ivs[i - 1] = (s0, end, sh)
+        else:
+            ivs.insert(i, (start, end, shard))
+            starts.insert(i, start)
+
+    def owner(self, client: int, clock: int) -> Optional[int]:
+        ivs = self.by_client.get(client)
+        if not ivs:
+            return None
+        i = bisect_right(self._starts[client], clock) - 1
+        if i >= 0 and ivs[i][0] <= clock < ivs[i][1]:
+            return ivs[i][2]
+        return None
+
+    def clip(self, client: int, start: int, end: int) -> List[Tuple[int, int, int]]:
+        """Sub-ranges of [start, end) grouped by owning shard."""
+        out = []
+        ivs = self.by_client.get(client, [])
+        starts = self._starts.get(client, [])
+        i = max(0, bisect_right(starts, start) - 1)
+        for s, e, sh in ivs[i:]:
+            if s >= end:
+                break
+            lo, hi = max(s, start), min(e, end)
+            if lo < hi:
+                out.append((sh, lo, hi))
+        return out
+
+
+class ShardedDoc:
+    """A single CRDT document sharded over S device slots (the sp axis).
+
+    API mirrors the host `Doc` surface for the sharded scope:
+    `apply_update_v1`, `state_vector`, `get_string`, `get_values`,
+    `encode_state_as_update_v1` — plus the sharding controls
+    (`rebalance`, `find_position`, `shard_lengths`).
+    """
+
+    def __init__(
+        self,
+        n_shards: int = 8,
+        capacity: int = 1024,
+        root_name: str = "text",
+        max_rows_per_step: int = 64,
+    ):
+        self.S = n_shards
+        self.capacity = capacity
+        self.enc = BatchEncoder(root_name=root_name)
+        self.state = init_state(n_shards, capacity)
+        self.sv = StateVector()
+        self.dir = _Directory()
+        self.pending: List = []  # carriers awaiting dependencies
+        self.pending_ds: Dict[int, List[Tuple[int, int]]] = {}
+        self.first_id: List[Optional[Tuple[int, int]]] = [None] * n_shards
+        self._n_rows = np.zeros(n_shards, dtype=np.int64)
+        # encode-parity journal: per interned client, the ordered arrival /
+        # delete events this doc applied. `_oracle_boundaries` replays it to
+        # reconstruct exactly which block boundaries the oracle's commit
+        # pipeline (squash steps 5-7, transaction.rs:828-962 + apply_delete's
+        # split rules, transaction.rs:472-575) would have left standing.
+        self._journal: Dict[int, List[tuple]] = {}
+        self._queue_rows: List[List[tuple]] = [[] for _ in range(n_shards)]
+        self._queue_dels: List[List[tuple]] = [[] for _ in range(n_shards)]
+        self._queued = 0
+        self.max_rows_per_step = max_rows_per_step
+        self._host_cache = None  # pulled columns, invalidated by flushes
+
+    # ------------------------------------------------------------- plumbing
+
+    def _rank(self) -> jax.Array:
+        return self.enc.interner.rank_table()
+
+    def _invalidate(self):
+        self._host_cache = None
+
+    def _pull(self):
+        """Host view of all shard columns (cached between flushes)."""
+        if self._host_cache is None:
+            self.flush()
+            self._host_cache = jax.tree.map(np.asarray, self.state)
+        return self._host_cache
+
+    def flush(self) -> None:
+        """Integrate every queued row/delete on device."""
+        if self._queued == 0:
+            return
+        U = max(1, max(len(q) for q in self._queue_rows))
+        R = max(1, max(len(q) for q in self._queue_dels))
+        # bucket pads to limit jit cache entries
+        U = 1 << (U - 1).bit_length()
+        R = 1 << (R - 1).bit_length()
+        rows = np.zeros((self.S, U, 14), dtype=np.int32)
+        rows[:, :, 3] = -1  # s_oc
+        rows[:, :, 5] = -1  # s_rc
+        rows[:, :, 7] = -1  # a_oc
+        rows[:, :, 9] = -1  # a_rc
+        valid = np.zeros((self.S, U), dtype=bool)
+        dels = np.zeros((self.S, R, 3), dtype=np.int32)
+        del_valid = np.zeros((self.S, R), dtype=bool)
+        for s in range(self.S):
+            for i, row in enumerate(self._queue_rows[s]):
+                rows[s, i] = row
+                valid[s, i] = True
+            for i, d in enumerate(self._queue_dels[s]):
+                dels[s, i] = d
+                del_valid[s, i] = True
+        step = SpStep(
+            client=jnp.asarray(rows[:, :, 0]),
+            clock=jnp.asarray(rows[:, :, 1]),
+            length=jnp.asarray(rows[:, :, 2]),
+            s_oc=jnp.asarray(rows[:, :, 3]),
+            s_ok=jnp.asarray(rows[:, :, 4]),
+            s_rc=jnp.asarray(rows[:, :, 5]),
+            s_rk=jnp.asarray(rows[:, :, 6]),
+            a_oc=jnp.asarray(rows[:, :, 7]),
+            a_ok=jnp.asarray(rows[:, :, 8]),
+            a_rc=jnp.asarray(rows[:, :, 9]),
+            a_rk=jnp.asarray(rows[:, :, 10]),
+            kind=jnp.asarray(rows[:, :, 11]),
+            content_ref=jnp.asarray(rows[:, :, 12]),
+            content_off=jnp.asarray(rows[:, :, 13]),
+            valid=jnp.asarray(valid),
+            del_client=jnp.asarray(dels[:, :, 0]),
+            del_start=jnp.asarray(dels[:, :, 1]),
+            del_end=jnp.asarray(dels[:, :, 2]),
+            del_valid=jnp.asarray(del_valid),
+        )
+        # pre-grow: every row can cost up to 3 slots (itself + two anchor
+        # splits) and every delete up to 2 (edge splits) — ensure headroom
+        # BEFORE integrating, or a capacity overflow would raise after the
+        # queues are cleared with the sticky error flag set
+        # _n_rows already counts queued rows (optimistic bump in
+        # _enqueue_row); each row/delete can add up to 2 split rows
+        worst = max(
+            int(self._n_rows[s])
+            + 2 * len(self._queue_rows[s])
+            + 2 * len(self._queue_dels[s])
+            for s in range(self.S)
+        )
+        if worst > self.capacity:
+            cap = self.capacity
+            while cap < worst:
+                cap *= 2
+            self._grow(cap)
+        self._queue_rows = [[] for _ in range(self.S)]
+        self._queue_dels = [[] for _ in range(self.S)]
+        self._queued = 0
+        self.state = apply_step_sharded(self.state, step, self._rank())
+        self._invalidate()
+        err = np.asarray(self.state.error)
+        if err.any():
+            raise RuntimeError(f"sharded integration error flags: {err}")
+        self._n_rows = np.asarray(self.state.n_blocks).astype(np.int64)
+        if self._n_rows.max() > 0.75 * self.capacity:
+            self._grow(self.capacity * 2)
+
+    def _grow(self, new_capacity: int) -> None:
+        from ytpu.ops.compaction import grow_state
+
+        self.state = grow_state(self.state, new_capacity)
+        self.capacity = new_capacity
+        self._invalidate()
+
+    def _shard_first_id(self, s: int) -> Optional[Tuple[int, int]]:
+        """(interned client, clock) of shard s's first doc-order row."""
+        if self.first_id[s] is not None:
+            return self.first_id[s]
+        if self._n_rows[s] == 0:
+            return None
+        st = self._pull()
+        head = int(st.start[s])
+        if head < 0:
+            return None
+        fid = (int(st.blocks.client[s, head]), int(st.blocks.clock[s, head]))
+        self.first_id[s] = fid
+        return fid
+
+    def _first_nonempty(self) -> int:
+        queued = [len(q) for q in self._queue_rows]
+        for s in range(self.S):
+            if self._n_rows[s] > 0 or queued[s] > 0:
+                return s
+        return 0
+
+    def _shards_empty_between(self, a: int, b: int) -> bool:
+        return all(
+            self._n_rows[s] == 0 and not self._queue_rows[s]
+            for s in range(a + 1, b)
+        )
+
+    def _shards_empty_after(self, a: int) -> bool:
+        return all(
+            self._n_rows[s] == 0 and not self._queue_rows[s]
+            for s in range(a + 1, self.S)
+        )
+
+    # -------------------------------------------------------------- routing
+
+    def _enqueue_row(self, shard: int, row: tuple) -> None:
+        self._queue_rows[shard].append(row)
+        self._queued += 1
+        self._n_rows[shard] += 1  # optimistic emptiness estimate
+        if self._queued >= self.max_rows_per_step * self.S:
+            self.flush()
+
+    def _route_row(self, item: Item) -> None:
+        """Route one dedup/trimmed carrier to its owner shard.
+
+        Owner = shard of the (trimmed) left origin; origin-less rows go to
+        the first non-empty shard (the document head — segments are
+        concatenated in shard order). A right-origin outside the owner is
+        anchored as the segment tail exactly when it is the first item of
+        the next non-empty shard: by the residence invariant (each item
+        lives in its origin's segment) the items between origin and
+        right-origin are then precisely the owner's tail — the same scan
+        the reference would run. Anything else resolves on host
+        (`_resolve_boundary`)."""
+        enc = self.enc
+        real_client = item.id.client
+        local = self.sv.get(real_client)
+        clock, length = item.id.clock, item.len
+        if local >= clock + length:
+            return  # full duplicate
+        content = item.content
+        offset = 0
+        if local > clock:
+            offset = local - clock
+        kind = content.kind
+        if kind == CONTENT_STRING:
+            ref = enc.payloads.add(kind, content.text.encode("utf-16-le"))
+        elif kind == CONTENT_ANY:
+            ref = enc.payloads.add(kind, list(content.items))
+        elif kind == CONTENT_DELETED:
+            ref = -1
+        elif kind == CONTENT_FORMAT:
+            ref = enc.payloads.add(kind, content)
+        else:
+            raise NotImplementedError(
+                f"sharded docs support sequence content only (kind={kind})"
+            )
+        c = enc.interner.intern(real_client)
+        if offset:
+            clock += offset
+            length -= offset
+            s_o = (c, clock - 1)
+        elif item.origin is not None:
+            s_o = (enc.interner.intern(item.origin.client), item.origin.clock)
+        else:
+            s_o = None
+        if item.right_origin is not None:
+            s_r = (
+                enc.interner.intern(item.right_origin.client),
+                item.right_origin.clock,
+            )
+        else:
+            s_r = None
+
+        if s_o is not None:
+            target = self.dir.owner(*s_o)
+            if target is None:
+                raise RuntimeError(f"origin {s_o} not in directory (routing bug)")
+        else:
+            target = self._first_nonempty()
+            self.first_id[target] = None  # a new head may arrive
+
+        a_r: Optional[Tuple[int, int]] = None
+        if s_r is not None:
+            r_owner = self.dir.owner(*s_r)
+            if r_owner is None:
+                raise RuntimeError(f"right origin {s_r} not in directory")
+            if r_owner == target:
+                a_r = s_r
+            elif r_owner > target and self._shards_empty_between(target, r_owner):
+                if self._queue_rows[r_owner]:
+                    # queued rows may have changed the neighbor head: the
+                    # safe-tail equivalence needs device state — resolve
+                    self._resolve_boundary(item, c, clock, length, s_o, s_r, kind, ref, offset)
+                    return
+                if s_r == self._shard_first_id(r_owner):
+                    a_r = None  # segment tail ≡ "before next shard's head"
+                else:
+                    self._resolve_boundary(item, c, clock, length, s_o, s_r, kind, ref, offset)
+                    return
+            else:
+                self._resolve_boundary(item, c, clock, length, s_o, s_r, kind, ref, offset)
+                return
+        else:
+            if not self._shards_empty_after(target):
+                self._resolve_boundary(item, c, clock, length, s_o, s_r, kind, ref, offset)
+                return
+
+        row = self._make_row(
+            c, clock, length, s_o, s_r, s_o, a_r, kind, ref, offset
+        )
+        self._enqueue_row(target, row)
+        self._journal_row(c, clock, length, s_o, s_r, kind)
+        self.dir.add(c, clock, clock + length, target)
+        self.sv.set_max(real_client, clock + length)
+
+    @staticmethod
+    def _make_row(c, clock, length, s_o, s_r, a_o, a_r, kind, ref, off):
+        return (
+            c,
+            clock,
+            length,
+            s_o[0] if s_o else -1,
+            s_o[1] if s_o else 0,
+            s_r[0] if s_r else -1,
+            s_r[1] if s_r else 0,
+            a_o[0] if a_o else -1,
+            a_o[1] if a_o else 0,
+            a_r[0] if a_r else -1,
+            a_r[1] if a_r else 0,
+            kind,
+            ref,
+            off,
+        )
+
+    # ---------------------------------------------- boundary (halo) resolve
+
+    def _global_rows(self, st) -> List[Tuple[int, int]]:
+        """(shard, slot) pairs in document order (full, tombstones included)."""
+        out = []
+        for s in range(self.S):
+            cur = int(st.start[s])
+            guard = 0
+            while cur >= 0:
+                out.append((s, cur))
+                cur = int(st.blocks.right[s, cur])
+                guard += 1
+                if guard > st.blocks.client.shape[-1] + 1:
+                    raise RuntimeError("cycle in shard linked list")
+        return out
+
+    def _resolve_boundary(
+        self, item, c, clock, length, s_o, s_r, kind, ref, off
+    ) -> None:
+        """Host-side exact placement for a boundary-straddling insert.
+
+        Mirrors the device scan (`block.rs:537-602` rules) over the pulled
+        global doc order, then re-issues the row with exact local anchors
+        (need_scan is then provably false on device). This is the rare
+        halo path; its cost is one device→host pull per boundary insert
+        burst (the pull is cached until the next flush)."""
+        self.flush()
+        st = self._pull()
+        order = self._global_rows(st)
+        bl = st.blocks
+        rank = np.asarray(self._rank())
+
+        # fragment view: rows, with the origin- and right-origin-containing
+        # rows virtually split at those units — the reference's repair
+        # splits (block.rs:1287-1300) happen before its scan, so mid-block
+        # anchors must expose the remainder/prefix as scan candidates.
+        # Each fragment: (shard, row, clock, len, oc, ok, rc, rk, client).
+        frags: List[tuple] = []
+        for s, r in order:
+            cl = int(bl.client[s, r])
+            ck = int(bl.clock[s, r])
+            ln = int(bl.length[s, r])
+            oc, ok = int(bl.origin_client[s, r]), int(bl.origin_clock[s, r])
+            rc_, rk_ = int(bl.ror_client[s, r]), int(bl.ror_clock[s, r])
+            cuts = [ck]
+            for an in (s_o, s_r):
+                if an and an[0] == cl and ck <= an[1] < ck + ln:
+                    # origin cut exposes the unit AFTER it; ror cut the unit AT it
+                    cut = an[1] + 1 if an is s_o else an[1]
+                    if ck < cut < ck + ln:
+                        cuts.append(cut)
+            cuts = sorted(set(cuts)) + [ck + ln]
+            for a_, b_ in zip(cuts, cuts[1:]):
+                f_oc, f_ok = (cl, a_ - 1) if a_ > ck else (oc, ok)
+                frags.append((s, r, a_, b_ - a_, f_oc, f_ok, rc_, rk_, cl))
+
+        # O(log n) unit -> fragment index
+        by_client: Dict[int, Tuple[List[int], List[int]]] = {}
+        grouped: Dict[int, List[Tuple[int, int]]] = {}
+        for gi, f in enumerate(frags):
+            grouped.setdefault(f[8], []).append((f[2], gi))
+        for cid, lst in grouped.items():
+            lst.sort()
+            by_client[cid] = ([x[0] for x in lst], [x[1] for x in lst])
+
+        def covering(cid, ck) -> Optional[int]:
+            entry = by_client.get(cid)
+            if not entry:
+                return None
+            starts, gis = entry
+            i = bisect_right(starts, ck) - 1
+            if i >= 0:
+                gi = gis[i]
+                if frags[gi][2] <= ck < frags[gi][2] + frags[gi][3]:
+                    return gi
+            return None
+
+        origin_pos = covering(*s_o) if s_o else None
+        ror_pos = covering(*s_r) if s_r else None
+        end = len(frags)
+        o = (origin_pos + 1) if origin_pos is not None else 0
+        stop = ror_pos if ror_pos is not None else end
+        left = origin_pos if origin_pos is not None else -1
+        before: set = set()
+        conflicting: set = set()
+        my_rank = rank[c]
+        while o < stop:
+            _, _, _, _, o_oc, o_ok, o_rc, o_rk, o_cl = frags[o]
+            before.add(o)
+            conflicting.add(o)
+            same_origin = (s_o is None and o_oc < 0) or (
+                s_o is not None and o_oc >= 0 and (o_oc, o_ok) == s_o
+            )
+            same_ror = (s_r is None and o_rc < 0) or (
+                s_r is not None and o_rc >= 0 and (o_rc, o_rk) == s_r
+            )
+            if same_origin:
+                if rank[o_cl] < my_rank:
+                    left = o
+                    conflicting.clear()
+                elif same_ror:
+                    break
+            else:
+                p = covering(o_oc, o_ok) if o_oc >= 0 else None
+                in_before = p is not None and p in before
+                if in_before and not (p in conflicting):
+                    left = o
+                    conflicting.clear()
+                elif not in_before:
+                    break
+            o += 1
+
+        if left >= 0:
+            ls = frags[left][0]
+            target = ls
+            a_o = (frags[left][8], frags[left][2] + frags[left][3] - 1)
+            if left + 1 < len(frags) and frags[left + 1][0] == ls:
+                a_r = (frags[left + 1][8], frags[left + 1][2])
+            else:
+                a_r = None
+        else:
+            target = frags[0][0] if frags else self._first_nonempty()
+            a_o = None
+            a_r = (frags[0][8], frags[0][2]) if frags else None
+            self.first_id[target] = None
+        # the oracle's repair splits the WIRE anchors' blocks even when the
+        # scan displaces the row elsewhere; mirror those splits on device
+        # with zero-length delete ranges (a pure clean-boundary split) so
+        # the stored row structure matches block-for-block
+        for an, at in ((s_o, (s_o[1] + 1) if s_o else 0), (s_r, s_r[1] if s_r else 0)):
+            if an is None:
+                continue
+            owner = self.dir.owner(an[0], at)  # shard holding the cut unit
+            if owner is not None:
+                self._queue_dels[owner].append((an[0], at, at))
+                self._queued += 1
+        row = self._make_row(c, clock, length, s_o, s_r, a_o, a_r, kind, ref, off)
+        self._enqueue_row(target, row)
+        self._journal_row(c, clock, length, s_o, s_r, kind, anchor_o=a_o)
+        self.dir.add(c, clock, clock + length, target)
+        self.sv.set_max(self.enc.interner.from_idx[c], clock + length)
+        self.flush()
+
+    # ------------------------------------------------------------ public API
+
+    def apply_update_v1(self, payload: bytes) -> None:
+        self.apply_update(Update.decode_v1(payload))
+
+    def apply_update(self, update: Update) -> None:
+        """Integrate a wire update (parity: transaction.rs:675-727 — the
+        stash/retry pending semantics run on the host router)."""
+        applicable, leftover = self.enc.partition_carriers(update, local_sv=self.sv)
+        for carrier in applicable:
+            if isinstance(carrier, SkipRange):
+                continue
+            if isinstance(carrier, GCRange):
+                raise NotImplementedError(
+                    "GC carriers need gc-enabled peers; sharded docs keep tombstones"
+                )
+            self._route_row(carrier)
+        self.pending.extend(leftover)
+        for client, ranges in update.delete_set.clients.items():
+            for s_, e_ in sorted(ranges):
+                self._route_delete(client, s_, e_)
+        self._retry_pending()
+
+    def _journal_row(
+        self,
+        c: int,
+        clock: int,
+        length: int,
+        s_o: Optional[Tuple[int, int]],
+        s_r: Optional[Tuple[int, int]],
+        kind: int,
+        anchor_o: Optional[Tuple[int, int]] = None,
+    ) -> None:
+        """Record a routed row for encode-parity replay.
+
+        Event kinds: the row's own arrival in its client's journal (with
+        the wire facts the oracle's commit squash consults — chain-to-
+        predecessor, right-origin, content kind, born-dead), plus
+        junction-split/occupation events:
+        - the oracle's repair (clean_end/clean_start of the WIRE anchors,
+          block.rs:1287-1300) splits those blocks and never re-squashes
+          (repair splits don't enter the merge list), so both wire anchors
+          journal a split at their junction — origin at `clock+1`
+          (self-chain continuations are the arrival itself, skipped),
+          right-origin at its own clock;
+        - when the row's RESOLVED left anchor differs (scan displacement
+          via the boundary resolver), the physically occupied junction is
+          recorded too (it blocks future arrival squash across it)."""
+        if s_o is not None and not (s_o[0] == c and s_o[1] == clock - 1):
+            self._journal.setdefault(s_o[0], []).append(("s", s_o[1] + 1))
+        if s_r is not None:
+            self._journal.setdefault(s_r[0], []).append(("s", s_r[1]))
+        if anchor_o is not None and anchor_o != s_o:
+            self._journal.setdefault(anchor_o[0], []).append(
+                ("s", anchor_o[1] + 1)
+            )
+        chain_ok = s_o is not None and s_o == (c, clock - 1)
+        self._journal.setdefault(c, []).append(
+            ("a", clock, length, kind == CONTENT_DELETED, chain_ok, s_r, kind)
+        )
+
+    def _route_delete(self, real_client: int, start: int, end: int) -> None:
+        c = self.enc.interner.intern(real_client)
+        known = min(end, self.sv.get(real_client))
+        if known > start:
+            # journal the UNCLIPPED range: per-shard clip edges are segment
+            # cuts, not delete-op boundaries (the oracle never split there)
+            self._journal.setdefault(c, []).append(("d", start, known))
+            for shard, lo, hi in self.dir.clip(c, start, known):
+                self._queue_dels[shard].append((c, lo, hi))
+                self._queued += 1
+        if end > known:
+            self.pending_ds.setdefault(real_client, []).append((max(start, known), end))
+
+    def _retry_pending(self) -> None:
+        """Re-attempt stashed carriers/deletes once new clocks land."""
+        progress = True
+        while progress:
+            progress = False
+            if self.pending:
+                blocks: Dict[int, deque] = {}
+                for ca in self.pending:
+                    blocks.setdefault(ca.id.client, deque()).append(ca)
+                retry = Update(blocks=blocks)
+                self.pending = []
+                applicable, leftover = self.enc.partition_carriers(
+                    retry, local_sv=self.sv
+                )
+                for carrier in applicable:
+                    if not isinstance(carrier, SkipRange):
+                        self._route_row(carrier)
+                        progress = True
+                self.pending = leftover
+            if self.pending_ds:
+                stash, self.pending_ds = self.pending_ds, {}
+                for client, ranges in stash.items():
+                    for s_, e_ in ranges:
+                        before = len(self.pending_ds.get(client, []))
+                        self._route_delete(client, s_, e_)
+                        if len(self.pending_ds.get(client, [])) == before:
+                            progress = True
+
+    def state_vector(self) -> StateVector:
+        return StateVector(dict(self.sv.clocks))
+
+    def shard_lengths(self) -> np.ndarray:
+        self.flush()
+        return np.asarray(visible_lengths(self.state))
+
+    def find_position(self, pos: int) -> Tuple[int, int]:
+        """(shard, local offset) for a visible position — prefix sum over
+        shard lengths instead of the reference's O(doc) item walk."""
+        lens = self.shard_lengths()
+        cum = np.concatenate([[0], np.cumsum(lens)])
+        shard = int(np.searchsorted(cum[1:], pos, side="right"))
+        shard = min(shard, self.S - 1)
+        return shard, pos - int(cum[shard])
+
+    def get_string(self) -> str:
+        from ytpu.models.batch_doc import get_string
+
+        self.flush()
+        return "".join(
+            get_string(self.state, s, self.enc.payloads) for s in range(self.S)
+        )
+
+    def get_values(self) -> list:
+        from ytpu.models.batch_doc import get_values
+
+        self.flush()
+        out: list = []
+        for s in range(self.S):
+            out.extend(get_values(self.state, s, self.enc.payloads))
+        return out
+
+    # ------------------------------------------------------------- encoding
+
+    def _row_item(self, st, s: int, r: int) -> Item:
+        """Reconstruct a host Item (wire-true fields) from device columns."""
+        bl = st.blocks
+        enc = self.enc
+        real = enc.interner.from_idx[int(bl.client[s, r])]
+        oc = int(bl.origin_client[s, r])
+        origin = ID(enc.interner.from_idx[oc], int(bl.origin_clock[s, r])) if oc >= 0 else None
+        rc = int(bl.ror_client[s, r])
+        ror = ID(enc.interner.from_idx[rc], int(bl.ror_clock[s, r])) if rc >= 0 else None
+        kind = int(bl.kind[s, r])
+        ref = int(bl.content_ref[s, r])
+        off = int(bl.content_off[s, r])
+        length = int(bl.length[s, r])
+        if kind == CONTENT_STRING:
+            content = ContentString(enc.payloads.slice_text(ref, off, length))
+        elif kind == CONTENT_ANY:
+            content = ContentAny(enc.payloads.slice_values(ref, off, length))
+        elif kind == CONTENT_DELETED:
+            content = ContentDeleted(length)
+        elif kind == CONTENT_FORMAT:
+            stored: ContentFormat = enc.payloads.items[ref][1]
+            content = stored
+        else:  # pragma: no cover - scope-guarded at routing
+            raise NotImplementedError(f"kind {kind}")
+        item = Item(
+            ID(real, int(bl.clock[s, r])),
+            None,
+            origin,
+            None,
+            ror,
+            self.enc.root_name if origin is None and ror is None else None,
+            None,
+            content,
+        )
+        item.deleted = bool(bl.deleted[s, r])
+        return item
+
+    def _oracle_boundaries(self, c: int, items, order) -> set:
+        """Replay this client's journal to reconstruct the block boundaries
+        the oracle's commit pipeline leaves standing.
+
+        Mirrors, in application order: arrival squash (commit steps 5-6 —
+        a new block merges into its clock-predecessor when the chain /
+        right-origin / tombstone-state / adjacency conditions of try_squash
+        hold, block.rs:775-799), and apply_delete's split + merge-candidate
+        mechanics (transaction.py:249-267 + commit step 7: a range edge
+        splits only when it lands strictly inside a live block; each split
+        piece then squash-tests the junction with its clock-successor —
+        or, for a tail piece, its predecessor). Chain/right-origin/kind/
+        doc-adjacency inputs come from the final device state (immutable
+        or monotone — see module docstring); tombstone state is replayed.
+        """
+        rc = self.enc.interner.from_idx[c]
+        rows = sorted(
+            ((it.id.clock, key) for key, it in items.items() if it.id.client == rc),
+            key=lambda e: e[0],
+        )
+        succ = {order[i]: order[i + 1] for i in range(len(order) - 1)}
+        # final-state compatibility for DELETE-time squash tests only:
+        # chain/ror/kind are immutable and doc-adjacency is monotone-
+        # breaking, so "final-adjacent" implies "adjacent at test time"
+        # (and a junction that is final-broken can never be merged at
+        # encode anyway, making its bset state irrelevant)
+        final_ok: Dict[int, bool] = {}
+        for (ck_a, key_a), (ck_b, key_b) in zip(rows, rows[1:]):
+            a, b = items[key_a], items[key_b]
+            final_ok[ck_b] = (
+                ck_a + a.len == ck_b
+                and b.origin is not None
+                and b.origin.client == rc
+                and b.origin.clock == ck_b - 1
+                and _same_ror_items(a, b)
+                and type(a.content) is type(b.content)
+                and succ.get(key_a) == key_b
+            )
+
+        bset: set = set()
+        dead: List[Tuple[int, int]] = []
+        arrivals: List[Tuple[int, object, int]] = []  # (start, ror, kind)
+        arrival_starts: List[int] = []  # parallel sorted keys for run_info
+        blocked: set = set()  # tail junctions occupied by other rows
+
+        def is_dead(x: int) -> bool:
+            return any(s <= x < e for s, e in dead)
+
+        def in_bset(j: int) -> bool:
+            return j == 0 or j in bset
+
+        def run_info(clock_unit: int):
+            """(ror, kind) of the arrival covering `clock_unit` — splits
+            never change a piece's right-origin (splice keeps it) so the
+            original arrival's facts hold for every later fragment."""
+            i = bisect_right(arrival_starts, clock_unit) - 1
+            return arrivals[i][1:] if i >= 0 else (None, -1)
+
+        tail = 0
+        for ev in self._journal.get(c, []):
+            if ev[0] == "a":
+                _, clock, ln, born_dead, chain_ok, ror, kind = ev
+                if clock > 0:
+                    left_ror, left_kind = run_info(clock - 1)
+                    merged = (
+                        tail == clock
+                        and chain_ok
+                        and clock not in blocked
+                        and left_ror == ror
+                        and left_kind == kind
+                        and is_dead(clock - 1) == bool(born_dead)
+                    )
+                    if not merged:
+                        bset.add(clock)
+                arrivals.append((clock, ror, kind))
+                arrival_starts.append(clock)
+                tail = max(tail, clock + ln)
+                if born_dead:
+                    dead.append((clock, clock + ln))
+            elif ev[0] == "s":
+                # another row occupies this junction: a physical split if
+                # mid-run (the junction persists with the row between),
+                # or a standing adjacency block at the run tail
+                j = ev[1]
+                if j >= tail:
+                    blocked.add(j)
+                elif not in_bset(j):
+                    bset.add(j)
+            else:
+                _, s, e = ev
+                candidates = []
+                if s > 0 and not in_bset(s) and not is_dead(s) and s < tail:
+                    bset.add(s)
+                    candidates.append(s)
+                if not in_bset(e) and not is_dead(e) and e < tail:
+                    bset.add(e)
+                    candidates.append(e)
+                dead.append((s, e))
+                for cand in candidates:
+                    later = [j for j in bset if j > cand]
+                    nb = min(later) if later else None
+                    j = nb if nb is not None else (cand if cand > 0 else None)
+                    if (
+                        j is not None
+                        and in_bset(j)
+                        and final_ok.get(j, False)
+                        and is_dead(j - 1) == is_dead(j)
+                    ):
+                        bset.discard(j)
+        return bset
+
+    def encode_state_as_update_v1(self, remote_sv: Optional[StateVector] = None) -> bytes:
+        """Wire-exact full/diff state encode.
+
+        Rows are gathered across shards, merged under the reference's
+        `try_squash` conditions (block.rs:775-799: same client, contiguous
+        clocks, origin chains to the left part's last id, same right
+        origin, doc-order adjacency, same tombstone state, mergeable
+        content) so the emitted blocks match what the reference's
+        commit-time squash would have stored, then encoded by the host
+        update encoder (byte parity with the oracle by construction)."""
+        st = self._pull()
+        order = self._global_rows(st)
+        bl = st.blocks
+        succ: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        for gi in range(len(order) - 1):
+            succ[order[gi]] = order[gi + 1]
+
+        items: Dict[Tuple[int, int], Item] = {}
+        merged_into: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        for s, r in order:
+            items[(s, r)] = self._row_item(st, s, r)
+
+        def root(k):
+            while k in merged_into:
+                k = merged_into[k]
+            return k
+
+        interned = self.enc.interner.to_idx
+        boundaries = {
+            c: self._oracle_boundaries(c, items, order) for c in self._journal
+        }
+        for gi in range(len(order) - 1):
+            a_key, b_key = root(order[gi]), order[gi + 1]
+            a, b = items[a_key], items[b_key]
+            if (
+                a.id.client == b.id.client
+                and a.id.clock + a.len == b.id.clock
+                and b.origin is not None
+                and b.origin.client == a.id.client
+                and b.origin.clock == a.id.clock + a.len - 1
+                and _same_ror_items(a, b)
+                and a.deleted == b.deleted
+                and b.id.clock
+                not in boundaries.get(interned.get(a.id.client, -1), ())
+                and a.content.merge(b.content)
+            ):
+                a.len += b.len
+                merged_into[b_key] = a_key
+                del items[b_key]
+
+        blocks: Dict[int, deque] = {}
+        for key in sorted(items, key=lambda k: (items[k].id.client, items[k].id.clock)):
+            it = items[key]
+            blocks.setdefault(it.id.client, deque()).append(it)
+        ds = DeleteSet()
+        for it_key, it in items.items():
+            if it.deleted:
+                ds.insert_range(it.id.client, it.id.clock, it.id.clock + it.len)
+        update = Update(blocks=blocks, delete_set=ds)
+        if remote_sv is None:
+            return update.encode_v1()
+        return update.encode_diff_v1(remote_sv)
+
+    # ------------------------------------------------------------ rebalance
+
+    def rebalance(self) -> None:
+        """Re-cut the segments evenly by clock units (the bulk boundary-
+        block exchange).
+
+        Pulls the global doc order, splits rows that straddle the new cut
+        points (host mirror of `_split` — the right part chains its origin
+        to the left part's last id and inherits the right origin, matching
+        splice at block.rs:435-478), assigns contiguous runs to shards and
+        rebuilds the chains + directory. Live split pairs re-merge at
+        encode time, so wire parity is preserved. Anchors that later
+        straddle the new boundaries either hit the exact-first-id fast
+        path or the host resolver."""
+        self.flush()
+        st = self._pull()
+        order = self._global_rows(st)
+        bl = st.blocks
+        rows: List[Dict[str, int]] = []
+        for s, r in order:
+            rows.append({n: int(getattr(bl, n)[s, r]) for n in BlockCols._fields})
+        total = sum(r["length"] for r in rows)
+        per_units = max(1, -(-total // self.S))
+
+        # split rows at the unit cut points
+        out_rows: List[List[Dict[str, int]]] = [[] for _ in range(self.S)]
+        tgt, acc = 0, 0
+        for row in rows:
+            while True:
+                room = per_units - acc
+                if tgt >= self.S - 1 or row["length"] <= room:
+                    out_rows[tgt].append(row)
+                    acc += row["length"]
+                    if acc >= per_units and tgt < self.S - 1:
+                        tgt, acc = tgt + 1, 0
+                    break
+                if room <= 0:
+                    tgt, acc = tgt + 1, 0
+                    continue
+                left_part = dict(row)
+                left_part["length"] = room
+                right_part = dict(row)
+                right_part["clock"] = row["clock"] + room
+                right_part["length"] = row["length"] - room
+                right_part["origin_client"] = row["client"]
+                right_part["origin_clock"] = row["clock"] + room - 1
+                right_part["content_off"] = row["content_off"] + room
+                out_rows[tgt].append(left_part)
+                tgt, acc = tgt + 1, 0
+                row = right_part
+
+        n_max = max(1, max(len(q) for q in out_rows))
+        cap = self.capacity
+        while cap < n_max * 2:
+            cap *= 2
+        arrays = {
+            name: np.full(
+                (self.S, cap),
+                COL_DEFAULTS[name],
+                dtype=np.bool_ if isinstance(COL_DEFAULTS[name], bool) else np.int32,
+            )
+            for name in BlockCols._fields
+        }
+        start = np.full(self.S, -1, dtype=np.int32)
+        n_blocks = np.zeros(self.S, dtype=np.int32)
+        self.dir = _Directory()
+        self.first_id = [None] * self.S
+        for s in range(self.S):
+            for li, row in enumerate(out_rows[s]):
+                for name in BlockCols._fields:
+                    arrays[name][s, li] = row[name]
+                arrays["left"][s, li] = li - 1 if li > 0 else -1
+                arrays["right"][s, li] = li + 1 if li + 1 < len(out_rows[s]) else -1
+                self.dir.add(
+                    row["client"], row["clock"], row["clock"] + row["length"], s
+                )
+            if out_rows[s]:
+                start[s] = 0
+                n_blocks[s] = len(out_rows[s])
+                self.first_id[s] = (out_rows[s][0]["client"], out_rows[s][0]["clock"])
+        self.state = DocStateBatch(
+            blocks=BlockCols(**{n: jnp.asarray(a) for n, a in arrays.items()}),
+            start=jnp.asarray(start),
+            n_blocks=jnp.asarray(n_blocks),
+            error=jnp.zeros(self.S, I32),
+        )
+        self.capacity = cap
+        self._n_rows = n_blocks.astype(np.int64)
+        self._invalidate()
+
+    # ------------------------------------------------------------------ mesh
+
+    def place_on_mesh(self, mesh, axis: str = AXIS_SP) -> None:
+        """Shard the block columns over a mesh's sequence-parallel axis.
+
+        The shard slot axis (leading) maps onto ``axis``; subsequent
+        `apply_step_sharded` calls then run SPMD across the mesh devices —
+        the data path has no cross-shard collectives by construction, so
+        the partitioned program is embarrassingly parallel and only
+        `visible_lengths`' reduction (a psum along sp at fetch time)
+        crosses devices."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        self.flush()
+        sh = NamedSharding(mesh, PartitionSpec(axis))
+        self.state = jax.tree.map(lambda a: jax.device_put(a, sh), self.state)
+        self._invalidate()
+
+    # ---------------------------------------------------------- constructors
+
+    @classmethod
+    def from_doc(
+        cls,
+        doc: Doc,
+        n_shards: int = 8,
+        capacity: int = 1024,
+        root_name: str = "text",
+        max_rows_per_step: int = 64,
+    ) -> "ShardedDoc":
+        sd = cls(
+            n_shards=n_shards,
+            capacity=capacity,
+            root_name=root_name,
+            max_rows_per_step=max_rows_per_step,
+        )
+        sd.apply_update_v1(doc.encode_state_as_update_v1())
+        sd.rebalance()
+        return sd
