@@ -187,3 +187,45 @@ def test_compact_plus_grow_sustains_small_capacity():
     state = compact_state(state)
     assert int(state.error[0]) == 0
     assert get_string(state, 0, enc.payloads) == expect
+
+
+def test_compact_packed_preserves_move_columns():
+    """compact_packed must carry all NC=25 columns, remapping `moved` slot
+    indices through the defragment permutation (regression: the packed
+    compactor once emitted only the 17 pre-move columns)."""
+    from ytpu.ops.compaction import compact_packed, grow_packed
+    from ytpu.ops.integrate_kernel import NC, MV, MPR, pack_state, unpack_state
+
+    doc = Doc(client_id=1)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    arr = doc.get_array("a")
+    with doc.transact() as txn:
+        for v in range(6):
+            arr.push_back(txn, v)
+    with doc.transact() as txn:
+        arr.move_to(txn, 1, 5)
+    with doc.transact() as txn:
+        arr.remove_range(txn, 0, 1)  # a tombstone for compaction to chew
+
+    enc = BatchEncoder(root_name="a")
+    state = init_state(1, 64)
+    for p in log:
+        batch = enc.build_batch([Update.decode_v1(p)])
+        state = apply_update_batch(state, batch, enc.interner.rank_table())
+    expect = get_values(state, 0, enc.payloads)
+
+    cols, meta = pack_state(state)
+    assert cols.shape[0] == NC
+    cols2, meta2 = compact_packed(cols, meta)
+    assert cols2.shape[0] == NC
+    cols3, meta3 = grow_packed(cols2, meta2, 128)
+    # padded slots must read as unowned, not "owned by slot 0"
+    assert int(np.asarray(cols3[MV]).max(initial=-1)) < 64
+    assert int(np.asarray(cols3[MV][0, 64:]).max(initial=-1)) == -1
+    assert int(np.asarray(cols3[MPR][0, 64:]).max(initial=-1)) == -1
+    out = unpack_state(cols3, meta3, state)
+    assert get_values(out, 0, enc.payloads) == expect
+    # a live move row still owns its range after defrag
+    moved = np.asarray(out.blocks.moved[0])
+    assert (moved >= 0).any()

@@ -222,6 +222,14 @@ def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
         LT,
         M_NBLOCKS,
         M_START,
+        MEA,
+        MEC,
+        MEK,
+        MPR,
+        MSA,
+        MSC,
+        MSK,
+        MV,
         OC,
         OF,
         OK,
@@ -274,6 +282,12 @@ def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
         & (deleted == g(deleted))
         & (key_c == g(key_c))
         & (pa_c == g(pa_c))
+        # try_squash parity (block.rs:775-799): `self.moved == other.moved`
+        # — rows owned by different moves (or one owned, one not) never
+        # merge, and move rows themselves (length-1 ranges) don't either
+        & (cols[MV] == g(cols[MV]))
+        & (cols[MPR] < 0)
+        & (g(cols[MPR]) < 0)
     )
     gcish = kind == BLOCK_GC
     # ContentType rows carry live child-sequence heads even when deleted;
@@ -361,6 +375,14 @@ def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
             pack(key_c, -1),  # KEY
             pack(remap(pa_c), -1),  # PA
             pack(remap(cols[HD]), -1),  # HD
+            pack(remap(cols[MV]), -1),  # MV (slot index: defrag remap)
+            pack(cols[MSC], -1),  # MSC
+            pack(cols[MSK], 0),  # MSK
+            pack(cols[MSA], 0),  # MSA
+            pack(cols[MEC], -1),  # MEC
+            pack(cols[MEK], 0),  # MEK
+            pack(cols[MEA], 0),  # MEA
+            pack(cols[MPR], -1),  # MPR
         ]
     )
     start = meta[M_START]
@@ -381,7 +403,21 @@ def compact_packed(cols, meta, unit_refs: bool = False, gc_ranges: bool = False)
 
 def grow_packed(cols, meta, new_capacity: int):
     """Widen a packed state's capacity (slot indices survive unchanged)."""
-    from ytpu.ops.integrate_kernel import CL, HD, KEY, LT, OC, PA, RC, RF, RT
+    from ytpu.ops.integrate_kernel import (
+        CL,
+        HD,
+        KEY,
+        LT,
+        MEC,
+        MPR,
+        MSC,
+        MV,
+        OC,
+        PA,
+        RC,
+        RF,
+        RT,
+    )
 
     NC_, D, C = cols.shape
     if new_capacity < C:
@@ -389,10 +425,11 @@ def grow_packed(cols, meta, new_capacity: int):
     if new_capacity == C:
         return cols, meta
     pad = jnp.zeros((NC_, D, new_capacity - C), I32)
-    # -1-filled columns: client/origin/ror clients, links, content ref
+    # -1-filled columns: client/origin/ror clients, links, content ref,
+    # move ownership/bound clients/priority (COL_DEFAULTS parity)
     neg = (
         jnp.zeros((NC_,), I32)
-        .at[jnp.array([CL, OC, RC, LT, RT, RF, KEY, PA, HD])]
+        .at[jnp.array([CL, OC, RC, LT, RT, RF, KEY, PA, HD, MV, MSC, MEC, MPR])]
         .set(-1)
     )
     pad = pad + neg[:, None, None]

@@ -64,14 +64,22 @@ I32 = jnp.int32
     KEY,  # interned parent_sub (-1 = sequence item)
     PA,  # parent ContentType row (-1 = root)
     HD,  # child-sequence head (ContentType rows)
-) = range(17)
-NC = 17
-# move columns are NOT packed: the fused kernel excludes move rows
-# (guarded below) — move ownership needs the end-of-update recompute pass
-# that only the XLA path runs; moved/mv_* pass through unchanged.
+    MV,  # slot of the move row owning this row (-1 = unowned)
+    MSC,  # move rows: range-start id client (-1 = branch-scoped bound)
+    MSK,  # move rows: range-start id clock
+    MSA,  # move rows: start assoc (>= 0 after, < 0 before)
+    MEC,  # move rows: range-end id client
+    MEK,  # move rows: range-end id clock
+    MEA,  # move rows: end assoc
+    MPR,  # move rows: conflict priority
+) = range(25)
+NC = 25
 
 # meta columns in the packed [D, 8] array (padded to a TPU-friendly lane dim)
-M_START, M_NBLOCKS, M_ERROR = 0, 1, 2
+# M_MDIRTY: move ownership must be recomputed for this doc at step end (a
+# move row arrived, an insert straddled differently-owned neighbors, or a
+# delete tombstoned a live move — the moves_dirty of batch_doc)
+M_START, M_NBLOCKS, M_ERROR, M_MDIRTY = 0, 1, 2, 3
 M_PAD = 8
 
 ERR_CAPACITY = 1
@@ -99,6 +107,14 @@ def pack_state(state: DocStateBatch) -> Tuple[jax.Array, jax.Array]:
             bl.key,
             bl.parent,
             bl.head,
+            bl.moved,
+            bl.mv_sc,
+            bl.mv_sk,
+            bl.mv_sa,
+            bl.mv_ec,
+            bl.mv_ek,
+            bl.mv_ea,
+            bl.mv_prio,
         ]
     )  # [NC, D, C]
     D = state.start.shape[0]
@@ -112,8 +128,8 @@ def pack_state(state: DocStateBatch) -> Tuple[jax.Array, jax.Array]:
 def unpack_state(
     cols: jax.Array, meta: jax.Array, state: DocStateBatch
 ) -> DocStateBatch:
-    """Rebuild state from kernel outputs; move columns pass through from
-    the pre-kernel `state` (move rows are excluded from the fused path)."""
+    """Rebuild state from kernel outputs."""
+    del state  # all columns now live in the packed buffers
     blocks = BlockCols(
         client=cols[CL],
         clock=cols[CK],
@@ -132,14 +148,14 @@ def unpack_state(
         key=cols[KEY],
         parent=cols[PA],
         head=cols[HD],
-        moved=state.blocks.moved,
-        mv_sc=state.blocks.mv_sc,
-        mv_sk=state.blocks.mv_sk,
-        mv_sa=state.blocks.mv_sa,
-        mv_ec=state.blocks.mv_ec,
-        mv_ek=state.blocks.mv_ek,
-        mv_ea=state.blocks.mv_ea,
-        mv_prio=state.blocks.mv_prio,
+        moved=cols[MV],
+        mv_sc=cols[MSC],
+        mv_sk=cols[MSK],
+        mv_sa=cols[MSA],
+        mv_ec=cols[MEC],
+        mv_ek=cols[MEK],
+        mv_ea=cols[MEA],
+        mv_prio=cols[MPR],
     )
     return DocStateBatch(
         blocks=blocks,
@@ -150,7 +166,7 @@ def unpack_state(
 
 
 def pack_stream(stream: UpdateBatch) -> Tuple[jax.Array, jax.Array]:
-    """Stacked doc-axis-free stream → rows [S, U, 15] / dels [S, R, 4] i32."""
+    """Stacked doc-axis-free stream → rows [S, U, 22] / dels [S, R, 4] i32."""
     rows = jnp.stack(
         [
             stream.client,
@@ -168,9 +184,16 @@ def pack_stream(stream: UpdateBatch) -> Tuple[jax.Array, jax.Array]:
             stream.p_client,
             stream.p_clock,
             stream.valid.astype(I32),
+            stream.mv_sc,
+            stream.mv_sk,
+            stream.mv_sa,
+            stream.mv_ec,
+            stream.mv_ek,
+            stream.mv_ea,
+            stream.mv_prio,
         ],
         axis=-1,
-    )  # [S, U, 15]
+    )  # [S, U, 22]
     dels = jnp.stack(
         [
             stream.del_client,
@@ -271,7 +294,8 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         @pl.when(jnp.any(do))
         def _():
             right_i = gather(RT, i_idx, -1)
-            # new row j = right half
+            # new row j = right half (moved inherits — splice parity; the
+            # mv_* range fields stay empty: length-1 move rows never split)
             put_many(
                 j,
                 do,
@@ -293,6 +317,14 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
                     (KEY, gather(KEY, i_idx, -1)),
                     (PA, gather(PA, i_idx, -1)),
                     (HD, gather(HD, i_idx, -1)),
+                    (MV, gather(MV, i_idx, -1)),
+                    (MSC, jnp.full((DB,), -1, I32)),
+                    (MSK, jnp.zeros((DB,), I32)),
+                    (MSA, jnp.zeros((DB,), I32)),
+                    (MEC, jnp.full((DB,), -1, I32)),
+                    (MEK, jnp.zeros((DB,), I32)),
+                    (MEA, jnp.zeros((DB,), I32)),
+                    (MPR, jnp.full((DB,), -1, I32)),
                 ],
             )
             # fix left half + old right neighbor
@@ -329,6 +361,14 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         r_ptag = rows_ref[s, u, 11]
         r_pclient = rows_ref[s, u, 12]
         r_pclock = rows_ref[s, u, 13]
+        r_mv_sc = rows_ref[s, u, 15]
+        r_mv_sk = rows_ref[s, u, 16]
+        r_mv_sa = rows_ref[s, u, 17]
+        r_mv_ec = rows_ref[s, u, 18]
+        r_mv_ek = rows_ref[s, u, 19]
+        r_mv_ea = rows_ref[s, u, 20]
+        r_mv_prio = rows_ref[s, u, 21]
+        is_move_row = r_kind == CONTENT_MOVE
 
         local = client_clock(r_client)  # (DB,)
         applicable = local >= r_clock
@@ -507,6 +547,19 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
             ~row_deleted & (r_kind != CONTENT_FORMAT) & (r_kind != CONTENT_MOVE)
         )
 
+        # moved-range inheritance (parity: block.rs:677-702): an insert
+        # between rows owned by the same move inherits the owner; a
+        # mismatch marks the doc for the end-of-step recompute
+        left_moved = jnp.where(has_left, gather(MV, left_idx, -1), -1)
+        right_moved = jnp.where(
+            right_final >= 0, gather(MV, right_final, -1), -1
+        )
+        inherit_moved = jnp.where(left_moved == right_moved, left_moved, -1)
+        moved_conflict = linkable & (left_moved != right_moved)
+        meta_ref[:, M_MDIRTY] = meta_ref[:, M_MDIRTY] | (
+            (moved_conflict | (do & is_move_row)).astype(I32)
+        )
+
         put_many(
             j,
             do,
@@ -528,6 +581,14 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
                 (KEY, key_v),
                 (PA, parent_row),
                 (HD, jnp.full((DB,), -1, I32)),
+                (MV, jnp.where(linkable, inherit_moved, -1)),
+                (MSC, jnp.full((DB,), jnp.where(is_move_row, r_mv_sc, -1), I32)),
+                (MSK, jnp.full((DB,), jnp.where(is_move_row, r_mv_sk, 0), I32)),
+                (MSA, jnp.full((DB,), jnp.where(is_move_row, r_mv_sa, 0), I32)),
+                (MEC, jnp.full((DB,), jnp.where(is_move_row, r_mv_ec, -1), I32)),
+                (MEK, jnp.full((DB,), jnp.where(is_move_row, r_mv_ek, 0), I32)),
+                (MEA, jnp.full((DB,), jnp.where(is_move_row, r_mv_ea, 0), I32)),
+                (MPR, jnp.full((DB,), jnp.where(is_move_row, r_mv_prio, -1), I32)),
             ],
         )
         # a map row that became its chain's tail is the key's live value;
@@ -558,7 +619,8 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         k, kfound = find_slot(client_v, end_v - 1, enable)
         k_ok = kfound & (gather(DL, k, 1) == 0)
         split(k, end_v - gather(CK, k, 0), k_ok)
-        # mark covered blocks deleted
+        # mark covered blocks deleted; tombstoning a live move row dirties
+        # the doc (its claims must be released — moving.rs:229-280)
         valid = iota_c < n_blocks()[:, None]
         m = (
             valid
@@ -566,7 +628,165 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
             & (col(CK) >= start)
             & (col(CK) + col(LN) <= end)
         )
+        hit_move = jnp.any(
+            m & (col(KD) == CONTENT_MOVE) & (col(DL) == 0), axis=1
+        )
+        meta_ref[:, M_MDIRTY] = meta_ref[:, M_MDIRTY] | hit_move.astype(I32)
         cols_ref[DL] = jnp.where(m, 1, col(DL))
+
+    # --- move ownership (parity: moving.rs:149-227 via batch_doc's
+    # _claim_move/_move_cycle/_recompute_moves) -----------------------------
+
+    def resolve_move_ptr(c_v, k_v, assoc_v, enable):
+        """Sticky (client, clock, assoc) -> first in-range slot per doc."""
+        after = assoc_v >= 0
+        i_a, found_a = clean_start(c_v, k_v, enable & after & (c_v >= 0))
+        i_b, found_b = clean_end(c_v, k_v, enable & ~after & (c_v >= 0))
+        right_b = gather(RT, i_b, -1)
+        ptr = jnp.where(after, i_a, right_b)
+        found = jnp.where(after, found_a, found_b)
+        return ptr, found
+
+    def claim_move(s_v, enable):
+        """One claim pass for per-doc move slot s_v (walk its range,
+        claiming rows the move beats on (priority, client rank, clock))."""
+        msc = gather(MSC, s_v, -1)
+        msk = gather(MSK, s_v, 0)
+        msa = gather(MSA, s_v, 0)
+        mec = gather(MEC, s_v, -1)
+        mek = gather(MEK, s_v, 0)
+        mea = gather(MEA, s_v, 0)
+        start, s_found = resolve_move_ptr(msc, msk, msa, enable)
+        endp, e_found = resolve_move_ptr(mec, mek, mea, enable)
+        par = gather(PA, s_v, -1)
+        seq_head = jnp.where(
+            par < 0, meta_ref[:, M_START], gather(HD, par, -1)
+        )
+        start = jnp.where(msc < 0, seq_head, start)
+        endp = jnp.where(mec < 0, -1, endp)
+        unresolved = enable & (
+            ((msc >= 0) & ~s_found) | ((mec >= 0) & ~e_found)
+        )
+        meta_ref[:, M_ERROR] = meta_ref[:, M_ERROR] | jnp.where(
+            unresolved, ERR_MISSING_DEP, 0
+        )
+        enable = enable & ~unresolved
+        prio_s = gather(MPR, s_v, -1)
+        rank_s = gather_rank(gather(CL, s_v, -1))
+        clock_s = gather(CK, s_v, 0)
+
+        def wcond(carry):
+            cur, n = carry
+            return jnp.any(enable & (cur >= 0) & (cur != endp) & (n <= C))
+
+        def wbody(carry):
+            cur, n = carry
+            active = enable & (cur >= 0) & (cur != endp) & (n <= C)
+            m = gather(MV, cur, -1)
+            prev_prio = jnp.where(m >= 0, gather(MPR, m, -1), -1)
+            prev_rank = gather_rank(gather(CL, m, -1))
+            prev_clock = gather(CK, m, 0)
+            takes = (prev_prio < prio_s) | (
+                (prev_prio == prio_s)
+                & (m >= 0)
+                & (
+                    (prev_rank < rank_s)
+                    | ((prev_rank == rank_s) & (prev_clock < clock_s))
+                )
+            )
+            # a beaten collapsed move tombstones on the spot (parity:
+            # _delete_as_cleanup, moving.rs:190-196)
+            m_msc = gather(MSC, m, -1)
+            m_collapsed = (
+                (m >= 0)
+                & (m_msc >= 0)
+                & (m_msc == gather(MEC, m, -2))
+                & (gather(MSK, m, 0) == gather(MEK, m, -1))
+            )
+            put(DL, m, jnp.ones((DB,), I32), active & takes & m_collapsed)
+            put(MV, cur, s_v, active & takes)
+            cur = jnp.where(active, gather(RT, cur, -1), cur)
+            return cur, n + 1
+
+        jax.lax.while_loop(wcond, wbody, (start, jnp.zeros((DB,), I32)))
+        return enable
+
+    def move_cycle(s_v, enable):
+        """Does s_v sit on an ownership cycle? Ownership is single-parent,
+        so walking the `moved` chain upward from s_v either terminates or
+        returns to s_v (find_move_loop parity, moving.rs:113-141). Like
+        the XLA `_move_cycle`, the chain only counts LIVE MOVE nodes — a
+        stale claim held by a tombstoned move must not close a cycle."""
+
+        def live_move(idx):
+            return (gather(KD, idx, -1) == CONTENT_MOVE) & (
+                gather(DL, idx, 1) == 0
+            )
+
+        def ccond(carry):
+            cur, n, hit = carry
+            return jnp.any(enable & (cur >= 0) & ~hit & (n <= C))
+
+        def cbody(carry):
+            cur, n, hit = carry
+            active = enable & (cur >= 0) & ~hit & (n <= C)
+            nxt = gather(MV, cur, -1)
+            hit = hit | (active & (nxt == s_v) & (s_v >= 0))
+            # a dead or non-move node breaks the live ownership chain
+            nxt = jnp.where(live_move(nxt), nxt, -1)
+            cur = jnp.where(active, nxt, cur)
+            return cur, n + 1, hit
+
+        first = gather(MV, s_v, -1)
+        first = jnp.where(live_move(first), first, -1)
+        _, _, hit = jax.lax.while_loop(
+            ccond,
+            cbody,
+            (first, jnp.zeros((DB,), I32), jnp.zeros((DB,), bool)),
+        )
+        return hit
+
+    def recompute_moves():
+        """Per-doc from-scratch ownership recompute for dirty docs (the
+        end-of-update pass of batch_doc._recompute_moves)."""
+        dirty = meta_ref[:, M_MDIRTY] > 0
+
+        @pl.when(jnp.any(dirty))
+        def _():
+            cols_ref[MV] = jnp.where(dirty[:, None], -1, col(MV))
+            done0 = jnp.zeros((DB, C), I32)
+
+            def active_moves(done):
+                return (
+                    (iota_c < n_blocks()[:, None])
+                    & (col(KD) == CONTENT_MOVE)
+                    & (col(DL) == 0)
+                    & (done == 0)
+                    & dirty[:, None]
+                )
+
+            def rcond(done):
+                return jnp.any(active_moves(done))
+
+            def rbody(done):
+                am = active_moves(done)
+                s_idx = jnp.min(jnp.where(am, iota_c, C), axis=1).astype(I32)
+                exists = s_idx < C
+                s_v = jnp.where(exists, s_idx, -1)
+                enable = claim_move(s_v, dirty & exists)
+                cyc = move_cycle(s_v, enable) & exists
+                put(DL, s_v, jnp.ones((DB,), I32), cyc)
+                # cycle: release every claim and replay without s
+                cols_ref[MV] = jnp.where(cyc[:, None], -1, col(MV))
+                onehot_s = (iota_c == s_v[:, None]) & exists[:, None]
+                done = jnp.where(
+                    cyc[:, None], 0, done | onehot_s.astype(I32)
+                )
+                return done
+
+            jax.lax.while_loop(rcond, rbody, done0)
+
+        meta_ref[:, M_MDIRTY] = jnp.zeros((DB,), I32)
 
     def step(s, _):
         def row_body(u, __):
@@ -586,6 +806,7 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
             return 0
 
         jax.lax.fori_loop(0, R, del_body, 0)
+        recompute_moves()
         return 0
 
     jax.lax.fori_loop(0, S, step, 0)
@@ -618,11 +839,12 @@ def _run(cols, meta, packed, d_block: int, interpret: bool):
         input_output_aliases={3: 0, 4: 1},
         interpret=interpret,
         # the doc tile ([NC, d_block, C] i32) plus the conflict-scan's
-        # [d_block, C] temporaries are the VMEM tenants; the default 16MB
-        # scoped limit caps d_block at 32 for C=2048 — v5e/v6e cores have
-        # 128MB VMEM, so let tiles use up to half (d_block=128, the
-        # measured sweet spot, needs ~56MB; 256 fits only with a ~118MB
-        # limit and compiles pathologically slowly — not worth it)
+        # [d_block, C] temporaries are the VMEM tenants. With NC=25 (move
+        # columns included) a d_block=128/C=2048 tile is ~26MB + scan
+        # temporaries; the pre-move measured sweet spot (d_block=128 at
+        # ~56MB total under NC=17) now lands near the 64MB limit, so
+        # re-measure on hardware — d_block<=96 is the safe default at
+        # C=2048 if allocation fails
         compiler_params=None
         if interpret
         else pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024),
@@ -639,22 +861,14 @@ def apply_update_stream_fused(
     guard: bool = True,
 ) -> DocStateBatch:
     """Fused-replay drop-in for `apply_update_stream`: sequence rows, map
-    rows (per-key LWW chains), and nested-branch parents all integrate
-    in-VMEM. Only move rows are excluded — move-ownership recomputation is
-    the XLA path's end-of-update pass.
+    rows (per-key LWW chains), nested-branch parents AND move ranges all
+    integrate in-VMEM — move claims run as a fused end-of-step recompute
+    pass (the claim walk / cycle check / ownership argmax of
+    `batch_doc._recompute_moves`, parity: moving.rs:149-227).
 
-    Callers that built everything through one `BatchEncoder` can check the
-    encoder's stream for moves host-side and pass `guard=False` — the
-    default device-side guard costs one host-device sync before launch."""
-    if guard and bool(
-        jnp.any((stream.kind == CONTENT_MOVE) & stream.valid)
-        | jnp.any(state.blocks.kind == CONTENT_MOVE)
-    ):
-        raise NotImplementedError(
-            "apply_update_stream_fused excludes move ranges (move claims "
-            "need the XLA path's recompute pass); use apply_update_stream "
-            "for streams containing ContentMove"
-        )
+    `guard` is kept for call-site compatibility; it no longer excludes
+    anything."""
+    del guard
     cols, meta = pack_state(state)
     D = cols.shape[1]
     if D % d_block != 0:
