@@ -8,35 +8,22 @@ bench.py and the driver's compile checks.
 import os
 import sys
 
-# The tunneled-TPU plugin (axon) registers itself at interpreter start and
-# can hang `import jax` indefinitely when the device tunnel is down — even
-# under JAX_PLATFORMS=cpu. The tests are CPU-only by design, so restart the
-# test process once with the registration env removed.
-def _is_pytest_cli() -> bool:
-    """Only a plain CLI invocation (`pytest …` / `python -m pytest …`) can
-    be faithfully rebuilt as `python -m pytest argv[1:]`; programmatic
-    pytest.main() callers and xdist worker bootstraps cannot."""
-    a0 = os.path.basename(sys.argv[0])
-    return a0 in ("pytest", "py.test") or sys.argv[0].endswith(
-        os.path.join("pytest", "__main__.py")
-    )
-
-
-if (
-    os.environ.get("PALLAS_AXON_POOL_IPS")
-    and not os.environ.get("YTPU_TEST_REEXEC")
-    and _is_pytest_cli()
-):
-    _env = dict(os.environ)
-    _env.pop("PALLAS_AXON_POOL_IPS", None)
-    _env["YTPU_TEST_REEXEC"] = "1"
-    _env["JAX_PLATFORMS"] = "cpu"
-    os.execve(
-        sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], _env
-    )
-
-# Must be set before the JAX backend initializes.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The tunneled-TPU plugin (axon) is imported at interpreter start (via a
+# site hook) in every Python process, and its *device init* can hang
+# indefinitely when the tunnel is down.  Registration alone is harmless;
+# the hang only happens if a backend for the axon platform is initialized
+# (e.g. jax.devices() with JAX_PLATFORMS=axon).  The tests are CPU-only by
+# design, so pin the platform to cpu in the environment BEFORE jax is
+# imported — jax never initializes backends at import time, so the axon
+# plugin is never touched.
+#
+# (An earlier version of this file re-exec'd the whole pytest process with
+# the axon env removed.  That silently swallowed all pytest output: pytest's
+# fd-level capture is active while conftest files load, so the exec'd child
+# inherited fd 1/2 pointing at pytest's private temp files.  Do not bring
+# the exec back.)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -45,8 +32,7 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax
 
-# Some environments inject an accelerator platform ahead of the env var
-# (e.g. a tunneled TPU plugin); pin to cpu explicitly for the test session.
+# Belt and braces: even if something imported jax before us, pin cpu.
 try:
     jax.config.update("jax_platforms", "cpu")
 except Exception:

@@ -9,6 +9,7 @@ from .batch_doc import (
     apply_update_stream,
     encode_diff_batch,
     finish_encode_diff,
+    finish_encode_diff_batch,
     BlockCols,
     ClientInterner,
     DocStateBatch,
@@ -30,6 +31,7 @@ __all__ = [
     "apply_update_stream",
     "encode_diff_batch",
     "finish_encode_diff",
+    "finish_encode_diff_batch",
     "BlockCols",
     "ClientInterner",
     "DocStateBatch",

@@ -61,6 +61,7 @@ __all__ = [
     "KeyInterner",
     "PayloadStore",
     "BatchEncoder",
+    "finish_encode_diff_batch",
     "get_string",
     "get_map",
     "get_tree",
@@ -1120,6 +1121,282 @@ def _encode_device_row(
         # other payload kinds stash the host content object directly
         content = payloads.items[ref][1]
         content.encode(out)
+
+
+def _payload_native_arenas(store) -> dict:
+    """Per-item arenas for the native finisher, cached on the PayloadStore.
+
+    The store is append-only, so the cache extends incrementally: UTF-16LE
+    text bytes for string payloads, pre-encoded content blobs (the exact
+    bytes `content.encode(EncoderV1())` emits — the Python finisher's
+    else-branch), and per-element pre-encoded `write_any` bytes for
+    ContentAny payloads.
+    """
+    from ytpu.encoding.codec import EncoderV1
+
+    ar = getattr(store, "_nat_arena", None)
+    if ar is None:
+        ar = {
+            "n": 0,
+            "text": bytearray(),
+            "text_off": [],
+            "text_units": [],
+            "blob": bytearray(),
+            "blob_off": [],
+            "blob_len": [],
+            "elem_base": [],
+            "elem_count": [],
+            "elem_off": [0],
+            "elem": bytearray(),
+        }
+        store._nat_arena = ar
+    items = store.items
+    for i in range(ar["n"], len(items)):
+        kind, payload = items[i]
+        text_off = blob_off = blob_len = elem_base = -1
+        text_units = elem_count = 0
+        if kind == CONTENT_STRING and isinstance(payload, (bytes, bytearray)):
+            text_off = len(ar["text"])
+            text_units = len(payload) // 2
+            ar["text"] += payload
+        elif kind == CONTENT_ANY and isinstance(payload, list):
+            elem_base = len(ar["elem_off"]) - 1
+            elem_count = len(payload)
+            for v in payload:
+                enc = EncoderV1()
+                enc.write_any(v)
+                ar["elem"] += enc.to_bytes()
+                ar["elem_off"].append(len(ar["elem"]))
+        else:
+            try:
+                enc = EncoderV1()
+                payload.encode(enc)
+                blob = enc.to_bytes()
+                blob_off = len(ar["blob"])
+                blob_len = len(blob)
+                ar["blob"] += blob
+            except Exception:
+                pass  # row falls back to the Python finisher
+        ar["text_off"].append(text_off)
+        ar["text_units"].append(text_units)
+        ar["blob_off"].append(blob_off)
+        ar["blob_len"].append(blob_len)
+        ar["elem_base"].append(elem_base)
+        ar["elem_count"].append(elem_count)
+    ar["n"] = len(items)
+
+    # numpy mirrors, rebuilt only when the store grew — a long-lived server
+    # answering single-doc syncs must not re-copy the whole store per reply
+    key = (ar["n"], len(ar["text"]), len(ar["blob"]), len(ar["elem"]))
+    if ar.get("np_key") != key:
+        ar["np"] = {
+            "text": np.frombuffer(bytes(ar["text"]) or b"\0", dtype=np.uint8),
+            "blob": np.frombuffer(bytes(ar["blob"]) or b"\0", dtype=np.uint8),
+            "elem": np.frombuffer(bytes(ar["elem"]) or b"\0", dtype=np.uint8),
+            "text_off": np.asarray(ar["text_off"] or [0], dtype=np.int64),
+            "text_units": np.asarray(ar["text_units"] or [0], dtype=np.int64),
+            "blob_off": np.asarray(ar["blob_off"] or [0], dtype=np.int64),
+            "blob_len": np.asarray(ar["blob_len"] or [0], dtype=np.int64),
+            "elem_base": np.asarray(ar["elem_base"] or [0], dtype=np.int64),
+            "elem_count": np.asarray(ar["elem_count"] or [0], dtype=np.int64),
+            "elem_off": np.asarray(ar["elem_off"] or [0], dtype=np.int64),
+        }
+        ar["np_key"] = key
+    return ar
+
+
+def _wire_concat(payloads) -> np.ndarray:
+    """One contiguous buffer over a ChunkedWirePayloads' retained chunks
+    (refs <= -2 index into it directly), cached by total byte count."""
+    cached = getattr(payloads, "_nat_wire", None)
+    if cached is not None and cached[0] == payloads.total_bytes:
+        return cached[1]
+    chunks = [flat for _, flat in payloads._chunks]
+    buf = np.concatenate(chunks) if chunks else np.empty(0, dtype=np.uint8)
+    payloads._nat_wire = (payloads.total_bytes, buf)
+    return buf
+
+
+def finish_encode_diff_batch(
+    state: DocStateBatch,
+    docs,
+    ship: np.ndarray,
+    offsets: np.ndarray,
+    deleted: np.ndarray,
+    enc: "BatchEncoder",
+    payloads=None,
+) -> List[bytes]:
+    """Batched native finisher: selected device rows -> v1 payloads for
+    many docs in one C++ call (VERDICT r2 #6; reference equivalent:
+    store.rs:204-248 compiled). Byte-identical to `finish_encode_diff`;
+    docs holding a row outside the native scope (wire-ref Format/Embed,
+    unknown kinds) fall back to the Python finisher individually.
+    """
+    import ctypes
+
+    from ytpu import native as _native
+    from ytpu.ops.decode_kernel import ChunkedWirePayloads
+
+    if payloads is None:
+        payloads = enc.payloads
+    docs = list(docs)
+    lib = _native.load()
+    if lib is None or not getattr(lib, "finisher_ok", False):
+        return [
+            finish_encode_diff(state, d, ship, offsets, deleted, enc, payloads)
+            for d in docs
+        ]
+
+    if isinstance(payloads, ChunkedWirePayloads):
+        store = payloads.store
+        wire = _wire_concat(payloads)
+    else:
+        store = payloads
+        wire = np.empty(0, dtype=np.uint8)
+    ar = _payload_native_arenas(store)
+
+    bl = state.blocks
+    D, B = bl.client.shape
+    col_names = (
+        "client",
+        "clock",
+        "length",
+        "origin_client",
+        "origin_clock",
+        "ror_client",
+        "ror_clock",
+        "kind",
+        "content_ref",
+        "content_off",
+        "key",
+        "parent",
+    )
+
+    def col_i32(a):
+        return np.ascontiguousarray(np.asarray(a), dtype=np.int32)
+
+    if len(docs) * 4 <= D:
+        # small selection (e.g. one sync reply): gather the selected docs'
+        # rows on device so only [n_sel, B] transfers to host, not [D, B]
+        idx = jnp.asarray(docs, dtype=jnp.int32)
+        cols = {
+            name: col_i32(jnp.take(getattr(bl, name), idx, axis=0))
+            for name in col_names
+        }
+        ship_u8 = np.ascontiguousarray(ship[docs], dtype=np.uint8)
+        deleted_u8 = np.ascontiguousarray(deleted[docs], dtype=np.uint8)
+        offsets_i32 = np.ascontiguousarray(offsets[docs], dtype=np.int32)
+        sel = np.arange(len(docs), dtype=np.int32)
+        D = len(docs)
+    else:
+        cols = {name: col_i32(getattr(bl, name)) for name in col_names}
+        ship_u8 = np.ascontiguousarray(ship, dtype=np.uint8)
+        deleted_u8 = np.ascontiguousarray(deleted, dtype=np.uint8)
+        offsets_i32 = np.ascontiguousarray(offsets, dtype=np.int32)
+        sel = np.ascontiguousarray(np.asarray(docs), dtype=np.int32)
+    from_idx = np.ascontiguousarray(enc.interner.from_idx, dtype=np.int64)
+    if from_idx.size == 0:
+        from_idx = np.zeros(1, dtype=np.int64)
+
+    n_keys = len(enc.keys)
+    key_names = [enc.keys.names[k].encode("utf-8") for k in range(n_keys)]
+    key_blob = np.frombuffer(b"".join(key_names) or b"\0", dtype=np.uint8)
+    key_off = np.zeros(n_keys + 1, dtype=np.int64)
+    if key_names:
+        key_off[1:] = np.cumsum([len(k) for k in key_names])
+    root = np.frombuffer(enc.root_name.encode("utf-8") or b"\0", dtype=np.uint8)
+
+    nparr = ar["np"]
+    text_arena = nparr["text"]
+    blob_arena = nparr["blob"]
+    elem_arena = nparr["elem"]
+    item_text_off = nparr["text_off"]
+    item_text_units = nparr["text_units"]
+    item_blob_off = nparr["blob_off"]
+    item_blob_len = nparr["blob_len"]
+    item_elem_base = nparr["elem_base"]
+    item_elem_count = nparr["elem_count"]
+    elem_off = nparr["elem_off"]
+    wire = np.ascontiguousarray(wire, dtype=np.uint8)
+    if wire.size == 0:
+        wire = np.zeros(1, dtype=np.uint8)
+
+    def p_i32(a):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+    def p_i64(a):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+    def p_u8(a):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+    fin = _native.FinishIn(
+        n_docs_total=D,
+        n_blocks_cap=B,
+        client=p_i32(cols["client"]),
+        clock=p_i32(cols["clock"]),
+        length=p_i32(cols["length"]),
+        origin_client=p_i32(cols["origin_client"]),
+        origin_clock=p_i32(cols["origin_clock"]),
+        ror_client=p_i32(cols["ror_client"]),
+        ror_clock=p_i32(cols["ror_clock"]),
+        kind=p_i32(cols["kind"]),
+        content_ref=p_i32(cols["content_ref"]),
+        content_off=p_i32(cols["content_off"]),
+        key=p_i32(cols["key"]),
+        parent=p_i32(cols["parent"]),
+        ship=p_u8(ship_u8),
+        offsets=p_i32(offsets_i32),
+        deleted=p_u8(deleted_u8),
+        sel=p_i32(sel),
+        n_sel=len(docs),
+        from_idx=p_i64(from_idx),
+        n_interned=len(enc.interner),
+        key_blob=p_u8(key_blob),
+        key_off=p_i64(key_off),
+        n_keys=n_keys,
+        root_name=p_u8(root),
+        root_name_len=len(enc.root_name.encode("utf-8")),
+        text_arena=p_u8(text_arena),
+        text_arena_len=len(ar["text"]),
+        item_text_off=p_i64(item_text_off),
+        item_text_units=p_i64(item_text_units),
+        blob_arena=p_u8(blob_arena),
+        blob_arena_len=len(ar["blob"]),
+        item_blob_off=p_i64(item_blob_off),
+        item_blob_len=p_i64(item_blob_len),
+        item_elem_base=p_i64(item_elem_base),
+        item_elem_count=p_i64(item_elem_count),
+        elem_off=p_i64(elem_off),
+        elem_arena=p_u8(elem_arena),
+        elem_arena_len=len(ar["elem"]),
+        n_items=ar["n"],
+        wire=p_u8(wire),
+        wire_len=int(getattr(payloads, "total_bytes", 0)),
+    )
+    handle = lib.ytpu_finish_batch(ctypes.byref(fin))
+    try:
+        data_ptr = lib.ytpu_finish_data(handle)
+        out: List[bytes] = []
+        off = ctypes.c_int64()
+        ln = ctypes.c_int64()
+        for i, d in enumerate(docs):
+            if lib.ytpu_finish_status(handle, i) == 0:
+                lib.ytpu_finish_span(handle, i, ctypes.byref(off), ctypes.byref(ln))
+                out.append(
+                    ctypes.string_at(
+                        ctypes.addressof(data_ptr.contents) + off.value, ln.value
+                    )
+                )
+            else:
+                out.append(
+                    finish_encode_diff(
+                        state, d, ship, offsets, deleted, enc, payloads
+                    )
+                )
+        return out
+    finally:
+        lib.ytpu_finish_free(handle)
 
 
 @partial(jax.jit, static_argnums=1)

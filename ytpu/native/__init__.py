@@ -29,6 +29,7 @@ __all__ = [
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "lib0_codec.cpp")
 _ENGINE_SRC = os.path.join(_HERE, "engine.cpp")
+_FINISHER_SRC = os.path.join(_HERE, "encode_finisher.cpp")
 _LIB = os.path.join(_HERE, "_libytpu.so")
 
 _lock = threading.Lock()
@@ -68,6 +69,7 @@ def _build() -> bool:
                 "-std=c++17",
                 _SRC,
                 _ENGINE_SRC,
+                _FINISHER_SRC,
                 "-o",
                 _LIB,
             ],
@@ -132,13 +134,66 @@ def build_capi(force: bool = False) -> Optional[str]:
         return None
 
 
+class FinishIn(ctypes.Structure):
+    """Mirror of `FinishIn` in encode_finisher.cpp (field order must match)."""
+
+    _fields_ = [
+        ("n_docs_total", ctypes.c_int32),
+        ("n_blocks_cap", ctypes.c_int32),
+        ("client", ctypes.POINTER(ctypes.c_int32)),
+        ("clock", ctypes.POINTER(ctypes.c_int32)),
+        ("length", ctypes.POINTER(ctypes.c_int32)),
+        ("origin_client", ctypes.POINTER(ctypes.c_int32)),
+        ("origin_clock", ctypes.POINTER(ctypes.c_int32)),
+        ("ror_client", ctypes.POINTER(ctypes.c_int32)),
+        ("ror_clock", ctypes.POINTER(ctypes.c_int32)),
+        ("kind", ctypes.POINTER(ctypes.c_int32)),
+        ("content_ref", ctypes.POINTER(ctypes.c_int32)),
+        ("content_off", ctypes.POINTER(ctypes.c_int32)),
+        ("key", ctypes.POINTER(ctypes.c_int32)),
+        ("parent", ctypes.POINTER(ctypes.c_int32)),
+        ("ship", ctypes.POINTER(ctypes.c_uint8)),
+        ("offsets", ctypes.POINTER(ctypes.c_int32)),
+        ("deleted", ctypes.POINTER(ctypes.c_uint8)),
+        ("sel", ctypes.POINTER(ctypes.c_int32)),
+        ("n_sel", ctypes.c_int32),
+        ("from_idx", ctypes.POINTER(ctypes.c_int64)),
+        ("n_interned", ctypes.c_int32),
+        ("key_blob", ctypes.POINTER(ctypes.c_uint8)),
+        ("key_off", ctypes.POINTER(ctypes.c_int64)),
+        ("n_keys", ctypes.c_int32),
+        ("root_name", ctypes.POINTER(ctypes.c_uint8)),
+        ("root_name_len", ctypes.c_int32),
+        ("text_arena", ctypes.POINTER(ctypes.c_uint8)),
+        ("text_arena_len", ctypes.c_int64),
+        ("item_text_off", ctypes.POINTER(ctypes.c_int64)),
+        ("item_text_units", ctypes.POINTER(ctypes.c_int64)),
+        ("blob_arena", ctypes.POINTER(ctypes.c_uint8)),
+        ("blob_arena_len", ctypes.c_int64),
+        ("item_blob_off", ctypes.POINTER(ctypes.c_int64)),
+        ("item_blob_len", ctypes.POINTER(ctypes.c_int64)),
+        ("item_elem_base", ctypes.POINTER(ctypes.c_int64)),
+        ("item_elem_count", ctypes.POINTER(ctypes.c_int64)),
+        ("elem_off", ctypes.POINTER(ctypes.c_int64)),
+        ("elem_arena", ctypes.POINTER(ctypes.c_uint8)),
+        ("elem_arena_len", ctypes.c_int64),
+        ("n_items", ctypes.c_int64),
+        ("wire", ctypes.POINTER(ctypes.c_uint8)),
+        ("wire_len", ctypes.c_int64),
+    ]
+
+
 def load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     with _lock:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        newest_src = max(os.path.getmtime(_SRC), os.path.getmtime(_ENGINE_SRC))
+        newest_src = max(
+            os.path.getmtime(_SRC),
+            os.path.getmtime(_ENGINE_SRC),
+            os.path.getmtime(_FINISHER_SRC),
+        )
         if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < newest_src:
             if not _build():
                 return None
@@ -189,6 +244,27 @@ def load() -> Optional[ctypes.CDLL]:
         lib.ytpu_engine_str_free.argtypes = [ctypes.c_void_p]
         lib.ytpu_engine_n_items.restype = ctypes.c_size_t
         lib.ytpu_engine_n_items.argtypes = [ctypes.c_void_p]
+        # the finisher passes a 40+ field struct by pointer; refuse to bind
+        # unless the C++ and ctypes layouts agree byte-for-byte (a field
+        # added/reordered on one side would otherwise corrupt memory)
+        lib.ytpu_finish_in_sizeof.restype = ctypes.c_int64
+        lib.finisher_ok = (
+            int(lib.ytpu_finish_in_sizeof()) == ctypes.sizeof(FinishIn)
+        )
+        if lib.finisher_ok:
+            lib.ytpu_finish_batch.restype = ctypes.c_void_p
+            lib.ytpu_finish_batch.argtypes = [ctypes.POINTER(FinishIn)]
+            lib.ytpu_finish_status.restype = ctypes.c_int32
+            lib.ytpu_finish_status.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+            lib.ytpu_finish_data.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.ytpu_finish_data.argtypes = [ctypes.c_void_p]
+            lib.ytpu_finish_span.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.ytpu_finish_free.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 

@@ -176,7 +176,10 @@ class DeviceSyncServer(SyncServer):
         247-263)."""
         import jax.numpy as jnp
 
-        from ytpu.models.batch_doc import encode_diff_batch, finish_encode_diff
+        from ytpu.models.batch_doc import (
+            encode_diff_batch,
+            finish_encode_diff_batch,
+        )
 
         self.flush_device()
         ing = self.ingestor
@@ -193,15 +196,15 @@ class DeviceSyncServer(SyncServer):
         ship, offsets, _local, deleted = encode_diff_batch(
             ing.state, jnp.asarray(remote), n_clients
         )
-        payload = finish_encode_diff(
+        payload = finish_encode_diff_batch(
             ing.state,
-            slot,
+            [slot],
             np.asarray(ship),
             np.asarray(offsets),
             np.asarray(deleted),
             ing.enc,
             payloads=ing.payloads,
-        )
+        )[0]
         pending = ing.pending_update(slot)
         pending_ds = ing.pending_ds(slot)
         if pending is not None or pending_ds is not None:
