@@ -212,3 +212,147 @@ def test_merge_partial_overlap_does_not_mutate_inputs():
     replica = Doc(client_id=10)
     replica.apply_update_v1(merged.encode_v1())
     assert replica.get_text("t").get_string() == "abcde"
+
+
+# --- YText.applyDelta (ywasm/src/text.rs:335 apply_delta; oracle scenarios
+# ported from the reference's tests-wasm/y-text.tests.js) -----------------
+
+
+def _delta(text):
+    return [
+        (d.insert, d.attributes) if d.attributes else (d.insert, None)
+        for d in text.diff()
+    ]
+
+
+def test_apply_delta_multiline_format():
+    doc = Doc(client_id=1)
+    t = doc.get_text("test")
+    with doc.transact() as txn:
+        t.insert(txn, 0, "Test\nMulti-line\nFormatting")
+    with doc.transact() as txn:
+        t.apply_delta(
+            txn,
+            [
+                {"retain": 4, "attributes": {"bold": True}},
+                {"retain": 1},
+                {"retain": 10, "attributes": {"bold": True}},
+                {"retain": 1},
+                {"retain": 10, "attributes": {"bold": True}},
+            ],
+        )
+    assert _delta(t) == [
+        ("Test", {"bold": True}),
+        ("\n", None),
+        ("Multi-line", {"bold": True}),
+        ("\n", None),
+        ("Formatting", {"bold": True}),
+    ]
+
+
+def test_apply_delta_does_not_merge_formatted_empty_lines():
+    doc = Doc(client_id=1)
+    t = doc.get_text("test")
+    with doc.transact() as txn:
+        t.apply_delta(
+            txn,
+            [
+                {"insert": "Text"},
+                {"insert": "\n", "attributes": {"title": True}},
+                {"insert": "\nText"},
+                {"insert": "\n", "attributes": {"title": True}},
+            ],
+        )
+    assert _delta(t) == [
+        ("Text", None),
+        ("\n", {"title": True}),
+        ("\nText", None),
+        ("\n", {"title": True}),
+    ]
+
+
+def test_apply_delta_embed():
+    doc = Doc(client_id=1)
+    t = doc.get_text("test")
+    with doc.transact() as txn:
+        t.apply_delta(txn, [{"insert": {"linebreak": "s"}}])
+    assert _delta(t) == [({"linebreak": "s"}, None)]
+
+
+def test_apply_delta_insert_unsets_surrounding_format():
+    """Quill semantics: an insert without attributes inside a bold region
+    must NOT inherit the bold (reference: pos.unset_missing, block.rs:954)."""
+    doc = Doc(client_id=1)
+    t = doc.get_text("test")
+    with doc.transact() as txn:
+        t.insert_with_attributes(txn, 0, "bold", {"b": True})
+    with doc.transact() as txn:
+        t.apply_delta(txn, [{"retain": 2}, {"insert": "plain"}])
+    assert _delta(t) == [
+        ("bo", {"b": True}),
+        ("plain", None),
+        ("ld", {"b": True}),
+    ]
+
+
+def test_apply_delta_snapshot_sequence():
+    doc = Doc(client_id=1, skip_gc=True)
+    t = doc.get_text("test")
+    with doc.transact() as txn:
+        t.apply_delta(txn, [{"insert": "abcd"}])
+    snap1 = doc.snapshot()
+    with doc.transact() as txn:
+        t.apply_delta(txn, [{"retain": 1}, {"insert": "x"}, {"delete": 1}])
+    snap2 = doc.snapshot()
+    with doc.transact() as txn:
+        t.apply_delta(
+            txn, [{"retain": 2}, {"delete": 1}, {"insert": "x"}, {"delete": 1}]
+        )
+    with doc.transact() as txn:
+        assert [d.insert for d in t.diff_range(txn, snap1)] == ["abcd"]
+    with doc.transact() as txn:
+        assert [d.insert for d in t.diff_range(txn, snap2)] == ["axcd"]
+    with doc.transact() as txn:
+        runs = [
+            (d.insert, d.ychange.kind if d.ychange else None)
+            for d in t.diff_range(txn, snap2, snap1)
+        ]
+    assert runs == [("a", None), ("x", "added"), ("b", "removed"), ("cd", None)]
+
+
+def test_apply_delta_converges_across_peers():
+    a, b = Doc(client_id=1), Doc(client_id=2)
+    ta = a.get_text("test")
+    with a.transact() as txn:
+        ta.apply_delta(txn, [{"insert": "shared "}, {"insert": "bold", "attributes": {"b": True}}])
+    b.apply_update_v1(a.encode_state_as_update_v1(StateVector()))
+    tb = b.get_text("test")
+    with b.transact() as txn:
+        tb.apply_delta(txn, [{"retain": 7}, {"delete": 4}, {"insert": "BOLD", "attributes": {"b": True}}])
+    a.apply_update_v1(b.encode_state_as_update_v1(a.state_vector()))
+    assert ta.get_string() == tb.get_string() == "shared BOLD"
+    assert _delta(ta) == _delta(tb)
+
+
+# --- Awareness.remove_states (ywasm/src/awareness.rs:134) ----------------
+
+
+def test_awareness_remove_states():
+    from ytpu.sync.awareness import Awareness
+
+    aw = Awareness(Doc(client_id=7))
+    aw.set_local_state({"x": 1})
+    events = []
+    aw.on_change(lambda a, e: events.append(e))
+    aw.remove_states([7])
+    assert aw.all_states() == {}
+    assert events and events[-1].removed == [7]
+    # clean_local_state really removes (it must bypass the remote-removal
+    # resurrection guard)
+    aw.set_local_state({"x": 2})
+    aw.clean_local_state()
+    assert aw.all_states() == {}
+    # and the removal replicates: a peer applying our update drops us too
+    peer = Awareness(Doc(client_id=9))
+    peer.apply_update(aw.update_with_clients([7]))
+    assert 7 not in peer.all_states()

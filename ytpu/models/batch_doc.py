@@ -1207,14 +1207,36 @@ def _payload_native_arenas(store) -> dict:
 
 def _wire_concat(payloads) -> np.ndarray:
     """One contiguous buffer over a ChunkedWirePayloads' retained chunks
-    (refs <= -2 index into it directly), cached by total byte count."""
-    cached = getattr(payloads, "_nat_wire", None)
-    if cached is not None and cached[0] == payloads.total_bytes:
-        return cached[1]
-    chunks = [flat for _, flat in payloads._chunks]
-    buf = np.concatenate(chunks) if chunks else np.empty(0, dtype=np.uint8)
-    payloads._nat_wire = (payloads.total_bytes, buf)
-    return buf
+    (refs <= -2 index into it directly). Grows incrementally — chunk lists
+    are append-only across calls (drop_if_unreferenced only fires within
+    an ingest step), so each call copies only the chunks added since the
+    last one, not the whole history."""
+    state = getattr(payloads, "_nat_wire", None)
+    if state is None:
+        state = {
+            "arr": np.empty(4096, dtype=np.uint8),
+            "len": 0,
+            "n_chunks": 0,
+            "gen": payloads.generation,
+        }
+        payloads._nat_wire = state
+    chunks = payloads._chunks
+    if state["gen"] != payloads.generation:
+        # a retained chunk was dropped since we last looked (possibly then
+        # replaced at the same base): resync from scratch
+        state["len"] = 0
+        state["n_chunks"] = 0
+        state["gen"] = payloads.generation
+    for _, flat in chunks[state["n_chunks"] :]:
+        need = state["len"] + flat.size
+        if need > state["arr"].size:
+            grown = np.empty(max(need, state["arr"].size * 2), dtype=np.uint8)
+            grown[: state["len"]] = state["arr"][: state["len"]]
+            state["arr"] = grown
+        state["arr"][state["len"] : need] = flat
+        state["len"] = need
+    state["n_chunks"] = len(chunks)
+    return state["arr"][: state["len"]]
 
 
 def finish_encode_diff_batch(
@@ -1294,17 +1316,32 @@ def finish_encode_diff_batch(
         deleted_u8 = np.ascontiguousarray(deleted, dtype=np.uint8)
         offsets_i32 = np.ascontiguousarray(offsets, dtype=np.int32)
         sel = np.ascontiguousarray(np.asarray(docs), dtype=np.int32)
-    from_idx = np.ascontiguousarray(enc.interner.from_idx, dtype=np.int64)
-    if from_idx.size == 0:
-        from_idx = np.zeros(1, dtype=np.int64)
-
+    # interner/key tables are append-only: rebuild only when they grew
+    tables = getattr(enc, "_nat_tables", None)
     n_keys = len(enc.keys)
-    key_names = [enc.keys.names[k].encode("utf-8") for k in range(n_keys)]
-    key_blob = np.frombuffer(b"".join(key_names) or b"\0", dtype=np.uint8)
-    key_off = np.zeros(n_keys + 1, dtype=np.int64)
-    if key_names:
-        key_off[1:] = np.cumsum([len(k) for k in key_names])
-    root = np.frombuffer(enc.root_name.encode("utf-8") or b"\0", dtype=np.uint8)
+    if tables is None or tables["key"] != (len(enc.interner), n_keys):
+        from_idx = np.ascontiguousarray(enc.interner.from_idx, dtype=np.int64)
+        if from_idx.size == 0:
+            from_idx = np.zeros(1, dtype=np.int64)
+        key_names = [enc.keys.names[k].encode("utf-8") for k in range(n_keys)]
+        key_blob = np.frombuffer(b"".join(key_names) or b"\0", dtype=np.uint8)
+        key_off = np.zeros(n_keys + 1, dtype=np.int64)
+        if key_names:
+            key_off[1:] = np.cumsum([len(k) for k in key_names])
+        tables = {
+            "key": (len(enc.interner), n_keys),
+            "from_idx": from_idx,
+            "key_blob": key_blob,
+            "key_off": key_off,
+            "root": np.frombuffer(
+                enc.root_name.encode("utf-8") or b"\0", dtype=np.uint8
+            ),
+        }
+        enc._nat_tables = tables
+    from_idx = tables["from_idx"]
+    key_blob = tables["key_blob"]
+    key_off = tables["key_off"]
+    root = tables["root"]
 
     nparr = ar["np"]
     text_arena = nparr["text"]

@@ -250,7 +250,11 @@ void put_canonical_float(Buf& out, double v) {
 // INTEGERs, whole-number floats become INTEGERs, f32-exact doubles become
 // FLOAT32s). Returns false (→ per-doc Python fallback) on malformed input
 // or a map with duplicate keys (dict dedup changes the count).
-bool reencode_any(const uint8_t* p, int64_t len, int64_t& pos, Buf& out) {
+bool reencode_any(const uint8_t* p, int64_t len, int64_t& pos, Buf& out,
+                  int depth = 0) {
+  // untrusted wire data: bound recursion so deeply nested arrays/maps
+  // degrade to the Python fallback instead of smashing the C stack
+  if (depth > 100) return false;
   if (pos >= len) return false;
   uint8_t tag = p[pos++];
   uint64_t n;
@@ -332,7 +336,7 @@ bool reencode_any(const uint8_t* p, int64_t len, int64_t& pos, Buf& out) {
         out.var(klen);
         out.raw(p + pos, static_cast<size_t>(klen));
         pos += static_cast<int64_t>(klen);
-        if (!reencode_any(p, len, pos, out)) return false;
+        if (!reencode_any(p, len, pos, out, depth + 1)) return false;
       }
       return true;
     }
@@ -341,7 +345,7 @@ bool reencode_any(const uint8_t* p, int64_t len, int64_t& pos, Buf& out) {
       out.u8(tag);
       out.var(n);
       for (uint64_t i = 0; i < n; i++)
-        if (!reencode_any(p, len, pos, out)) return false;
+        if (!reencode_any(p, len, pos, out, depth + 1)) return false;
       return true;
     }
     default:
@@ -351,7 +355,8 @@ bool reencode_any(const uint8_t* p, int64_t len, int64_t& pos, Buf& out) {
 
 // skip one lib0 Any value (tags descend from 127; ytpu/encoding/lib0.py
 // read_any / reference any.rs:93-184)
-bool skip_any(const uint8_t* p, int64_t len, int64_t& pos) {
+bool skip_any(const uint8_t* p, int64_t len, int64_t& pos, int depth = 0) {
+  if (depth > 100) return false;
   if (pos >= len) return false;
   uint8_t tag = p[pos++];
   uint64_t n;
@@ -390,14 +395,14 @@ bool skip_any(const uint8_t* p, int64_t len, int64_t& pos) {
         if (!read_var(p, len, pos, klen)) return false;
         if (klen > static_cast<uint64_t>(len - pos)) return false;
         pos += static_cast<int64_t>(klen);
-        if (!skip_any(p, len, pos)) return false;
+        if (!skip_any(p, len, pos, depth + 1)) return false;
       }
       return true;
     }
     case 117: {  // array
       if (!read_var(p, len, pos, n)) return false;
       for (uint64_t i = 0; i < n; i++)
-        if (!skip_any(p, len, pos)) return false;
+        if (!skip_any(p, len, pos, depth + 1)) return false;
       return true;
     }
     default:

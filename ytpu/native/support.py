@@ -28,6 +28,7 @@ from ytpu.types.shared import (
     SharedType,
     TextPrelim,
     XmlElementPrelim,
+    XmlFragmentPrelim,
     XmlTextPrelim,
 )
 from ytpu.types.text import Text
@@ -208,6 +209,8 @@ def input_to_value(tag: int, payload: Any) -> Any:
         return MapPrelim(json.loads(payload) if payload else {})
     if tag == Y_XML_ELEM:
         return XmlElementPrelim(payload or "UNDEFINED")
+    if tag == Y_XML_FRAG:
+        return XmlFragmentPrelim(payload or [])
     if tag == Y_DOC:
         return payload  # a Doc instance → ContentDoc on insertion
     if tag == Y_WEAK_LINK:

@@ -1089,6 +1089,9 @@ class ChunkedWirePayloads:
         self.store = store
         self._chunks: List[Tuple[int, np.ndarray]] = []  # (base, flat bytes)
         self.total_bytes = 0
+        # bumped whenever a chunk is dropped, so incremental consumers
+        # (the native finisher's wire-buffer cache) know to resync
+        self.generation = 0
 
     @property
     def items(self):
@@ -1109,6 +1112,7 @@ class ChunkedWirePayloads:
         if self._chunks and self._chunks[-1][0] == base:
             self._chunks.pop()
             self.total_bytes = base
+            self.generation += 1
 
     def _locate(self, ref: int) -> Tuple[np.ndarray, int]:
         off = -(int(ref) + 2)

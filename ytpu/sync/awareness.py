@@ -99,13 +99,38 @@ class Awareness:
     def set_local_state(self, state: PyAny) -> None:
         """Set (or with None: clear) this client's presence."""
         client = self.client_id
+        if state is None:
+            self.remove_state(client)
+            return
         prev = self.meta.get(client)
         clock = (prev.clock if prev else 0) + 1
-        json = NULL_STR if state is None else _json.dumps(state, separators=(",", ":"))
+        json = _json.dumps(state, separators=(",", ":"))
         self._apply_entry(client, clock, json)
 
     def clean_local_state(self) -> None:
-        self.set_local_state(None)
+        self.remove_state(self.client_id)
+
+    def remove_state(self, client: int) -> None:
+        """Clear a client's state, marking it disconnected (parity:
+        awareness.rs:217 remove_state; surfaced as ywasm
+        removeAwarenessStates). A DIRECT removal — the local-state
+        resurrection guard in `apply_update` only applies to entries
+        received from remote peers, never to deliberate local removals.
+        The bumped clock makes the removal win precedence at peers."""
+        prev = self.meta.get(client)
+        clock = (prev.clock if prev else 0) + 1
+        self.meta[client] = _MetaClientState(clock, self._now())
+        was_present = self.states.pop(client, None) is not None
+        if was_present:
+            event = AwarenessEvent([], [], [client])
+            for cb in list(self.on_change_subs):
+                cb(self, event)
+            for cb in list(self.on_update_subs):
+                cb(self, event)
+
+    def remove_states(self, clients) -> None:
+        for client in clients:
+            self.remove_state(client)
 
     # --- wire ------------------------------------------------------------------
 

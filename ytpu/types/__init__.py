@@ -26,6 +26,7 @@ from .shared import (
     SharedType,
     TextPrelim,
     XmlElementPrelim,
+    XmlFragmentPrelim,
     XmlTextPrelim,
 )
 from .text import Diff, Text
@@ -48,6 +49,7 @@ __all__ = [
     "ArrayPrelim",
     "MapPrelim",
     "XmlElementPrelim",
+    "XmlFragmentPrelim",
     "XmlTextPrelim",
     "WeakRef",
     "WeakPrelim",

@@ -43,6 +43,7 @@ __all__ = [
     "MapPrelim",
     "XmlTextPrelim",
     "XmlElementPrelim",
+    "XmlFragmentPrelim",
     "find_position",
     "out_value",
     "to_content",
@@ -151,6 +152,22 @@ class MapPrelim(Prelim):
 
 class XmlTextPrelim(TextPrelim):
     type_ref = TYPE_XML_TEXT
+
+
+class XmlFragmentPrelim(Prelim):
+    """Nested XML fragment (parity: yrs XmlFragmentPrelim, types/xml.rs:384;
+    ywasm YXmlFragment::new(children))."""
+
+    type_ref = TYPE_XML_FRAGMENT
+
+    def __init__(self, children=()):
+        self.children = list(children)
+
+    def fill(self, txn: Transaction, branch: Branch) -> None:
+        if self.children:
+            from .xml import XmlFragment
+
+            XmlFragment(branch).insert_range(txn, 0, self.children)
 
 
 class XmlElementPrelim(Prelim):

@@ -317,6 +317,29 @@ class Text(SharedType):
             )
             pos.left = item
 
+    def apply_delta(self, txn: Transaction, delta) -> None:
+        """Apply a Quill-style delta (parity: types/text.rs:233-265
+        `apply_delta`, with helpers insert :703, remove :806, insert_format
+        :875; surfaced as ywasm YText.applyDelta).
+
+        `delta` is an iterable of ops: ``{"insert": str | embed | prelim,
+        "attributes"?}``, ``{"delete": n}``, ``{"retain": n, "attributes"?}``.
+        A single cursor walks the sequence across ops; inserts explicitly
+        unset surrounding formats not named in their attributes (Quill
+        semantics — unlike `insert`, which inherits them).
+        """
+        branch = self.branch
+        pos = ItemPosition(branch, None, branch.start, 0, {})
+        for op in delta:
+            if "insert" in op:
+                attrs = dict(op.get("attributes") or {})
+                _delta_insert(branch, txn, pos, op["insert"], attrs)
+            elif "delete" in op:
+                _delta_remove(txn, pos, int(op["delete"]))
+            elif "retain" in op:
+                attrs = dict(op.get("attributes") or {})
+                _delta_retain(branch, txn, pos, int(op["retain"]), attrs)
+
     def push(self, txn: Transaction, chunk: str) -> None:
         self.insert(txn, len(self), chunk)
 
@@ -345,3 +368,165 @@ class Text(SharedType):
         if pos is None:
             raise IndexError(index)
         return pos
+
+
+# --- apply_delta cursor machinery ---------------------------------------------
+# Faithful ports of the reference free functions the Delta walker composes
+# (types/text.rs: unset_missing block.rs:954, minimize_attr_changes :943,
+# insert_attributes :965, insert_negated_attributes :1008, insert :703,
+# remove :806 + clean_format_gap :1058, insert_format :875). Attribute
+# values use None for the wire's Null (an explicit format reset).
+
+
+def _unset_missing(pos: ItemPosition, attrs: Dict[str, PyAny]) -> None:
+    if pos.current_attrs:
+        for k in pos.current_attrs:
+            if k not in attrs:
+                attrs[k] = None
+
+
+def _minimize_attr_changes(pos: ItemPosition, attrs: Dict[str, PyAny]) -> None:
+    """Skip over existing format marks that already state what we'd insert."""
+    while pos.right is not None:
+        right = pos.right
+        if right.deleted:
+            pos.forward()
+        elif (
+            isinstance(right.content, ContentFormat)
+            and right.content.key in attrs
+            and attrs[right.content.key] == right.content.value
+        ):
+            pos.forward()
+        else:
+            break
+
+
+def _insert_attributes(branch, txn: Transaction, pos: ItemPosition, attrs):
+    negated: Dict[str, PyAny] = {}
+    for k, v in attrs.items():
+        current = (pos.current_attrs or {}).get(k)
+        if v != current:
+            negated[k] = current
+            item = txn.create_item(pos, ContentFormat(k, v), None)
+            pos.right = item
+            pos.forward()
+    return negated
+
+
+def _insert_negated_attributes(branch, txn: Transaction, pos: ItemPosition, negated):
+    while pos.right is not None:
+        right = pos.right
+        if right.deleted:
+            pos.forward()
+        elif (
+            isinstance(right.content, ContentFormat)
+            and right.content.key in negated
+            and negated[right.content.key] == right.content.value
+        ):
+            del negated[right.content.key]
+            pos.forward()
+        else:
+            break
+    for k, v in negated.items():
+        item = txn.create_item(pos, ContentFormat(k, v), None)
+        pos.right = item
+        pos.forward()
+
+
+def _delta_insert(branch, txn: Transaction, pos: ItemPosition, value, attrs) -> None:
+    _unset_missing(pos, attrs)
+    _minimize_attr_changes(pos, attrs)
+    negated = _insert_attributes(branch, txn, pos, attrs)
+    if isinstance(value, str):
+        item = txn.create_item(pos, ContentString(value), None)
+    elif hasattr(value, "make_branch"):  # a prelim shared type as embed
+        content, prelim = to_content(value)
+        item = txn.create_item(pos, content, None)
+        prelim.fill(txn, item.content.branch)
+    else:
+        item = txn.create_item(pos, ContentEmbed(value), None)
+    if item is not None:  # zero-length content creates no item (text.rs:714)
+        pos.right = item
+        pos.forward()
+    _insert_negated_attributes(branch, txn, pos, negated)
+
+
+def _delta_remove(txn: Transaction, pos: ItemPosition, length: int) -> None:
+    remaining = length
+    start = pos.right
+    start_attrs = dict(pos.current_attrs or {})
+    store = txn.store
+    while pos.right is not None and remaining > 0:
+        item = pos.right
+        if not item.deleted and isinstance(
+            item.content, (ContentString, ContentEmbed, ContentType)
+        ):
+            if remaining < item.len:
+                store.blocks.split_at(item, remaining)
+                remaining = 0
+            else:
+                remaining -= item.len
+            txn.delete(item)
+        pos.forward()
+    if remaining > 0:
+        raise IndexError(f"delta delete past end of text ({remaining} left)")
+    _clean_format_gap(txn, start, pos.right, start_attrs, dict(pos.current_attrs or {}))
+
+
+def _clean_format_gap(txn: Transaction, start, end, start_attrs, end_attrs) -> None:
+    """Drop format marks in a deleted gap that restate the surrounding
+    formatting (parity: types/text.rs:1058 clean_format_gap)."""
+    while end is not None:
+        content = end.content
+        if isinstance(content, (ContentString, ContentEmbed)):
+            break
+        if not end.deleted and isinstance(content, ContentFormat):
+            if content.value is None:
+                end_attrs.pop(content.key, None)
+            else:
+                end_attrs[content.key] = content.value
+        end = end.right
+    while start is not None and start is not end:
+        right = start.right
+        if not start.deleted and isinstance(start.content, ContentFormat):
+            key, value = start.content.key, start.content.value
+            if end_attrs.get(key) != value or start_attrs.get(key) == value:
+                txn.delete(start)
+        start = right
+
+
+def _is_valid_format_target(item: Item) -> bool:
+    return item.deleted or isinstance(item.content, ContentFormat)
+
+
+def _delta_retain(branch, txn: Transaction, pos: ItemPosition, length: int, attrs) -> None:
+    """insert_format parity (types/text.rs:875): walk `length` units applying
+    `attrs`, deleting overridden marks inside the range, closing with the
+    negated values after it. With empty attrs this is a plain cursor skip."""
+    _minimize_attr_changes(pos, attrs)
+    negated = _insert_attributes(branch, txn, pos, dict(attrs))
+    remaining = length
+    store = txn.store
+    while pos.right is not None and (
+        remaining > 0 or (negated and _is_valid_format_target(pos.right))
+    ):
+        item = pos.right
+        if not item.deleted:
+            content = item.content
+            if isinstance(content, ContentFormat):
+                if content.key in attrs:
+                    if attrs[content.key] == content.value:
+                        negated.pop(content.key, None)
+                    else:
+                        negated[content.key] = content.value
+                    txn.delete(item)
+            elif item.countable:
+                if remaining < item.len:
+                    store.blocks.split_at(item, remaining)
+                    remaining = 0
+                    pos.forward()
+                    break
+                remaining -= item.len
+        if not pos.forward():
+            break
+    _insert_negated_attributes(branch, txn, pos, negated)

@@ -176,6 +176,9 @@ class UndoManager(Generic[M]):
         """Force the next change into a fresh stack item."""
         self.last_change = 0
 
+    # ywasm name (undo.rs:99 stop_capturing → UndoManager::reset)
+    stop_capturing = reset
+
     def clear(self) -> None:
         with self.doc.transact(self) as txn:
             for item in self.undo_stack + self.redo_stack:
