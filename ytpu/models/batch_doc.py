@@ -1008,6 +1008,7 @@ def finish_encode_diff(
     deleted: np.ndarray,
     enc: "BatchEncoder",
     payloads=None,
+    root_name: Optional[str] = None,
 ) -> bytes:
     """Host finisher: selected device rows -> a v1 update payload.
 
@@ -1038,7 +1039,9 @@ def finish_encode_diff(
         out.write_var(int(bl.clock[slots[0]]) + first_off)
         for pos, r in enumerate(slots):
             off = first_off if pos == 0 else 0
-            _encode_device_row(out, bl, r, off, real_client, enc, payloads)
+            _encode_device_row(
+                out, bl, r, off, real_client, enc, payloads, root_name
+            )
     ds = DeleteSet()
     for r in np.nonzero(deleted[doc])[0]:
         real_client = enc.interner.from_idx[int(bl.client[r])]
@@ -1048,7 +1051,8 @@ def finish_encode_diff(
 
 
 def _encode_device_row(
-    out, bl, r, off, real_client, enc: "BatchEncoder", payloads=None
+    out, bl, r, off, real_client, enc: "BatchEncoder", payloads=None,
+    root_name: Optional[str] = None,
 ) -> None:
     if payloads is None:
         payloads = enc.payloads
@@ -1090,7 +1094,8 @@ def _encode_device_row(
             )
         else:
             out.write_parent_info(True)
-            out.write_string(enc.root_name)
+            # per-tenant root name (serving) falls back to the batch root
+            out.write_string(root_name if root_name is not None else enc.root_name)
         if has_sub:
             out.write_string(enc.keys.names[key])
     ref = int(bl.content_ref[r])
@@ -1247,12 +1252,15 @@ def finish_encode_diff_batch(
     deleted: np.ndarray,
     enc: "BatchEncoder",
     payloads=None,
+    root_name: Optional[str] = None,
 ) -> List[bytes]:
     """Batched native finisher: selected device rows -> v1 payloads for
     many docs in one C++ call (VERDICT r2 #6; reference equivalent:
     store.rs:204-248 compiled). Byte-identical to `finish_encode_diff`;
     docs holding a row outside the native scope (wire-ref Format/Embed,
     unknown kinds) fall back to the Python finisher individually.
+    `root_name` overrides the batch root branch name on the wire for this
+    call (per-tenant serving; all selected docs share it).
     """
     import ctypes
 
@@ -1265,7 +1273,9 @@ def finish_encode_diff_batch(
     lib = _native.load()
     if lib is None or not getattr(lib, "finisher_ok", False):
         return [
-            finish_encode_diff(state, d, ship, offsets, deleted, enc, payloads)
+            finish_encode_diff(
+                state, d, ship, offsets, deleted, enc, payloads, root_name
+            )
             for d in docs
         ]
 
@@ -1341,7 +1351,12 @@ def finish_encode_diff_batch(
     from_idx = tables["from_idx"]
     key_blob = tables["key_blob"]
     key_off = tables["key_off"]
-    root = tables["root"]
+    if root_name is not None:
+        root_bytes = root_name.encode("utf-8")
+        root = np.frombuffer(root_bytes or b"\0", dtype=np.uint8)
+    else:
+        root_bytes = enc.root_name.encode("utf-8")
+        root = tables["root"]
 
     nparr = ar["np"]
     text_arena = nparr["text"]
@@ -1393,7 +1408,7 @@ def finish_encode_diff_batch(
         key_off=p_i64(key_off),
         n_keys=n_keys,
         root_name=p_u8(root),
-        root_name_len=len(enc.root_name.encode("utf-8")),
+        root_name_len=len(root_bytes),
         text_arena=p_u8(text_arena),
         text_arena_len=len(ar["text"]),
         item_text_off=p_i64(item_text_off),
@@ -1428,7 +1443,7 @@ def finish_encode_diff_batch(
             else:
                 out.append(
                     finish_encode_diff(
-                        state, d, ship, offsets, deleted, enc, payloads
+                        state, d, ship, offsets, deleted, enc, payloads, root_name
                     )
                 )
         return out

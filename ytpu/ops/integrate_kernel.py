@@ -206,13 +206,31 @@ def pack_stream(stream: UpdateBatch) -> Tuple[jax.Array, jax.Array]:
     return rows, dels
 
 
-def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref):
+def _kernel(
+    rows_ref,
+    dels_ref,
+    rank_ref,
+    _cols_in,
+    _meta_in,
+    cols_ref,
+    meta_ref,
+    *,
+    phases: int = 3,
+    row_phase: int = 4,
+):
     """One doc tile: integrate the whole stream in VMEM.
 
     cols_ref: [NC, DB, C] out-ref aliased to the input (holds the state),
-    meta_ref: [DB, 8] aliased; rows_ref: [S, U, 11], dels_ref: [S, R, 4],
+    meta_ref: [DB, 8] aliased; rows_ref: [S, U, 22], dels_ref: [S, R, 4],
     rank_ref: [1, K]. The plain in-refs are shadows of the aliased buffers
     and are unused.
+
+    `phases` / `row_phase` are HARDWARE-BISECT hooks (trace-time static,
+    threaded from `apply_update_stream_fused`): they truncate the kernel
+    after the row loop / delete loop (phases) or mid-`integrate_row`
+    (row_phase) so a Mosaic miscompile or device fault can be localized.
+    Production callers leave the defaults (full kernel); partial values
+    corrupt state by design and must never ship.
     """
     S, U, _ = rows_ref.shape
     R = dels_ref.shape[1]
@@ -223,6 +241,12 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
     def col(i):
         return cols_ref[i]
 
+    def mrow(mask):
+        """(DB,) bool -> (DB, 1) bool. Mosaic cannot insert a minor dim on
+        an i1 vector ("only supported for 32-bit types"), so widen to i32,
+        insert, and compare back down."""
+        return mask.astype(I32)[:, None] > 0
+
     def gather(i, idx, fill):
         """Per-doc element col(i)[d, idx[d]] with idx < 0 -> fill."""
         onehot = iota_c == idx[:, None]
@@ -231,14 +255,14 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
 
     def put(i, idx, val, active):
         """col(i)[d, idx[d]] = val[d] where active[d] & idx valid."""
-        mask = (iota_c == idx[:, None]) & active[:, None] & (idx >= 0)[:, None]
+        mask = (iota_c == idx[:, None]) & mrow(active) & (idx[:, None] >= 0)
         cols_ref[i] = jnp.where(mask, val[:, None], col(i))
 
     def put_many(idx, active, writes):
         """Write several columns at one slot, computing the mask once.
 
         `writes` is [(col_idx, val_vector), ...]; same semantics as `put`."""
-        mask = (iota_c == idx[:, None]) & active[:, None] & (idx >= 0)[:, None]
+        mask = (iota_c == idx[:, None]) & mrow(active) & (idx[:, None] >= 0)
         for i, val in writes:
             cols_ref[i] = jnp.where(mask, val[:, None], col(i))
 
@@ -262,7 +286,7 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
             & (col(CL) == client_v[:, None])
             & (col(CK) <= clock_v[:, None])
             & (clock_v[:, None] < col(CK) + col(LN))
-            & enable[:, None]
+            & mrow(enable)
         )
         # integer argmax is unsupported in Mosaic: min-reduce the indices
         idx = jnp.min(jnp.where(m, iota_c, C), axis=1).astype(I32)
@@ -388,6 +412,12 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         is_gc = r_kind == BLOCK_GC
         linkable = do & ~is_gc
 
+        if row_phase < 2:
+            meta_ref[:, M_ERROR] = meta_ref[:, M_ERROR] | jnp.where(
+                missing, ERR_MISSING_DEP, 0
+            )
+            return
+
         left_idx, lfound = clean_end(
             origin_client, origin_clock, linkable & has_origin
         )
@@ -423,6 +453,8 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         parent_missing = linkable & (r_ptag == 2) & (parent_slot < 0)
         missing = missing | parent_missing
         linkable = linkable & ~parent_missing
+        if row_phase < 3:
+            return
 
         # parent_sub: inherited from the anchors when omitted on the wire
         # (parity: block.rs:604-612)
@@ -443,7 +475,7 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
             & (col(KEY) == key_v[:, None])
             & (col(PA) == parent_row[:, None])
             & (col(LT) == -1)
-            & is_map[:, None]
+            & mrow(is_map)
         )
         chain_idx = jnp.min(jnp.where(chain_mask, iota_c, C), axis=1).astype(I32)
         chain_head = jnp.where(chain_idx < C, chain_idx, -1)
@@ -471,7 +503,7 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         def scan_body(carry):
             o, left, conflicting, before, brk = carry
             active = (o >= 0) & (o != right_idx) & (brk == 0)
-            onehot_o = ((iota_c == o[:, None]) & active[:, None]).astype(I32)
+            onehot_o = ((iota_c == o[:, None]) & mrow(active)).astype(I32)
             before = before | onehot_o
             conflicting = conflicting | onehot_o
             o_oc = gather(OC, o, -1)
@@ -502,7 +534,7 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
 
             take = (case1_take | case2_take) & active
             left = jnp.where(take, o, left)
-            conflicting = jnp.where(take[:, None], 0, conflicting)
+            conflicting = jnp.where(mrow(take), 0, conflicting)
             brk = brk | ((case1_break | case2_break) & active).astype(I32)
             o_next = gather(RT, o, -1)
             o = jnp.where(active & (brk == 0), o_next, o)
@@ -515,6 +547,8 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
             (o0, left_idx, zeros, zeros, jnp.zeros((DB,), I32)),
         )
         left_idx = jnp.where(need_scan, left_scanned, left_idx)
+        if row_phase < 4:
+            return
 
         j = n_blocks()
         overflow = do & (j >= C)
@@ -644,7 +678,9 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         i_b, found_b = clean_end(c_v, k_v, enable & ~after & (c_v >= 0))
         right_b = gather(RT, i_b, -1)
         ptr = jnp.where(after, i_a, right_b)
-        found = jnp.where(after, found_a, found_b)
+        # logical blend, not jnp.where: Mosaic cannot lower an i1-vector
+        # select (trunci i8->i1) on real TPU
+        found = (after & found_a) | (~after & found_b)
         return ptr, found
 
     def claim_move(s_v, enable):
@@ -723,15 +759,17 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
                 gather(DL, idx, 1) == 0
             )
 
+        # `hit` rides the carry as i32 0/1: an i1-vector loop carry fails
+        # Mosaic legalization (scf.yield) on real TPU
         def ccond(carry):
             cur, n, hit = carry
-            return jnp.any(enable & (cur >= 0) & ~hit & (n <= C))
+            return jnp.any(enable & (cur >= 0) & (hit == 0) & (n <= C))
 
         def cbody(carry):
             cur, n, hit = carry
-            active = enable & (cur >= 0) & ~hit & (n <= C)
+            active = enable & (cur >= 0) & (hit == 0) & (n <= C)
             nxt = gather(MV, cur, -1)
-            hit = hit | (active & (nxt == s_v) & (s_v >= 0))
+            hit = hit | (active & (nxt == s_v) & (s_v >= 0)).astype(I32)
             # a dead or non-move node breaks the live ownership chain
             nxt = jnp.where(live_move(nxt), nxt, -1)
             cur = jnp.where(active, nxt, cur)
@@ -742,9 +780,9 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         _, _, hit = jax.lax.while_loop(
             ccond,
             cbody,
-            (first, jnp.zeros((DB,), I32), jnp.zeros((DB,), bool)),
+            (first, jnp.zeros((DB,), I32), jnp.zeros((DB,), I32)),
         )
-        return hit
+        return hit > 0
 
     def recompute_moves():
         """Per-doc from-scratch ownership recompute for dirty docs (the
@@ -753,7 +791,7 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
 
         @pl.when(jnp.any(dirty))
         def _():
-            cols_ref[MV] = jnp.where(dirty[:, None], -1, col(MV))
+            cols_ref[MV] = jnp.where(mrow(dirty), -1, col(MV))
             done0 = jnp.zeros((DB, C), I32)
 
             def active_moves(done):
@@ -762,7 +800,7 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
                     & (col(KD) == CONTENT_MOVE)
                     & (col(DL) == 0)
                     & (done == 0)
-                    & dirty[:, None]
+                    & mrow(dirty)
                 )
 
             def rcond(done):
@@ -777,10 +815,10 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
                 cyc = move_cycle(s_v, enable) & exists
                 put(DL, s_v, jnp.ones((DB,), I32), cyc)
                 # cycle: release every claim and replay without s
-                cols_ref[MV] = jnp.where(cyc[:, None], -1, col(MV))
-                onehot_s = (iota_c == s_v[:, None]) & exists[:, None]
+                cols_ref[MV] = jnp.where(mrow(cyc), -1, col(MV))
+                onehot_s = (iota_c == s_v[:, None]) & mrow(exists)
                 done = jnp.where(
-                    cyc[:, None], 0, done | onehot_s.astype(I32)
+                    mrow(cyc), 0, done | onehot_s.astype(I32)
                 )
                 return done
 
@@ -789,37 +827,43 @@ def _kernel(rows_ref, dels_ref, rank_ref, _cols_in, _meta_in, cols_ref, meta_ref
         meta_ref[:, M_MDIRTY] = jnp.zeros((DB,), I32)
 
     def step(s, _):
-        def row_body(u, __):
-            @pl.when(rows_ref[s, u, 14] == 1)
-            def _():
-                integrate_row(s, u)
+        if phases >= 1:
+            def row_body(u, __):
+                @pl.when(rows_ref[s, u, 14] == 1)
+                def _():
+                    integrate_row(s, u)
 
-            return 0
+                return 0
 
-        jax.lax.fori_loop(0, U, row_body, 0)
+            jax.lax.fori_loop(0, U, row_body, 0)
 
-        def del_body(r, __):
-            @pl.when(dels_ref[s, r, 3] == 1)
-            def _():
-                delete_range(s, r)
+        if phases >= 2:
+            def del_body(r, __):
+                @pl.when(dels_ref[s, r, 3] == 1)
+                def _():
+                    delete_range(s, r)
 
-            return 0
+                return 0
 
-        jax.lax.fori_loop(0, R, del_body, 0)
-        recompute_moves()
+            jax.lax.fori_loop(0, R, del_body, 0)
+        if phases >= 3:
+            recompute_moves()
         return 0
 
     jax.lax.fori_loop(0, S, step, 0)
 
 
-@partial(jax.jit, static_argnums=(3, 4), donate_argnums=(0, 1))
-def _run(cols, meta, packed, d_block: int, interpret: bool):
+@partial(jax.jit, static_argnums=(3, 4, 5, 6), donate_argnums=(0, 1))
+def _run(
+    cols, meta, packed, d_block: int, interpret: bool,
+    phases: int = 3, row_phase: int = 4,
+):
     rows, dels, rank = packed
     NC_, D, C = cols.shape
     grid = (D // d_block,)
     rank = rank.reshape(1, -1)
     out = pl.pallas_call(
-        _kernel,
+        partial(_kernel, phases=phases, row_phase=row_phase),
         grid=grid,
         in_specs=[
             pl.BlockSpec(rows.shape, lambda d: (0, 0, 0)),
@@ -859,6 +903,8 @@ def apply_update_stream_fused(
     d_block: int = 32,
     interpret: bool = False,
     guard: bool = True,
+    _debug_phases: int = 3,
+    _debug_row_phase: int = 4,
 ) -> DocStateBatch:
     """Fused-replay drop-in for `apply_update_stream`: sequence rows, map
     rows (per-key LWW chains), nested-branch parents AND move ranges all
@@ -867,12 +913,17 @@ def apply_update_stream_fused(
     `batch_doc._recompute_moves`, parity: moving.rs:149-227).
 
     `guard` is kept for call-site compatibility; it no longer excludes
-    anything."""
+    anything. `_debug_phases` / `_debug_row_phase` truncate the kernel for
+    hardware bisection only (see `_kernel`); never pass them in production
+    — partial kernels corrupt state by design."""
     del guard
     cols, meta = pack_state(state)
     D = cols.shape[1]
     if D % d_block != 0:
         raise ValueError(f"n_docs {D} must be a multiple of d_block {d_block}")
     rows, dels = pack_stream(stream)
-    cols, meta = _run(cols, meta, (rows, dels, client_rank), d_block, interpret)
+    cols, meta = _run(
+        cols, meta, (rows, dels, client_rank), d_block, interpret,
+        _debug_phases, _debug_row_phase,
+    )
     return unpack_state(cols, meta, state)

@@ -70,6 +70,11 @@ class DeviceSyncServer(SyncServer):
         self.ingestor = ingestor
         self.device_authoritative = device_authoritative
         self._slot_of: Dict[str, int] = {}
+        # per-tenant wire root name (the batch engine maps any single-root
+        # tenant onto one device branch; the name must round-trip on the
+        # wire — doc.rs root branches are keyed by name). Learned by a
+        # one-time host peek at the first content-bearing update.
+        self._root_names: Dict[str, str] = {}
         self._queues: List[List[bytes]] = [
             [] for _ in range(ingestor.n_docs)
         ]
@@ -144,6 +149,10 @@ class DeviceSyncServer(SyncServer):
                         Message.sync(SyncMessage.step2(diff)).encode_v1()
                     )
                 else:  # SyncStep2 / Update: straight to the device slot
+                    if session.tenant not in self._root_names:
+                        name = self._peek_root_name(sub.payload)
+                        if name is not None:
+                            self._root_names[session.tenant] = name
                     self._queues[slot].append(sub.payload)
                     self._applied.inc()
                     # broadcast at-least-once (idempotent CRDT updates;
@@ -154,12 +163,47 @@ class DeviceSyncServer(SyncServer):
                     ).encode_v1()
                     for other in t.sessions:
                         if other is not session:
-                            other.outbox.append(frame)
+                            other.push(frame)
                 continue
             reply = self.protocol.handle_message(t.awareness, msg)
             if reply is not None:
                 replies.append(reply.encode_v1())
         return replies
+
+    def _peek_root_name(self, payload: bytes) -> Optional[str]:
+        """The first root-parent name in a wire update (None when every
+        block is nested/GC — retry on the next update). Scans all blocks
+        of the updates it inspects and flags a tenant that carries more
+        than one distinct root name (single-root device scope; aliasing
+        roots would corrupt fresh replicas). Coverage caveat: peeking
+        stops once a name is learned — a second root introduced in a
+        LATER update is not detected until multi-root serving lands
+        (it requires decoding every queued update, the cost the
+        device-authoritative lane exists to avoid)."""
+        from ytpu.core.update import Update
+        from ytpu.utils import metrics
+
+        try:
+            up = Update.decode_v1(payload)
+        except Exception:
+            return None
+        names = []
+        for blocks in up.blocks.values():
+            for b in blocks:
+                p = getattr(b, "parent", None)
+                if isinstance(p, str) and p not in names:
+                    names.append(p)
+        if len(names) > 1:
+            metrics.counter("sync.multi_root_tenant_updates").inc()
+            import warnings
+
+            warnings.warn(
+                "device-authoritative tenant uses multiple roots "
+                f"{names!r}; single-root scope would alias them — "
+                "serve this tenant from a host doc (device_authoritative"
+                "=False) until multi-root serving lands"
+            )
+        return names[0] if names else None
 
     def device_state_vector(self, tenant_name: str) -> StateVector:
         """The device mirror's state vector for one tenant (real ids)."""
@@ -204,6 +248,7 @@ class DeviceSyncServer(SyncServer):
             np.asarray(deleted),
             ing.enc,
             payloads=ing.payloads,
+            root_name=self._root_names.get(tenant_name),
         )[0]
         pending = ing.pending_update(slot)
         pending_ds = ing.pending_ds(slot)

@@ -15,15 +15,17 @@ Wire format per connection:
 
 One `SyncServer` (or `DeviceSyncServer`) instance serves all connections;
 each connection becomes a `Session`. Replies go straight back; broadcasts
-land in the other sessions' outboxes and are flushed to their sockets
-after every processed frame. With a `DeviceSyncServer`, `flush_every`
-controls how often queued updates ship to the device batch.
+land in the other sessions' outboxes, and every connection handler pushes
+its OWN outbox to its socket after each processed frame or `idle_flush`
+wakeup (one writer per task — no cross-coroutine drain races). With a
+`DeviceSyncServer`, `flush_every` controls how often queued updates ship
+to the device batch.
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 from ytpu.encoding.lib0 import EncodingError, Writer
 from ytpu.sync.protocol import (
@@ -99,9 +101,16 @@ async def serve(
     host: str = "127.0.0.1",
     port: int = 0,
     flush_every: int = 1,
+    idle_flush: float = 0.2,
 ) -> Tuple[asyncio.AbstractServer, int]:
-    """Start serving; returns (asyncio server, bound port)."""
-    writers: Dict[int, asyncio.StreamWriter] = {}
+    """Start serving; returns (asyncio server, bound port).
+
+    `idle_flush`: how long a connection may sit idle before its own queued
+    broadcasts are pushed out anyway. Each handler writes ONLY its own
+    socket — a broadcast enqueued by another connection's frame (or by an
+    in-process write: server-side transaction, replica link) ships on this
+    connection's next frame or idle wakeup. One writer per task means no
+    two coroutines ever await drain() on the same transport."""
 
     async def handle(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         session = None
@@ -115,44 +124,32 @@ async def serve(
                 session, greeting = server.connect_frames(tenant)
             except DeviceBatchFull:
                 return  # capacity: reject quietly
-            writers[session.id] = writer
             for frame in greeting:
                 write_frame(writer, frame)
             await writer.drain()
             while True:
-                frame = await read_frame(reader)
+                frame = await read_frame(reader, first_byte_timeout=idle_flush)
                 if frame is None:
-                    break
-                for f in server.receive_frames(session, frame):
-                    write_frame(writer, f)
-                frames_seen += 1
-                if flush_every and frames_seen % flush_every == 0:
-                    flush = getattr(server, "flush_device", None)
-                    if flush is not None:
-                        flush()
-                # fan broadcasts out to every session of this tenant
-                # (snapshot the list: a concurrent disconnect mutates it)
-                stale = []
-                for other in list(server.tenant(tenant).sessions):
-                    w = writer if other is session else writers.get(other.id)
-                    if w is None:
-                        continue  # in-process session: keep its outbox
-                    try:
-                        for payload in server.drain(other):
-                            write_frame(w, payload)
-                        if w is not writer:
-                            await w.drain()
-                    except (ConnectionError, RuntimeError):
-                        stale.append(other)
-                for other in stale:
-                    writers.pop(other.id, None)
-                    server.disconnect(other)
+                    if reader.at_eof():
+                        break
+                else:
+                    for f in server.receive_frames(session, frame):
+                        write_frame(writer, f)
+                    frames_seen += 1
+                    if flush_every and frames_seen % flush_every == 0:
+                        flush = getattr(server, "flush_device", None)
+                        if flush is not None:
+                            flush()
+                # own outbox only (frame processed or idle wakeup)
+                for payload in server.drain(session):
+                    write_frame(writer, payload)
                 await writer.drain()
+                if session.dead:
+                    break  # slow consumer: evicted by Session.push
         except _PEER_ERRORS:
             pass
         finally:
             if session is not None:
-                writers.pop(session.id, None)
                 server.disconnect(session)
             writer.close()
 

@@ -1,0 +1,161 @@
+"""Cross-server (pod-to-pod) replication over the y-sync protocol.
+
+Behavioral parity target: /root/reference/yrs/src/sync/protocol.rs — the
+handshake contract (:8-31) and default handlers (:42-135) are symmetric
+peer-to-peer; a "server" is just a peer that happens to fan updates out to
+its own sessions. This module applies that symmetry *between two server
+processes*: each pod holds authoritative tenant state (host docs or device
+batch slots) and a `ReplicaLink` makes one pod a session of the other.
+
+Design: the link bridges a local in-process `Session` (obtained from
+`SyncServer.connect_frames`, so the local server speaks its own greeting —
+SyncStep1(sv) + awareness snapshot) to the remote pod's TCP endpoint
+(`ytpu.sync.net.serve`). Frames flow both ways untouched:
+
+- local greeting / replies / outbox broadcasts  → written to the socket;
+- remote frames → `server.receive_frames(session, frame)`; the local
+  server applies them with the link's session as origin, so its own
+  broadcast fan-out delivers to every *other* local session but never
+  echoes back over the link.
+
+Because only `connect_frames` / `receive_frames` / `drain` are used, the
+same link replicates a plain host `SyncServer` and a device-authoritative
+`DeviceSyncServer` (whose overrides answer SyncStep1 from device state and
+queue inbound updates straight to batch slots) without special cases.
+
+One link per tenant per peer pair is fully bidirectional; duplicate
+delivery through redundant links is harmless (CRDT updates are idempotent,
+exactly the reference's at-least-once stance). Anti-entropy: `gossip()`
+re-sends SyncStep1 with the current local state vector so a peer that
+missed live updates (e.g. reconnect) ships the SV-diff — the
+reference's read-your-state handshake used as a repair round.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional
+
+from ytpu.sync.net import read_frame, write_frame
+from ytpu.sync.protocol import Message, SyncMessage
+from ytpu.sync.server import Session, SyncServer
+
+__all__ = ["ReplicaLink", "Replicator"]
+
+
+def _step1_frame(server: SyncServer, tenant: str) -> bytes:
+    """A SyncStep1 frame carrying the server's CURRENT state vector for
+    `tenant` — device state when the server is device-authoritative."""
+    if getattr(server, "device_authoritative", False):
+        server.flush_device()
+        sv = server.device_state_vector(tenant)
+    else:
+        sv = server.doc(tenant).state_vector()
+    return Message.sync(SyncMessage.step1(sv)).encode_v1()
+
+
+class ReplicaLink:
+    """Replicate one tenant between a local server and a remote pod."""
+
+    def __init__(self, server: SyncServer, tenant: str):
+        self.server = server
+        self.tenant = tenant
+        self.session: Optional[Session] = None
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+
+    async def connect(self, host: str, port: int) -> None:
+        """Dial the peer pod and run the symmetric greeting."""
+        self.reader, self.writer = await asyncio.open_connection(host, port)
+        write_frame(self.writer, self.tenant.encode("utf-8"))
+        # local server's own greeting (SyncStep1 + awareness) goes first —
+        # both sides open with step1, per the protocol.rs header contract
+        self.session, greeting = self.server.connect_frames(self.tenant)
+        for frame in greeting:
+            write_frame(self.writer, frame)
+        await self.writer.drain()
+
+    async def pump(self, max_frames: int = 64, timeout: float = 0.2) -> int:
+        """Process up to `max_frames` inbound frames, then flush outbox.
+
+        Returns the number of frames read. A `timeout` bounds the wait for
+        each frame's first byte, so a quiet peer never blocks the loop."""
+        n = 0
+        while n < max_frames:
+            frame = await read_frame(self.reader, first_byte_timeout=timeout)
+            if frame is None:
+                break
+            for reply in self.server.receive_frames(self.session, frame):
+                write_frame(self.writer, reply)
+            n += 1
+        await self.flush()
+        return n
+
+    async def flush(self) -> None:
+        """Ship local broadcasts (other sessions' applies) to the peer."""
+        if self.writer is None:
+            return
+        for payload in self.server.drain(self.session):
+            write_frame(self.writer, payload)
+        await self.writer.drain()
+
+    async def gossip(self) -> None:
+        """Anti-entropy round: advertise the current local SV; the peer
+        answers with the SV-diff update (protocol.rs:60-68 semantics)."""
+        if self.writer is None:
+            return
+        write_frame(self.writer, _step1_frame(self.server, self.tenant))
+        await self.writer.drain()
+
+    async def run(self, interval: float = 0.05, gossip_every: int = 0) -> None:
+        """Continuous replication loop (cancel the task to stop)."""
+        rounds = 0
+        while True:
+            await self.pump(timeout=interval)
+            rounds += 1
+            if gossip_every and rounds % gossip_every == 0:
+                await self.gossip()
+
+    async def close(self) -> None:
+        if self.session is not None:
+            self.server.disconnect(self.session)
+            self.session = None
+        if self.writer is not None:
+            self.writer.close()
+            try:
+                await self.writer.wait_closed()
+            except Exception:
+                pass
+            self.writer = None
+
+
+class Replicator:
+    """All of one pod's links to one peer pod (one link per tenant)."""
+
+    def __init__(self, server: SyncServer, host: str, port: int):
+        self.server = server
+        self.host = host
+        self.port = port
+        self.links: List[ReplicaLink] = []
+
+    async def add_tenant(self, tenant: str) -> ReplicaLink:
+        link = ReplicaLink(self.server, tenant)
+        await link.connect(self.host, self.port)
+        self.links.append(link)
+        return link
+
+    async def pump(self, rounds: int = 1, timeout: float = 0.2) -> int:
+        total = 0
+        for _ in range(rounds):
+            for link in self.links:
+                total += await link.pump(timeout=timeout)
+        return total
+
+    async def gossip(self) -> None:
+        for link in self.links:
+            await link.gossip()
+
+    async def close(self) -> None:
+        for link in self.links:
+            await link.close()
+        self.links = []

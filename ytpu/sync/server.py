@@ -31,13 +31,31 @@ class DeviceBatchFull(RuntimeError):
 
 
 class Session:
-    __slots__ = ("id", "tenant", "server", "outbox")
+    __slots__ = ("id", "tenant", "server", "outbox", "dead")
+
+    #: broadcast frames a session may hold undelivered before it is
+    #: declared a slow consumer and evicted (its transport handler sees
+    #: `dead` and closes). Unbounded outboxes let one stalled TCP peer
+    #: grow server memory without limit while its tenant stays busy.
+    OUTBOX_CAP = 4096
 
     def __init__(self, id_: int, tenant: str, server: "SyncServer"):
         self.id = id_
         self.tenant = tenant
         self.server = server
         self.outbox: List[bytes] = []
+        self.dead = False
+
+    def push(self, frame: bytes) -> None:
+        """Queue a broadcast frame, evicting the session when it is too
+        far behind. Dead sessions drop frames (their connection is about
+        to close; a reconnect resyncs via SyncStep1)."""
+        if self.dead:
+            return
+        self.outbox.append(frame)
+        if len(self.outbox) > self.OUTBOX_CAP:
+            self.dead = True
+            self.outbox = []
 
 
 class _Tenant:
@@ -72,7 +90,7 @@ class SyncServer:
                 frame = Message.sync(SyncMessage.update(payload)).encode_v1()
                 for session in self.tenants[_name].sessions:
                     if origin is not session:
-                        session.outbox.append(frame)
+                        session.push(frame)
 
             doc.observe_update_v1(broadcast)
         return t
@@ -135,7 +153,7 @@ class SyncServer:
                 frame = Message.awareness(msg.body).encode_v1()
                 for other in t.sessions:
                     if other is not session:
-                        other.outbox.append(frame)
+                        other.push(frame)
                 continue
             reply = self.protocol.handle_message(t.awareness, msg)
             if reply is not None:
