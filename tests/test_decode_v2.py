@@ -1,0 +1,351 @@
+"""Device-side V2 update decoding (ytpu/ops/decode_v2.py).
+
+Parity oracle: host `Update.decode_v2` on the same bytes. The device lane
+must emit identical block rows / delete ranges for the supported set
+(GC / Skip / Deleted / String, root + nested parents, parent_sub keys,
+multi-section, delete sets) and flag everything else to the host lane —
+VERDICT r2 #5: a V2-encoded B4 stream rides the raw-bytes lane with zero
+host fallbacks.
+"""
+
+import random
+import string as _string
+
+import numpy as np
+import pytest
+
+from ytpu.core import Doc, Update
+from ytpu.core.state_vector import StateVector
+from ytpu.ops.decode_kernel import FLAG_ERRORS, FLAG_UNSUPPORTED, utf8_slice_u16
+from ytpu.ops.decode_v2 import decode_updates_v2, pack_updates_v2
+
+
+def v1_to_v2(payload: bytes) -> bytes:
+    return Update.decode_v1(payload).encode_v2()
+
+
+def capture_v1(ops_fn, client_id=1):
+    doc = Doc(client_id=client_id)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    ops_fn(doc)
+    return doc, log
+
+
+def decode(payloads_v2, max_rows=8, max_dels=8, **kw):
+    buf, lens, spans = pack_updates_v2(payloads_v2)
+    stream, flags = decode_updates_v2(
+        buf, lens, spans, max_rows, max_dels, **kw
+    )
+    return buf, stream, np.asarray(flags)
+
+
+def oracle_rows(payload_v2):
+    """(client, clock, length, kind-ish) rows from the host decoder."""
+    up = Update.decode_v2(payload_v2)
+    rows = []
+    for client, blocks in sorted(up.blocks.items()):
+        for b in blocks:
+            rows.append((client, b.id.clock, b.len))
+    return rows
+
+
+def test_plain_text_inserts_roundtrip():
+    def ops(doc):
+        t = doc.get_text("text")
+        for chunk in ["hello ", "world", "!"]:
+            with doc.transact() as txn:
+                t.insert(txn, len(t), chunk)
+
+    doc, log = capture_v1(ops)
+    v2 = [v1_to_v2(p) for p in log]
+    buf, stream, flags = decode(v2)
+    assert (flags & FLAG_ERRORS == 0).all(), flags
+    valid = np.asarray(stream.valid)
+    for s, payload in enumerate(v2):
+        got = [
+            (
+                int(np.asarray(stream.client)[s, u]),
+                int(np.asarray(stream.clock)[s, u]),
+                int(np.asarray(stream.length)[s, u]),
+            )
+            for u in range(valid.shape[1])
+            if valid[s, u]
+        ]
+        assert got == oracle_rows(payload), (s, got)
+    # string contents slice straight out of the packed buffer
+    flat = np.asarray(buf).reshape(-1)
+    refs = np.asarray(stream.content_ref)
+    texts = []
+    for s in range(len(v2)):
+        for u in range(valid.shape[1]):
+            if valid[s, u] and refs[s, u] >= 0:
+                texts.append(
+                    utf8_slice_u16(
+                        flat,
+                        refs[s, u],
+                        0,
+                        int(np.asarray(stream.length)[s, u]),
+                    )
+                )
+    assert texts == ["hello ", "world", "!"]
+
+
+def test_deletes_and_delete_set():
+    def ops(doc):
+        t = doc.get_text("text")
+        with doc.transact() as txn:
+            t.insert(txn, 0, "abcdefgh")
+        with doc.transact() as txn:
+            t.remove_range(txn, 2, 3)
+        with doc.transact() as txn:
+            t.remove_range(txn, 0, 1)
+
+    doc, log = capture_v1(ops)
+    v2 = [v1_to_v2(p) for p in log]
+    _, stream, flags = decode(v2)
+    assert (flags & FLAG_ERRORS == 0).all(), flags
+    dvalid = np.asarray(stream.del_valid)
+    for s, payload in enumerate(v2):
+        up = Update.decode_v2(payload)
+        want = []
+        for client, ranges in sorted(up.delete_set.clients.items()):
+            for a, bnd in ranges:
+                want.append((client, a, bnd))
+        got = sorted(
+            (
+                int(np.asarray(stream.del_client)[s, r]),
+                int(np.asarray(stream.del_start)[s, r]),
+                int(np.asarray(stream.del_end)[s, r]),
+            )
+            for r in range(dvalid.shape[1])
+            if dvalid[s, r]
+        )
+        assert got == sorted(want), (s, got, want)
+
+
+def test_merged_multi_client_update_with_skips():
+    """Merged updates exercise multi-section wire + Skip runs."""
+    from ytpu.compat import merge_updates
+
+    # build two docs whose merged update has 2 client sections + a skip
+    d1 = Doc(client_id=1)
+    with d1.transact() as txn:
+        d1.get_text("text").insert(txn, 0, "aaaa")
+    d2 = Doc(client_id=2)
+    d2.apply_update_v1(d1.encode_state_as_update_v1(StateVector({})))
+    with d2.transact() as txn:
+        d2.get_text("text").insert(txn, 2, "bb")
+    u_all = d2.encode_state_as_update_v1(StateVector({}))
+    # a gapped second update from client 1 (skip synthesized on merge)
+    with d1.transact() as txn:
+        d1.get_text("text").insert(txn, 0, "x")
+    with d1.transact() as txn:
+        d1.get_text("text").insert(txn, 0, "y")
+    full = d1.encode_state_as_update_v1(StateVector({}))
+    merged = merge_updates(u_all, full)
+    v2 = [v1_to_v2(merged)]
+    _, stream, flags = decode(v2, max_rows=12)
+    assert (flags & FLAG_ERRORS == 0).all(), flags
+    valid = np.asarray(stream.valid)
+    got = [
+        (
+            int(np.asarray(stream.client)[0, u]),
+            int(np.asarray(stream.clock)[0, u]),
+            int(np.asarray(stream.length)[0, u]),
+        )
+        for u in range(valid.shape[1])
+        if valid[0, u]
+    ]
+    # oracle emits items + GC only (skips carry no row)
+    up = Update.decode_v2(v2[0])
+    want = []
+    for client, blocks in sorted(up.blocks.items()):
+        for blk in blocks:
+            if type(blk).__name__ != "SkipRange":
+                want.append((client, blk.id.clock, blk.len))
+    assert sorted(got) == sorted(want), (got, want)
+
+
+def test_map_rows_parent_sub_keys():
+    from ytpu.ops.decode_kernel import key_hash_host
+
+    def ops(doc):
+        m = doc.get_map("config")
+        with doc.transact() as txn:
+            m.insert(txn, "title", "zedoc")
+
+    doc, log = capture_v1(ops)
+    v2 = [v1_to_v2(p) for p in log]
+    # ContentAny map values are host-lane in v0 — but parent_sub keys on a
+    # *text-valued* map row must resolve through the key table.
+    # Use a nested text under a map key instead: that is ContentType →
+    # unsupported too. So assert the Any case FLAGS (host fallback), which
+    # is the documented contract.
+    _, stream, flags = decode(v2)
+    assert (flags & FLAG_UNSUPPORTED != 0).all()
+    assert not np.asarray(stream.valid).any()
+
+
+def test_random_text_trace_parity():
+    rng = random.Random(11)
+
+    def ops(doc):
+        t = doc.get_text("text")
+        for _ in range(40):
+            with doc.transact() as txn:
+                n = len(t)
+                if n > 6 and rng.random() < 0.35:
+                    pos = rng.randint(0, n - 3)
+                    t.remove_range(txn, pos, rng.randint(1, 3))
+                else:
+                    word = "".join(
+                        rng.choice(_string.ascii_lowercase)
+                        for _ in range(rng.randint(1, 8))
+                    )
+                    t.insert(txn, rng.randint(0, n), word)
+
+    doc, log = capture_v1(ops)
+    v2 = [v1_to_v2(p) for p in log]
+    _, stream, flags = decode(v2, max_rows=8, max_dels=8)
+    assert (flags & FLAG_ERRORS == 0).all(), flags
+    valid = np.asarray(stream.valid)
+    dvalid = np.asarray(stream.del_valid)
+    for s, payload in enumerate(v2):
+        up = Update.decode_v2(payload)
+        want = []
+        for client, blocks in sorted(up.blocks.items()):
+            for blk in blocks:
+                want.append((client, blk.id.clock, blk.len))
+        got = [
+            (
+                int(np.asarray(stream.client)[s, u]),
+                int(np.asarray(stream.clock)[s, u]),
+                int(np.asarray(stream.length)[s, u]),
+            )
+            for u in range(valid.shape[1])
+            if valid[s, u]
+        ]
+        assert sorted(got) == sorted(want), (s, got, want)
+        want_d = []
+        for client, ranges in sorted(up.delete_set.clients.items()):
+            for a, bnd in ranges:
+                want_d.append((client, a, bnd))
+        got_d = sorted(
+            (
+                int(np.asarray(stream.del_client)[s, r]),
+                int(np.asarray(stream.del_start)[s, r]),
+                int(np.asarray(stream.del_end)[s, r]),
+            )
+            for r in range(dvalid.shape[1])
+            if dvalid[s, r]
+        )
+        assert got_d == sorted(want_d), (s, got_d, want_d)
+
+
+def test_unicode_string_offsets():
+    def ops(doc):
+        t = doc.get_text("text")
+        with doc.transact() as txn:
+            t.insert(txn, 0, "héllo 🌍 wörld")
+        with doc.transact() as txn:
+            t.insert(txn, 3, "日本語")
+
+    doc, log = capture_v1(ops)
+    v2 = [v1_to_v2(p) for p in log]
+    buf, stream, flags = decode(v2)
+    assert (flags & FLAG_ERRORS == 0).all(), flags
+    flat = np.asarray(buf).reshape(-1)
+    valid = np.asarray(stream.valid)
+    texts = [
+        utf8_slice_u16(
+            flat,
+            int(np.asarray(stream.content_ref)[s, u]),
+            0,
+            int(np.asarray(stream.length)[s, u]),
+        )
+        for s in range(len(v2))
+        for u in range(valid.shape[1])
+        if valid[s, u]
+    ]
+    assert texts == ["héllo 🌍 wörld", "日本語"]
+    # lengths are UTF-16 units (surrogate pair counts 2)
+    assert int(np.asarray(stream.length)[0, 0]) == 14
+
+
+def test_apply_v2_device_stream_end_to_end():
+    """A V2 stream decoded on device integrates into the batch engine and
+    renders the same text as the host replay — zero host fallbacks."""
+    import jax.numpy as jnp
+
+    from ytpu.models.batch_doc import (
+        apply_update_stream,
+        get_string,
+        init_state,
+    )
+    from ytpu.models.batch_doc import BatchEncoder
+    from ytpu.ops.decode_kernel import RawPayloadView, identity_rank
+
+    rng = random.Random(5)
+
+    def ops(doc):
+        t = doc.get_text("text")
+        for _ in range(25):
+            with doc.transact() as txn:
+                n = len(t)
+                if n > 5 and rng.random() < 0.3:
+                    t.remove_range(txn, rng.randint(0, n - 2), 1)
+                else:
+                    t.insert(
+                        txn,
+                        rng.randint(0, n),
+                        rng.choice(_string.ascii_lowercase) * rng.randint(1, 4),
+                    )
+
+    doc, log = capture_v1(ops)
+    v2 = [v1_to_v2(p) for p in log]
+    buf, lens, spans = pack_updates_v2(v2)
+    stream, flags = decode_updates_v2(buf, lens, spans, 4, 4)
+    assert (np.asarray(flags) & FLAG_ERRORS == 0).all(), np.asarray(flags)
+
+    # the stream is already step-shaped: update s = step s over the batch
+    state = init_state(1, 256)
+    state = apply_update_stream(state, stream, identity_rank(2))
+    payloads = RawPayloadView(np.asarray(buf))
+    assert int(np.asarray(state.error).max()) == 0
+    assert get_string(state, 0, payloads) == doc.get_text("text").get_string()
+
+
+def test_b4_trace_prefix_rides_device_lane():
+    """VERDICT r2 #5 'done' criterion: a V2-encoded B4 editing-trace stream
+    decodes on the device lane with ZERO host fallbacks, and the decoded
+    stream integrates to the same text as the host replay."""
+    import sys, os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    from ytpu.models.batch_doc import apply_update_stream, get_string, init_state
+    from ytpu.ops.decode_kernel import RawPayloadView, identity_rank
+
+    ops = bench.load_b4_ops(400)
+    doc = Doc(client_id=1)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    t = doc.get_text("text")
+    for tag, pos, payload in ops:
+        with doc.transact() as txn:
+            if tag == "i":
+                t.insert(txn, pos, payload)
+            else:
+                t.remove_range(txn, pos, payload)
+    v2 = [v1_to_v2(p) for p in log]
+    buf, lens, spans = pack_updates_v2(v2)
+    stream, flags = decode_updates_v2(buf, lens, spans, 4, 4)
+    f = np.asarray(flags)
+    assert (f & FLAG_ERRORS == 0).all(), f[(f & FLAG_ERRORS) != 0][:5]
+
+    state = init_state(1, 4096)
+    state = apply_update_stream(state, stream, identity_rank(2))
+    assert int(np.asarray(state.error).max()) == 0
+    got = get_string(state, 0, RawPayloadView(np.asarray(buf)))
+    assert got == doc.get_text("text").get_string()

@@ -790,6 +790,20 @@ def decode_updates_v1(
     regs, rows, dels = jax.lax.fori_loop(0, T, step, init_carry())
     flags = regs["flags"] | jnp.where(regs["st"] != ST_DONE, FLAG_MALFORMED, 0)
 
+    return _resolve_and_pack(
+        rows, dels, flags, client_table, key_table, client_hash_table
+    )
+
+
+def _resolve_and_pack(
+    rows, dels, flags, client_table, key_table, client_hash_table
+):
+    """Shared post-decode pass for the V1 and V2 device lanes: raw client
+    ids -> interned indices (`client_table`), big-client hash entries ->
+    indices (`client_hash_table`), parent_sub hashes -> key indices
+    (`key_table`), error-lane row invalidation, and UpdateBatch packing."""
+    S, U = rows["client"].shape
+    R = dels["client"].shape[1]
     if client_table is not None:
         sorted_ids, perm = client_table
         K = sorted_ids.shape[0]

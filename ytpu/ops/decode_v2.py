@@ -1,0 +1,740 @@
+"""Device-side V2 (columnar) update decoding — wire bytes → block rows.
+
+The V2 format (reference: /root/reference/yrs/src/updates/encoder.rs:182-528,
+decoder.rs:195-505) is struct-of-arrays on the wire: nine independently
+RLE-compressed column buffers (key-clock, client, left/right clock, info,
+string, parent-info, type-ref, len) followed by a `rest` stream holding the
+structural varints (section headers, Skip lengths, the delete set). That
+layout is exactly the device's own columnar model, so — unlike the V1 lane's
+byte-at-a-time state machine (`decode_kernel.py`) — V2 decodes with NO
+sequential pass over the wire bytes:
+
+1. the 10 sub-buffer spans are split on host (one varint each — memcpy-level
+   cost, like `pack_updates`);
+2. each RLE column expands on device with an entry-sequential scan (one run
+   per step, bulk run writes — runs, not bytes, bound the loop);
+3. the `rest` stream is bulk-parsed in one shot: every lib0 varint ends at a
+   byte < 0x80, so terminator positions come from a cumsum + searchsorted
+   and all values extract in parallel;
+4. everything else is pure tensor assembly — per-block column consumption
+   counts are computed from the info bytes alone, prefix-summed into
+   per-block column indices, and gathered.
+
+Device-supported set (v0): GC / Skip / Deleted / String blocks with root,
+ID, or nested parents, parent_sub map keys (hashed through the same
+`key_table` as the V1 lane), multi client sections, and the delete set —
+i.e. every shape in the text-editing north-star workloads (B4). Lanes
+holding Any / JSON / Embed / Binary / Format / Type / Doc / Move content
+flag FLAG_UNSUPPORTED and take the host lane (their `rest` stream is no
+longer a flat varint list, so nothing after the first such block could be
+trusted anyway). Client ids beyond i32 flag FLAG_BIG_CLIENT (the V1 lane's
+varint-byte hash bridge does not transfer: V2 client columns use *signed*
+varints, a different byte sequence).
+
+Output contract is identical to `decode_updates_v1`: ``(UpdateBatch,
+flags)`` with per-lane error flags and rows invalidated on flagged lanes;
+string content refs are byte offsets into the same packed ``[S, L]`` buffer
+(`RawPayloadView` slices them out of the string-column blob exactly as it
+does out of a V1 update body).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ytpu.core.content import (
+    BLOCK_GC,
+    BLOCK_SKIP,
+    CONTENT_DELETED,
+    CONTENT_STRING,
+)
+from ytpu.encoding.lib0 import Cursor
+
+from .decode_kernel import (
+    FLAG_BIG_CLIENT,
+    FLAG_MALFORMED,
+    FLAG_MULTI_CLIENT,
+    FLAG_OVERFLOW,
+    FLAG_UNSUPPORTED,
+    KEY_HASH_BYTES,
+    _resolve_and_pack,
+    pack_updates,
+)
+
+__all__ = ["pack_updates_v2", "decode_updates_v2"]
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+# span indices into the host-split frame table
+(
+    SP_KEY_CLOCK,
+    SP_CLIENT,
+    SP_LEFT_CLOCK,
+    SP_RIGHT_CLOCK,
+    SP_INFO,
+    SP_STRING,
+    SP_PARENT_INFO,
+    SP_TYPE_REF,
+    SP_LEN,
+    SP_REST,
+    SP_STR_BLOB,
+    SP_STR_LENS,
+) = range(12)
+
+
+def pack_updates_v2(
+    payloads: List[bytes], pad_to: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pad raw V2 update byte strings into ``[S, L] uint8`` + frame spans.
+
+    Host cost: eleven varint reads per update (the feature flag, nine
+    column-buffer length prefixes, and the string column's inner blob
+    length) — no value decoding, interning, or copying beyond the pad.
+
+    Returns ``(buf, lens, spans)`` with ``spans[s, k] = (start, len)`` for
+    the twelve regions (`SP_*`). A lane that fails frame splitting gets
+    all-zero spans; `decode_updates_v2` flags it malformed.
+    """
+    buf, lens = pack_updates(payloads, pad_to)
+    S = len(payloads)
+    spans = np.zeros((S, 12, 2), dtype=np.int32)
+    for s, p in enumerate(payloads):
+        try:
+            cur = Cursor(p)
+            cur.read_u8()  # feature flag
+            for k in range(9):
+                n = cur.read_var_uint()
+                spans[s, k] = (cur.pos, n)
+                cur.read_exact(n)
+            spans[s, SP_REST] = (cur.pos, len(p) - cur.pos)
+            # string column inner layout: [varint blob_len][blob][lens rle]
+            st, sl = spans[s, SP_STRING]
+            if sl > 0:
+                scur = Cursor(p[st : st + sl])
+                bn = scur.read_var_uint()
+                spans[s, SP_STR_BLOB] = (st + scur.pos, bn)
+                spans[s, SP_STR_LENS] = (
+                    st + scur.pos + bn,
+                    sl - scur.pos - bn,
+                )
+        except Exception:
+            spans[s] = 0  # malformed frame: flagged on device
+    return buf, lens, spans
+
+
+# --- vectorized varint helpers ----------------------------------------------
+
+
+def _window(b, pos, end, width):
+    """[S, width] byte window at per-lane ``pos``, zero past ``end``."""
+    S, L = b.shape
+    idx = jnp.clip(pos[:, None] + jnp.arange(width, dtype=I32)[None, :], 0, L - 1)
+    ok = (pos[:, None] + jnp.arange(width, dtype=I32)[None, :]) < end[:, None]
+    return jnp.where(ok, jnp.take_along_axis(b, idx, axis=1), 0)
+
+
+def _uvar_from(bytes10):
+    """Unsigned lib0 varint from a [S, 10] window → (val, nbytes, ovf)."""
+    S = bytes10.shape[0]
+    cont = bytes10 >= 0x80
+    inb = jnp.concatenate(
+        [jnp.ones((S, 1), I32), jnp.cumprod(cont[:, :9].astype(I32), axis=1)],
+        axis=1,
+    )
+    nbytes = jnp.sum(inb, axis=1)
+    shifts = (7 * jnp.arange(5, dtype=I32))[None, :].astype(U32)
+    val = jnp.sum(
+        jnp.where(
+            inb[:, :5] == 1,
+            (bytes10[:, :5].astype(U32) & 0x7F) << shifts,
+            jnp.zeros((S, 5), U32),
+        ),
+        axis=1,
+    ).astype(I32)
+    ovf = (nbytes > 5) | ((nbytes == 5) & ((bytes10[:, 4] & 0x7F) >= 8))
+    return val, nbytes, ovf
+
+
+def _svar_from(bytes10):
+    """Signed lib0 varint (6 bits + sign in byte 0, then 7-bit groups) from
+    a [S, 10] window → (magnitude, negative, nbytes, ovf)."""
+    S = bytes10.shape[0]
+    cont = bytes10 >= 0x80
+    inb = jnp.concatenate(
+        [jnp.ones((S, 1), I32), jnp.cumprod(cont[:, :9].astype(I32), axis=1)],
+        axis=1,
+    )
+    nbytes = jnp.sum(inb, axis=1)
+    neg = (bytes10[:, 0] & 0x40) != 0
+    mag = (bytes10[:, 0].astype(U32) & 0x3F)
+    shifts = (6 + 7 * jnp.arange(4, dtype=I32)).astype(U32)
+    mag = mag + jnp.sum(
+        jnp.where(
+            inb[:, 1:5] == 1,
+            (bytes10[:, 1:5].astype(U32) & 0x7F) << shifts[None, :],
+            jnp.zeros((S, 4), U32),
+        ),
+        axis=1,
+    )
+    ovf = (nbytes > 5) | ((nbytes == 5) & ((bytes10[:, 4] & 0x7F) >= 4))
+    return mag.astype(I32), neg, nbytes, ovf
+
+
+def _bulk_uvarints(b, start, end, NV):
+    """All unsigned varints of a flat region, in parallel.
+
+    A lib0 varint ends at its first byte < 0x80, so terminator k of the
+    region starts value k+1; positions come from a cumsum + searchsorted,
+    values from 5-byte windows. Returns (vals [S, NV], n_varints [S],
+    ovf [S, NV])."""
+    S, L = b.shape
+    iota = jnp.arange(L, dtype=I32)[None, :]
+    in_region = (iota >= start[:, None]) & (iota < end[:, None])
+    term = in_region & (b < 0x80)
+    cum = jnp.cumsum(term.astype(I32), axis=1)
+    n_varints = cum[:, -1]
+    targets = jnp.arange(1, NV + 1, dtype=I32)
+    term_pos = jax.vmap(lambda c: jnp.searchsorted(c, targets, side="left"))(cum)
+    starts = jnp.concatenate(
+        [start[:, None], (term_pos + 1)[:, :-1]], axis=1
+    )  # [S, NV]
+    idx = jnp.clip(
+        starts[:, :, None] + jnp.arange(5, dtype=I32)[None, None, :], 0, L - 1
+    )
+    w = jnp.take_along_axis(b, idx.reshape(S, -1), axis=1).reshape(S, NV, 5)
+    nb = jnp.clip(term_pos - starts + 1, 1, 10)
+    inb = jnp.arange(5, dtype=I32)[None, None, :] < jnp.minimum(nb, 5)[:, :, None]
+    shifts = (7 * jnp.arange(5, dtype=I32))[None, None, :].astype(U32)
+    vals = jnp.sum(
+        jnp.where(inb, (w.astype(U32) & 0x7F) << shifts, 0), axis=2
+    ).astype(I32)
+    ovf = (nb > 5) | ((nb == 5) & ((w[:, :, 4] & 0x7F) >= 8))
+    return vals, n_varints, ovf
+
+
+# --- RLE column expanders ----------------------------------------------------
+
+
+def _expand_uintoptrle(b, start, length, N):
+    """UIntOptRle column → [S, N] values.
+
+    Entry grammar (codec.py _UIntOptRleDecoder): signed varint; negative →
+    run of |v| with count = next uvarint + 2; else single value. Returns
+    (vals, produced, big) — `big` marks positions whose value overflowed
+    i32 (real 53-bit client ids)."""
+    S = b.shape[0]
+    end = start + length
+    iota_n = jnp.arange(N, dtype=I32)[None, :]
+
+    def step(_, carry):
+        pos, oidx, vals, big = carry
+        active = (pos < end) & (oidx < N)
+        w = _window(b, pos, end, 10)
+        mag, neg, nb, ovf = _svar_from(w)
+        w2 = _window(b, pos + nb, end, 10)
+        cnt, nb2, _ = _uvar_from(w2)
+        count = jnp.where(neg, cnt + 2, 1)
+        adv = nb + jnp.where(neg, nb2, 0)
+        mask = (
+            (iota_n >= oidx[:, None])
+            & (iota_n < (oidx + count)[:, None])
+            & active[:, None]
+        )
+        vals = jnp.where(mask, mag[:, None], vals)
+        big = big | (mask & ovf[:, None])
+        pos = jnp.where(active, pos + adv, pos)
+        oidx = jnp.where(active, oidx + count, oidx)
+        return pos, oidx, vals, big
+
+    pos0 = jnp.where(length > 0, start, end)
+    init = (
+        pos0,
+        jnp.zeros((S,), I32),
+        jnp.zeros((S, N), I32),
+        jnp.zeros((S, N), bool),
+    )
+    _, produced, vals, big = jax.lax.fori_loop(0, N, step, init)
+    return vals, produced, big
+
+
+def _expand_intdiffoptrle(b, start, length, N):
+    """IntDiffOptRle column → [S, N] values (codec.py _IntDiffOptRleDecoder):
+    signed varint `encoded` = (diff << 1) | has_count; run values are the
+    arithmetic sequence last + diff, last + 2*diff, …"""
+    S = b.shape[0]
+    end = start + length
+    iota_n = jnp.arange(N, dtype=I32)[None, :]
+
+    def step(_, carry):
+        pos, oidx, last, vals = carry
+        active = (pos < end) & (oidx < N)
+        w = _window(b, pos, end, 10)
+        mag, neg, nb, _ = _svar_from(w)
+        enc = jnp.where(neg, -mag, mag)
+        has_count = (enc & 1) != 0
+        diff = enc >> 1  # arithmetic shift: negative diffs survive
+        w2 = _window(b, pos + nb, end, 10)
+        cnt, nb2, _ = _uvar_from(w2)
+        count = jnp.where(has_count, cnt + 2, 1)
+        adv = nb + jnp.where(has_count, nb2, 0)
+        k = iota_n - oidx[:, None] + 1  # 1-based position in the run
+        mask = (k >= 1) & (k <= count[:, None]) & active[:, None]
+        vals = jnp.where(mask, last[:, None] + diff[:, None] * k, vals)
+        last = jnp.where(active, last + diff * count, last)
+        pos = jnp.where(active, pos + adv, pos)
+        oidx = jnp.where(active, oidx + count, oidx)
+        return pos, oidx, last, vals
+
+    pos0 = jnp.where(length > 0, start, end)
+    init = (
+        pos0,
+        jnp.zeros((S,), I32),
+        jnp.zeros((S,), I32),
+        jnp.zeros((S, N), I32),
+    )
+    _, produced, _, vals = jax.lax.fori_loop(0, N, step, init)
+    return vals, produced
+
+
+def _expand_rle(b, start, length, N):
+    """Rle column → [S, N] u8 values (codec.py _RleDecoder): u8 value, then
+    count-1 as uvarint — omitted on the final entry ("repeat forever")."""
+    S = b.shape[0]
+    end = start + length
+    iota_n = jnp.arange(N, dtype=I32)[None, :]
+
+    def step(_, carry):
+        pos, oidx, vals = carry
+        active = (pos < end) & (oidx < N)
+        value = _window(b, pos, end, 1)[:, 0]
+        has_count = (pos + 1) < end
+        w2 = _window(b, pos + 1, end, 10)
+        cnt, nb2, _ = _uvar_from(w2)
+        count = jnp.where(has_count, cnt + 1, N)  # tail entry fills out
+        adv = 1 + jnp.where(has_count, nb2, 0)
+        mask = (
+            (iota_n >= oidx[:, None])
+            & (iota_n < (oidx + count)[:, None])
+            & active[:, None]
+        )
+        vals = jnp.where(mask, value[:, None], vals)
+        pos = jnp.where(active, pos + adv, pos)
+        oidx = jnp.where(active, oidx + count, oidx)
+        return pos, oidx, vals
+
+    pos0 = jnp.where(length > 0, start, end)
+    init = (pos0, jnp.zeros((S,), I32), jnp.zeros((S, N), I32))
+    _, produced, vals = jax.lax.fori_loop(0, N, step, init)
+    return vals, produced
+
+
+def _cumsum_excl(x):
+    return jnp.cumsum(x, axis=1) - x
+
+
+def decode_updates_v2(
+    buf: jax.Array,
+    lens: jax.Array,
+    spans: jax.Array,
+    max_rows: int,
+    max_dels: int,
+    max_sections: Optional[int] = None,
+    client_table: Optional[Tuple[jax.Array, jax.Array]] = None,
+    key_table: Optional[Tuple[jax.Array, jax.Array]] = None,
+    client_hash_table: Optional[Tuple[jax.Array, jax.Array]] = None,
+):
+    """Decode S V2 updates into an ``[S, U] / [S, R]`` UpdateBatch stream.
+
+    Same contract as `decode_updates_v1` (see its docstring for the table
+    semantics); `spans` comes from `pack_updates_v2`. `client_hash_table`
+    is accepted for signature parity but unused — V2 big clients flag
+    FLAG_BIG_CLIENT and take the host lane (module docstring).
+    """
+    del client_hash_table
+    S, L = buf.shape
+    U, R = max_rows, max_dels
+    SEC = max_sections if max_sections is not None else 4
+    NB = U + 8  # blocks incl. Skip runs (emitted rows still cap at U)
+    DSEC = R + 4
+    NV = 2 + 2 * SEC + NB + 2 * DSEC + 2 * R
+    NS = 2 * U + 4  # strings: root names + parent_subs + string contents
+    NCLI = 3 * NB + SEC + 2
+    b = buf.astype(I32)
+    lens = lens.astype(I32)
+    sp = spans.astype(I32)
+
+    def span(k):
+        return sp[:, k, 0], sp[:, k, 1]
+
+    flags = jnp.zeros((S,), I32)
+    # all-zero spans with a non-empty payload = host frame split failed
+    frame_bad = (lens > 0) & (jnp.sum(jnp.abs(sp.reshape(S, -1)), axis=1) == 0)
+    flags = flags | jnp.where(frame_bad, FLAG_MALFORMED, 0)
+
+    # --- column expansions ---------------------------------------------------
+    info_vals, info_n = _expand_rle(b, *span(SP_INFO), NB)
+    pi_vals, pi_n = _expand_rle(b, *span(SP_PARENT_INFO), NB)
+    cli_vals, cli_n, cli_big = _expand_uintoptrle(b, *span(SP_CLIENT), NCLI)
+    lc_vals, lc_n = _expand_intdiffoptrle(b, *span(SP_LEFT_CLOCK), NB)
+    rc_vals, rc_n = _expand_intdiffoptrle(b, *span(SP_RIGHT_CLOCK), NB)
+    len_vals, len_n, _ = _expand_uintoptrle(b, *span(SP_LEN), NB)
+    str16, str_n, _ = _expand_uintoptrle(b, *span(SP_STR_LENS), NS)
+
+    # string byte offsets: binary-search the buffer's UTF-16 prefix sums for
+    # each string's cumulative unit target inside the blob
+    head = ((b & 0xC0) != 0x80).astype(I32)
+    lead4 = (b >= 0xF0).astype(I32)
+    zero = jnp.zeros((S, 1), I32)
+    u16_psum = jnp.concatenate([zero, jnp.cumsum(head + lead4, axis=1)], axis=1)
+    blob_start, blob_len = span(SP_STR_BLOB)
+    base16 = jnp.take_along_axis(u16_psum, blob_start[:, None], axis=1)
+    tgt16 = base16 + _cumsum_excl(str16)  # [S, NS]
+    lo = jnp.broadcast_to(blob_start[:, None], (S, NS))
+    hi = jnp.broadcast_to((blob_start + blob_len)[:, None], (S, NS))
+    for _ in range(18):  # L < 2^18: first byte index with psum >= target
+        mid = (lo + hi) // 2
+        pm = jnp.take_along_axis(u16_psum, jnp.clip(mid, 0, L), axis=1)
+        go_right = pm < tgt16
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(go_right, hi, mid)
+    str_start = lo  # [S, NS] byte offsets
+    str_end = jnp.concatenate(
+        [str_start[:, 1:], (blob_start + blob_len)[:, None]], axis=1
+    )
+    str_bytes = str_end - str_start
+
+    # --- rest stream: every varint at once -----------------------------------
+    rest_start, rest_len = span(SP_REST)
+    v, n_varints, v_ovf = _bulk_uvarints(b, rest_start, rest_start + rest_len, NV)
+    iota_nv = jnp.arange(NV, dtype=I32)[None, :]
+
+    def vat(idx, used):
+        """v[idx] with bounds+overflow accounting for consumed positions."""
+        safe = jnp.clip(idx, 0, NV - 1)
+        out = jnp.take_along_axis(v, safe, axis=1)
+        bad = used & ((idx >= n_varints[:, None]) | (idx >= NV))
+        ob = used & jnp.take_along_axis(v_ovf, safe, axis=1)
+        return out, jnp.any(bad | ob, axis=1)
+
+    nc = v[:, 0]
+    malformed = (lens > 0) & (n_varints < 1)
+    flags = flags | jnp.where(nc > 1, FLAG_MULTI_CLIENT, 0)
+    sec_ovf = nc > SEC
+
+    # --- per-block column consumption (info bytes alone determine it) --------
+    iota_nb = jnp.arange(NB, dtype=I32)[None, :]
+    info = info_vals
+    is_gc = info == BLOCK_GC
+    is_skip = info == BLOCK_SKIP
+    is_item = ~is_gc & ~is_skip
+    kind4 = info & 0x0F
+    has_o = is_item & ((info & 0x80) != 0)
+    has_r = is_item & ((info & 0x40) != 0)
+    cant_copy = is_item & ~has_o & ~has_r
+    has_psub = cant_copy & ((info & 0x20) != 0)
+    # parent_info column index per block (consumed by parentful items only)
+    pi_idx = _cumsum_excl(cant_copy.astype(I32))
+    pi = jnp.take_along_axis(pi_vals, jnp.clip(pi_idx, 0, NB - 1), axis=1)
+    is_root = cant_copy & (pi == 1)
+    is_nested = cant_copy & (pi != 1)
+    # client column: 1 per origin id, ror id, nested parent id
+    c_cnt = has_o.astype(I32) + has_r.astype(I32) + is_nested.astype(I32)
+    c_base = _cumsum_excl(c_cnt)
+    # left-clock column: origin clock or nested-parent clock (≤ 1 per block)
+    l_cnt = (has_o | is_nested).astype(I32)
+    l_idx = _cumsum_excl(l_cnt)
+    r_idx = _cumsum_excl(has_r.astype(I32))
+    # string column: root name, parent_sub, string content — in that order
+    is_str_content = is_item & (kind4 == CONTENT_STRING)
+    s_cnt = is_root.astype(I32) + has_psub.astype(I32) + is_str_content.astype(I32)
+    s_base = _cumsum_excl(s_cnt)
+    # len column: GC lengths + Deleted lengths
+    is_del_content = is_item & (kind4 == CONTENT_DELETED)
+    n_cnt = (is_gc | is_del_content).astype(I32)
+    n_idx = _cumsum_excl(n_cnt)
+    cum_skip = _cumsum_excl(is_skip.astype(I32))  # skips before block j
+    cum_skip_incl = jnp.cumsum(is_skip.astype(I32), axis=1)
+
+    def _skips_upto(n):
+        """Skip blocks among blocks [0, n) per lane ([S] -> [S])."""
+        at = jnp.take_along_axis(
+            cum_skip_incl, jnp.clip(n - 1, 0, NB - 1)[:, None], axis=1
+        )[:, 0]
+        return jnp.where(n > 0, at, 0)
+
+    # --- section walk (tiny: SEC iterations of [S]-vector work) --------------
+    def sec_step(i, carry):
+        vidx, base, sec_h, sec_base, sec_nb = carry
+        active = i < nc
+        nb_i, _ = vat(vidx[:, None], active[:, None])
+        nb_i = nb_i[:, 0]
+        sec_h = sec_h.at[:, i].set(jnp.where(active, vidx, -1))
+        sec_base = sec_base.at[:, i].set(jnp.where(active, base, NB))
+        sec_nb = sec_nb.at[:, i].set(jnp.where(active, nb_i, 0))
+        nxt = jnp.clip(base + nb_i, 0, NB)
+        skips_i = _skips_upto(nxt) - _skips_upto(base)
+        vidx = jnp.where(active, vidx + 2 + skips_i, vidx)
+        base = jnp.where(active, nxt, base)
+        return vidx, base, sec_h, sec_base, sec_nb
+
+    sec_h0 = jnp.full((S, SEC), -1, I32)
+    sec_b0 = jnp.full((S, SEC), NB, I32)
+    sec_n0 = jnp.zeros((S, SEC), I32)
+    vidx_end, total_blocks, sec_h, sec_base, sec_nb = jax.lax.fori_loop(
+        0, SEC, sec_step, (jnp.ones((S,), I32), jnp.zeros((S,), I32),
+                           sec_h0, sec_b0, sec_n0)
+    )
+    blk_ovf = (total_blocks > NB) | (total_blocks > info_n) | sec_ovf
+
+    valid_blk = iota_nb < total_blocks[:, None]
+    # section id per block: number of section bases <= j, minus 1
+    sec_id = (
+        jnp.sum(
+            (sec_base[:, None, :] <= iota_nb[:, :, None]).astype(I32), axis=2
+        )
+        - 1
+    )
+    sec_id = jnp.clip(sec_id, 0, SEC - 1)
+    g = partial(jnp.take_along_axis, axis=1)
+    blk_h = g(sec_h, sec_id)  # section header varint index
+    blk_secbase = g(sec_base, sec_id)
+    sec_clk, bad_v1 = vat(jnp.clip(blk_h, 0, NV - 1) + 1, valid_blk & (blk_h >= 0))
+    sec_cli_idx = sec_id + g(c_base, jnp.clip(blk_secbase, 0, NB - 1))
+    sec_client = g(cli_vals, jnp.clip(sec_cli_idx, 0, NCLI - 1))
+
+    # skip lengths ride the rest stream between their section's blocks
+    skip_rank_in_sec = cum_skip - g(cum_skip, jnp.clip(blk_secbase, 0, NB - 1))
+    skip_vidx = blk_h + 2 + skip_rank_in_sec
+    skip_len, bad_v2 = vat(jnp.clip(skip_vidx, 0, NV - 1), valid_blk & is_skip)
+
+    # per-block fields from the expanded columns
+    cli_at = lambda idx: g(cli_vals, jnp.clip(idx, 0, NCLI - 1))
+    blk_cli_base = (sec_id + 1) + c_base
+    oc = jnp.where(valid_blk & has_o, cli_at(blk_cli_base), -1)
+    ok = jnp.where(
+        valid_blk & has_o, g(lc_vals, jnp.clip(l_idx, 0, NB - 1)), 0
+    )
+    rc = jnp.where(valid_blk & has_r, cli_at(blk_cli_base + has_o), -1)
+    rk = jnp.where(
+        valid_blk & has_r, g(rc_vals, jnp.clip(r_idx, 0, NB - 1)), 0
+    )
+    pc = jnp.where(valid_blk & is_nested, cli_at(blk_cli_base), -1)
+    pk = jnp.where(
+        valid_blk & is_nested, g(lc_vals, jnp.clip(l_idx, 0, NB - 1)), 0
+    )
+    ptag = jnp.where(is_root, 1, jnp.where(is_nested, 2, 0))
+
+    # string indices: root name at s_base, psub next, content last
+    psub_idx = s_base + is_root
+    content_sidx = psub_idx + has_psub
+    str_at = lambda idx, arr: g(arr, jnp.clip(idx, 0, NS - 1))
+    psub_start = str_at(psub_idx, str_start)
+    psub_bytes = str_at(psub_idx, str_bytes)
+    content_start = str_at(content_sidx, str_start)
+    content_len16 = str_at(content_sidx, str16)
+
+    # parent_sub key hash — identical mixing to the V1 lane / key_hash_host
+    kh_idx = jnp.clip(
+        psub_start[:, :, None] + jnp.arange(KEY_HASH_BYTES, dtype=I32)[None, None, :],
+        0,
+        L - 1,
+    )
+    kh_b = jnp.take_along_axis(b, kh_idx.reshape(S, -1), axis=1).reshape(
+        S, NB, KEY_HASH_BYTES
+    )
+    kh_m = (
+        jnp.arange(KEY_HASH_BYTES, dtype=I32)[None, None, :]
+        < psub_bytes[:, :, None]
+    )
+    pow31 = jnp.asarray(
+        np.array(
+            [pow(31, i, 1 << 32) for i in range(KEY_HASH_BYTES)], dtype=np.uint32
+        )
+    )
+    khash = jnp.sum(
+        jnp.where(kh_m, kh_b.astype(U32) * pow31[None, None, :], 0).astype(U32),
+        axis=2,
+    )
+    khash = (
+        (khash ^ (psub_bytes.astype(U32) * jnp.uint32(2654435761)))
+        & jnp.uint32(0x7FFFFFFF)
+    ).astype(I32)
+    keyh = jnp.where(valid_blk & has_psub, khash, -1)
+    key_too_long = valid_blk & has_psub & (psub_bytes > KEY_HASH_BYTES)
+
+    # block lengths + clocks
+    blk_len = jnp.where(
+        is_str_content,
+        content_len16,
+        jnp.where(
+            is_gc | is_del_content,
+            g(len_vals, jnp.clip(n_idx, 0, NB - 1)),
+            jnp.where(is_skip, skip_len, 0),
+        ),
+    )
+    blk_len = jnp.where(valid_blk, blk_len, 0)
+    len_psum = _cumsum_excl(blk_len)
+    clock = sec_clk + len_psum - g(len_psum, jnp.clip(blk_secbase, 0, NB - 1))
+
+    # --- unsupported / overflow / big-client flags ---------------------------
+    unsupported = jnp.any(
+        valid_blk
+        & is_item
+        & ~is_del_content
+        & ~is_str_content,
+        axis=1,
+    ) | jnp.any(key_too_long, axis=1)
+    # every consumed client-column position must be checked for i32
+    # overflow: blocks consume up to two entries (origin + right-origin)
+    big_at = lambda idx: g(cli_big.astype(I32), jnp.clip(idx, 0, NCLI - 1)) > 0
+    big = (
+        jnp.any(big_at(blk_cli_base) & valid_blk & (c_cnt > 0), axis=1)
+        | jnp.any(big_at(blk_cli_base + 1) & valid_blk & (c_cnt > 1), axis=1)
+        | jnp.any(big_at(sec_cli_idx) & valid_blk, axis=1)
+    )
+    consumption_ovf = (
+        (g(c_base, jnp.full((S, 1), NB - 1, I32))[:, 0] + 3 > NCLI)
+        | (total_blocks > NB)
+    )
+    # truncated column buffers: the info bytes imply consumption counts
+    # that each expansion must actually have produced (V1 parity: such
+    # wire flags FLAG_MALFORMED and takes the host lane)
+    vb = valid_blk.astype(I32)
+    need_cli = jnp.minimum(nc, SEC) + jnp.sum(c_cnt * vb, axis=1)
+    need_lc = jnp.sum(l_cnt * vb, axis=1)
+    need_rc = jnp.sum(has_r.astype(I32) * vb, axis=1)
+    need_len = jnp.sum(n_cnt * vb, axis=1)
+    need_str = jnp.sum(s_cnt * vb, axis=1)
+    need_pi = jnp.sum(cant_copy.astype(I32) * vb, axis=1)
+    truncated = (
+        (need_cli > cli_n)
+        | (need_lc > lc_n)
+        | (need_rc > rc_n)
+        | (need_len > len_n)
+        | (need_str > str_n)
+        | (need_pi > pi_n)
+    )
+
+    # --- delete set ----------------------------------------------------------
+    d0 = 1 + 2 * jnp.minimum(nc, SEC) + _skips_upto(total_blocks)
+    ds_n, bad_v3 = vat(d0[:, None], (lens > 0)[:, None] & ~frame_bad[:, None])
+    ds_n = ds_n[:, 0]
+    iota_r = jnp.arange(R, dtype=I32)[None, :]
+
+    dels = dict(
+        client=jnp.zeros((S, R), I32),
+        start=jnp.zeros((S, R), I32),
+        end=jnp.zeros((S, R), I32),
+        valid=jnp.zeros((S, R), bool),
+    )
+
+    def ds_step(k, carry):
+        p, out_base, dels, bad, ovf = carry
+        active = k < ds_n
+        cli, b1 = vat(p[:, None], active[:, None])
+        nr, b2 = vat(p[:, None] + 1, active[:, None])
+        cli, nr = cli[:, 0], nr[:, 0]
+        in_sec = active[:, None] & (iota_r < nr[:, None])
+        dv, b3 = vat(p[:, None] + 2 + 2 * iota_r, in_sec)
+        lv, b4 = vat(p[:, None] + 3 + 2 * iota_r, in_sec)
+        lv = lv + 1  # write_ds_len stores length - 1
+        # ds_curr_val accumulates diffs and lengths within the section
+        dvm = jnp.where(in_sec, dv, 0)
+        lvm = jnp.where(in_sec, lv, 0)
+        clocks = jnp.cumsum(dvm, axis=1) + _cumsum_excl(lvm)
+        # scatter range m of this section to output slot out_base + m
+        tgt = out_base[:, None] + iota_r
+        ohm = (iota_r[:, :, None] == tgt[:, None, :]) & in_sec[:, None, :]
+        hit = jnp.any(ohm, axis=2)  # [S, R_out]
+
+        def put(cur, val):
+            return jnp.where(
+                hit, jnp.einsum("som,sm->so", ohm.astype(I32), val), cur
+            )
+
+        dels = dict(
+            client=put(dels["client"], jnp.broadcast_to(cli[:, None], (S, R))),
+            start=put(dels["start"], clocks),
+            end=put(dels["end"], clocks + lvm),
+            valid=dels["valid"] | hit,
+        )
+        ovf = ovf | (active & (out_base + nr > R))
+        bad = bad | b1 | b2 | b3 | b4
+        p = jnp.where(active, p + 2 + 2 * nr, p)
+        out_base = jnp.where(active, jnp.clip(out_base + nr, 0, R), out_base)
+        return p, out_base, dels, bad, ovf
+
+    p0 = d0 + 1
+    _, _, dels, ds_bad, ds_ovf = jax.lax.fori_loop(
+        0,
+        DSEC,
+        ds_step,
+        (p0, jnp.zeros((S,), I32), dels, jnp.zeros((S,), bool), jnp.zeros((S,), bool)),
+    )
+    ds_sec_ovf = ds_n > DSEC
+
+    # --- row emission (compact out the Skip blocks) --------------------------
+    emit = valid_blk & ~is_skip & (blk_len > 0)
+    emit_idx = _cumsum_excl(emit.astype(I32))
+    row_ovf = jnp.any(emit & (emit_idx >= U), axis=1)
+    iota_u = jnp.arange(U, dtype=I32)[None, :]
+    oh = (
+        (iota_u[:, None, :] == emit_idx[:, :, None])
+        & emit[:, :, None]
+        & (emit_idx < U)[:, :, None]
+    )  # [S, NB, U]
+
+    def scatter(vec, fill):
+        out = jnp.einsum("sbu,sb->su", oh.astype(I32), vec)
+        hit = jnp.any(oh, axis=1)
+        return jnp.where(hit, out, fill)
+
+    row_ids = jnp.arange(S, dtype=I32)[:, None]
+    rows = dict(
+        client=scatter(jnp.broadcast_to(sec_client, (S, NB)), 0),
+        clock=scatter(clock, 0),
+        length=scatter(blk_len, 0),
+        oc=scatter(oc, -1),
+        ok=scatter(ok, 0),
+        rc=scatter(rc, -1),
+        rk=scatter(rk, 0),
+        kind=scatter(jnp.where(is_gc, BLOCK_GC, kind4), 0),
+        ref=scatter(
+            jnp.where(is_str_content, row_ids * L + content_start, -1), -1
+        ),
+        ptag=scatter(ptag, 0),
+        pc=scatter(pc, -1),
+        pk=scatter(pk, 0),
+        keyh=scatter(keyh, -1),
+        valid=jnp.any(oh, axis=1),
+    )
+
+    malformed = (
+        malformed
+        | frame_bad
+        | bad_v1
+        | bad_v2
+        | bad_v3
+        | ds_bad
+        | truncated
+        | (valid_blk & (blk_len < 0)).any(axis=1)
+    )
+    flags = (
+        flags
+        | jnp.where(malformed, FLAG_MALFORMED, 0)
+        | jnp.where(unsupported, FLAG_UNSUPPORTED, 0)
+        | jnp.where(big, FLAG_BIG_CLIENT, 0)
+        | jnp.where(
+            blk_ovf | row_ovf | consumption_ovf | ds_ovf | ds_sec_ovf,
+            FLAG_OVERFLOW,
+            0,
+        )
+    )
+
+    return _resolve_and_pack(rows, dels, flags, client_table, key_table, None)

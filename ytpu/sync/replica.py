@@ -79,15 +79,26 @@ class ReplicaLink:
         """Process up to `max_frames` inbound frames, then flush outbox.
 
         Returns the number of frames read. A `timeout` bounds the wait for
-        each frame's first byte, so a quiet peer never blocks the loop."""
+        each frame's first byte, so a quiet peer never blocks the loop.
+        Raises ConnectionError when the peer closed (EOF) or when this
+        link's session was evicted as a slow consumer — a silent return
+        in either case would leave `run()` busy-spinning / the pods
+        silently diverging."""
         n = 0
         while n < max_frames:
             frame = await read_frame(self.reader, first_byte_timeout=timeout)
             if frame is None:
+                if self.reader.at_eof():
+                    raise ConnectionError("replica peer closed the link")
                 break
             for reply in self.server.receive_frames(self.session, frame):
                 write_frame(self.writer, reply)
             n += 1
+        if self.session is not None and self.session.dead:
+            raise ConnectionError(
+                "replica link session evicted (outbox overflow); "
+                "reconnect and resync via the SyncStep1 greeting"
+            )
         await self.flush()
         return n
 
