@@ -136,3 +136,26 @@ def test_unit_arena_view_surrogate_halves():
     assert v.slice_text(0, 2, 2) == "�b"  # starts at the second half
     assert v.slice_text(1, 0, 2) == "🙂"
     assert v.slice_text(0, 1, 2) == "🙂"
+
+
+def test_xla_lane_replay_parity():
+    """The un-fused XLA replay lane (bench fallback when Mosaic cannot
+    compile the Pallas kernel on real hardware) must render the same text
+    as the host oracle through compaction and growth."""
+    import bench as _bench
+    from ytpu.models.replay import FusedReplay, plan_replay
+
+    ops = _bench.synthetic_ops(300, seed=13)
+    log, expect = _bench.build_updates(ops)
+    rep = FusedReplay(
+        n_docs=8,
+        plan=plan_replay(log),
+        capacity=512,
+        max_capacity=4096,
+        d_block=4,
+        chunk=64,
+        lane="xla",
+    )
+    rep.run(log)
+    assert rep.get_string(0) == expect
+    assert rep.get_string(7) == expect

@@ -199,6 +199,32 @@ class ReplayStats:
     chunk_seconds: List[float] = field(default_factory=list)
 
 
+_XLA_STEP = None
+
+
+def _xla_chunk_step(cols, meta, stream, rank):
+    """One chunk of stream steps through the un-fused XLA integrate path,
+    on the packed kernel state (unpack → apply_update_stream → repack,
+    all inside one jit so XLA fuses the repacks away). The jitted step is
+    a module singleton — a per-call closure would retrace every chunk."""
+    global _XLA_STEP
+    if _XLA_STEP is None:
+        import jax
+
+        from ytpu.models.batch_doc import apply_update_stream
+        from ytpu.ops.integrate_kernel import pack_state, unpack_state
+
+        def step(cols, meta, stream, rank):
+            state = unpack_state(cols, meta, None)
+            state = apply_update_stream(state, stream, rank)
+            return pack_state(state)
+
+        # donate like the fused _run: the packed state updates in place
+        # instead of holding two full copies at grown capacity
+        _XLA_STEP = jax.jit(step, donate_argnums=(0, 1))
+    return _XLA_STEP(cols, meta, stream, rank)
+
+
 class FusedReplay:
     """Chunked fused replay of one shared update stream over a doc batch.
 
@@ -217,17 +243,21 @@ class FusedReplay:
         d_block: int = 8,
         chunk: int = 8192,
         interpret: bool = False,
+        lane: str = "fused",
     ):
         import jax.numpy as jnp
 
         from ytpu.models.batch_doc import init_state
         from ytpu.ops.integrate_kernel import pack_state
 
+        if lane not in ("fused", "xla"):
+            raise ValueError(f"lane must be 'fused' or 'xla', got {lane!r}")
         self.plan = plan
         self.n_docs = n_docs
         self.d_block = d_block
         self.chunk = chunk
         self.interpret = interpret
+        self.lane = lane
         self.max_capacity = max_capacity
         self.cols, self.meta = pack_state(init_state(n_docs, capacity))
         self.stats = ReplayStats(capacity=capacity)
@@ -320,14 +350,22 @@ class FusedReplay:
                     f"device decode flagged updates "
                     f"{(pos + bad[:8]).tolist()}: flags {f[bad[:8]].tolist()}"
                 )
-            rows, dels = pack_stream(stream)
-            self.cols, self.meta = _run(
-                self.cols,
-                self.meta,
-                (rows, dels, rank),
-                self.d_block,
-                self.interpret,
-            )
+            if self.lane == "fused":
+                rows, dels = pack_stream(stream)
+                self.cols, self.meta = _run(
+                    self.cols,
+                    self.meta,
+                    (rows, dels, rank),
+                    self.d_block,
+                    self.interpret,
+                )
+            else:
+                # XLA lane: the un-fused integrate path (batch_doc's
+                # apply_update_stream) on the same packed state — the
+                # HBM-bound fallback when Mosaic can't take the kernel
+                self.cols, self.meta = _xla_chunk_step(
+                    self.cols, self.meta, stream, rank
+                )
             # high-water check (forces the step to complete: the readback
             # doubles as the per-chunk latency fence)
             meta_np = np.asarray(self.meta)
