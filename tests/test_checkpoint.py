@@ -98,3 +98,38 @@ def test_periodic_save_to_fixed_path_overwrites(tmp_path):
 
 
 from ytpu.core import StateVector  # noqa: E402
+
+
+def test_device_server_checkpoint_preserves_root_names(tmp_path):
+    """A restored device-authoritative pod must keep emitting each tenant's
+    wire root name — falling back to the batch default would rename every
+    root across a restart (code-review r3)."""
+    from ytpu.core import Doc
+    from ytpu.core.state_vector import StateVector
+    from ytpu.models.checkpoint import load_device_server, save_device_server
+    from ytpu.sync.device_server import DeviceSyncServer
+    from ytpu.sync.protocol import Message, SyncMessage
+
+    pod = DeviceSyncServer(n_docs=2, capacity=256, device_authoritative=True)
+    session, _ = pod.connect_frames("pad")
+    c = Doc(client_id=7)
+    with c.transact() as txn:
+        c.get_text("notes").insert(txn, 0, "persisted")
+    upd = c.encode_state_as_update_v1(StateVector({}))
+    pod.receive_frames(
+        session, Message.sync(SyncMessage.update(upd)).encode_v1()
+    )
+    pod.flush_device()
+    assert pod._root_names == {"pad": "notes"}
+
+    save_device_server(str(tmp_path / "pod"), pod)
+    restored = load_device_server(str(tmp_path / "pod"))
+    assert restored.device_authoritative
+    assert restored._root_names == {"pad": "notes"}
+    assert restored.slot_of("pad") == pod.slot_of("pad")
+
+    # a fresh client syncing from the restored pod sees root "notes"
+    diff = restored.device_encode_diff("pad", StateVector({}))
+    d = Doc(client_id=9)
+    d.apply_update_v1(diff)
+    assert d.get_text("notes").get_string() == "persisted"

@@ -268,3 +268,149 @@ def test_device_authoritative_incremental_diff():
     for msg in message_reader(reply):
         reader.apply_update_v1(msg.body.payload)
     assert reader.get_text("text").get_string() == "part one. part two."
+
+
+def test_multi_root_tenant_demotes_to_host_path():
+    """A tenant whose clients use several named roots (text+map — the
+    reference's normal doc shape, doc.rs:156-228) exceeds the single-root
+    device scope: the server detects the second root via the native wire
+    prescan and demotes the tenant to the host path mid-stream, with no
+    content lost and no root aliasing."""
+    from ytpu.core import Doc
+    from ytpu.core.state_vector import StateVector
+    from ytpu.sync.device_server import DeviceSyncServer
+    from ytpu.sync.protocol import Message, SyncMessage
+
+    pod = DeviceSyncServer(n_docs=2, capacity=256, device_authoritative=True)
+    session, _ = pod.connect_frames("app")
+
+    c = Doc(client_id=31)
+    log = []
+    c.observe_update_v1(lambda p, o, t: log.append(p))
+    with c.transact() as txn:
+        c.get_text("body").insert(txn, 0, "words")
+    with c.transact() as txn:
+        c.get_map("meta").insert(txn, "title", "doc one")
+    with c.transact() as txn:
+        c.get_text("body").insert(txn, 5, "!")
+    for p in log:
+        pod.receive_frames(
+            session, Message.sync(SyncMessage.update(p)).encode_v1()
+        )
+    pod.flush_device()
+    assert "app" in pod._host_tenants
+
+    # a fresh client syncing sees BOTH roots intact
+    session2, greeting = pod.connect_frames("app")
+    step1 = Message.sync(
+        SyncMessage.step1(StateVector({}))
+    ).encode_v1()
+    replies = pod.receive_frames(session2, step1)
+    d = Doc(client_id=32)
+    from ytpu.sync.protocol import message_reader
+
+    for frame in replies:
+        for m in message_reader(frame):
+            if m.kind == 0 and m.body.tag == 1:
+                d.apply_update_v1(m.body.payload)
+    assert d.get_text("body").get_string() == "words!"
+    assert d.get_map("meta").to_json() == {"title": "doc one"}
+
+
+def test_demoted_tenant_checkpoint_roundtrip(tmp_path):
+    from ytpu.core import Doc
+    from ytpu.core.state_vector import StateVector
+    from ytpu.models.checkpoint import load_device_server, save_device_server
+    from ytpu.sync.device_server import DeviceSyncServer
+    from ytpu.sync.protocol import Message, SyncMessage
+
+    pod = DeviceSyncServer(n_docs=2, capacity=256, device_authoritative=True)
+    session, _ = pod.connect_frames("app")
+    c = Doc(client_id=41)
+    log = []
+    c.observe_update_v1(lambda p, o, t: log.append(p))
+    with c.transact() as txn:
+        c.get_text("a").insert(txn, 0, "alpha")
+    with c.transact() as txn:
+        c.get_text("b").insert(txn, 0, "beta")
+    for p in log:
+        pod.receive_frames(
+            session, Message.sync(SyncMessage.update(p)).encode_v1()
+        )
+    assert "app" in pod._host_tenants
+
+    save_device_server(str(tmp_path / "pod"), pod)
+    restored = load_device_server(str(tmp_path / "pod"))
+    assert "app" in restored._host_tenants
+    doc = restored.doc("app")
+    assert doc.get_text("a").get_string() == "alpha"
+    assert doc.get_text("b").get_string() == "beta"
+
+
+def test_demotion_reclaims_device_slot():
+    from ytpu.core import Doc
+    from ytpu.sync.device_server import DeviceSyncServer
+    from ytpu.sync.protocol import Message, SyncMessage
+
+    pod = DeviceSyncServer(n_docs=1, capacity=256, device_authoritative=True)
+    session, _ = pod.connect_frames("multi")
+    c = Doc(client_id=51)
+    log = []
+    c.observe_update_v1(lambda p, o, t: log.append(p))
+    with c.transact() as txn:
+        c.get_text("a").insert(txn, 0, "x")
+    with c.transact() as txn:
+        c.get_text("b").insert(txn, 0, "y")
+    for p in log:
+        pod.receive_frames(
+            session, Message.sync(SyncMessage.update(p)).encode_v1()
+        )
+    assert "multi" in pod._host_tenants
+    # the single slot was reclaimed: a NEW tenant fits a 1-slot pod
+    s2, _ = pod.connect_frames("fresh")
+    d = Doc(client_id=52)
+    log2 = []
+    d.observe_update_v1(lambda p, o, t: log2.append(p))
+    with d.transact() as txn:
+        d.get_text("t").insert(txn, 0, "fresh-tenant")
+    for p in log2:
+        pod.receive_frames(s2, Message.sync(SyncMessage.update(p)).encode_v1())
+    pod.flush_device()
+    assert pod.device_text("fresh") == "fresh-tenant"
+
+
+def test_mirrored_server_checkpoint_keeps_host_docs(tmp_path):
+    from ytpu.models.checkpoint import load_device_server, save_device_server
+    from ytpu.sync.device_server import DeviceSyncServer
+
+    pod = DeviceSyncServer(n_docs=2, capacity=256)  # mirrored mode
+    doc = pod.doc("pad")
+    with doc.transact() as txn:
+        doc.get_text("t").insert(txn, 0, "persisted")
+    pod.flush_device()
+    save_device_server(str(tmp_path / "pod"), pod)
+    restored = load_device_server(str(tmp_path / "pod"))
+    assert not restored.device_authoritative
+    assert restored.doc("pad").get_text("t").get_string() == "persisted"
+
+
+def test_unflushed_queue_survives_checkpoint(tmp_path):
+    from ytpu.core import Doc
+    from ytpu.core.state_vector import StateVector
+    from ytpu.models.checkpoint import load_device_server, save_device_server
+    from ytpu.sync.device_server import DeviceSyncServer
+    from ytpu.sync.protocol import Message, SyncMessage
+
+    pod = DeviceSyncServer(n_docs=2, capacity=256, device_authoritative=True)
+    session, _ = pod.connect_frames("pad")
+    c = Doc(client_id=61)
+    with c.transact() as txn:
+        c.get_text("t").insert(txn, 0, "acked")
+    upd = c.encode_state_as_update_v1(StateVector({}))
+    pod.receive_frames(
+        session, Message.sync(SyncMessage.update(upd)).encode_v1()
+    )
+    # NO flush_device() here: save must flush so the ack is durable
+    save_device_server(str(tmp_path / "pod"), pod)
+    restored = load_device_server(str(tmp_path / "pod"))
+    assert restored.device_text("pad") == "acked"

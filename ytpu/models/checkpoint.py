@@ -97,9 +97,12 @@ def load_state(path: str) -> Tuple[DocStateBatch, BatchEncoder]:
     return state, _enc_restore(side["enc"])
 
 
-def save_ingestor(path: str, ing: BatchIngestor) -> None:
-    """Persist a BatchIngestor: device state + encoder + pending stashes."""
+def save_ingestor(path: str, ing: BatchIngestor, extra: Optional[dict] = None) -> None:
+    """Persist a BatchIngestor: device state + encoder + pending stashes.
+    `extra` (JSON-serializable) rides the sidecar for embedding layers
+    (e.g. DeviceSyncServer tenant metadata)."""
     side = {
+        "extra": extra or {},
         "format": _FORMAT,
         "enc": _enc_sidecar(ing.enc),
         "n_docs": ing.n_docs,
@@ -122,6 +125,12 @@ def save_ingestor(path: str, ing: BatchIngestor) -> None:
 
 
 def load_ingestor(path: str) -> BatchIngestor:
+    return load_ingestor_with_extra(path)[0]
+
+
+def load_ingestor_with_extra(path: str) -> Tuple[BatchIngestor, dict]:
+    """Like `load_ingestor`, also returning the embedder sidecar saved via
+    `save_ingestor(..., extra=...)` (empty dict for older checkpoints)."""
     from ytpu.core.id_set import DeleteSet
     from ytpu.core.state_vector import StateVector
 
@@ -155,7 +164,65 @@ def load_ingestor(path: str) -> BatchIngestor:
     for cid in ing.enc.interner.from_idx:
         if cid > 2**31 - 1:
             ing._register_big_client(cid)
-    return ing
+    return ing, dict(side.get("extra", {}))
+
+
+def save_device_server(path: str, server) -> None:
+    """Persist a DeviceSyncServer: the ingestor checkpoint plus the tenant
+    overlay (slot assignments and learned wire root names — without the
+    names, a restored pod would re-emit every tenant root under the batch
+    default name; code-review r3). Queued-but-unflushed updates integrate
+    first so an acknowledged update can never be lost across a restart."""
+    server.flush_device()
+    if server.device_authoritative:
+        # host docs matter only for demoted (multi-root) tenants
+        host_docs = {
+            name: server.doc(name).encode_state_as_update_v1()
+            for name in server._host_tenants
+        }
+    else:
+        # mirrored mode: the HOST docs are authoritative (the device batch
+        # only shadows them) — snapshot every tenant
+        host_docs = {
+            name: server.doc(name).encode_state_as_update_v1()
+            for name in server.tenants
+        }
+    save_ingestor(
+        path,
+        server.ingestor,
+        extra={
+            "slot_of": dict(server._slot_of),
+            "root_names": dict(server._root_names),
+            "host_tenants": sorted(server._host_tenants),
+            "host_docs": host_docs,
+            "device_authoritative": server.device_authoritative,
+        },
+    )
+
+
+def load_device_server(path: str, **server_kwargs):
+    """Restore a DeviceSyncServer around a checkpointed ingestor. Tenant
+    docs/sessions are transient (clients resync via the greeting); slot
+    assignments and root names are durable."""
+    from ytpu.sync.device_server import DeviceSyncServer
+
+    ing, extra = load_ingestor_with_extra(path)
+    server_kwargs.setdefault(
+        "device_authoritative", extra.get("device_authoritative", False)
+    )
+    server = DeviceSyncServer(ingestor=ing, **server_kwargs)
+    server._slot_of = dict(extra.get("slot_of", {}))
+    server._root_names = dict(extra.get("root_names", {}))
+    server._host_tenants = set(extra.get("host_tenants", []))
+    used = set(server._slot_of.values())
+    server._next_slot = max(used, default=-1) + 1
+    server._free_slots = sorted(set(range(server._next_slot)) - used)
+    # re-register tenants so greetings answer from the restored slots
+    for name in server._slot_of:
+        server.tenant(name)
+    for name, payload in extra.get("host_docs", {}).items():
+        server.doc(name).apply_update_v1(payload)
+    return server
 
 
 # --- storage backends ---------------------------------------------------------

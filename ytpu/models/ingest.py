@@ -88,6 +88,22 @@ class BatchIngestor:
         self._client_hashes: Dict[int, int] = {}
         self._client_id_collisions: set = set()
 
+    def reset_slot(self, doc: int) -> None:
+        """Return a doc slot to its empty state (start/-1, zero blocks,
+        clear error, empty SV and pending stashes). Block columns stay —
+        they are masked by n_blocks — so the reset is O(1) metadata. Used
+        when a tenant leaves its slot (e.g. multi-root demotion) so the
+        slot can serve a new tenant without leaking capacity."""
+        st = self.state
+        self.state = st._replace(
+            start=st.start.at[doc].set(-1),
+            n_blocks=st.n_blocks.at[doc].set(0),
+            error=st.error.at[doc].set(0),
+        )
+        self.svs[doc] = StateVector()
+        self._pending[doc] = {}
+        self._pending_ds[doc] = DeleteSet()
+
     # --- introspection (parity: ytransaction_pending_update/_ds shape) -------
 
     def pending_update(self, doc: int) -> Optional[Update]:

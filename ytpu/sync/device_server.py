@@ -30,6 +30,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ytpu.core.state_vector import StateVector
+from ytpu.encoding.lib0 import Writer
 from ytpu.models.ingest import BatchIngestor
 from ytpu.sync.protocol import (
     MSG_SYNC,
@@ -72,9 +73,17 @@ class DeviceSyncServer(SyncServer):
         self._slot_of: Dict[str, int] = {}
         # per-tenant wire root name (the batch engine maps any single-root
         # tenant onto one device branch; the name must round-trip on the
-        # wire — doc.rs root branches are keyed by name). Learned by a
-        # one-time host peek at the first content-bearing update.
+        # wire — doc.rs root branches are keyed by name). Learned from the
+        # native wire prescan of every inbound update.
         self._root_names: Dict[str, str] = {}
+        # tenants demoted to the host path: a second distinct root name
+        # appeared (multi-root tenants — doc.rs:156-228's normal shape —
+        # exceed the single-root device scope, so they are served from the
+        # host doc instead of being silently aliased onto one root)
+        self._host_tenants: set = set()
+        # slot allocation: next fresh slot + slots reclaimed by demotions
+        self._next_slot = 0
+        self._free_slots: List[int] = []
         self._queues: List[List[bytes]] = [
             [] for _ in range(ingestor.n_docs)
         ]
@@ -91,11 +100,15 @@ class DeviceSyncServer(SyncServer):
     def _assign_slot(self, tenant_name: str) -> int:
         slot = self._slot_of.get(tenant_name)
         if slot is None:
-            if len(self._slot_of) >= self.ingestor.n_docs:
+            if self._free_slots:
+                slot = self._free_slots.pop()
+            elif self._next_slot < self.ingestor.n_docs:
+                slot = self._next_slot
+                self._next_slot += 1
+            else:
                 raise DeviceBatchFull(
                     f"device batch is full ({self.ingestor.n_docs} tenant slots)"
                 )
-            slot = len(self._slot_of)
             self._slot_of[tenant_name] = slot
         return slot
 
@@ -119,7 +132,7 @@ class DeviceSyncServer(SyncServer):
     # --- device-authoritative protocol path ------------------------------------
 
     def connect_frames(self, tenant_name: str):
-        if not self.device_authoritative:
+        if not self.device_authoritative or tenant_name in self._host_tenants:
             return super().connect_frames(tenant_name)
         t = self.tenant(tenant_name)
         self._next_session += 1
@@ -135,12 +148,13 @@ class DeviceSyncServer(SyncServer):
         ]
 
     def receive_frames(self, session: Session, data: bytes) -> List[bytes]:
-        if not self.device_authoritative:
+        if not self.device_authoritative or session.tenant in self._host_tenants:
             return super().receive_frames(session, data)
         t = self.tenant(session.tenant)
         slot = self.slot_of(session.tenant)
         replies: List[bytes] = []
-        for msg in message_reader(data):
+        msgs = list(message_reader(data))
+        for i, msg in enumerate(msgs):
             if msg.kind == MSG_SYNC:
                 sub: SyncMessage = msg.body
                 if sub.tag == MSG_SYNC_STEP_1:
@@ -149,10 +163,19 @@ class DeviceSyncServer(SyncServer):
                         Message.sync(SyncMessage.step2(diff)).encode_v1()
                     )
                 else:  # SyncStep2 / Update: straight to the device slot
-                    if session.tenant not in self._root_names:
-                        name = self._peek_root_name(sub.payload)
-                        if name is not None:
-                            self._root_names[session.tenant] = name
+                    if self._note_roots(session.tenant, sub.payload):
+                        # a second root name: this update must NOT touch
+                        # the single-root device slot — demote the tenant
+                        # and route it plus the rest of the frame through
+                        # the host path
+                        self._demote_to_host(session.tenant)
+                        w = Writer()
+                        for rest in msgs[i:]:
+                            rest.encode(w)
+                        replies.extend(
+                            super().receive_frames(session, w.to_bytes())
+                        )
+                        return replies
                     self._queues[slot].append(sub.payload)
                     self._applied.inc()
                     # broadcast at-least-once (idempotent CRDT updates;
@@ -170,40 +193,74 @@ class DeviceSyncServer(SyncServer):
                 replies.append(reply.encode_v1())
         return replies
 
-    def _peek_root_name(self, payload: bytes) -> Optional[str]:
-        """The first root-parent name in a wire update (None when every
-        block is nested/GC — retry on the next update). Scans all blocks
-        of the updates it inspects and flags a tenant that carries more
-        than one distinct root name (single-root device scope; aliasing
-        roots would corrupt fresh replicas). Coverage caveat: peeking
-        stops once a name is learned — a second root introduced in a
-        LATER update is not detected until multi-root serving lands
-        (it requires decoding every queued update, the cost the
-        device-authoritative lane exists to avoid)."""
+    @staticmethod
+    def _scan_root_names(payload: bytes) -> List[str]:
+        """Distinct root-parent names in a wire update, in block order.
+        Uses the native columnar prescan (the same C++ pass the ingest
+        fast lane runs — microseconds), falling back to the host decoder
+        when the native library is absent."""
+        from ytpu.native import decode_update_columns
+
+        cols = decode_update_columns(payload)
+        names: List[str] = []
+        if cols is not None and not cols.error:
+            for i in range(cols.n_blocks):
+                n = cols.parent_name(i)
+                if n and n not in names:
+                    names.append(n)
+            return names
         from ytpu.core.update import Update
-        from ytpu.utils import metrics
 
         try:
             up = Update.decode_v1(payload)
         except Exception:
-            return None
-        names = []
+            return names
         for blocks in up.blocks.values():
             for b in blocks:
                 p = getattr(b, "parent", None)
                 if isinstance(p, str) and p not in names:
                     names.append(p)
-        if len(names) > 1:
-            metrics.counter("sync.multi_root_tenant_updates").inc()
-            import warnings
+        return names
 
-            warnings.warn(
-                "device-authoritative tenant uses multiple roots "
-                f"{names!r}; single-root scope would alias them — "
-                "serve this tenant from a host doc (device_authoritative"
-                "=False) until multi-root serving lands"
-            )
-        return names[0] if names else None
+    def _note_roots(self, tenant: str, payload: bytes) -> bool:
+        """Record the tenant's root names from one inbound update; True
+        when the tenant just turned multi-root (caller must demote BEFORE
+        the update reaches the device slot)."""
+        names = self._scan_root_names(payload)
+        if not names:
+            return False
+        known = self._root_names.get(tenant)
+        if known is None:
+            self._root_names[tenant] = known = names[0]
+        if any(n != known for n in names):
+            from ytpu.utils import metrics
+
+            metrics.counter("sync.multi_root_demotions").inc()
+            return True
+        return False
+
+    def _demote_to_host(self, tenant: str) -> None:
+        """Move a tenant from its device slot to the host path: integrate
+        everything queued, materialize the host doc from device state, and
+        route the tenant through `SyncServer` from now on. Correctness
+        over speed — a multi-root tenant silently aliased onto one device
+        root would corrupt every fresh replica."""
+        self.flush_device()
+        doc = self.tenant(tenant).awareness.doc
+        diff = self.device_encode_diff(tenant, doc.state_vector())
+        self._host_tenants.add(tenant)
+        # the apply fires the tenant's broadcast observer once (all
+        # sessions receive a full-state update frame — idempotent)
+        doc.apply_update_v1(diff)
+        # reclaim the device slot for future tenants
+        slot = self._slot_of.pop(tenant)
+        self.ingestor.reset_slot(slot)
+        self._free_slots.append(slot)
+
+    def tenant_state_vector(self, tenant_name: str) -> StateVector:
+        if not self.device_authoritative or tenant_name in self._host_tenants:
+            return super().tenant_state_vector(tenant_name)
+        return self.device_state_vector(tenant_name)
 
     def device_state_vector(self, tenant_name: str) -> StateVector:
         """The device mirror's state vector for one tenant (real ids)."""

@@ -45,13 +45,13 @@ __all__ = ["ReplicaLink", "Replicator"]
 
 def _step1_frame(server: SyncServer, tenant: str) -> bytes:
     """A SyncStep1 frame carrying the server's CURRENT state vector for
-    `tenant` — device state when the server is device-authoritative."""
+    `tenant` — device state when the server is device-authoritative
+    (`tenant_state_vector` dispatches, including host-demoted tenants)."""
     if getattr(server, "device_authoritative", False):
         server.flush_device()
-        sv = server.device_state_vector(tenant)
-    else:
-        sv = server.doc(tenant).state_vector()
-    return Message.sync(SyncMessage.step1(sv)).encode_v1()
+    return Message.sync(
+        SyncMessage.step1(server.tenant_state_vector(tenant))
+    ).encode_v1()
 
 
 class ReplicaLink:

@@ -98,6 +98,11 @@ class SyncServer:
     def doc(self, name: str) -> Doc:
         return self.tenant(name).awareness.doc
 
+    def tenant_state_vector(self, name: str):
+        """The authoritative state vector for a tenant (host doc here;
+        device-backed servers override for device-authoritative slots)."""
+        return self.doc(name).state_vector()
+
     # --- session lifecycle ------------------------------------------------------
 
     def connect(self, tenant_name: str) -> Tuple[Session, bytes]:
