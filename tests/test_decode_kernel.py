@@ -354,3 +354,62 @@ def test_huge_string_length_varint_flags_malformed():
     buf, lens = pack_updates([payload])
     _, flags = decode_updates_v1(buf, lens, 4, 4)
     assert np.asarray(flags)[0] & FLAG_MALFORMED
+
+
+def test_content_type_nested_types_decode():
+    """Nested shared types (ContentType rows) decode on device: a map
+    holding a YText and an XmlElement (named branch). WeakRef branches
+    stay host-lane (flagged)."""
+    from ytpu.core.content import CONTENT_TYPE
+
+    from ytpu.types.shared import TextPrelim, XmlElementPrelim
+
+    doc = Doc(client_id=1)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    arr = doc.get_array("root")
+    with doc.transact() as txn:
+        arr.insert(txn, 0, TextPrelim("nested text"))
+    frag = doc.get_xml_fragment("xml")
+    with doc.transact() as txn:
+        frag.insert(txn, 0, XmlElementPrelim("div"))
+
+    buf, stream, flags = _decode(log, U=6)
+    assert (flags & FLAG_ERRORS == 0).all(), flags
+    st = {k: np.asarray(v) for k, v in stream._asdict().items()}
+    view = RawPayloadView(buf)
+    type_rows = [
+        (s, u)
+        for s in range(len(log))
+        for u in range(st["valid"].shape[1])
+        if st["valid"][s, u] and st["kind"][s, u] == CONTENT_TYPE
+    ]
+    assert type_rows, "expected ContentType rows on the device lane"
+    branches = [
+        view.type_branch(int(st["content_ref"][s, u])) for s, u in type_rows
+    ]
+    from ytpu.core.branch import TYPE_TEXT, TYPE_XML_ELEMENT
+
+    refs = sorted(b.type_ref for b in branches)
+    assert TYPE_TEXT in refs and TYPE_XML_ELEMENT in refs
+    named = [b for b in branches if b.type_ref == TYPE_XML_ELEMENT]
+    assert named and named[0].type_name == "div"
+
+
+def test_weak_type_flags_unsupported():
+    from ytpu.types.shared import TextPrelim
+
+    doc = Doc(client_id=1)
+    t = doc.get_text("src")
+    arr = doc.get_array("links")
+    with doc.transact() as txn:
+        t.insert(txn, 0, "quote me")
+    from ytpu.types.weak import quote_range
+
+    log = []
+    doc.observe_update_v1(lambda p, o, t_: log.append(p))
+    with doc.transact() as txn:
+        q = quote_range(t, txn, 1, 4)
+        arr.insert(txn, 0, q)
+    buf, stream, flags = _decode(log)
+    assert (flags & FLAG_UNSUPPORTED != 0).any(), flags

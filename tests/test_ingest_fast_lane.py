@@ -80,16 +80,21 @@ def test_out_of_order_takes_slow_lane_and_stashes():
 
 @needs_native
 def test_mixed_lanes_one_step():
-    """Doc 0 rides fast; doc 1 (a nested shared type, ContentType) rides
-    slow — same step. (Plain map rows now decode on device.)"""
-    from ytpu.types.shared import MapPrelim
+    """Doc 0 rides fast; doc 1 (a WeakRef branch — host-resolved link
+    source) rides slow — same step. (Plain maps AND nested shared types
+    now decode on device; WeakRef is the remaining host-only type.)"""
+    from ytpu.types.weak import quote_range
 
     log0, expect0 = _edit_log([("i", 0, "fast lane")])
     d = Doc(client_id=7)
+    t1 = d.get_text("src")
+    with d.transact() as txn:
+        t1.insert(txn, 0, "quote me")
     log1 = []
     d.observe_update_v1(lambda p, o, t: log1.append(p))
     with d.transact() as txn:
-        d.get_map("m").insert(txn, "k", MapPrelim({"x": "y"}))
+        q = quote_range(t1, txn, 1, 4)
+        d.get_array("links").insert(txn, 0, q)
     ing = BatchIngestor(n_docs=2, capacity=256)
     ing.apply_bytes([log0[0], log1[0]])
     assert ing.fast_docs == 1 and ing.slow_docs == 1
@@ -431,3 +436,64 @@ def test_b3_style_map_fan_in_zero_host_fallbacks():
     assert ing.slow_docs == 0
     got = get_map(ing.state, 0, ing.payloads, ing.enc.keys)
     assert got == oracle.get_map("map").to_json()
+
+
+def test_nested_types_ride_fast_lane():
+    """ContentType rows (nested shared types) now decode on device: a map
+    tenant holding a nested YText rides the raw-bytes lane end to end —
+    fast_docs counts it, the tree renders, and the diff round-trips
+    (north-star config #4 tenants; VERDICT r2 weak #4)."""
+    from ytpu.core.state_vector import StateVector
+    from ytpu.models.batch_doc import encode_diff_batch, finish_encode_diff
+    from ytpu.types.shared import TextPrelim
+
+    doc = Doc(client_id=1)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    m = doc.get_map("root")
+    with doc.transact() as txn:
+        m.insert(txn, "title", "plain value")
+    with doc.transact() as txn:
+        m.insert(txn, "body", TextPrelim("nested"))
+    nested = m.get("body")
+    with doc.transact() as txn:
+        nested.insert(txn, 6, " text")
+
+    ing = BatchIngestor(1, 128)
+    for p in log:
+        ing.apply_bytes([p])
+    assert ing.fast_docs == len(log), (ing.fast_docs, ing.slow_docs)
+    assert int(np.asarray(ing.state.error).max()) == 0
+
+    from ytpu.models.batch_doc import get_tree
+
+    tree = get_tree(
+        ing.state, 0, ing.payloads, ing.enc.keys, interner=ing.enc.interner
+    )
+    assert tree["map"]["title"] == "plain value"
+    assert tree["map"]["body"] == "nested text"
+
+    # serving: the diff re-applies on a fresh host doc with the nested
+    # type intact (wire ContentType spans re-emitted verbatim)
+    import jax.numpy as jnp
+
+    n_clients = 2
+    remote = np.zeros((1, n_clients), dtype=np.int32)
+    ship, offsets, _loc, deleted = encode_diff_batch(
+        ing.state, jnp.asarray(remote), n_clients
+    )
+    payload = finish_encode_diff(
+        ing.state,
+        0,
+        np.asarray(ship),
+        np.asarray(offsets),
+        np.asarray(deleted),
+        ing.enc,
+        ing.payloads,
+        root_name="root",
+    )
+    d = Doc(client_id=9)
+    d.apply_update_v1(payload)
+    got = d.get_map("root")
+    assert got.get("title") == "plain value"
+    assert got.get("body").get_string() == "nested text"

@@ -83,6 +83,10 @@ class EncoderV1:
     def write_type_ref(self, tag: int) -> None:
         self.w.write_u8(tag)
 
+    def write_raw(self, data: bytes) -> None:
+        """Verbatim wire bytes (re-emission of device-retained spans)."""
+        self.w.write_raw(data)
+
     def write_len(self, length: int) -> None:
         self.w.write_var_uint(length)
 

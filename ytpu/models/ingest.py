@@ -50,10 +50,10 @@ def _sorted_table(mapping: Dict[int, int]):
     )
 
 # content kinds the device decoder handles: GC, Deleted, Json, Binary,
-# String, Embed, Format, Any(scalar), Skip
-_FAST_KINDS = frozenset((0, 1, 2, 3, 4, 5, 6, 8, 10))
+# String, Embed, Format, Type (non-weak), Any(scalar), Skip
+_FAST_KINDS = frozenset((0, 1, 2, 3, 4, 5, 6, 7, 8, 10))
 # kinds whose rows keep content refs into the retained wire bytes
-_WIRE_REF_KINDS = frozenset((2, 3, 4, 5, 6, 8))
+_WIRE_REF_KINDS = frozenset((2, 3, 4, 5, 6, 7, 8))
 _I32_MAX = 2**31 - 1
 
 
@@ -237,6 +237,12 @@ class BatchIngestor:
             kind = int(cols.kind[i])
             if kind not in _FAST_KINDS:
                 return False
+            if kind == 7:
+                # ContentType rides the wire lane except WeakRef branches
+                # (host-resolved link sources) and unknown TypeRef tags
+                span = cols.content_bytes(i)
+                if not span or span[0] >= 7:
+                    return False
             psl = int(cols.parent_sub_len[i])
             if psl > KEY_HASH_BYTES:
                 return False  # key exceeds the device hash window

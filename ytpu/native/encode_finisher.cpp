@@ -37,6 +37,7 @@ constexpr int32_t KIND_BINARY = 3;
 constexpr int32_t KIND_STRING = 4;
 constexpr int32_t KIND_EMBED = 5;
 constexpr int32_t KIND_FORMAT = 6;
+constexpr int32_t KIND_TYPE = 7;
 constexpr int32_t KIND_ANY = 8;
 
 constexpr int32_t STATUS_OK = 0;
@@ -678,6 +679,21 @@ class DocEncoder {
       if (!read_var(p, L, pos, n)) return false;
       if (n > static_cast<uint64_t>(L - pos)) return false;
       pos += static_cast<int64_t>(n);
+      out.raw(p + w, static_cast<size_t>(pos - w));
+      return true;
+    }
+    if (kind == KIND_TYPE) {
+      // device-retained ContentType span: verbatim copy of the TypeRef
+      // tag byte (+ XmlElement/XmlHook name buf) — no re-serialization
+      int64_t pos = w;
+      if (pos >= L) return false;
+      const uint8_t tag = p[pos++];
+      if (tag == 3 || tag == 5) {
+        uint64_t n;
+        if (!read_var(p, L, pos, n)) return false;
+        if (n > static_cast<uint64_t>(L - pos)) return false;
+        pos += static_cast<int64_t>(n);
+      }
       out.raw(p + w, static_cast<size_t>(pos - w));
       return true;
     }

@@ -29,17 +29,19 @@ block.rs:1786-1835, id_set.rs decode):
     content  := GC len:var | Skip len:var | Deleted len:var | String str
                 | Any n:var value{token}* | Json n:var str* | Embed str
                 | Binary buf | Format key:str value:str
-                (ContentType / Doc / Move → host fallback, flagged)
+                | Type tag:u8 [name:str]
+                (WeakRef types / Doc / Move → host fallback, flagged)
     delete_set := n_clients:var ( client:var n_ranges:var (clock:var len:var)* )*
 
 Supported on-device: GC / Skip / Deleted / String / scalar+array Any /
-Json / Embed / Binary / Format blocks with root, ID, or nested parents,
-including map rows — parent_sub keys resolve through a host-verified
-hash table (`key_table`), and client ids beyond i32 (real 53-bit Yjs
-ids) through a varint-byte hash table (`client_hash_table`). The
-remaining host-lane shapes: map-valued Any, oversized keys, ContentType
-/ Doc / Move. Flagged updates lose nothing — they take the exact host
-path they take today.
+Json / Embed / Binary / Format / Type (nested shared types; WeakRef
+branches excluded) blocks with root, ID, or nested parents, including
+map rows — parent_sub keys resolve through a host-verified hash table
+(`key_table`), and client ids beyond i32 (real 53-bit Yjs ids) through a
+varint-byte hash table (`client_hash_table`). The remaining host-lane
+shapes: map-valued Any, oversized keys, WeakRef types, Doc, Move.
+Flagged updates lose nothing — they take the exact host path they take
+today.
 
 Without tables, client ids are kept *raw*: YATA's tie-break is monotone
 in the client id itself, so the rank table for the fused kernel is the
@@ -64,6 +66,7 @@ from ytpu.core.content import (
     CONTENT_FORMAT,
     CONTENT_JSON,
     CONTENT_STRING,
+    CONTENT_TYPE,
 )
 from ytpu.models.batch_doc import UpdateBatch
 
@@ -141,9 +144,11 @@ FLAG_ERRORS = (
     ST_SPAN1,  # ContentEmbed/Binary: one length-prefixed span, len 1
     ST_FMT_KEY,  # ContentFormat: key string
     ST_FMT_VAL,  # ContentFormat: one Any value
+    ST_TYPE_TAG,  # ContentType: branch TypeRef tag byte
+    ST_TYPE_NAME,  # ContentType: XmlElement/XmlHook name string
     ST_DONE,
     ST_ERR,
-) = range(32)
+) = range(34)
 
 # key-hash window: parent_sub keys longer than this take the host lane
 KEY_HASH_BYTES = 32
@@ -399,8 +404,10 @@ def decode_updates_v1(
         ovf = (nbytes > 5) | ((nbytes == 5) & ((bytes10[:, 4] & 0x7F) >= 8))
 
         is_info = st == ST_INFO
-        v = jnp.where(is_info, bytes10[:, 0], val)
-        consumed = jnp.where(is_info, 1, nbytes)
+        # the TypeRef tag is a raw u8 (EncoderV1.write_type_ref), like info
+        is_u8 = is_info | (st == ST_TYPE_TAG)
+        v = jnp.where(is_u8, bytes10[:, 0], val)
+        consumed = jnp.where(is_u8, 1, nbytes)
 
         # string states consume the payload bytes too
         is_str_skip = (
@@ -410,6 +417,7 @@ def decode_updates_v1(
             | (st == ST_FMT_KEY)
             | (st == ST_FMT_VAL)  # format values are JSON strings on wire
             | (st == ST_SPAN1)
+            | (st == ST_TYPE_NAME)  # XmlElement/XmlHook branch name
         )
         is_str = st == ST_STR
         str_start = pos + nbytes
@@ -513,7 +521,7 @@ def decode_updates_v1(
             # under the pos_after bound; no real payload exceeds its buffer
             | ((is_str_skip | is_str) & (v > L))
             | (is_any_val & ((tag == 119) | (tag == 116)) & (val2 > L))
-            | (ovf & ~is_info & ~is_client_st & ~is_any_val)
+            | (ovf & ~is_u8 & ~is_client_st & ~is_any_val)
             | ((st == ST_NCLIENTS) & (v > max_sec))  # absurd header: garbage
         )
         act = active & ~bad
@@ -537,6 +545,10 @@ def decode_updates_v1(
         # finish immediately and emit nothing)
         empty_list = (on(ST_ANY_COUNT) | on(ST_JSON_COUNT)) & (v == 0)
         list_done = (on(ST_ANY_VAL) | on(ST_JSON_VAL)) & (vals_left2 == 0)
+        # TypeRef tags 3/5 (XmlElement/XmlHook) carry a name string; 7
+        # (WeakRef: host-resolved link source) and unknown tags flag
+        type_named = on(ST_TYPE_TAG) & ((v == 3) | (v == 5))
+        type_done = (on(ST_TYPE_TAG) & ~type_named) | on(ST_TYPE_NAME)
         emit_row_st = (
             on(ST_DEL_LEN)
             | on(ST_GC_LEN)
@@ -545,6 +557,7 @@ def decode_updates_v1(
             | list_done
             | on(ST_SPAN1)
             | on(ST_FMT_VAL)
+            | type_done
         )
         str_len16 = u16_span(str_start, str_start + v)
         is_list_done = list_done
@@ -554,7 +567,9 @@ def decode_updates_v1(
             jnp.where(
                 is_list_done,
                 regs["vals_n"],
-                jnp.where(on(ST_SPAN1) | on(ST_FMT_VAL), 1, v),
+                jnp.where(
+                    on(ST_SPAN1) | on(ST_FMT_VAL) | type_done, 1, v
+                ),
             ),
         )
         block_end = emit_row_st | empty_list
@@ -605,7 +620,11 @@ def decode_updates_v1(
                             (kind4 == CONTENT_EMBED) | (kind4 == CONTENT_BINARY),
                             ST_SPAN1,
                             jnp.where(
-                                kind4 == CONTENT_FORMAT, ST_FMT_KEY, ST_ERR
+                                kind4 == CONTENT_FORMAT,
+                                ST_FMT_KEY,
+                                jnp.where(
+                                    kind4 == CONTENT_TYPE, ST_TYPE_TAG, ST_ERR
+                                ),
                             ),
                         ),
                     ),
@@ -661,6 +680,7 @@ def decode_updates_v1(
         st2 = upd(st2, on(ST_ANY_COUNT) & (v > 0), ST_ANY_VAL)
         st2 = upd(st2, on(ST_JSON_COUNT) & (v > 0), ST_JSON_VAL)
         st2 = upd(st2, on(ST_FMT_KEY), ST_FMT_VAL)
+        st2 = upd(st2, type_named, ST_TYPE_NAME)
         st2 = upd(st2, block_end, after_block)
         st2 = upd(st2, on(ST_DS_NCLIENTS), jnp.where(v > 0, ST_DS_CLIENT, ST_DONE))
         st2 = upd(st2, on(ST_DS_CLIENT), ST_DS_NRANGES)
@@ -684,6 +704,10 @@ def decode_updates_v1(
             | (on(ST_PARENT_SUB) & content_unsupported)
             | (act & key_too_long)  # key exceeds the hash window
             | (act & any_bad_tag)  # recursive/unknown Any value
+            # WeakRef branches (host-resolved link sources), Doc subtrees
+            # and unknown TypeRef tags (valid device set: 0-6) stay on the
+            # host lane
+            | (on(ST_TYPE_TAG) & ((v == 7) | (v >= 8)))
         )
         # item with neither origin flag whose dispatch happens after parent
         st2 = upd(st2, unsupported, ST_ERR)
@@ -705,7 +729,7 @@ def decode_updates_v1(
         regs2["vals_n"] = upd(regs["vals_n"], count_st, v)
         regs2["vals_left"] = upd(vals_left2, count_st, v)
         regs2["cref"] = upd(
-            regs["cref"], count_st | on(ST_FMT_KEY), pos
+            regs["cref"], count_st | on(ST_FMT_KEY) | on(ST_TYPE_TAG), pos
         )
         regs2["info"] = upd(regs["info"], on(ST_INFO), v)
         # reset per-item registers when a new info byte arrives
@@ -751,9 +775,13 @@ def decode_updates_v1(
             is_str,
             row_ids * L + str_start,
             jnp.where(
-                is_list_done | on(ST_FMT_VAL),
+                is_list_done | on(ST_FMT_VAL) | on(ST_TYPE_NAME),
                 row_ids * L + regs["cref"],
-                jnp.where(on(ST_SPAN1), row_ids * L + pos, -1),
+                jnp.where(
+                    on(ST_SPAN1) | on(ST_TYPE_TAG),
+                    row_ids * L + pos,
+                    -1,
+                ),
             ),
         )
         put_row("client", regs["client"])
@@ -1054,6 +1082,33 @@ def _wire_format_kv(flat: np.ndarray, start: int):
     return key, any_from_json(cur.read_string())
 
 
+def _wire_type_branch(flat: np.ndarray, start: int):
+    """ContentType at wire offset `start`: TypeRef tag byte (+ name for
+    XmlElement/XmlHook) → a Branch carrying just the rendering-relevant
+    fields (branch.rs decode_type_ref; WeakRef never reaches here — the
+    decoder flags it to the host lane)."""
+    from ytpu.core.branch import Branch
+    from ytpu.encoding.lib0 import Cursor
+
+    cur = Cursor(bytes(flat[start:]))
+    tag = cur.read_u8()
+    if tag in (3, 5):  # TYPE_XML_ELEMENT / TYPE_XML_HOOK
+        return Branch(tag, type_name=cur.read_string())
+    return Branch(tag)
+
+
+def _wire_type_raw(flat: np.ndarray, start: int) -> bytes:
+    """The exact wire bytes of a ContentType payload (for re-emission by
+    the encode finisher)."""
+    from ytpu.encoding.lib0 import Cursor
+
+    cur = Cursor(bytes(flat[start:]))
+    tag = cur.read_u8()
+    if tag in (3, 5):
+        cur.read_buf()  # name
+    return bytes(flat[start : start + cur.pos])
+
+
 class RawPayloadView:
     """PayloadStore-shaped reader over the raw wire-byte matrix.
 
@@ -1087,6 +1142,12 @@ class RawPayloadView:
 
     def format_kv(self, ref: int):
         return _wire_format_kv(self.buf, int(ref))
+
+    def type_branch(self, ref: int):
+        return _wire_type_branch(self.buf, int(ref))
+
+    def type_raw(self, ref: int) -> bytes:
+        return _wire_type_raw(self.buf, int(ref))
 
 
 class ChunkedWirePayloads:
@@ -1177,3 +1238,13 @@ class ChunkedWirePayloads:
             return self.store.format_kv(ref)
         flat, start = self._locate(ref)
         return _wire_format_kv(flat, start)
+
+    def type_branch(self, ref: int):
+        if int(ref) >= 0:
+            return self.store.items[int(ref)][1].branch
+        flat, start = self._locate(ref)
+        return _wire_type_branch(flat, start)
+
+    def type_raw(self, ref: int) -> bytes:
+        flat, start = self._locate(ref)
+        return _wire_type_raw(flat, start)
