@@ -406,6 +406,31 @@ def _device_phase_child(in_path: str, out_path: str) -> None:
     # everything flushed before that survives (observed round 3).
     _device_configs(result, flush)
     try:
+        # B1-B3 device lanes (benches/micro.py; VERDICT r2 weak #9)
+        import random as _random
+
+        import importlib.util as _ilu
+
+        _mp = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benches", "micro.py"
+        )
+        _spec = _ilu.spec_from_file_location("ytpu_bench_micro", _mp)
+        _micro = _ilu.module_from_spec(_spec)
+        _spec.loader.exec_module(_micro)
+        md = result.setdefault("micro_device", {})
+        for key, fn in (
+            ("b1_text", _micro.device_b1_text),
+            ("b2_concurrent", _micro.device_b2_concurrent),
+            ("b3_fanin", _micro.device_b3_fanin),
+        ):
+            md[key] = fn(400, _random.Random(42), d_docs=512)
+            flush()
+    except Exception as e:
+        result.setdefault("micro_device", {})["error"] = (
+            f"{type(e).__name__}: {e}"[:300]
+        )
+    flush()
+    try:
         xla = device_replay_full(job["log"], job["expect"], lane="xla")
         result.update({f"xla_{k}": v for k, v in xla.items()})
     except Exception as e:

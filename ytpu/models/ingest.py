@@ -57,6 +57,19 @@ _WIRE_REF_KINDS = frozenset((2, 3, 4, 5, 6, 7, 8))
 _I32_MAX = 2**31 - 1
 
 
+def _bucket(n: int, lo: int = 4) -> int:
+    """Round a jit-static dimension up to a power of two (floor `lo`).
+
+    Serving streams vary per step (payload length, row/delete counts,
+    decode budget); compiling the decode/integrate programs for the exact
+    per-step shape retraces almost every step. Bucketing caps the set of
+    compiled programs at a handful per dimension."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
 class BatchIngestor:
     def __init__(
         self,
@@ -419,8 +432,8 @@ class BatchIngestor:
             rows, dels = self._plan_doc(d, u)
             all_rows.append(rows)
             all_dels.append(dels)
-        n_rows = max(max_fast_rows, 1, max(len(r) for r in all_rows))
-        n_dels = max(max_fast_dels, 1, max(len(d_) for d_ in all_dels))
+        n_rows = _bucket(max(max_fast_rows, 1, max(len(r) for r in all_rows)))
+        n_dels = _bucket(max(max_fast_dels, 1, max(len(d_) for d_ in all_dels)))
         batch = self.enc.batch_from_rows(all_rows, all_dels, n_rows, n_dels)
 
         flags = None
@@ -431,8 +444,8 @@ class BatchIngestor:
             batch, flags, chunk_base = self._merge_fast_lane(
                 batch, fast_idx, fast_payloads, n_rows, n_dels,
                 retain_lanes=fast_has_str,
-                n_steps=max_steps or None,
-                max_sections=max_sections or None,
+                n_steps=16 * ((max_steps + 15) // 16) or None,
+                max_sections=_bucket(max_sections, 2) if max_sections else None,
             )
         self.state = apply_update_batch(
             self.state, batch, self.enc.interner.rank_table()
@@ -502,7 +515,8 @@ class BatchIngestor:
             pack_updates,
         )
 
-        buf, lens = pack_updates(fast_payloads)
+        maxlen = max(len(p) for p in fast_payloads)
+        buf, lens = pack_updates(fast_payloads, pad_to=_bucket(maxlen + 16, 64))
         S, L = buf.shape
         # Retain only the wire bytes of lanes that emitted string rows
         # (lens-trimmed, concatenated) — refs are rebased from the padded
