@@ -413,3 +413,88 @@ def test_weak_type_flags_unsupported():
         arr.insert(txn, 0, q)
     buf, stream, flags = _decode(log)
     assert (flags & FLAG_UNSUPPORTED != 0).any(), flags
+
+
+def test_content_move_rows_decode():
+    """ContentMove rows (array.move_to) decode on device with full range
+    fields — bounds, assocs, priority (moving.rs:189-215 wire layout)."""
+    from ytpu.core.content import CONTENT_MOVE
+
+    doc = Doc(client_id=1)
+    arr = doc.get_array("a")
+    with doc.transact() as txn:
+        for i in range(5):
+            arr.push_back(txn, i)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    with doc.transact() as txn:
+        arr.move_to(txn, 0, 4)
+    with doc.transact() as txn:
+        arr.move_range_to(txn, 1, 2, 0)
+
+    buf, stream, flags = _decode(log, U=4)
+    assert (flags & FLAG_ERRORS == 0).all(), flags
+    st = {k: np.asarray(v) for k, v in stream._asdict().items()}
+    from ytpu.core import Update as _U
+
+    for s, payload in enumerate(log):
+        up = _U.decode_v1(payload)
+        want = []
+        for client, blocks in sorted(up.blocks.items()):
+            for blk in blocks:
+                mv = blk.content.move
+                want.append(
+                    (
+                        mv.start.id.client,
+                        mv.start.id.clock,
+                        mv.start.assoc,
+                        mv.end.id.client,
+                        mv.end.id.clock,
+                        mv.end.assoc,
+                        max(mv.priority, 0),
+                    )
+                )
+        got = [
+            (
+                int(st["mv_sc"][s, u]),
+                int(st["mv_sk"][s, u]),
+                int(st["mv_sa"][s, u]),
+                int(st["mv_ec"][s, u]),
+                int(st["mv_ek"][s, u]),
+                int(st["mv_ea"][s, u]),
+                int(st["mv_prio"][s, u]),
+            )
+            for u in range(st["valid"].shape[1])
+            if st["valid"][s, u] and st["kind"][s, u] == CONTENT_MOVE
+        ]
+        assert got == want, (s, got, want)
+
+
+def test_move_stream_rides_fast_lane_end_to_end():
+    """An array move stream through BatchIngestor.apply_bytes: device
+    decode + XLA integrate + claim recompute render the host-identical
+    order."""
+    from ytpu.models.ingest import BatchIngestor
+    from ytpu.models.batch_doc import get_tree
+
+    doc = Doc(client_id=1)
+    arr = doc.get_array("a")
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    with doc.transact() as txn:
+        for i in range(6):
+            arr.push_back(txn, i)
+    with doc.transact() as txn:
+        arr.move_to(txn, 0, 5)
+    with doc.transact() as txn:
+        arr.move_range_to(txn, 1, 2, 0)
+
+    ing = BatchIngestor(1, 256)
+    for p in log:
+        ing.apply_bytes([p])
+    assert ing.fast_docs == len(log), (ing.fast_docs, ing.slow_docs)
+    assert int(np.asarray(ing.state.error).max()) == 0
+    tree = get_tree(
+        ing.state, 0, ing.payloads, ing.enc.keys, interner=ing.enc.interner
+    )
+    assert tree["seq"] == arr.to_json()

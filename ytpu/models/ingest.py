@@ -50,8 +50,8 @@ def _sorted_table(mapping: Dict[int, int]):
     )
 
 # content kinds the device decoder handles: GC, Deleted, Json, Binary,
-# String, Embed, Format, Type (non-weak), Any(scalar), Skip
-_FAST_KINDS = frozenset((0, 1, 2, 3, 4, 5, 6, 7, 8, 10))
+# String, Embed, Format, Type (non-weak), Any(scalar), Skip, Move
+_FAST_KINDS = frozenset((0, 1, 2, 3, 4, 5, 6, 7, 8, 10, 11))
 # kinds whose rows keep content refs into the retained wire bytes
 _WIRE_REF_KINDS = frozenset((2, 3, 4, 5, 6, 7, 8))
 _I32_MAX = 2**31 - 1
@@ -256,6 +256,25 @@ class BatchIngestor:
                 span = cols.content_bytes(i)
                 if not span or span[0] >= 7:
                     return False
+            if kind == 11:
+                # ContentMove: the range-bound ids must already be covered
+                # (the claim walk resolves them by id; an unresolved bound
+                # sets ERR_MISSING_DEP and poisons the step)
+                from ytpu.encoding.lib0 import Cursor, EncodingError
+
+                cur = Cursor(bytes(cols.content_bytes(i)))
+                try:
+                    flags = cur.read_var_uint()
+                    bounds = [(cur.read_var_uint(), cur.read_var_uint())]
+                    if not flags & 1:
+                        bounds.append(
+                            (cur.read_var_uint(), cur.read_var_uint())
+                        )
+                except EncodingError:
+                    return False  # truncated span: host lane decides
+                for bc, bk in bounds:
+                    if not self._client_ok(bc) or bk >= cov(bc):
+                        return False
             psl = int(cols.parent_sub_len[i])
             if psl > KEY_HASH_BYTES:
                 return False  # key exceeds the device hash window

@@ -30,18 +30,19 @@ block.rs:1786-1835, id_set.rs decode):
                 | Any n:var value{token}* | Json n:var str* | Embed str
                 | Binary buf | Format key:str value:str
                 | Type tag:u8 [name:str]
-                (WeakRef types / Doc / Move → host fallback, flagged)
+                | Move flags:var start:id [end:id]
+                (WeakRef types / Doc → host fallback, flagged)
     delete_set := n_clients:var ( client:var n_ranges:var (clock:var len:var)* )*
 
 Supported on-device: GC / Skip / Deleted / String / scalar+array Any /
 Json / Embed / Binary / Format / Type (nested shared types; WeakRef
-branches excluded) blocks with root, ID, or nested parents, including
-map rows — parent_sub keys resolve through a host-verified hash table
-(`key_table`), and client ids beyond i32 (real 53-bit Yjs ids) through a
-varint-byte hash table (`client_hash_table`). The remaining host-lane
-shapes: map-valued Any, oversized keys, WeakRef types, Doc, Move.
-Flagged updates lose nothing — they take the exact host path they take
-today.
+branches excluded) / Move blocks with root, ID, or nested parents,
+including map rows — parent_sub keys resolve through a host-verified
+hash table (`key_table`), and client ids beyond i32 (real 53-bit Yjs
+ids) through a varint-byte hash table (`client_hash_table`). The
+remaining host-lane shapes: map-valued Any, oversized keys, WeakRef
+types, Doc. Flagged updates lose nothing — they take the exact host
+path they take today.
 
 Without tables, client ids are kept *raw*: YATA's tie-break is monotone
 in the client id itself, so the rank table for the fused kernel is the
@@ -65,6 +66,7 @@ from ytpu.core.content import (
     CONTENT_EMBED,
     CONTENT_FORMAT,
     CONTENT_JSON,
+    CONTENT_MOVE,
     CONTENT_STRING,
     CONTENT_TYPE,
 )
@@ -146,9 +148,14 @@ FLAG_ERRORS = (
     ST_FMT_VAL,  # ContentFormat: one Any value
     ST_TYPE_TAG,  # ContentType: branch TypeRef tag byte
     ST_TYPE_NAME,  # ContentType: XmlElement/XmlHook name string
+    ST_MV_FLAGS,  # ContentMove: collapsed/assoc/priority flags varint
+    ST_MV_SC,  # ContentMove: range-start id client
+    ST_MV_SK,  # ContentMove: range-start id clock
+    ST_MV_EC,  # ContentMove: range-end id client (absent if collapsed)
+    ST_MV_EK,  # ContentMove: range-end id clock
     ST_DONE,
     ST_ERR,
-) = range(34)
+) = range(39)
 
 # key-hash window: parent_sub keys longer than this take the host lane
 KEY_HASH_BYTES = 32
@@ -352,6 +359,10 @@ def decode_updates_v1(
             vals_left=jnp.zeros((S,), I32),  # Any/Json values remaining
             vals_n=jnp.zeros((S,), I32),  # total value count (clock len)
             cref=jnp.full((S,), -1, I32),  # content span start byte
+            mvf=jnp.zeros((S,), I32),  # ContentMove flags
+            msc=jnp.full((S,), -1, I32),
+            msk=jnp.zeros((S,), I32),
+            mec=jnp.full((S,), -1, I32),
         )
         rows = dict(
             client=jnp.zeros((S, U), I32),
@@ -367,6 +378,13 @@ def decode_updates_v1(
             pc=jnp.full((S, U), -1, I32),
             pk=jnp.zeros((S, U), I32),
             keyh=jnp.full((S, U), -1, I32),
+            msc=jnp.full((S, U), -1, I32),
+            msk=jnp.zeros((S, U), I32),
+            msa=jnp.zeros((S, U), I32),
+            mec=jnp.full((S, U), -1, I32),
+            mek=jnp.zeros((S, U), I32),
+            mea=jnp.zeros((S, U), I32),
+            mprio=jnp.full((S, U), -1, I32),
             valid=jnp.zeros((S, U), bool),
         )
         dels = dict(
@@ -496,6 +514,7 @@ def decode_updates_v1(
         is_client_st = (
             (st == ST_CLIENT) | (st == ST_ORIGIN_C) | (st == ST_ROR_C)
             | (st == ST_PARENT_ID_C) | (st == ST_DS_CLIENT)
+            | (st == ST_MV_SC) | (st == ST_MV_EC)
         )
         # client ids beyond i32 (ovf at a client state) are represented by
         # a hash of their varint bytes, encoded as -2 - hash (< -1); the
@@ -549,6 +568,9 @@ def decode_updates_v1(
         # (WeakRef: host-resolved link source) and unknown tags flag
         type_named = on(ST_TYPE_TAG) & ((v == 3) | (v == 5))
         type_done = (on(ST_TYPE_TAG) & ~type_named) | on(ST_TYPE_NAME)
+        # a collapsed move (flags bit 0) ends at its start clock
+        mv_collapsed = (regs["mvf"] & 1) != 0
+        move_done = (on(ST_MV_SK) & mv_collapsed) | on(ST_MV_EK)
         emit_row_st = (
             on(ST_DEL_LEN)
             | on(ST_GC_LEN)
@@ -558,6 +580,7 @@ def decode_updates_v1(
             | on(ST_SPAN1)
             | on(ST_FMT_VAL)
             | type_done
+            | move_done
         )
         str_len16 = u16_span(str_start, str_start + v)
         is_list_done = list_done
@@ -568,7 +591,9 @@ def decode_updates_v1(
                 is_list_done,
                 regs["vals_n"],
                 jnp.where(
-                    on(ST_SPAN1) | on(ST_FMT_VAL) | type_done, 1, v
+                    on(ST_SPAN1) | on(ST_FMT_VAL) | type_done | move_done,
+                    1,
+                    v,
                 ),
             ),
         )
@@ -623,7 +648,13 @@ def decode_updates_v1(
                                 kind4 == CONTENT_FORMAT,
                                 ST_FMT_KEY,
                                 jnp.where(
-                                    kind4 == CONTENT_TYPE, ST_TYPE_TAG, ST_ERR
+                                    kind4 == CONTENT_TYPE,
+                                    ST_TYPE_TAG,
+                                    jnp.where(
+                                        kind4 == CONTENT_MOVE,
+                                        ST_MV_FLAGS,
+                                        ST_ERR,
+                                    ),
                                 ),
                             ),
                         ),
@@ -681,6 +712,10 @@ def decode_updates_v1(
         st2 = upd(st2, on(ST_JSON_COUNT) & (v > 0), ST_JSON_VAL)
         st2 = upd(st2, on(ST_FMT_KEY), ST_FMT_VAL)
         st2 = upd(st2, type_named, ST_TYPE_NAME)
+        st2 = upd(st2, on(ST_MV_FLAGS), ST_MV_SC)
+        st2 = upd(st2, on(ST_MV_SC), ST_MV_SK)
+        st2 = upd(st2, on(ST_MV_SK) & ~mv_collapsed, ST_MV_EC)
+        st2 = upd(st2, on(ST_MV_EC), ST_MV_EK)
         st2 = upd(st2, block_end, after_block)
         st2 = upd(st2, on(ST_DS_NCLIENTS), jnp.where(v > 0, ST_DS_CLIENT, ST_DONE))
         st2 = upd(st2, on(ST_DS_CLIENT), ST_DS_NRANGES)
@@ -746,6 +781,10 @@ def decode_updates_v1(
         regs2["ds_ranges_left"] = upd(ds_ranges_left2, on(ST_DS_NRANGES), v)
         regs2["ds_client"] = upd(regs["ds_client"], on(ST_DS_CLIENT), vc)
         regs2["ds_clock"] = upd(regs["ds_clock"], on(ST_DS_CLOCK), v)
+        regs2["mvf"] = upd(regs["mvf"], on(ST_MV_FLAGS), v)
+        regs2["msc"] = upd(regs["msc"], on(ST_MV_SC), vc)
+        regs2["msk"] = upd(regs["msk"], on(ST_MV_SK), v)
+        regs2["mec"] = upd(regs["mec"], on(ST_MV_EC), vc)
 
         flags2 = (
             regs["flags"]
@@ -797,6 +836,26 @@ def decode_updates_v1(
         put_row("pc", jnp.where(is_gc_row, -1, regs["pc"]))
         put_row("pk", jnp.where(is_gc_row, 0, regs["pk"]))
         put_row("keyh", jnp.where(is_gc_row, -1, regs["keyh"]))
+        # ContentMove range fields (moving.rs:189-215 flag layout): assoc
+        # columns use the engine convention 0 = After, -1 = Before; a
+        # collapsed move's end is its start; end clock is the CURRENT
+        # varint at ST_MV_EK (registers update after emission)
+        is_move_emit = move_done
+        mvf = regs["mvf"]
+        msa = jnp.where((mvf & 2) != 0, 0, -1)
+        mea = jnp.where((mvf & 4) != 0, 0, -1)
+        # the CURRENT varint is the start clock when emitting collapsed at
+        # ST_MV_SK, and the end clock at ST_MV_EK (registers update after
+        # emission); the end id of a collapsed move is its start id
+        msk_cur = jnp.where(on(ST_MV_SK), v, regs["msk"])
+        mv_end_c = jnp.where(mv_collapsed, regs["msc"], regs["mec"])
+        put_row("msc", jnp.where(is_move_emit, regs["msc"], -1))
+        put_row("msk", jnp.where(is_move_emit, msk_cur, 0))
+        put_row("msa", jnp.where(is_move_emit, msa, 0))
+        put_row("mec", jnp.where(is_move_emit, mv_end_c, -1))
+        put_row("mek", jnp.where(is_move_emit, v, 0))
+        put_row("mea", jnp.where(is_move_emit, mea, 0))
+        put_row("mprio", jnp.where(is_move_emit, mvf >> 6, -1))
         rows["valid"] = rows["valid"] | oh
         regs2["n_rows"] = regs["n_rows"] + emit.astype(I32)
 
@@ -844,7 +903,11 @@ def _resolve_and_pack(
                 ("oc", rows["valid"]),
                 ("rc", rows["valid"]),
                 ("pc", rows["valid"]),
+                ("msc", rows["valid"]),
+                ("mec", rows["valid"]),
             ):
+                if name not in rows:
+                    continue
                 raw_used = raw_used | jnp.any(used & (rows[name] >= 0), axis=1)
             raw_used = raw_used | jnp.any(
                 dels["valid"] & (dels["client"] >= 0), axis=1
@@ -869,7 +932,11 @@ def _resolve_and_pack(
             ("oc", rows["valid"]),
             ("rc", rows["valid"]),
             ("pc", rows["valid"]),
+            ("msc", rows["valid"]),
+            ("mec", rows["valid"]),
         ):
+            if name not in rows:
+                continue
             rows[name], u = map_ids(rows[name], used)
             unk = unk | u
         dels["client"], u = map_ids(dels["client"], dels["valid"])
@@ -902,7 +969,11 @@ def _resolve_and_pack(
         ("oc", rows["valid"]),
         ("rc", rows["valid"]),
         ("pc", rows["valid"]),
+        ("msc", rows["valid"]),
+        ("mec", rows["valid"]),
     ):
+        if name not in rows:
+            continue
         rows[name], b, m = map_hashed(rows[name], used)
         bigf = bigf | b
         unkh = unkh | m
@@ -952,13 +1023,13 @@ def _resolve_and_pack(
         p_tag=rows["ptag"],
         p_client=rows["pc"],
         p_clock=rows["pk"],
-        mv_sc=neg_u,
-        mv_sk=z_u,
-        mv_sa=z_u,
-        mv_ec=neg_u,
-        mv_ek=z_u,
-        mv_ea=z_u,
-        mv_prio=neg_u,
+        mv_sc=rows.get("msc", neg_u),
+        mv_sk=rows.get("msk", z_u),
+        mv_sa=rows.get("msa", z_u),
+        mv_ec=rows.get("mec", neg_u),
+        mv_ek=rows.get("mek", z_u),
+        mv_ea=rows.get("mea", z_u),
+        mv_prio=rows.get("mprio", neg_u),
         valid=valid,
         del_client=dels["client"],
         del_start=dels["start"],
