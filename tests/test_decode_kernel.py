@@ -498,3 +498,47 @@ def test_move_stream_rides_fast_lane_end_to_end():
         ing.state, 0, ing.payloads, ing.enc.keys, interner=ing.enc.interner
     )
     assert tree["seq"] == arr.to_json()
+
+
+def test_flat_map_any_values_decode_clean():
+    """Depth-1 object values ({str: scalar}) decode on device: header +
+    per-key + per-scalar-value steps; content refs re-parse on host via
+    read_any."""
+    doc = Doc(client_id=5)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    arr = doc.get_array("a")
+    with doc.transact() as txn:
+        arr.insert(txn, 0, {"name": "zed", "age": 7, "tall": True})
+    with doc.transact() as txn:
+        arr.insert(txn, 1, [1, {"k": None}, "s"])
+    buf, stream, flags = _decode(log, U=4, R=4)
+    assert (flags & FLAG_ERRORS == 0).all(), flags
+    view = RawPayloadView(buf)
+    st = {k: np.asarray(v) for k, v in stream._asdict().items()}
+    vals0 = view.slice_values(int(st["content_ref"][0, 0]), 0, 1)
+    assert vals0 == [{"name": "zed", "age": 7, "tall": True}]
+    # a python list inserts as ONE nested Any value (array token whose
+    # children include a depth-1 object)
+    vals1 = view.slice_values(int(st["content_ref"][1, 0]), 0, 1)
+    assert vals1 == [[1, {"k": None}, "s"]]
+
+
+def test_map_tenant_object_values_ride_fast_lane():
+    from ytpu.models.batch_doc import get_tree
+    from ytpu.models.ingest import BatchIngestor
+
+    doc = Doc(client_id=1)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    m = doc.get_map("root")
+    with doc.transact() as txn:
+        m.insert(txn, "config", {"theme": "dark", "size": 14})
+    ing = BatchIngestor(1, 128)
+    for p in log:
+        ing.apply_bytes([p])
+    assert ing.fast_docs == len(log), (ing.fast_docs, ing.slow_docs)
+    tree = get_tree(
+        ing.state, 0, ing.payloads, ing.enc.keys, interner=ing.enc.interner
+    )
+    assert tree["map"]["config"] == {"theme": "dark", "size": 14}

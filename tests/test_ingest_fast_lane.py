@@ -123,7 +123,7 @@ def test_map_rows_ride_fast_lane():
     with d.transact() as txn:
         m.insert(txn, "flags", [True, None, 2.5])  # array value: tokenized
     with d.transact() as txn:
-        m.insert(txn, "obj", {"k": 1})  # map value: host lane
+        m.insert(txn, "obj", {"k": [1]})  # nested-in-object: host lane
     with d.transact() as txn:
         m.remove(txn, "age")
     ing = BatchIngestor(n_docs=1, capacity=256)
@@ -138,7 +138,7 @@ def test_map_rows_ride_fast_lane():
         "name": "bob",
         "score": 2.5,
         "flags": [True, None, 2.5],
-        "obj": {"k": 1},
+        "obj": {"k": [1]},
     }
 
 

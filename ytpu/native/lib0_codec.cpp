@@ -151,8 +151,26 @@ struct Cursor {
   void skip_any_tokens(int64_t* tokens, int64_t* complex_vals) {
     if (pos < len) {
       uint8_t tag = buf[pos];
-      if (tag == 118 || tag < 116) {
+      if (tag < 116) {
         (*complex_vals)++;
+      } else if (tag == 118) {
+        // depth-1 object: header token + one token per key + one per
+        // scalar value; nested arrays/objects inside stay host-lane
+        pos++;  // tag
+        uint64_t n = var_uint();
+        (*tokens)++;
+        for (uint64_t i = 0; i < n && !error; i++) {
+          uint64_t klen = var_uint();
+          skip((size_t)klen);
+          (*tokens)++;
+          if (pos < len) {
+            uint8_t vt = buf[pos];
+            if (vt == 117 || vt == 118 || vt < 116) (*complex_vals)++;
+          }
+          (*tokens)++;
+          skip_any();
+        }
+        return;
       } else if (tag == 117) {
         // array header consumes one token; children count themselves
         size_t save = pos;
@@ -289,8 +307,9 @@ int64_t read_content(Cursor& c, uint8_t info, Columns& out) {
       uint64_t n = c.var_uint();
       int64_t tokens = 0;
       for (uint64_t i = 0; i < n && !c.error; i++) {
-        // one device step per scalar/array-header token; map values and
-        // unknown tags exceed the device model (complex -> host lane)
+        // one device step per scalar/array-header/object-header/key
+        // token; non-scalar values INSIDE an object and unknown tags
+        // exceed the device model (complex -> host lane)
         c.skip_any_tokens(&tokens, &out.n_complex_any);
       }
       crdt_len = (int64_t)n;

@@ -34,15 +34,16 @@ block.rs:1786-1835, id_set.rs decode):
                 (WeakRef types / Doc → host fallback, flagged)
     delete_set := n_clients:var ( client:var n_ranges:var (clock:var len:var)* )*
 
-Supported on-device: GC / Skip / Deleted / String / scalar+array Any /
-Json / Embed / Binary / Format / Type (nested shared types; WeakRef
-branches excluded) / Move blocks with root, ID, or nested parents,
-including map rows — parent_sub keys resolve through a host-verified
-hash table (`key_table`), and client ids beyond i32 (real 53-bit Yjs
-ids) through a varint-byte hash table (`client_hash_table`). The
-remaining host-lane shapes: map-valued Any, oversized keys, WeakRef
-types, Doc. Flagged updates lose nothing — they take the exact host
-path they take today.
+Supported on-device: GC / Skip / Deleted / String / Any (scalars,
+arrays, depth-1 objects) / Json / Embed / Binary / Format / Type
+(nested shared types; WeakRef branches excluded) / Move blocks with
+root, ID, or nested parents, including map rows — parent_sub keys
+resolve through a host-verified hash table (`key_table`), and client
+ids beyond i32 (real 53-bit Yjs ids) through a varint-byte hash table
+(`client_hash_table`). The remaining host-lane shapes: non-scalar
+values nested inside object Any values, oversized keys, WeakRef types,
+Doc. Flagged updates lose nothing — they take the exact host path they
+take today.
 
 Without tables, client ids are kept *raw*: YATA's tie-break is monotone
 in the client id itself, so the rank table for the fused kernel is the
@@ -153,9 +154,11 @@ FLAG_ERRORS = (
     ST_MV_SK,  # ContentMove: range-start id clock
     ST_MV_EC,  # ContentMove: range-end id client (absent if collapsed)
     ST_MV_EK,  # ContentMove: range-end id clock
+    ST_ANY_MKEY,  # ContentAny map value: one key string per step
+    ST_ANY_MVAL,  # ContentAny map value: one scalar value per step
     ST_DONE,
     ST_ERR,
-) = range(39)
+) = range(41)
 
 # key-hash window: parent_sub keys longer than this take the host lane
 KEY_HASH_BYTES = 32
@@ -359,6 +362,7 @@ def decode_updates_v1(
             vals_left=jnp.zeros((S,), I32),  # Any/Json values remaining
             vals_n=jnp.zeros((S,), I32),  # total value count (clock len)
             cref=jnp.full((S,), -1, I32),  # content span start byte
+            mpairs=jnp.zeros((S,), I32),  # depth-1 object pairs remaining
             mvf=jnp.zeros((S,), I32),  # ContentMove flags
             msc=jnp.full((S,), -1, I32),
             msk=jnp.zeros((S,), I32),
@@ -436,6 +440,7 @@ def decode_updates_v1(
             | (st == ST_FMT_VAL)  # format values are JSON strings on wire
             | (st == ST_SPAN1)
             | (st == ST_TYPE_NAME)  # XmlElement/XmlHook branch name
+            | (st == ST_ANY_MKEY)  # map-value keys: plain strings, no tag
         )
         is_str = st == ST_STR
         str_start = pos + nbytes
@@ -445,6 +450,7 @@ def decode_updates_v1(
         # pos, then a tag-dependent payload. A second varint extraction
         # over the window shifted by one covers int/string/buffer tags.
         is_any_val = st == ST_ANY_VAL
+        is_any_mval = st == ST_ANY_MVAL
         tag = bytes10[:, 0]
         cont2 = bytes10[:, 1:] >= 0x80
         inb2 = jnp.concatenate(
@@ -475,16 +481,24 @@ def decode_updates_v1(
                         jnp.where(
                             (tag == 119) | (tag == 116),  # string / buffer
                             nb2 + val2,
-                            jnp.where(tag == 117, nb2, 0),  # array header
+                            jnp.where(
+                                # array / depth-1 object header: tag + count
+                                (tag == 117) | (tag == 118),
+                                nb2,
+                                0,
+                            ),
                         ),
                     ),
                 ),
             ),
         )
-        # map values / unknown tags fall back to the host lane (arrays are
-        # handled as header tokens: children enqueue on the value counter)
-        any_bad_tag = is_any_val & ((tag == 118) | (tag < 116))
-        consumed = jnp.where(is_any_val, 1 + any_extra, consumed)
+        # unknown tags — and non-scalar values INSIDE an object (depth-1
+        # support) — fall back to the host lane; arrays and depth-1
+        # objects are header tokens whose children step individually
+        any_bad_tag = (is_any_val & (tag < 116)) | (
+            is_any_mval & ((tag == 117) | (tag == 118) | (tag < 116))
+        )
+        consumed = jnp.where(is_any_val | is_any_mval, 1 + any_extra, consumed)
 
         # --- parent_sub key hash (device map rows): mix the key bytes so
         # the host-built (hash -> interned key) table resolves them
@@ -539,8 +553,10 @@ def decode_updates_v1(
             # a string length > L would wrap `pos + v` past int32 and slip
             # under the pos_after bound; no real payload exceeds its buffer
             | ((is_str_skip | is_str) & (v > L))
-            | (is_any_val & ((tag == 119) | (tag == 116)) & (val2 > L))
-            | (ovf & ~is_u8 & ~is_client_st & ~is_any_val)
+            | ((is_any_val | is_any_mval)
+               & ((tag == 119) | (tag == 116))
+               & (val2 > L))
+            | (ovf & ~is_u8 & ~is_client_st & ~is_any_val & ~is_any_mval)
             | ((st == ST_NCLIENTS) & (v > max_sec))  # absurd header: garbage
         )
         act = active & ~bad
@@ -553,17 +569,22 @@ def decode_updates_v1(
 
         # --- end-of-block / end-of-ds-range shared bookkeeping -----------
         # one token consumed per value step; an array header enqueues its
-        # children onto the counter
+        # children onto the counter; a depth-1 object header suspends the
+        # counter until its last pair's value lands (ST_ANY_MVAL)
         any_children = jnp.where((st == ST_ANY_VAL) & (tag == 117), val2, 0)
+        map_open = on(ST_ANY_VAL) & (tag == 118) & (val2 > 0)
+        mpairs2 = upd(regs["mpairs"], on(ST_ANY_MVAL), regs["mpairs"] - 1)
+        map_done = on(ST_ANY_MVAL) & (mpairs2 == 0)
+        vals_dec = (on(ST_ANY_VAL) & ~map_open) | on(ST_JSON_VAL) | map_done
         vals_left2 = upd(
             regs["vals_left"],
-            on(ST_ANY_VAL) | on(ST_JSON_VAL),
+            vals_dec,
             regs["vals_left"] - 1 + any_children,
         )
         # states that finish a block this step (zero-count value lists
         # finish immediately and emit nothing)
         empty_list = (on(ST_ANY_COUNT) | on(ST_JSON_COUNT)) & (v == 0)
-        list_done = (on(ST_ANY_VAL) | on(ST_JSON_VAL)) & (vals_left2 == 0)
+        list_done = vals_dec & (vals_left2 == 0)
         # TypeRef tags 3/5 (XmlElement/XmlHook) carry a name string; 7
         # (WeakRef: host-resolved link source) and unknown tags flag
         type_named = on(ST_TYPE_TAG) & ((v == 3) | (v == 5))
@@ -709,6 +730,12 @@ def decode_updates_v1(
         st2 = upd(st2, on(ST_PARENT_ID_K), after_parent)
         st2 = upd(st2, on(ST_PARENT_SUB), content_st)
         st2 = upd(st2, on(ST_ANY_COUNT) & (v > 0), ST_ANY_VAL)
+        st2 = upd(st2, map_open, ST_ANY_MKEY)
+        st2 = upd(st2, on(ST_ANY_MKEY), ST_ANY_MVAL)
+        st2 = upd(st2, on(ST_ANY_MVAL) & ~map_done, ST_ANY_MKEY)
+        st2 = upd(
+            st2, map_done & (vals_left2 > 0), ST_ANY_VAL
+        )
         st2 = upd(st2, on(ST_JSON_COUNT) & (v > 0), ST_JSON_VAL)
         st2 = upd(st2, on(ST_FMT_KEY), ST_FMT_VAL)
         st2 = upd(st2, type_named, ST_TYPE_NAME)
@@ -781,6 +808,7 @@ def decode_updates_v1(
         regs2["ds_ranges_left"] = upd(ds_ranges_left2, on(ST_DS_NRANGES), v)
         regs2["ds_client"] = upd(regs["ds_client"], on(ST_DS_CLIENT), vc)
         regs2["ds_clock"] = upd(regs["ds_clock"], on(ST_DS_CLOCK), v)
+        regs2["mpairs"] = upd(mpairs2, map_open, val2)
         regs2["mvf"] = upd(regs["mvf"], on(ST_MV_FLAGS), v)
         regs2["msc"] = upd(regs["msc"], on(ST_MV_SC), vc)
         regs2["msk"] = upd(regs["msk"], on(ST_MV_SK), v)
