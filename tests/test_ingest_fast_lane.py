@@ -402,9 +402,9 @@ def test_b3_style_map_fan_in_zero_host_fallbacks():
     """B3 micro-bench shape (yrs/benches/benches.rs:536-551): N clients
     each commit one transaction against a shared map/array doc; every
     update must ride the raw-bytes fast lane (VERDICT r1 #5 done
-    criterion). Covers B3.1 (map num), B3.3 (map string), B3.4 (array
-    insert) — B3.2's object values are map-typed Any (host lane by
-    design)."""
+    criterion). Covers B3.1 (map num), B3.2 (flat object values —
+    depth-1 Any objects decode on device since r3), B3.3 (map string),
+    B3.4 (array insert)."""
     from ytpu.models.batch_doc import get_map
 
     n_clients = 24
@@ -418,9 +418,11 @@ def test_b3_style_map_fan_in_zero_host_fallbacks():
         d.observe_update_v1(lambda p, o, t, log=log: log.append(p))
         m = d.get_map("map")
         with d.transact() as txn:
-            if i % 3 == 0:
+            if i % 4 == 0:
                 m.insert(txn, f"n{i}", i)  # B3.1
-            elif i % 3 == 1:
+            elif i % 4 == 1:
+                m.insert(txn, f"o{i}", {"x": i, "y": f"v{i}"})  # B3.2
+            elif i % 4 == 2:
                 m.insert(txn, f"s{i}", f"val-{i}")  # B3.3
             else:
                 m.insert(txn, f"a{i}", [i, i + 1])  # B3.4-ish
