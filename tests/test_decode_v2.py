@@ -349,3 +349,69 @@ def test_b4_trace_prefix_rides_device_lane():
     assert int(np.asarray(state.error).max()) == 0
     got = get_string(state, 0, RawPayloadView(np.asarray(buf)))
     assert got == doc.get_text("text").get_string()
+
+
+def test_big_client_ids_resolve_through_hash_table():
+    """Real Yjs client ids (random 53-bit) ride the V2 lane: the expander
+    reconstructs each big id's unsigned-varint bytes from its signed V2
+    encoding and hashes with client_hash_host's mixing."""
+    import jax.numpy as jnp
+
+    from ytpu.ops.decode_kernel import client_hash_host
+
+    big_a = (1 << 52) + 12345
+    big_b = (1 << 45) + 7
+    d1 = Doc(client_id=big_a)
+    with d1.transact() as txn:
+        d1.get_text("t").insert(txn, 0, "from-a")
+    d2 = Doc(client_id=big_b)
+    d2.apply_update_v1(d1.encode_state_as_update_v1(StateVector({})))
+    with d2.transact() as txn:
+        d2.get_text("t").insert(txn, 3, "-b-")
+    with d2.transact() as txn:
+        # a deletion: the DS client id (rest stream) must hash too
+        d2.get_text("t").remove_range(txn, 0, 1)
+    v2 = [v1_to_v2(d2.encode_state_as_update_v1(StateVector({})))]
+
+    # interner tables: both ids interned; big ones registered in the hash
+    # table exactly as BatchIngestor does
+    idx = {big_a: 0, big_b: 1}
+    hashes = {client_hash_host(c): i for c, i in idx.items()}
+    hs = sorted(hashes)
+    cht = (
+        jnp.asarray(np.asarray(hs, dtype=np.int32)),
+        jnp.asarray(np.asarray([hashes[h] for h in hs], dtype=np.int32)),
+    )
+    client_table = (
+        jnp.asarray(np.zeros(0, dtype=np.int64)),
+        jnp.asarray(np.zeros(0, dtype=np.int32)),
+    )
+    buf, lens, spans = pack_updates_v2(v2)
+    stream, flags = decode_updates_v2(
+        buf, lens, spans, 8, 8,
+        client_table=client_table,
+        client_hash_table=cht,
+    )
+    f = np.asarray(flags)
+    assert (f & FLAG_ERRORS == 0).all(), f
+    valid = np.asarray(stream.valid)
+    got = sorted(
+        int(np.asarray(stream.client)[0, u])
+        for u in range(valid.shape[1])
+        if valid[0, u]
+    )
+    # both blocks present, each client resolved to its DISTINCT index
+    assert set(got) == {0, 1}
+    dvalid = np.asarray(stream.del_valid)
+    ds_clients = {
+        int(np.asarray(stream.del_client)[0, r])
+        for r in range(dvalid.shape[1])
+        if dvalid[0, r]
+    }
+    assert ds_clients and ds_clients <= {0, 1}
+
+    # without a hash table the lane flags FLAG_BIG_CLIENT
+    from ytpu.ops.decode_kernel import FLAG_BIG_CLIENT
+
+    _, flags2 = decode_updates_v2(buf, lens, spans, 8, 8)
+    assert np.asarray(flags2)[0] & FLAG_BIG_CLIENT

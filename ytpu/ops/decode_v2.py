@@ -27,9 +27,11 @@ i.e. every shape in the text-editing north-star workloads (B4). Lanes
 holding Any / JSON / Embed / Binary / Format / Type / Doc / Move content
 flag FLAG_UNSUPPORTED and take the host lane (their `rest` stream is no
 longer a flat varint list, so nothing after the first such block could be
-trusted anyway). Client ids beyond i32 flag FLAG_BIG_CLIENT (the V1 lane's
-varint-byte hash bridge does not transfer: V2 client columns use *signed*
-varints, a different byte sequence).
+trusted anyway). Client ids beyond i32 resolve through the SAME
+`client_hash_table` as the V1 lane: V2 client columns use *signed*
+varints, so the expander reconstructs each big id's unsigned-varint byte
+sequence from its 64-bit limbs and applies `client_hash_host`'s mixing
+on device; without a table such lanes flag FLAG_BIG_CLIENT.
 
 Output contract is identical to `decode_updates_v1`: ``(UpdateBatch,
 flags)`` with per-lane error flags and rows invalidated on flagged lanes;
@@ -182,7 +184,7 @@ def _svar_from(bytes10):
         ),
         axis=1,
     )
-    ovf = (nbytes > 5) | ((nbytes == 5) & ((bytes10[:, 4] & 0x7F) >= 4))
+    ovf = (nbytes > 5) | ((nbytes == 5) & ((bytes10[:, 4] & 0x7F) >= 16))
     return mag.astype(I32), neg, nbytes, ovf
 
 
@@ -215,28 +217,97 @@ def _bulk_uvarints(b, start, end, NV):
         jnp.where(inb, (w.astype(U32) & 0x7F) << shifts, 0), axis=2
     ).astype(I32)
     ovf = (nb > 5) | ((nb == 5) & ((w[:, :, 4] & 0x7F) >= 8))
-    return vals, n_varints, ovf
+    return vals, n_varints, ovf, starts
 
 
 # --- RLE column expanders ----------------------------------------------------
 
 
-def _expand_uintoptrle(b, start, length, N):
+def _svar_limbs(bytes10):
+    """64-bit magnitude of a signed lib0 varint as (lo, hi) u32 limbs.
+
+    Byte 0 contributes 6 bits; byte k ≥ 1 contributes 7 bits at offset
+    6 + 7(k-1). Groups straddling bit 32 split across the limbs."""
+    S = bytes10.shape[0]
+    cont = bytes10 >= 0x80
+    inb = jnp.concatenate(
+        [jnp.ones((S, 1), I32), jnp.cumprod(cont[:, :9].astype(I32), axis=1)],
+        axis=1,
+    )
+    lo = bytes10[:, 0].astype(U32) & 0x3F
+    hi = jnp.zeros((S,), U32)
+    for k in range(1, 10):
+        o = 6 + 7 * (k - 1)
+        g = jnp.where(inb[:, k] == 1, bytes10[:, k].astype(U32) & 0x7F, 0)
+        if o < 32:
+            lo = lo + (g << o)
+            if o > 25:  # straddles bit 32
+                hi = hi + (g >> (32 - o))
+        else:
+            hi = hi + (g << (o - 32))
+    return lo, hi
+
+
+def _hash_u64_varint(lo, hi):
+    """`client_hash_host` of the value's UNSIGNED-varint byte sequence,
+    recomputed from (lo, hi) limbs — the bridge that lets V2's signed
+    client varints resolve through the same host hash table as V1."""
+    # 7-bit groups of the 64-bit value
+    groups = []
+    for k in range(10):
+        o = 7 * k
+        if o < 32:
+            g = (lo >> o) & 0x7F
+            if o > 25:
+                g = g | ((hi << (32 - o)) & 0x7F)
+        else:
+            g = (hi >> (o - 32)) & 0x7F
+        groups.append(g.astype(U32))
+    gs = jnp.stack(groups, axis=-1)  # [S, 10]
+    nonzero = gs != 0
+    # index of the highest nonzero group (0 when value == 0)
+    idx10 = jnp.arange(10, dtype=I32)
+    last = jnp.max(jnp.where(nonzero, idx10[None, :], 0), axis=1)
+    nbytes = last + 1
+    in_seq = idx10[None, :] < nbytes[:, None]
+    is_last = idx10[None, :] == last[:, None]
+    byte_k = jnp.where(in_seq, gs | jnp.where(is_last, 0, 0x80), 0)
+    pow31 = jnp.asarray(
+        np.array([pow(31, i, 1 << 32) for i in range(10)], dtype=np.uint32)
+    )
+    h = jnp.sum(
+        jnp.where(in_seq, byte_k.astype(U32) * pow31[None, :], 0).astype(U32),
+        axis=1,
+    )
+    h = (h ^ (nbytes.astype(U32) * jnp.uint32(2654435761))) & jnp.uint32(
+        0x3FFFFFFF
+    )
+    return h.astype(I32)
+
+
+def _expand_uintoptrle(b, start, length, N, hash_big: bool = False):
     """UIntOptRle column → [S, N] values.
 
     Entry grammar (codec.py _UIntOptRleDecoder): signed varint; negative →
     run of |v| with count = next uvarint + 2; else single value. Returns
-    (vals, produced, big) — `big` marks positions whose value overflowed
-    i32 (real 53-bit client ids)."""
+    ``(vals, produced)``. With ``hash_big``, positions whose value
+    overflows i32 (real 53-bit client ids) carry
+    ``-2 - client_hash`` instead of a truncated magnitude, so the shared
+    `client_hash_table` resolution applies (V1-lane convention); other
+    columns treat an i32 overflow as garbage-in (clamped value on a
+    lane whose structural checks flag it)."""
     S = b.shape[0]
     end = start + length
     iota_n = jnp.arange(N, dtype=I32)[None, :]
 
     def step(_, carry):
-        pos, oidx, vals, big = carry
+        pos, oidx, vals = carry
         active = (pos < end) & (oidx < N)
         w = _window(b, pos, end, 10)
         mag, neg, nb, ovf = _svar_from(w)
+        if hash_big:
+            lo, hi = _svar_limbs(w)
+            mag = jnp.where(ovf, -2 - _hash_u64_varint(lo, hi), mag)
         w2 = _window(b, pos + nb, end, 10)
         cnt, nb2, _ = _uvar_from(w2)
         count = jnp.where(neg, cnt + 2, 1)
@@ -247,20 +318,14 @@ def _expand_uintoptrle(b, start, length, N):
             & active[:, None]
         )
         vals = jnp.where(mask, mag[:, None], vals)
-        big = big | (mask & ovf[:, None])
         pos = jnp.where(active, pos + adv, pos)
         oidx = jnp.where(active, oidx + count, oidx)
-        return pos, oidx, vals, big
+        return pos, oidx, vals
 
     pos0 = jnp.where(length > 0, start, end)
-    init = (
-        pos0,
-        jnp.zeros((S,), I32),
-        jnp.zeros((S, N), I32),
-        jnp.zeros((S, N), bool),
-    )
-    _, produced, vals, big = jax.lax.fori_loop(0, N, step, init)
-    return vals, produced, big
+    init = (pos0, jnp.zeros((S,), I32), jnp.zeros((S, N), I32))
+    _, produced, vals = jax.lax.fori_loop(0, N, step, init)
+    return vals, produced
 
 
 def _expand_intdiffoptrle(b, start, length, N):
@@ -352,11 +417,11 @@ def decode_updates_v2(
     """Decode S V2 updates into an ``[S, U] / [S, R]`` UpdateBatch stream.
 
     Same contract as `decode_updates_v1` (see its docstring for the table
-    semantics); `spans` comes from `pack_updates_v2`. `client_hash_table`
-    is accepted for signature parity but unused — V2 big clients flag
-    FLAG_BIG_CLIENT and take the host lane (module docstring).
+    semantics); `spans` comes from `pack_updates_v2`. Client ids beyond
+    i32 hash to the same `client_hash_table` entries as the V1 lane: the
+    expander reconstructs the id's UNSIGNED-varint bytes from its signed
+    V2 encoding and applies `client_hash_host`'s mixing on device.
     """
-    del client_hash_table
     S, L = buf.shape
     U, R = max_rows, max_dels
     SEC = max_sections if max_sections is not None else 4
@@ -380,11 +445,13 @@ def decode_updates_v2(
     # --- column expansions ---------------------------------------------------
     info_vals, info_n = _expand_rle(b, *span(SP_INFO), NB)
     pi_vals, pi_n = _expand_rle(b, *span(SP_PARENT_INFO), NB)
-    cli_vals, cli_n, cli_big = _expand_uintoptrle(b, *span(SP_CLIENT), NCLI)
+    cli_vals, cli_n = _expand_uintoptrle(
+        b, *span(SP_CLIENT), NCLI, hash_big=True
+    )
     lc_vals, lc_n = _expand_intdiffoptrle(b, *span(SP_LEFT_CLOCK), NB)
     rc_vals, rc_n = _expand_intdiffoptrle(b, *span(SP_RIGHT_CLOCK), NB)
-    len_vals, len_n, _ = _expand_uintoptrle(b, *span(SP_LEN), NB)
-    str16, str_n, _ = _expand_uintoptrle(b, *span(SP_STR_LENS), NS)
+    len_vals, len_n = _expand_uintoptrle(b, *span(SP_LEN), NB)
+    str16, str_n = _expand_uintoptrle(b, *span(SP_STR_LENS), NS)
 
     # string byte offsets: binary-search the buffer's UTF-16 prefix sums for
     # each string's cumulative unit target inside the blob
@@ -411,7 +478,9 @@ def decode_updates_v2(
 
     # --- rest stream: every varint at once -----------------------------------
     rest_start, rest_len = span(SP_REST)
-    v, n_varints, v_ovf = _bulk_uvarints(b, rest_start, rest_start + rest_len, NV)
+    v, n_varints, v_ovf, v_starts = _bulk_uvarints(
+        b, rest_start, rest_start + rest_len, NV
+    )
     iota_nv = jnp.arange(NV, dtype=I32)[None, :]
 
     def vat(idx, used):
@@ -421,6 +490,49 @@ def decode_updates_v2(
         bad = used & ((idx >= n_varints[:, None]) | (idx >= NV))
         ob = used & jnp.take_along_axis(v_ovf, safe, axis=1)
         return out, jnp.any(bad | ob, axis=1)
+
+    pow31_10 = jnp.asarray(
+        np.array([pow(31, i, 1 << 32) for i in range(10)], dtype=np.uint32)
+    )
+
+    def vat_id(idx, used):
+        """Like `vat` for CLIENT-ID positions: a value beyond i32 is a real
+        53-bit Yjs client — hash its wire bytes (`client_hash_host` mixing;
+        rest varints are already the unsigned encoding) to ``-2 - h``
+        instead of flagging malformed."""
+        safe = jnp.clip(idx, 0, NV - 1)
+        out = jnp.take_along_axis(v, safe, axis=1)
+        bad = used & ((idx >= n_varints[:, None]) | (idx >= NV))
+        ovf = jnp.take_along_axis(v_ovf, safe, axis=1)
+        st = jnp.take_along_axis(v_starts, safe, axis=1)  # [S, K]
+        K = st.shape[1]
+        widx = jnp.clip(
+            st[:, :, None] + jnp.arange(10, dtype=I32)[None, None, :], 0, L - 1
+        )
+        wb = jnp.take_along_axis(b, widx.reshape(S, -1), axis=1).reshape(
+            S, K, 10
+        )
+        cont = wb >= 0x80
+        inb = jnp.concatenate(
+            [
+                jnp.ones((S, K, 1), I32),
+                jnp.cumprod(cont[:, :, :9].astype(I32), axis=2),
+            ],
+            axis=2,
+        )
+        nbytes = jnp.sum(inb, axis=2)
+        h = jnp.sum(
+            jnp.where(
+                inb == 1, wb.astype(U32) * pow31_10[None, None, :], 0
+            ).astype(U32),
+            axis=2,
+        )
+        h = (
+            (h ^ (nbytes.astype(U32) * jnp.uint32(2654435761)))
+            & jnp.uint32(0x3FFFFFFF)
+        ).astype(I32)
+        out = jnp.where(ovf, -2 - h, out)
+        return out, jnp.any(bad, axis=1)
 
     nc = v[:, 0]
     malformed = (lens > 0) & (n_varints < 1)
@@ -590,14 +702,6 @@ def decode_updates_v2(
         & ~is_str_content,
         axis=1,
     ) | jnp.any(key_too_long, axis=1)
-    # every consumed client-column position must be checked for i32
-    # overflow: blocks consume up to two entries (origin + right-origin)
-    big_at = lambda idx: g(cli_big.astype(I32), jnp.clip(idx, 0, NCLI - 1)) > 0
-    big = (
-        jnp.any(big_at(blk_cli_base) & valid_blk & (c_cnt > 0), axis=1)
-        | jnp.any(big_at(blk_cli_base + 1) & valid_blk & (c_cnt > 1), axis=1)
-        | jnp.any(big_at(sec_cli_idx) & valid_blk, axis=1)
-    )
     consumption_ovf = (
         (g(c_base, jnp.full((S, 1), NB - 1, I32))[:, 0] + 3 > NCLI)
         | (total_blocks > NB)
@@ -637,7 +741,7 @@ def decode_updates_v2(
     def ds_step(k, carry):
         p, out_base, dels, bad, ovf = carry
         active = k < ds_n
-        cli, b1 = vat(p[:, None], active[:, None])
+        cli, b1 = vat_id(p[:, None], active[:, None])
         nr, b2 = vat(p[:, None] + 1, active[:, None])
         cli, nr = cli[:, 0], nr[:, 0]
         in_sec = active[:, None] & (iota_r < nr[:, None])
@@ -729,7 +833,6 @@ def decode_updates_v2(
         flags
         | jnp.where(malformed, FLAG_MALFORMED, 0)
         | jnp.where(unsupported, FLAG_UNSUPPORTED, 0)
-        | jnp.where(big, FLAG_BIG_CLIENT, 0)
         | jnp.where(
             blk_ovf | row_ovf | consumption_ovf | ds_ovf | ds_sec_ovf,
             FLAG_OVERFLOW,
@@ -737,4 +840,6 @@ def decode_updates_v2(
         )
     )
 
-    return _resolve_and_pack(rows, dels, flags, client_table, key_table, None)
+    return _resolve_and_pack(
+        rows, dels, flags, client_table, key_table, client_hash_table
+    )
