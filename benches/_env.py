@@ -1,0 +1,20 @@
+"""Shared bench-process environment guards."""
+
+from __future__ import annotations
+
+import os
+
+
+def repin_jax_platforms() -> None:
+    """Re-pin jax_platforms from the JAX_PLATFORMS env var.
+
+    The axon site-hook force-updates jax_platforms to "axon,cpu" at
+    interpreter start, overriding the env var; when the TPU tunnel hangs
+    (rather than failing fast) that blocks jax.devices() forever even for
+    CPU-only runs. config.update beats the hook's value — same fix as
+    tests/conftest.py. No-op when JAX_PLATFORMS is unset (hardware runs
+    WANT the axon backend)."""
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

@@ -16,11 +16,17 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
 import sys
 import time
 
 sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _env import repin_jax_platforms  # noqa: E402
+
+repin_jax_platforms()
 
 import numpy as np
 
@@ -211,30 +217,38 @@ def bench_config5(n_docs: int, n_clients: int = 64):
         relay.encode_state_as_update_v1(sv)
     host_dt = (time.perf_counter() - t0) / host_n
 
-    fn = lambda: jax.tree_util.tree_map(
-        np.asarray, encode_diff_batch(state, remote, C)
-    )
-    fn()  # compile + warm
+    def select():
+        out = encode_diff_batch(state, remote, C)
+        jax.block_until_ready(out)
+        return out
+
+    out = select()  # compile + warm
     t0 = time.perf_counter()
-    out = fn()
-    dt = time.perf_counter() - t0
+    out = select()
+    sel_dt = time.perf_counter() - t0
     assert out[0].shape == (n_docs, 1024)
 
     # the finisher: selected rows -> wire bytes. Python per-row loop vs the
     # native batched C++ finisher (VERDICT r2 #6; ref store.rs:204-248).
+    # Selection outputs stay DEVICE-resident: the finisher compacts the
+    # shipped rows on device and pulls one packed tensor (VERDICT r3 #3).
     from ytpu.models.batch_doc import finish_encode_diff, finish_encode_diff_batch
 
     ship, offsets, _sv, deleted = out
     py_n = min(256, n_docs)
+    # the Python baseline gets host-resident arrays (one conversion, before
+    # its timer) so it isn't billed per-doc device syncs the native path
+    # no longer pays
+    ship_np, off_np, del_np = (np.asarray(a) for a in (ship, offsets, deleted))
     t0 = time.perf_counter()
     py_payloads = [
-        finish_encode_diff(state, d, ship, offsets, deleted, enc)
+        finish_encode_diff(state, d, ship_np, off_np, del_np, enc)
         for d in range(py_n)
     ]
     py_dt = (time.perf_counter() - t0) / py_n
     all_docs = list(range(n_docs))
-    finish_encode_diff_batch(  # warm the payload arenas
-        state, all_docs[:1], ship, offsets, deleted, enc
+    finish_encode_diff_batch(  # warm the payload arenas + compile compaction
+        state, all_docs, ship, offsets, deleted, enc
     )
     t0 = time.perf_counter()
     nat_payloads = finish_encode_diff_batch(
@@ -244,11 +258,17 @@ def bench_config5(n_docs: int, n_clients: int = 64):
     assert nat_payloads[:py_n] == py_payloads  # byte parity
     finisher_speedup = py_dt / nat_dt if nat_dt > 0 else float("inf")
 
+    # headline = END-TO-END serving rate (selection + finisher), the number
+    # an operator gets per sync round (VERDICT r3 weak #9: the old value
+    # reported device selection alone and hid the finisher bottleneck)
+    e2e_dt = sel_dt / n_docs + nat_dt
     return {
         "metric": "config5_encode_diff_batch_docs_per_sec",
-        "value": round(n_docs / dt, 1),
-        "unit": f"doc-diffs/s over {n_docs} docs x {C} clients (device selection)",
-        "vs_baseline": round((n_docs / dt) / (1.0 / host_dt), 2),
+        "value": round(1.0 / e2e_dt, 1),
+        "unit": f"doc-diffs/s END-TO-END over {n_docs} docs x {C} clients "
+        "(device selection + native finisher, byte parity asserted)",
+        "vs_baseline": round((1.0 / e2e_dt) / (1.0 / host_dt), 2),
+        "selection_docs_per_sec": round(n_docs / sel_dt, 1),
         "finisher_native_docs_per_sec": round(1.0 / nat_dt, 1),
         "finisher_python_docs_per_sec": round(1.0 / py_dt, 1),
         "finisher_native_vs_python": round(finisher_speedup, 2),

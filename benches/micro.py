@@ -18,10 +18,17 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import random
 import string
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _env import repin_jax_platforms  # noqa: E402
+
+repin_jax_platforms()
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 

@@ -1247,6 +1247,81 @@ def _wire_concat(payloads) -> np.ndarray:
     return state["arr"][: state["len"]]
 
 
+_FINISH_COLS = (
+    "client",
+    "clock",
+    "length",
+    "origin_client",
+    "origin_clock",
+    "ror_client",
+    "ror_clock",
+    "kind",
+    "content_ref",
+    "content_off",
+    "key",
+    "parent",
+)
+
+
+def _next_pow2(n: int, floor: int = 8) -> int:
+    return max(floor, 1 << max(0, int(n - 1).bit_length()))
+
+
+def _finish_include(parent, ship, deleted):
+    """Rows the native finisher must see: shipped, deleted, or the parent
+    of a shipped row (encode_row walks one parent hop for parentful items)."""
+    B = ship.shape[1]
+    pv = ship & (parent >= 0)
+    spar = jnp.where(pv, parent, 0)
+    incl = ship | deleted
+    return jax.vmap(
+        lambda inc, par, m: inc.at[jnp.where(m, par, B)].max(m, mode="drop")
+    )(incl, spar, pv), pv, spar
+
+
+@jax.jit
+def _finish_counts(parent, ship, deleted, idx):
+    g = lambda a: jnp.take(a, idx, axis=0)
+    incl, _, _ = _finish_include(g(parent), g(ship), g(deleted))
+    return jnp.sum(incl, axis=1, dtype=jnp.int32)
+
+
+@partial(jax.jit, static_argnums=(5,))
+def _finish_pack(bl, ship, offsets, deleted, idx, R):
+    """Compact the finisher's row set to [Dsel, 15, R] i32 ON DEVICE.
+
+    The tunnel-dominated cost of the old path was pulling every [D, B]
+    block column to host (capacity-sized, ~all HBM-resident state); the
+    finisher only reads shipped/deleted/parent rows, so this scatters just
+    those into R slots per doc and ships ONE packed tensor. The parent
+    column is remapped into the compacted index space (valid for every
+    shipped row by construction; -1 elsewhere — never read by the C++
+    side, which only dereferences parents of shipped rows)."""
+    g = lambda a: jnp.take(a, idx, axis=0)
+    ship = g(ship)
+    offsets = g(offsets).astype(jnp.int32)
+    deleted = g(deleted)
+    cols = {n: g(getattr(bl, n)).astype(jnp.int32) for n in _FINISH_COLS}
+    Ds, B = ship.shape
+    incl, pv, spar = _finish_include(cols["parent"], ship, deleted)
+    incl_i = incl.astype(jnp.int32)
+    new_idx = jnp.cumsum(incl_i, axis=1) - incl_i
+    tgt = jnp.where(incl, new_idx, R)  # R is out of range -> dropped
+    didx = jnp.broadcast_to(jnp.arange(Ds, dtype=jnp.int32)[:, None], (Ds, B))
+    cols["parent"] = jnp.where(
+        pv, jnp.take_along_axis(new_idx, spar, axis=1), -1
+    )
+
+    def compact(col):
+        return jnp.zeros((Ds, R), jnp.int32).at[didx, tgt].set(col, mode="drop")
+
+    packed = [compact(cols[n]) for n in _FINISH_COLS]
+    packed.append(compact(ship.astype(jnp.int32)))
+    packed.append(compact(offsets))
+    packed.append(compact(deleted.astype(jnp.int32)))
+    return jnp.stack(packed, axis=1)
+
+
 def finish_encode_diff_batch(
     state: DocStateBatch,
     docs,
@@ -1293,43 +1368,34 @@ def finish_encode_diff_batch(
 
     bl = state.blocks
     D, B = bl.client.shape
-    col_names = (
-        "client",
-        "clock",
-        "length",
-        "origin_client",
-        "origin_clock",
-        "ror_client",
-        "ror_clock",
-        "kind",
-        "content_ref",
-        "content_off",
-        "key",
-        "parent",
-    )
+    col_names = _FINISH_COLS
 
-    def col_i32(a):
-        return np.ascontiguousarray(np.asarray(a), dtype=np.int32)
-
-    if len(docs) * 4 <= D:
-        # small selection (e.g. one sync reply): gather the selected docs'
-        # rows on device so only [n_sel, B] transfers to host, not [D, B]
-        idx = jnp.asarray(docs, dtype=jnp.int32)
-        cols = {
-            name: col_i32(jnp.take(getattr(bl, name), idx, axis=0))
-            for name in col_names
-        }
-        ship_u8 = np.ascontiguousarray(ship[docs], dtype=np.uint8)
-        deleted_u8 = np.ascontiguousarray(deleted[docs], dtype=np.uint8)
-        offsets_i32 = np.ascontiguousarray(offsets[docs], dtype=np.int32)
-        sel = np.arange(len(docs), dtype=np.int32)
-        D = len(docs)
-    else:
-        cols = {name: col_i32(getattr(bl, name)) for name in col_names}
-        ship_u8 = np.ascontiguousarray(ship, dtype=np.uint8)
-        deleted_u8 = np.ascontiguousarray(deleted, dtype=np.uint8)
-        offsets_i32 = np.ascontiguousarray(offsets, dtype=np.int32)
-        sel = np.ascontiguousarray(np.asarray(docs), dtype=np.int32)
+    # Device-side row compaction (VERDICT r3 #3): only shipped/deleted/
+    # parent rows cross the device->host boundary, as ONE [Dsel, 15, R]
+    # tensor — R is the largest per-doc row set, bucketed to a power of
+    # two to bound recompiles (as is the doc-selection length).
+    ship_j = ship if isinstance(ship, jax.Array) else jnp.asarray(ship)
+    off_j = offsets if isinstance(offsets, jax.Array) else jnp.asarray(offsets)
+    del_j = deleted if isinstance(deleted, jax.Array) else jnp.asarray(deleted)
+    n_sel = len(docs)
+    # no clamp to D: `docs` may legally repeat slots, so n_sel can exceed
+    # the doc capacity; padding entries index doc 0 (valid at any length)
+    d_pad = _next_pow2(n_sel)
+    idx_np = np.zeros(d_pad, dtype=np.int32)
+    idx_np[:n_sel] = np.asarray(docs, dtype=np.int32)
+    idx = jnp.asarray(idx_np)
+    counts = np.asarray(_finish_counts(bl.parent, ship_j, del_j, idx))
+    R = min(_next_pow2(int(counts.max(initial=1))), B)
+    arr = np.asarray(_finish_pack(bl, ship_j, off_j, del_j, idx, R))
+    cols = {
+        name: np.ascontiguousarray(arr[:, k, :])
+        for k, name in enumerate(col_names)
+    }
+    ship_u8 = np.ascontiguousarray(arr[:, 12, :], dtype=np.uint8)
+    offsets_i32 = np.ascontiguousarray(arr[:, 13, :])
+    deleted_u8 = np.ascontiguousarray(arr[:, 14, :], dtype=np.uint8)
+    sel = np.arange(n_sel, dtype=np.int32)
+    D, B = d_pad, R
     # interner/key tables are append-only: rebuild only when they grew
     tables = getattr(enc, "_nat_tables", None)
     n_keys = len(enc.keys)
@@ -1430,7 +1496,9 @@ def finish_encode_diff_batch(
         wire=p_u8(wire),
         wire_len=int(getattr(payloads, "total_bytes", 0)),
     )
-    handle = lib.ytpu_finish_batch(ctypes.byref(fin))
+    # many-doc batches fan out across cores (docs encode independently);
+    # small selections stay single-threaded to avoid spawn overhead
+    handle = lib.ytpu_finish_batch_mt(ctypes.byref(fin), 0 if len(docs) >= 128 else 1)
     try:
         data_ptr = lib.ytpu_finish_data(handle)
         out: List[bytes] = []

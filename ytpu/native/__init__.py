@@ -66,6 +66,7 @@ def _build() -> bool:
                 "-O2",
                 "-shared",
                 "-fPIC",
+                "-pthread",
                 "-std=c++17",
                 _SRC,
                 _ENGINE_SRC,
@@ -254,6 +255,11 @@ def load() -> Optional[ctypes.CDLL]:
         if lib.finisher_ok:
             lib.ytpu_finish_batch.restype = ctypes.c_void_p
             lib.ytpu_finish_batch.argtypes = [ctypes.POINTER(FinishIn)]
+            lib.ytpu_finish_batch_mt.restype = ctypes.c_void_p
+            lib.ytpu_finish_batch_mt.argtypes = [
+                ctypes.POINTER(FinishIn),
+                ctypes.c_int32,
+            ]
             lib.ytpu_finish_status.restype = ctypes.c_int32
             lib.ytpu_finish_status.argtypes = [ctypes.c_void_p, ctypes.c_int32]
             lib.ytpu_finish_data.restype = ctypes.POINTER(ctypes.c_uint8)

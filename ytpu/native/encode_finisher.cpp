@@ -26,6 +26,7 @@
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -758,6 +759,36 @@ class DocEncoder {
   std::string scratch_;
 };
 
+// One worker's output: spans are relative to this shard's `data` and get
+// rebased during the merge.
+struct Shard {
+  std::string data;
+  std::vector<int64_t> off;
+  std::vector<int64_t> len;
+  std::vector<int32_t> status;
+};
+
+void encode_range(const FinishIn& in, int32_t lo, int32_t hi, Shard& sh) {
+  const int32_t n = hi - lo;
+  sh.off.assign(n, 0);
+  sh.len.assign(n, 0);
+  sh.status.assign(n, STATUS_FALLBACK);
+  Buf buf;
+  for (int32_t i = lo; i < hi; i++) {
+    const int32_t doc = in.sel[i];
+    const size_t start = buf.b.size();
+    DocEncoder enc(in, doc);
+    if (doc < 0 || doc >= in.n_docs_total || !enc.run(buf)) {
+      buf.b.resize(start);  // drop partial output
+      continue;
+    }
+    sh.status[i - lo] = STATUS_OK;
+    sh.off[i - lo] = static_cast<int64_t>(start);
+    sh.len[i - lo] = static_cast<int64_t>(buf.b.size() - start);
+  }
+  sh.data.swap(buf.b);
+}
+
 }  // namespace
 
 extern "C" {
@@ -767,29 +798,55 @@ extern "C" {
 // the two hand-maintained struct definitions)
 int64_t ytpu_finish_in_sizeof() { return static_cast<int64_t>(sizeof(FinishIn)); }
 
-void* ytpu_finish_batch(const FinishIn* in) {
+// Docs encode independently (FinishIn is read-only; each DocEncoder owns
+// its scratch), so the batch splits into contiguous chunks of `sel`, one
+// per worker. n_threads <= 0 means hardware concurrency. Called with the
+// GIL released (ctypes drops it around foreign calls).
+void* ytpu_finish_batch_mt(const FinishIn* in, int32_t n_threads) {
   auto* out = new FinishOut();
-  out->span_off.resize(in->n_sel);
-  out->span_len.resize(in->n_sel);
-  out->status.resize(in->n_sel);
-  Buf buf;
-  for (int32_t i = 0; i < in->n_sel; i++) {
-    const int32_t doc = in->sel[i];
-    const size_t start = buf.b.size();
-    DocEncoder enc(*in, doc);
-    if (doc < 0 || doc >= in->n_docs_total || !enc.run(buf)) {
-      buf.b.resize(start);  // drop partial output
-      out->status[i] = STATUS_FALLBACK;
-      out->span_off[i] = 0;
-      out->span_len[i] = 0;
-      continue;
+  const int32_t n = in->n_sel;
+  out->span_off.resize(n);
+  out->span_len.resize(n);
+  out->status.resize(n);
+  if (n == 0) return out;
+  int32_t hw = static_cast<int32_t>(std::thread::hardware_concurrency());
+  if (hw <= 0) hw = 1;
+  int32_t t = n_threads <= 0 ? hw : std::min(n_threads, hw);
+  // ~64 docs per chunk keeps thread spawn cost irrelevant for small calls
+  t = std::min(t, std::max(int32_t{1}, n / 64));
+  std::vector<Shard> shards(t);
+  if (t <= 1) {
+    encode_range(*in, 0, n, shards[0]);
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(t);
+    for (int32_t k = 0; k < t; k++) {
+      const int32_t lo = static_cast<int32_t>(static_cast<int64_t>(n) * k / t);
+      const int32_t hi =
+          static_cast<int32_t>(static_cast<int64_t>(n) * (k + 1) / t);
+      pool.emplace_back(encode_range, std::cref(*in), lo, hi,
+                        std::ref(shards[k]));
     }
-    out->status[i] = STATUS_OK;
-    out->span_off[i] = static_cast<int64_t>(start);
-    out->span_len[i] = static_cast<int64_t>(buf.b.size() - start);
+    for (auto& th : pool) th.join();
   }
-  out->data.swap(buf.b);
+  size_t total = 0;
+  for (const auto& sh : shards) total += sh.data.size();
+  out->data.reserve(total);
+  int32_t i = 0;
+  for (const auto& sh : shards) {
+    const int64_t base = static_cast<int64_t>(out->data.size());
+    out->data.append(sh.data);
+    for (size_t j = 0; j < sh.status.size(); j++, i++) {
+      out->status[i] = sh.status[j];
+      out->span_off[i] = sh.status[j] == STATUS_OK ? base + sh.off[j] : 0;
+      out->span_len[i] = sh.len[j];
+    }
+  }
   return out;
+}
+
+void* ytpu_finish_batch(const FinishIn* in) {
+  return ytpu_finish_batch_mt(in, 1);
 }
 
 int32_t ytpu_finish_status(void* h, int32_t i) {

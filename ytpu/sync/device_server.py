@@ -297,12 +297,14 @@ class DeviceSyncServer(SyncServer):
         ship, offsets, _local, deleted = encode_diff_batch(
             ing.state, jnp.asarray(remote), n_clients
         )
+        # device arrays stay device-resident: the finisher compacts the
+        # shipped rows on device and pulls ONE packed tensor to host
         payload = finish_encode_diff_batch(
             ing.state,
             [slot],
-            np.asarray(ship),
-            np.asarray(offsets),
-            np.asarray(deleted),
+            ship,
+            offsets,
+            deleted,
             ing.enc,
             payloads=ing.payloads,
             root_name=self._root_names.get(tenant_name),
