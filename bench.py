@@ -248,6 +248,57 @@ def device_replay(log, expect: str):
     return time.perf_counter() - t0
 
 
+def device_step_latency(log, n_steps: int = 200, n_docs: int = 256):
+    """p50/p99 per-apply latency (BASELINE's second metric, VERDICT r3 #10).
+
+    The throughput replay amortizes dispatch across a whole lax.scan; a
+    serving loop pays one dispatch per request round. This times ONE
+    apply_update_stream step per update (blocking readback) on a fresh
+    batch — the honest SLO shape — over the first `n_steps` B4 updates.
+    """
+    import jax
+
+    from ytpu.core.update import Update
+    from ytpu.models.batch_doc import (
+        BatchEncoder,
+        apply_update_stream,
+        init_state,
+    )
+
+    enc = BatchEncoder()
+    steps = [
+        enc.build_step(Update.decode_v1(p), ROWS_PER_STEP, DELS_PER_STEP)
+        for p in log[:n_steps]
+    ]
+    stream = BatchEncoder.stack_steps(steps)
+    rank = enc.interner.rank_table()
+    one = jax.tree_util.tree_map(lambda a: a[:1], stream)
+    state = apply_update_stream(init_state(n_docs, CAPACITY), one, rank)
+    import numpy as np
+
+    np.asarray(state.n_blocks)  # compile the 1-step shape + sync
+    state = init_state(n_docs, CAPACITY)
+    np.asarray(state.n_blocks)
+    lat_ms = []
+    for s in range(len(steps)):
+        step_s = jax.tree_util.tree_map(lambda a: a[s : s + 1], stream)
+        t0 = time.perf_counter()
+        state = apply_update_stream(state, step_s, rank)
+        np.asarray(state.n_blocks)
+        lat_ms.append(1e3 * (time.perf_counter() - t0))
+    err = int(np.asarray(state.error).max())
+    if err != 0:
+        raise RuntimeError(f"latency phase error flag {err}")
+    lat_ms.sort()
+    n = len(lat_ms)
+    return {
+        "p50_apply_ms": round(lat_ms[n // 2], 3),
+        "p99_apply_ms": round(lat_ms[min(n - 1, int(0.99 * n))], 3),
+        "latency_steps": n,
+        "latency_docs": n_docs,
+    }
+
+
 def device_replay_full(log, expect, lane="fused"):
     """Full-stream chunked replay with compaction + growth in the timed
     loop (ytpu/models/replay.py). `lane="fused"` drives the Pallas kernel;
@@ -436,6 +487,14 @@ def _device_phase_child(in_path: str, out_path: str) -> None:
     except Exception as e:
         result["xla_full_error"] = f"{type(e).__name__}: {e}"[:300]
     flush()
+    try:
+        # p50/p99 per-apply dispatch latency (BASELINE metric 2). AFTER the
+        # flagship capture: 200 serial blocking round-trips on a flaky
+        # tunnel must not burn the window before xla_full lands.
+        result.update(device_step_latency(job["log"]))
+    except Exception as e:
+        result["latency_error"] = f"{type(e).__name__}: {e}"[:300]
+    flush()
     if os.environ.get("YTPU_BENCH_FUSED", "1") != "0":
         try:
             result["quick_dt"] = device_replay(
@@ -570,6 +629,11 @@ def main():
             out["probe"] = probe
         if "configs" in res:
             out["configs"] = res["configs"]
+        for k in ("p50_apply_ms", "p99_apply_ms", "latency_steps", "latency_docs"):
+            if k in res:
+                out[k] = res[k]
+        if "latency_error" in res:
+            out["latency_error"] = res["latency_error"]
     if res and "quick_dt" in res:
         quick_rate = len(quick_log) * N_DOCS / res["quick_dt"]
         out["quick_updates_per_sec"] = round(quick_rate, 1)

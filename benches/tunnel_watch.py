@@ -64,6 +64,20 @@ def main() -> int:
             with open(OUT, "w") as f:
                 json.dump(stamp, f, indent=1)
             log(f"attempt {attempt}: DEVICE CAPTURE ({dt:.0f}s) -> {OUT}")
+            # the XLA capture is safe on disk — now spend the rest of the
+            # window on the crash-risky part: the Mosaic bisection ladder
+            # (results flush per rung, so even a worker crash attributes
+            # the faulting construct; see benches/mosaic_ladder.py)
+            log("running mosaic_ladder on the live tunnel")
+            try:
+                subprocess.run(
+                    [sys.executable, os.path.join(HERE, "benches", "mosaic_ladder.py")],
+                    timeout=3600,
+                    cwd=HERE,
+                )
+                log("mosaic_ladder finished (see benches/mosaic_ladder.json)")
+            except Exception as e:  # noqa: BLE001
+                log(f"mosaic_ladder died: {type(e).__name__}: {e}")
             return 0
         log(
             f"attempt {attempt}: no device ({dt:.0f}s): "

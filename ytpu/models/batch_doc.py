@@ -1378,11 +1378,20 @@ def finish_encode_diff_batch(
     off_j = offsets if isinstance(offsets, jax.Array) else jnp.asarray(offsets)
     del_j = deleted if isinstance(deleted, jax.Array) else jnp.asarray(deleted)
     n_sel = len(docs)
+    sel_np = np.asarray(docs, dtype=np.int32)
+    if n_sel and (sel_np.min() < 0 or sel_np.max() >= D):
+        # jnp.take clamps OOB indices — without this check a stale slot id
+        # would silently encode the LAST doc's diff for the wrong tenant
+        raise IndexError(
+            f"doc selection out of range: {sel_np.min()}..{sel_np.max()} "
+            f"for {D} docs"
+        )
     # no clamp to D: `docs` may legally repeat slots, so n_sel can exceed
-    # the doc capacity; padding entries index doc 0 (valid at any length)
+    # the doc capacity; padding entries repeat the first SELECTED doc so R
+    # (the packed width) is sized by the actual selection, not by doc 0
     d_pad = _next_pow2(n_sel)
-    idx_np = np.zeros(d_pad, dtype=np.int32)
-    idx_np[:n_sel] = np.asarray(docs, dtype=np.int32)
+    idx_np = np.full(d_pad, sel_np[0] if n_sel else 0, dtype=np.int32)
+    idx_np[:n_sel] = sel_np
     idx = jnp.asarray(idx_np)
     counts = np.asarray(_finish_counts(bl.parent, ship_j, del_j, idx))
     R = min(_next_pow2(int(counts.max(initial=1))), B)
