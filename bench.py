@@ -495,6 +495,26 @@ def _device_phase_child(in_path: str, out_path: str) -> None:
     except Exception as e:
         result["latency_error"] = f"{type(e).__name__}: {e}"[:300]
     flush()
+    try:
+        # sequence-parallel axis (SURVEY §5.7; VERDICT r3 #6): B4-prefix
+        # replay on a 1- vs 8-shard ShardedDoc
+        import importlib.util as _ilu2
+
+        _sp_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benches", "sp_axis.py"
+        )
+        _sp_spec = _ilu2.spec_from_file_location("ytpu_bench_sp", _sp_path)
+        _sp = _ilu2.module_from_spec(_sp_spec)
+        _sp_spec.loader.exec_module(_sp)
+        sp_log, sp_expect = _sp.b4_prefix_updates(1200)
+        sp = {}
+        for n in (1, 8):
+            sp[f"shards_{n}"] = _sp.run_shards(sp_log, sp_expect, n)
+            result["sp"] = sp
+            flush()
+    except Exception as e:
+        result["sp_error"] = f"{type(e).__name__}: {e}"[:300]
+    flush()
     if os.environ.get("YTPU_BENCH_FUSED", "1") != "0":
         try:
             result["quick_dt"] = device_replay(
@@ -634,6 +654,10 @@ def main():
                 out[k] = res[k]
         if "latency_error" in res:
             out["latency_error"] = res["latency_error"]
+        if "sp" in res:
+            out["sp"] = res["sp"]
+        if "sp_error" in res:
+            out["sp_error"] = res["sp_error"]
     if res and "quick_dt" in res:
         quick_rate = len(quick_log) * N_DOCS / res["quick_dt"]
         out["quick_updates_per_sec"] = round(quick_rate, 1)

@@ -1,0 +1,102 @@
+"""Sequence-parallel (sp) axis benchmark — VERDICT r3 next-step #6.
+
+Replays a prefix of the B4 editing trace through `ShardedDoc` at 1 vs 8
+shards and measures:
+
+- routed updates/s end-to-end (host router + device YATA per shard);
+- `find_position` latency (the O(S) prefix-sum lookup vs the reference's
+  O(items) walk, types/text.rs:734 / block.rs:723);
+- the per-flush device step cost.
+
+Run: python benches/sp_axis.py [--ops N]. Prints one JSON line per shard
+count plus a summary comparing 8-shard to 1-shard throughput. CPU or TPU
+(whatever backend jax resolves; the capture labels it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _env import repin_jax_platforms  # noqa: E402
+
+repin_jax_platforms()
+
+
+def b4_prefix_updates(n_ops: int):
+    import bench as bench_mod
+
+    if os.path.exists(bench_mod.TRACE_PATH):
+        ops = bench_mod.load_b4_ops(n_ops)
+    else:
+        ops = bench_mod.synthetic_ops(n_ops)
+    return bench_mod.build_updates(ops)
+
+
+def run_shards(log, expect, n_shards: int, capacity: int = 2048) -> dict:
+    import jax
+
+    from ytpu.parallel.sharded_doc import ShardedDoc
+
+    sd = ShardedDoc(n_shards=n_shards, capacity=capacity)
+    t0 = time.perf_counter()
+    for p in log:
+        sd.apply_update_v1(p)
+    sd.flush()
+    dt = time.perf_counter() - t0
+    got = sd.get_string()
+    assert got == expect, f"sp replay mismatch: {got[:40]!r} != {expect[:40]!r}"
+
+    # find_position: prefix-sum lookup cost over the final doc
+    lens = sd.shard_lengths()  # warm the cached pull
+    total = int(lens.sum())
+    t0 = time.perf_counter()
+    n_lookups = 200
+    for i in range(n_lookups):
+        sd.find_position((i * 37) % max(1, total))
+    pos_dt = (time.perf_counter() - t0) / n_lookups
+    return {
+        "metric": f"sp{n_shards}_updates_per_sec",
+        "value": round(len(log) / dt, 1),
+        "unit": f"routed updates/s, {n_shards}-shard ShardedDoc "
+        f"({len(log)} B4-prefix updates)",
+        "find_position_us": round(1e6 * pos_dt, 1),
+        "doc_units": total,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", type=int, default=2000)
+    args = ap.parse_args()
+    log, expect = b4_prefix_updates(args.ops)
+    out = []
+    for s in (1, 8):
+        r = run_shards(log, expect, s)
+        out.append(r)
+        print(json.dumps(r), flush=True)
+    print(
+        json.dumps(
+            {
+                "metric": "sp_axis_8v1_speedup",
+                "value": round(out[1]["value"] / out[0]["value"], 3),
+                "unit": "8-shard / 1-shard routed updates/s "
+                "(host router shared; device YATA parallel over sp)",
+                "find_position_us_8": out[1]["find_position_us"],
+                "find_position_us_1": out[0]["find_position_us"],
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

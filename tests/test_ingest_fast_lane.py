@@ -499,3 +499,68 @@ def test_nested_types_ride_fast_lane():
     got = d.get_map("root")
     assert got.get("title") == "plain value"
     assert got.get("body").get_string() == "nested text"
+
+
+@needs_native
+def test_fast_lane_multi_root_doc():
+    """Multi-root docs (doc.rs:156-228, the reference's normal shape) ride
+    the FAST lane: the wire prescan registers root names, non-primary
+    roots anchor through BLOCK_ROOT_ANCHOR rows, and the device decode
+    resolves them via the key table (p_root) with zero host fallbacks."""
+    from ytpu.models.batch_doc import (
+        encode_diff_batch,
+        finish_encode_diff_batch,
+        get_tree,
+    )
+
+    doc = Doc(client_id=3)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    body = doc.get_text("body")
+    title = doc.get_text("title")
+    meta = doc.get_map("meta")
+    with doc.transact() as txn:
+        body.insert(txn, 0, "content here")
+    with doc.transact() as txn:
+        title.insert(txn, 0, "A Title")
+    with doc.transact() as txn:
+        meta.insert(txn, "lang", "en")
+    with doc.transact() as txn:
+        title.insert(txn, 7, "?")
+        body.insert(txn, 0, "* ")
+
+    ing = BatchIngestor(n_docs=2, capacity=256)
+    for p in log:
+        ing.apply_bytes([p, p])
+        assert _flags_clean(ing)
+    assert int(np.asarray(ing.state.error).max()) == 0
+    # everything after the first update (which creates the primary) should
+    # stay on the fast lane — anchors resolve on device
+    assert ing.fast_docs == 2 * len(log)
+    assert ing.primary_roots[0] == "body"
+    assert get_string(ing.state, 0, ing.payloads) == body.get_string()
+    for d in (0, 1):
+        tree = get_tree(
+            ing.state, d, ing.payloads, ing.enc.keys, interner=ing.enc.interner
+        )
+        assert tree["roots"]["title"]["seq"] == list("A Title?")
+        assert tree["roots"]["meta"]["map"] == {"lang": "en"}
+
+    # serving: a fresh replica reconstructs ALL roots from the device diff
+    import jax.numpy as jnp
+
+    C = max(8, len(ing.enc.interner))
+    remote = np.zeros((2, C), dtype=np.int32)
+    ship, offsets, _loc, deleted = encode_diff_batch(
+        ing.state, jnp.asarray(remote), C
+    )
+    payloads = finish_encode_diff_batch(
+        ing.state, [0, 1], ship, offsets, deleted, ing.enc,
+        payloads=ing.payloads, root_name="body",
+    )
+    for p in payloads:
+        d = Doc(client_id=77)
+        d.apply_update_v1(p)
+        assert d.get_text("body").get_string() == body.get_string()
+        assert d.get_text("title").get_string() == "A Title?"
+        assert d.get_map("meta").to_json() == {"lang": "en"}

@@ -226,3 +226,161 @@ def test_device_authoritative_pod_replicates_with_host_pod():
         await srv_h.wait_closed()
 
     run(main())
+
+
+def test_three_pod_mesh_partition_reconnect_convergence():
+    """VERDICT r3 #8: an N-pod line mesh (A<->B<->C) with 8 tenants and
+    concurrent writers converges within a BOUNDED number of pump rounds;
+    a killed link mid-stream reconnects and re-converges through the
+    symmetric SyncStep1 greeting alone (no sleeps-as-synchronization —
+    rounds are counted)."""
+
+    async def main():
+        pods = [SyncServer(), SyncServer(), SyncServer()]
+        ports = []
+        srvs = []
+        for p in pods:
+            srv, port = await serve(p)
+            srvs.append(srv)
+            ports.append(port)
+        tenants = [f"room{i}" for i in range(8)]
+        rep_ab = Replicator(pods[0], "127.0.0.1", ports[1])
+        rep_bc = Replicator(pods[1], "127.0.0.1", ports[2])
+        for t in tenants:
+            await rep_ab.add_tenant(t)
+            await rep_bc.add_tenant(t)
+
+        clients = []
+        for i, t in enumerate(tenants):
+            for pod_i in (i % 3, (i + 1) % 3):
+                c = SyncClient(Doc(client_id=1000 + 10 * i + pod_i))
+                await c.connect("127.0.0.1", ports[pod_i], t)
+                clients.append((t, c))
+        for _, c in clients:
+            await c.pump(max_frames=4, timeout=0.1)
+
+        marks: dict = {t: [] for t in tenants}
+        for i, (t, c) in enumerate(clients):
+            mark = f"w{i};"
+            marks[t].append(mark)
+            with c.doc.transact() as txn:
+                c.doc.get_text("t").insert(txn, 0, mark)
+            await c.flush()
+
+        def converged() -> bool:
+            for t in tenants:
+                texts = {p.doc(t).get_text("t").get_string() for p in pods}
+                if len(texts) != 1:
+                    return False
+                text = next(iter(texts))
+                if not all(m in text for m in marks[t]):
+                    return False
+            return True
+
+        rounds = 0
+        while not converged() and rounds < 16:
+            await rep_ab.pump(timeout=0.05)
+            await rep_bc.pump(timeout=0.05)
+            for _, c in clients:
+                await c.pump(max_frames=4, timeout=0.05)
+            rounds += 1
+        assert converged(), f"mesh did not converge within {rounds} rounds"
+
+        # --- partition: kill A<->B mid-stream, keep writing both sides ---
+        await rep_ab.close()
+        for i, (t, c) in enumerate(clients[:6]):
+            mark = f"p{i};"
+            marks[t].append(mark)
+            with c.doc.transact() as txn:
+                c.doc.get_text("t").insert(txn, 0, mark)
+            await c.flush()
+        # B<->C still converges between themselves while A drifts
+        for _ in range(6):
+            await rep_bc.pump(timeout=0.05)
+            for _, c in clients:
+                await c.pump(max_frames=4, timeout=0.05)
+        assert not converged()  # A is partitioned and must be behind
+
+        # --- reconnect: a FRESH replicator; greeting alone must repair ---
+        rep_ab2 = Replicator(pods[0], "127.0.0.1", ports[1])
+        for t in tenants:
+            await rep_ab2.add_tenant(t)
+        rounds2 = 0
+        while not converged() and rounds2 < 16:
+            await rep_ab2.pump(timeout=0.05)
+            await rep_bc.pump(timeout=0.05)
+            for _, c in clients:
+                await c.pump(max_frames=4, timeout=0.05)
+            rounds2 += 1
+        assert converged(), f"post-partition reconvergence took >{rounds2} rounds"
+
+        await rep_ab2.close()
+        await rep_bc.close()
+        for _, c in clients:
+            await c.close()
+        for srv in srvs:
+            srv.close()
+            await srv.wait_closed()
+
+    run(main())
+
+
+def test_slow_pod_link_evicted_and_resyncs():
+    """Backpressure at the pod level: a replica link whose peer stalls is
+    evicted as a slow consumer (outbox overflow -> ConnectionError on the
+    next pump) instead of growing server memory; a fresh link resyncs the
+    whole gap through the greeting SV-diff."""
+    from ytpu.sync.server import Session
+
+    async def main():
+        pod_a, pod_b = SyncServer(), SyncServer()
+        srv_a, port_a = await serve(pod_a)
+        srv_b, port_b = await serve(pod_b)
+        rep = Replicator(pod_a, "127.0.0.1", port_b)
+        link = await rep.add_tenant("room")
+
+        c1 = SyncClient(Doc(client_id=201))
+        await c1.connect("127.0.0.1", port_a, "room")
+        await c1.pump(max_frames=4, timeout=0.1)
+
+        cap = Session.OUTBOX_CAP
+        Session.OUTBOX_CAP = 16  # make the flood cheap
+        try:
+            # flood pod A while the replica link never pumps ("slow" B)
+            for i in range(Session.OUTBOX_CAP + 4):
+                with c1.doc.transact() as txn:
+                    c1.doc.get_text("t").insert(txn, 0, f"x{i};")
+                await c1.flush()
+                # the server handler pushes broadcasts into the link's
+                # outbox as frames arrive
+                await asyncio.sleep(0)
+            # let the server process the client frames without the link
+            for _ in range(8):
+                await c1.pump(max_frames=8, timeout=0.05)
+            assert link.session.dead, "stalled replica link was not evicted"
+            import pytest
+
+            with pytest.raises(ConnectionError):
+                await link.pump(timeout=0.05)
+        finally:
+            Session.OUTBOX_CAP = cap
+
+        # recovery: a fresh link resyncs everything through the greeting
+        rep2 = Replicator(pod_a, "127.0.0.1", port_b)
+        await rep2.add_tenant("room")
+        for _ in range(8):
+            await rep2.pump(timeout=0.05)
+            await c1.pump(max_frames=4, timeout=0.05)
+        a_text = pod_a.doc("room").get_text("t").get_string()
+        b_text = pod_b.doc("room").get_text("t").get_string()
+        assert a_text == b_text and "x0;" in b_text
+
+        await rep.close()  # the evicted link's TCP side must close too, or
+        # the peer pod's handler outlives the test and wait_closed() hangs
+        await rep2.close()
+        await c1.close()
+        for srv in (srv_a, srv_b):
+            srv.close()
+            await srv.wait_closed()
+
+    run(main())

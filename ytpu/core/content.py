@@ -62,6 +62,11 @@ CONTENT_ANY = 8
 CONTENT_DOC = 9
 BLOCK_SKIP = 10
 CONTENT_MOVE = 11
+# Device-engine sentinel (NOT a wire ref): a synthetic per-doc block row
+# anchoring a non-primary named root branch (doc.rs:156-228 multi-root
+# shape). Anchor rows have client == -1 (no wire identity, never ship);
+# blocks parented to one re-emit the root-name wire form at encode time.
+BLOCK_ROOT_ANCHOR = 12
 
 
 def utf16_len(s: str) -> int:

@@ -38,6 +38,7 @@ from ytpu.core import Doc, Update
 from ytpu.core.block import GCRange, Item, SkipRange
 from ytpu.core.content import (
     BLOCK_GC,
+    BLOCK_ROOT_ANCHOR,
     CONTENT_ANY,
     CONTENT_BINARY,
     CONTENT_DELETED,
@@ -62,6 +63,7 @@ __all__ = [
     "PayloadStore",
     "BatchEncoder",
     "finish_encode_diff_batch",
+    "ensure_root_anchor",
     "get_string",
     "get_map",
     "get_tree",
@@ -125,6 +127,8 @@ class UpdateBatch(NamedTuple):
     p_tag: jax.Array  # [*, U] i32 parent form: 0 inherit, 1 root, 2 branch id
     p_client: jax.Array  # [*, U] i32 branch-id parent (p_tag == 2)
     p_clock: jax.Array  # [*, U] i32
+    p_root: jax.Array  # [*, U] i32 root-name key id (p_tag == 1; -1 = the
+    # primary root branch, i.e. state.start — doc.rs:156-228 named roots)
     mv_sc: jax.Array  # [*, U] i32 move rows: range-start id client (-1 n/a)
     mv_sk: jax.Array  # [*, U] i32
     mv_sa: jax.Array  # [*, U] i32 start assoc (0 after, -1 before)
@@ -193,6 +197,63 @@ def init_state(n_docs: int, capacity: int) -> DocStateBatch:
         n_blocks=full((n_docs,), 0),
         error=full((n_docs,), 0),
     )
+
+
+@jax.jit
+def _append_root_anchor(state: DocStateBatch, doc, key_id) -> DocStateBatch:
+    """Idempotently append doc's BLOCK_ROOT_ANCHOR row for root `key_id`.
+
+    Anchors give non-primary named roots (doc.rs:156-228) a per-doc row
+    the integrate path can parent through (its `head` column is the root's
+    child-sequence head, exactly like a nested ContentType row). They have
+    no wire identity: client == -1 keeps them out of state vectors, ship
+    masks, and delete sets; compaction keeps and remaps them like any row.
+    """
+    bl = state.blocks
+    B = bl.client.shape[-1]
+    slots = jnp.arange(B, dtype=I32)
+    j = state.n_blocks[doc]
+    exists = jnp.any(
+        (slots < j)
+        & (bl.kind[doc] == BLOCK_ROOT_ANCHOR)
+        & (bl.key[doc] == key_id)
+    )
+    do = ~exists & (j < B)
+    overflow = ~exists & (j >= B)
+    wj = jnp.where(do, j, B)
+
+    def put(col, val):
+        return col.at[doc, wj].set(val, mode="drop")
+
+    new_bl = bl._replace(
+        kind=put(bl.kind, BLOCK_ROOT_ANCHOR),
+        key=put(bl.key, key_id),
+        client=put(bl.client, -1),
+        length=put(bl.length, 0),
+        head=put(bl.head, -1),
+        left=put(bl.left, -1),
+        right=put(bl.right, -1),
+        deleted=put(bl.deleted, False),
+        countable=put(bl.countable, False),
+    )
+    return DocStateBatch(
+        blocks=new_bl,
+        start=state.start,
+        n_blocks=state.n_blocks.at[doc].add(do.astype(I32)),
+        # error is a BITMASK — OR the flag in (".add" would drift the
+        # value across error classes on repeated overflows)
+        error=state.error.at[doc].set(
+            state.error[doc] | jnp.where(overflow, ERR_CAPACITY, 0)
+        ),
+    )
+
+
+def ensure_root_anchor(state: DocStateBatch, doc: int, key_id: int) -> DocStateBatch:
+    """Host entry: create doc's anchor row for a non-primary root (no-op
+    when it already exists). Call BEFORE applying updates whose rows carry
+    ``p_root == key_id`` — the integrate path resolves anchors, it never
+    creates them (missing anchor -> pending stash, like any missing dep)."""
+    return _append_root_anchor(state, jnp.int32(doc), jnp.int32(key_id))
 
 
 # --- per-doc primitives (vmapped over the doc axis) ---------------------------
@@ -423,6 +484,7 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array):
         r_ptag,
         r_pclient,
         r_pclock,
+        r_proot,
         r_mv_sc,
         r_mv_sk,
         r_mv_sa,
@@ -472,17 +534,35 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array):
 
     # resolve the parent branch (parity: block.rs:503-523 TypePtr handling):
     # p_tag 2 = a nested branch, addressed by its ContentType item's id;
-    # p_tag 1 = the root branch; p_tag 0 = omitted on the wire (an origin is
-    # present) — inherit from the resolved left (else right) anchor
+    # p_tag 1 = a named root — the primary branch (p_root < 0, state.start)
+    # or a non-primary root's anchor row (p_root = interned key id; the
+    # anchor is created by `ensure_root_anchor` before the apply);
+    # p_tag 0 = omitted on the wire (an origin is present) — inherit from
+    # the resolved left (else right) anchor
     parent_probe = jnp.where(linkable & (r_ptag == 2), r_pclient, -2)
     parent_slot = _find_slot(bl, state.n_blocks, parent_probe, r_pclock)
+    slots_b = jnp.arange(B, dtype=I32)
+    anchor_mask = (
+        (slots_b < state.n_blocks)
+        & (bl.kind == BLOCK_ROOT_ANCHOR)
+        & (bl.key == r_proot)
+    )
+    anchor_slot = jnp.where(
+        jnp.any(anchor_mask), jnp.argmax(anchor_mask).astype(I32), -1
+    )
+    root_row = jnp.where(r_proot >= 0, anchor_slot, -1)
     left_parent = jnp.where(left_idx >= 0, bl.parent[safe(left_idx)], -1)
     right_parent = jnp.where(right_idx >= 0, bl.parent[safe(right_idx)], -1)
     inherited_parent = jnp.where(left_idx >= 0, left_parent, right_parent)
     parent_row = jnp.where(
-        r_ptag == 2, parent_slot, jnp.where(r_ptag == 1, -1, inherited_parent)
+        r_ptag == 2,
+        parent_slot,
+        jnp.where(r_ptag == 1, root_row, inherited_parent),
     )
-    parent_missing = linkable & (r_ptag == 2) & (parent_slot < 0)
+    parent_missing = linkable & (
+        ((r_ptag == 2) & (parent_slot < 0))
+        | ((r_ptag == 1) & (r_proot >= 0) & (anchor_slot < 0))
+    )
     missing = missing | parent_missing
     linkable = linkable & ~parent_missing
 
@@ -888,6 +968,7 @@ def _apply_update_one_doc(
             batch.p_tag[i],
             batch.p_client[i],
             batch.p_clock[i],
+            batch.p_root[i],
             batch.mv_sc[i],
             batch.mv_sk[i],
             batch.mv_sa[i],
@@ -1083,7 +1164,12 @@ def _encode_device_row(
         out.write_right_id(ID(enc.interner.from_idx[rc], rk))
     if not has_o and not has_r:
         parent_row = int(bl.parent[r])
-        if parent_row >= 0:
+        if parent_row >= 0 and int(bl.kind[parent_row]) == BLOCK_ROOT_ANCHOR:
+            # non-primary named root: the anchor row has no wire identity —
+            # re-emit the root-name form with the anchor's interned name
+            out.write_parent_info(True)
+            out.write_string(enc.keys.names[int(bl.key[parent_row])])
+        elif parent_row >= 0:
             # nested branch: parent is the ContentType item's id
             out.write_parent_info(False)
             out.write_left_id(
@@ -1652,6 +1738,11 @@ class BatchEncoder:
         self.keys = KeyInterner()
         self.payloads = PayloadStore()
         self.root_name = root_name  # root branch of the device sequence
+        # Until a named root has been seen, the FIRST one encountered is
+        # ADOPTED as the batch root (legacy single-root callers never name
+        # their root at construction); later distinct names are true
+        # multi-root and anchor through BLOCK_ROOT_ANCHOR rows.
+        self._root_adopted = False
         # True once any encoded row was a map row or had a branch-id parent
         # (streams with such rows cannot take the fused Pallas path)
         self.saw_map_or_nested = False
@@ -1732,8 +1823,10 @@ class BatchEncoder:
         ordered, _ = self.partition_carriers(update)
         return ordered
 
-    def rows_from_update(self, update: Update) -> Tuple[list, list]:
-        rows = self.rows_from_carriers(self._ordered_carriers(update))
+    def rows_from_update(self, update: Update, primary_root=None) -> Tuple[list, list]:
+        rows = self.rows_from_carriers(
+            self._ordered_carriers(update), primary_root=primary_root
+        )
         dels = []
         for client, ranges in update.delete_set.clients.items():
             c = self.interner.intern(client)
@@ -1741,8 +1834,17 @@ class BatchEncoder:
                 dels.append((c, s, e))
         return rows, dels
 
-    def rows_from_carriers(self, carriers: list) -> list:
-        """Row tuples for already-ordered carriers (see partition_carriers)."""
+    def rows_from_carriers(self, carriers: list, primary_root=None) -> list:
+        """Row tuples for already-ordered carriers (see partition_carriers).
+
+        ``primary_root`` is the root name mapped onto the implicit device
+        branch (``state.start``); other named roots intern into the key
+        table and anchor through per-doc BLOCK_ROOT_ANCHOR rows
+        (doc.rs:156-228 multi-root shape). When omitted, the batch root is
+        used — and the first named root ever seen is adopted as it."""
+        explicit_primary = primary_root
+        if primary_root is None:
+            primary_root = self.root_name
         no_move = (-1, 0, 0, -1, 0, 0, -1)  # mv_sc..mv_prio padding
         rows = []
         for carrier in carriers:
@@ -1750,7 +1852,7 @@ class BatchEncoder:
             if isinstance(carrier, GCRange):
                 rows.append(
                     (c, carrier.id.clock, carrier.len, -1, 0, -1, 0,
-                     BLOCK_GC, -1, 0, -1, 0, -1, 0) + no_move
+                     BLOCK_GC, -1, 0, -1, 0, -1, 0, -1) + no_move
                 )
                 continue
             item: Item = carrier
@@ -1780,11 +1882,21 @@ class BatchEncoder:
                 else -1
             )
             parent = item.parent
+            p_root = -1
             if isinstance(parent, ID):
                 p_tag = 2
                 pc, pk = self.interner.intern(parent.client), parent.clock
-            elif parent is not None:  # named root (single-root device scope)
+            elif parent is not None:  # named root (doc.rs root branches)
                 p_tag, pc, pk = 1, -1, 0
+                if explicit_primary is None and not self._root_adopted:
+                    # first named root this encoder ever sees becomes the
+                    # batch root (legacy single-root behavior)
+                    self.root_name = primary_root = parent
+                    self._root_adopted = True
+                if parent != primary_root:
+                    # non-primary root: anchored through a per-doc
+                    # BLOCK_ROOT_ANCHOR row keyed by the interned name
+                    p_root = self.keys.intern(parent)
             else:  # omitted on the wire: inherit from the resolved anchor
                 p_tag, pc, pk = 0, -1, 0
             if key >= 0 or p_tag == 2:
@@ -1808,7 +1920,7 @@ class BatchEncoder:
                 mv = (sc, sk, sa, ec, ek, ea, max(move.priority, 0))
             rows.append(
                 (c, item.id.clock, item.len, oc, ok, rc, rk, kind, ref, 0,
-                 key, p_tag, pc, pk) + mv
+                 key, p_tag, pc, pk, p_root) + mv
             )
         return rows
 
@@ -1818,7 +1930,24 @@ class BatchEncoder:
         n_rows: Optional[int] = None,
         n_dels: Optional[int] = None,
     ) -> UpdateBatch:
-        """Pad per-doc rows into one [D, U] / [D, R] batch."""
+        """Pad per-doc rows into one [D, U] / [D, R] batch.
+
+        Each doc's primary root is ITS OWN first named root (docs in one
+        batch may use different root names; each maps onto its slot's
+        implicit branch — the pre-multi-root behavior for single-root
+        docs). Genuinely multi-root updates need per-doc anchor rows,
+        which `BatchIngestor` manages; raw build_batch callers get the
+        missing-dep flag for non-primary roots instead of silent aliasing.
+        """
+
+        def first_root(u: Update):
+            for blocks in u.blocks.values():
+                for b in blocks:
+                    p = getattr(b, "parent", None)
+                    if isinstance(p, str):
+                        return p
+            return None
+
         all_rows = []
         all_dels = []
         for u in updates:
@@ -1826,7 +1955,7 @@ class BatchEncoder:
                 all_rows.append([])
                 all_dels.append([])
             else:
-                r, d = self.rows_from_update(u)
+                r, d = self.rows_from_update(u, primary_root=first_root(u))
                 all_rows.append(r)
                 all_dels.append(d)
         return self.batch_from_rows(all_rows, all_dels, n_rows, n_dels)
@@ -1844,12 +1973,13 @@ class BatchEncoder:
         D = len(all_rows)
 
         def pad_rows():
-            out = np.zeros((D, U, 21), dtype=np.int32)
+            out = np.zeros((D, U, 22), dtype=np.int32)
             out[:, :, 10] = -1  # key padding must read as "sequence row"
             out[:, :, 12] = -1  # p_client padding
-            out[:, :, 14] = -1  # mv_sc padding
-            out[:, :, 17] = -1  # mv_ec padding
-            out[:, :, 20] = -1  # mv_prio padding
+            out[:, :, 14] = -1  # p_root padding (primary root)
+            out[:, :, 15] = -1  # mv_sc padding
+            out[:, :, 18] = -1  # mv_ec padding
+            out[:, :, 21] = -1  # mv_prio padding
             valid = np.zeros((D, U), dtype=bool)
             for d, rows in enumerate(all_rows):
                 for i, row in enumerate(rows):
@@ -1883,13 +2013,14 @@ class BatchEncoder:
             p_tag=jnp.asarray(rows[:, :, 11]),
             p_client=jnp.asarray(rows[:, :, 12]),
             p_clock=jnp.asarray(rows[:, :, 13]),
-            mv_sc=jnp.asarray(rows[:, :, 14]),
-            mv_sk=jnp.asarray(rows[:, :, 15]),
-            mv_sa=jnp.asarray(rows[:, :, 16]),
-            mv_ec=jnp.asarray(rows[:, :, 17]),
-            mv_ek=jnp.asarray(rows[:, :, 18]),
-            mv_ea=jnp.asarray(rows[:, :, 19]),
-            mv_prio=jnp.asarray(rows[:, :, 20]),
+            p_root=jnp.asarray(rows[:, :, 14]),
+            mv_sc=jnp.asarray(rows[:, :, 15]),
+            mv_sk=jnp.asarray(rows[:, :, 16]),
+            mv_sa=jnp.asarray(rows[:, :, 17]),
+            mv_ec=jnp.asarray(rows[:, :, 18]),
+            mv_ek=jnp.asarray(rows[:, :, 19]),
+            mv_ea=jnp.asarray(rows[:, :, 20]),
+            mv_prio=jnp.asarray(rows[:, :, 21]),
             valid=jnp.asarray(rows_valid),
             del_client=jnp.asarray(dels[:, :, 0]),
             del_start=jnp.asarray(dels[:, :, 1]),
@@ -1897,21 +2028,24 @@ class BatchEncoder:
             del_valid=jnp.asarray(dels_valid),
         )
 
-    def build_step(self, update: Update, n_rows: int, n_dels: int) -> UpdateBatch:
+    def build_step(
+        self, update: Update, n_rows: int, n_dels: int, primary_root=None
+    ) -> UpdateBatch:
         """One update as a doc-axis-free batch (leaves [U]/[R]) for
         `apply_update_stream`."""
-        rows, dels = self.rows_from_update(update)
+        rows, dels = self.rows_from_update(update, primary_root=primary_root)
         if len(rows) > n_rows or len(dels) > n_dels:
             raise ValueError(
                 f"update needs {len(rows)} rows/{len(dels)} dels, "
                 f"buckets are {n_rows}/{n_dels}"
             )
-        row_arr = np.zeros((n_rows, 21), dtype=np.int32)
+        row_arr = np.zeros((n_rows, 22), dtype=np.int32)
         row_arr[:, 10] = -1
         row_arr[:, 12] = -1
         row_arr[:, 14] = -1
-        row_arr[:, 17] = -1
-        row_arr[:, 20] = -1
+        row_arr[:, 15] = -1
+        row_arr[:, 18] = -1
+        row_arr[:, 21] = -1
         row_valid = np.zeros(n_rows, dtype=bool)
         for i, row in enumerate(rows):
             row_arr[i] = row
@@ -1936,13 +2070,14 @@ class BatchEncoder:
             p_tag=jnp.asarray(row_arr[:, 11]),
             p_client=jnp.asarray(row_arr[:, 12]),
             p_clock=jnp.asarray(row_arr[:, 13]),
-            mv_sc=jnp.asarray(row_arr[:, 14]),
-            mv_sk=jnp.asarray(row_arr[:, 15]),
-            mv_sa=jnp.asarray(row_arr[:, 16]),
-            mv_ec=jnp.asarray(row_arr[:, 17]),
-            mv_ek=jnp.asarray(row_arr[:, 18]),
-            mv_ea=jnp.asarray(row_arr[:, 19]),
-            mv_prio=jnp.asarray(row_arr[:, 20]),
+            p_root=jnp.asarray(row_arr[:, 14]),
+            mv_sc=jnp.asarray(row_arr[:, 15]),
+            mv_sk=jnp.asarray(row_arr[:, 16]),
+            mv_sa=jnp.asarray(row_arr[:, 17]),
+            mv_ec=jnp.asarray(row_arr[:, 18]),
+            mv_ek=jnp.asarray(row_arr[:, 19]),
+            mv_ea=jnp.asarray(row_arr[:, 20]),
+            mv_prio=jnp.asarray(row_arr[:, 21]),
             valid=jnp.asarray(row_valid),
             del_client=jnp.asarray(del_arr[:, 0]),
             del_start=jnp.asarray(del_arr[:, 1]),
@@ -2260,7 +2395,19 @@ def get_tree(
         return seq, mp
 
     seq, mp = render_branch(int(state.start[doc]), -1)
-    return {"seq": seq, "map": mp}
+    out = {"seq": seq, "map": mp}
+    # non-primary named roots live behind per-doc anchor rows
+    # (doc.rs:156-228 multi-root shape); render each under its name
+    roots: dict = {}
+    for i in range(n):
+        if int(bl.kind[i]) == BLOCK_ROOT_ANCHOR:
+            name = keys.names.get(int(bl.key[i]))
+            r_seq, r_mp = render_branch(int(bl.head[i]), i)
+            if name is not None:
+                roots[name] = {"seq": r_seq, "map": r_mp}
+    if roots:
+        out["roots"] = roots
+    return out
 
 
 def get_values(state: DocStateBatch, doc: int, payloads: PayloadStore) -> list:

@@ -66,6 +66,7 @@ def _state_from_numpy(flat: dict) -> DocStateBatch:
 def _enc_sidecar(enc: BatchEncoder) -> dict:
     return {
         "root_name": enc.root_name,
+        "root_adopted": getattr(enc, "_root_adopted", False),
         "interner_from_idx": list(enc.interner.from_idx),
         "key_names": dict(enc.keys.names),
         "payload_items": list(enc.payloads.items),
@@ -76,6 +77,7 @@ def _enc_sidecar(enc: BatchEncoder) -> dict:
 
 def _enc_restore(side: dict) -> BatchEncoder:
     enc = BatchEncoder(root_name=side["root_name"])
+    enc._root_adopted = bool(side.get("root_adopted", False))
     for client in side["interner_from_idx"]:
         enc.interner.intern(client)
     for kid in sorted(side["key_names"]):
@@ -120,6 +122,10 @@ def save_ingestor(path: str, ing: BatchIngestor, extra: Optional[dict] = None) -
             (base, flat.tobytes()) for base, flat in ing.payloads._chunks
         ],
         "wire_total": ing.payloads.total_bytes,
+        # multi-root docs: which name maps to the implicit branch, and
+        # which anchors already exist (anchor ROWS persist in the state)
+        "primary_roots": dict(ing.primary_roots),
+        "anchored_roots": [sorted(s) for s in ing._anchored_roots],
     }
     _save(path, ing.state, side)
 
@@ -164,6 +170,13 @@ def load_ingestor_with_extra(path: str) -> Tuple[BatchIngestor, dict]:
     for cid in ing.enc.interner.from_idx:
         if cid > 2**31 - 1:
             ing._register_big_client(cid)
+    ing.primary_roots = {
+        int(d): name for d, name in side.get("primary_roots", {}).items()
+    }
+    ing._anchored_roots = [
+        set(s)
+        for s in side.get("anchored_roots", [[] for _ in range(ing.n_docs)])
+    ]
     return ing, dict(side.get("extra", {}))
 
 

@@ -100,6 +100,11 @@ class BatchIngestor:
         # interned idx; colliding ids take the host lane
         self._client_hashes: Dict[int, int] = {}
         self._client_id_collisions: set = set()
+        # multi-root docs (doc.rs:156-228): the first named root seen per
+        # doc maps onto the implicit device branch; others anchor through
+        # BLOCK_ROOT_ANCHOR rows created before the apply
+        self.primary_roots: Dict[int, str] = {}
+        self._anchored_roots: List[set] = [set() for _ in range(n_docs)]
 
     def reset_slot(self, doc: int) -> None:
         """Return a doc slot to its empty state (start/-1, zero blocks,
@@ -116,6 +121,8 @@ class BatchIngestor:
         self.svs[doc] = StateVector()
         self._pending[doc] = {}
         self._pending_ds[doc] = DeleteSet()
+        self.primary_roots.pop(doc, None)
+        self._anchored_roots[doc] = set()
 
     # --- introspection (parity: ytransaction_pending_update/_ds shape) -------
 
@@ -170,6 +177,7 @@ class BatchIngestor:
             # its mirror SV only advances through its own incoming updates
             return [], []
         merged = self._merge_with_stash(doc, incoming)
+        self._register_roots_from_update(doc, merged)
         sv = self.svs[doc]
         applicable, leftover = self.enc.partition_carriers(merged, sv)
         for carrier in applicable:
@@ -189,7 +197,12 @@ class BatchIngestor:
                 else:  # split: tombstone what exists, defer the tail
                     dels.append((c, start, covered))
                     self._pending_ds[doc].insert_range(client, covered, end)
-        return self.enc.rows_from_carriers(applicable), dels
+        return (
+            self.enc.rows_from_carriers(
+                applicable, primary_root=self.primary_roots.get(doc)
+            ),
+            dels,
+        )
 
     def apply(
         self, payloads: List[Optional[bytes]], v2: bool = False
@@ -226,6 +239,12 @@ class BatchIngestor:
         dependency (the exactness the slow lane gets from
         `partition_carriers`)."""
         if cols.error or self._pending[doc] or not self._pending_ds[doc].is_empty():
+            return False
+        # named roots: record primaries, create anchors for the rest; any
+        # un-hashable/colliding root name routes the doc to the host lane
+        # (anchors created here are needed either way — both lanes
+        # integrate on device)
+        if not self._register_roots_from_cols(doc, cols):
             return False
         # Degenerate-but-legal wire shapes (many client sections holding only
         # covered Skip runs, many empty ds-client sections) are correct on
@@ -366,6 +385,69 @@ class BatchIngestor:
     def _key_table(self):
         """Device key table: (sorted hashes, interned key idx perm)."""
         return _sorted_table(self._key_hashes)
+
+    def _ensure_anchor(self, doc: int, name: str) -> None:
+        """Create doc's BLOCK_ROOT_ANCHOR row for a non-primary named root
+        (idempotent; the integrate path resolves anchors but never creates
+        them). A doc at block capacity does NOT mark the root anchored —
+        the next update retries after compaction frees slots, instead of
+        wedging every future row of that root as a missing dep."""
+        if name in self._anchored_roots[doc]:
+            return
+        from ytpu.models.batch_doc import ensure_root_anchor
+
+        if int(np.asarray(self.state.n_blocks[doc])) >= int(
+            self.state.blocks.client.shape[-1]
+        ):
+            return  # full: leave unanchored; rows stash + retry
+        kid = self.enc.keys.intern(name)
+        self.state = ensure_root_anchor(self.state, doc, kid)
+        self._anchored_roots[doc].add(name)
+
+    def _register_roots_from_cols(self, doc: int, cols) -> bool:
+        """Record named roots from the wire prescan; False -> host lane.
+
+        The first named root a doc ever mentions becomes its primary
+        (mapped onto the implicit device branch); later names anchor
+        through BLOCK_ROOT_ANCHOR rows. Names beyond the device hash
+        window, or whose hash collides in the key table, are host-lane
+        work."""
+        from ytpu.ops.decode_kernel import KEY_HASH_BYTES
+
+        ok = True
+        for i in range(cols.n_blocks):
+            if int(cols.parent_kind[i]) != 1:
+                continue
+            name = cols.parent_name(i)
+            prim = self.primary_roots.setdefault(doc, name)
+            if len(name.encode("utf-8")) > KEY_HASH_BYTES:
+                ok = False  # device can't hash this name (compare/resolve)
+                continue
+            if name == prim:
+                # register the PRIMARY's hash too: a later root whose hash
+                # collides with it would otherwise silently alias onto the
+                # primary branch on device (the unguarded collision
+                # channel; key-vs-key and client-id collisions already
+                # route to the host lane)
+                if not self._register_key(name):
+                    ok = False
+                continue
+            if not self._register_key(name):
+                ok = False
+                continue
+            self._ensure_anchor(doc, name)
+        return ok
+
+    def _register_roots_from_update(self, doc: int, update) -> None:
+        """Host-lane root registration: primaries + anchors from a decoded
+        Update (no hash-window limits — the host encodes names directly)."""
+        for blocks in update.blocks.values():
+            for b in blocks:
+                p = getattr(b, "parent", None)
+                if isinstance(p, str):
+                    prim = self.primary_roots.setdefault(doc, p)
+                    if p != prim:
+                        self._ensure_anchor(doc, p)
 
     def _client_table(self):
         """Device intern table: (sorted raw ids, perm to interned idx).
@@ -557,6 +639,13 @@ class BatchIngestor:
             base = self.payloads.add_chunk(
                 np.frombuffer(compact, dtype=np.uint8)
             )
+        from ytpu.ops.decode_kernel import key_hash_host
+
+        prim_hash = np.full(S, -1, dtype=np.int32)
+        for s_i, d in enumerate(fast_idx):
+            name = self.primary_roots.get(d)
+            if name is not None:
+                prim_hash[s_i] = key_hash_host(name.encode("utf-8"))
         stream, flags = decode_updates_v1(
             jnp.asarray(buf),
             jnp.asarray(lens),
@@ -567,6 +656,7 @@ class BatchIngestor:
             max_sections=max_sections,
             key_table=self._key_table(),
             client_hash_table=self._client_hash_table(),
+            primary_root_hash=jnp.asarray(prim_hash),
         )
         is_str_ref = stream.valid & (stream.content_ref >= 0)
         lane = jnp.arange(S, dtype=jnp.int32)[:, None]

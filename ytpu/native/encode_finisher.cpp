@@ -40,6 +40,10 @@ constexpr int32_t KIND_EMBED = 5;
 constexpr int32_t KIND_FORMAT = 6;
 constexpr int32_t KIND_TYPE = 7;
 constexpr int32_t KIND_ANY = 8;
+// engine sentinel, not a wire ref: a synthetic per-doc row anchoring a
+// non-primary named root (content.py BLOCK_ROOT_ANCHOR); rows parented
+// to one re-emit the root-name wire form with the anchor's key name
+constexpr int32_t KIND_ROOT_ANCHOR = 12;
 
 constexpr int32_t STATUS_OK = 0;
 constexpr int32_t STATUS_FALLBACK = 1;
@@ -543,11 +547,21 @@ class DocEncoder {
       if (parent_row >= 0) {
         if (parent_row >= in_.n_blocks_cap) return false;
         const int64_t p = base_ + parent_row;
-        const int32_t pc = in_.client[p];
-        if (pc < 0 || pc >= in_.n_interned) return false;
-        out.var(0);  // parent_info: nested (not a root name)
-        out.var(static_cast<uint64_t>(in_.from_idx[pc]));
-        out.var(static_cast<uint64_t>(in_.clock[p]));
+        if (in_.kind[p] == KIND_ROOT_ANCHOR) {
+          // non-primary named root: emit the root-name form with the
+          // anchor's interned key name
+          const int32_t rkey = in_.key[p];
+          if (rkey < 0 || rkey >= in_.n_keys) return false;
+          const int64_t ks = in_.key_off[rkey], ke = in_.key_off[rkey + 1];
+          out.var(1);
+          out.str(in_.key_blob + ks, static_cast<size_t>(ke - ks));
+        } else {
+          const int32_t pc = in_.client[p];
+          if (pc < 0 || pc >= in_.n_interned) return false;
+          out.var(0);  // parent_info: nested (not a root name)
+          out.var(static_cast<uint64_t>(in_.from_idx[pc]));
+          out.var(static_cast<uint64_t>(in_.clock[p]));
+        }
       } else {
         out.var(1);  // parent_info: root name
         out.str(in_.root_name, static_cast<size_t>(in_.root_name_len));

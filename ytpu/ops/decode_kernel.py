@@ -275,6 +275,7 @@ def decode_updates_v1(
     max_sections: Optional[int] = None,
     key_table: Optional[Tuple[jax.Array, jax.Array]] = None,
     client_hash_table: Optional[Tuple[jax.Array, jax.Array]] = None,
+    primary_root_hash: Optional[jax.Array] = None,
 ) -> Tuple[UpdateBatch, jax.Array]:
     """Decode S updates into an ``[S, U] / [S, R]`` UpdateBatch stream.
 
@@ -308,6 +309,14 @@ def decode_updates_v1(
     updates don't trip the garbage-header guard. Pair it with an
     ``n_steps`` budget that covers the extra section fields
     (`exact_steps`).
+
+    ``primary_root_hash`` ([S] i32, -1 = legacy single-root lane) enables
+    multi-root decode (doc.rs:156-228): a named-root parent whose name
+    hash equals the lane's primary maps to the implicit branch
+    (``p_root == -1``); other names resolve through ``key_table`` to the
+    anchor key id (miss -> FLAG_UNKNOWN_KEY, name beyond the hash
+    window -> FLAG_UNSUPPORTED). Without it every named root aliases to
+    the primary branch — the pre-multi-root behavior.
     """
     S, L = buf.shape
     U, R = max_rows, max_dels
@@ -359,6 +368,8 @@ def decode_updates_v1(
             n_rows=jnp.zeros((S,), I32),
             n_dels=jnp.zeros((S,), I32),
             keyh=jnp.full((S,), -1, I32),  # parent_sub hash (-1 = none)
+            rooth=jnp.full((S,), -1, I32),  # root parent name hash (-1 =
+            # not a named-root parent; -2 = name beyond the hash window)
             vals_left=jnp.zeros((S,), I32),  # Any/Json values remaining
             vals_n=jnp.zeros((S,), I32),  # total value count (clock len)
             cref=jnp.full((S,), -1, I32),  # content span start byte
@@ -382,6 +393,7 @@ def decode_updates_v1(
             pc=jnp.full((S, U), -1, I32),
             pk=jnp.zeros((S, U), I32),
             keyh=jnp.full((S, U), -1, I32),
+            rooth=jnp.full((S, U), -1, I32),
             msc=jnp.full((S, U), -1, I32),
             msk=jnp.zeros((S, U), I32),
             msa=jnp.zeros((S, U), I32),
@@ -787,6 +799,15 @@ def decode_updates_v1(
         regs2["keyh"] = upd(
             upd(regs["keyh"], on(ST_INFO), -1), on(ST_PARENT_SUB), khash
         )
+        # root-parent name hash (multi-root docs, doc.rs:156-228): khash is
+        # computed from the CURRENT string's bytes, which at ST_PARENT_NAME
+        # are the root name; names beyond the hash window mark -2 (resolved
+        # lanes flag unsupported — legacy single-root callers ignore it)
+        regs2["rooth"] = upd(
+            upd(regs["rooth"], on(ST_INFO), -1),
+            on(ST_PARENT_NAME),
+            jnp.where(v <= KEY_HASH_BYTES, khash, -2),
+        )
         count_st = on(ST_ANY_COUNT) | on(ST_JSON_COUNT)
         regs2["vals_n"] = upd(regs["vals_n"], count_st, v)
         regs2["vals_left"] = upd(vals_left2, count_st, v)
@@ -864,6 +885,7 @@ def decode_updates_v1(
         put_row("pc", jnp.where(is_gc_row, -1, regs["pc"]))
         put_row("pk", jnp.where(is_gc_row, 0, regs["pk"]))
         put_row("keyh", jnp.where(is_gc_row, -1, regs["keyh"]))
+        put_row("rooth", jnp.where(is_gc_row, -1, regs["rooth"]))
         # ContentMove range fields (moving.rs:189-215 flag layout): assoc
         # columns use the engine convention 0 = After, -1 = Before; a
         # collapsed move's end is its start; end clock is the CURRENT
@@ -906,12 +928,14 @@ def decode_updates_v1(
     flags = regs["flags"] | jnp.where(regs["st"] != ST_DONE, FLAG_MALFORMED, 0)
 
     return _resolve_and_pack(
-        rows, dels, flags, client_table, key_table, client_hash_table
+        rows, dels, flags, client_table, key_table, client_hash_table,
+        primary_root_hash,
     )
 
 
 def _resolve_and_pack(
-    rows, dels, flags, client_table, key_table, client_hash_table
+    rows, dels, flags, client_table, key_table, client_hash_table,
+    primary_root_hash=None,
 ):
     """Shared post-decode pass for the V1 and V2 device lanes: raw client
     ids -> interned indices (`client_table`), big-client hash entries ->
@@ -1030,6 +1054,31 @@ def _resolve_and_pack(
         jnp.any(key_miss, axis=1), FLAG_UNKNOWN_KEY, 0
     )
 
+    # named-root parents (multi-root docs): the lane's primary root name
+    # maps to the implicit branch (p_root -1); other names resolve through
+    # the same key table to their anchor's key id
+    rooth = rows.get("rooth")
+    p_root_col = jnp.full((S, U), -1, I32)
+    if rooth is not None and primary_root_hash is not None:
+        prim = primary_root_hash[:, None]
+        named = rows["valid"] & (rows["ptag"] == 1) & (prim >= 0)
+        nonprim = named & (rooth >= 0) & (rooth != prim)
+        root_long = named & (rooth == -2)
+        root_miss = nonprim
+        if key_table is not None and key_table[0].shape[0] > 0:
+            rhashes, rperm = key_table
+            rj = jnp.clip(
+                jnp.searchsorted(rhashes, rooth), 0, rhashes.shape[0] - 1
+            )
+            rhit = nonprim & (rhashes[rj] == rooth)
+            p_root_col = jnp.where(rhit, rperm[rj], -1)
+            root_miss = nonprim & ~rhit
+        flags = (
+            flags
+            | jnp.where(jnp.any(root_miss, axis=1), FLAG_UNKNOWN_KEY, 0)
+            | jnp.where(jnp.any(root_long, axis=1), FLAG_UNSUPPORTED, 0)
+        )
+
     # lanes that errored out must not contribute partial rows
     lane_ok = (flags & FLAG_ERRORS) == 0
     valid = rows["valid"] & lane_ok[:, None]
@@ -1051,6 +1100,7 @@ def _resolve_and_pack(
         p_tag=rows["ptag"],
         p_client=rows["pc"],
         p_clock=rows["pk"],
+        p_root=p_root_col,
         mv_sc=rows.get("msc", neg_u),
         mv_sk=rows.get("msk", z_u),
         mv_sa=rows.get("msa", z_u),

@@ -413,6 +413,7 @@ def decode_updates_v2(
     client_table: Optional[Tuple[jax.Array, jax.Array]] = None,
     key_table: Optional[Tuple[jax.Array, jax.Array]] = None,
     client_hash_table: Optional[Tuple[jax.Array, jax.Array]] = None,
+    primary_root_hash: Optional[jax.Array] = None,
 ):
     """Decode S V2 updates into an ``[S, U] / [S, R]`` UpdateBatch stream.
 
@@ -651,34 +652,50 @@ def decode_updates_v2(
     content_start = str_at(content_sidx, str_start)
     content_len16 = str_at(content_sidx, str16)
 
-    # parent_sub key hash — identical mixing to the V1 lane / key_hash_host
-    kh_idx = jnp.clip(
-        psub_start[:, :, None] + jnp.arange(KEY_HASH_BYTES, dtype=I32)[None, None, :],
-        0,
-        L - 1,
-    )
-    kh_b = jnp.take_along_axis(b, kh_idx.reshape(S, -1), axis=1).reshape(
-        S, NB, KEY_HASH_BYTES
-    )
-    kh_m = (
-        jnp.arange(KEY_HASH_BYTES, dtype=I32)[None, None, :]
-        < psub_bytes[:, :, None]
-    )
+    # parent_sub / root-name hashes — identical mixing to the V1 lane's
+    # key_hash_host (shared table resolution on both lanes)
     pow31 = jnp.asarray(
         np.array(
             [pow(31, i, 1 << 32) for i in range(KEY_HASH_BYTES)], dtype=np.uint32
         )
     )
-    khash = jnp.sum(
-        jnp.where(kh_m, kh_b.astype(U32) * pow31[None, None, :], 0).astype(U32),
-        axis=2,
-    )
-    khash = (
-        (khash ^ (psub_bytes.astype(U32) * jnp.uint32(2654435761)))
-        & jnp.uint32(0x7FFFFFFF)
-    ).astype(I32)
+
+    def name_hash(start, nbytes):
+        """[S, NB] hash of the string column entry at byte `start`."""
+        idx = jnp.clip(
+            start[:, :, None]
+            + jnp.arange(KEY_HASH_BYTES, dtype=I32)[None, None, :],
+            0,
+            L - 1,
+        )
+        w = jnp.take_along_axis(b, idx.reshape(S, -1), axis=1).reshape(
+            S, NB, KEY_HASH_BYTES
+        )
+        m = (
+            jnp.arange(KEY_HASH_BYTES, dtype=I32)[None, None, :]
+            < nbytes[:, :, None]
+        )
+        h = jnp.sum(
+            jnp.where(m, w.astype(U32) * pow31[None, None, :], 0).astype(U32),
+            axis=2,
+        )
+        return (
+            (h ^ (nbytes.astype(U32) * jnp.uint32(2654435761)))
+            & jnp.uint32(0x7FFFFFFF)
+        ).astype(I32)
+
+    khash = name_hash(psub_start, psub_bytes)
     keyh = jnp.where(valid_blk & has_psub, khash, -1)
     key_too_long = valid_blk & has_psub & (psub_bytes > KEY_HASH_BYTES)
+    # root-parent names (is_root rows consume the string at s_base)
+    rname_start = str_at(s_base, str_start)
+    rname_bytes = str_at(s_base, str_bytes)
+    rhash = name_hash(rname_start, rname_bytes)
+    rooth = jnp.where(
+        valid_blk & is_root,
+        jnp.where(rname_bytes <= KEY_HASH_BYTES, rhash, -2),
+        -1,
+    )
 
     # block lengths + clocks
     blk_len = jnp.where(
@@ -816,6 +833,7 @@ def decode_updates_v2(
         pc=scatter(pc, -1),
         pk=scatter(pk, 0),
         keyh=scatter(keyh, -1),
+        rooth=scatter(rooth, -1),
         valid=jnp.any(oh, axis=1),
     )
 
@@ -841,5 +859,6 @@ def decode_updates_v2(
     )
 
     return _resolve_and_pack(
-        rows, dels, flags, client_table, key_table, client_hash_table
+        rows, dels, flags, client_table, key_table, client_hash_table,
+        primary_root_hash,
     )

@@ -30,6 +30,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ytpu.core.content import (
     BLOCK_GC,
+    BLOCK_ROOT_ANCHOR,
     CONTENT_DELETED,
     CONTENT_FORMAT,
     CONTENT_MOVE,
@@ -166,7 +167,7 @@ def unpack_state(
 
 
 def pack_stream(stream: UpdateBatch) -> Tuple[jax.Array, jax.Array]:
-    """Stacked doc-axis-free stream → rows [S, U, 22] / dels [S, R, 4] i32."""
+    """Stacked doc-axis-free stream → rows [S, U, 23] / dels [S, R, 4] i32."""
     rows = jnp.stack(
         [
             stream.client,
@@ -191,9 +192,10 @@ def pack_stream(stream: UpdateBatch) -> Tuple[jax.Array, jax.Array]:
             stream.mv_ek,
             stream.mv_ea,
             stream.mv_prio,
+            stream.p_root,
         ],
         axis=-1,
-    )  # [S, U, 22]
+    )  # [S, U, 23]
     dels = jnp.stack(
         [
             stream.del_client,
@@ -392,6 +394,7 @@ def _kernel(
         r_mv_ek = rows_ref[s, u, 19]
         r_mv_ea = rows_ref[s, u, 20]
         r_mv_prio = rows_ref[s, u, 21]
+        r_proot = rows_ref[s, u, 22]
         is_move_row = r_kind == CONTENT_MOVE
 
         local = client_clock(r_client)  # (DB,)
@@ -445,12 +448,28 @@ def _kernel(
         left_parent = gather(PA, left_idx, -1)
         right_parent = gather(PA, right_idx, -1)
         inherited_parent = jnp.where(left_idx >= 0, left_parent, right_parent)
+        # named-root parents: primary (p_root < 0) -> the doc sequence;
+        # non-primary -> the BLOCK_ROOT_ANCHOR row keyed by the root id
+        # (created host-side before the apply; absence = missing dep)
+        anchor_m = (
+            (iota_c < n_blocks()[:, None])
+            & (col(KD) == BLOCK_ROOT_ANCHOR)
+            & (col(KEY) == r_proot)
+        )
+        anchor_idx = jnp.min(jnp.where(anchor_m, iota_c, C), axis=1).astype(I32)
+        anchor_found = anchor_idx < C
+        root_row = jnp.where(
+            (r_proot >= 0) & anchor_found, anchor_idx, -1
+        )
         parent_row = jnp.where(
             r_ptag == 2,
             parent_slot,
-            jnp.where(r_ptag == 1, -1, inherited_parent),
+            jnp.where(r_ptag == 1, root_row, inherited_parent),
         )
-        parent_missing = linkable & (r_ptag == 2) & (parent_slot < 0)
+        parent_missing = linkable & (
+            ((r_ptag == 2) & (parent_slot < 0))
+            | ((r_ptag == 1) & (r_proot >= 0) & ~anchor_found)
+        )
         missing = missing | parent_missing
         linkable = linkable & ~parent_missing
         if row_phase < 3:

@@ -34,10 +34,16 @@ Storage vs anchors: every row stores its TRUE origin/right-origin ids
 while anchoring on host-localized ids; the two coincide except at segment
 boundaries.
 
-Scope (round 3): root-sequence documents (YText / YArray shapes — string,
-Any, deleted and format runs). Map components, nested branches, moves and
-GC-range carriers raise; sharded docs keep tombstones (the `skip_gc`
-regime of the reference, store.rs:139-151).
+Scope (round 4): root-sequence documents (YText / YArray shapes — string,
+Any, deleted and format runs) PLUS root map components: per-key LWW
+chains hold no sequence position, so each key's whole chain lives on
+shard ``key id % S`` (origins/right-origins of chain rows are shard-local
+by construction — no halo cases), integrated by the same YATA scan with
+the chain head as the no-left entry point and journaled for byte-exact
+encode parity (a host chain mirror records LWW tombstones /
+dead-on-arrival at their true order). Nested branches, moves and
+GC-range carriers still raise; sharded docs keep tombstones (the
+`skip_gc` regime of the reference, store.rs:139-151).
 """
 
 from __future__ import annotations
@@ -115,6 +121,7 @@ class SpStep(NamedTuple):
     kind: jax.Array
     content_ref: jax.Array
     content_off: jax.Array
+    key: jax.Array  # interned parent_sub (-1 = sequence row)
     valid: jax.Array  # bool
     del_client: jax.Array
     del_start: jax.Array
@@ -145,6 +152,7 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
         r_kind,
         r_ref,
         r_off,
+        r_key,
         r_valid,
     ) = row
     bl = state.blocks
@@ -169,7 +177,21 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
     linkable = linkable & ~anchor_missing
 
     safe = lambda idx: jnp.maximum(idx, 0)
-    anchor0 = state.start
+    # map rows (parent_sub keys) anchor on their key chain's leftmost item,
+    # not the segment sequence (parity: block.rs:541-551); chains are
+    # whole-shard-resident by routing (key id % S), so the scan is local
+    is_map = r_key >= 0
+    slots_c = jnp.arange(B, dtype=I32)
+    chain_mask = (
+        (slots_c < state.n_blocks)
+        & (bl.key == r_key)
+        & (bl.left == -1)
+        & is_map
+    )
+    chain_head = jnp.where(
+        jnp.any(chain_mask), jnp.argmax(chain_mask).astype(I32), -1
+    )
+    anchor0 = jnp.where(is_map, chain_head, state.start)
 
     # --- conflict scan (parity: block.rs:537-602) ---
     right_left = jnp.where(right_idx >= 0, bl.left[safe(right_idx)], -1)
@@ -213,12 +235,18 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
     )
     w_left = jnp.where(has_left, left_idx, B)
     new_right_col = _set(bl.right, w_left, j)
-    new_start = jnp.where(linkable & ~has_left, j, state.start)
+    # map rows never move the segment head (parity: block.rs:618-632)
+    new_start = jnp.where(linkable & ~has_left & ~is_map, j, state.start)
     w_right = jnp.where(linkable & (right_final >= 0), right_final, B)
     new_left_col = _set(bl.left, w_right, j)
 
-    row_deleted = r_kind == CONTENT_DELETED
-    row_countable = ~row_deleted & (r_kind != CONTENT_FORMAT)
+    # a map row landing with a right neighbor is a losing concurrent write
+    # (parity: block.rs:751-765 "deleted on arrival")
+    dead_on_arrival = linkable & is_map & (right_final >= 0)
+    row_deleted = (r_kind == CONTENT_DELETED) | dead_on_arrival
+    # map rows are not sequence content: they never count toward visible
+    # positions (the sp prefix sums sum countable rows shard-wide)
+    row_countable = ~row_deleted & (r_kind != CONTENT_FORMAT) & ~is_map
 
     new_bl = BlockCols(
         client=_set(bl.client, wj, r_client),
@@ -235,7 +263,7 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
         kind=_set(bl.kind, wj, r_kind),
         content_ref=_set(bl.content_ref, wj, r_ref),
         content_off=_set(bl.content_off, wj, r_off),
-        key=_set(bl.key, wj, -1),
+        key=_set(bl.key, wj, jnp.where(is_map, r_key, -1)),
         parent=_set(bl.parent, wj, -1),
         head=_set(bl.head, wj, -1),
         moved=_set(bl.moved, wj, -1),
@@ -247,6 +275,12 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
         mv_ea=bl.mv_ea,
         mv_prio=bl.mv_prio,
     )
+    # a map row that became its chain's tail is the key's new live value;
+    # the previous winner — its immediate left — gets tombstoned (parity:
+    # block.rs:637-659)
+    new_tail = linkable & is_map & (right_final < 0)
+    w_prev = jnp.where(new_tail & has_left, left_idx, B)
+    new_bl = new_bl._replace(deleted=_set(new_bl.deleted, w_prev, True))
     error = (
         state.error
         | jnp.where(overflow, ERR_CAPACITY, 0)
@@ -282,6 +316,7 @@ def _apply_step_one_shard(
             step.kind[i],
             step.content_ref[i],
             step.content_off[i],
+            step.key[i],
             step.valid[i],
         )
         return jax.lax.cond(
@@ -417,6 +452,15 @@ class ShardedDoc:
         # pipeline (squash steps 5-7, transaction.rs:828-962 + apply_delete's
         # split rules, transaction.rs:472-575) would have left standing.
         self._journal: Dict[int, List[tuple]] = {}
+        # host mirror of the per-key LWW chains (map components): chain
+        # order + member facts, enough to journal LWW tombstones and
+        # dead-on-arrival exactly (the device state stays authoritative)
+        self._chains: Dict[int, List[dict]] = {}
+        # (client, clock_unit) -> key id for every unit of every chain
+        # member: the wire omits parent_sub when an origin/right-origin is
+        # present (block.rs:604-612), so map REPLACEMENT rows are
+        # recognized by their anchors pointing into a chain
+        self._map_id_index: Dict[Tuple[int, int], int] = {}
         self._queue_rows: List[List[tuple]] = [[] for _ in range(n_shards)]
         self._queue_dels: List[List[tuple]] = [[] for _ in range(n_shards)]
         self._queued = 0
@@ -447,11 +491,12 @@ class ShardedDoc:
         # bucket pads to limit jit cache entries
         U = 1 << (U - 1).bit_length()
         R = 1 << (R - 1).bit_length()
-        rows = np.zeros((self.S, U, 14), dtype=np.int32)
+        rows = np.zeros((self.S, U, 15), dtype=np.int32)
         rows[:, :, 3] = -1  # s_oc
         rows[:, :, 5] = -1  # s_rc
         rows[:, :, 7] = -1  # a_oc
         rows[:, :, 9] = -1  # a_rc
+        rows[:, :, 14] = -1  # key (sequence row)
         valid = np.zeros((self.S, U), dtype=bool)
         dels = np.zeros((self.S, R, 3), dtype=np.int32)
         del_valid = np.zeros((self.S, R), dtype=bool)
@@ -477,6 +522,7 @@ class ShardedDoc:
             kind=jnp.asarray(rows[:, :, 11]),
             content_ref=jnp.asarray(rows[:, :, 12]),
             content_off=jnp.asarray(rows[:, :, 13]),
+            key=jnp.asarray(rows[:, :, 14]),
             valid=jnp.asarray(valid),
             del_client=jnp.asarray(dels[:, :, 0]),
             del_start=jnp.asarray(dels[:, :, 1]),
@@ -579,6 +625,24 @@ class ShardedDoc:
         clock, length = item.id.clock, item.len
         if local >= clock + length:
             return  # full duplicate
+        if isinstance(item.parent, ID):
+            raise NotImplementedError(
+                "sharded docs: nested branches are routed to their parent's "
+                "shard in a future round (sequence + map components today)"
+            )
+        if isinstance(item.parent, str):
+            # adopt the doc's root name from the wire (the encode re-emits
+            # it for origin-less rows); a SECOND distinct root is out of
+            # the sharded scope — one ShardedDoc shards one root branch
+            if not self.enc._root_adopted:
+                self.enc.root_name = item.parent
+                self.enc._root_adopted = True
+            elif item.parent != self.enc.root_name:
+                raise NotImplementedError(
+                    "sharded docs shard ONE root branch; shard each root "
+                    f"separately (saw {item.parent!r} after "
+                    f"{self.enc.root_name!r})"
+                )
         content = item.content
         offset = 0
         if local > clock:
@@ -594,7 +658,7 @@ class ShardedDoc:
             ref = enc.payloads.add(kind, content)
         else:
             raise NotImplementedError(
-                f"sharded docs support sequence content only (kind={kind})"
+                f"sharded docs support sequence/map content only (kind={kind})"
             )
         c = enc.interner.intern(real_client)
         if offset:
@@ -612,6 +676,58 @@ class ShardedDoc:
             )
         else:
             s_r = None
+
+        key_id = None
+        if item.parent_sub is not None:
+            key_id = enc.keys.intern(item.parent_sub)
+        elif s_o is not None and s_o in self._map_id_index:
+            key_id = self._map_id_index[s_o]  # map replacement (key omitted
+            # on the wire when an origin rides along, block.rs:604-612)
+        elif s_r is not None and s_r in self._map_id_index:
+            key_id = self._map_id_index[s_r]  # concurrent loser keyed by ror
+        if key_id is not None:
+            # map component: per-key LWW chain, no sequence position. ALL
+            # rows of a key live on shard (key id % S) — origin-ful writes
+            # route via the directory (the origin IS a chain row, already
+            # on that shard), so every anchor is shard-local by
+            # construction and no boundary/halo case exists.
+            if s_o is not None:
+                target = self.dir.owner(*s_o)
+                if target is None:
+                    raise RuntimeError(
+                        f"map origin {s_o} not in directory (routing bug)"
+                    )
+            else:
+                target = key_id % self.S
+            if s_r is not None:
+                r_owner = self.dir.owner(*s_r)
+                if r_owner is not None and r_owner != target:
+                    raise RuntimeError(
+                        "map right-origin off its key shard (routing bug)"
+                    )
+            born_dead, tombstoned = self._map_chain_insert(
+                key_id, c, clock, length, s_o, s_r
+            )
+            row = self._make_row(
+                c, clock, length, s_o, s_r, s_o, s_r, kind, ref, offset,
+                key=key_id,
+            )
+            self._enqueue_row(target, row)
+            # the LWW replacement is a delete in the oracle's commit (the
+            # replaced value joins the merge-candidate set) — journal it
+            # on ITS client so squash boundaries replay exactly
+            if tombstoned is not None:
+                self._journal.setdefault(tombstoned["c"], []).append(
+                    ("d", tombstoned["clock"],
+                     tombstoned["clock"] + tombstoned["len"])
+                )
+            self._journal_row(
+                c, clock, length, s_o, s_r, kind, key=key_id,
+                born_dead=born_dead or kind == CONTENT_DELETED,
+            )
+            self.dir.add(c, clock, clock + length, target)
+            self.sv.set_max(real_client, clock + length)
+            return
 
         if s_o is not None:
             target = self.dir.owner(*s_o)
@@ -655,8 +771,70 @@ class ShardedDoc:
         self.dir.add(c, clock, clock + length, target)
         self.sv.set_max(real_client, clock + length)
 
+    def _map_chain_insert(self, key_id, c, clock, length, s_o, s_r):
+        """Host mirror of the device key-chain YATA (block.rs:537-659 over
+        one short chain): inserts the member, returns ``(born_dead,
+        tombstoned_member_or_None)``. The device state stays authoritative;
+        this mirror exists so the journal can record LWW tombstones and
+        dead-on-arrival facts exactly when they happen."""
+        chain = self._chains.setdefault(key_id, [])
+        from_idx = self.enc.interner.from_idx
+
+        def covering(iid):
+            for i, m in enumerate(chain):
+                if m["c"] == iid[0] and m["clock"] <= iid[1] < m["clock"] + m["len"]:
+                    return i
+            return None
+
+        left_i = covering(s_o) if s_o is not None else None
+        right_i = covering(s_r) if s_r is not None else None
+        end = right_i if right_i is not None else len(chain)
+        ins = left_i + 1 if left_i is not None else 0
+        new_real = from_idx[c]
+        before: set = set()
+        conflicting: set = set()
+        idx = ins
+        while idx < end:
+            m = chain[idx]
+            before.add(idx)
+            conflicting.add(idx)
+            same_origin = m["s_o"] == s_o
+            if same_origin:
+                if from_idx[m["c"]] < new_real:
+                    ins = idx + 1
+                    conflicting = set()
+                elif m["s_r"] == s_r:
+                    break
+            else:
+                mo = covering(m["s_o"]) if m["s_o"] is not None else None
+                if mo is not None and mo in before and mo not in conflicting:
+                    ins = idx + 1
+                    conflicting = set()
+                elif mo is None or mo not in before:
+                    break
+            idx += 1
+        born_dead = ins < len(chain)
+        tombstoned = None
+        if not born_dead and ins > 0:
+            tombstoned = chain[ins - 1]
+            tombstoned["deleted"] = True
+        chain.insert(
+            ins,
+            {
+                "c": c,
+                "clock": clock,
+                "len": length,
+                "s_o": s_o,
+                "s_r": s_r,
+                "deleted": bool(born_dead),
+            },
+        )
+        for u in range(length):
+            self._map_id_index[(c, clock + u)] = key_id
+        return born_dead, tombstoned
+
     @staticmethod
-    def _make_row(c, clock, length, s_o, s_r, a_o, a_r, kind, ref, off):
+    def _make_row(c, clock, length, s_o, s_r, a_o, a_r, kind, ref, off, key=-1):
         return (
             c,
             clock,
@@ -672,6 +850,7 @@ class ShardedDoc:
             kind,
             ref,
             off,
+            key,
         )
 
     # ---------------------------------------------- boundary (halo) resolve
@@ -689,6 +868,26 @@ class ShardedDoc:
                 if guard > st.blocks.client.shape[-1] + 1:
                     raise RuntimeError("cycle in shard linked list")
         return out
+
+    def _chain_rows(self, st) -> List[List[Tuple[int, int]]]:
+        """Map key chains as (shard, slot) runs in chain order — separate
+        adjacency runs from the sequence (map rows hold no doc position)."""
+        bl = st.blocks
+        runs: List[List[Tuple[int, int]]] = []
+        for s in range(self.S):
+            n = int(st.n_blocks[s])
+            for h in range(n):
+                if int(bl.key[s, h]) < 0 or int(bl.left[s, h]) >= 0:
+                    continue
+                run, cur, guard = [], h, 0
+                while cur >= 0:
+                    run.append((s, cur))
+                    cur = int(bl.right[s, cur])
+                    guard += 1
+                    if guard > n + 1:
+                        raise RuntimeError("cycle in map chain")
+                runs.append(run)
+        return runs
 
     def _resolve_boundary(
         self, item, c, clock, length, s_o, s_r, kind, ref, off
@@ -849,6 +1048,8 @@ class ShardedDoc:
         s_r: Optional[Tuple[int, int]],
         kind: int,
         anchor_o: Optional[Tuple[int, int]] = None,
+        key: int = -1,
+        born_dead: Optional[bool] = None,
     ) -> None:
         """Record a routed row for encode-parity replay.
 
@@ -874,8 +1075,10 @@ class ShardedDoc:
                 ("s", anchor_o[1] + 1)
             )
         chain_ok = s_o is not None and s_o == (c, clock - 1)
+        if born_dead is None:
+            born_dead = kind == CONTENT_DELETED
         self._journal.setdefault(c, []).append(
-            ("a", clock, length, kind == CONTENT_DELETED, chain_ok, s_r, kind)
+            ("a", clock, length, born_dead, chain_ok, s_r, kind, key)
         )
 
     def _route_delete(self, real_client: int, start: int, end: int) -> None:
@@ -952,6 +1155,28 @@ class ShardedDoc:
             out.extend(get_values(self.state, s, self.enc.payloads))
         return out
 
+    def get_map(self) -> dict:
+        """The root map component's live values (chain tails; LWW)."""
+        st = self._pull()
+        bl = st.blocks
+        out: dict = {}
+        for run in self._chain_rows(st):
+            s, r = run[-1]  # chain tail = the key's live value
+            if bool(bl.deleted[s, r]):
+                continue
+            name = self.enc.keys.names.get(int(bl.key[s, r]))
+            kind = int(bl.kind[s, r])
+            if name is None or kind != CONTENT_ANY:
+                continue
+            vals = self.enc.payloads.slice_values(
+                int(bl.content_ref[s, r]),
+                int(bl.content_off[s, r]),
+                int(bl.length[s, r]),
+            )
+            if vals:
+                out[name] = vals[-1]
+        return out
+
     # ------------------------------------------------------------- encoding
 
     def _row_item(self, st, s: int, r: int) -> Item:
@@ -978,6 +1203,8 @@ class ShardedDoc:
             content = stored
         else:  # pragma: no cover - scope-guarded at routing
             raise NotImplementedError(f"kind {kind}")
+        key = int(bl.key[s, r])
+        sub = enc.keys.names.get(key) if key >= 0 else None
         item = Item(
             ID(real, int(bl.clock[s, r])),
             None,
@@ -985,13 +1212,13 @@ class ShardedDoc:
             None,
             ror,
             self.enc.root_name if origin is None and ror is None else None,
-            None,
+            sub,
             content,
         )
         item.deleted = bool(bl.deleted[s, r])
         return item
 
-    def _oracle_boundaries(self, c: int, items, order) -> set:
+    def _oracle_boundaries(self, c: int, items, succ) -> set:
         """Replay this client's journal to reconstruct the block boundaries
         the oracle's commit pipeline leaves standing.
 
@@ -1011,7 +1238,6 @@ class ShardedDoc:
             ((it.id.clock, key) for key, it in items.items() if it.id.client == rc),
             key=lambda e: e[0],
         )
-        succ = {order[i]: order[i + 1] for i in range(len(order) - 1)}
         # final-state compatibility for DELETE-time squash tests only:
         # chain/ror/kind are immutable and doc-adjacency is monotone-
         # breaking, so "final-adjacent" implies "adjacent at test time"
@@ -1027,12 +1253,13 @@ class ShardedDoc:
                 and b.origin.clock == ck_b - 1
                 and _same_ror_items(a, b)
                 and type(a.content) is type(b.content)
+                and a.parent_sub == b.parent_sub
                 and succ.get(key_a) == key_b
             )
 
         bset: set = set()
         dead: List[Tuple[int, int]] = []
-        arrivals: List[Tuple[int, object, int]] = []  # (start, ror, kind)
+        arrivals: List[tuple] = []  # (start, ror, kind, key)
         arrival_starts: List[int] = []  # parallel sorted keys for run_info
         blocked: set = set()  # tail junctions occupied by other rows
 
@@ -1043,29 +1270,30 @@ class ShardedDoc:
             return j == 0 or j in bset
 
         def run_info(clock_unit: int):
-            """(ror, kind) of the arrival covering `clock_unit` — splits
-            never change a piece's right-origin (splice keeps it) so the
-            original arrival's facts hold for every later fragment."""
+            """(ror, kind, key) of the arrival covering `clock_unit` —
+            splits never change a piece's right-origin (splice keeps it) so
+            the original arrival's facts hold for every later fragment."""
             i = bisect_right(arrival_starts, clock_unit) - 1
-            return arrivals[i][1:] if i >= 0 else (None, -1)
+            return arrivals[i][1:] if i >= 0 else (None, -1, -1)
 
         tail = 0
         for ev in self._journal.get(c, []):
             if ev[0] == "a":
-                _, clock, ln, born_dead, chain_ok, ror, kind = ev
+                _, clock, ln, born_dead, chain_ok, ror, kind, key = ev
                 if clock > 0:
-                    left_ror, left_kind = run_info(clock - 1)
+                    left_ror, left_kind, left_key = run_info(clock - 1)
                     merged = (
                         tail == clock
                         and chain_ok
                         and clock not in blocked
                         and left_ror == ror
                         and left_kind == kind
+                        and left_key == key
                         and is_dead(clock - 1) == bool(born_dead)
                     )
                     if not merged:
                         bset.add(clock)
-                arrivals.append((clock, ror, kind))
+                arrivals.append((clock, ror, kind, key))
                 arrival_starts.append(clock)
                 tail = max(tail, clock + ln)
                 if born_dead:
@@ -1113,16 +1341,19 @@ class ShardedDoc:
         commit-time squash would have stored, then encoded by the host
         update encoder (byte parity with the oracle by construction)."""
         st = self._pull()
-        order = self._global_rows(st)
-        bl = st.blocks
+        # adjacency RUNS: the doc-order sequence plus each map key chain —
+        # squash adjacency (a.right is b) never crosses a run boundary
+        runs = [self._global_rows(st)] + self._chain_rows(st)
         succ: Dict[Tuple[int, int], Tuple[int, int]] = {}
-        for gi in range(len(order) - 1):
-            succ[order[gi]] = order[gi + 1]
+        for run in runs:
+            for gi in range(len(run) - 1):
+                succ[run[gi]] = run[gi + 1]
 
         items: Dict[Tuple[int, int], Item] = {}
         merged_into: Dict[Tuple[int, int], Tuple[int, int]] = {}
-        for s, r in order:
-            items[(s, r)] = self._row_item(st, s, r)
+        for run in runs:
+            for s, r in run:
+                items[(s, r)] = self._row_item(st, s, r)
 
         def root(k):
             while k in merged_into:
@@ -1131,26 +1362,28 @@ class ShardedDoc:
 
         interned = self.enc.interner.to_idx
         boundaries = {
-            c: self._oracle_boundaries(c, items, order) for c in self._journal
+            c: self._oracle_boundaries(c, items, succ) for c in self._journal
         }
-        for gi in range(len(order) - 1):
-            a_key, b_key = root(order[gi]), order[gi + 1]
-            a, b = items[a_key], items[b_key]
-            if (
-                a.id.client == b.id.client
-                and a.id.clock + a.len == b.id.clock
-                and b.origin is not None
-                and b.origin.client == a.id.client
-                and b.origin.clock == a.id.clock + a.len - 1
-                and _same_ror_items(a, b)
-                and a.deleted == b.deleted
-                and b.id.clock
-                not in boundaries.get(interned.get(a.id.client, -1), ())
-                and a.content.merge(b.content)
-            ):
-                a.len += b.len
-                merged_into[b_key] = a_key
-                del items[b_key]
+        for run in runs:
+            for gi in range(len(run) - 1):
+                a_key, b_key = root(run[gi]), run[gi + 1]
+                a, b = items[a_key], items[b_key]
+                if (
+                    a.id.client == b.id.client
+                    and a.id.clock + a.len == b.id.clock
+                    and b.origin is not None
+                    and b.origin.client == a.id.client
+                    and b.origin.clock == a.id.clock + a.len - 1
+                    and _same_ror_items(a, b)
+                    and a.deleted == b.deleted
+                    and a.parent_sub == b.parent_sub
+                    and b.id.clock
+                    not in boundaries.get(interned.get(a.id.client, -1), ())
+                    and a.content.merge(b.content)
+                ):
+                    a.len += b.len
+                    merged_into[b_key] = a_key
+                    del items[b_key]
 
         blocks: Dict[int, deque] = {}
         for key in sorted(items, key=lambda k: (items[k].id.client, items[k].id.clock)):
@@ -1186,6 +1419,16 @@ class ShardedDoc:
         rows: List[Dict[str, int]] = []
         for s, r in order:
             rows.append({n: int(getattr(bl, n)[s, r]) for n in BlockCols._fields})
+        # map key chains hold no doc position: they stay on their key
+        # shard (key id % S), re-appended after the sequence re-cut
+        chains: List[List[Dict[str, int]]] = []
+        for run in self._chain_rows(st):
+            chains.append(
+                [
+                    {n: int(getattr(bl, n)[s, r]) for n in BlockCols._fields}
+                    for s, r in run
+                ]
+            )
         total = sum(r["length"] for r in rows)
         per_units = max(1, -(-total // self.S))
 
@@ -1216,7 +1459,17 @@ class ShardedDoc:
                 tgt, acc = tgt + 1, 0
                 row = right_part
 
-        n_max = max(1, max(len(q) for q in out_rows))
+        # re-place map chains: each chain appended whole to its key shard
+        chain_rows: List[List[List[Dict[str, int]]]] = [[] for _ in range(self.S)]
+        for chain in chains:
+            chain_rows[chain[0]["key"] % self.S].append(chain)
+        n_max = max(
+            1,
+            max(
+                len(out_rows[s]) + sum(len(ch) for ch in chain_rows[s])
+                for s in range(self.S)
+            ),
+        )
         cap = self.capacity
         while cap < n_max * 2:
             cap *= 2
@@ -1245,6 +1498,20 @@ class ShardedDoc:
                 start[s] = 0
                 n_blocks[s] = len(out_rows[s])
                 self.first_id[s] = (out_rows[s][0]["client"], out_rows[s][0]["clock"])
+            li = len(out_rows[s])
+            for chain in chain_rows[s]:
+                for ci, row in enumerate(chain):
+                    for name in BlockCols._fields:
+                        arrays[name][s, li + ci] = row[name]
+                    arrays["left"][s, li + ci] = li + ci - 1 if ci > 0 else -1
+                    arrays["right"][s, li + ci] = (
+                        li + ci + 1 if ci + 1 < len(chain) else -1
+                    )
+                    self.dir.add(
+                        row["client"], row["clock"], row["clock"] + row["length"], s
+                    )
+                li += len(chain)
+            n_blocks[s] = li
         self.state = DocStateBatch(
             blocks=BlockCols(**{n: jnp.asarray(a) for n, a in arrays.items()}),
             start=jnp.asarray(start),

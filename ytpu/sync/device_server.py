@@ -50,8 +50,10 @@ class DeviceSyncServer(SyncServer):
     `n_docs` bounds the tenant count (one slot per tenant, assigned on
     first touch). Updates accumulate per slot and ship on `flush_device()`
     — call it per request batch, on a timer, or from the serving loop.
-    Flagship scope: single-root tenants (the batch encoder maps named
-    roots onto one device root branch).
+    Multi-root tenants (doc.rs:156-228, the reference's normal doc shape)
+    are device-resident: the first named root maps onto the implicit
+    device branch, later ones anchor through per-doc BLOCK_ROOT_ANCHOR
+    rows the ingestor creates from the wire prescan.
     """
 
     def __init__(
@@ -163,19 +165,12 @@ class DeviceSyncServer(SyncServer):
                         Message.sync(SyncMessage.step2(diff)).encode_v1()
                     )
                 else:  # SyncStep2 / Update: straight to the device slot
-                    if self._note_roots(session.tenant, sub.payload):
-                        # a second root name: this update must NOT touch
-                        # the single-root device slot — demote the tenant
-                        # and route it plus the rest of the frame through
-                        # the host path
-                        self._demote_to_host(session.tenant)
-                        w = Writer()
-                        for rest in msgs[i:]:
-                            rest.encode(w)
-                        replies.extend(
-                            super().receive_frames(session, w.to_bytes())
-                        )
-                        return replies
+                    # record the tenant's root names (the first becomes the
+                    # wire primary); non-primary roots stay device-resident
+                    # via the ingestor's BLOCK_ROOT_ANCHOR rows — multi-root
+                    # tenants are served from the batch like any other
+                    # (doc.rs:156-228 is the reference's normal doc shape)
+                    self._note_roots(session.tenant, sub.payload)
                     self._queues[slot].append(sub.payload)
                     self._applied.inc()
                     # broadcast at-least-once (idempotent CRDT updates;
@@ -224,8 +219,9 @@ class DeviceSyncServer(SyncServer):
 
     def _note_roots(self, tenant: str, payload: bytes) -> bool:
         """Record the tenant's root names from one inbound update; True
-        when the tenant just turned multi-root (caller must demote BEFORE
-        the update reaches the device slot)."""
+        when the tenant just turned multi-root (observability only — the
+        batch engine anchors non-primary roots per doc, so multi-root
+        tenants stay device-resident)."""
         names = self._scan_root_names(payload)
         if not names:
             return False
@@ -235,16 +231,16 @@ class DeviceSyncServer(SyncServer):
         if any(n != known for n in names):
             from ytpu.utils import metrics
 
-            metrics.counter("sync.multi_root_demotions").inc()
+            metrics.counter("sync.multi_root_tenants").inc()
             return True
         return False
 
     def _demote_to_host(self, tenant: str) -> None:
-        """Move a tenant from its device slot to the host path: integrate
-        everything queued, materialize the host doc from device state, and
-        route the tenant through `SyncServer` from now on. Correctness
-        over speed — a multi-root tenant silently aliased onto one device
-        root would corrupt every fresh replica."""
+        """Escape hatch: move a tenant from its device slot to the host
+        path (integrate everything queued, materialize the host doc from
+        device state, route through `SyncServer` from now on). No longer
+        used for multi-root tenants — the batch engine serves those via
+        per-doc root anchors — but kept for operational fallback."""
         self.flush_device()
         doc = self.tenant(tenant).awareness.doc
         diff = self.device_encode_diff(tenant, doc.state_vector())
