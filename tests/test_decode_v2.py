@@ -8,6 +8,7 @@ VERDICT r2 #5: a V2-encoded B4 stream rides the raw-bytes lane with zero
 host fallbacks.
 """
 
+import os
 import random
 import string as _string
 
@@ -177,13 +178,14 @@ def test_map_rows_parent_sub_keys():
 
     doc, log = capture_v1(ops)
     v2 = [v1_to_v2(p) for p in log]
-    # ContentAny map values are host-lane in v0 — but parent_sub keys on a
-    # *text-valued* map row must resolve through the key table.
-    # Use a nested text under a map key instead: that is ContentType →
-    # unsupported too. So assert the Any case FLAGS (host fallback), which
-    # is the documented contract.
+    # Round 4 widened the lane: ContentAny map values DEVICE-decode. The
+    # parent_sub key must resolve through the key table — without one the
+    # lane flags FLAG_UNKNOWN_KEY (host fallback interns for next step).
+    from ytpu.ops.decode_kernel import FLAG_UNKNOWN_KEY
+
     _, stream, flags = decode(v2)
-    assert (flags & FLAG_UNSUPPORTED != 0).all()
+    assert (flags & FLAG_UNKNOWN_KEY != 0).all()
+    assert not np.asarray(stream.valid).any()
     assert not np.asarray(stream.valid).any()
 
 
@@ -415,3 +417,126 @@ def test_big_client_ids_resolve_through_hash_table():
 
     _, flags2 = decode_updates_v2(buf, lens, spans, 8, 8)
     assert np.asarray(flags2)[0] & FLAG_BIG_CLIENT
+
+
+@pytest.mark.skipif(
+    not os.environ.get("YTPU_RUN_SLOW"),
+    reason="full-trace V2 decode (minutes); set YTPU_RUN_SLOW=1",
+)
+def test_b4_full_trace_rides_v2_device_lane():
+    """VERDICT r3 #4 'done' criterion, first half: the FULL 259,778-op B4
+    editing trace, V2-encoded, decodes on the V2 device lane with ZERO
+    host fallbacks (chunked; every lane's flags clean), and a sampled
+    chunk integrates to text parity with the host replay."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    from ytpu.models.batch_doc import apply_update_stream, get_string, init_state
+    from ytpu.ops.decode_kernel import RawPayloadView, identity_rank
+
+    log, expect, trace = bench.load_full_log()
+    v2 = [v1_to_v2(p) for p in log]
+    CHUNK = 8192
+    total_flagged = 0
+    for base in range(0, len(v2), CHUNK):
+        part = v2[base : base + CHUNK]
+        buf, lens, spans = pack_updates_v2(part, pad_to=64)
+        stream, flags = decode_updates_v2(buf, lens, spans, 4, 4)
+        f = np.asarray(flags)
+        total_flagged += int((f & FLAG_ERRORS != 0).sum())
+    assert total_flagged == 0, f"{total_flagged} lanes fell back to host"
+
+    # parity spot-check: integrate the first chunk and compare against a
+    # host replay of the same prefix
+    n = min(CHUNK, len(log))
+    doc = Doc(client_id=99)
+    for p in log[:n]:
+        doc.apply_update_v1(p)
+    buf, lens, spans = pack_updates_v2(v2[:n], pad_to=64)
+    stream, flags = decode_updates_v2(buf, lens, spans, 4, 4)
+    state = init_state(1, 1 << 14)
+    state = apply_update_stream(state, stream, identity_rank(2))
+    assert int(np.asarray(state.error).max()) == 0
+    got = get_string(state, 0, RawPayloadView(np.asarray(buf)))
+    assert got == doc.get_text("text").get_string()
+
+
+def test_widened_content_kinds_ride_device_lane():
+    """VERDICT r3 #4: the V2 columnar decoder's rest WALKER device-decodes
+    Any values (depth-1 lists/objects), Binary bufs, map LWW chains (via
+    the key table) and Move payloads with ZERO host fallbacks — the V2
+    lane's supported set now covers every north-star array/map workload
+    shape. (Type/Embed/Format/Json/Doc content still routes to the host:
+    their V2 wire splits across columns in forms the V1-shaped span
+    readers cannot address; they stay per-lane flagged.)"""
+    import jax.numpy as jnp
+
+    from ytpu.models.batch_doc import (
+        KeyInterner,
+        apply_update_stream,
+        get_tree,
+        init_state,
+    )
+    from ytpu.ops.decode_kernel import (
+        RawPayloadView,
+        identity_rank,
+        key_hash_host,
+    )
+
+    d = Doc(client_id=3)
+    log = []
+    d.observe_update_v1(lambda p, o, t: log.append(p))
+    arr = d.get_array("a")
+    with d.transact() as txn:
+        arr.insert_range(txn, 0, [1, "two", 3.5, True, None])
+    with d.transact() as txn:
+        arr.insert_range(txn, 2, [[1, 2], {"k": 7}])
+    with d.transact() as txn:
+        arr.insert_range(txn, 0, [b"\x00\xffbinary"])
+    with d.transact() as txn:
+        arr.remove_range(txn, 2, 2)
+    m = d.get_map("a")
+    with d.transact() as txn:
+        m.insert(txn, "x", 42)
+    with d.transact() as txn:
+        m.insert(txn, "x", 43)  # LWW replacement (origin-chained)
+    with d.transact() as txn:
+        arr.move_to(txn, 1, 3)
+
+    v2 = [v1_to_v2(p) for p in log]
+    buf, lens, spans = pack_updates_v2(v2, pad_to=128)
+    keys = KeyInterner()
+    kt = (
+        jnp.asarray([key_hash_host(b"x")]),
+        jnp.asarray([keys.intern("x")]),
+    )
+    stream, flags = decode_updates_v2(buf, lens, spans, 8, 4, key_table=kt)
+    f = np.asarray(flags)
+    assert (f & FLAG_ERRORS == 0).all(), f"host fallbacks: {f}"
+
+    state = init_state(1, 256)
+    state = apply_update_stream(state, stream, identity_rank(2))
+    assert int(np.asarray(state.error).max()) == 0
+    view = RawPayloadView(np.asarray(buf), v2_any=True)
+    tree = get_tree(state, 0, view, keys)
+    assert tree["seq"] == arr.to_json(), (tree["seq"], arr.to_json())
+    assert tree["map"] == {"x": 43}, tree["map"]
+
+
+def test_deep_any_values_fall_back_to_host():
+    """Any values nested beyond depth 1 (an object holding a list) exceed
+    the walker's scope and must flag the lane — never decode wrong."""
+    d = Doc(client_id=5)
+    log = []
+    d.observe_update_v1(lambda p, o, t: log.append(p))
+    arr = d.get_array("a")
+    with d.transact() as txn:
+        arr.insert_range(txn, 0, [{"deep": [1, 2, 3]}])
+    v2 = [v1_to_v2(p) for p in log]
+    buf, lens, spans = pack_updates_v2(v2, pad_to=128)
+    stream, flags = decode_updates_v2(buf, lens, spans, 4, 4)
+    f = np.asarray(flags)
+    assert (f & FLAG_UNSUPPORTED != 0).all(), f
+    assert not np.asarray(stream.valid).any()  # flagged lanes emit no rows

@@ -1743,6 +1743,9 @@ class BatchEncoder:
         # their root at construction); later distinct names are true
         # multi-root and anchor through BLOCK_ROOT_ANCHOR rows.
         self._root_adopted = False
+        # build_batch slot primaries: doc index -> its first named root,
+        # sticky across calls (each slot keeps its own implicit branch)
+        self.doc_primaries: Dict[int, str] = {}
         # True once any encoded row was a map row or had a branch-id parent
         # (streams with such rows cannot take the fused Pallas path)
         self.saw_map_or_nested = False
@@ -1932,17 +1935,20 @@ class BatchEncoder:
     ) -> UpdateBatch:
         """Pad per-doc rows into one [D, U] / [D, R] batch.
 
-        Each doc's primary root is ITS OWN first named root (docs in one
-        batch may use different root names; each maps onto its slot's
-        implicit branch — the pre-multi-root behavior for single-root
-        docs). Genuinely multi-root updates need per-doc anchor rows,
-        which `BatchIngestor` manages; raw build_batch callers get the
-        missing-dep flag for non-primary roots instead of silent aliasing.
+        Each doc slot's primary root is the first named root it EVER used
+        (sticky across build_batch calls on this encoder, recorded in
+        `doc_primaries` — docs in one batch may use different root names;
+        each maps onto its slot's implicit branch, matching the
+        pre-multi-root behavior for single-root docs). Genuinely
+        multi-root updates need per-doc anchor rows, which `BatchIngestor`
+        manages; raw build_batch callers get the missing-dep flag for
+        non-primary roots instead of silent aliasing.
         """
 
         def first_root(u: Update):
-            for blocks in u.blocks.values():
-                for b in blocks:
+            # wire order: clients descending, then block order
+            for c in sorted(u.blocks, reverse=True):
+                for b in u.blocks[c]:
                     p = getattr(b, "parent", None)
                     if isinstance(p, str):
                         return p
@@ -1950,12 +1956,18 @@ class BatchEncoder:
 
         all_rows = []
         all_dels = []
-        for u in updates:
+        for d_i, u in enumerate(updates):
             if u is None:
                 all_rows.append([])
                 all_dels.append([])
             else:
-                r, d = self.rows_from_update(u, primary_root=first_root(u))
+                fr = first_root(u)
+                prim = (
+                    self.doc_primaries.setdefault(d_i, fr)
+                    if fr is not None
+                    else self.doc_primaries.get(d_i)
+                )
+                r, d = self.rows_from_update(u, primary_root=prim)
                 all_rows.append(r)
                 all_dels.append(d)
         return self.batch_from_rows(all_rows, all_dels, n_rows, n_dels)

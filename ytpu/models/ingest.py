@@ -440,13 +440,19 @@ class BatchIngestor:
 
     def _register_roots_from_update(self, doc: int, update) -> None:
         """Host-lane root registration: primaries + anchors from a decoded
-        Update (no hash-window limits — the host encodes names directly)."""
+        Update (no hash-window limits — the host encodes names directly).
+        The primary's DEVICE hash registers here too: a later fast-lane
+        root whose hash collides with it must hit the collision guard and
+        route to the host, never silently alias onto the primary branch."""
         for blocks in update.blocks.values():
             for b in blocks:
                 p = getattr(b, "parent", None)
                 if isinstance(p, str):
                     prim = self.primary_roots.setdefault(doc, p)
-                    if p != prim:
+                    if p == prim:
+                        self._register_key(p)  # collision guard; result
+                        # re-checked per fast update in _register_roots_from_cols
+                    else:
                         self._ensure_anchor(doc, p)
 
     def _client_table(self):

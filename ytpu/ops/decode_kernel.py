@@ -1177,6 +1177,22 @@ def _wire_any_values(flat: np.ndarray, start: int, off: int, length: int) -> lis
     return out
 
 
+def _wire_any_values_countless(
+    flat: np.ndarray, start: int, off: int, length: int
+) -> list:
+    """V2-lane ContentAny span: values start AT `start` (the count lives in
+    the len column — the caller's `off + length` bounds the read)."""
+    from ytpu.encoding.lib0 import Cursor, read_any
+
+    cur = Cursor(bytes(flat[start:]))
+    out = []
+    for i in range(off + length):
+        v = read_any(cur)
+        if i >= off:
+            out.append(v)
+    return out
+
+
 def _wire_json_values(flat: np.ndarray, start: int, off: int, length: int) -> list:
     """ContentJson at `start`: count then JSON strings (parsed, None on
     parse failure — ContentJSON.values parity)."""
@@ -1268,13 +1284,19 @@ class RawPayloadView:
     start.
     """
 
-    def __init__(self, buf: np.ndarray):
+    def __init__(self, buf: np.ndarray, v2_any: bool = False):
         self.buf = np.ascontiguousarray(buf, dtype=np.uint8).reshape(-1)
+        # V2-lane states: ContentAny refs point at the FIRST value byte
+        # (the V2 wire keeps the element count in the len COLUMN, so the
+        # span is count-less; the row's length is the count)
+        self.v2_any = v2_any
 
     def slice_text(self, ref: int, off: int, length: int) -> str:
         return utf8_slice_u16(self.buf, int(ref), off, length)
 
     def slice_values(self, ref: int, off: int, length: int) -> list:
+        if self.v2_any:
+            return _wire_any_values_countless(self.buf, int(ref), off, length)
         return _wire_any_values(self.buf, int(ref), off, length)
 
     def json_values(self, ref: int, off: int, length: int) -> list:

@@ -52,7 +52,14 @@ import numpy as np
 from ytpu.core.content import (
     BLOCK_GC,
     BLOCK_SKIP,
+    CONTENT_ANY,
+    CONTENT_BINARY,
     CONTENT_DELETED,
+    CONTENT_DOC,
+    CONTENT_EMBED,
+    CONTENT_FORMAT,
+    CONTENT_JSON,
+    CONTENT_MOVE,
     CONTENT_STRING,
 )
 from ytpu.encoding.lib0 import Cursor
@@ -403,6 +410,341 @@ def _cumsum_excl(x):
     return jnp.cumsum(x, axis=1) - x
 
 
+# rest-walker FSM states
+(
+    W_NC,
+    W_SEC_N,
+    W_SEC_CLK,
+    W_BLK,
+    W_SKIP,
+    W_MVF,
+    W_MSC,
+    W_MSK,
+    W_MEC,
+    W_MEK,
+    W_ANY,
+    W_MKEY,
+    W_MVAL,
+    W_BUF,
+    W_DS,
+    W_DONE,
+) = range(16)
+
+
+def _rest_walker(
+    b, start, end, NV: int, NB: int, is_skip, any_cnt, is_buf, is_move
+):
+    """Sequential rest-stream walker for lanes whose blocks put NON-VARINT
+    bytes in the rest buffer (Any values, Binary bufs, Move payloads —
+    encoder.rs:253-260 routes content through `rest` while ids/lens ride
+    the RLE columns).
+
+    Walks the stream with a per-lane FSM driven by the per-block content
+    plan (`is_skip` / `any_cnt` / `is_buf` / `is_move`, all [S, NB] from
+    the info/len columns): structural varints (section headers, skip
+    lengths, the delete set) are decoded into output slots with the SAME
+    numbering the flat bulk parse assigns to content-free lanes — so all
+    downstream slot arithmetic is shared — while content regions are
+    excised, their byte spans recorded per block (`c_start`), and Move
+    payload fields parsed inline (they are plain varints). Any values
+    step one VALUE per iteration with the V1 machine's depth-1 scope
+    (arrays spawn element steps, objects key/value steps; deeper nesting
+    sets `deep`, routing the lane to the host). Client-id-sized move
+    fields beyond i32 hash to ``-2 - client_hash`` exactly like `vat_id`.
+
+    Returns dict(vv, vstart, vovf [S, NV], n_varints [S], c_start, mvf,
+    msc, msk, mec, mek [S, NB], bad [S], deep [S]).
+    """
+    S, L = b.shape
+    pow31_10 = jnp.asarray(
+        np.array([pow(31, i, 1 << 32) for i in range(10)], dtype=np.uint32)
+    )
+
+    def win_hash(w10):
+        """client_hash_host mixing over a varint's bytes ([S, 10] window)."""
+        cont = w10 >= 0x80
+        inb = jnp.concatenate(
+            [jnp.ones((S, 1), I32), jnp.cumprod(cont[:, :9].astype(I32), axis=1)],
+            axis=1,
+        )
+        nbytes = jnp.sum(inb, axis=1)
+        h = jnp.sum(
+            jnp.where(inb == 1, w10.astype(U32) * pow31_10[None, :], 0).astype(
+                U32
+            ),
+            axis=1,
+        )
+        return (
+            (h ^ (nbytes.astype(U32) * jnp.uint32(2654435761)))
+            & jnp.uint32(0x3FFFFFFF)
+        ).astype(I32)
+
+    # per-iteration the FSM consumes a varint, an Any element (or object
+    # key/value), a buf, or a zero-byte dispatch. Budget: all structural
+    # varints + one dispatch per block + section plumbing + an 8-elements-
+    # per-row allowance for Any lists; an Any-heavier lane runs out,
+    # finishes != DONE, and flags malformed -> host lane (correct, slower)
+    T_total = NV + 3 * NB + 8 * max(1, NB // 2) + 16
+
+    def gat(arr, idx):
+        return jnp.take_along_axis(arr, jnp.clip(idx, 0, NB - 1)[:, None], axis=1)[
+            :, 0
+        ]
+
+    def step(_, carry):
+        regs, out = carry
+        pos, st, vidx, blk, blocks_left, nc_left, elems, pairs, collapsed = regs
+        active = (st != W_DONE) & (pos <= end)
+        w = _window(b, pos, end, 10)
+        val, nb, ovf = _uvar_from(w)
+        tag = w[:, 0]
+
+        is_var_state = (
+            (st == W_NC)
+            | (st == W_SEC_N)
+            | (st == W_SEC_CLK)
+            | (st == W_SKIP)
+            | (st == W_MVF)
+            | (st == W_MSC)
+            | (st == W_MSK)
+            | (st == W_MEC)
+            | (st == W_MEK)
+            | (st == W_DS)
+        )
+        # move id fields: values beyond i32 hash like vat_id
+        hashed_val = jnp.where(ovf, -2 - win_hash(w), val)
+
+        # --- Any value stepping (depth-1, mirrors the V1 machine) ---------
+        in_any = st == W_ANY
+        in_mkey = st == W_MKEY
+        in_mval = st == W_MVAL
+        # second varint in the window (value length/count after the tag)
+        w2 = _window(b, pos + 1, end, 10)
+        val2, nb2, _ = _uvar_from(w2)
+        any_extra = jnp.where(
+            (tag == 127) | (tag == 126) | (tag == 121) | (tag == 120),
+            0,
+            jnp.where(
+                tag == 125,
+                nb2,
+                jnp.where(
+                    tag == 124,
+                    4,
+                    jnp.where(
+                        (tag == 123) | (tag == 122),
+                        8,
+                        jnp.where(
+                            (tag == 119) | (tag == 116),
+                            nb2 + val2,
+                            jnp.where(
+                                (tag == 117) | (tag == 118),
+                                nb2,  # header: children step individually
+                                0,
+                            ),
+                        ),
+                    ),
+                ),
+            ),
+        )
+        deep_bad = (in_any & (tag < 116)) | (
+            in_mval & ((tag == 117) | (tag == 118) | (tag < 116))
+        )
+        any_children = jnp.where(in_any & (tag == 117), val2, 0)
+        map_open = in_any & (tag == 118) & (val2 > 0)
+        pairs2 = jnp.where(in_mval, pairs - 1, pairs)
+        map_done = in_mval & (pairs2 == 0)
+        elem_done = (in_any & ~map_open) | map_done
+        elems2 = jnp.where(
+            elem_done, elems - 1 + any_children, elems
+        )
+        any_finished = elem_done & (elems2 == 0)
+
+        # --- consumption / output ----------------------------------------
+        consumed = jnp.where(
+            is_var_state,
+            nb,
+            jnp.where(
+                in_any | in_mval,
+                1 + any_extra,
+                jnp.where(
+                    in_mkey,
+                    nb + val,  # key string: len varint + bytes
+                    jnp.where(st == W_BUF, nb + val, 0),  # [len][payload]
+                ),
+            ),
+        )
+        consumed = jnp.where(active, consumed, 0)
+        # move payload varints are CONTENT: consumed and parsed into the
+        # per-block arrays, but never assigned structural slots (the slot
+        # numbering must match the content-free bulk parse)
+        is_mv_state = (
+            (st == W_MVF)
+            | (st == W_MSC)
+            | (st == W_MSK)
+            | (st == W_MEC)
+            | (st == W_MEK)
+        )
+        emit_slot = active & is_var_state & ~is_mv_state
+        slot = jnp.clip(vidx, 0, NV - 1)
+        stored = jnp.where(
+            (st == W_MSC) | (st == W_MEC), hashed_val, val
+        )
+        vv = out["vv"].at[jnp.arange(S), slot].set(
+            jnp.where(emit_slot, stored, out["vv"][jnp.arange(S), slot])
+        )
+        vstart = out["vstart"].at[jnp.arange(S), slot].set(
+            jnp.where(emit_slot, pos, out["vstart"][jnp.arange(S), slot])
+        )
+        # id-field overflow is legal (hashed); others flag via vovf
+        track_ovf = emit_slot & ovf
+        vovf = out["vovf"].at[jnp.arange(S), slot].set(
+            jnp.where(track_ovf, True, out["vovf"][jnp.arange(S), slot])
+        )
+        vidx2 = vidx + emit_slot.astype(I32)
+        # move flag/clock overflow (clocks past i32) is malformed; id
+        # fields (MSC/MEC) hash instead
+        mv_num_ovf = (
+            active
+            & ovf
+            & ((st == W_MVF) | (st == W_MSK) | (st == W_MEK))
+        )
+
+        # move field capture
+        def put_blk(name, cond, value):
+            cur = out[name]
+            sblk = jnp.clip(blk, 0, NB - 1)
+            return cur.at[jnp.arange(S), sblk].set(
+                jnp.where(active & cond, value, cur[jnp.arange(S), sblk])
+            )
+
+        out2 = dict(out)
+        out2["vv"], out2["vstart"], out2["vovf"] = vv, vstart, vovf
+        out2["mvf"] = put_blk("mvf", st == W_MVF, val)
+        out2["msc"] = put_blk("msc", st == W_MSC, hashed_val)
+        out2["msk"] = put_blk("msk", st == W_MSK, val)
+        out2["mec"] = put_blk("mec", st == W_MEC, hashed_val)
+        out2["mek"] = put_blk("mek", st == W_MEK, val)
+        out2["deep"] = out["deep"] | (active & deep_bad)
+        # running past the region is malformed (checked at the end too)
+        out2["bad"] = (
+            out["bad"]
+            | (active & (pos + consumed > end) & (consumed > 0))
+            | mv_num_ovf
+        )
+
+        # --- state transitions --------------------------------------------
+        collapsed2 = jnp.where(st == W_MVF, (val & 1) != 0, collapsed)
+        blk_is_skip = gat(is_skip, blk)
+        blk_any = gat(any_cnt, blk)
+        blk_buf = gat(is_buf, blk)
+        blk_move = gat(is_move, blk)
+        has_content = (blk_any > 0) | blk_buf | blk_move
+
+        # next state (default: stay)
+        nst = st
+        nst = jnp.where(st == W_NC, jnp.where(val > 0, W_SEC_N, W_DS), nst)
+        nst = jnp.where(st == W_SEC_N, W_SEC_CLK, nst)
+        nst = jnp.where(st == W_SEC_CLK, W_BLK, nst)
+        # BLK dispatch (consumes nothing this step)
+        sec_done = blocks_left == 0
+        dispatch_skip = (st == W_BLK) & ~sec_done & blk_is_skip
+        dispatch_any = (st == W_BLK) & ~sec_done & ~blk_is_skip & (blk_any > 0)
+        dispatch_buf = (st == W_BLK) & ~sec_done & ~blk_is_skip & blk_buf
+        dispatch_move = (st == W_BLK) & ~sec_done & ~blk_is_skip & blk_move
+        dispatch_none = (st == W_BLK) & ~sec_done & ~blk_is_skip & ~has_content
+        nst = jnp.where(dispatch_skip, W_SKIP, nst)
+        nst = jnp.where(dispatch_any, W_ANY, nst)
+        nst = jnp.where(dispatch_buf, W_BUF, nst)
+        nst = jnp.where(dispatch_move, W_MVF, nst)
+        # none-content blocks advance in place (stay W_BLK)
+        nst = jnp.where(
+            (st == W_BLK) & sec_done,
+            jnp.where(nc_left > 1, W_SEC_N, W_DS),
+            nst,
+        )
+        out2["c_start"] = put_blk(
+            "c_start", dispatch_any | dispatch_buf | dispatch_move, pos
+        )
+        # content-finishing transitions -> back to block dispatch
+        fin = (
+            (st == W_SKIP)
+            | ((st == W_ANY) & any_finished)
+            | ((st == W_MVAL) & map_done & (elems2 == 0))
+            | (st == W_BUF)
+            | ((st == W_MSK) & collapsed2)
+            | (st == W_MEK)
+        )
+        nst = jnp.where(st == W_MVF, W_MSC, nst)
+        nst = jnp.where(st == W_MSC, W_MSK, nst)
+        nst = jnp.where((st == W_MSK) & ~collapsed2, W_MEC, nst)
+        nst = jnp.where(st == W_MEC, W_MEK, nst)
+        nst = jnp.where(map_open, W_MKEY, nst)
+        nst = jnp.where(in_mkey, W_MVAL, nst)
+        nst = jnp.where(in_mval & ~map_done, W_MKEY, nst)
+        nst = jnp.where(
+            map_done & (elems2 > 0), W_ANY, nst
+        )
+        nst = jnp.where(fin, W_BLK, nst)
+        nst = jnp.where((st == W_DS) & (pos + consumed >= end), W_DONE, nst)
+        nst = jnp.where(active, nst, st)
+
+        adv_blk = (dispatch_none | fin).astype(I32)
+        blk2 = blk + jnp.where(active, adv_blk, 0)
+        blocks_left2 = blocks_left - jnp.where(active, adv_blk, 0)
+        blocks_left2 = jnp.where(
+            active & (st == W_SEC_N), val, blocks_left2
+        )
+        nc_left2 = jnp.where(active & (st == W_NC), val, nc_left)
+        nc_left2 = nc_left2 - (active & (st == W_BLK) & sec_done).astype(I32)
+        elems3 = jnp.where(dispatch_any, blk_any, elems2)
+        pairs3 = jnp.where(map_open, val2, pairs2)
+
+        pos2 = pos + consumed
+        regs2 = (
+            jnp.where(active, pos2, pos),
+            nst,
+            vidx2,
+            blk2,
+            blocks_left2,
+            nc_left2,
+            elems3,
+            pairs3,
+            collapsed2,
+        )
+        return regs2, out2
+
+    z_nv = jnp.zeros((S, NV), I32)
+    out0 = dict(
+        vv=z_nv,
+        vstart=z_nv,
+        vovf=jnp.zeros((S, NV), bool),
+        c_start=jnp.zeros((S, NB), I32),
+        mvf=jnp.zeros((S, NB), I32),
+        msc=jnp.full((S, NB), -1, I32),
+        msk=jnp.zeros((S, NB), I32),
+        mec=jnp.full((S, NB), -1, I32),
+        mek=jnp.zeros((S, NB), I32),
+        bad=jnp.zeros((S,), bool),
+        deep=jnp.zeros((S,), bool),
+    )
+    regs0 = (
+        jnp.where(end > start, start, end),  # pos
+        jnp.where(end > start, W_NC, W_DONE),  # empty rest: done
+        jnp.zeros((S,), I32),  # vidx
+        jnp.zeros((S,), I32),  # blk
+        jnp.zeros((S,), I32),  # blocks_left
+        jnp.zeros((S,), I32),  # nc_left
+        jnp.zeros((S,), I32),  # elems
+        jnp.zeros((S,), I32),  # pairs
+        jnp.zeros((S,), bool),  # collapsed
+    )
+    regs, out = jax.lax.fori_loop(0, T_total, step, (regs0, out0))
+    pos_f, st_f, vidx_f = regs[0], regs[1], regs[2]
+    out["bad"] = out["bad"] | ((st_f != W_DONE) & (end > start))
+    out["n_varints"] = vidx_f
+    return out
+
+
 def decode_updates_v2(
     buf: jax.Array,
     lens: jax.Array,
@@ -477,11 +819,130 @@ def decode_updates_v2(
     )
     str_bytes = str_end - str_start
 
-    # --- rest stream: every varint at once -----------------------------------
+    # --- per-block column consumption (info bytes alone determine it) --------
+    # (hoisted above the rest parse: the rest WALKER needs the per-block
+    # content plan to excise non-varint regions — Any values, bufs, move
+    # payloads — from the structural varint stream)
+    iota_nb = jnp.arange(NB, dtype=I32)[None, :]
+    info = info_vals
+    is_gc = info == BLOCK_GC
+    is_skip = info == BLOCK_SKIP
+    is_item = ~is_gc & ~is_skip
+    kind4 = info & 0x0F
+    has_o = is_item & ((info & 0x80) != 0)
+    has_r = is_item & ((info & 0x40) != 0)
+    cant_copy = is_item & ~has_o & ~has_r
+    has_psub = cant_copy & ((info & 0x20) != 0)
+    # parent_info column index per block (consumed by parentful items only)
+    pi_idx = _cumsum_excl(cant_copy.astype(I32))
+    pi = jnp.take_along_axis(pi_vals, jnp.clip(pi_idx, 0, NB - 1), axis=1)
+    is_root = cant_copy & (pi == 1)
+    is_nested = cant_copy & (pi != 1)
+    # client column: 1 per origin id, ror id, nested parent id
+    c_cnt = has_o.astype(I32) + has_r.astype(I32) + is_nested.astype(I32)
+    c_base = _cumsum_excl(c_cnt)
+    # left-clock column: origin clock or nested-parent clock (≤ 1 per block)
+    l_cnt = (has_o | is_nested).astype(I32)
+    l_idx = _cumsum_excl(l_cnt)
+    r_idx = _cumsum_excl(has_r.astype(I32))
+    # string column: root name, parent_sub, string content — in that order
+    is_str_content = is_item & (kind4 == CONTENT_STRING)
+    s_cnt = is_root.astype(I32) + has_psub.astype(I32) + is_str_content.astype(I32)
+    s_base = _cumsum_excl(s_cnt)
+    # len column: GC + Deleted lengths, plus Any/Json element counts
+    # (ContentAny/ContentJson write their element count via write_len —
+    # encoder.rs:253-260 — so they consume len-column entries too)
+    is_del_content = is_item & (kind4 == CONTENT_DELETED)
+    is_any_content = is_item & (kind4 == CONTENT_ANY)
+    is_json_content = is_item & (kind4 == CONTENT_JSON)
+    is_bin_content = is_item & (kind4 == CONTENT_BINARY)
+    is_move_content = is_item & ((info & 0x0F) == (CONTENT_MOVE & 0x0F))
+    # one traversable Any value rides the rest stream for these kinds
+    # (Embed + Format value + Doc options); their lanes still take the
+    # host path (FLAG_UNSUPPORTED) but the walker keeps the stream sound
+    is_one_any = is_item & (
+        (kind4 == CONTENT_EMBED)
+        | (kind4 == CONTENT_FORMAT)
+        | (kind4 == (CONTENT_DOC & 0x0F))
+    )
+    n_cnt = (
+        is_gc | is_del_content | is_any_content | is_json_content
+    ).astype(I32)
+    n_idx = _cumsum_excl(n_cnt)
+    len_at_blk = jnp.take_along_axis(
+        len_vals, jnp.clip(n_idx, 0, NB - 1), axis=1
+    )
+    w_any_cnt = jnp.where(
+        is_any_content, len_at_blk, jnp.where(is_one_any, 1, 0)
+    )
+    cum_skip = _cumsum_excl(is_skip.astype(I32))  # skips before block j
+    cum_skip_incl = jnp.cumsum(is_skip.astype(I32), axis=1)
+
+    def _skips_upto(n):
+        """Skip blocks among blocks [0, n) per lane ([S] -> [S])."""
+        at = jnp.take_along_axis(
+            cum_skip_incl, jnp.clip(n - 1, 0, NB - 1)[:, None], axis=1
+        )[:, 0]
+        return jnp.where(n > 0, at, 0)
+
+    # --- rest stream -----------------------------------------------------------
+    # Content-free lanes (every block GC/Skip/Deleted/String/Json/Type):
+    # the rest stream is flat varints and parses in ONE parallel pass.
+    # Lanes whose blocks put bytes in rest (Any values, Binary bufs, Move
+    # payloads, Embed/Format/Doc values) run the sequential WALKER below,
+    # which excises those regions while assigning the SAME structural slot
+    # numbering — downstream arithmetic is shared.
     rest_start, rest_len = span(SP_REST)
     v, n_varints, v_ovf, v_starts = _bulk_uvarints(
         b, rest_start, rest_start + rest_len, NV
     )
+    lane_has_content = jnp.any(
+        (w_any_cnt > 0) | is_bin_content | is_move_content, axis=1
+    )
+
+    def _run_walker(_):
+        return _rest_walker(
+            b,
+            rest_start,
+            rest_start + rest_len,
+            NV,
+            NB,
+            is_skip,
+            w_any_cnt,
+            is_bin_content,
+            is_move_content,
+        )
+
+    def _skip_walker(_):
+        z_nv = jnp.zeros((S, NV), I32)
+        z_nb = jnp.zeros((S, NB), I32)
+        return dict(
+            vv=z_nv,
+            vstart=z_nv,
+            vovf=jnp.zeros((S, NV), bool),
+            c_start=z_nb,
+            mvf=z_nb,
+            msc=jnp.full((S, NB), -1, I32),
+            msk=z_nb,
+            mec=jnp.full((S, NB), -1, I32),
+            mek=z_nb,
+            bad=jnp.zeros((S,), bool),
+            deep=jnp.zeros((S,), bool),
+            n_varints=jnp.zeros((S,), I32),
+        )
+
+    # the sequential walker only runs when SOME lane actually put content
+    # bytes in rest — the pure-text hot path (B4) stays bulk-only
+    walker_out = jax.lax.cond(
+        jnp.any(lane_has_content), _run_walker, _skip_walker, 0
+    )
+    sel = lane_has_content[:, None]
+    v = jnp.where(sel, walker_out["vv"], v)
+    v_starts = jnp.where(sel, walker_out["vstart"], v_starts)
+    v_ovf = jnp.where(sel, walker_out["vovf"], v_ovf)
+    n_varints = jnp.where(lane_has_content, walker_out["n_varints"], n_varints)
+    walk_bad = lane_has_content & walker_out["bad"]
+    deep_any = lane_has_content & walker_out["deep"]
     iota_nv = jnp.arange(NV, dtype=I32)[None, :]
 
     def vat(idx, used):
@@ -539,47 +1000,6 @@ def decode_updates_v2(
     malformed = (lens > 0) & (n_varints < 1)
     flags = flags | jnp.where(nc > 1, FLAG_MULTI_CLIENT, 0)
     sec_ovf = nc > SEC
-
-    # --- per-block column consumption (info bytes alone determine it) --------
-    iota_nb = jnp.arange(NB, dtype=I32)[None, :]
-    info = info_vals
-    is_gc = info == BLOCK_GC
-    is_skip = info == BLOCK_SKIP
-    is_item = ~is_gc & ~is_skip
-    kind4 = info & 0x0F
-    has_o = is_item & ((info & 0x80) != 0)
-    has_r = is_item & ((info & 0x40) != 0)
-    cant_copy = is_item & ~has_o & ~has_r
-    has_psub = cant_copy & ((info & 0x20) != 0)
-    # parent_info column index per block (consumed by parentful items only)
-    pi_idx = _cumsum_excl(cant_copy.astype(I32))
-    pi = jnp.take_along_axis(pi_vals, jnp.clip(pi_idx, 0, NB - 1), axis=1)
-    is_root = cant_copy & (pi == 1)
-    is_nested = cant_copy & (pi != 1)
-    # client column: 1 per origin id, ror id, nested parent id
-    c_cnt = has_o.astype(I32) + has_r.astype(I32) + is_nested.astype(I32)
-    c_base = _cumsum_excl(c_cnt)
-    # left-clock column: origin clock or nested-parent clock (≤ 1 per block)
-    l_cnt = (has_o | is_nested).astype(I32)
-    l_idx = _cumsum_excl(l_cnt)
-    r_idx = _cumsum_excl(has_r.astype(I32))
-    # string column: root name, parent_sub, string content — in that order
-    is_str_content = is_item & (kind4 == CONTENT_STRING)
-    s_cnt = is_root.astype(I32) + has_psub.astype(I32) + is_str_content.astype(I32)
-    s_base = _cumsum_excl(s_cnt)
-    # len column: GC lengths + Deleted lengths
-    is_del_content = is_item & (kind4 == CONTENT_DELETED)
-    n_cnt = (is_gc | is_del_content).astype(I32)
-    n_idx = _cumsum_excl(n_cnt)
-    cum_skip = _cumsum_excl(is_skip.astype(I32))  # skips before block j
-    cum_skip_incl = jnp.cumsum(is_skip.astype(I32), axis=1)
-
-    def _skips_upto(n):
-        """Skip blocks among blocks [0, n) per lane ([S] -> [S])."""
-        at = jnp.take_along_axis(
-            cum_skip_incl, jnp.clip(n - 1, 0, NB - 1)[:, None], axis=1
-        )[:, 0]
-        return jnp.where(n > 0, at, 0)
 
     # --- section walk (tiny: SEC iterations of [S]-vector work) --------------
     def sec_step(i, carry):
@@ -702,9 +1122,14 @@ def decode_updates_v2(
         is_str_content,
         content_len16,
         jnp.where(
-            is_gc | is_del_content,
-            g(len_vals, jnp.clip(n_idx, 0, NB - 1)),
-            jnp.where(is_skip, skip_len, 0),
+            is_gc | is_del_content | is_any_content | is_json_content,
+            len_at_blk,
+            jnp.where(
+                is_skip,
+                skip_len,
+                # Binary/Move/Embed/Format/Type/Doc occupy ONE clock unit
+                jnp.where(is_item, 1, 0),
+            ),
         ),
     )
     blk_len = jnp.where(valid_blk, blk_len, 0)
@@ -712,13 +1137,20 @@ def decode_updates_v2(
     clock = sec_clk + len_psum - g(len_psum, jnp.clip(blk_secbase, 0, NB - 1))
 
     # --- unsupported / overflow / big-client flags ---------------------------
-    unsupported = jnp.any(
-        valid_blk
-        & is_item
-        & ~is_del_content
-        & ~is_str_content,
-        axis=1,
-    ) | jnp.any(key_too_long, axis=1)
+    unsupported = (
+        jnp.any(
+            valid_blk
+            & is_item
+            & ~is_del_content
+            & ~is_str_content
+            & ~is_any_content
+            & ~is_bin_content
+            & ~is_move_content,
+            axis=1,
+        )
+        | jnp.any(key_too_long, axis=1)
+        | deep_any
+    )
     consumption_ovf = (
         (g(c_base, jnp.full((S, 1), NB - 1, I32))[:, 0] + 3 > NCLI)
         | (total_blocks > NB)
@@ -817,6 +1249,24 @@ def decode_updates_v2(
         return jnp.where(hit, out, fill)
 
     row_ids = jnp.arange(S, dtype=I32)[:, None]
+    c_start = walker_out["c_start"]
+    # content refs: strings point into the string blob; Any values point at
+    # their FIRST value byte (count-less — the row length is the count; the
+    # reader must be in V2/count-less mode, see RawPayloadView(v2_any=...));
+    # Binary and Move spans are byte-identical to their V1 wire forms
+    has_span = is_any_content | is_bin_content | is_move_content
+    ref_col = jnp.where(
+        is_str_content,
+        row_ids * L + content_start,
+        jnp.where(has_span, row_ids * L + c_start, -1),
+    )
+    mvf = walker_out["mvf"]
+    mv_collapsed = (mvf & 1) != 0
+    msa_col = jnp.where((mvf & 2) != 0, 0, -1)
+    mea_col = jnp.where((mvf & 4) != 0, 0, -1)
+    mec_raw = jnp.where(mv_collapsed, walker_out["msc"], walker_out["mec"])
+    mek_raw = jnp.where(mv_collapsed, walker_out["msk"], walker_out["mek"])
+    mv_on = is_move_content & valid_blk
     rows = dict(
         client=scatter(jnp.broadcast_to(sec_client, (S, NB)), 0),
         clock=scatter(clock, 0),
@@ -826,14 +1276,19 @@ def decode_updates_v2(
         rc=scatter(rc, -1),
         rk=scatter(rk, 0),
         kind=scatter(jnp.where(is_gc, BLOCK_GC, kind4), 0),
-        ref=scatter(
-            jnp.where(is_str_content, row_ids * L + content_start, -1), -1
-        ),
+        ref=scatter(ref_col, -1),
         ptag=scatter(ptag, 0),
         pc=scatter(pc, -1),
         pk=scatter(pk, 0),
         keyh=scatter(keyh, -1),
         rooth=scatter(rooth, -1),
+        msc=scatter(jnp.where(mv_on, walker_out["msc"], -1), -1),
+        msk=scatter(jnp.where(mv_on, walker_out["msk"], 0), 0),
+        msa=scatter(jnp.where(mv_on, msa_col, 0), 0),
+        mec=scatter(jnp.where(mv_on, mec_raw, -1), -1),
+        mek=scatter(jnp.where(mv_on, mek_raw, 0), 0),
+        mea=scatter(jnp.where(mv_on, mea_col, 0), 0),
+        mprio=scatter(jnp.where(mv_on, mvf >> 6, -1), -1),
         valid=jnp.any(oh, axis=1),
     )
 
@@ -845,6 +1300,7 @@ def decode_updates_v2(
         | bad_v3
         | ds_bad
         | truncated
+        | walk_bad
         | (valid_blk & (blk_len < 0)).any(axis=1)
     )
     flags = (

@@ -223,7 +223,7 @@ def _kernel(
     """One doc tile: integrate the whole stream in VMEM.
 
     cols_ref: [NC, DB, C] out-ref aliased to the input (holds the state),
-    meta_ref: [DB, 8] aliased; rows_ref: [S, U, 22], dels_ref: [S, R, 4],
+    meta_ref: [DB, 8] aliased; rows_ref: [S, U, 23], dels_ref: [S, R, 4],
     rank_ref: [1, K]. The plain in-refs are shadows of the aliased buffers
     and are unused.
 
