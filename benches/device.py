@@ -109,8 +109,34 @@ def bench_config3(n_docs: int):
     )
 
     log, expect = stream_workload_array(n_clients=256, ops_per_client=2)
+    # scan-width diagnostic (VERDICT r3 weak #10): the device integrate
+    # runs the same YATA conflict scan as a while_loop; this distribution
+    # bounds its per-row iteration count and explains why 256-concurrent-
+    # client traffic costs more per update than sequential text
+    import math
+
+    import ytpu.core.store as _store
+
+    # probe a SEPARATE (untimed) replay so the counters never inflate
+    # host_dt / vs_baseline
+    _store.SCAN_WIDTH_PROBE = widths = []
+    try:
+        timed_host_replay(log)
+    finally:
+        _store.SCAN_WIDTH_PROBE = None
     host_dt, host_doc = timed_host_replay(log)
     assert host_doc.get_array("a").to_json() == expect
+    widths.sort()
+    scan_stats = (
+        {
+            "p50": widths[len(widths) // 2],
+            "p99": widths[max(0, math.ceil(0.99 * len(widths)) - 1)],
+            "max": widths[-1],
+            "scans": len(widths),
+        }
+        if widths
+        else {}
+    )
 
     enc = BatchEncoder(root_name="a")
     steps = [enc.build_step(Update.decode_v1(p), 8, 4) for p in log]
@@ -131,6 +157,7 @@ def bench_config3(n_docs: int):
         "value": round(len(log) * n_docs / dt, 1),
         "unit": f"updates/s over {n_docs}-doc batch (256-client concurrent array)",
         "vs_baseline": round((len(log) * n_docs / dt) / (len(log) / host_dt), 2),
+        "conflict_scan_width": scan_stats,
     }
 
 

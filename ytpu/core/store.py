@@ -36,6 +36,13 @@ from .update import PendingUpdate, Update
 
 __all__ = ["DocStore"]
 
+# Optional perf probe (benches/device.py config #3 diagnostic): when set
+# to a list, every YATA conflict scan appends its candidate-walk length.
+# The device engine runs the SAME scan as a while_loop whose iteration
+# count this distribution bounds — the p99 here explains conflict-heavy
+# workloads' device step cost.
+SCAN_WIDTH_PROBE: Optional[list] = None
+
 
 class DocStore:
     __slots__ = (
@@ -179,7 +186,9 @@ class DocStore:
 
             conflicting: Set[int] = set()
             before_origin: Set[int] = set()
+            _scan_steps = 0
             while o is not None and o is not item.right:
+                _scan_steps += 1
                 before_origin.add(id(o))
                 conflicting.add(id(o))
                 if item.origin == o.origin:
@@ -202,6 +211,8 @@ class DocStore:
                     else:
                         break
                 o = o.right
+            if SCAN_WIDTH_PROBE is not None:
+                SCAN_WIDTH_PROBE.append(_scan_steps)
             item.left = left
 
         # inherit parent_sub from neighbors (block.rs:604-612)
