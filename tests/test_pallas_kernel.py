@@ -13,9 +13,12 @@ import pytest
 
 from ytpu.core import Doc, Update
 from ytpu.models.batch_doc import (
+    ERR_MISSING_DEP,
     BatchEncoder,
     apply_update_stream,
+    ensure_root_anchor,
     get_string,
+    get_tree,
     init_state,
 )
 from ytpu.ops.integrate_kernel import apply_update_stream_fused
@@ -217,3 +220,57 @@ def test_fused_concurrent_map_writes_two_clients():
     expect_val = ma.get("k")
     got = get_map(fused_state, 0, enc.payloads, enc.keys)
     assert got == {"k": expect_val}
+
+
+def test_fused_multi_root_anchor_rows():
+    """Rows parented at a non-primary named root resolve their per-doc
+    BLOCK_ROOT_ANCHOR on the fused lane exactly like the XLA path (the
+    kernel's in-VMEM (kind, key) anchor scan vs _integrate_row's)."""
+
+    def ops(doc):
+        t1 = doc.get_text("text")
+        t2 = doc.get_text("title")
+        with doc.transact() as txn:
+            t1.insert(txn, 0, "body")
+        with doc.transact() as txn:
+            t2.insert(txn, 0, "head")
+        with doc.transact() as txn:
+            t2.insert(txn, 4, "!")
+            t1.insert(txn, 4, "?")
+
+    stream, rank, enc, _ = build_stream(ops)
+    kid = enc.keys.intern("title")
+
+    def seed():
+        st = init_state(8, 128)
+        for d in range(8):
+            st = ensure_root_anchor(st, d, kid)
+        return st
+
+    xla_state = apply_update_stream(seed(), stream, rank)
+    fused_state = apply_update_stream_fused(
+        seed(), stream, rank, d_block=4, interpret=True
+    )
+    assert_same_state(xla_state, fused_state)
+    assert int(np.asarray(fused_state.error).max()) == 0
+    assert get_string(fused_state, 0, enc.payloads) == "body?"
+    tree = get_tree(fused_state, 7, enc.payloads, enc.keys)
+    assert tree["roots"]["title"]["seq"] == list("head!")
+
+
+def test_fused_missing_anchor_flags_missing_dep():
+    """A p_root row whose anchor was never created must set
+    ERR_MISSING_DEP on the fused lane too — never silently alias onto
+    the primary branch."""
+
+    def ops(doc):
+        with doc.transact() as txn:
+            doc.get_text("text").insert(txn, 0, "x")
+        with doc.transact() as txn:
+            doc.get_text("title").insert(txn, 0, "y")
+
+    stream, rank, enc, _ = build_stream(ops)
+    fused_state = apply_update_stream_fused(
+        init_state(4, 64), stream, rank, d_block=4, interpret=True
+    )
+    assert (np.asarray(fused_state.error) & ERR_MISSING_DEP).all()
