@@ -165,6 +165,7 @@ def bench_config4(n_docs: int):
     from ytpu.models.batch_doc import (
         BatchEncoder,
         apply_update_stream,
+        ensure_root_anchor_all,
         get_tree,
         init_state,
     )
@@ -176,12 +177,23 @@ def bench_config4(n_docs: int):
     steps = [enc.build_step(Update.decode_v1(p), 6, 4) for p in log]
     stream = BatchEncoder.stack_steps(steps)
     rank = enc.interner.rank_table()
-    state = init_state(n_docs, 2048)
-    state = apply_update_stream(state, stream, rank)  # compile + warm
+
+    def seed():
+        # this doc is genuinely MULTI-ROOT (map "m" + xml fragment "x",
+        # doc.rs:156-228's normal shape): the non-primary root needs its
+        # per-doc BLOCK_ROOT_ANCHOR rows before the replay — one
+        # vectorized dispatch seeds every slot
+        st = init_state(n_docs, 2048)
+        for name in ("m", "x"):
+            if name != enc.root_name:
+                st = ensure_root_anchor_all(st, enc.keys.intern(name))
+        return st
+
+    state = apply_update_stream(seed(), stream, rank)  # compile + warm
     assert int(np.asarray(state.error).max()) == 0
     got = get_tree(state, 0, enc.payloads, enc.keys)["map"]
     assert got == host_doc.get_map("m").to_json()
-    state = init_state(n_docs, 2048)
+    state = seed()
     np.asarray(state.n_blocks)
     t0 = time.perf_counter()
     state = apply_update_stream(state, stream, rank)

@@ -50,11 +50,17 @@ import pytest
 # fori_loop/scan program (faulthandler stack captured; test_device_server
 # ::test_chatty_tenant_does_not_block_quiet_one was the trigger that
 # run). A standalone repro compiling 650+ DISTINCT SMALL programs shows
-# stable /proc maps + fds and no crash — so the failure needs either
-# LARGE programs (the decode state machines) or the accumulated
-# compile-state of a real suite, not compile count alone. Until that is
-# isolated upstream, the cache clear below stays; it bounds live
-# compiled-program state at the cost of recompiles (~2x wall).
+# stable /proc maps + fds and no crash — so the failure needs LARGE
+# programs, not compile count alone. The bench.py CPU rehearsal then
+# exposed the mechanism: right before the SIGSEGV the process logs
+# "LLVM compilation error: Cannot allocate memory" (execution_engine.cc)
+# — the LLVM JIT's code/memory allocator exhausts after many large
+# compiles accumulate in one process, and the subsequent allocation
+# failure is mishandled into a segfault. jax.clear_caches() releases the
+# jitted executables (and their JIT memory), which is exactly why this
+# fixture works. Until the allocator failure is fixed upstream, the
+# cache clear below stays; bench.py applies the same defense between
+# its CPU phases.
 
 _modules_since_clear = 0
 

@@ -64,6 +64,7 @@ __all__ = [
     "BatchEncoder",
     "finish_encode_diff_batch",
     "ensure_root_anchor",
+    "ensure_root_anchor_all",
     "get_string",
     "get_map",
     "get_tree",
@@ -200,8 +201,10 @@ def init_state(n_docs: int, capacity: int) -> DocStateBatch:
 
 
 @jax.jit
-def _append_root_anchor(state: DocStateBatch, doc, key_id) -> DocStateBatch:
-    """Idempotently append doc's BLOCK_ROOT_ANCHOR row for root `key_id`.
+def _append_root_anchor_masked(state: DocStateBatch, doc_mask, key_id) -> DocStateBatch:
+    """Idempotently append the BLOCK_ROOT_ANCHOR row for root `key_id` in
+    every doc selected by ``doc_mask`` ([D] bool) — the shared core of
+    `ensure_root_anchor` (one-hot mask) and `ensure_root_anchor_all`.
 
     Anchors give non-primary named roots (doc.rs:156-228) a per-doc row
     the integrate path can parent through (its `head` column is the root's
@@ -210,20 +213,22 @@ def _append_root_anchor(state: DocStateBatch, doc, key_id) -> DocStateBatch:
     masks, and delete sets; compaction keeps and remaps them like any row.
     """
     bl = state.blocks
-    B = bl.client.shape[-1]
-    slots = jnp.arange(B, dtype=I32)
-    j = state.n_blocks[doc]
+    D, B = bl.client.shape
+    slots = jnp.arange(B, dtype=I32)[None, :]
     exists = jnp.any(
-        (slots < j)
-        & (bl.kind[doc] == BLOCK_ROOT_ANCHOR)
-        & (bl.key[doc] == key_id)
+        (slots < state.n_blocks[:, None])
+        & (bl.kind == BLOCK_ROOT_ANCHOR)
+        & (bl.key == key_id),
+        axis=1,
     )
-    do = ~exists & (j < B)
-    overflow = ~exists & (j >= B)
+    j = state.n_blocks
+    do = doc_mask & ~exists & (j < B)
+    overflow = doc_mask & ~exists & (j >= B)
     wj = jnp.where(do, j, B)
+    didx = jnp.arange(D, dtype=I32)
 
     def put(col, val):
-        return col.at[doc, wj].set(val, mode="drop")
+        return col.at[didx, wj].set(val, mode="drop")
 
     new_bl = bl._replace(
         kind=put(bl.kind, BLOCK_ROOT_ANCHOR),
@@ -239,12 +244,9 @@ def _append_root_anchor(state: DocStateBatch, doc, key_id) -> DocStateBatch:
     return DocStateBatch(
         blocks=new_bl,
         start=state.start,
-        n_blocks=state.n_blocks.at[doc].add(do.astype(I32)),
-        # error is a BITMASK — OR the flag in (".add" would drift the
-        # value across error classes on repeated overflows)
-        error=state.error.at[doc].set(
-            state.error[doc] | jnp.where(overflow, ERR_CAPACITY, 0)
-        ),
+        n_blocks=state.n_blocks + do.astype(I32),
+        # error is a BITMASK — OR the flag in
+        error=state.error | jnp.where(overflow, ERR_CAPACITY, 0),
     )
 
 
@@ -253,7 +255,20 @@ def ensure_root_anchor(state: DocStateBatch, doc: int, key_id: int) -> DocStateB
     when it already exists). Call BEFORE applying updates whose rows carry
     ``p_root == key_id`` — the integrate path resolves anchors, it never
     creates them (missing anchor -> pending stash, like any missing dep)."""
-    return _append_root_anchor(state, jnp.int32(doc), jnp.int32(key_id))
+    D = state.blocks.client.shape[0]
+    mask = jnp.arange(D, dtype=I32) == jnp.int32(doc)
+    return _append_root_anchor_masked(state, mask, jnp.int32(key_id))
+
+
+def ensure_root_anchor_all(state: DocStateBatch, key_id: int) -> DocStateBatch:
+    """Create the anchor row for root `key_id` in EVERY doc slot (one
+    vectorized dispatch — the batched-replay analogue of
+    `ensure_root_anchor`, for streams that broadcast one multi-root doc
+    to all slots)."""
+    D = state.blocks.client.shape[0]
+    return _append_root_anchor_masked(
+        state, jnp.ones((D,), bool), jnp.int32(key_id)
+    )
 
 
 # --- per-doc primitives (vmapped over the doc axis) ---------------------------
