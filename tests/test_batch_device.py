@@ -205,3 +205,48 @@ def test_state_vectors_device():
     sv = np.asarray(state_vectors(state, max(1, len(enc.interner))))
     client_idx = enc.interner.to_idx[5]
     assert sv[0, client_idx] == 5
+
+
+def test_multi_root_broadcast_stream_with_anchor_all():
+    """A multi-root doc broadcast to every slot (the batched-replay shape):
+    `ensure_root_anchor_all` seeds the non-primary root's anchor row in one
+    vectorized dispatch, and every slot renders both roots."""
+    from ytpu.models.batch_doc import (
+        BatchEncoder,
+        apply_update_stream,
+        ensure_root_anchor_all,
+        get_tree,
+        init_state,
+    )
+
+    d = Doc(client_id=7)
+    log = capture_updates(d)
+    body = d.get_text("body")
+    meta = d.get_map("meta")
+    with d.transact() as txn:
+        body.insert(txn, 0, "words")
+    with d.transact() as txn:
+        meta.insert(txn, "v", 2)
+    with d.transact() as txn:
+        body.insert(txn, 5, "!")
+
+    enc = BatchEncoder()
+    steps = [enc.build_step(Update.decode_v1(p), 4, 4) for p in log]
+    stream = BatchEncoder.stack_steps(steps)
+    state = init_state(8, 64)
+    state = ensure_root_anchor_all(state, enc.keys.intern("meta"))
+    state = ensure_root_anchor_all(state, enc.keys.intern("meta"))  # idempotent
+    state = apply_update_stream(state, stream, enc.interner.rank_table())
+    assert np.all(np.asarray(state.error) == 0)
+    for slot in (0, 7):
+        tree = get_tree(state, slot, enc.payloads, enc.keys)
+        assert tree["seq"] == list("words!")
+        assert tree["roots"]["meta"]["map"] == {"v": 2}
+    # exactly ONE anchor per doc despite the double seeding
+    kinds = np.asarray(state.blocks.kind)
+    n = np.asarray(state.n_blocks)
+    from ytpu.core.content import BLOCK_ROOT_ANCHOR
+
+    for slot in range(8):
+        rows = kinds[slot, : n[slot]]
+        assert int((rows == BLOCK_ROOT_ANCHOR).sum()) == 1
