@@ -4,7 +4,7 @@ Loops `python bench.py` with the fused Pallas lane DISABLED (the XLA
 lanes are known-good on this backend; a Mosaic miscompile crashed the
 TPU worker in round 3 and took the tunnel down for 8+ hours). The first
 run whose JSON carries a real device measurement is saved to
-`BENCH_r04_midsession.json` and the watcher exits 0 — so one healthy
+`BENCH_r05_midsession.json` and the watcher exits 0 — so one healthy
 tunnel window anywhere in the session lands the flagship number.
 
 Run from the repo root:  python benches/tunnel_watch.py
@@ -19,7 +19,7 @@ import sys
 import time
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(HERE, "BENCH_r04_midsession.json")
+OUT = os.path.join(HERE, "BENCH_r05_midsession.json")
 ATTEMPT_LOG = os.path.join(HERE, "benches", "tunnel_watch.log")
 
 
