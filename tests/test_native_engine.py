@@ -136,14 +136,60 @@ def test_duplicate_and_partial_redelivery():
 
 @needs_native
 def test_unsupported_stream_raises():
+    # moves are the remaining out-of-scope content kind (map keys and
+    # nested parents are in scope since round 5)
+    doc = Doc(client_id=1)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    arr = doc.get_array("a")
+    with doc.transact() as txn:
+        arr.insert_range(txn, 0, [1, 2, 3])
+    with doc.transact() as txn:
+        arr.move_to(txn, 0, 2)
+    with pytest.raises(NativeUnsupported):
+        native_replay_v1(log)
+
+
+@needs_native
+def test_map_and_nested_xml_parity():
     doc = Doc(client_id=1)
     log = []
     doc.observe_update_v1(lambda p, o, t: log.append(p))
     m = doc.get_map("m")
+    from ytpu.types import XmlElementPrelim
+
+    frag = doc.get_xml_fragment("x")
     with doc.transact() as txn:
         m.insert(txn, "k", "v")
-    with pytest.raises(NativeUnsupported):
-        native_replay_v1(log)
+        m.insert(txn, "n", [1, {"a": True}])
+        frag.insert(txn, 0, XmlElementPrelim("div", attributes={"id": "d1"}))
+    with doc.transact() as txn:
+        m.insert(txn, "k", "v2")  # overwrite: last write wins
+        m.remove(txn, "n")
+    eng = NativeEngine()
+    for p in log:
+        eng.apply_update_v1(p)
+    assert eng.root_json("m", "map") == m.to_json()
+    assert eng.root_json("x", "seq") == [
+        {"name": "div", "attrs": {"id": "d1"}, "children": []}
+    ]
+    eng.close()
+
+
+@needs_native
+def test_concurrent_array_parity():
+    import importlib
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "benches"))
+    dev = importlib.import_module("device")
+    log, expect = dev.stream_workload_array(n_clients=24, ops_per_client=2, seed=3)
+    eng = NativeEngine()
+    for p in log:
+        eng.apply_update_v1(p)
+    assert eng.root_json("a", "seq") == expect
+    eng.close()
 
 
 @needs_native

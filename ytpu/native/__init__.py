@@ -242,6 +242,14 @@ def load() -> Optional[ctypes.CDLL]:
         ]
         lib.ytpu_engine_text.restype = ctypes.c_void_p  # freed manually
         lib.ytpu_engine_text.argtypes = [ctypes.c_void_p]
+        lib.ytpu_engine_text_root.restype = ctypes.c_void_p
+        lib.ytpu_engine_text_root.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ytpu_engine_root_json.restype = ctypes.c_void_p
+        lib.ytpu_engine_root_json.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
         lib.ytpu_engine_str_free.argtypes = [ctypes.c_void_p]
         lib.ytpu_engine_n_items.restype = ctypes.c_size_t
         lib.ytpu_engine_n_items.argtypes = [ctypes.c_void_p]
@@ -339,16 +347,19 @@ def decode_update_columns(payload: bytes) -> Optional[NativeColumns]:
 
 
 class NativeUnsupported(RuntimeError):
-    """The C++ engine hit a feature outside its scope (map keys, nested
-    parents, GC ranges, non-text content) — use the host oracle."""
+    """The C++ engine hit a feature outside its scope (GC ranges, move
+    ranges, sub-documents) — use the host oracle."""
 
 
 class NativeEngine:
     """Scalar single-doc YATA engine in C++ (`engine.cpp`).
 
     The native-speed performance baseline: reference-equivalent integrate
-    / apply_delete semantics for root-text update streams. Raises
-    `NativeUnsupported` for out-of-scope features.
+    / apply_delete semantics for text, array, map and nested-XML update
+    streams (String / Deleted / Any / JSON / Binary / Embed / Format /
+    Type content, root-name and branch-id parents, map key chains with
+    last-write-wins shadowing). Raises `NativeUnsupported` for
+    out-of-scope features (GC ranges, moves, subdocs).
     """
 
     def __init__(self):
@@ -371,6 +382,33 @@ class NativeEngine:
             raise MemoryError("ytpu_engine_text")
         try:
             return ctypes.string_at(ptr).decode("utf-8")
+        finally:
+            self._lib.ytpu_engine_str_free(ptr)
+
+    def text_root(self, name: str) -> str:
+        ptr = self._lib.ytpu_engine_text_root(self._handle, name.encode())
+        if not ptr:
+            raise MemoryError("ytpu_engine_text_root")
+        try:
+            return ctypes.string_at(ptr).decode("utf-8")
+        finally:
+            self._lib.ytpu_engine_str_free(ptr)
+
+    def root_json(self, name: str, shape: str = "seq"):
+        """Parsed visible state of a named root ("seq" = array / xml
+        children order, "map" = key/value object). Raises
+        `NativeUnsupported` when the root holds content with no native
+        JSON projection (binary, subdocs, hooks)."""
+        import json as _json
+
+        shapes = {"seq": 0, "map": 1}
+        ptr = self._lib.ytpu_engine_root_json(
+            self._handle, name.encode(), shapes[shape]
+        )
+        if not ptr:
+            raise NativeUnsupported(f"no native JSON projection for {name!r}")
+        try:
+            return _json.loads(ctypes.string_at(ptr).decode("utf-8"))
         finally:
             self._lib.ytpu_engine_str_free(ptr)
 

@@ -1,22 +1,26 @@
 // ytpu/native/engine.cpp — scalar single-doc YATA engine in C++.
 //
-// The native-speed performance baseline (VERDICT r1 #3): a from-scratch
-// C++ implementation of the YATA integration algorithm over the columnar
-// decode (lib0_codec.cpp), semantics matching the reference's hot path —
-// integrate (yrs/src/block.rs:482-769, conflict scan :537-602),
-// apply_delete (yrs/src/transaction.rs:472-575), squash
-// (yrs/src/block.rs:775-799) — for the block kinds the B-series benches
-// exercise (String / Deleted content + delete-set ranges, root text
-// parent). It is NOT a port: storage is an index-based arena (no
-// pointers), per-client lookup is an ordered clock map, and the sequence
-// is an intrusive doubly-linked list over indices.
+// The native-speed performance baseline (VERDICT r1 #3, extended r5 #3):
+// a from-scratch C++ implementation of the YATA integration algorithm
+// over the columnar decode (lib0_codec.cpp), semantics matching the
+// reference's hot path — integrate (yrs/src/block.rs:482-769, conflict
+// scan :537-602), apply_delete (yrs/src/transaction.rs:472-575), map
+// key chains with last-write-wins shadowing (block.rs:614-659), nested
+// branch parents (block.rs:1287-1343 repair) — for every content kind
+// the B-series benches exercise: String / Deleted / Any / JSON / Binary
+// / Embed / Format / Type (nested branches: YArray, YMap, YText,
+// XmlElement, XmlFragment). It is NOT a port: storage is an index-based
+// arena (no pointers), per-client lookup is an ordered clock map, and
+// each parent (root or nested branch) owns an intrusive doubly-linked
+// sequence over item indices plus a key->live-entry map.
 //
 // Scope guard: updates containing features outside this engine's scope
-// (map keys, nested parents, moves, non-text content) set `unsupported`
-// and the Python wrapper falls back to the host oracle.
+// (GC ranges, move ranges, sub-documents) set `unsupported` and the
+// Python wrapper falls back to the host oracle.
 
 #include <algorithm>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <map>
@@ -39,7 +43,12 @@ const int64_t* ytpu_col_origin_clock(void* h);
 const int64_t* ytpu_col_ror_client(void* h);
 const int64_t* ytpu_col_ror_clock(void* h);
 const int64_t* ytpu_col_parent_kind(void* h);
+const int64_t* ytpu_col_parent_name_start(void* h);
+const int64_t* ytpu_col_parent_name_len(void* h);
+const int64_t* ytpu_col_parent_id_client(void* h);
+const int64_t* ytpu_col_parent_id_clock(void* h);
 const int64_t* ytpu_col_parent_sub_start(void* h);
+const int64_t* ytpu_col_parent_sub_len(void* h);
 const int64_t* ytpu_col_content_start(void* h);
 const int64_t* ytpu_col_content_len_bytes(void* h);
 const int64_t* ytpu_col_del_client(void* h);
@@ -52,24 +61,25 @@ namespace {
 
 constexpr int64_t KIND_GC = 0;
 constexpr int64_t KIND_DELETED = 1;
+constexpr int64_t KIND_JSON = 2;
+constexpr int64_t KIND_BINARY = 3;
 constexpr int64_t KIND_STRING = 4;
+constexpr int64_t KIND_EMBED = 5;
+constexpr int64_t KIND_FORMAT = 6;
+constexpr int64_t KIND_TYPE = 7;
+constexpr int64_t KIND_ANY = 8;
+constexpr int64_t KIND_DOC = 9;
 constexpr int64_t KIND_SKIP = 10;
+constexpr int64_t KIND_MOVE = 11;
 
-struct Item {
-  uint64_t client = 0;
-  uint64_t clock = 0;
-  int64_t len = 0;  // CRDT length (UTF-16 units for strings)
-  int64_t oc = -1;  // origin (client, clock); -1 client = none
-  int64_t ok = 0;
-  int64_t rc = -1;  // right origin
-  int64_t rk = 0;
-  int32_t left = -1;   // sequence neighbors (indices into items)
-  int32_t right = -1;
-  bool deleted = false;
-  bool is_string = false;
-  size_t str_off = 0;  // UTF-8 bytes in the arena (strings only)
-  size_t str_len = 0;
-};
+// shared-type tags inside ContentType payloads (branch type refs)
+constexpr uint8_t TYPE_ARRAY = 0;
+constexpr uint8_t TYPE_MAP = 1;
+constexpr uint8_t TYPE_TEXT = 2;
+constexpr uint8_t TYPE_XML_ELEMENT = 3;
+constexpr uint8_t TYPE_XML_FRAGMENT = 4;
+constexpr uint8_t TYPE_XML_HOOK = 5;
+constexpr uint8_t TYPE_XML_TEXT = 6;
 
 // Byte offset of the k-th UTF-16 unit within s[0..n). If the cut lands
 // inside a surrogate pair (astral char = 4-byte UTF-8 = 2 units), sets
@@ -106,15 +116,321 @@ size_t utf16_to_byte(const uint8_t* s, size_t n, int64_t units,
 
 constexpr const char* kReplacement = "\xEF\xBF\xBD";  // U+FFFD
 
+// ---- lib0 Any byte-span scanning (element boundaries for splits) ----
+
+bool read_var_uint(const uint8_t* p, size_t n, size_t& pos, uint64_t* out) {
+  uint64_t num = 0;
+  int shift = 0;
+  while (pos < n) {
+    uint8_t b = p[pos++];
+    num |= (uint64_t)(b & 0x7F) << shift;
+    shift += 7;
+    if (b < 0x80) {
+      if (out) *out = num;
+      return true;
+    }
+    if (shift >= 70) return false;  // 10-byte cap: shift 70 would be UB
+  }
+  return false;
+}
+
+// overflow-safe "pos + k <= n" for attacker-controlled k
+bool fits(size_t pos, uint64_t k, size_t n) {
+  return pos <= n && k <= (uint64_t)(n - pos);
+}
+
+bool skip_var_int(const uint8_t* p, size_t n, size_t& pos) {
+  if (pos >= n) return false;
+  uint8_t b = p[pos++];
+  if ((b & 0x80) == 0) return true;
+  while (pos < n) {
+    b = p[pos++];
+    if (b < 0x80) return true;
+  }
+  return false;
+}
+
+// skip one Any value (parity: any.rs:37-83)
+bool skip_any_bytes(const uint8_t* p, size_t n, size_t& pos) {
+  if (pos >= n) return false;
+  uint8_t tag = p[pos++];
+  switch (tag) {
+    case 127:  // undefined
+    case 126:  // null
+    case 121:  // false
+    case 120:  // true
+      return true;
+    case 125:  // integer (signed varint)
+      return skip_var_int(p, n, pos);
+    case 124:  // f32
+      pos += 4;
+      return pos <= n;
+    case 123:  // f64
+    case 122:  // bigint
+      pos += 8;
+      return pos <= n;
+    case 119:
+    case 116: {  // string / buffer
+      uint64_t k = 0;
+      if (!read_var_uint(p, n, pos, &k)) return false;
+      if (!fits(pos, k, n)) return false;
+      pos += (size_t)k;
+      return true;
+    }
+    case 118: {  // map
+      uint64_t cnt = 0;
+      if (!read_var_uint(p, n, pos, &cnt)) return false;
+      for (uint64_t i = 0; i < cnt; i++) {
+        uint64_t k = 0;
+        if (!read_var_uint(p, n, pos, &k)) return false;
+        if (!fits(pos, k, n)) return false;
+        pos += (size_t)k;
+        if (!skip_any_bytes(p, n, pos)) return false;
+      }
+      return true;
+    }
+    case 117: {  // array
+      uint64_t cnt = 0;
+      if (!read_var_uint(p, n, pos, &cnt)) return false;
+      for (uint64_t i = 0; i < cnt; i++)
+        if (!skip_any_bytes(p, n, pos)) return false;
+      return true;
+    }
+    default:
+      return false;
+  }
+}
+
+// byte offset after `k` Any elements
+bool any_elems_to_byte(const uint8_t* p, size_t n, int64_t k, size_t* cut) {
+  size_t pos = 0;
+  for (int64_t i = 0; i < k; i++)
+    if (!skip_any_bytes(p, n, pos)) return false;
+  *cut = pos;
+  return true;
+}
+
+// byte offset after `k` length-prefixed strings (ContentJSON elements)
+bool json_elems_to_byte(const uint8_t* p, size_t n, int64_t k, size_t* cut) {
+  size_t pos = 0;
+  for (int64_t i = 0; i < k; i++) {
+    uint64_t len = 0;
+    if (!read_var_uint(p, n, pos, &len)) return false;
+    if (!fits(pos, len, n)) return false;
+    pos += (size_t)len;
+  }
+  *cut = pos;
+  return true;
+}
+
+// ---- JSON emission (visible-state oracle output) ----
+
+void json_escape(const uint8_t* p, size_t n, std::string& out) {
+  out.push_back('"');
+  for (size_t i = 0; i < n; i++) {
+    uint8_t c = p[i];
+    switch (c) {
+      case '"':
+        out += "\\\"";
+        break;
+      case '\\':
+        out += "\\\\";
+        break;
+      case '\n':
+        out += "\\n";
+        break;
+      case '\r':
+        out += "\\r";
+        break;
+      case '\t':
+        out += "\\t";
+        break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out.push_back((char)c);
+        }
+    }
+  }
+  out.push_back('"');
+}
+
+bool read_f_be(const uint8_t* p, size_t n, size_t& pos, int width,
+               double* out) {
+  if (pos + (size_t)width > n) return false;
+  if (width == 4) {
+    uint32_t bits = 0;
+    for (int i = 0; i < 4; i++) bits = (bits << 8) | p[pos++];
+    float f;
+    memcpy(&f, &bits, 4);
+    *out = (double)f;
+  } else {
+    uint64_t bits = 0;
+    for (int i = 0; i < 8; i++) bits = (bits << 8) | p[pos++];
+    memcpy(out, &bits, 8);
+  }
+  return true;
+}
+
+// emit one Any value as JSON; returns false on error/unsupported
+bool any_json(const uint8_t* p, size_t n, size_t& pos, std::string& out) {
+  if (pos >= n) return false;
+  uint8_t tag = p[pos++];
+  switch (tag) {
+    case 127:  // undefined (host any_to_json: null)
+    case 126:
+      out += "null";
+      return true;
+    case 121:
+      out += "false";
+      return true;
+    case 120:
+      out += "true";
+      return true;
+    case 125: {  // signed varint
+      if (pos >= n) return false;
+      uint8_t b = p[pos++];
+      bool neg = (b & 0x40) != 0;
+      uint64_t num = b & 0x3F;
+      int shift = 6;
+      while (b & 0x80) {
+        if (pos >= n || shift >= 64) return false;
+        b = p[pos++];
+        num |= (uint64_t)(b & 0x7F) << shift;
+        shift += 7;
+      }
+      char buf[32];
+      snprintf(buf, sizeof(buf), "%s%llu", neg ? "-" : "",
+               (unsigned long long)num);
+      out += buf;
+      return true;
+    }
+    case 124:
+    case 123: {  // f32 / f64 (big-endian)
+      double v = 0;
+      if (!read_f_be(p, n, pos, tag == 124 ? 4 : 8, &v)) return false;
+      if (!(v == v) || v - v != 0)  // NaN / ±inf: not valid JSON
+        return false;
+      char buf[40];
+      snprintf(buf, sizeof(buf), "%.17g", v);
+      out += buf;
+      return true;
+    }
+    case 122: {  // bigint i64 big-endian
+      if (pos + 8 > n) return false;
+      uint64_t bits = 0;
+      for (int i = 0; i < 8; i++) bits = (bits << 8) | p[pos++];
+      char buf[32];
+      snprintf(buf, sizeof(buf), "%lld", (long long)(int64_t)bits);
+      out += buf;
+      return true;
+    }
+    case 119: {  // string
+      uint64_t k = 0;
+      if (!read_var_uint(p, n, pos, &k)) return false;
+      if (!fits(pos, k, n)) return false;
+      json_escape(p + pos, (size_t)k, out);
+      pos += (size_t)k;
+      return true;
+    }
+    case 118: {  // map
+      uint64_t cnt = 0;
+      if (!read_var_uint(p, n, pos, &cnt)) return false;
+      out.push_back('{');
+      for (uint64_t i = 0; i < cnt; i++) {
+        if (i) out.push_back(',');
+        uint64_t k = 0;
+        if (!read_var_uint(p, n, pos, &k)) return false;
+        if (!fits(pos, k, n)) return false;
+        json_escape(p + pos, (size_t)k, out);
+        pos += (size_t)k;
+        out.push_back(':');
+        if (!any_json(p, n, pos, out)) return false;
+      }
+      out.push_back('}');
+      return true;
+    }
+    case 117: {  // array
+      uint64_t cnt = 0;
+      if (!read_var_uint(p, n, pos, &cnt)) return false;
+      out.push_back('[');
+      for (uint64_t i = 0; i < cnt; i++) {
+        if (i) out.push_back(',');
+        if (!any_json(p, n, pos, out)) return false;
+      }
+      out.push_back(']');
+      return true;
+    }
+    default:
+      return false;  // binary / unknown: no JSON projection
+  }
+}
+
+struct Item {
+  uint64_t client = 0;
+  uint64_t clock = 0;
+  int64_t len = 0;  // CRDT length (UTF-16 units / element count)
+  int64_t oc = -1;  // origin (client, clock); -1 client = none
+  int64_t ok = 0;
+  int64_t rc = -1;  // right origin
+  int64_t rk = 0;
+  int32_t left = -1;   // sequence neighbors (indices into items)
+  int32_t right = -1;
+  int32_t parent = -1;  // parents index; -2 = inherit from neighbors
+  int32_t sub = -1;     // interned map key; -1 = sequence item
+  int32_t branch = -1;  // parents index when ContentType
+  uint8_t kind = (uint8_t)KIND_STRING;
+  bool deleted = false;
+  bool countable = true;
+  bool detached = false;  // integrated without a live parent (GC-like)
+  size_t c_off = 0;  // content bytes in the arena
+  size_t c_len = 0;  // (strings: UTF-8; Any/JSON: element bytes, no
+                     // count prefix; others: raw wire payload span)
+};
+
+// One sequence scope: a root type or a nested branch (reference Branch,
+// types/mod.rs). `entries` maps interned keys to the LIVE (right-most)
+// chain entry, mirroring parent.map in block.rs:614-659.
+struct ParentSeq {
+  int32_t head = -1;
+  int32_t item = -1;  // backing ContentType item (-1 for roots)
+  std::unordered_map<int32_t, int32_t> entries;
+};
+
 struct Engine {
   std::vector<Item> items;
-  std::string arena;  // string content bytes
+  std::vector<ParentSeq> parents;
+  std::string arena;  // content bytes
+  std::unordered_map<std::string, int32_t> roots;  // root name -> parent
+  std::unordered_map<std::string, int32_t> key_ids;
+  std::vector<std::string> key_names;
   // per-client: start clock -> item index, ordered (O(log n) find/split)
   std::unordered_map<uint64_t, std::map<uint64_t, int32_t>> by_client;
   std::unordered_map<uint64_t, uint64_t> sv;  // next expected clock
-  int32_t head = -1;  // first item of the root sequence
   bool unsupported = false;
   bool error = false;
+
+  int32_t root_key(const std::string& name) {
+    auto it = roots.find(name);
+    if (it != roots.end()) return it->second;
+    int32_t k = (int32_t)parents.size();
+    parents.push_back(ParentSeq{});
+    roots.emplace(name, k);
+    return k;
+  }
+
+  int32_t intern_key(const uint8_t* p, size_t n) {
+    std::string s((const char*)p, n);
+    auto it = key_ids.find(s);
+    if (it != key_ids.end()) return it->second;
+    int32_t k = (int32_t)key_names.size();
+    key_names.push_back(s);
+    key_ids.emplace(std::move(s), k);
+    return k;
+  }
 
   uint64_t cov(uint64_t client) const {
     auto it = sv.find(client);
@@ -148,35 +464,51 @@ struct Engine {
     r.ok = (int64_t)(at - 1);
     r.rc = b.rc;
     r.rk = b.rk;
+    r.parent = b.parent;
+    r.sub = b.sub;
+    r.kind = b.kind;
     r.deleted = b.deleted;
-    r.is_string = b.is_string;
-    if (b.is_string) {
-      const uint8_t* s = (const uint8_t*)arena.data() + b.str_off;
+    r.countable = b.countable;
+    r.detached = b.detached;
+    if (b.kind == KIND_STRING) {
+      const uint8_t* s = (const uint8_t*)arena.data() + b.c_off;
       bool mid = false;
-      size_t cut = utf16_to_byte(s, b.str_len, left_units, &mid);
+      size_t cut = utf16_to_byte(s, b.c_len, left_units, &mid);
       if (!mid) {
-        r.str_off = b.str_off + cut;
-        r.str_len = b.str_len - cut;
-        b.str_len = cut;
+        r.c_off = b.c_off + cut;
+        r.c_len = b.c_len - cut;
+        b.c_len = cut;
       } else {
         // surrogate-pair split: each half gets a U+FFFD stand-in (1 unit
         // each, keeping content length == clock length on both sides).
         // Spans can't express the substitution in place, so both halves
         // move to fresh arena regions (rare; bounded by astral splits).
-        std::string lbytes(arena, b.str_off, cut);
-        std::string rbytes(arena, b.str_off + cut + 4,
-                           b.str_len - cut - 4);
+        std::string lbytes(arena, b.c_off, cut);
+        std::string rbytes(arena, b.c_off + cut + 4, b.c_len - cut - 4);
         size_t loff = arena.size();
         arena.append(lbytes);
         arena.append(kReplacement);
         size_t roff = arena.size();
         arena.append(kReplacement);
         arena.append(rbytes);
-        b.str_off = loff;
-        b.str_len = cut + 3;
-        r.str_off = roff;
-        r.str_len = 3 + rbytes.size();
+        b.c_off = loff;
+        b.c_len = cut + 3;
+        r.c_off = roff;
+        r.c_len = 3 + rbytes.size();
       }
+    } else if (b.kind == KIND_ANY || b.kind == KIND_JSON) {
+      const uint8_t* s = (const uint8_t*)arena.data() + b.c_off;
+      size_t cut = 0;
+      bool ok2 = (b.kind == KIND_ANY)
+                     ? any_elems_to_byte(s, b.c_len, left_units, &cut)
+                     : json_elems_to_byte(s, b.c_len, left_units, &cut);
+      if (!ok2) {
+        error = true;
+        cut = b.c_len;
+      }
+      r.c_off = b.c_off + cut;
+      r.c_len = b.c_len - cut;
+      b.c_len = cut;
     }
     b.len = left_units;
     int32_t ridx = (int32_t)items.size();
@@ -188,6 +520,12 @@ struct Engine {
     if (b2.right >= 0) items[b2.right].left = ridx;
     b2.right = ridx;
     by_client[r.client][at] = ridx;
+    // the live map entry moves to the right half (it ends the chain)
+    if (r.sub >= 0 && r.parent >= 0 && r.right < 0) {
+      auto f = parents[r.parent].entries.find(r.sub);
+      if (f != parents[r.parent].entries.end() && f->second == idx)
+        f->second = ridx;
+    }
     return ridx;
   }
 
@@ -209,8 +547,15 @@ struct Engine {
     return idx;
   }
 
+  // first entry of the map-key chain that ends at `live`
+  int32_t chain_start(int32_t live) {
+    while (live >= 0 && items[live].left >= 0) live = items[live].left;
+    return live;
+  }
+
   // YATA conflict resolution (reference: block.rs:482-769; the conflict
-  // scan :537-602 with the client-id tie-break :571-580).
+  // scan :537-602 with the client-id tie-break :571-580; map binding and
+  // last-write-wins shadowing :614-659).
   void integrate(Item it) {
     // repair: resolve origin → left neighbor (clean end) and right origin
     // → scan bound (clean start), independently (block.rs:1287-1343)
@@ -221,6 +566,10 @@ struct Engine {
         error = true;  // missing dependency (caller checked coverage)
         return;
       }
+      if (items[left].detached) {
+        unsupported = true;
+        return;
+      }
     }
     if (it.rc >= 0) {
       right = clean_start((uint64_t)it.rc, (uint64_t)it.rk);
@@ -228,10 +577,51 @@ struct Engine {
         error = true;
         return;
       }
+      if (items[right].detached) {
+        unsupported = true;
+        return;
+      }
     }
 
+    // parent inheritance from resolved neighbors (store.rs repair /
+    // block.rs:604-612 first half)
+    if (it.parent == -2) {
+      if (left >= 0) {
+        it.parent = items[left].parent;
+        it.sub = items[left].sub;
+      } else if (right >= 0) {
+        it.parent = items[right].parent;
+        it.sub = items[right].sub;
+      } else {
+        unsupported = true;  // no anchor to inherit from
+        return;
+      }
+    }
+    if (it.parent < 0) {
+      // unresolvable parent (deleted nested type): the reference turns
+      // the block into a GC range. Register coverage, keep no sequence
+      // position; origins resolving into it escalate to the host.
+      it.detached = true;
+      it.deleted = true;
+      int32_t idx = (int32_t)items.size();
+      items.push_back(it);
+      by_client[it.client][it.clock] = idx;
+      uint64_t end = it.clock + (uint64_t)it.len;
+      if (end > cov(it.client)) sv[it.client] = end;
+      return;
+    }
+    const int32_t pidx = it.parent;
+
     // conflict scan: walk candidates in (left, right_origin_bound)
-    int32_t o = (left >= 0) ? items[left].right : head;
+    int32_t o;
+    if (left >= 0) {
+      o = items[left].right;
+    } else if (it.sub >= 0) {
+      auto f = parents[pidx].entries.find(it.sub);
+      o = chain_start(f == parents[pidx].entries.end() ? -1 : f->second);
+    } else {
+      o = parents[pidx].head;
+    }
     if (o >= 0 && o != right) {
       // item-index sets; small in practice (concurrent-insert width)
       std::vector<int32_t> conflicting, before_origin;
@@ -267,20 +657,55 @@ struct Engine {
       }
     }
 
-    // splice into the sequence
+    // inherit parent_sub from the settled left neighbor (block.rs:604-612)
+    if (it.sub < 0 && left >= 0) {
+      if (items[left].sub >= 0)
+        it.sub = items[left].sub;
+      else if (right >= 0 && items[right].sub >= 0)
+        it.sub = items[right].sub;
+    }
+
+    // splice into the sequence / key chain (block.rs:614-659)
     int32_t idx = (int32_t)items.size();
     it.left = left;
-    it.right = (left >= 0) ? items[left].right : head;
+    if (left >= 0) {
+      it.right = items[left].right;
+    } else if (it.sub >= 0) {
+      auto f = parents[pidx].entries.find(it.sub);
+      it.right = chain_start(f == parents[pidx].entries.end() ? -1 : f->second);
+    } else {
+      it.right = parents[pidx].head;
+      parents[pidx].head = idx;
+    }
     items.push_back(it);
     Item& nb = items[idx];
-    if (nb.left >= 0)
-      items[nb.left].right = idx;
-    else
-      head = idx;
-    if (nb.right >= 0) items[nb.right].left = idx;
+    if (nb.left >= 0) items[nb.left].right = idx;
+    if (nb.right >= 0) {
+      items[nb.right].left = idx;
+    } else if (nb.sub >= 0) {
+      // became the live value of a map entry; shadow the previous chain
+      parents[pidx].entries[nb.sub] = idx;
+      if (nb.left >= 0) items[nb.left].deleted = true;
+    }
     by_client[nb.client][nb.clock] = idx;
     uint64_t end = nb.clock + (uint64_t)nb.len;
     if (end > cov(nb.client)) sv[nb.client] = end;
+
+    // content side effects (block.rs:704-741)
+    if (nb.kind == KIND_DELETED) nb.deleted = true;
+    if (nb.kind == KIND_TYPE) {
+      nb.branch = (int32_t)parents.size();
+      ParentSeq br;
+      br.item = idx;
+      parents.push_back(br);
+    }
+    // late arrivals behind a newer map value, or a deleted parent, are
+    // integrated directly as tombstones (integrate_block's return True)
+    Item& nb2 = items[idx];  // parents.push_back does not move items
+    bool parent_deleted =
+        parents[pidx].item >= 0 && items[parents[pidx].item].deleted;
+    if (parent_deleted || (nb2.sub >= 0 && nb2.right >= 0))
+      nb2.deleted = true;
   }
 
   // tombstone [start, end) of `client` (apply_delete semantics:
@@ -324,102 +749,340 @@ struct Engine {
     const int64_t* rc = ytpu_col_ror_client(h);
     const int64_t* rk = ytpu_col_ror_clock(h);
     const int64_t* pk = ytpu_col_parent_kind(h);
+    const int64_t* pns = ytpu_col_parent_name_start(h);
+    const int64_t* pnl = ytpu_col_parent_name_len(h);
+    const int64_t* pic = ytpu_col_parent_id_client(h);
+    const int64_t* pik = ytpu_col_parent_id_clock(h);
     const int64_t* pss = ytpu_col_parent_sub_start(h);
+    const int64_t* psl = ytpu_col_parent_sub_len(h);
     const int64_t* cs = ytpu_col_content_start(h);
     const int64_t* cl = ytpu_col_content_len_bytes(h);
     const int64_t* dc = ytpu_col_del_client(h);
     const int64_t* ds = ytpu_col_del_start(h);
     const int64_t* de = ytpu_col_del_end(h);
-    for (size_t i = 0; i < nb && !error && !unsupported; i++) {
+    // Dependency-driven ordering: the host Update driver integrates
+    // carriers as their origins/parents become available (update.rs
+    // stack machine). Here rows not yet ready are deferred and retried
+    // in passes; a pass with no progress means a genuinely missing
+    // dependency (the host lane stashes those as pending — this engine
+    // reports an error and the caller falls back to the oracle).
+    std::vector<size_t> work(nb), next;
+    for (size_t i = 0; i < nb; i++) {
+      work[i] = i;
+      // register roots in wire order regardless of integration order so
+      // parents[0] (the `text()` default) is deterministic under deferral
+      if (kind[i] != KIND_SKIP && kind[i] != KIND_GC && pk[i] == 1)
+        root_key(std::string((const char*)data + pns[i], (size_t)pnl[i]));
+    }
+    bool progress = true;
+    bool forward = true;
+    while (!work.empty() && progress && !error && !unsupported) {
+      progress = false;
+      next.clear();
+      // alternate scan direction between passes: a dependency chain laid
+      // out against the scan order then settles in 2 passes, not O(n)
+      if (!forward) std::reverse(work.begin(), work.end());
+      forward = !forward;
+      for (size_t wi = 0; wi < work.size() && !error && !unsupported;
+           wi++) {
+        size_t i = work[wi];
       if (kind[i] == KIND_SKIP) continue;
-      if (pk[i] == 2 || pss[i] >= 0) {  // branch-id parent / map row
+      if (kind[i] == KIND_GC || kind[i] == KIND_MOVE ||
+          kind[i] == KIND_DOC) {
+        // GC ranges are position-less (BlockRange); moves and subdocs
+        // carry transaction machinery this engine does not model — fall
+        // back to the host oracle for such streams.
         unsupported = true;
         break;
       }
       uint64_t cend = (uint64_t)clock[i] + (uint64_t)length[i];
       uint64_t have = cov((uint64_t)client[i]);
-      if (cend <= have) continue;  // duplicate delivery
-      if ((uint64_t)clock[i] > have) {
-        error = true;  // out-of-order (bench streams are in-order)
-        break;
+      if (cend <= have) {
+        progress = true;
+        continue;  // duplicate delivery
+      }
+      bool ready = (uint64_t)clock[i] <= have;
+      if (ready && oc[i] >= 0 && ok[i] >= 0 &&
+          (uint64_t)ok[i] >= cov((uint64_t)oc[i]))
+        ready = false;
+      if (ready && rc[i] >= 0 && rk[i] >= 0 &&
+          (uint64_t)rk[i] >= cov((uint64_t)rc[i]))
+        ready = false;
+      if (ready && pk[i] == 2 &&
+          (uint64_t)pik[i] >= cov((uint64_t)pic[i]))
+        ready = false;
+      if (!ready) {
+        next.push_back(i);
+        continue;
       }
       Item it;
       it.client = (uint64_t)client[i];
       it.clock = (uint64_t)clock[i];
       it.len = length[i];
+      it.kind = (uint8_t)kind[i];
       it.oc = oc[i] >= 0 && ok[i] >= 0 ? oc[i] : -1;
       it.ok = ok[i];
       it.rc = rc[i] >= 0 && rk[i] >= 0 ? rc[i] : -1;
       it.rk = rk[i];
-      int64_t offset = (int64_t)(have - it.clock);  // partial redelivery
-      if (kind[i] == KIND_STRING) {
-        it.is_string = true;
-        // content span = varint byte-length prefix + UTF-8 payload
-        const uint8_t* p = data + cs[i];
-        size_t pn = (size_t)cl[i];
-        size_t vi = 0;
-        uint64_t blen = 0;
-        int shift = 0;
-        while (vi < pn) {
-          uint8_t b = p[vi++];
-          blen |= (uint64_t)(b & 0x7F) << shift;
-          shift += 7;
-          if (b < 0x80) break;
+      it.countable =
+          !(kind[i] == KIND_DELETED || kind[i] == KIND_FORMAT);
+      // parent columns: 1 = root name, 2 = branch id, 3 = inherit
+      if (pk[i] == 1) {
+        it.parent =
+            root_key(std::string((const char*)data + pns[i], (size_t)pnl[i]));
+      } else if (pk[i] == 2) {
+        int32_t tgt = find((uint64_t)pic[i], (uint64_t)pik[i]);
+        if (tgt < 0) {
+          error = true;  // parent not integrated yet (host lane stashes)
+          break;
         }
-        it.str_off = arena.size();
-        it.str_len = (size_t)blen;
-        arena.append((const char*)p + vi, (size_t)blen);
-      } else if (kind[i] == KIND_DELETED) {
-        it.deleted = true;
+        if (items[tgt].branch >= 0) {
+          it.parent = items[tgt].branch;
+        } else if (items[tgt].kind == KIND_DELETED) {
+          it.parent = -1;  // reference: parent resolves to None → GC
+        } else {
+          error = true;  // defect: parent is not a shared type
+          break;
+        }
       } else {
-        // GC ranges are position-less (BlockRange, not a sequence item);
-        // integrating one here would corrupt origin resolution — fall
-        // back to the host oracle for such streams.
-        unsupported = true;
-        break;
+        it.parent = -2;  // inherit from origin neighbors at integrate
+      }
+      if (pss[i] >= 0)
+        it.sub = intern_key(data + pss[i], (size_t)psl[i]);
+      int64_t offset = (int64_t)(have - it.clock);  // partial redelivery
+      // content payload → arena
+      const uint8_t* p = data + cs[i];
+      size_t pn = (size_t)cl[i];
+      if (kind[i] == KIND_STRING || kind[i] == KIND_ANY ||
+          kind[i] == KIND_JSON) {
+        // strip the count/byte-length prefix; keep element bytes so
+        // splits can cut on element boundaries
+        size_t vi = 0;
+        if (!read_var_uint(p, pn, vi, nullptr)) {
+          error = true;
+          break;
+        }
+        it.c_off = arena.size();
+        it.c_len = pn - vi;
+        arena.append((const char*)p + vi, pn - vi);
+      } else if (kind[i] != KIND_DELETED) {
+        // Binary / Embed / Format / Type: raw payload span
+        it.c_off = arena.size();
+        it.c_len = pn;
+        arena.append((const char*)p, pn);
       }
       if (offset > 0) {
         // drop the already-integrated prefix (integrate(txn, offset))
         it.clock += (uint64_t)offset;
-        if (it.is_string) {
-          const uint8_t* s = (const uint8_t*)arena.data() + it.str_off;
+        if (it.kind == KIND_STRING) {
+          const uint8_t* s = (const uint8_t*)arena.data() + it.c_off;
           bool mid = false;
-          size_t cut = utf16_to_byte(s, it.str_len, offset, &mid);
+          size_t cut = utf16_to_byte(s, it.c_len, offset, &mid);
           if (!mid) {
-            it.str_off += cut;
-            it.str_len -= cut;
+            it.c_off += cut;
+            it.c_len -= cut;
           } else {
-            std::string rest(arena, it.str_off + cut + 4,
-                             it.str_len - cut - 4);
-            it.str_off = arena.size();
+            std::string rest(arena, it.c_off + cut + 4, it.c_len - cut - 4);
+            it.c_off = arena.size();
             arena.append(kReplacement);
             arena.append(rest);
-            it.str_len = 3 + rest.size();
+            it.c_len = 3 + rest.size();
           }
+        } else if (it.kind == KIND_ANY || it.kind == KIND_JSON) {
+          const uint8_t* s = (const uint8_t*)arena.data() + it.c_off;
+          size_t cut = 0;
+          bool ok2 = (it.kind == KIND_ANY)
+                         ? any_elems_to_byte(s, it.c_len, offset, &cut)
+                         : json_elems_to_byte(s, it.c_len, offset, &cut);
+          if (!ok2) {
+            error = true;
+            break;
+          }
+          it.c_off += cut;
+          it.c_len -= cut;
+        } else if (it.kind != KIND_DELETED) {
+          // length-1 content cannot be partially redelivered
+          error = true;
+          break;
         }
         it.len -= offset;
         it.oc = (int64_t)it.client;
         it.ok = (int64_t)(it.clock - 1);
       }
       integrate(it);
+      progress = true;
+      }
+      work.swap(next);
     }
+    if (!work.empty() && !error && !unsupported)
+      error = true;  // missing dependency: host lane stashes as pending
     for (size_t i = 0; i < nd && !error && !unsupported; i++) {
       apply_delete((uint64_t)dc[i], (uint64_t)ds[i], (uint64_t)de[i]);
     }
     ytpu_columns_free(h);
   }
 
-  std::string text() const {
+  std::string text_of(int32_t pidx) const {
     std::string out;
-    out.reserve(arena.size());
-    for (int32_t i = head; i >= 0; i = items[i].right) {
+    if (pidx < 0) return out;
+    for (int32_t i = parents[pidx].head; i >= 0; i = items[i].right) {
       const Item& b = items[i];
-      if (!b.deleted && b.is_string)
-        out.append(arena, b.str_off, b.str_len);
+      if (!b.deleted && b.kind == KIND_STRING)
+        out.append(arena, b.c_off, b.c_len);
     }
     return out;
   }
+
+  std::string text() const { return text_of(parents.empty() ? -1 : 0); }
+
+  // ---- visible-state JSON (validation oracle for benches/tests) ----
+  // shapes: 0 = sequence (YArray / XmlFragment children), 1 = map,
+  // 2 = type (infer from the backing ContentType payload)
+
+  bool type_json(int32_t item_idx, std::string& out) const {
+    const Item& b = items[item_idx];
+    if (b.branch < 0) return false;
+    const uint8_t* p = (const uint8_t*)arena.data() + b.c_off;
+    size_t n = b.c_len;
+    if (n < 1) return false;
+    uint8_t tag = p[0];
+    switch (tag) {
+      case TYPE_ARRAY:
+        return seq_json(b.branch, out);
+      case TYPE_MAP:
+        return map_json(b.branch, out);
+      case TYPE_TEXT:
+      case TYPE_XML_TEXT: {
+        std::string t = text_of(b.branch);
+        json_escape((const uint8_t*)t.data(), t.size(), out);
+        return true;
+      }
+      case TYPE_XML_ELEMENT: {
+        size_t pos = 1;
+        uint64_t k = 0;
+        if (!read_var_uint(p, n, pos, &k)) return false;
+        if (!fits(pos, k, n)) return false;
+        out += "{\"name\":";
+        json_escape(p + pos, (size_t)k, out);
+        out += ",\"attrs\":";
+        if (!map_json(b.branch, out)) return false;
+        out += ",\"children\":";
+        if (!seq_json(b.branch, out)) return false;
+        out.push_back('}');
+        return true;
+      }
+      case TYPE_XML_FRAGMENT:
+        return seq_json(b.branch, out);
+      default:
+        return false;  // hooks / weak links: host-side projection only
+    }
+  }
+
+  bool value_json(int32_t idx, bool last_only, std::string& out) const {
+    const Item& b = items[idx];
+    switch (b.kind) {
+      case KIND_ANY: {
+        const uint8_t* p = (const uint8_t*)arena.data() + b.c_off;
+        size_t pos = 0;
+        for (int64_t e = 0; e < b.len; e++) {
+          std::string one;
+          if (!any_json(p, b.c_len, pos, one)) return false;
+          if (last_only) {
+            if (e == b.len - 1) out += one;
+          } else {
+            if (e) out.push_back(',');
+            out += one;
+          }
+        }
+        return true;
+      }
+      case KIND_JSON: {
+        const uint8_t* p = (const uint8_t*)arena.data() + b.c_off;
+        size_t pos = 0;
+        for (int64_t e = 0; e < b.len; e++) {
+          uint64_t k = 0;
+          if (!read_var_uint(p, b.c_len, pos, &k)) return false;
+          if (!fits(pos, k, b.c_len)) return false;
+          if (!last_only && e) out.push_back(',');
+          if (!last_only || e == b.len - 1)
+            out.append((const char*)p + pos, (size_t)k);
+          pos += (size_t)k;
+        }
+        return true;
+      }
+      case KIND_STRING:
+        json_escape((const uint8_t*)arena.data() + b.c_off, b.c_len, out);
+        return true;
+      case KIND_EMBED: {
+        // v1 embed payload = length-prefixed JSON text
+        const uint8_t* p = (const uint8_t*)arena.data() + b.c_off;
+        size_t pos = 0;
+        uint64_t k = 0;
+        if (!read_var_uint(p, b.c_len, pos, &k)) return false;
+        if (!fits(pos, k, b.c_len)) return false;
+        out.append((const char*)p + pos, (size_t)k);
+        return true;
+      }
+      case KIND_TYPE:
+        return type_json(idx, out);
+      default:
+        return false;  // binary / doc: no JSON projection
+    }
+  }
+
+  bool seq_json(int32_t pidx, std::string& out) const {
+    out.push_back('[');
+    bool first = true;
+    for (int32_t i = parents[pidx].head; i >= 0; i = items[i].right) {
+      const Item& b = items[i];
+      if (b.deleted || !b.countable) continue;
+      if (!first) out.push_back(',');
+      first = false;
+      if (!value_json(i, false, out)) return false;
+    }
+    out.push_back(']');
+    return true;
+  }
+
+  bool map_json(int32_t pidx, std::string& out) const {
+    out.push_back('{');
+    bool first = true;
+    for (const auto& kv : parents[pidx].entries) {
+      int32_t idx = kv.second;
+      if (idx < 0 || items[idx].deleted) continue;
+      if (!first) out.push_back(',');
+      first = false;
+      const std::string& key = key_names[kv.first];
+      json_escape((const uint8_t*)key.data(), key.size(), out);
+      out.push_back(':');
+      if (!value_json(idx, true, out)) return false;
+    }
+    out.push_back('}');
+    return true;
+  }
+
+  // JSON of a root's visible state; empty string on unsupported content
+  std::string root_json(const std::string& name, int shape) const {
+    std::string out;
+    auto it = roots.find(name);
+    if (it == roots.end()) {
+      out = (shape == 1) ? "{}" : "[]";
+      return out;
+    }
+    bool ok2 = (shape == 1) ? map_json(it->second, out)
+                            : seq_json(it->second, out);
+    if (!ok2) return std::string();
+    return out;
+  }
 };
+
+char* dup_cstr(const std::string& s) {
+  char* out = (char*)malloc(s.size() + 1);
+  if (!out) return nullptr;
+  memcpy(out, s.data(), s.size());
+  out[s.size()] = 0;
+  return out;
+}
 
 }  // namespace
 
@@ -438,14 +1101,29 @@ int ytpu_engine_apply(void* h, const uint8_t* data, size_t len) {
   return 0;
 }
 
-// UTF-8 text of the root sequence; caller frees with ytpu_engine_str_free
+// UTF-8 text of the first root sequence; caller frees with
+// ytpu_engine_str_free
 char* ytpu_engine_text(void* h) {
   std::string s = static_cast<Engine*>(h)->text();
-  char* out = (char*)malloc(s.size() + 1);
-  if (!out) return nullptr;
-  memcpy(out, s.data(), s.size());
-  out[s.size()] = 0;
-  return out;
+  return dup_cstr(s);
+}
+
+// UTF-8 text of the named root
+char* ytpu_engine_text_root(void* h, const char* name) {
+  Engine* e = static_cast<Engine*>(h);
+  auto it = e->roots.find(name);
+  std::string s = it == e->roots.end() ? "" : e->text_of(it->second);
+  return dup_cstr(s);
+}
+
+// JSON of a named root's visible state. shape: 0 = sequence (array /
+// xml-fragment children), 1 = map. Returns NULL when the root holds
+// content with no JSON projection (binary, subdocs, hooks) — callers
+// fall back to the host oracle.
+char* ytpu_engine_root_json(void* h, const char* name, int shape) {
+  std::string s = static_cast<Engine*>(h)->root_json(name, shape);
+  if (s.empty()) return nullptr;
+  return dup_cstr(s);
 }
 
 void ytpu_engine_str_free(char* s) { free(s); }

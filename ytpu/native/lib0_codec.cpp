@@ -63,7 +63,7 @@ struct Cursor {
       num |= (uint64_t)(b & 0x7F) << shift;
       shift += 7;
       if (b < 0x80) return num;
-      if (shift > 70) {
+      if (shift >= 70) {  // 10-byte cap: an 11th byte would shift ≥64 (UB)
         error = true;
         return 0;
       }
@@ -71,7 +71,7 @@ struct Cursor {
   }
 
   void skip(size_t n) {
-    if (pos + n > len) {
+    if (pos > len || n > len - pos) {  // overflow-safe bound
       error = true;
       return;
     }
