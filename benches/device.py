@@ -47,6 +47,29 @@ def timed_host_replay(log):
     return time.perf_counter() - t0, doc
 
 
+def timed_native_replay(log, checks):
+    """Native single-core denominator (VERDICT r4 #3): replay through the
+    C++ engine (ytpu/native/engine.cpp) and validate its visible state
+    against the host oracle. `checks` = [(root, shape, expected), ...].
+    Returns updates/s, or None when the native path is unavailable or the
+    stream is out of the engine's scope."""
+    try:
+        from ytpu.native import NativeEngine
+
+        eng = NativeEngine()
+        t0 = time.perf_counter()
+        for p in log:
+            eng.apply_update_v1(p)
+        dt = time.perf_counter() - t0
+        for root, shape, expected in checks:
+            got = eng.root_json(root, shape)
+            assert got == expected, f"native {root} diverged from oracle"
+        eng.close()
+        return len(log) / dt if dt > 0 else None
+    except Exception:
+        return None
+
+
 def stream_workload_array(n_clients: int, ops_per_client: int, seed=11):
     """Config #3 generator: n_clients peers concurrently edit one array,
     exchanging through a relay doc so every op becomes one wire update."""
@@ -152,11 +175,21 @@ def bench_config3(n_docs: int):
     state = apply_update_stream(state, stream, rank)
     np.asarray(state.n_blocks)
     dt = time.perf_counter() - t0
+    rate = len(log) * n_docs / dt
+    py_rate = len(log) / host_dt
+    native_rate = timed_native_replay(log, [("a", "seq", expect)])
+    # the honest baseline is the native-speed single-core CPU engine
+    # (VERDICT r4 missing #2); the Python-oracle ratio stays visible but
+    # never headlines
     return {
         "metric": "config3_array_256client_updates_per_sec",
-        "value": round(len(log) * n_docs / dt, 1),
+        "value": round(rate, 1),
         "unit": f"updates/s over {n_docs}-doc batch (256-client concurrent array)",
-        "vs_baseline": round((len(log) * n_docs / dt) / (len(log) / host_dt), 2),
+        "vs_baseline": round(rate / (native_rate or py_rate), 2),
+        "baseline_kind": "native_cpp" if native_rate else "py_oracle_SOFT",
+        "vs_native": round(rate / native_rate, 2) if native_rate else None,
+        "vs_py_oracle": round(rate / py_rate, 2),
+        "native_updates_per_sec": round(native_rate, 1) if native_rate else None,
         "conflict_scan_width": scan_stats,
     }
 
@@ -199,11 +232,32 @@ def bench_config4(n_docs: int):
     state = apply_update_stream(state, stream, rank)
     np.asarray(state.n_blocks)
     dt = time.perf_counter() - t0
+    rate = len(log) * n_docs / dt
+    py_rate = len(log) / host_dt
+    host_xml = [
+        {
+            "name": ch.tag,
+            "attrs": {k: v for k, v in ch.attributes()},
+            "children": [],
+        }
+        for ch in host_doc.get_xml_fragment("x").children()
+    ]
+    native_rate = timed_native_replay(
+        log,
+        [
+            ("m", "map", host_doc.get_map("m").to_json()),
+            ("x", "seq", host_xml),
+        ],
+    )
     return {
         "metric": "config4_map_xml_updates_per_sec",
-        "value": round(len(log) * n_docs / dt, 1),
+        "value": round(rate, 1),
         "unit": f"updates/s over {n_docs}-doc batch (map+xml tenants)",
-        "vs_baseline": round((len(log) * n_docs / dt) / (len(log) / host_dt), 2),
+        "vs_baseline": round(rate / (native_rate or py_rate), 2),
+        "baseline_kind": "native_cpp" if native_rate else "py_oracle_SOFT",
+        "vs_native": round(rate / native_rate, 2) if native_rate else None,
+        "vs_py_oracle": round(rate / py_rate, 2),
+        "native_updates_per_sec": round(native_rate, 1) if native_rate else None,
     }
 
 
@@ -256,6 +310,50 @@ def bench_config5(n_docs: int, n_clients: int = 64):
         relay.encode_state_as_update_v1(sv)
     host_dt = (time.perf_counter() - t0) / host_n
 
+    # native single-core denominator (VERDICT r4 #3): the C++ engine
+    # replays the relay state once, then per-SV diff encodes. Validated
+    # by applying host vs native bytes to fresh docs (granularity may
+    # differ: the engine splits but never squashes).
+    native_dt = None
+    try:
+        from ytpu.native import NativeEngine
+
+        neng = NativeEngine()
+        for p in log:
+            neng.apply_update_v1(p)
+        svs = [
+            {
+                enc.interner.from_idx[c]: int(remote[d, c])
+                for c in range(len(enc.interner))
+                if remote[d, c] > 0
+            }
+            for d in range(host_n)
+        ]
+        t0 = time.perf_counter()
+        for sv in svs:
+            neng.encode_diff_v1(sv)
+        native_dt = (time.perf_counter() - t0) / host_n
+        def coverage(payload):
+            upd = Update.decode_v1(payload)
+            cov = {}
+            for client, blocks in upd.blocks.items():
+                lo = min(b.id.clock for b in blocks)
+                hi = max(b.id.clock + b.len for b in blocks)
+                cov[client] = (lo, hi)
+            ds = {
+                c: sorted((s, e) for s, e in rs)
+                for c, rs in upd.delete_set.clients.items()
+                if rs
+            }
+            return cov, ds
+
+        for sv in svs[:3]:
+            host_b = relay.encode_state_as_update_v1(StateVector(dict(sv)))
+            assert coverage(host_b) == coverage(neng.encode_diff_v1(sv))
+        neng.close()
+    except Exception:
+        native_dt = None
+
     def select():
         out = encode_diff_batch(state, remote, C)
         jax.block_until_ready(out)
@@ -306,7 +404,11 @@ def bench_config5(n_docs: int, n_clients: int = 64):
         "value": round(1.0 / e2e_dt, 1),
         "unit": f"doc-diffs/s END-TO-END over {n_docs} docs x {C} clients "
         "(device selection + native finisher, byte parity asserted)",
-        "vs_baseline": round((1.0 / e2e_dt) / (1.0 / host_dt), 2),
+        "vs_baseline": round((1.0 / e2e_dt) / (1.0 / (native_dt or host_dt)), 2),
+        "baseline_kind": "native_cpp" if native_dt else "py_oracle_SOFT",
+        "vs_native": round(native_dt / e2e_dt, 2) if native_dt else None,
+        "vs_py_oracle": round(host_dt / e2e_dt, 2),
+        "native_diffs_per_sec": round(1.0 / native_dt, 1) if native_dt else None,
         "selection_docs_per_sec": round(n_docs / sel_dt, 1),
         "finisher_native_docs_per_sec": round(1.0 / nat_dt, 1),
         "finisher_python_docs_per_sec": round(1.0 / py_dt, 1),

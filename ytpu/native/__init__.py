@@ -250,6 +250,14 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p,
             ctypes.c_int,
         ]
+        lib.ytpu_engine_encode_diff.restype = ctypes.c_void_p
+        lib.ytpu_engine_encode_diff.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
         lib.ytpu_engine_str_free.argtypes = [ctypes.c_void_p]
         lib.ytpu_engine_n_items.restype = ctypes.c_size_t
         lib.ytpu_engine_n_items.argtypes = [ctypes.c_void_p]
@@ -391,6 +399,27 @@ class NativeEngine:
             raise MemoryError("ytpu_engine_text_root")
         try:
             return ctypes.string_at(ptr).decode("utf-8")
+        finally:
+            self._lib.ytpu_engine_str_free(ptr)
+
+    def encode_diff_v1(self, sv: dict) -> bytes:
+        """V1 update bytes for the diff vs a remote state vector (mapping
+        client-id -> clock). Semantics parity with the host's
+        `encode_state_as_update_v1` (reference store.rs:204-248); block
+        granularity may differ (the engine splits but never squashes), so
+        validate by applying to a fresh doc, not by byte compare. Raises
+        `NativeUnsupported` when the state cannot be re-encoded natively."""
+        n = len(sv)
+        clients = (ctypes.c_uint64 * n)(*sv.keys())
+        clocks = (ctypes.c_uint64 * n)(*sv.values())
+        out_len = ctypes.c_size_t(0)
+        ptr = self._lib.ytpu_engine_encode_diff(
+            self._handle, clients, clocks, n, ctypes.byref(out_len)
+        )
+        if not ptr:
+            raise NativeUnsupported("state has no native diff encoding")
+        try:
+            return ctypes.string_at(ptr, out_len.value)
         finally:
             self._lib.ytpu_engine_str_free(ptr)
 

@@ -397,7 +397,26 @@ struct Item {
 struct ParentSeq {
   int32_t head = -1;
   int32_t item = -1;  // backing ContentType item (-1 for roots)
+  std::string name;   // root name (empty for nested branches)
   std::unordered_map<int32_t, int32_t> entries;
+};
+
+// V1 wire writer (lib0 varint framing)
+struct Wr {
+  std::string buf;
+  void u8(uint8_t b) { buf.push_back((char)b); }
+  void vu(uint64_t v) {
+    while (v >= 0x80) {
+      buf.push_back((char)(0x80 | (v & 0x7F)));
+      v >>= 7;
+    }
+    buf.push_back((char)v);
+  }
+  void bytes(const char* p, size_t n) { buf.append(p, n); }
+  void str(const std::string& s) {
+    vu(s.size());
+    buf.append(s);
+  }
 };
 
 struct Engine {
@@ -417,7 +436,9 @@ struct Engine {
     auto it = roots.find(name);
     if (it != roots.end()) return it->second;
     int32_t k = (int32_t)parents.size();
-    parents.push_back(ParentSeq{});
+    ParentSeq ps;
+    ps.name = name;
+    parents.push_back(std::move(ps));
     roots.emplace(name, k);
     return k;
   }
@@ -1074,6 +1095,164 @@ struct Engine {
     if (!ok2) return std::string();
     return out;
   }
+
+  // ---- V1 diff encoder (reference: store.rs:204-248 write_blocks_from
+  // + block.rs:868-908 item encode; host parity: ytpu/core/store.py
+  // write_blocks_from / block.py Item.encode) ----
+
+  // encode one item with the first `offset` clock units dropped
+  bool encode_item(Wr& w, const Item& b, int64_t offset) const {
+    if (b.detached) return false;
+    constexpr uint8_t HAS_ORIGIN = 0x80, HAS_RIGHT = 0x40, HAS_SUB = 0x20;
+    bool has_origin = offset > 0 || b.oc >= 0;
+    uint8_t info = (uint8_t)b.kind;
+    if (has_origin) info |= HAS_ORIGIN;
+    if (b.rc >= 0) info |= HAS_RIGHT;
+    if (b.sub >= 0) info |= HAS_SUB;
+    w.u8(info);
+    if (has_origin) {
+      // with offset > 0 the origin is rewritten to the preceding unit
+      uint64_t oc2 = offset > 0 ? b.client : (uint64_t)b.oc;
+      uint64_t ok2 = offset > 0 ? b.clock + (uint64_t)offset - 1
+                                : (uint64_t)b.ok;
+      w.vu(oc2);
+      w.vu(ok2);
+    }
+    if (b.rc >= 0) {
+      w.vu((uint64_t)b.rc);
+      w.vu((uint64_t)b.rk);
+    }
+    if (!has_origin && b.rc < 0) {
+      if (b.parent < 0) return false;
+      const ParentSeq& P = parents[b.parent];
+      if (P.item >= 0) {
+        w.vu(0);  // parent by branch id
+        w.vu(items[P.item].client);
+        w.vu(items[P.item].clock);
+      } else {
+        w.vu(1);  // parent by root name
+        w.str(P.name);
+      }
+      if (b.sub >= 0) w.str(key_names[b.sub]);
+    }
+    // content
+    switch (b.kind) {
+      case KIND_DELETED:
+        w.vu((uint64_t)(b.len - offset));
+        return true;
+      case KIND_STRING: {
+        const uint8_t* s = (const uint8_t*)arena.data() + b.c_off;
+        size_t cut = 0;
+        if (offset > 0) {
+          bool mid = false;
+          cut = utf16_to_byte(s, b.c_len, offset, &mid);
+          if (mid) return false;  // astral-split re-encode: host lane
+        }
+        w.vu((uint64_t)(b.c_len - cut));
+        w.bytes((const char*)s + cut, b.c_len - cut);
+        return true;
+      }
+      case KIND_ANY:
+      case KIND_JSON: {
+        const uint8_t* s = (const uint8_t*)arena.data() + b.c_off;
+        size_t cut = 0;
+        if (offset > 0) {
+          bool ok3 = (b.kind == KIND_ANY)
+                         ? any_elems_to_byte(s, b.c_len, offset, &cut)
+                         : json_elems_to_byte(s, b.c_len, offset, &cut);
+          if (!ok3) return false;
+        }
+        w.vu((uint64_t)(b.len - offset));
+        w.bytes((const char*)s + cut, b.c_len - cut);
+        return true;
+      }
+      case KIND_BINARY:
+      case KIND_EMBED:
+      case KIND_FORMAT:
+      case KIND_TYPE:
+        if (offset != 0) return false;  // length-1 content cannot slice
+        w.bytes(arena.data() + b.c_off, b.c_len);
+        return true;
+      default:
+        return false;
+    }
+  }
+
+  // full diff vs a remote state vector; empty result = unsupported
+  std::string encode_diff(const std::vector<std::pair<uint64_t, uint64_t>>&
+                              remote) const {
+    Wr w;
+    std::unordered_map<uint64_t, uint64_t> rsv;
+    for (const auto& kv : remote) rsv[kv.first] = kv.second;
+    // clients whose local clock is ahead, higher ids first
+    std::vector<std::pair<uint64_t, uint64_t>> diff;  // (client, remote)
+    for (const auto& kv : sv) {
+      auto f = rsv.find(kv.first);
+      uint64_t rc2 = f == rsv.end() ? 0 : f->second;
+      if (kv.second > rc2) diff.emplace_back(kv.first, rc2);
+    }
+    std::sort(diff.begin(), diff.end(),
+              [](const auto& a, const auto& b2) { return a.first > b2.first; });
+    w.vu(diff.size());
+    for (const auto& [client, rclock] : diff) {
+      const auto& m = by_client.at(client);
+      // pivot: block containing rclock (or the first block)
+      auto it = m.begin();
+      int64_t offset = 0;
+      if (rclock > 0) {
+        auto ub = m.upper_bound(rclock);
+        if (ub != m.begin()) {
+          auto prev = std::prev(ub);
+          const Item& pb = items[prev->second];
+          if (rclock < pb.clock + (uint64_t)pb.len) {
+            it = prev;
+            offset = (int64_t)(rclock - pb.clock);
+          } else {
+            it = ub;
+          }
+        }
+      }
+      size_t count = 0;
+      for (auto c = it; c != m.end(); ++c) count++;
+      w.vu(count);
+      w.vu(client);
+      w.vu(items[it->second].clock + (uint64_t)offset);
+      bool first = true;
+      for (; it != m.end(); ++it) {
+        if (!encode_item(w, items[it->second], first ? offset : 0))
+          return std::string();
+        first = false;
+      }
+    }
+    // delete set: merged deleted ranges per client, higher ids first
+    std::vector<std::pair<uint64_t, std::vector<std::pair<uint64_t, uint64_t>>>>
+        dels;
+    for (const auto& kv : by_client) {
+      std::vector<std::pair<uint64_t, uint64_t>> rs;
+      for (const auto& ci : kv.second) {
+        const Item& b = items[ci.second];
+        if (!b.deleted) continue;
+        uint64_t s = b.clock, e = b.clock + (uint64_t)b.len;
+        if (!rs.empty() && rs.back().second == s)
+          rs.back().second = e;
+        else
+          rs.emplace_back(s, e);
+      }
+      if (!rs.empty()) dels.emplace_back(kv.first, std::move(rs));
+    }
+    std::sort(dels.begin(), dels.end(),
+              [](const auto& a, const auto& b2) { return a.first > b2.first; });
+    w.vu(dels.size());
+    for (const auto& [client, rs] : dels) {
+      w.vu(client);
+      w.vu(rs.size());
+      for (const auto& [s, e] : rs) {
+        w.vu(s);
+        w.vu(e - s);
+      }
+    }
+    return w.buf;
+  }
 };
 
 char* dup_cstr(const std::string& s) {
@@ -1124,6 +1303,33 @@ char* ytpu_engine_root_json(void* h, const char* name, int shape) {
   std::string s = static_cast<Engine*>(h)->root_json(name, shape);
   if (s.empty()) return nullptr;
   return dup_cstr(s);
+}
+
+// V1 update bytes for the diff vs a remote state vector (parallel
+// client/clock arrays). Returns a malloc'd buffer (length in *out_len),
+// or NULL when the state holds content this encoder cannot re-emit —
+// callers fall back to the host oracle. Free with ytpu_engine_str_free.
+char* ytpu_engine_encode_diff(void* h, const uint64_t* sv_clients,
+                              const uint64_t* sv_clocks, size_t n_sv,
+                              size_t* out_len) {
+  Engine* e = static_cast<Engine*>(h);
+  std::vector<std::pair<uint64_t, uint64_t>> remote;
+  remote.reserve(n_sv);
+  for (size_t i = 0; i < n_sv; i++)
+    remote.emplace_back(sv_clients[i], sv_clocks[i]);
+  std::string s = e->encode_diff(remote);
+  if (s.empty()) {
+    *out_len = 0;
+    return nullptr;
+  }
+  char* out = (char*)malloc(s.size());
+  if (!out) {
+    *out_len = 0;
+    return nullptr;
+  }
+  memcpy(out, s.data(), s.size());
+  *out_len = s.size();
+  return out;
 }
 
 void ytpu_engine_str_free(char* s) { free(s); }
