@@ -34,9 +34,9 @@ def capture_v1(ops_fn, client_id=1):
 
 
 def decode(payloads_v2, max_rows=8, max_dels=8, **kw):
-    buf, lens, spans = pack_updates_v2(payloads_v2)
+    buf, lens, spans, side = pack_updates_v2(payloads_v2)
     stream, flags = decode_updates_v2(
-        buf, lens, spans, max_rows, max_dels, **kw
+        buf, lens, spans, max_rows, max_dels, sidecar=side, **kw
     )
     return buf, stream, np.asarray(flags)
 
@@ -306,8 +306,8 @@ def test_apply_v2_device_stream_end_to_end():
 
     doc, log = capture_v1(ops)
     v2 = [v1_to_v2(p) for p in log]
-    buf, lens, spans = pack_updates_v2(v2)
-    stream, flags = decode_updates_v2(buf, lens, spans, 4, 4)
+    buf, lens, spans, side = pack_updates_v2(v2)
+    stream, flags = decode_updates_v2(buf, lens, spans, 4, 4, sidecar=side)
     assert (np.asarray(flags) & FLAG_ERRORS == 0).all(), np.asarray(flags)
 
     # the stream is already step-shaped: update s = step s over the batch
@@ -341,8 +341,8 @@ def test_b4_trace_prefix_rides_device_lane():
             else:
                 t.remove_range(txn, pos, payload)
     v2 = [v1_to_v2(p) for p in log]
-    buf, lens, spans = pack_updates_v2(v2)
-    stream, flags = decode_updates_v2(buf, lens, spans, 4, 4)
+    buf, lens, spans, side = pack_updates_v2(v2)
+    stream, flags = decode_updates_v2(buf, lens, spans, 4, 4, sidecar=side)
     f = np.asarray(flags)
     assert (f & FLAG_ERRORS == 0).all(), f[(f & FLAG_ERRORS) != 0][:5]
 
@@ -388,7 +388,7 @@ def test_big_client_ids_resolve_through_hash_table():
         jnp.asarray(np.zeros(0, dtype=np.int64)),
         jnp.asarray(np.zeros(0, dtype=np.int32)),
     )
-    buf, lens, spans = pack_updates_v2(v2)
+    buf, lens, spans, side = pack_updates_v2(v2)
     stream, flags = decode_updates_v2(
         buf, lens, spans, 8, 8,
         client_table=client_table,
@@ -442,8 +442,8 @@ def test_b4_full_trace_rides_v2_device_lane():
     total_flagged = 0
     for base in range(0, len(v2), CHUNK):
         part = v2[base : base + CHUNK]
-        buf, lens, spans = pack_updates_v2(part, pad_to=64)
-        stream, flags = decode_updates_v2(buf, lens, spans, 4, 4)
+        buf, lens, spans, side = pack_updates_v2(part, pad_to=64)
+        stream, flags = decode_updates_v2(buf, lens, spans, 4, 4, sidecar=side)
         f = np.asarray(flags)
         total_flagged += int((f & FLAG_ERRORS != 0).sum())
     assert total_flagged == 0, f"{total_flagged} lanes fell back to host"
@@ -454,8 +454,8 @@ def test_b4_full_trace_rides_v2_device_lane():
     doc = Doc(client_id=99)
     for p in log[:n]:
         doc.apply_update_v1(p)
-    buf, lens, spans = pack_updates_v2(v2[:n], pad_to=64)
-    stream, flags = decode_updates_v2(buf, lens, spans, 4, 4)
+    buf, lens, spans, side = pack_updates_v2(v2[:n], pad_to=64)
+    stream, flags = decode_updates_v2(buf, lens, spans, 4, 4, sidecar=side)
     state = init_state(1, 1 << 14)
     state = apply_update_stream(state, stream, identity_rank(2))
     assert int(np.asarray(state.error).max()) == 0
@@ -468,9 +468,9 @@ def test_widened_content_kinds_ride_device_lane():
     Any values (depth-1 lists/objects), Binary bufs, map LWW chains (via
     the key table) and Move payloads with ZERO host fallbacks — the V2
     lane's supported set now covers every north-star array/map workload
-    shape. (Type/Embed/Format/Json/Doc content still routes to the host:
-    their V2 wire splits across columns in forms the V1-shaped span
-    readers cannot address; they stay per-lane flagged.)"""
+    shape. (Since round 5, Type/Embed/Format/Json also ride the lane via
+    the pack-time V1-form sidecar — see the cold-content tests below;
+    only Doc content and weak type tags stay per-lane flagged.)"""
     import jax.numpy as jnp
 
     from ytpu.models.batch_doc import (
@@ -506,13 +506,13 @@ def test_widened_content_kinds_ride_device_lane():
         arr.move_to(txn, 1, 3)
 
     v2 = [v1_to_v2(p) for p in log]
-    buf, lens, spans = pack_updates_v2(v2, pad_to=128)
+    buf, lens, spans, side = pack_updates_v2(v2, pad_to=128)
     keys = KeyInterner()
     kt = (
         jnp.asarray([key_hash_host(b"x")]),
         jnp.asarray([keys.intern("x")]),
     )
-    stream, flags = decode_updates_v2(buf, lens, spans, 8, 4, key_table=kt)
+    stream, flags = decode_updates_v2(buf, lens, spans, 8, 4, key_table=kt, sidecar=side)
     f = np.asarray(flags)
     assert (f & FLAG_ERRORS == 0).all(), f"host fallbacks: {f}"
 
@@ -535,8 +535,151 @@ def test_deep_any_values_fall_back_to_host():
     with d.transact() as txn:
         arr.insert_range(txn, 0, [{"deep": [1, 2, 3]}])
     v2 = [v1_to_v2(p) for p in log]
-    buf, lens, spans = pack_updates_v2(v2, pad_to=128)
-    stream, flags = decode_updates_v2(buf, lens, spans, 4, 4)
+    buf, lens, spans, side = pack_updates_v2(v2, pad_to=128)
+    stream, flags = decode_updates_v2(buf, lens, spans, 4, 4, sidecar=side)
     f = np.asarray(flags)
     assert (f & FLAG_UNSUPPORTED != 0).all(), f
     assert not np.asarray(stream.valid).any()  # flagged lanes emit no rows
+
+
+def test_cold_content_payload_refs_resolve_v1_form():
+    """Round 5 (VERDICT r4 #4): Json / Embed / Format / Type content
+    structure-decodes on the V2 device lane; each row's payload ref
+    points at the pack-time V1-form sidecar span and every V1-shaped
+    reader resolves it — validated field-by-field against the host
+    decoder."""
+    from collections import deque
+
+    from ytpu.core.block import Item
+    from ytpu.core.content import ContentJSON
+    from ytpu.core.id_set import DeleteSet
+    from ytpu.core.ids import ID
+    from ytpu.ops.decode_kernel import RawPayloadView
+    from ytpu.types import XmlElementPrelim
+
+    d = Doc(client_id=11)
+    log = []
+    d.observe_update_v1(lambda p, o, t: log.append(p))
+    t = d.get_text("t")
+    with d.transact() as txn:
+        t.insert(txn, 0, "hello world")
+    with d.transact() as txn:
+        t.format(txn, 0, 5, {"bold": True})
+    with d.transact() as txn:
+        t.insert_embed(txn, 5, {"img": "x.png"})
+    frag = d.get_xml_fragment("x")
+    with d.transact() as txn:
+        frag.insert(txn, 0, XmlElementPrelim("div", attributes={"id": "a1"}))
+    v2 = [v1_to_v2(p) for p in log]
+    # hand-crafted legacy ContentJSON carrier (the host lib never emits
+    # one; the wire still must decode — block.rs:1786-1835 uniformity)
+    ContentJSON  # noqa: B018 — imported for the carrier below
+    it = Item(
+        ID(99, 0), None, None, None, None, "j", None,
+        ContentJSON(["1", '{"a": 2}']),
+    )
+    up = Update({99: deque([it])}, DeleteSet())
+    v2.append(up.encode_v2())
+
+    buf, lens, spans, side = pack_updates_v2(v2, pad_to=256)
+    assert side is not None  # cold kinds detected
+    import jax.numpy as jnp
+
+    from ytpu.models.batch_doc import KeyInterner
+    from ytpu.ops.decode_kernel import key_hash_host
+
+    keys = KeyInterner()
+    kt = (
+        jnp.asarray([key_hash_host(b"id")]),
+        jnp.asarray([keys.intern("id")]),
+    )
+    stream, flags = decode_updates_v2(
+        buf, lens, spans, 8, 4, key_table=kt, sidecar=side
+    )
+    f = np.asarray(flags)
+    assert (f & FLAG_ERRORS == 0).all(), f"host fallbacks: {f}"
+
+    view = RawPayloadView(np.asarray(buf), v2_any=True)
+    valid = np.asarray(stream.valid)
+    kinds = np.asarray(stream.kind)
+    refs = np.asarray(stream.content_ref)
+    lengths = np.asarray(stream.length)
+    from ytpu.core.content import (
+        CONTENT_EMBED as K_EMBED,
+        CONTENT_FORMAT as K_FMT,
+        CONTENT_JSON as K_JSON,
+        CONTENT_TYPE as K_TYPE,
+    )
+
+    seen = {"fmt": 0, "embed": 0, "type": 0, "json": 0}
+    for s, payload in enumerate(v2):
+        hosts = []
+        for client, blocks in sorted(Update.decode_v2(payload).blocks.items()):
+            hosts.extend(b for b in blocks if getattr(b, "content", None))
+        hi = 0
+        for u in range(valid.shape[1]):
+            if not valid[s, u]:
+                continue
+            host_content = hosts[hi].content if hi < len(hosts) else None
+            hi += 1
+            k, ref = int(kinds[s, u]), int(refs[s, u])
+            if k == K_FMT:
+                key, val = view.format_kv(ref)
+                assert (key, val) == (host_content.key, host_content.value)
+                seen["fmt"] += 1
+            elif k == K_EMBED:
+                assert view.embed_value(ref) == host_content.value
+                seen["embed"] += 1
+            elif k == K_TYPE:
+                br = view.type_branch(ref)
+                assert br.type_ref == host_content.branch.type_ref
+                assert br.type_name == host_content.branch.type_name
+                seen["type"] += 1
+            elif k == K_JSON:
+                assert (
+                    view.json_raw(ref, 0, int(lengths[s, u]))
+                    == host_content.raw
+                )
+                seen["json"] += 1
+    assert all(v > 0 for v in seen.values()), seen
+
+
+def test_rich_text_stream_rides_v2_device_lane():
+    """Format + embed text streams decode on the V2 lane with zero host
+    fallbacks and integrate to the same rich-text runs as the host."""
+    from ytpu.models.batch_doc import (
+        apply_update_stream,
+        get_diff,
+        init_state,
+    )
+    from ytpu.ops.decode_kernel import RawPayloadView, identity_rank
+
+    def ops(doc):
+        t = doc.get_text("text")
+        with doc.transact() as txn:
+            t.insert(txn, 0, "the quick brown fox")
+        with doc.transact() as txn:
+            t.format(txn, 4, 5, {"b": True})
+        with doc.transact() as txn:
+            t.insert_embed(txn, 9, {"u": "e.png"})
+        with doc.transact() as txn:
+            t.format(txn, 4, 5, {"b": None})  # unformat
+        with doc.transact() as txn:
+            t.remove_range(txn, 0, 4)
+
+    doc, log = capture_v1(ops)
+    v2 = [v1_to_v2(p) for p in log]
+    buf, lens, spans, side = pack_updates_v2(v2, pad_to=256)
+    stream, flags = decode_updates_v2(buf, lens, spans, 8, 4, sidecar=side)
+    f = np.asarray(flags)
+    assert (f & FLAG_ERRORS == 0).all(), f"host fallbacks: {f}"
+
+    state = init_state(1, 256)
+    state = apply_update_stream(state, stream, identity_rank(2))
+    assert int(np.asarray(state.error).max()) == 0
+    view = RawPayloadView(np.asarray(buf), v2_any=True)
+    got = get_diff(state, 0, view)
+    want = doc.get_text("text").diff()
+    assert [(r.insert, r.attributes) for r in got] == [
+        (r.insert, r.attributes) for r in want
+    ]

@@ -20,14 +20,20 @@ sequential pass over the wire bytes:
    counts are computed from the info bytes alone, prefix-summed into
    per-block column indices, and gathered.
 
-Device-supported set (v0): GC / Skip / Deleted / String blocks with root,
-ID, or nested parents, parent_sub map keys (hashed through the same
-`key_table` as the V1 lane), multi client sections, and the delete set —
-i.e. every shape in the text-editing north-star workloads (B4). Lanes
-holding Any / JSON / Embed / Binary / Format / Type / Doc / Move content
-flag FLAG_UNSUPPORTED and take the host lane (their `rest` stream is no
-longer a flat varint list, so nothing after the first such block could be
-trusted anyway). Client ids beyond i32 resolve through the SAME
+Device-supported set (round 5): GC / Skip blocks and EVERY item content
+kind except sub-documents — Deleted / String / Any / Binary / Move
+decode fully on device (Any values via the rest WALKER, depth-1
+lists/objects); Json / Embed / Format / Type structure-decodes on
+device while their payload bytes resolve through a pack-time V1-form
+sidecar (`_cold_sidecar` — the V2 wire scatters those payloads across
+the len/string/type-ref/rest columns in forms the V1-shaped span
+readers cannot address, so pack transcodes them once, host-side).
+Root, ID, and nested parents, parent_sub map keys (hashed through the
+same `key_table` as the V1 lane), multi client sections, and the delete
+set all decode on device. Still host-routed (FLAG_UNSUPPORTED): Doc
+content (subdoc lifecycle is host-level on both lanes), weak/unknown
+type-ref tags, and Any values nested beyond the walker's depth-1 scope.
+Client ids beyond i32 resolve through the SAME
 `client_hash_table` as the V1 lane: V2 client columns use *signed*
 varints, so the expander reconstructs each big id's unsigned-varint byte
 sequence from its 64-bit limbs and applies `client_hash_host`'s mixing
@@ -61,6 +67,7 @@ from ytpu.core.content import (
     CONTENT_JSON,
     CONTENT_MOVE,
     CONTENT_STRING,
+    CONTENT_TYPE,
 )
 from ytpu.encoding.lib0 import Cursor
 
@@ -97,22 +104,91 @@ U32 = jnp.uint32
 ) = range(12)
 
 
+# content kinds whose V2 payloads scatter across columns in forms the
+# V1-shaped span readers cannot address; pack transcodes them into a
+# V1-form SIDECAR appended after the update bytes (see pack_updates_v2)
+_COLD_KINDS = (CONTENT_JSON, CONTENT_EMBED, CONTENT_FORMAT, 7)  # 7=Type
+
+
+def _info_has_cold(p: bytes, start: int, length: int) -> bool:
+    """Scan the info column's RLE runs for cold content kinds — O(runs)."""
+    cur = Cursor(p[start : start + length])
+    try:
+        while cur.pos < length:
+            v = cur.read_u8()
+            if cur.pos < length:
+                cur.read_var_uint()  # run count - 1
+            if v not in (0, BLOCK_SKIP) and (v & 0x0F) in _COLD_KINDS:
+                return True
+    except Exception:
+        pass
+    return False
+
+
+def _cold_sidecar(p: bytes) -> Optional[List[bytes]]:
+    """V1-form payload bytes for every cold-kind block, in WIRE block
+    order (sections as written, blocks within each section in order).
+
+    The V2 wire splits Json / Embed / Format / Type payloads across the
+    len / string / type-ref / rest columns (encoder.rs:253-260); the
+    device lane decodes their STRUCTURE (ids, lengths, parents) from
+    those columns, but the payload-byte readers (`RawPayloadView`,
+    `ChunkedWirePayloads`, the native finisher arenas) all speak the V1
+    inline form. `content.encode(EncoderV1)` is by construction exactly
+    that form, so pack transcodes each cold payload once, host-side,
+    into a sidecar span the row's ref points at. Returns None when the
+    update cannot be walked (the device flags it malformed anyway)."""
+    from ytpu.core.ids import ID
+    from ytpu.core.update import _decode_block
+    from ytpu.encoding.codec import DecoderV2, EncoderV1
+
+    try:
+        dec = DecoderV2(p)
+        out: List[bytes] = []
+        n_clients = dec.read_var()
+        for _ in range(n_clients):
+            n_blocks = dec.read_var()
+            client = dec.read_client()
+            clock = dec.read_var()
+            for _ in range(n_blocks):
+                carrier = _decode_block(ID(client, clock), dec)
+                if carrier is None:
+                    continue
+                clock += carrier.len
+                content = getattr(carrier, "content", None)
+                if content is not None and content.kind in _COLD_KINDS:
+                    enc = EncoderV1()
+                    content.encode(enc)
+                    out.append(enc.to_bytes())
+        return out
+    except Exception:
+        return None
+
+
 def pack_updates_v2(
     payloads: List[bytes], pad_to: Optional[int] = None
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Optional[np.ndarray]]:
     """Pad raw V2 update byte strings into ``[S, L] uint8`` + frame spans.
 
     Host cost: eleven varint reads per update (the feature flag, nine
     column-buffer length prefixes, and the string column's inner blob
-    length) — no value decoding, interning, or copying beyond the pad.
+    length) — no value decoding, interning, or copying beyond the pad —
+    UNLESS an update's info column holds cold content kinds (Json /
+    Embed / Format / Type), in which case that update's cold payloads
+    are transcoded into a V1-form sidecar appended after its bytes (the
+    rows' content refs point there; structure still decodes on device).
 
-    Returns ``(buf, lens, spans)`` with ``spans[s, k] = (start, len)`` for
-    the twelve regions (`SP_*`). A lane that fails frame splitting gets
-    all-zero spans; `decode_updates_v2` flags it malformed.
+    Returns ``(buf, lens, spans, sidecar)`` with ``spans[s, k] =
+    (start, len)`` for the twelve regions (`SP_*`) and ``sidecar`` an
+    ``[S, NCOLD] int32`` of per-cold-block byte offsets into the lane
+    row (wire block order, -1 padded) — or None when no lane has cold
+    content. A lane that fails frame splitting gets all-zero spans;
+    `decode_updates_v2` flags it malformed.
     """
-    buf, lens = pack_updates(payloads, pad_to)
     S = len(payloads)
     spans = np.zeros((S, 12, 2), dtype=np.int32)
+    side: List[Optional[List[bytes]]] = [None] * S
+    side_failed = [False] * S
     for s, p in enumerate(payloads):
         try:
             cur = Cursor(p)
@@ -132,9 +208,41 @@ def pack_updates_v2(
                     st + scur.pos + bn,
                     sl - scur.pos - bn,
                 )
+            ist, isl = spans[s, SP_INFO]
+            if isl > 0 and _info_has_cold(p, int(ist), int(isl)):
+                side[s] = _cold_sidecar(p)
+                side_failed[s] = side[s] is None
         except Exception:
             spans[s] = 0  # malformed frame: flagged on device
-    return buf, lens, spans
+    n_cold = max((len(c) for c in side if c), default=0)
+    if n_cold == 0:
+        need = max((len(p) for p in payloads), default=1)
+        L = max(pad_to or 0, need, 1)
+        buf = np.zeros((S, L), dtype=np.uint8)
+        for s, p in enumerate(payloads):
+            buf[s, : len(p)] = np.frombuffer(p, dtype=np.uint8)
+        lens = np.asarray([len(p) for p in payloads], dtype=np.int32)
+        return buf, lens, spans, None
+    sidecar = np.full((S, n_cold), -1, dtype=np.int32)
+    need = max(
+        len(p) + sum(len(c) for c in (side[s] or []))
+        for s, p in enumerate(payloads)
+    )
+    L = max(pad_to or 0, need, 1)
+    buf = np.zeros((S, L), dtype=np.uint8)
+    for s, p in enumerate(payloads):
+        buf[s, : len(p)] = np.frombuffer(p, dtype=np.uint8)
+        off = len(p)
+        for k, cbytes in enumerate(side[s] or []):
+            buf[s, off : off + len(cbytes)] = np.frombuffer(
+                cbytes, dtype=np.uint8
+            )
+            sidecar[s, k] = off
+            off += len(cbytes)
+        if side_failed[s]:
+            spans[s] = 0  # cold walk failed: flag the lane malformed
+    lens = np.asarray([len(p) for p in payloads], dtype=np.int32)
+    return buf, lens, spans, sidecar
 
 
 # --- vectorized varint helpers ----------------------------------------------
@@ -756,14 +864,17 @@ def decode_updates_v2(
     key_table: Optional[Tuple[jax.Array, jax.Array]] = None,
     client_hash_table: Optional[Tuple[jax.Array, jax.Array]] = None,
     primary_root_hash: Optional[jax.Array] = None,
+    sidecar: Optional[np.ndarray] = None,
 ):
     """Decode S V2 updates into an ``[S, U] / [S, R]`` UpdateBatch stream.
 
     Same contract as `decode_updates_v1` (see its docstring for the table
-    semantics); `spans` comes from `pack_updates_v2`. Client ids beyond
-    i32 hash to the same `client_hash_table` entries as the V1 lane: the
-    expander reconstructs the id's UNSIGNED-varint bytes from its signed
-    V2 encoding and applies `client_hash_host`'s mixing on device.
+    semantics); `spans` and `sidecar` come from `pack_updates_v2` (the
+    sidecar carries V1-form payload spans for Json / Embed / Format /
+    Type content — see `_cold_sidecar`). Client ids beyond i32 hash to
+    the same `client_hash_table` entries as the V1 lane: the expander
+    reconstructs the id's UNSIGNED-varint bytes from its signed V2
+    encoding and applies `client_hash_host`'s mixing on device.
     """
     S, L = buf.shape
     U, R = max_rows, max_dels
@@ -794,6 +905,7 @@ def decode_updates_v2(
     lc_vals, lc_n = _expand_intdiffoptrle(b, *span(SP_LEFT_CLOCK), NB)
     rc_vals, rc_n = _expand_intdiffoptrle(b, *span(SP_RIGHT_CLOCK), NB)
     len_vals, len_n = _expand_uintoptrle(b, *span(SP_LEN), NB)
+    tr_vals, tr_n = _expand_uintoptrle(b, *span(SP_TYPE_REF), NB)
     str16, str_n = _expand_uintoptrle(b, *span(SP_STR_LENS), NS)
 
     # string byte offsets: binary-search the buffer's UTF-16 prefix sums for
@@ -845,26 +957,27 @@ def decode_updates_v2(
     l_cnt = (has_o | is_nested).astype(I32)
     l_idx = _cumsum_excl(l_cnt)
     r_idx = _cumsum_excl(has_r.astype(I32))
-    # string column: root name, parent_sub, string content — in that order
+    # content-kind masks (full set — every kind structure-decodes here;
+    # only Doc and weak/unknown type tags still route to the host)
     is_str_content = is_item & (kind4 == CONTENT_STRING)
-    s_cnt = is_root.astype(I32) + has_psub.astype(I32) + is_str_content.astype(I32)
-    s_base = _cumsum_excl(s_cnt)
-    # len column: GC + Deleted lengths, plus Any/Json element counts
-    # (ContentAny/ContentJson write their element count via write_len —
-    # encoder.rs:253-260 — so they consume len-column entries too)
     is_del_content = is_item & (kind4 == CONTENT_DELETED)
     is_any_content = is_item & (kind4 == CONTENT_ANY)
     is_json_content = is_item & (kind4 == CONTENT_JSON)
     is_bin_content = is_item & (kind4 == CONTENT_BINARY)
+    is_embed_content = is_item & (kind4 == CONTENT_EMBED)
+    is_format_content = is_item & (kind4 == CONTENT_FORMAT)
+    is_type_content = is_item & (kind4 == CONTENT_TYPE)
+    is_doc_content = is_item & (kind4 == (CONTENT_DOC & 0x0F))
     is_move_content = is_item & ((info & 0x0F) == (CONTENT_MOVE & 0x0F))
     # one traversable Any value rides the rest stream for these kinds
-    # (Embed + Format value + Doc options); their lanes still take the
-    # host path (FLAG_UNSUPPORTED) but the walker keeps the stream sound
+    # (Embed value, Format value, Doc options) — the walker excises it
+    # and, for Embed/Format, the sidecar carries its V1-form transcode
     is_one_any = is_item & (
-        (kind4 == CONTENT_EMBED)
-        | (kind4 == CONTENT_FORMAT)
-        | (kind4 == (CONTENT_DOC & 0x0F))
+        is_embed_content | is_format_content | is_doc_content
     )
+    # len column: GC + Deleted lengths, plus Any/Json element counts
+    # (ContentAny/ContentJson write their element count via write_len —
+    # encoder.rs:253-260 — so they consume len-column entries too)
     n_cnt = (
         is_gc | is_del_content | is_any_content | is_json_content
     ).astype(I32)
@@ -875,6 +988,24 @@ def decode_updates_v2(
     w_any_cnt = jnp.where(
         is_any_content, len_at_blk, jnp.where(is_one_any, 1, 0)
     )
+    # type-ref column: one entry per ContentType block; XmlElement /
+    # XmlHook tags additionally consume a string (the node name)
+    tr_idx = _cumsum_excl(is_type_content.astype(I32))
+    tr_tag = jnp.take_along_axis(tr_vals, jnp.clip(tr_idx, 0, NB - 1), axis=1)
+    is_type_named = is_type_content & ((tr_tag == 3) | (tr_tag == 5))
+    type_weak_or_unknown = is_type_content & (tr_tag >= 7)
+    # string column: root name, parent_sub, then content strings — in
+    # that order per block (Json: N strings; Format: the key; XmlElement
+    # / XmlHook type: the node name; String: the payload)
+    s_cnt = (
+        is_root.astype(I32)
+        + has_psub.astype(I32)
+        + is_str_content.astype(I32)
+        + jnp.where(is_json_content, len_at_blk, 0)
+        + is_format_content.astype(I32)
+        + is_type_named.astype(I32)
+    )
+    s_base = _cumsum_excl(s_cnt)
     cum_skip = _cumsum_excl(is_skip.astype(I32))  # skips before block j
     cum_skip_incl = jnp.cumsum(is_skip.astype(I32), axis=1)
 
@@ -1137,20 +1268,29 @@ def decode_updates_v2(
     clock = sec_clk + len_psum - g(len_psum, jnp.clip(blk_secbase, 0, NB - 1))
 
     # --- unsupported / overflow / big-client flags ---------------------------
+    # cold kinds (Json/Embed/Format/Type) structure-decode here and take
+    # their payload refs from the pack-time V1-form sidecar; only Doc
+    # content (subdoc lifecycle is host-level on BOTH lanes — decode_
+    # kernel.py routes it to ST_ERR too) and weak/unknown type tags still
+    # flag the lane
+    cold_mask = valid_blk & (
+        is_json_content
+        | is_embed_content
+        | is_format_content
+        | (is_type_content & ~type_weak_or_unknown)
+    )
     unsupported = (
         jnp.any(
             valid_blk
-            & is_item
-            & ~is_del_content
-            & ~is_str_content
-            & ~is_any_content
-            & ~is_bin_content
-            & ~is_move_content,
+            & (is_doc_content | type_weak_or_unknown),
             axis=1,
         )
         | jnp.any(key_too_long, axis=1)
         | deep_any
     )
+    if sidecar is None:
+        # no pack-time sidecar: cold payload bytes are unaddressable
+        unsupported = unsupported | jnp.any(cold_mask, axis=1)
     consumption_ovf = (
         (g(c_base, jnp.full((S, 1), NB - 1, I32))[:, 0] + 3 > NCLI)
         | (total_blocks > NB)
@@ -1165,6 +1305,7 @@ def decode_updates_v2(
     need_len = jnp.sum(n_cnt * vb, axis=1)
     need_str = jnp.sum(s_cnt * vb, axis=1)
     need_pi = jnp.sum(cant_copy.astype(I32) * vb, axis=1)
+    need_tr = jnp.sum(is_type_content.astype(I32) * vb, axis=1)
     truncated = (
         (need_cli > cli_n)
         | (need_lc > lc_n)
@@ -1172,7 +1313,11 @@ def decode_updates_v2(
         | (need_len > len_n)
         | (need_str > str_n)
         | (need_pi > pi_n)
+        | (need_tr > tr_n)
     )
+    # string demand beyond the expansion cap (Json-heavy blocks) would
+    # silently clip offsets — route to the host instead
+    str_cap_ovf = need_str > NS
 
     # --- delete set ----------------------------------------------------------
     d0 = 1 + 2 * jnp.minimum(nc, SEC) + _skips_upto(total_blocks)
@@ -1255,10 +1400,29 @@ def decode_updates_v2(
     # reader must be in V2/count-less mode, see RawPayloadView(v2_any=...));
     # Binary and Move spans are byte-identical to their V1 wire forms
     has_span = is_any_content | is_bin_content | is_move_content
+    # cold kinds: refs point at the pack-time V1-form sidecar spans,
+    # matched by cold-block rank in wire block order
+    side_bad = jnp.zeros((S,), bool)
+    ref_cold = jnp.full((S, NB), -1, I32)
+    if sidecar is not None:
+        side_j = jnp.asarray(sidecar, dtype=I32)
+        NC2 = side_j.shape[1]
+        cold_rank = _cumsum_excl(cold_mask.astype(I32))
+        cold_off = jnp.take_along_axis(
+            side_j, jnp.clip(cold_rank, 0, max(NC2 - 1, 0)), axis=1
+        )
+        side_bad = jnp.any(
+            cold_mask & ((cold_rank >= NC2) | (cold_off < 0)), axis=1
+        )
+        ref_cold = row_ids * L + cold_off
     ref_col = jnp.where(
         is_str_content,
         row_ids * L + content_start,
-        jnp.where(has_span, row_ids * L + c_start, -1),
+        jnp.where(
+            has_span,
+            row_ids * L + c_start,
+            jnp.where(cold_mask, ref_cold, -1),
+        ),
     )
     mvf = walker_out["mvf"]
     mv_collapsed = (mvf & 1) != 0
@@ -1301,6 +1465,7 @@ def decode_updates_v2(
         | ds_bad
         | truncated
         | walk_bad
+        | side_bad
         | (valid_blk & (blk_len < 0)).any(axis=1)
     )
     flags = (
@@ -1308,7 +1473,8 @@ def decode_updates_v2(
         | jnp.where(malformed, FLAG_MALFORMED, 0)
         | jnp.where(unsupported, FLAG_UNSUPPORTED, 0)
         | jnp.where(
-            blk_ovf | row_ovf | consumption_ovf | ds_ovf | ds_sec_ovf,
+            blk_ovf | row_ovf | consumption_ovf | ds_ovf | ds_sec_ovf
+            | str_cap_ovf,
             FLAG_OVERFLOW,
             0,
         )
