@@ -466,6 +466,7 @@ class ShardedDoc:
         self._queued = 0
         self.max_rows_per_step = max_rows_per_step
         self._host_cache = None  # pulled columns, invalidated by flushes
+        self._dirty = False  # device steps in flight since the last _sync
 
     # ------------------------------------------------------------- plumbing
 
@@ -479,60 +480,43 @@ class ShardedDoc:
         """Host view of all shard columns (cached between flushes)."""
         if self._host_cache is None:
             self.flush()
+            self._sync()
             self._host_cache = jax.tree.map(np.asarray, self.state)
         return self._host_cache
 
+    def _sync(self) -> None:
+        """Block on the device pipeline: surface sticky error flags and
+        tighten the optimistic row-count upper bound to the real one.
+        Called at read points and near-capacity — NOT per flush, so host
+        routing overlaps the async device steps (VERDICT r4 #5)."""
+        if not self._dirty:
+            return
+        err = np.asarray(self.state.error)
+        if err.any():
+            raise RuntimeError(f"sharded integration error flags: {err}")
+        self._n_rows = np.asarray(self.state.n_blocks).astype(np.int64)
+        self._dirty = False
+        if self._n_rows.max() > 0.75 * self.capacity:
+            self._grow(self.capacity * 2)
+
     def flush(self) -> None:
-        """Integrate every queued row/delete on device."""
+        """Integrate every queued row/delete on device.
+
+        Steps are dispatched at ONE fixed shape — ``(S, max_rows_per_step)``
+        rows + ``(S, max_rows_per_step)`` deletes — chunking longer queues
+        into several dispatches. A single compiled program per capacity is
+        the point: the round-4 sp capture was dominated by ~4s CPU
+        recompiles every time a power-of-two bucket (usually the delete
+        count) grew mid-run, burying the ~12ms steady step cost.
+        """
         if self._queued == 0:
             return
-        U = max(1, max(len(q) for q in self._queue_rows))
-        R = max(1, max(len(q) for q in self._queue_dels))
-        # bucket pads to limit jit cache entries
-        U = 1 << (U - 1).bit_length()
-        R = 1 << (R - 1).bit_length()
-        rows = np.zeros((self.S, U, 15), dtype=np.int32)
-        rows[:, :, 3] = -1  # s_oc
-        rows[:, :, 5] = -1  # s_rc
-        rows[:, :, 7] = -1  # a_oc
-        rows[:, :, 9] = -1  # a_rc
-        rows[:, :, 14] = -1  # key (sequence row)
-        valid = np.zeros((self.S, U), dtype=bool)
-        dels = np.zeros((self.S, R, 3), dtype=np.int32)
-        del_valid = np.zeros((self.S, R), dtype=bool)
-        for s in range(self.S):
-            for i, row in enumerate(self._queue_rows[s]):
-                rows[s, i] = row
-                valid[s, i] = True
-            for i, d in enumerate(self._queue_dels[s]):
-                dels[s, i] = d
-                del_valid[s, i] = True
-        step = SpStep(
-            client=jnp.asarray(rows[:, :, 0]),
-            clock=jnp.asarray(rows[:, :, 1]),
-            length=jnp.asarray(rows[:, :, 2]),
-            s_oc=jnp.asarray(rows[:, :, 3]),
-            s_ok=jnp.asarray(rows[:, :, 4]),
-            s_rc=jnp.asarray(rows[:, :, 5]),
-            s_rk=jnp.asarray(rows[:, :, 6]),
-            a_oc=jnp.asarray(rows[:, :, 7]),
-            a_ok=jnp.asarray(rows[:, :, 8]),
-            a_rc=jnp.asarray(rows[:, :, 9]),
-            a_rk=jnp.asarray(rows[:, :, 10]),
-            kind=jnp.asarray(rows[:, :, 11]),
-            content_ref=jnp.asarray(rows[:, :, 12]),
-            content_off=jnp.asarray(rows[:, :, 13]),
-            key=jnp.asarray(rows[:, :, 14]),
-            valid=jnp.asarray(valid),
-            del_client=jnp.asarray(dels[:, :, 0]),
-            del_start=jnp.asarray(dels[:, :, 1]),
-            del_end=jnp.asarray(dels[:, :, 2]),
-            del_valid=jnp.asarray(del_valid),
-        )
+        U = self.max_rows_per_step
+        R = self.max_rows_per_step
         # pre-grow: every row can cost up to 3 slots (itself + two anchor
         # splits) and every delete up to 2 (edge splits) — ensure headroom
         # BEFORE integrating, or a capacity overflow would raise after the
-        # queues are cleared with the sticky error flag set
+        # queues are cleared with the sticky error flag set.
         # _n_rows already counts queued rows (optimistic bump in
         # _enqueue_row); each row/delete can add up to 2 split rows
         worst = max(
@@ -546,17 +530,80 @@ class ShardedDoc:
             while cap < worst:
                 cap *= 2
             self._grow(cap)
+        row_q = self._queue_rows
+        del_q = self._queue_dels
+        n_q_rows = np.asarray([len(q) for q in row_q], dtype=np.int64)
+        n_q_dels = np.asarray([len(q) for q in del_q], dtype=np.int64)
         self._queue_rows = [[] for _ in range(self.S)]
         self._queue_dels = [[] for _ in range(self.S)]
         self._queued = 0
-        self.state = apply_step_sharded(self.state, step, self._rank())
+
+        def dispatch(row_chunk, del_chunk):
+            rows = np.zeros((self.S, U, 15), dtype=np.int32)
+            rows[:, :, 3] = -1  # s_oc
+            rows[:, :, 5] = -1  # s_rc
+            rows[:, :, 7] = -1  # a_oc
+            rows[:, :, 9] = -1  # a_rc
+            rows[:, :, 14] = -1  # key (sequence row)
+            valid = np.zeros((self.S, U), dtype=bool)
+            dels = np.zeros((self.S, R, 3), dtype=np.int32)
+            del_valid = np.zeros((self.S, R), dtype=bool)
+            for s in range(self.S):
+                for i, row in enumerate(row_chunk[s]):
+                    rows[s, i] = row
+                    valid[s, i] = True
+                for i, d in enumerate(del_chunk[s]):
+                    dels[s, i] = d
+                    del_valid[s, i] = True
+            step = SpStep(
+                client=jnp.asarray(rows[:, :, 0]),
+                clock=jnp.asarray(rows[:, :, 1]),
+                length=jnp.asarray(rows[:, :, 2]),
+                s_oc=jnp.asarray(rows[:, :, 3]),
+                s_ok=jnp.asarray(rows[:, :, 4]),
+                s_rc=jnp.asarray(rows[:, :, 5]),
+                s_rk=jnp.asarray(rows[:, :, 6]),
+                a_oc=jnp.asarray(rows[:, :, 7]),
+                a_ok=jnp.asarray(rows[:, :, 8]),
+                a_rc=jnp.asarray(rows[:, :, 9]),
+                a_rk=jnp.asarray(rows[:, :, 10]),
+                kind=jnp.asarray(rows[:, :, 11]),
+                content_ref=jnp.asarray(rows[:, :, 12]),
+                content_off=jnp.asarray(rows[:, :, 13]),
+                key=jnp.asarray(rows[:, :, 14]),
+                valid=jnp.asarray(valid),
+                del_client=jnp.asarray(dels[:, :, 0]),
+                del_start=jnp.asarray(dels[:, :, 1]),
+                del_end=jnp.asarray(dels[:, :, 2]),
+                del_valid=jnp.asarray(del_valid),
+            )
+            self.state = apply_step_sharded(self.state, step, self._rank())
+
+        # rows first (in queue order), then deletes: a delete may target
+        # rows queued in the same flush
+        n_row_chunks = (int(n_q_rows.max(initial=0)) + U - 1) // U
+        n_del_chunks = (int(n_q_dels.max(initial=0)) + R - 1) // R
+        empty = [[] for _ in range(self.S)]
+        for c in range(max(n_row_chunks, 1) if n_del_chunks else n_row_chunks):
+            row_chunk = [q[c * U : (c + 1) * U] for q in row_q]
+            # ride the deletes' first chunk along with the LAST row chunk
+            if c == max(n_row_chunks - 1, 0) and n_del_chunks == 1:
+                dispatch(row_chunk, [q[:R] for q in del_q])
+                n_del_chunks = 0
+            else:
+                dispatch(row_chunk, empty)
+        for c in range(n_del_chunks):
+            dispatch(empty, [q[c * R : (c + 1) * R] for q in del_q])
         self._invalidate()
-        err = np.asarray(self.state.error)
-        if err.any():
-            raise RuntimeError(f"sharded integration error flags: {err}")
-        self._n_rows = np.asarray(self.state.n_blocks).astype(np.int64)
+        # no device sync here: the steps run async while the host keeps
+        # routing. Maintain an UPPER BOUND on row counts (each row can
+        # add 2 split rows beyond the _enqueue_row bump, each delete 2);
+        # `_sync` (read points / near-capacity) tightens it and surfaces
+        # the sticky error flags.
+        self._dirty = True
+        self._n_rows = self._n_rows + 2 * n_q_rows + 2 * n_q_dels
         if self._n_rows.max() > 0.75 * self.capacity:
-            self._grow(self.capacity * 2)
+            self._sync()
 
     def _grow(self, new_capacity: int) -> None:
         from ytpu.ops.compaction import grow_state
