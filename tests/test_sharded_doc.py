@@ -372,3 +372,104 @@ def test_map_chain_fuzz_byte_identical():
     assert sd.get_string() == oracle.get_text("doc").get_string()
     assert sd.get_map() == oracle.get_map("doc").to_json()
     assert sd.encode_state_as_update_v1() == oracle.encode_state_as_update_v1()
+
+
+def test_nested_xml_tree_byte_exact_8_shards():
+    """Round 5 (VERDICT r4 #6): a sharded XML-tree replay — elements,
+    attributes (nested LWW chains), nested text edits, two concurrent
+    clients — is byte-exact vs the skip_gc oracle on 8 shards. Nested
+    branches are shard-affine with their ContentType row; the primary
+    root's children distribute across segments."""
+    from ytpu.types import XmlElementPrelim, XmlTextPrelim
+
+    rng = random.Random(11)
+    a, b = Doc(client_id=1, skip_gc=True), Doc(client_id=2, skip_gc=True)
+    relay = Doc(client_id=0xFFFF, skip_gc=True)
+    log = capture(relay)
+    fa, fb = a.get_xml_fragment("x"), b.get_xml_fragment("x")
+    with a.transact() as txn:
+        fa.insert(txn, 0, XmlElementPrelim("doc"))
+        fa.insert(txn, 1, XmlTextPrelim("seed"))
+    relay.apply_update_v1(a.encode_state_as_update_v1(relay.state_vector()))
+    b.apply_update_v1(a.encode_state_as_update_v1(b.state_vector()))
+    for step in range(50):
+        doc, frag = (a, fa) if rng.random() < 0.5 else (b, fb)
+        with doc.transact() as txn:
+            r = rng.random()
+            kids = list(frag.children())
+            if r < 0.3:
+                frag.insert(
+                    txn,
+                    rng.randrange(len(kids) + 1),
+                    XmlElementPrelim(f"e{step}", attributes={"n": str(step)}),
+                )
+            elif r < 0.6 and kids:
+                el = kids[rng.randrange(len(kids))]
+                if hasattr(el, "insert_attribute"):
+                    el.insert_attribute(txn, f"k{step % 5}", str(step))
+            else:
+                tx = [k for k in kids if type(k).__name__ == "XmlText"]
+                if tx:
+                    t = tx[rng.randrange(len(tx))]
+                    n = len(t)
+                    if n > 3 and rng.random() < 0.3:
+                        t.remove_range(txn, rng.randrange(n - 2), 2)
+                    else:
+                        t.insert(txn, rng.randrange(n + 1), f"w{step} ")
+        relay.apply_update_v1(doc.encode_state_as_update_v1(relay.state_vector()))
+        other = b if doc is a else a
+        other.apply_update_v1(doc.encode_state_as_update_v1(other.state_vector()))
+
+    oracle = Doc(client_id=0xBEEF, skip_gc=True)
+    sd = ShardedDoc(n_shards=8, capacity=2048, root_name="x")
+    for p in log:
+        sd.apply_update_v1(p)
+        oracle.apply_update_v1(p)
+    sd.flush()
+    assert sd.encode_state_as_update_v1() == oracle.encode_state_as_update_v1()
+
+
+def test_multi_root_byte_exact_8_shards():
+    """Round 5: secondary roots (a text root + a map root next to the
+    primary fragment) anchor through BLOCK_ROOT_ANCHOR rows and re-encode
+    byte-exactly."""
+    from ytpu.types import XmlElementPrelim
+
+    d = Doc(client_id=1, skip_gc=True)
+    log = capture(d)
+    frag = d.get_xml_fragment("x")
+    m = d.get_map("meta")
+    t = d.get_text("title")
+    with d.transact() as txn:
+        frag.insert(txn, 0, XmlElementPrelim("div", attributes={"id": "a"}))
+    with d.transact() as txn:
+        m.insert(txn, "version", 3)
+        t.insert(txn, 0, "hello")
+    with d.transact() as txn:
+        t.insert(txn, 5, " world")
+        m.insert(txn, "version", 4)
+    with d.transact() as txn:
+        t.remove_range(txn, 0, 3)
+
+    oracle = Doc(client_id=0xBEEF, skip_gc=True)
+    sd = ShardedDoc(n_shards=8, capacity=1024, root_name="x")
+    for p in log:
+        sd.apply_update_v1(p)
+        oracle.apply_update_v1(p)
+    sd.flush()
+    assert sd.encode_state_as_update_v1() == oracle.encode_state_as_update_v1()
+
+
+def test_moves_still_guarded():
+    """Move carriers stay out of the sharded scope with a clear error."""
+    d = Doc(client_id=1)
+    log = capture(d)
+    arr = d.get_array("a")
+    with d.transact() as txn:
+        arr.insert_range(txn, 0, [1, 2, 3])
+    with d.transact() as txn:
+        arr.move_to(txn, 0, 2)
+    sd = ShardedDoc(n_shards=4, capacity=256, root_name="a")
+    with pytest.raises(NotImplementedError):
+        for p in log:
+            sd.apply_update_v1(p)

@@ -34,16 +34,31 @@ Storage vs anchors: every row stores its TRUE origin/right-origin ids
 while anchoring on host-localized ids; the two coincide except at segment
 boundaries.
 
-Scope (round 4): root-sequence documents (YText / YArray shapes — string,
-Any, deleted and format runs) PLUS root map components: per-key LWW
-chains hold no sequence position, so each key's whole chain lives on
-shard ``key id % S`` (origins/right-origins of chain rows are shard-local
-by construction — no halo cases), integrated by the same YATA scan with
-the chain head as the no-left entry point and journaled for byte-exact
-encode parity (a host chain mirror records LWW tombstones /
-dead-on-arrival at their true order). Nested branches, moves and
-GC-range carriers still raise; sharded docs keep tombstones (the
-`skip_gc` regime of the reference, store.rs:139-151).
+Scope (round 5): root-sequence documents (YText / YArray shapes — string,
+Any, deleted and format runs) PLUS root map components, nested branches
+and secondary roots:
+
+- map components: per-(parent, key) LWW chains hold no sequence
+  position; a ROOT key's whole chain lives on shard ``key id % S``
+  (origins/right-origins of chain rows are shard-local by construction —
+  no halo cases), integrated by the same YATA scan with the chain head
+  as the no-left entry point and journaled for byte-exact encode parity
+  (a host chain mirror records LWW tombstones / dead-on-arrival at their
+  true order).
+- nested branches (XML trees, rich-text embeds of shared types): each
+  branch is shard-AFFINE with its backing ContentType row — the primary
+  root's direct children distribute across segments, each subtree lives
+  whole on its element's shard (its anchors are local by construction,
+  so no boundary cases; the parent row's `head` column tracks the child
+  sequence). Reference shape: types/xml.rs:237-1034.
+- secondary roots anchor through a BLOCK_ROOT_ANCHOR row on shard
+  ``root key % S`` and are likewise shard-affine.
+
+Moves and GC-range carriers still raise (moves need cross-segment range
+bookkeeping the sp engine does not model yet); sharded docs keep
+tombstones (the `skip_gc` regime of the reference, store.rs:139-151).
+`rebalance()` currently re-cuts the primary root only and refuses when
+branch-affine rows exist.
 """
 
 from __future__ import annotations
@@ -122,6 +137,10 @@ class SpStep(NamedTuple):
     content_ref: jax.Array
     content_off: jax.Array
     key: jax.Array  # interned parent_sub (-1 = sequence row)
+    pc: jax.Array  # parent: -1 = primary root; >= 0 = nested parent item
+    #                client (with pk its clock); <= -2 = secondary root,
+    #                encoded as -2 - root_key (anchor-row lookup by key)
+    pk: jax.Array
     valid: jax.Array  # bool
     del_client: jax.Array
     del_start: jax.Array
@@ -153,15 +172,19 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
         r_ref,
         r_off,
         r_key,
+        r_pc,
+        r_pk,
         r_valid,
     ) = row
     bl = state.blocks
     B = _capacity(bl)
+    from ytpu.core.content import BLOCK_ROOT_ANCHOR
 
     do = r_valid
+    is_anchor = do & (r_kind == BLOCK_ROOT_ANCHOR)
     has_origin = s_oc >= 0
     has_ror = s_rc >= 0
-    linkable = do
+    linkable = do & ~is_anchor
 
     # resolve local anchors (repair; parity: block.rs:1287-1300)
     probe_oc = jnp.where(linkable & (a_oc >= 0), a_oc, -2)
@@ -173,25 +196,54 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
     anchor_missing = (linkable & (a_oc >= 0) & (left_idx < 0)) | (
         linkable & (a_rc >= 0) & (right_idx < 0)
     )
-    missing = anchor_missing
-    linkable = linkable & ~anchor_missing
 
     safe = lambda idx: jnp.maximum(idx, 0)
+    slots_c = jnp.arange(B, dtype=I32)
+    # nested parents (pc >= 0: a ContentType row's id) and secondary
+    # roots (pc <= -2: a BLOCK_ROOT_ANCHOR row keyed -2 - pc) resolve to
+    # a parent SLOT; branches are whole-shard-resident by routing so the
+    # lookup is local (parity: store.py repair / block.rs:1287-1343)
+    has_parent = linkable & (r_pc != -1)
+    nested_mask = (
+        (slots_c < state.n_blocks)
+        & (bl.client == r_pc)
+        & (bl.clock <= r_pk)
+        & (r_pk < bl.clock + bl.length)
+    )
+    anchor_mask = (
+        (slots_c < state.n_blocks)
+        & (bl.kind == BLOCK_ROOT_ANCHOR)
+        & (bl.key == (-2 - r_pc))
+    )
+    pmask = jnp.where(r_pc >= 0, nested_mask, anchor_mask)
+    pslot = jnp.where(
+        has_parent & jnp.any(pmask), jnp.argmax(pmask).astype(I32), -1
+    )
+    parent_missing = has_parent & (pslot < 0)
+    missing = anchor_missing | parent_missing
+    linkable = linkable & ~anchor_missing & ~parent_missing
+
     # map rows (parent_sub keys) anchor on their key chain's leftmost item,
     # not the segment sequence (parity: block.rs:541-551); chains are
-    # whole-shard-resident by routing (key id % S), so the scan is local
-    is_map = r_key >= 0
-    slots_c = jnp.arange(B, dtype=I32)
+    # whole-shard-resident by routing, so the scan is local. Chains are
+    # per (parent, key): attribute chains on different elements share
+    # key ids but never parents.
+    is_map = (r_key >= 0) & ~is_anchor
     chain_mask = (
         (slots_c < state.n_blocks)
         & (bl.key == r_key)
         & (bl.left == -1)
+        & (bl.parent == jnp.where(pslot >= 0, pslot, -1))
+        & (bl.kind != BLOCK_ROOT_ANCHOR)
         & is_map
     )
     chain_head = jnp.where(
         jnp.any(chain_mask), jnp.argmax(chain_mask).astype(I32), -1
     )
-    anchor0 = jnp.where(is_map, chain_head, state.start)
+    parent_head = jnp.where(
+        pslot >= 0, bl.head[safe(pslot)], state.start
+    )
+    anchor0 = jnp.where(is_map, chain_head, parent_head)
 
     # --- conflict scan (parity: block.rs:537-602) ---
     right_left = jnp.where(right_idx >= 0, bl.left[safe(right_idx)], -1)
@@ -235,8 +287,13 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
     )
     w_left = jnp.where(has_left, left_idx, B)
     new_right_col = _set(bl.right, w_left, j)
-    # map rows never move the segment head (parity: block.rs:618-632)
-    new_start = jnp.where(linkable & ~has_left & ~is_map, j, state.start)
+    # map rows never move a head (parity: block.rs:618-632); headless
+    # sequence rows become the PRIMARY segment head (pslot < 0) or their
+    # parent branch's head (stored in the parent row's `head` column)
+    new_head = linkable & ~has_left & ~is_map
+    new_start = jnp.where(new_head & (pslot < 0), j, state.start)
+    w_phead = jnp.where(new_head & (pslot >= 0), pslot, B)
+    new_head_col = _set(bl.head, w_phead, j)
     w_right = jnp.where(linkable & (right_final >= 0), right_final, B)
     new_left_col = _set(bl.left, w_right, j)
 
@@ -244,9 +301,12 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
     # (parity: block.rs:751-765 "deleted on arrival")
     dead_on_arrival = linkable & is_map & (right_final >= 0)
     row_deleted = (r_kind == CONTENT_DELETED) | dead_on_arrival
-    # map rows are not sequence content: they never count toward visible
-    # positions (the sp prefix sums sum countable rows shard-wide)
-    row_countable = ~row_deleted & (r_kind != CONTENT_FORMAT) & ~is_map
+    # map rows are not sequence content, and nested rows count inside
+    # their branch, not the root prefix sums (visible_lengths filters on
+    # parent == -1); anchors are bookkeeping rows
+    row_countable = (
+        ~row_deleted & (r_kind != CONTENT_FORMAT) & ~is_map & ~is_anchor
+    )
 
     new_bl = BlockCols(
         client=_set(bl.client, wj, r_client),
@@ -263,9 +323,9 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
         kind=_set(bl.kind, wj, r_kind),
         content_ref=_set(bl.content_ref, wj, r_ref),
         content_off=_set(bl.content_off, wj, r_off),
-        key=_set(bl.key, wj, jnp.where(is_map, r_key, -1)),
-        parent=_set(bl.parent, wj, -1),
-        head=_set(bl.head, wj, -1),
+        key=_set(bl.key, wj, jnp.where(is_map | is_anchor, r_key, -1)),
+        parent=_set(bl.parent, wj, jnp.where(pslot >= 0, pslot, -1)),
+        head=_set(new_head_col, wj, -1),
         moved=_set(bl.moved, wj, -1),
         mv_sc=bl.mv_sc,
         mv_sk=bl.mv_sk,
@@ -317,6 +377,8 @@ def _apply_step_one_shard(
             step.content_ref[i],
             step.content_off[i],
             step.key[i],
+            step.pc[i],
+            step.pk[i],
             step.valid[i],
         )
         return jax.lax.cond(
@@ -370,7 +432,12 @@ def visible_lengths(state: DocStateBatch) -> jax.Array:
     bl = state.blocks
     B = _capacity(bl)
     slots = jnp.arange(B, dtype=I32)
-    live = (slots[None, :] < state.n_blocks[:, None]) & bl.countable & ~bl.deleted
+    live = (
+        (slots[None, :] < state.n_blocks[:, None])
+        & bl.countable
+        & ~bl.deleted
+        & (bl.parent == -1)  # nested rows count inside their branch only
+    )
     return jnp.sum(jnp.where(live, bl.length, 0), axis=-1)
 
 
@@ -455,12 +522,17 @@ class ShardedDoc:
         # host mirror of the per-key LWW chains (map components): chain
         # order + member facts, enough to journal LWW tombstones and
         # dead-on-arrival exactly (the device state stays authoritative)
-        self._chains: Dict[int, List[dict]] = {}
+        self._chains: Dict[tuple, List[dict]] = {}  # (parent_ref, key)
         # (client, clock_unit) -> key id for every unit of every chain
         # member: the wire omits parent_sub when an origin/right-origin is
         # present (block.rs:604-612), so map REPLACEMENT rows are
         # recognized by their anchors pointing into a chain
-        self._map_id_index: Dict[Tuple[int, int], int] = {}
+        self._map_id_index: Dict[Tuple[int, int], tuple] = {}
+        # (client, clock_unit) -> (pc, pk) parent encoding for every unit
+        # of nested-branch / secondary-root rows (parent inheritance when
+        # the wire omits the parent, block.rs:604-612)
+        self._parent_index: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        self._root_anchor_shard: Dict[int, int] = {}  # root key -> shard
         self._queue_rows: List[List[tuple]] = [[] for _ in range(n_shards)]
         self._queue_dels: List[List[tuple]] = [[] for _ in range(n_shards)]
         self._queued = 0
@@ -539,12 +611,13 @@ class ShardedDoc:
         self._queued = 0
 
         def dispatch(row_chunk, del_chunk):
-            rows = np.zeros((self.S, U, 15), dtype=np.int32)
+            rows = np.zeros((self.S, U, 17), dtype=np.int32)
             rows[:, :, 3] = -1  # s_oc
             rows[:, :, 5] = -1  # s_rc
             rows[:, :, 7] = -1  # a_oc
             rows[:, :, 9] = -1  # a_rc
             rows[:, :, 14] = -1  # key (sequence row)
+            rows[:, :, 15] = -1  # pc (primary root)
             valid = np.zeros((self.S, U), dtype=bool)
             dels = np.zeros((self.S, R, 3), dtype=np.int32)
             del_valid = np.zeros((self.S, R), dtype=bool)
@@ -571,6 +644,8 @@ class ShardedDoc:
                 content_ref=jnp.asarray(rows[:, :, 12]),
                 content_off=jnp.asarray(rows[:, :, 13]),
                 key=jnp.asarray(rows[:, :, 14]),
+                pc=jnp.asarray(rows[:, :, 15]),
+                pk=jnp.asarray(rows[:, :, 16]),
                 valid=jnp.asarray(valid),
                 del_client=jnp.asarray(dels[:, :, 0]),
                 del_start=jnp.asarray(dels[:, :, 1]),
@@ -626,6 +701,19 @@ class ShardedDoc:
         self.first_id[s] = fid
         return fid
 
+    def _parent_shard(self, parent_ref: Tuple[int, int]) -> int:
+        """Shard owning a parent encoding: a nested ContentType row's
+        directory interval, or a secondary root's anchor shard."""
+        pc, pk = parent_ref
+        if pc <= -2:
+            return self._root_anchor_shard[-2 - pc]
+        owner = self.dir.owner(pc, pk)
+        if owner is None:
+            raise RuntimeError(
+                f"parent {parent_ref} not in directory (routing bug)"
+            )
+        return owner
+
     def _first_nonempty(self) -> int:
         queued = [len(q) for q in self._queue_rows]
         for s in range(self.S):
@@ -666,30 +754,45 @@ class ShardedDoc:
         right-origin are then precisely the owner's tail — the same scan
         the reference would run. Anything else resolves on host
         (`_resolve_boundary`)."""
+        from ytpu.core.content import CONTENT_TYPE as K_TYPE
+        from ytpu.core.content import BLOCK_ROOT_ANCHOR
+
         enc = self.enc
         real_client = item.id.client
         local = self.sv.get(real_client)
         clock, length = item.id.clock, item.len
         if local >= clock + length:
             return  # full duplicate
+        parent_ref: Optional[Tuple[int, int]] = None
         if isinstance(item.parent, ID):
-            raise NotImplementedError(
-                "sharded docs: nested branches are routed to their parent's "
-                "shard in a future round (sequence + map components today)"
+            # nested branch: the whole branch is shard-affine with its
+            # backing ContentType row (r5; the primary ROOT still shards
+            # by segment — its direct children distribute, each subtree
+            # lives with its element)
+            parent_ref = (
+                enc.interner.intern(item.parent.client),
+                item.parent.clock,
             )
-        if isinstance(item.parent, str):
-            # adopt the doc's root name from the wire (the encode re-emits
-            # it for origin-less rows); a SECOND distinct root is out of
-            # the sharded scope — one ShardedDoc shards one root branch
+        elif isinstance(item.parent, str):
+            # adopt the doc's PRIMARY root from the wire; other roots are
+            # shard-affine through a BLOCK_ROOT_ANCHOR row (r5)
             if not self.enc._root_adopted:
                 self.enc.root_name = item.parent
                 self.enc._root_adopted = True
             elif item.parent != self.enc.root_name:
-                raise NotImplementedError(
-                    "sharded docs shard ONE root branch; shard each root "
-                    f"separately (saw {item.parent!r} after "
-                    f"{self.enc.root_name!r})"
-                )
+                root_key = enc.keys.intern(item.parent)
+                shard = self._root_anchor_shard.get(root_key)
+                if shard is None:
+                    shard = root_key % self.S
+                    self._root_anchor_shard[root_key] = shard
+                    self._enqueue_row(
+                        shard,
+                        self._make_row(
+                            -1, 0, 0, None, None, None, None,
+                            BLOCK_ROOT_ANCHOR, -1, 0, key=root_key,
+                        ),
+                    )
+                parent_ref = (-2 - root_key, 0)
         content = item.content
         offset = 0
         if local > clock:
@@ -701,11 +804,13 @@ class ShardedDoc:
             ref = enc.payloads.add(kind, list(content.items))
         elif kind == CONTENT_DELETED:
             ref = -1
-        elif kind == CONTENT_FORMAT:
+        elif kind in (CONTENT_FORMAT, K_TYPE):
             ref = enc.payloads.add(kind, content)
         else:
             raise NotImplementedError(
-                f"sharded docs support sequence/map content only (kind={kind})"
+                "sharded docs support sequence / map / nested-branch "
+                f"content only (kind={kind}; moves and GC carriers need "
+                "the unsharded engine)"
             )
         c = enc.interner.intern(real_client)
         if offset:
@@ -724,26 +829,40 @@ class ShardedDoc:
         else:
             s_r = None
 
-        key_id = None
+        # inherit the parent from resolved neighbors when the wire omits
+        # it (an origin/right-origin rode along — block.rs:604-612)
+        if parent_ref is None:
+            if s_o is not None and s_o in self._parent_index:
+                parent_ref = self._parent_index[s_o]
+            elif s_r is not None and s_r in self._parent_index:
+                parent_ref = self._parent_index[s_r]
+
+        chain_key = None
         if item.parent_sub is not None:
-            key_id = enc.keys.intern(item.parent_sub)
+            chain_key = (parent_ref, enc.keys.intern(item.parent_sub))
         elif s_o is not None and s_o in self._map_id_index:
-            key_id = self._map_id_index[s_o]  # map replacement (key omitted
-            # on the wire when an origin rides along, block.rs:604-612)
+            chain_key = self._map_id_index[s_o]  # map replacement (key
+            # omitted on the wire when an origin rides along)
+            parent_ref = chain_key[0]
         elif s_r is not None and s_r in self._map_id_index:
-            key_id = self._map_id_index[s_r]  # concurrent loser keyed by ror
-        if key_id is not None:
-            # map component: per-key LWW chain, no sequence position. ALL
-            # rows of a key live on shard (key id % S) — origin-ful writes
-            # route via the directory (the origin IS a chain row, already
-            # on that shard), so every anchor is shard-local by
-            # construction and no boundary/halo case exists.
+            chain_key = self._map_id_index[s_r]  # concurrent loser (ror)
+            parent_ref = chain_key[0]
+        if chain_key is not None:
+            key_id = chain_key[1]
+            # map component: per-(parent, key) LWW chain, no sequence
+            # position. A ROOT key's whole chain lives on shard
+            # (key id % S); a nested chain (element attributes) lives on
+            # its parent's shard — origin-ful writes route via the
+            # directory (the origin IS a chain row, already there), so
+            # every anchor is shard-local and no boundary case exists.
             if s_o is not None:
                 target = self.dir.owner(*s_o)
                 if target is None:
                     raise RuntimeError(
                         f"map origin {s_o} not in directory (routing bug)"
                     )
+            elif parent_ref is not None:
+                target = self._parent_shard(parent_ref)
             else:
                 target = key_id % self.S
             if s_r is not None:
@@ -753,11 +872,11 @@ class ShardedDoc:
                         "map right-origin off its key shard (routing bug)"
                     )
             born_dead, tombstoned = self._map_chain_insert(
-                key_id, c, clock, length, s_o, s_r
+                chain_key, c, clock, length, s_o, s_r
             )
             row = self._make_row(
                 c, clock, length, s_o, s_r, s_o, s_r, kind, ref, offset,
-                key=key_id,
+                key=key_id, parent=parent_ref or (-1, 0),
             )
             self._enqueue_row(target, row)
             # the LWW replacement is a delete in the oracle's commit (the
@@ -774,6 +893,35 @@ class ShardedDoc:
             )
             self.dir.add(c, clock, clock + length, target)
             self.sv.set_max(real_client, clock + length)
+            return
+
+        if parent_ref is not None:
+            # nested-branch / secondary-root sequence row: every anchor is
+            # shard-local by branch affinity — no boundary cases
+            if s_o is not None:
+                target = self.dir.owner(*s_o)
+                if target is None:
+                    raise RuntimeError(
+                        f"nested origin {s_o} not in directory (routing bug)"
+                    )
+            else:
+                target = self._parent_shard(parent_ref)
+            if s_r is not None:
+                r_owner = self.dir.owner(*s_r)
+                if r_owner is not None and r_owner != target:
+                    raise RuntimeError(
+                        "nested right-origin off its branch shard (routing bug)"
+                    )
+            row = self._make_row(
+                c, clock, length, s_o, s_r, s_o, s_r, kind, ref, offset,
+                parent=parent_ref,
+            )
+            self._enqueue_row(target, row)
+            self._journal_row(c, clock, length, s_o, s_r, kind)
+            self.dir.add(c, clock, clock + length, target)
+            self.sv.set_max(real_client, clock + length)
+            for u in range(length):
+                self._parent_index[(c, clock + u)] = parent_ref
             return
 
         if s_o is not None:
@@ -818,13 +966,13 @@ class ShardedDoc:
         self.dir.add(c, clock, clock + length, target)
         self.sv.set_max(real_client, clock + length)
 
-    def _map_chain_insert(self, key_id, c, clock, length, s_o, s_r):
+    def _map_chain_insert(self, chain_key, c, clock, length, s_o, s_r):
         """Host mirror of the device key-chain YATA (block.rs:537-659 over
         one short chain): inserts the member, returns ``(born_dead,
         tombstoned_member_or_None)``. The device state stays authoritative;
         this mirror exists so the journal can record LWW tombstones and
         dead-on-arrival facts exactly when they happen."""
-        chain = self._chains.setdefault(key_id, [])
+        chain = self._chains.setdefault(chain_key, [])
         from_idx = self.enc.interner.from_idx
 
         def covering(iid):
@@ -877,11 +1025,14 @@ class ShardedDoc:
             },
         )
         for u in range(length):
-            self._map_id_index[(c, clock + u)] = key_id
+            self._map_id_index[(c, clock + u)] = chain_key
         return born_dead, tombstoned
 
     @staticmethod
-    def _make_row(c, clock, length, s_o, s_r, a_o, a_r, kind, ref, off, key=-1):
+    def _make_row(
+        c, clock, length, s_o, s_r, a_o, a_r, kind, ref, off, key=-1,
+        parent=(-1, 0),
+    ):
         return (
             c,
             clock,
@@ -898,6 +1049,8 @@ class ShardedDoc:
             ref,
             off,
             key,
+            parent[0],
+            parent[1],
         )
 
     # ---------------------------------------------- boundary (halo) resolve
@@ -919,12 +1072,18 @@ class ShardedDoc:
     def _chain_rows(self, st) -> List[List[Tuple[int, int]]]:
         """Map key chains as (shard, slot) runs in chain order — separate
         adjacency runs from the sequence (map rows hold no doc position)."""
+        from ytpu.core.content import BLOCK_ROOT_ANCHOR
+
         bl = st.blocks
         runs: List[List[Tuple[int, int]]] = []
         for s in range(self.S):
             n = int(st.n_blocks[s])
             for h in range(n):
-                if int(bl.key[s, h]) < 0 or int(bl.left[s, h]) >= 0:
+                if (
+                    int(bl.key[s, h]) < 0
+                    or int(bl.left[s, h]) >= 0
+                    or int(bl.kind[s, h]) == BLOCK_ROOT_ANCHOR
+                ):
                     continue
                 run, cur, guard = [], h, 0
                 while cur >= 0:
@@ -933,6 +1092,28 @@ class ShardedDoc:
                     guard += 1
                     if guard > n + 1:
                         raise RuntimeError("cycle in map chain")
+                runs.append(run)
+        return runs
+
+    def _branch_rows(self, st) -> List[List[Tuple[int, int]]]:
+        """Nested-branch / secondary-root sequences as (shard, slot) runs:
+        one run per non-empty `head` chain (ContentType rows and
+        BLOCK_ROOT_ANCHOR rows carry their child sequence's head)."""
+        bl = st.blocks
+        runs: List[List[Tuple[int, int]]] = []
+        for s in range(self.S):
+            n = int(st.n_blocks[s])
+            for p in range(n):
+                h = int(bl.head[s, p])
+                if h < 0:
+                    continue
+                run, cur, guard = [], h, 0
+                while cur >= 0:
+                    run.append((s, cur))
+                    cur = int(bl.right[s, cur])
+                    guard += 1
+                    if guard > n + 1:
+                        raise RuntimeError("cycle in branch sequence")
                 runs.append(run)
         return runs
 
@@ -1209,8 +1390,8 @@ class ShardedDoc:
         out: dict = {}
         for run in self._chain_rows(st):
             s, r = run[-1]  # chain tail = the key's live value
-            if bool(bl.deleted[s, r]):
-                continue
+            if bool(bl.deleted[s, r]) or int(bl.parent[s, r]) >= 0:
+                continue  # nested chains (element attrs) are not root keys
             name = self.enc.keys.names.get(int(bl.key[s, r]))
             kind = int(bl.kind[s, r])
             if name is None or kind != CONTENT_ANY:
@@ -1239,26 +1420,40 @@ class ShardedDoc:
         ref = int(bl.content_ref[s, r])
         off = int(bl.content_off[s, r])
         length = int(bl.length[s, r])
+        from ytpu.core.content import BLOCK_ROOT_ANCHOR
+        from ytpu.core.content import CONTENT_TYPE as K_TYPE
+
         if kind == CONTENT_STRING:
             content = ContentString(enc.payloads.slice_text(ref, off, length))
         elif kind == CONTENT_ANY:
             content = ContentAny(enc.payloads.slice_values(ref, off, length))
         elif kind == CONTENT_DELETED:
             content = ContentDeleted(length)
-        elif kind == CONTENT_FORMAT:
-            stored: ContentFormat = enc.payloads.items[ref][1]
-            content = stored
+        elif kind in (CONTENT_FORMAT, K_TYPE):
+            content = enc.payloads.items[ref][1]  # stored content object
         else:  # pragma: no cover - scope-guarded at routing
             raise NotImplementedError(f"kind {kind}")
         key = int(bl.key[s, r])
         sub = enc.keys.names.get(key) if key >= 0 else None
+        parent = None
+        if origin is None and ror is None:
+            pcol = int(bl.parent[s, r])
+            if pcol < 0:
+                parent = self.enc.root_name
+            elif int(bl.kind[s, pcol]) == BLOCK_ROOT_ANCHOR:
+                parent = enc.keys.names[int(bl.key[s, pcol])]
+            else:
+                parent = ID(
+                    enc.interner.from_idx[int(bl.client[s, pcol])],
+                    int(bl.clock[s, pcol]),
+                )
         item = Item(
             ID(real, int(bl.clock[s, r])),
             None,
             origin,
             None,
             ror,
-            self.enc.root_name if origin is None and ror is None else None,
+            parent,
             sub,
             content,
         )
@@ -1390,7 +1585,11 @@ class ShardedDoc:
         st = self._pull()
         # adjacency RUNS: the doc-order sequence plus each map key chain —
         # squash adjacency (a.right is b) never crosses a run boundary
-        runs = [self._global_rows(st)] + self._chain_rows(st)
+        runs = (
+            [self._global_rows(st)]
+            + self._branch_rows(st)
+            + self._chain_rows(st)
+        )
         succ: Dict[Tuple[int, int], Tuple[int, int]] = {}
         for run in runs:
             for gi in range(len(run) - 1):
@@ -1459,6 +1658,15 @@ class ShardedDoc:
         encode time, so wire parity is preserved. Anchors that later
         straddle the new boundaries either hit the exact-first-id fast
         path or the host resolver."""
+        if self._parent_index or self._root_anchor_shard:
+            # nested branches / secondary roots are shard-AFFINE (not
+            # segment-cut); re-cutting would strand children from their
+            # parent row. Rebalance currently re-cuts the primary root
+            # only, so refuse when affine rows exist.
+            raise NotImplementedError(
+                "rebalance with nested branches / secondary roots: "
+                "branch-affine rows must move with their parent"
+            )
         self.flush()
         st = self._pull()
         order = self._global_rows(st)
