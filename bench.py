@@ -452,11 +452,12 @@ def _device_phase_child(in_path: str, out_path: str) -> None:
 
     # CPU runs only: the LLVM JIT's memory allocator exhausts after many
     # large compiles in one process ("Cannot allocate memory" then
-    # SIGSEGV — the same failure the test suite's clear_caches fixture
-    # works around); dropping caches between phases keeps a CPU capture
-    # alive. TPU compiles go through the backend/remote helper, so this
-    # is a no-op risk there (and compile caching still applies within a
-    # phase, where the reuse actually is).
+    # SIGSEGV). The library bounds its own live program set in-band now
+    # (ytpu/utils/progbudget — r5 replaced the suite's conftest fixture),
+    # but the bench intentionally sweeps FAR more distinct large shapes
+    # per phase than any server would hold, so a wholesale drop between
+    # phases stays as capture armor. TPU compiles don't ride the LLVM
+    # arena; this is a no-op risk there.
     def phase_gc():
         if devs[0].platform == "cpu":
             jax.clear_caches()

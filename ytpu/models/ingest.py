@@ -216,6 +216,9 @@ class BatchIngestor:
         ]
         if len(updates) != self.n_docs:
             raise ValueError(f"expected {self.n_docs} payload slots")
+        from ytpu.utils.progbudget import tick
+
+        tick()
         all_rows, all_dels = [], []
         for d, u in enumerate(updates):
             rows, dels = self._plan_doc(d, u)

@@ -1484,3 +1484,56 @@ def decode_updates_v2(
         rows, dels, flags, client_table, key_table, client_hash_table,
         primary_root_hash,
     )
+
+
+# --- bounded resident-program wrapper (VERDICT r4 #7) -----------------------
+# Same policy as the V1 lane: the columnar decode compiles as ONE
+# per-function-evictable program under the progbudget registry.
+
+_decode_updates_v2_impl = decode_updates_v2
+_decode_updates_v2_jit = partial(
+    jax.jit, static_argnames=("max_rows", "max_dels", "max_sections")
+)(_decode_updates_v2_impl)
+
+
+def decode_updates_v2(
+    buf,
+    lens,
+    spans,
+    max_rows,
+    max_dels,
+    max_sections=None,
+    client_table=None,
+    key_table=None,
+    client_hash_table=None,
+    primary_root_hash=None,
+    sidecar=None,
+):
+    from ytpu.utils.progbudget import tick
+
+    tick()
+    return _decode_updates_v2_jit(
+        jnp.asarray(buf),
+        jnp.asarray(lens),
+        jnp.asarray(spans),
+        max_rows=max_rows,
+        max_dels=max_dels,
+        max_sections=max_sections,
+        client_table=client_table,
+        key_table=key_table,
+        client_hash_table=client_hash_table,
+        primary_root_hash=primary_root_hash,
+        sidecar=None if sidecar is None else jnp.asarray(sidecar),
+    )
+
+
+decode_updates_v2.__doc__ = _decode_updates_v2_impl.__doc__
+
+
+def _register_programs():
+    from ytpu.utils import progbudget
+
+    progbudget.register("decode_updates_v2", _decode_updates_v2_jit)
+
+
+_register_programs()

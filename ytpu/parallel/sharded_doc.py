@@ -583,6 +583,9 @@ class ShardedDoc:
         """
         if self._queued == 0:
             return
+        from ytpu.utils.progbudget import tick
+
+        tick()
         U = self.max_rows_per_step
         R = self.max_rows_per_step
         # pre-grow: every row can cost up to 3 slots (itself + two anchor
@@ -1815,3 +1818,13 @@ class ShardedDoc:
         sd.apply_update_v1(doc.encode_state_as_update_v1())
         sd.rebalance()
         return sd
+
+
+def _register_programs():
+    from ytpu.utils import progbudget
+
+    progbudget.register("apply_step_sharded", apply_step_sharded)
+    progbudget.register("sp_visible_lengths", visible_lengths)
+
+
+_register_programs()
