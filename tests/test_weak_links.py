@@ -82,3 +82,138 @@ def test_weak_link_observer_fires_on_target_change():
     with d.transact() as txn:
         data.insert(txn, "k", "v1")
     assert fired, "link observer should fire when the target entry changes"
+
+
+def test_quote_spans_moved_range():
+    """Quotation follows DOCUMENT order (reference weak.rs:638
+    `RangeIter<MoveIter>`): elements moved into the quoted span appear,
+    elements moved out vanish."""
+    d = Doc(client_id=1)
+    arr = d.get_array("a")
+    m = d.get_map("m")
+    with d.transact() as txn:
+        arr.insert_range(txn, 0, [10, 20, 30, 40, 50])
+    with d.transact() as txn:
+        m.insert(txn, "q", quote_range(arr, txn, 1, 3))  # [20, 30, 40]
+    # move 50 INTO the quoted span (before 30)
+    with d.transact() as txn:
+        arr.move_to(txn, 4, 2)
+    assert arr.to_json() == [10, 20, 50, 30, 40]
+    assert m.get("q").unquote() == [20, 50, 30, 40]
+    # move 30 OUT of the span (to the front)
+    with d.transact() as txn:
+        arr.move_to(txn, 3, 0)
+    assert arr.to_json() == [30, 10, 20, 50, 40]
+    assert m.get("q").unquote() == [20, 50, 40]
+
+
+def test_quote_moved_range_survives_sync():
+    """The move-aware quotation renders identically on a synced replica."""
+    a, b = Doc(client_id=1), Doc(client_id=2)
+    arr = a.get_array("a")
+    m = a.get_map("m")
+    with a.transact() as txn:
+        arr.insert_range(txn, 0, [1, 2, 3, 4])
+    with a.transact() as txn:
+        m.insert(txn, "q", quote_range(arr, txn, 0, 2))  # [1, 2]
+    with a.transact() as txn:
+        arr.move_to(txn, 3, 1)  # 4 moves inside: [1, 4, 2, 3]
+    b.apply_update_v1(a.encode_state_as_update_v1())
+    assert b.get_array("a").to_json() == [1, 4, 2, 3]
+    assert a.get_map("m").get("q").unquote() == [1, 4, 2]
+    assert b.get_map("m").get("q").unquote() == [1, 4, 2]
+
+
+def test_deleting_link_unlinks_targets():
+    """Deleting the weak link removes its back-references: later edits to
+    the old target no longer notify the (dead) link (weak.rs:509)."""
+    d = Doc(client_id=1)
+    arr = d.get_array("a")
+    m = d.get_map("m")
+    with d.transact() as txn:
+        arr.insert_range(txn, 0, ["a", "b", "c"])
+    with d.transact() as txn:
+        m.insert(txn, "q", quote_range(arr, txn, 0, 3))
+    store = d.store
+    assert any(store.linked_by.values())
+    with d.transact() as txn:
+        m.remove(txn, "q")
+    assert not store.linked_by  # back-refs gone
+    # edits to the former targets neither crash nor resurrect the link
+    with d.transact() as txn:
+        arr.insert(txn, 1, "x")
+    assert arr.to_json() == ["a", "x", "b", "c"]
+
+
+def test_deep_observation_through_link():
+    """Changes to quoted content surface through the link: deletions of
+    linked items notify the link's observers (transaction.rs:634-647),
+    and in-range inserts appear in the next unquote (the range is
+    bounded by sticky ids, not a snapshot)."""
+    d = Doc(client_id=1)
+    txt = d.get_text("t")
+    m = d.get_map("m")
+    with d.transact() as txn:
+        txt.insert(txn, 0, "hello world")
+    with d.transact() as txn:
+        m.insert(txn, "q", quote_range(txt, txn, 0, 5))  # "hello"
+    ref = m.get("q")
+    # in-range insert: content flows into the quotation
+    with d.transact() as txn:
+        txt.insert(txn, 2, "XY")
+    assert "".join(ref.unquote()) == "heXYllo"
+    # deleting linked content notifies the link branch
+    fired = []
+    d.observe_after_transaction(lambda txn: fired.append(
+        any(b is ref.branch for b in txn.changed)
+    ))
+    with d.transact() as txn:
+        txt.remove_range(txn, 0, 2)  # inside the quoted range
+    assert fired and fired[-1], "link not notified of in-range delete"
+    # an edit far outside the range must NOT notify the link
+    fired.clear()
+    with d.transact() as txn:
+        txt.insert(txn, len(txt), "!")
+    assert fired and not fired[-1]
+
+
+def test_quote_roundtrip_v1_v2():
+    """Weak links survive both wire formats byte-compatibly."""
+    from ytpu.core import Update
+
+    d = Doc(client_id=1)
+    arr = d.get_array("a")
+    m = d.get_map("m")
+    with d.transact() as txn:
+        arr.insert_range(txn, 0, [7, 8, 9])
+    with d.transact() as txn:
+        m.insert(txn, "q", quote_range(arr, txn, 1, 2))
+    v1 = d.encode_state_as_update_v1()
+    for payload, fmt in ((v1, "v1"), (Update.decode_v1(v1).encode_v2(), "v2")):
+        fresh = Doc(client_id=9)
+        if fmt == "v1":
+            fresh.apply_update_v1(payload)
+        else:
+            fresh.apply_update_v2(payload)
+        assert fresh.get_map("m").get("q").unquote() == [8, 9], fmt
+
+
+def test_overlapping_quotes_share_targets():
+    """Two links quoting overlapping ranges both track edits; deleting
+    one leaves the other's back-refs intact."""
+    d = Doc(client_id=1)
+    arr = d.get_array("a")
+    m = d.get_map("m")
+    with d.transact() as txn:
+        arr.insert_range(txn, 0, [1, 2, 3, 4, 5])
+    with d.transact() as txn:
+        m.insert(txn, "q1", quote_range(arr, txn, 0, 3))  # [1,2,3]
+        m.insert(txn, "q2", quote_range(arr, txn, 2, 3))  # [3,4,5]
+    assert m.get("q1").unquote() == [1, 2, 3]
+    assert m.get("q2").unquote() == [3, 4, 5]
+    with d.transact() as txn:
+        m.remove(txn, "q1")
+    assert m.get("q2").unquote() == [3, 4, 5]
+    with d.transact() as txn:
+        arr.remove_range(txn, 3, 1)  # delete 4 (inside q2)
+    assert m.get("q2").unquote() == [3, 5]

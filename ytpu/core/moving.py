@@ -53,15 +53,19 @@ class StickyIndex:
             if index == 0:
                 return cls._from_branch(branch, assoc)
             index -= 1
-        item = branch.start
-        while item is not None:
+        # the walk is MOVE-AWARE: `index` is a VISIBLE position, and after
+        # a move the raw link order no longer matches document order
+        # (parity: moving.rs:809 via the move-aware block iterator — a
+        # raw walk would anchor a second move on the wrong element)
+        from ytpu.types.shared import visible_items
+
+        for item in visible_items(branch):
             if not item.deleted and item.countable:
                 if item.len > index:
                     return cls(
                         id_=ID(item.id.client, item.id.clock + index), assoc=assoc
                     )
                 index -= item.len
-            item = item.right
         return cls._from_branch(branch, assoc)
 
     @classmethod

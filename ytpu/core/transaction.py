@@ -198,6 +198,12 @@ class Transaction:
                 branch = content.branch
                 self.store.deregister(branch)
                 self.changed.pop(branch, None)
+                if branch.link_source is not None:
+                    # deleting a weak link unlinks its quoted items
+                    # (parity: weak.rs:509-517 LinkSource::unlink)
+                    from ytpu.types.weak import unlink_all
+
+                    unlink_all(self.store, branch)
                 node = branch.start
                 while node is not None:
                     if not node.deleted:

@@ -27,6 +27,7 @@ from .shared import (
     TextPrelim,
     XmlElementPrelim,
     XmlFragmentPrelim,
+    XmlHookPrelim,
     XmlTextPrelim,
 )
 from .text import Diff, Text
@@ -50,6 +51,7 @@ __all__ = [
     "MapPrelim",
     "XmlElementPrelim",
     "XmlFragmentPrelim",
+    "XmlHookPrelim",
     "XmlTextPrelim",
     "WeakRef",
     "WeakPrelim",

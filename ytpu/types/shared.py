@@ -21,6 +21,7 @@ from ytpu.core.branch import (
     TYPE_TEXT,
     TYPE_XML_ELEMENT,
     TYPE_XML_FRAGMENT,
+    TYPE_XML_HOOK,
     TYPE_XML_TEXT,
 )
 from ytpu.core.content import (
@@ -168,6 +169,27 @@ class XmlFragmentPrelim(Prelim):
             from .xml import XmlFragment
 
             XmlFragment(branch).insert_range(txn, 0, self.children)
+
+
+class XmlHookPrelim(Prelim):
+    """Opaque hook node keyed by name (parity: xml.rs XmlHook; ywasm
+    YXmlHook) — attributes behave like a map on the hook branch."""
+
+    type_ref = TYPE_XML_HOOK
+
+    def __init__(self, name: str, attributes: Optional[dict] = None):
+        self.name = name
+        self.attributes = dict(attributes) if attributes else {}
+
+    def make_branch(self) -> Branch:
+        return Branch(self.type_ref, type_name=self.name)
+
+    def fill(self, txn: Transaction, branch: Branch) -> None:
+        from .xml import XmlHook
+
+        hook = XmlHook(branch)
+        for key, value in self.attributes.items():
+            hook.insert_attribute(txn, key, value)
 
 
 class XmlElementPrelim(Prelim):

@@ -44,7 +44,10 @@ def materialize_link(store, branch: Branch) -> None:
         last.linked = True
         store.linked_by.setdefault(last, set()).add(branch)
         return
-    # sequence range: mark every item between start and end ids
+    # sequence range: mark every item between start and end ids. The walk
+    # is MOVE-AWARE (parity: weak.rs:581 `.moved().within_range(..)`) — a
+    # quoted range follows document order, so items moved into the range
+    # are linked and items moved out are not.
     end_id = src.quote_end.id
     item = store.blocks.get_item_clean_start(src.quote_start.id)
     if item is None:
@@ -52,15 +55,55 @@ def materialize_link(store, branch: Branch) -> None:
     if end_id is not None:
         store.blocks.get_item_clean_end(end_id)  # align the boundary
     src.first_item = item
-    while item is not None:
-        item.linked = True
-        store.linked_by.setdefault(item, set()).add(branch)
-        # stop only at the block containing the end id — a clock
-        # comparison fires early on out-of-order blocks (same fix as
-        # unquote; a prepend carries a HIGHER clock than the quote end)
-        if end_id is not None and item.contains(end_id):
-            break
-        item = item.right
+    for it in _range_items(store, item, src.quote_start.id, end_id):
+        it.linked = True
+        store.linked_by.setdefault(it, set()).add(branch)
+
+
+def _range_items(store, start_item, start_id: ID, end_id: Optional[ID]):
+    """Items of the quoted range in move-aware document order.
+
+    Mirrors the reference's `Unquote` iterator (weak.rs:638-700:
+    `Values<RangeIter<MoveIter>>`): the parent sequence is walked with
+    move semantics (`visible_items`), the range opening at the item
+    containing the start id and closing after the one containing the end
+    id. Tombstoned items inside the range are yielded too — callers
+    filter (`materialize` links them; `unquote` skips their values)."""
+    from .shared import visible_items
+
+    parent = start_item.parent
+    if not isinstance(parent, Branch):
+        return
+    inside = False
+    for it in visible_items(parent):
+        if not inside and start_id is not None and it.contains(start_id):
+            inside = True
+        if inside:
+            yield it
+            if end_id is not None and it.contains(end_id):
+                return
+    # anchors vanished from the walk (e.g. the whole range was moved and
+    # the bounds now invert): nothing further to yield
+
+
+def unlink_all(store, branch: Branch) -> None:
+    """Remove this link's back-references from every quoted item.
+
+    Parity: weak.rs:509-517 (`LinkSource::unlink`) — deleting the weak
+    link must stop target edits from notifying its (dead) observers."""
+    src = branch.link_source
+    if src is None:
+        return
+    stale = [
+        item for item, links in store.linked_by.items() if branch in links
+    ]
+    for item in stale:
+        links = store.linked_by[item]
+        links.discard(branch)
+        if not links:
+            del store.linked_by[item]
+            item.linked = False
+    src.first_item = None
 
 
 class WeakPrelim(Prelim):
@@ -89,7 +132,11 @@ class WeakRef(SharedType):
         return self.branch.link_source
 
     def unquote(self) -> List[PyAny]:
-        """Visible values inside the quoted range (parity: weak.rs:303-372)."""
+        """Visible values inside the quoted range (parity: weak.rs:303-372).
+
+        The walk is move-aware (weak.rs:638: `RangeIter<MoveIter>`):
+        elements moved INTO the quoted span appear, elements moved out
+        don't — quotation follows document order, not insertion order."""
         store = self.branch.store
         src = self.source
         if store is None or src is None or src.quote_start.id is None:
@@ -99,16 +146,10 @@ class WeakRef(SharedType):
             return []
         end_id = src.quote_end.id
         out: List[PyAny] = []
-        while item is not None:
-            if not item.deleted and item.countable:
-                for i in range(item.len):
-                    out.append(out_value(item, i))
-            # stop only at the block actually containing the end id — a
-            # clock comparison would fire early on out-of-order blocks
-            # (a prepend carries a HIGHER clock than the quote end)
-            if end_id is not None and item.contains(end_id):
-                break
-            item = item.right
+        for it in _range_items(store, item, src.quote_start.id, end_id):
+            if not it.deleted and it.countable:
+                for i in range(it.len):
+                    out.append(out_value(it, i))
         return out
 
     def try_deref(self) -> Optional[PyAny]:

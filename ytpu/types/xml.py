@@ -67,8 +67,11 @@ class _XmlChildren:
     def __len__(self) -> int:
         return self.branch.content_len
 
-    def insert(self, txn: Transaction, index: int, value) -> None:
+    def insert(self, txn: Transaction, index: int, value):
+        """Insert a node; returns the integrated child (parity: xml.rs
+        XmlFragment::insert returning the node ref)."""
         Array(self.branch).insert(txn, index, value)
+        return self.get(index)
 
     def insert_range(self, txn: Transaction, index: int, values: List[PyAny]) -> None:
         Array(self.branch).insert_range(txn, index, values)
