@@ -164,20 +164,28 @@ def host_replay(log):
     return dt, doc.get_text("text").get_string()
 
 
-def native_replay(log):
+def native_replay(log, trials: int = 3):
     """C++ single-doc replay (`ytpu/native/engine.cpp`, scalar YATA) — the
     native-speed baseline the ≥50x target is defined against (the Python
     oracle alone overstates the device ratio). Returns None when the
-    native library isn't built or the stream needs host-only features."""
+    native library isn't built or the stream needs host-only features.
+
+    Best-of-N: the r4 capture read 18% below r3's on the same code —
+    box contention (the driver, the watcher, and the suite time-share
+    1 vCPU) skews single-shot CPU timings; the fastest of three replays
+    is the least-contended estimate of the engine's true rate."""
     try:
         from ytpu.native import engine_available, native_replay_v1
 
         if not engine_available():
             return None
-        t0 = time.perf_counter()
-        text = native_replay_v1(log)
-        dt = time.perf_counter() - t0
-        return dt, text
+        best, text = None, None
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            text = native_replay_v1(log)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best, text
     except Exception:
         # never let the optional baseline break the measurement contract
         return None
