@@ -30,14 +30,42 @@ def log(msg: str) -> None:
         f.write(line + "\n")
 
 
+def probe() -> bool:
+    """Cheap tunnel-health probe: one tiny device op under the axon
+    platform, 120s cap. A full bench attempt costs ~25 min of this
+    1-vCPU box even when the tunnel is down (host-fallback phases run
+    regardless) — probing first keeps the box free for the builder."""
+    try:
+        res = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax, jax.numpy as jnp;"
+                "jnp.ones((8, 8)).sum().block_until_ready();"
+                "print(jax.devices()[0].platform)",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            cwd=HERE,
+        )
+        return "tpu" in res.stdout.lower() or "axon" in res.stdout.lower()
+    except Exception:
+        return False
+
+
 def main() -> int:
     attempt = 0
     while True:
         attempt += 1
+        if not probe():
+            log(f"attempt {attempt}: probe says tunnel down; sleeping")
+            time.sleep(120)
+            continue
         env = dict(os.environ)
         env["YTPU_BENCH_FUSED"] = "0"  # crash-safe lanes only
         env.setdefault("YTPU_BENCH_DEVICE_TIMEOUT", "2400")
-        log(f"attempt {attempt}: running bench.py (fused disabled)")
+        log(f"attempt {attempt}: probe HEALTHY - running bench.py (fused disabled)")
         t0 = time.time()
         try:
             res = subprocess.run(
