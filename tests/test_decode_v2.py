@@ -525,15 +525,52 @@ def test_widened_content_kinds_ride_device_lane():
     assert tree["map"] == {"x": 43}, tree["map"]
 
 
-def test_deep_any_values_fall_back_to_host():
-    """Any values nested beyond depth 1 (an object holding a list) exceed
-    the walker's scope and must flag the lane — never decode wrong."""
+def test_nested_any_values_ride_device_lane():
+    """Round 5: the rest walker's container STACK device-decodes Any
+    values with maps nested to W_DEPTH - 1 = 3 levels and arrays nested
+    arbitrarily (r4 flagged anything past depth 1)."""
+    from ytpu.ops.decode_kernel import RawPayloadView
+
+    deep_vals = [
+        {"deep": [1, 2, 3]},                       # map -> array
+        {"a": {"b": 7}, "c": [4, [5, 6]]},         # map -> map / arr -> arr
+        [{"x": [1, {"y": 2}]}, 9],                 # arr -> map -> arr -> map
+        "plain",
+    ]
     d = Doc(client_id=5)
     log = []
     d.observe_update_v1(lambda p, o, t: log.append(p))
     arr = d.get_array("a")
     with d.transact() as txn:
-        arr.insert_range(txn, 0, [{"deep": [1, 2, 3]}])
+        arr.insert_range(txn, 0, deep_vals)
+    with d.transact() as txn:
+        arr.insert(txn, 2, {"tail": {"k": [10]}})
+    v2 = [v1_to_v2(p) for p in log]
+    buf, lens, spans, side = pack_updates_v2(v2, pad_to=256)
+    stream, flags = decode_updates_v2(buf, lens, spans, 8, 4, sidecar=side)
+    f = np.asarray(flags)
+    assert (f & FLAG_ERRORS == 0).all(), f"host fallbacks: {f}"
+    view = RawPayloadView(np.asarray(buf), v2_any=True)
+    valid = np.asarray(stream.valid)
+    refs = np.asarray(stream.content_ref)
+    lengths = np.asarray(stream.length)
+    got = []
+    for s in range(len(v2)):
+        for u in range(valid.shape[1]):
+            if valid[s, u] and refs[s, u] >= 0:
+                got.extend(view.slice_values(refs[s, u], 0, int(lengths[s, u])))
+    assert got == deep_vals + [{"tail": {"k": [10]}}], got
+
+
+def test_too_deep_any_values_fall_back_to_host():
+    """Maps nested beyond the walker's W_DEPTH - 1 = 3 levels exceed the
+    stacked scope and must flag the lane — never decode wrong."""
+    d = Doc(client_id=5)
+    log = []
+    d.observe_update_v1(lambda p, o, t: log.append(p))
+    arr = d.get_array("a")
+    with d.transact() as txn:
+        arr.insert_range(txn, 0, [{"a": {"b": {"c": {"d": 1}}}}])
     v2 = [v1_to_v2(p) for p in log]
     buf, lens, spans, side = pack_updates_v2(v2, pad_to=128)
     stream, flags = decode_updates_v2(buf, lens, spans, 4, 4, sidecar=side)

@@ -32,7 +32,8 @@ Root, ID, and nested parents, parent_sub map keys (hashed through the
 same `key_table` as the V1 lane), multi client sections, and the delete
 set all decode on device. Still host-routed (FLAG_UNSUPPORTED): Doc
 content (subdoc lifecycle is host-level on both lanes), weak/unknown
-type-ref tags, and Any values nested beyond the walker's depth-1 scope.
+type-ref tags, and Any maps nested beyond the walker's stacked scope
+(W_DEPTH - 1 = 3 map levels; arrays nest arbitrarily).
 Client ids beyond i32 resolve through the SAME
 `client_hash_table` as the V1 lane: V2 client columns use *signed*
 varints, so the expander reconstructs each big id's unsigned-varint byte
@@ -518,6 +519,11 @@ def _cumsum_excl(x):
     return jnp.cumsum(x, axis=1) - x
 
 
+# rest-walker container-nesting stack depth: supports maps nested up to
+# W_DEPTH - 1 levels (arrays nest arbitrarily at any level — they spend
+# the level's own elems counter); deeper wire flags `deep` → host lane
+W_DEPTH = 4
+
 # rest-walker FSM states
 (
     W_NC,
@@ -555,9 +561,10 @@ def _rest_walker(
     downstream slot arithmetic is shared — while content regions are
     excised, their byte spans recorded per block (`c_start`), and Move
     payload fields parsed inline (they are plain varints). Any values
-    step one VALUE per iteration with the V1 machine's depth-1 scope
-    (arrays spawn element steps, objects key/value steps; deeper nesting
-    sets `deep`, routing the lane to the host). Client-id-sized move
+    step one token per iteration over a W_DEPTH-register container stack
+    (arrays spend their level's elems counter, each open map tracks its
+    pending pairs; maps nested beyond W_DEPTH - 1 levels set `deep`,
+    routing the lane to the host). Client-id-sized move
     fields beyond i32 hash to ``-2 - client_hash`` exactly like `vat_id`.
 
     Returns dict(vv, vstart, vovf [S, NV], n_varints [S], c_start, mvf,
@@ -601,7 +608,18 @@ def _rest_walker(
 
     def step(_, carry):
         regs, out = carry
-        pos, st, vidx, blk, blocks_left, nc_left, elems, pairs, collapsed = regs
+        (
+            pos,
+            st,
+            vidx,
+            blk,
+            blocks_left,
+            nc_left,
+            elems,
+            pairs,
+            depth,
+            collapsed,
+        ) = regs
         active = (st != W_DONE) & (pos <= end)
         w = _window(b, pos, end, 10)
         val, nb, ovf = _uvar_from(w)
@@ -654,18 +672,84 @@ def _rest_walker(
                 ),
             ),
         )
-        deep_bad = (in_any & (tag < 116)) | (
-            in_mval & ((tag == 117) | (tag == 118) | (tag < 116))
+        # Depth-stacked container bookkeeping (r5; the r4 machine was
+        # depth-1 — nested containers inside map values flagged `deep`).
+        # At depth 0, `elems[:, 0]` counts pending top-level values; each
+        # open map at depth d >= 1 tracks `pairs[:, d]` pending pairs and
+        # `elems[:, d]` pending array-child value tokens of the CURRENT
+        # pair's value. A push past W_DEPTH-1 (3 nested map levels) still
+        # flags `deep` — bounded registers, unbounded wire.
+        iota_s = jnp.arange(S)
+        in_anyval = in_any | in_mval
+
+        def sget(a, d):
+            return a[iota_s, jnp.clip(d, 0, W_DEPTH - 1)]
+
+        def sset(a, d, v, mask):
+            dd_ = jnp.clip(d, 0, W_DEPTH - 1)
+            return a.at[iota_s, dd_].set(jnp.where(mask, v, a[iota_s, dd_]))
+
+        scalar_tag = (tag >= 116) & (tag != 117) & (tag != 118)
+        bad_tag = tag < 116
+        arr_tag = tag == 117
+        map_tag = (tag == 118) & (val2 > 0)
+        empty_map = (tag == 118) & (val2 == 0)
+        push = active & in_anyval & map_tag
+        deep_bad = (active & in_anyval & bad_tag) | (
+            push & (depth >= W_DEPTH - 1)
         )
-        any_children = jnp.where(in_any & (tag == 117), val2, 0)
-        map_open = in_any & (tag == 118) & (val2 > 0)
-        pairs2 = jnp.where(in_mval, pairs - 1, pairs)
-        map_done = in_mval & (pairs2 == 0)
-        elem_done = (in_any & ~map_open) | map_done
-        elems2 = jnp.where(
-            elem_done, elems - 1 + any_children, elems
+        push = push & ~deep_bad
+
+        # value-token effects at the current depth (W_ANY tokens are
+        # pre-counted in elems[d]; a W_MVAL token is implied by its pair)
+        elems_delta = jnp.where(
+            active & in_any & (scalar_tag | empty_map),
+            -1,
+            jnp.where(
+                active & in_any & arr_tag,
+                val2 - 1,
+                jnp.where(active & in_mval & arr_tag, val2, 0),
+            ),
         )
-        any_finished = elem_done & (elems2 == 0)
+        ed2 = sget(elems, depth) + elems_delta
+        elems_n = sset(elems, depth, ed2, active & in_anyval)
+        depth_n = jnp.where(push, depth + 1, depth)
+        pairs_n = sset(pairs, depth_n, val2, push)
+        elems_n = sset(elems_n, depth_n, 0, push)
+
+        # completion cascade: a finished value at depth d >= 1 completes
+        # its pair when no array children remain; a finished map pops and
+        # completes one value at the depth below (unrolled W_DEPTH times
+        # — a cascade can never be longer than the stack)
+        pair_done = active & (
+            (in_mval & (scalar_tag | empty_map))
+            | (in_any & (scalar_tag | empty_map) & (depth >= 1) & (ed2 == 0))
+        )
+        for _ in range(W_DEPTH):
+            pd = sget(pairs_n, depth_n) - 1
+            pairs_n = sset(pairs_n, depth_n, pd, pair_done)
+            map_closed = pair_done & (pd <= 0)
+            depth_n = jnp.where(map_closed, depth_n - 1, depth_n)
+            # value completion at the popped-to depth
+            e_at = sget(elems_n, depth_n)
+            dec_nested = map_closed & (depth_n >= 1) & (e_at > 0)
+            e_new = jnp.where(dec_nested, e_at - 1, e_at)
+            elems_n = sset(elems_n, depth_n, e_new, dec_nested)
+            dec_top = map_closed & (depth_n == 0)
+            elems_n = sset(
+                elems_n, jnp.zeros_like(depth_n), sget(elems_n, jnp.zeros_like(depth_n)) - 1, dec_top
+            )
+            pair_done = map_closed & (depth_n >= 1) & (e_new == 0)
+        post_any = active & in_anyval & ~deep_bad
+        e_top = sget(elems_n, depth_n)
+        to_mkey = (post_any & (depth_n >= 1) & (e_top == 0)) | push
+        to_any = post_any & (
+            ((depth_n >= 1) & (e_top > 0))
+            | ((depth_n == 0) & (elems_n[:, 0] > 0))
+        )
+        any_finished = (
+            active & in_anyval & (depth_n == 0) & (elems_n[:, 0] <= 0)
+        )
 
         # --- consumption / output ----------------------------------------
         consumed = jnp.where(
@@ -776,8 +860,7 @@ def _rest_walker(
         # content-finishing transitions -> back to block dispatch
         fin = (
             (st == W_SKIP)
-            | ((st == W_ANY) & any_finished)
-            | ((st == W_MVAL) & map_done & (elems2 == 0))
+            | any_finished
             | (st == W_BUF)
             | ((st == W_MSK) & collapsed2)
             | (st == W_MEK)
@@ -786,12 +869,9 @@ def _rest_walker(
         nst = jnp.where(st == W_MSC, W_MSK, nst)
         nst = jnp.where((st == W_MSK) & ~collapsed2, W_MEC, nst)
         nst = jnp.where(st == W_MEC, W_MEK, nst)
-        nst = jnp.where(map_open, W_MKEY, nst)
+        nst = jnp.where(to_mkey, W_MKEY, nst)
+        nst = jnp.where(to_any, W_ANY, nst)
         nst = jnp.where(in_mkey, W_MVAL, nst)
-        nst = jnp.where(in_mval & ~map_done, W_MKEY, nst)
-        nst = jnp.where(
-            map_done & (elems2 > 0), W_ANY, nst
-        )
         nst = jnp.where(fin, W_BLK, nst)
         nst = jnp.where((st == W_DS) & (pos + consumed >= end), W_DONE, nst)
         nst = jnp.where(active, nst, st)
@@ -804,8 +884,16 @@ def _rest_walker(
         )
         nc_left2 = jnp.where(active & (st == W_NC), val, nc_left)
         nc_left2 = nc_left2 - (active & (st == W_BLK) & sec_done).astype(I32)
-        elems3 = jnp.where(dispatch_any, blk_any, elems2)
-        pairs3 = jnp.where(map_open, val2, pairs2)
+        # entering a new Any block resets the whole container stack
+        elems3 = jnp.where(
+            dispatch_any[:, None],
+            jnp.concatenate(
+                [blk_any[:, None], jnp.zeros((S, W_DEPTH - 1), I32)], axis=1
+            ),
+            elems_n,
+        )
+        pairs3 = jnp.where(dispatch_any[:, None], 0, pairs_n)
+        depth3 = jnp.where(dispatch_any, 0, depth_n)
 
         pos2 = pos + consumed
         regs2 = (
@@ -817,6 +905,7 @@ def _rest_walker(
             nc_left2,
             elems3,
             pairs3,
+            depth3,
             collapsed2,
         )
         return regs2, out2
@@ -842,8 +931,9 @@ def _rest_walker(
         jnp.zeros((S,), I32),  # blk
         jnp.zeros((S,), I32),  # blocks_left
         jnp.zeros((S,), I32),  # nc_left
-        jnp.zeros((S,), I32),  # elems
-        jnp.zeros((S,), I32),  # pairs
+        jnp.zeros((S, W_DEPTH), I32),  # elems (container stack)
+        jnp.zeros((S, W_DEPTH), I32),  # pairs (container stack)
+        jnp.zeros((S,), I32),  # depth
         jnp.zeros((S,), bool),  # collapsed
     )
     regs, out = jax.lax.fori_loop(0, T_total, step, (regs0, out0))
