@@ -535,6 +535,8 @@ def test_nested_any_values_ride_device_lane():
         {"deep": [1, 2, 3]},                       # map -> array
         {"a": {"b": 7}, "c": [4, [5, 6]]},         # map -> map / arr -> arr
         [{"x": [1, {"y": 2}]}, 9],                 # arr -> map -> arr -> map
+        {"e": [], "f": 2},                         # EMPTY array as pair value
+        [{"g": [1, []]}, {}, []],                  # empty arr/map tails
         "plain",
     ]
     d = Doc(client_id=5)

@@ -691,9 +691,15 @@ def _rest_walker(
 
         scalar_tag = (tag >= 116) & (tag != 117) & (tag != 118)
         bad_tag = tag < 116
-        arr_tag = tag == 117
+        arr_tag = (tag == 117) & (val2 > 0)
         map_tag = (tag == 118) & (val2 > 0)
-        empty_map = (tag == 118) & (val2 == 0)
+        # empty containers complete like scalars — an empty array as a
+        # pair value (or last array child) must still fire pair_done
+        scalar_like = (
+            scalar_tag
+            | ((tag == 118) & (val2 == 0))
+            | ((tag == 117) & (val2 == 0))
+        )
         push = active & in_anyval & map_tag
         deep_bad = (active & in_anyval & bad_tag) | (
             push & (depth >= W_DEPTH - 1)
@@ -703,7 +709,7 @@ def _rest_walker(
         # value-token effects at the current depth (W_ANY tokens are
         # pre-counted in elems[d]; a W_MVAL token is implied by its pair)
         elems_delta = jnp.where(
-            active & in_any & (scalar_tag | empty_map),
+            active & in_any & scalar_like,
             -1,
             jnp.where(
                 active & in_any & arr_tag,
@@ -722,8 +728,8 @@ def _rest_walker(
         # completes one value at the depth below (unrolled W_DEPTH times
         # — a cascade can never be longer than the stack)
         pair_done = active & (
-            (in_mval & (scalar_tag | empty_map))
-            | (in_any & (scalar_tag | empty_map) & (depth >= 1) & (ed2 == 0))
+            (in_mval & scalar_like)
+            | (in_any & scalar_like & (depth >= 1) & (ed2 == 0))
         )
         for _ in range(W_DEPTH):
             pd = sget(pairs_n, depth_n) - 1
