@@ -2467,13 +2467,46 @@ def get_values(state: DocStateBatch, doc: int, payloads: PayloadStore) -> list:
     return out
 
 
+# --- bounded resident-program plumbing (VERDICT r4 #7) ----------------------
+# The two batched-apply entry points get tick-ing host wrappers: nearly
+# every test and serving path integrates through one of them, so the
+# budget's periodic enforcement actually runs suite-wide (the library-
+# internal hooks alone missed direct callers — the r5 no-crutch suite
+# segfaulted at ~73% compiling an unregistered giant program).
+
+_apply_update_batch_jit = apply_update_batch
+_apply_update_stream_jit = apply_update_stream
+
+
+def apply_update_batch(
+    state: DocStateBatch, batch: UpdateBatch, client_rank: jax.Array
+) -> DocStateBatch:
+    from ytpu.utils.progbudget import tick
+
+    tick()
+    return _apply_update_batch_jit(state, batch, client_rank)
+
+
+def apply_update_stream(
+    state: DocStateBatch, stream: UpdateBatch, client_rank: jax.Array
+) -> DocStateBatch:
+    from ytpu.utils.progbudget import tick
+
+    tick()
+    return _apply_update_stream_jit(state, stream, client_rank)
+
+
+apply_update_batch.__doc__ = _apply_update_batch_jit.__doc__
+apply_update_stream.__doc__ = _apply_update_stream_jit.__doc__
+
+
 def _register_programs():
     """Track the big jitted entry points under the bounded resident-
     program registry (VERDICT r4 #7; see ytpu/utils/progbudget.py)."""
     from ytpu.utils import progbudget
 
-    progbudget.register("apply_update_batch", apply_update_batch)
-    progbudget.register("apply_update_stream", apply_update_stream)
+    progbudget.register("apply_update_batch", _apply_update_batch_jit)
+    progbudget.register("apply_update_stream", _apply_update_stream_jit)
     progbudget.register("encode_diff_batch", encode_diff_batch)
     progbudget.register("finish_pack", _finish_pack)
     progbudget.register("finish_counts", _finish_counts)

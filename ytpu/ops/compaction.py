@@ -452,3 +452,13 @@ def grow_state(state: DocStateBatch, new_capacity: int) -> DocStateBatch:
         ext = jnp.full(col.shape[:-1] + (pad,), fill, dtype=col.dtype)
         cols[name] = jnp.concatenate([col, ext], axis=-1)
     return state._replace(blocks=BlockCols(**cols))
+
+
+def _register_programs():
+    from ytpu.utils import progbudget
+
+    progbudget.register("compact_state", compact_state)
+    progbudget.register("compact_packed", compact_packed)
+
+
+_register_programs()

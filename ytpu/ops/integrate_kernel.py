@@ -936,6 +936,13 @@ def apply_update_stream_fused(
     hardware bisection only (see `_kernel`); never pass them in production
     — partial kernels corrupt state by design."""
     del guard
+    # the fused program (especially interpret-mode on CPU) is the largest
+    # in the process: evict under the resident-program budget BEFORE a
+    # possible compile, not just on the periodic tick (the r5 no-crutch
+    # suite segfaulted compiling exactly this program at ~73%)
+    from ytpu.utils import progbudget
+
+    progbudget.enforce()
     cols, meta = pack_state(state)
     D = cols.shape[1]
     if D % d_block != 0:
@@ -946,3 +953,12 @@ def apply_update_stream_fused(
         _debug_phases, _debug_row_phase,
     )
     return unpack_state(cols, meta, state)
+
+
+def _register_programs():
+    from ytpu.utils import progbudget
+
+    progbudget.register("fused_run", _run)
+
+
+_register_programs()

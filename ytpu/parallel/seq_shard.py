@@ -271,3 +271,12 @@ def read_text(state: ShardedTextState) -> str:
         "".join(chr(c) for c in text[i, : lengths[i]]) for i in range(len(lengths))
     ]
     return "".join(parts)
+
+
+def _register_programs():
+    from ytpu.utils import progbudget
+
+    progbudget.register("seq_shard_apply_ops", _apply_ops_impl)
+
+
+_register_programs()
