@@ -305,6 +305,13 @@ class Move:
                 cur.moved = item
                 if not cur.deleted and isinstance(cur.content, ContentMove):
                     if cur.content.move.find_move_loop(store, cur, {item}):
+                        if adapt:
+                            # the tombstoned move still re-encodes: its
+                            # priority must leave the adapt sentinel (-1)
+                            # before the early return, or a later
+                            # encode_state_as_update writes a negative
+                            # varint and throws
+                            self.priority = max_priority + 1
                         self._delete_as_cleanup(txn, item, adapt)
                         return
             else:

@@ -54,11 +54,17 @@ and secondary roots:
 - secondary roots anchor through a BLOCK_ROOT_ANCHOR row on shard
   ``root key % S`` and are likewise shard-affine.
 
-Moves and GC-range carriers still raise (moves need cross-segment range
-bookkeeping the sp engine does not model yet); sharded docs keep
-tombstones (the `skip_gc` regime of the reference, store.rs:139-151).
-`rebalance()` currently re-cuts the primary root only and refuses when
-branch-affine rows exist.
+- moves (r5): a move row integrates with its range bounds in the mv
+  columns and ownership recomputes per shard (`_recompute_moves`) —
+  valid because the router requires move ranges to live WHOLE on the
+  move's shard (always true inside shard-affine branches; true on the
+  primary root while the range sits in one segment). Cross-segment
+  ranges and boundary-straddling move rows still raise.
+
+GC-range carriers still raise; sharded docs keep tombstones (the
+`skip_gc` regime of the reference, store.rs:139-151). `rebalance()`
+currently re-cuts the primary root only and refuses when branch-affine
+rows or live moves exist (a re-cut could split a move's range).
 """
 
 from __future__ import annotations
@@ -77,6 +83,7 @@ from ytpu.core.content import (
     CONTENT_ANY,
     CONTENT_DELETED,
     CONTENT_FORMAT,
+    CONTENT_MOVE,
     CONTENT_STRING,
     ContentAny,
     ContentDeleted,
@@ -141,6 +148,13 @@ class SpStep(NamedTuple):
     #                client (with pk its clock); <= -2 = secondary root,
     #                encoded as -2 - root_key (anchor-row lookup by key)
     pk: jax.Array
+    mv_sc: jax.Array  # move rows: range bounds + priority (batch_doc
+    mv_sk: jax.Array  # `no_move` convention; -1 client = branch-scoped)
+    mv_sa: jax.Array
+    mv_ec: jax.Array
+    mv_ek: jax.Array
+    mv_ea: jax.Array
+    mv_prio: jax.Array
     valid: jax.Array  # bool
     del_client: jax.Array
     del_start: jax.Array
@@ -174,6 +188,13 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
         r_key,
         r_pc,
         r_pk,
+        r_mv_sc,
+        r_mv_sk,
+        r_mv_sa,
+        r_mv_ec,
+        r_mv_ek,
+        r_mv_ea,
+        r_mv_prio,
         r_valid,
     ) = row
     bl = state.blocks
@@ -185,6 +206,33 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
     has_origin = s_oc >= 0
     has_ror = s_rc >= 0
     linkable = do & ~is_anchor
+
+    # move rows: the range-bound repair splits (moving.rs:100-111 —
+    # assoc After cleans the bound's start, Before its end) happen on
+    # device too, so block granularity matches the oracle's. They run
+    # BEFORE the anchor cleans: a later split could re-home the anchor
+    # unit to a fresh slot and stale left_idx/right_idx.
+    is_mv_pre = do & (r_kind == CONTENT_MOVE)
+    state, _ = _clean_start(
+        state,
+        jnp.where(is_mv_pre & (r_mv_sc >= 0) & (r_mv_sa >= 0), r_mv_sc, -2),
+        r_mv_sk,
+    )
+    state, _ = _clean_end(
+        state,
+        jnp.where(is_mv_pre & (r_mv_sc >= 0) & (r_mv_sa < 0), r_mv_sc, -2),
+        r_mv_sk,
+    )
+    state, _ = _clean_start(
+        state,
+        jnp.where(is_mv_pre & (r_mv_ec >= 0) & (r_mv_ea >= 0), r_mv_ec, -2),
+        r_mv_ek,
+    )
+    state, _ = _clean_end(
+        state,
+        jnp.where(is_mv_pre & (r_mv_ec >= 0) & (r_mv_ea < 0), r_mv_ec, -2),
+        r_mv_ek,
+    )
 
     # resolve local anchors (repair; parity: block.rs:1287-1300)
     probe_oc = jnp.where(linkable & (a_oc >= 0), a_oc, -2)
@@ -304,8 +352,13 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
     # map rows are not sequence content, and nested rows count inside
     # their branch, not the root prefix sums (visible_lengths filters on
     # parent == -1); anchors are bookkeeping rows
+    is_move_row = do & (r_kind == CONTENT_MOVE)
     row_countable = (
-        ~row_deleted & (r_kind != CONTENT_FORMAT) & ~is_map & ~is_anchor
+        ~row_deleted
+        & (r_kind != CONTENT_FORMAT)
+        & (r_kind != CONTENT_MOVE)
+        & ~is_map
+        & ~is_anchor
     )
 
     new_bl = BlockCols(
@@ -327,13 +380,13 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
         parent=_set(bl.parent, wj, jnp.where(pslot >= 0, pslot, -1)),
         head=_set(new_head_col, wj, -1),
         moved=_set(bl.moved, wj, -1),
-        mv_sc=bl.mv_sc,
-        mv_sk=bl.mv_sk,
-        mv_sa=bl.mv_sa,
-        mv_ec=bl.mv_ec,
-        mv_ek=bl.mv_ek,
-        mv_ea=bl.mv_ea,
-        mv_prio=bl.mv_prio,
+        mv_sc=_set(bl.mv_sc, wj, jnp.where(is_move_row, r_mv_sc, -1)),
+        mv_sk=_set(bl.mv_sk, wj, jnp.where(is_move_row, r_mv_sk, 0)),
+        mv_sa=_set(bl.mv_sa, wj, jnp.where(is_move_row, r_mv_sa, 0)),
+        mv_ec=_set(bl.mv_ec, wj, jnp.where(is_move_row, r_mv_ec, -1)),
+        mv_ek=_set(bl.mv_ek, wj, jnp.where(is_move_row, r_mv_ek, 0)),
+        mv_ea=_set(bl.mv_ea, wj, jnp.where(is_move_row, r_mv_ea, 0)),
+        mv_prio=_set(bl.mv_prio, wj, jnp.where(is_move_row, r_mv_prio, -1)),
     )
     # a map row that became its chain's tail is the key's new live value;
     # the previous winner — its immediate left — gets tombstoned (parity:
@@ -379,6 +432,13 @@ def _apply_step_one_shard(
             step.key[i],
             step.pc[i],
             step.pk[i],
+            step.mv_sc[i],
+            step.mv_sk[i],
+            step.mv_sa[i],
+            step.mv_ec[i],
+            step.mv_ek[i],
+            step.mv_ea[i],
+            step.mv_prio[i],
             step.valid[i],
         )
         return jax.lax.cond(
@@ -405,7 +465,23 @@ def _apply_step_one_shard(
         )
         return st
 
-    return jax.lax.fori_loop(0, R, del_body, state)
+    state = jax.lax.fori_loop(0, R, del_body, state)
+
+    # move ownership: recompute when this step could have changed it —
+    # a move row arrived, or any activity touched a shard holding live
+    # moves (the router guarantees move ranges are shard-local, so the
+    # per-shard recompute is the whole answer; batch_doc parity)
+    from ytpu.models.batch_doc import _recompute_moves
+
+    bl = state.blocks
+    slots = jnp.arange(_capacity(bl), dtype=I32)
+    has_moves = jnp.any(
+        (slots < state.n_blocks) & (bl.kind == CONTENT_MOVE) & ~bl.deleted
+    )
+    new_move = jnp.any(step.valid & (step.kind == CONTENT_MOVE))
+    activity = jnp.any(step.valid) | jnp.any(step.del_valid)
+    dirty = new_move | (activity & has_moves)
+    return _recompute_moves(state, dirty, client_rank)
 
 
 @jax.jit
@@ -533,6 +609,7 @@ class ShardedDoc:
         # the wire omits the parent, block.rs:604-612)
         self._parent_index: Dict[Tuple[int, int], Tuple[int, int]] = {}
         self._root_anchor_shard: Dict[int, int] = {}  # root key -> shard
+        self._has_moves = False  # live move rows anywhere (rebalance guard)
         self._queue_rows: List[List[tuple]] = [[] for _ in range(n_shards)]
         self._queue_dels: List[List[tuple]] = [[] for _ in range(n_shards)]
         self._queued = 0
@@ -614,13 +691,16 @@ class ShardedDoc:
         self._queued = 0
 
         def dispatch(row_chunk, del_chunk):
-            rows = np.zeros((self.S, U, 17), dtype=np.int32)
+            rows = np.zeros((self.S, U, 24), dtype=np.int32)
             rows[:, :, 3] = -1  # s_oc
             rows[:, :, 5] = -1  # s_rc
             rows[:, :, 7] = -1  # a_oc
             rows[:, :, 9] = -1  # a_rc
             rows[:, :, 14] = -1  # key (sequence row)
             rows[:, :, 15] = -1  # pc (primary root)
+            rows[:, :, 17] = -1  # mv_sc (no move)
+            rows[:, :, 20] = -1  # mv_ec
+            rows[:, :, 23] = -1  # mv_prio
             valid = np.zeros((self.S, U), dtype=bool)
             dels = np.zeros((self.S, R, 3), dtype=np.int32)
             del_valid = np.zeros((self.S, R), dtype=bool)
@@ -649,6 +729,13 @@ class ShardedDoc:
                 key=jnp.asarray(rows[:, :, 14]),
                 pc=jnp.asarray(rows[:, :, 15]),
                 pk=jnp.asarray(rows[:, :, 16]),
+                mv_sc=jnp.asarray(rows[:, :, 17]),
+                mv_sk=jnp.asarray(rows[:, :, 18]),
+                mv_sa=jnp.asarray(rows[:, :, 19]),
+                mv_ec=jnp.asarray(rows[:, :, 20]),
+                mv_ek=jnp.asarray(rows[:, :, 21]),
+                mv_ea=jnp.asarray(rows[:, :, 22]),
+                mv_prio=jnp.asarray(rows[:, :, 23]),
                 valid=jnp.asarray(valid),
                 del_client=jnp.asarray(dels[:, :, 0]),
                 del_start=jnp.asarray(dels[:, :, 1]),
@@ -716,6 +803,37 @@ class ShardedDoc:
                 f"parent {parent_ref} not in directory (routing bug)"
             )
         return owner
+
+    def _check_move_local(self, mv_fields, target: int) -> None:
+        """A move's claimed range must live WHOLE on the move row's shard
+        (segments are contiguous, so both bounds on `target` implies the
+        range is): cross-segment ranges would need cross-shard moved-flag
+        propagation the sp engine does not model. Branch-scoped bounds
+        (client -1 = sequence head/tail) are fine for shard-affine
+        branches; for the SEGMENTED primary root they span every shard,
+        so they only pass while the doc still lives on one shard."""
+        sc_i, sk_i, _sa, ec_i, ek_i, _ea, _pr = mv_fields
+        for bc, bk in ((sc_i, sk_i), (ec_i, ek_i)):
+            if bc >= 0:
+                owner = self.dir.owner(bc, bk)
+                if owner is not None and owner != target:
+                    raise NotImplementedError(
+                        "sharded docs: move range crosses shard segments "
+                        f"(bound on shard {owner}, move on {target}); "
+                        "cross-shard moves need the unsharded engine"
+                    )
+            else:
+                others = [
+                    s
+                    for s in range(self.S)
+                    if s != target and self._n_rows[s] > 0
+                ]
+                if others:
+                    raise NotImplementedError(
+                        "sharded docs: branch-scoped move bound spans the "
+                        "segmented primary root; cross-shard moves need "
+                        "the unsharded engine"
+                    )
 
     def _first_nonempty(self) -> int:
         queued = [len(q) for q in self._queue_rows]
@@ -807,14 +925,45 @@ class ShardedDoc:
             ref = enc.payloads.add(kind, list(content.items))
         elif kind == CONTENT_DELETED:
             ref = -1
-        elif kind in (CONTENT_FORMAT, K_TYPE):
+        elif kind in (CONTENT_FORMAT, K_TYPE, CONTENT_MOVE):
             ref = enc.payloads.add(kind, content)
         else:
             raise NotImplementedError(
-                "sharded docs support sequence / map / nested-branch "
-                f"content only (kind={kind}; moves and GC carriers need "
-                "the unsharded engine)"
+                "sharded docs support sequence / map / nested-branch / "
+                f"shard-local move content only (kind={kind}; GC carriers "
+                "need the unsharded engine)"
             )
+        mv_fields = (-1, 0, 0, -1, 0, 0, -1)
+        if kind == CONTENT_MOVE:
+            self._has_moves = True
+            mv = content.move
+            sc_i, sk_i, sa_i = -1, 0, mv.start.assoc
+            if mv.start.id is not None:
+                sc_i = enc.interner.intern(mv.start.id.client)
+                sk_i = mv.start.id.clock
+            ec_i, ek_i, ea_i = -1, 0, mv.end.assoc
+            if mv.end.id is not None:
+                ec_i = enc.interner.intern(mv.end.id.client)
+                ek_i = mv.end.id.clock
+            mv_fields = (
+                sc_i, sk_i, sa_i, ec_i, ek_i, ea_i, max(mv.priority, 0)
+            )
+            # the oracle's range repair splits blocks at the bounds
+            # (moving.rs:100-111: assoc After -> clean_start at the id's
+            # clock; Before -> clean_end, junction one past) and repair
+            # splits never re-squash — journal them as permanent
+            # junctions so encode-time merges stop exactly where the
+            # oracle's did. A junction AT the client's current coverage
+            # edge is NOT a split (the clean is a no-op there); a later
+            # arrival may still squash across it, so don't record it.
+            for bc, bj in (
+                (sc_i, sk_i if sa_i >= 0 else sk_i + 1),
+                (ec_i, ek_i if ea_i >= 0 else ek_i + 1),
+            ):
+                if bc >= 0 and 0 < bj < self.sv.get(
+                    enc.interner.from_idx[bc]
+                ):
+                    self._journal.setdefault(bc, []).append(("s", bj))
         c = enc.interner.intern(real_client)
         if offset:
             clock += offset
@@ -915,9 +1064,11 @@ class ShardedDoc:
                     raise RuntimeError(
                         "nested right-origin off its branch shard (routing bug)"
                     )
+            if kind == CONTENT_MOVE:
+                self._check_move_local(mv_fields, target)
             row = self._make_row(
                 c, clock, length, s_o, s_r, s_o, s_r, kind, ref, offset,
-                parent=parent_ref,
+                parent=parent_ref, mv=mv_fields,
             )
             self._enqueue_row(target, row)
             self._journal_row(c, clock, length, s_o, s_r, kind)
@@ -961,8 +1112,11 @@ class ShardedDoc:
                 self._resolve_boundary(item, c, clock, length, s_o, s_r, kind, ref, offset)
                 return
 
+        if kind == CONTENT_MOVE:
+            self._check_move_local(mv_fields, target)
         row = self._make_row(
-            c, clock, length, s_o, s_r, s_o, a_r, kind, ref, offset
+            c, clock, length, s_o, s_r, s_o, a_r, kind, ref, offset,
+            mv=mv_fields,
         )
         self._enqueue_row(target, row)
         self._journal_row(c, clock, length, s_o, s_r, kind)
@@ -1034,7 +1188,7 @@ class ShardedDoc:
     @staticmethod
     def _make_row(
         c, clock, length, s_o, s_r, a_o, a_r, kind, ref, off, key=-1,
-        parent=(-1, 0),
+        parent=(-1, 0), mv=(-1, 0, 0, -1, 0, 0, -1),
     ):
         return (
             c,
@@ -1054,7 +1208,7 @@ class ShardedDoc:
             key,
             parent[0],
             parent[1],
-        )
+        ) + tuple(mv)
 
     # ---------------------------------------------- boundary (halo) resolve
 
@@ -1432,7 +1586,7 @@ class ShardedDoc:
             content = ContentAny(enc.payloads.slice_values(ref, off, length))
         elif kind == CONTENT_DELETED:
             content = ContentDeleted(length)
-        elif kind in (CONTENT_FORMAT, K_TYPE):
+        elif kind in (CONTENT_FORMAT, K_TYPE, CONTENT_MOVE):
             content = enc.payloads.items[ref][1]  # stored content object
         else:  # pragma: no cover - scope-guarded at routing
             raise NotImplementedError(f"kind {kind}")
@@ -1613,12 +1767,32 @@ class ShardedDoc:
         boundaries = {
             c: self._oracle_boundaries(c, items, succ) for c in self._journal
         }
+        bl_mv = st.blocks.moved
         for run in runs:
             for gi in range(len(run) - 1):
                 a_key, b_key = root(run[gi]), run[gi + 1]
                 a, b = items[a_key], items[b_key]
+                (sa_, ra_), (sb_, rb_) = run[gi], run[gi + 1]
+                mv_a, mv_b = int(bl_mv[sa_, ra_]), int(bl_mv[sb_, rb_])
+                moved_ok = (
+                    mv_a == mv_b if sa_ == sb_ else (mv_a == -1 and mv_b == -1)
+                )
+                # a junction both of whose sides are owned by the SAME
+                # live move was a claim-merge candidate at that move's
+                # commit (integrate_block queues claimed items into
+                # merge_blocks; commit step 7 squashes them) — the
+                # oracle re-merged it, so the journal boundary yields.
+                # Released ownership (owner deleted / None-None) keeps
+                # repair splits standing, like the oracle's delete path.
+                claim_merged = (
+                    sa_ == sb_
+                    and mv_a >= 0
+                    and mv_a == mv_b
+                    and not bool(st.blocks.deleted[sa_, mv_a])
+                )
                 if (
-                    a.id.client == b.id.client
+                    moved_ok
+                    and a.id.client == b.id.client
                     and a.id.clock + a.len == b.id.clock
                     and b.origin is not None
                     and b.origin.client == a.id.client
@@ -1626,8 +1800,11 @@ class ShardedDoc:
                     and _same_ror_items(a, b)
                     and a.deleted == b.deleted
                     and a.parent_sub == b.parent_sub
-                    and b.id.clock
-                    not in boundaries.get(interned.get(a.id.client, -1), ())
+                    and (
+                        claim_merged
+                        or b.id.clock
+                        not in boundaries.get(interned.get(a.id.client, -1), ())
+                    )
                     and a.content.merge(b.content)
                 ):
                     a.len += b.len
@@ -1661,14 +1838,16 @@ class ShardedDoc:
         encode time, so wire parity is preserved. Anchors that later
         straddle the new boundaries either hit the exact-first-id fast
         path or the host resolver."""
-        if self._parent_index or self._root_anchor_shard:
+        if self._parent_index or self._root_anchor_shard or self._has_moves:
             # nested branches / secondary roots are shard-AFFINE (not
             # segment-cut); re-cutting would strand children from their
-            # parent row. Rebalance currently re-cuts the primary root
-            # only, so refuse when affine rows exist.
+            # parent row — and would split shard-local move ranges.
+            # Rebalance currently re-cuts the primary root only, so
+            # refuse when affine rows or moves exist.
             raise NotImplementedError(
-                "rebalance with nested branches / secondary roots: "
-                "branch-affine rows must move with their parent"
+                "rebalance with nested branches / secondary roots / "
+                "moves: affine rows must move with their parent and "
+                "move ranges must stay whole"
             )
         self.flush()
         st = self._pull()
