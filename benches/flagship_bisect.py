@@ -1,0 +1,145 @@
+"""Bisect the TPU-worker crash in the flagship xla-lane full replay.
+
+`FusedReplay(lane="xla")` kills the TPU worker process (observed twice on
+fresh workers, 2026-08-01). Per-chunk it runs exactly two device
+programs: the chunked device decode (`decode_updates_v1`, n_steps=chunk)
+and the un-fused integrate scan (`_xla_chunk_step`: unpack →
+apply_update_stream's lax.scan → repack). This driver runs each in
+isolation at increasing shapes, flushing a JSON line per stage, so the
+worker crash attributes to a named stage + shape.
+
+Usage: python benches/flagship_bisect.py [out.json]
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+    HERE, "benches", "flagship_bisect.json"
+)
+state: dict = {"stages": {}}
+
+
+def flush():
+    with open(OUT, "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def stage(name, fn):
+    state["stages"][name] = {"status": "running"}
+    flush()
+    t0 = time.time()
+    try:
+        extra = fn() or {}
+        state["stages"][name] = {
+            "status": "ok", "seconds": round(time.time() - t0, 1), **extra
+        }
+    except Exception as e:  # noqa: BLE001 — attribute and continue
+        state["stages"][name] = {
+            "status": "fail",
+            "seconds": round(time.time() - t0, 1),
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }
+    flush()
+    return state["stages"][name]["status"] == "ok"
+
+
+def main() -> int:
+    spec = importlib.util.spec_from_file_location(
+        "ytpu_bench_main", os.path.join(HERE, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    log, _, trace = bench.load_full_log()
+    state["trace"] = trace
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import partial
+
+    state["platform"] = jax.devices()[0].platform
+    flush()
+
+    from ytpu.models.replay import plan_replay, _xla_chunk_step
+    from ytpu.ops.decode_kernel import (
+        decode_updates_v1,
+        identity_rank,
+        pack_updates,
+    )
+    from ytpu.ops.integrate_kernel import pack_state
+    from ytpu.models.batch_doc import init_state
+
+    plan = plan_replay(log)
+    rank = identity_rank(256)
+
+    def make_chunk(n, chunk):
+        batch = log[:n]
+        if len(batch) < chunk:
+            batch = batch + [b"\x00\x00"] * (chunk - len(batch))
+        buf, lens = pack_updates(batch, pad_to=plan.max_len + 16)
+        return jnp.asarray(buf), jnp.asarray(lens)
+
+    def run_decode(chunk):
+        decode = jax.jit(
+            partial(
+                decode_updates_v1,
+                max_rows=plan.max_rows,
+                max_dels=plan.max_dels,
+                n_steps=chunk,
+                max_sections=plan.max_sections,
+            )
+        )
+        buf, lens = make_chunk(chunk, chunk)
+        stream, flags = decode(buf, lens)
+        jax.block_until_ready(flags)
+        return {"chunk": chunk}
+
+    def run_integrate(chunk, docs, cap):
+        decode = jax.jit(
+            partial(
+                decode_updates_v1,
+                max_rows=plan.max_rows,
+                max_dels=plan.max_dels,
+                n_steps=chunk,
+                max_sections=plan.max_sections,
+            )
+        )
+        buf, lens = make_chunk(chunk, chunk)
+        stream, flags = decode(buf, lens)
+        cols, meta = pack_state(init_state(docs, cap))
+        cols, meta = _xla_chunk_step(cols, meta, stream, rank)
+        jax.block_until_ready(meta)
+        err = int(np.asarray(meta)[:, 2].max())
+        return {"chunk": chunk, "docs": docs, "cap": cap, "err": err}
+
+    # crash order: smallest first so the log attributes the first killer
+    if not stage("d1_decode_512", lambda: run_decode(512)):
+        return 1
+    if not stage("d2_decode_8192", lambda: run_decode(8192)):
+        return 1
+    if not stage("i1_int_512x64x4096", lambda: run_integrate(512, 64, 4096)):
+        return 1
+    if not stage("i2_int_8192x64x8192", lambda: run_integrate(8192, 64, 8192)):
+        return 1
+    if not stage(
+        "i3_int_8192x1024x8192", lambda: run_integrate(8192, 1024, 8192)
+    ):
+        return 1
+    state["conclusion"] = "all stages passed in isolation"
+    flush()
+    print(json.dumps(state))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,133 @@
+"""Stage 2 of the flagship-crash bisect: HBM ceiling + max stable docs.
+
+Stage 1 (flagship_bisect.py) attributed the TPU worker crash to the
+integrate step at 1024 docs (64 docs ok, decode ok at all chunks).  This
+driver (a) measures the visible HBM ceiling with straight allocations,
+(b) walks docs up 128 -> 256 -> 512 on the real chunk shape, flushing
+per stage.  The first failing stage names the flagship's safe envelope.
+
+Usage: python benches/flagship_bisect2.py
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import time
+from functools import partial
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+OUT = os.path.join(HERE, "benches", "flagship_bisect2.json")
+state: dict = {"stages": {}}
+
+
+def flush():
+    with open(OUT, "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def stage(name, fn, keep_going=False):
+    state["stages"][name] = {"status": "running"}
+    flush()
+    t0 = time.time()
+    try:
+        extra = fn() or {}
+        state["stages"][name] = {
+            "status": "ok", "seconds": round(time.time() - t0, 1), **extra
+        }
+    except Exception as e:  # noqa: BLE001
+        state["stages"][name] = {
+            "status": "fail",
+            "seconds": round(time.time() - t0, 1),
+            "error": f"{type(e).__name__}: {e}"[:300],
+        }
+    flush()
+    return keep_going or state["stages"][name]["status"] == "ok"
+
+
+def main() -> int:
+    spec = importlib.util.spec_from_file_location(
+        "ytpu_bench_main", os.path.join(HERE, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    log, _, _ = bench.load_full_log()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    state["platform"] = jax.devices()[0].platform
+    try:
+        ms = jax.devices()[0].memory_stats()
+        state["memory_stats"] = {
+            k: int(v) for k, v in (ms or {}).items() if "bytes" in k
+        }
+    except Exception as e:  # noqa: BLE001
+        state["memory_stats"] = f"{type(e).__name__}: {e}"[:120]
+    flush()
+
+    # (a) HBM ceiling: 1 GiB steps, freed immediately (fail is expected
+    # and non-fatal: RESOURCE_EXHAUSTED here = memory behaves normally)
+    def alloc(gib):
+        x = jnp.zeros((gib * (1 << 28),), jnp.int32)  # 4B elements
+        x.block_until_ready()
+        del x
+        return {"gib": gib}
+
+    for g in (2, 4, 6, 8, 12):
+        if not stage(f"a_alloc_{g}gib", partial(alloc, g), keep_going=True):
+            break
+
+    from ytpu.models.replay import plan_replay, _xla_chunk_step
+    from ytpu.ops.decode_kernel import (
+        decode_updates_v1,
+        identity_rank,
+        pack_updates,
+    )
+    from ytpu.ops.integrate_kernel import pack_state
+    from ytpu.models.batch_doc import init_state
+
+    plan = plan_replay(log)
+    rank = identity_rank(256)
+    chunk = 8192
+
+    decode = jax.jit(
+        partial(
+            decode_updates_v1,
+            max_rows=plan.max_rows,
+            max_dels=plan.max_dels,
+            n_steps=chunk,
+            max_sections=plan.max_sections,
+        )
+    )
+    batch = log[:chunk]
+    buf, lens = pack_updates(batch, pad_to=plan.max_len + 16)
+    stream, flags = decode(jnp.asarray(buf), jnp.asarray(lens))
+    jax.block_until_ready(flags)
+
+    def run_integrate(docs, cap=8192):
+        cols, meta = pack_state(init_state(docs, cap))
+        cols, meta = _xla_chunk_step(cols, meta, stream, rank)
+        jax.block_until_ready(meta)
+        err = int(np.asarray(meta)[:, 2].max())
+        return {"docs": docs, "cap": cap, "err": err}
+
+    for docs in (128, 256, 512):
+        if not stage(f"i_docs_{docs}", partial(run_integrate, docs)):
+            state["conclusion"] = f"first integrate failure at docs={docs}"
+            flush()
+            print(json.dumps(state))
+            return 1
+    state["conclusion"] = "integrate ok through docs=512 at cap 8192"
+    flush()
+    print(json.dumps(state))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""Stage 3: attribute the flagship crash inside the multi-chunk loop.
+
+Stages 1-2 cleared device decode (all chunks) and single-chunk integrate
+(through 512 docs); the crash therefore lives in FusedReplay.run's loop —
+compaction (`compact_packed`), growth (`grow_packed`), or repeated-chunk
+execution.  Three probes at 512 docs, flushing per stage:
+
+  c1: 3 chunks, capacity ample (no compaction, no growth)
+  c2: 3 chunks, capacity tight (compactions fire, no growth)
+  c3: 3 chunks, capacity tiny + max_capacity high (growth fires)
+
+Usage: python benches/flagship_bisect3.py
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import time
+from functools import partial
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+OUT = os.path.join(HERE, "benches", "flagship_bisect3.json")
+state: dict = {"stages": {}}
+
+
+def flush():
+    with open(OUT, "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def stage(name, fn):
+    state["stages"][name] = {"status": "running"}
+    flush()
+    t0 = time.time()
+    try:
+        extra = fn() or {}
+        state["stages"][name] = {
+            "status": "ok", "seconds": round(time.time() - t0, 1), **extra
+        }
+    except Exception as e:  # noqa: BLE001
+        state["stages"][name] = {
+            "status": "fail",
+            "seconds": round(time.time() - t0, 1),
+            "error": f"{type(e).__name__}: {e}"[:300],
+        }
+    flush()
+    return state["stages"][name]["status"] == "ok"
+
+
+def main() -> int:
+    spec = importlib.util.spec_from_file_location(
+        "ytpu_bench_main", os.path.join(HERE, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    log, _, _ = bench.load_full_log()
+
+    import jax
+
+    state["platform"] = jax.devices()[0].platform
+    flush()
+
+    from ytpu.models.replay import FusedReplay, plan_replay
+
+    prefix = log[: 3 * 8192]
+    plan = plan_replay(prefix)
+
+    def run(docs, cap0, maxcap):
+        rep = FusedReplay(
+            n_docs=docs,
+            plan=plan,
+            capacity=cap0,
+            max_capacity=maxcap,
+            d_block=8,
+            chunk=8192,
+            interpret=False,
+            lane="xla",
+        )
+        stats = rep.run(prefix)
+        got = rep.get_string(0)
+        return {
+            "docs": docs,
+            "chunks": stats.chunks,
+            "compactions": stats.compactions,
+            "growths": stats.growths,
+            "final_capacity": stats.capacity,
+            "peak_blocks": stats.peak_blocks,
+            "text_head": got[:24],
+        }
+
+    if not stage("c1_roomy", partial(run, 512, 32768, 32768)):
+        state["conclusion"] = "repeated chunks alone crash (no compact/grow)"
+        flush()
+        return 1
+    if not stage("c2_compact", partial(run, 512, 8192, 8192)):
+        state["conclusion"] = "compaction path crashes"
+        flush()
+        return 1
+    if not stage("c3_grow", partial(run, 512, 4096, 32768)):
+        state["conclusion"] = "growth path crashes"
+        flush()
+        return 1
+    state["conclusion"] = "512-doc 3-chunk loop clean in all modes"
+    flush()
+    print(json.dumps(state))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,82 @@
+"""Run ONLY the flagship full-B4 XLA-lane device replay (+ latency).
+
+Contingency runner for a short tunnel window: bench.py's device child
+spends its budget on configs + micro lanes before the flagship phase; if
+it gets killed at the budget boundary, this script grabs the headline
+number (full-B4 `apply_update_batch` over a doc batch, XLA lane) in
+~one warmup + one timed pass, nothing else.
+
+Usage: python benches/flagship_only.py [out.json]
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        HERE, "benches", "flagship_only.json"
+    )
+    spec = importlib.util.spec_from_file_location(
+        "ytpu_bench_main", os.path.join(HERE, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    log, expect, trace = bench.load_full_log()
+    host_dt, host_text = bench.host_replay(log)
+    expect = host_text
+    host_rate = len(log) / host_dt
+    native = bench.native_replay(log)
+    native_rate = None
+    if native is not None:
+        native_dt, native_text = native
+        if native_text == expect:
+            native_rate = len(log) / native_dt
+
+    import jax
+
+    res = {
+        "platform": jax.devices()[0].platform,
+        "device_kind": str(jax.devices()[0]),
+        "trace": trace,
+        "host_oracle_updates_per_sec": round(host_rate, 1),
+    }
+    if native_rate:
+        res["native_updates_per_sec"] = round(native_rate, 1)
+
+    def flush():
+        with open(out_path, "w") as f:
+            json.dump(res, f, indent=1)
+
+    flush()
+    try:
+        xla = bench.device_replay_full(log, expect, lane="xla")
+        res.update({f"xla_{k}": v for k, v in xla.items()})
+        rate = len(log) * xla["full_docs"] / xla["full_dt"]
+        res["xla_full_updates_per_sec"] = round(rate, 1)
+        if native_rate:
+            res["vs_native"] = round(rate / native_rate, 2)
+        res["vs_py_oracle"] = round(rate / host_rate, 2)
+    except Exception as e:  # noqa: BLE001 — record, keep the window
+        res["xla_full_error"] = f"{type(e).__name__}: {e}"[:300]
+    flush()
+    try:
+        res.update(bench.device_step_latency(log))
+    except Exception as e:  # noqa: BLE001
+        res["latency_error"] = f"{type(e).__name__}: {e}"[:300]
+    flush()
+    print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
