@@ -190,7 +190,7 @@ def test_compact_plus_grow_sustains_small_capacity():
 
 
 def test_compact_packed_preserves_move_columns():
-    """compact_packed must carry all NC=25 columns, remapping `moved` slot
+    """compact_packed must carry all NC columns, remapping `moved` slot
     indices through the defragment permutation (regression: the packed
     compactor once emitted only the 17 pre-move columns)."""
     from ytpu.ops.compaction import compact_packed, grow_packed

@@ -38,8 +38,19 @@ def build_stream(ops_fn, n_docs=8, capacity=128, rows=4, dels=4):
 
 
 def assert_same_state(a, b):
+    """a = XLA-lane state (incrementally maintained origin_slot), b =
+    fused-lane state (origin_slot recomputed wholesale at unpack)."""
     for name in a.blocks._fields:
         va, vb = np.asarray(getattr(a.blocks, name)), np.asarray(getattr(b.blocks, name))
+        if name == "origin_slot":
+            # cache contract: the maintained column may hold -1 on rows
+            # that never linked (GC carriers); the recompute resolves
+            # those too. Anywhere the XLA lane cached a slot, the fused
+            # recompute must agree exactly.
+            assert np.array_equal(np.where(va >= 0, va, vb), vb), (
+                "column origin_slot diverged"
+            )
+            continue
         assert np.array_equal(va, vb), f"column {name} diverged"
     assert np.array_equal(np.asarray(a.start), np.asarray(b.start))
     assert np.array_equal(np.asarray(a.n_blocks), np.asarray(b.n_blocks))

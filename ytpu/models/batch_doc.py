@@ -65,6 +65,7 @@ __all__ = [
     "finish_encode_diff_batch",
     "ensure_root_anchor",
     "ensure_root_anchor_all",
+    "recompute_origin_slot",
     "get_string",
     "get_map",
     "get_tree",
@@ -102,6 +103,15 @@ class BlockCols(NamedTuple):
     mv_ek: jax.Array  # [*, B] i32 move rows: range-end id clock
     mv_ea: jax.Array  # [*, B] i32 move rows: end assoc
     mv_prio: jax.Array  # [*, B] i32 move rows: conflict priority
+    origin_slot: jax.Array  # [*, B] i32 cached slot of the block containing
+    # this row's origin id (-1 = no origin / absent from the local store).
+    # The conflict scan's case-2 resolution (block.rs:537-602) reads it as
+    # one gather instead of an O(B) find per while-trip (VERDICT r4 #9).
+    # Maintained at insert/split/squash/compact/grow; recomputed wholesale
+    # at fused-lane unpack and pre-origin_slot checkpoint load. Contract
+    # (asserted in tests/test_origin_slot.py): authoritative for every
+    # sequence-LINKED row; unlinked rows (GC carriers, rows in
+    # error-flagged docs) may conservatively hold -1.
 
 
 class DocStateBatch(NamedTuple):
@@ -175,6 +185,7 @@ COL_DEFAULTS: Dict[str, object] = {
     "mv_ek": 0,
     "mv_ea": 0,
     "mv_prio": -1,
+    "origin_slot": -1,
 }
 assert tuple(COL_DEFAULTS) == BlockCols._fields
 
@@ -310,6 +321,32 @@ def _set(arr: jax.Array, idx: jax.Array, val) -> jax.Array:
     return arr.at[idx].set(val, mode="drop")
 
 
+def recompute_origin_slot(state: DocStateBatch) -> DocStateBatch:
+    """Rebuild the `origin_slot` cache column wholesale (brute-force
+    containment search per row; the incremental maintenance lives in
+    `_split` / `_integrate_row` / compaction's remap).
+
+    Used at boundaries where the cache cannot ride along: fused-kernel
+    unpack (the packed [NC, D, C] domain has no origin_slot column),
+    pre-origin_slot checkpoint restore, and ShardedDoc.rebalance. Docs are
+    processed sequentially (`lax.map`) so the [B, B] containment compare
+    never materializes across the whole batch."""
+
+    def one_doc(args):
+        bl, n = args
+
+        def q(c, k):
+            return _find_slot(bl, n, c, k)
+
+        found = jax.vmap(q)(bl.origin_client, bl.origin_clock)
+        B = _capacity(bl)
+        active = jnp.arange(B, dtype=I32) < n
+        return jnp.where(active & (bl.origin_client >= 0), found, -1)
+
+    os_col = jax.lax.map(one_doc, (state.blocks, state.n_blocks))
+    return state._replace(blocks=state.blocks._replace(origin_slot=os_col))
+
+
 def _split(state: DocStateBatch, i: jax.Array, off: jax.Array):
     """Split block `i` at `off` clock units; returns (state, right_slot).
 
@@ -329,6 +366,15 @@ def _split(state: DocStateBatch, i: jax.Array, off: jax.Array):
     safe_i = jnp.maximum(i, 0)
     right_i = bl.right[safe_i]
     w_right = jnp.where(do & (right_i >= 0), right_i, B)
+
+    # origin_slot repair: rows whose cached origin slot is the split block
+    # and whose origin clock landed in the new right half repoint to j;
+    # the right half's own origin is the left half (block.rs:435-478 —
+    # splice chains the right part to the left part's last id)
+    repoint = do & (bl.origin_slot == i) & (
+        bl.origin_clock >= bl.clock[safe_i] + off
+    )
+    os_col = jnp.where(repoint, j, bl.origin_slot)
 
     new_bl = BlockCols(
         client=_set(bl.client, wj, bl.client[safe_i]),
@@ -356,6 +402,7 @@ def _split(state: DocStateBatch, i: jax.Array, off: jax.Array):
         mv_ek=_set(bl.mv_ek, wj, 0),
         mv_ea=_set(bl.mv_ea, wj, 0),
         mv_prio=_set(bl.mv_prio, wj, -1),
+        origin_slot=_set(os_col, wj, safe_i),
     )
     state = DocStateBatch(
         blocks=new_bl,
@@ -414,17 +461,15 @@ def _conflict_scan(
     predicate held).
 
     Cost model (VERDICT r4 #9): each while trip is ~8 capacity-wide
-    vector ops, dominated by the unconditional case-2 origin resolution
-    (`_find_slot`, an O(B) compare). Measured width distribution on the
-    256-client concurrent-array workload: p50=32, p99=337 — the tail
-    rides this loop. Recorded next step: cache each block's origin SLOT
-    as a column (set at insert where `left_idx` IS the clean-end of the
-    origin; repaired on splits with one vector op: slots whose cached
-    origin clock falls in the split-off right half repoint to the new
-    slot; REMAPPED by compaction's permutation). That turns case 2 into
-    one gather and cuts wide-scan cost ~4x; it touches every BlockCols
-    constructor (9 sites incl. checkpoint/compaction), hence deferred to
-    a round that can re-run the full parity matrix around it."""
+    vector ops; before round 5 it was dominated by the unconditional
+    case-2 origin resolution (`_find_slot`, an O(B) compare per trip —
+    measured width distribution on the 256-client concurrent-array
+    workload: p50=32, p99=337, the tail rode this loop). Case 2 now reads
+    the `origin_slot` cache column as ONE gather: the cache is set at
+    insert (where the pre-scan `left_idx` IS the clean-end of the
+    origin), repaired on splits with one vector op, and remapped by
+    compaction's permutation (absorbed rows redirect to their chain head,
+    whose widened range still contains the origin clock)."""
     bl = state.blocks
     B = _capacity(bl)
     safe = lambda idx: jnp.maximum(idx, 0)
@@ -462,10 +507,9 @@ def _conflict_scan(
         # case 2: o anchors somewhere inside the scanned region. A slot
         # that fails to resolve (-1, e.g. a non-local origin on a shard)
         # reads as "origin precedes the scanned region" — the break case.
+        # The cached origin_slot makes this one gather (see docstring).
         o_has_origin = bl.origin_client[so] >= 0
-        o_origin_idx = _find_slot(
-            bl, state.n_blocks, bl.origin_client[so], bl.origin_clock[so]
-        )
+        o_origin_idx = bl.origin_slot[so]
         o_origin_known = o_has_origin & (o_origin_idx >= 0)
         in_before = o_origin_known & before[safe(o_origin_idx)]
         in_conflicting = o_origin_known & conflicting[safe(o_origin_idx)]
@@ -557,6 +601,11 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array):
     )
     missing = missing | anchor_missing
     linkable = linkable & ~anchor_missing
+
+    # the pre-scan left_idx IS the clean-end slot of this row's origin —
+    # cache it now, before the conflict scan overwrites left_idx with the
+    # YATA-final left neighbor
+    origin_slot_j = jnp.where(linkable & has_origin & (left_idx >= 0), left_idx, -1)
 
     safe = lambda idx: jnp.maximum(idx, 0)
 
@@ -721,6 +770,7 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array):
         mv_ek=_set(bl.mv_ek, wj, jnp.where(is_move_row, r_mv_ek, 0)),
         mv_ea=_set(bl.mv_ea, wj, jnp.where(is_move_row, r_mv_ea, 0)),
         mv_prio=_set(bl.mv_prio, wj, jnp.where(is_move_row, r_mv_prio, -1)),
+        origin_slot=_set(bl.origin_slot, wj, origin_slot_j),
     )
     # a map row that became its chain's tail is the key's new live value;
     # the previous winner — its immediate left — gets tombstoned (parity:

@@ -36,7 +36,10 @@ __all__ = ["save_state", "load_state", "save_ingestor", "load_ingestor"]
 
 # 2: BlockCols gained move columns (moved, mv_sc..mv_prio) and the encoder
 #    sidecar gained saw_move — format-1 checkpoints cannot be restored
-_FORMAT = 2
+# 3: BlockCols gained the origin_slot cache column. Format-2 checkpoints
+#    restore fine: the cache is derived state, recomputed at load
+_FORMAT = 3
+_READABLE_FORMATS = (2, 3)
 
 
 def _state_to_numpy(state: DocStateBatch) -> dict:
@@ -48,19 +51,25 @@ def _state_to_numpy(state: DocStateBatch) -> dict:
 
 
 def _state_from_numpy(flat: dict) -> DocStateBatch:
-    blocks = BlockCols(
-        **{
-            k.split(".", 1)[1]: jnp.asarray(v)
-            for k, v in flat.items()
-            if k.startswith("blocks.")
-        }
-    )
-    return DocStateBatch(
-        blocks=blocks,
+    cols = {
+        k.split(".", 1)[1]: jnp.asarray(v)
+        for k, v in flat.items()
+        if k.startswith("blocks.")
+    }
+    needs_cache = "origin_slot" not in cols  # format-2 checkpoint
+    if needs_cache:
+        cols["origin_slot"] = jnp.full_like(cols["client"], -1)
+    state = DocStateBatch(
+        blocks=BlockCols(**cols),
         start=jnp.asarray(flat["start"]),
         n_blocks=jnp.asarray(flat["n_blocks"]),
         error=jnp.asarray(flat["error"]),
     )
+    if needs_cache:
+        from ytpu.models.batch_doc import recompute_origin_slot
+
+        state = recompute_origin_slot(state)
+    return state
 
 
 def _enc_sidecar(enc: BatchEncoder) -> dict:
@@ -273,7 +282,7 @@ def _save(path: str, state: DocStateBatch, sidecar: dict) -> None:
 def _load(path: str) -> Tuple[DocStateBatch, dict]:
     with open(os.path.join(path, "host.pkl"), "rb") as f:
         side = pickle.load(f)
-    if side.get("format") != _FORMAT:
+    if side.get("format") not in _READABLE_FORMATS:
         raise ValueError(f"unsupported checkpoint format {side.get('format')}")
     if side.get("saved_with") == "orbax":
         import orbax.checkpoint as ocp

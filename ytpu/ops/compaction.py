@@ -163,6 +163,10 @@ def _compact_one(state: DocStateBatch) -> DocStateBatch:
         parent=remap(bl.parent),
         head=remap(bl.head),
         moved=remap(bl.moved),
+        # origin_slot: absorbed rows redirect to their chain head via
+        # old2new; the head's widened clock range still contains the
+        # origin id, so containment (the cache contract) is preserved
+        origin_slot=remap(bl.origin_slot),
     )
     n_new = jnp.sum(keep.astype(I32))
     # kept rows first (slot order preserved), dropped rows after
@@ -233,6 +237,7 @@ def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
         OC,
         OF,
         OK,
+        OS,
         PA,
         RC,
         RF,
@@ -261,6 +266,8 @@ def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
     ok = jnp.where(convert & gc_ranges, 0, cols[OK])
     rc = jnp.where(convert & gc_ranges, -1, cols[RC])
     rk = jnp.where(convert & gc_ranges, 0, cols[RK])
+    # origin cleared -> cached origin slot cleared with it (cache contract)
+    os_c = jnp.where(convert & gc_ranges, -1, cols[OS])
 
     cl, ck, ln, lt, rt = cols[CL], cols[CK], cols[LN], cols[LT], cols[RT]
 
@@ -383,6 +390,7 @@ def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
             pack(cols[MEK], 0),  # MEK
             pack(cols[MEA], 0),  # MEA
             pack(cols[MPR], -1),  # MPR
+            pack(remap(os_c), -1),  # OS (slot index: defrag remap)
         ]
     )
     start = meta[M_START]
@@ -394,9 +402,9 @@ def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
 @partial(jax.jit, static_argnums=(2, 3), donate_argnums=(0, 1))
 def compact_packed(cols, meta, unit_refs: bool = False, gc_ranges: bool = False):
     """Squash + GC + defragment a packed [NC, D, C] state (fused-kernel
-    domain) without materializing the 25-column unpacked schema — the
-    full-trace replay compacts at high-water marks where holding both
-    layouts would double HBM."""
+    domain, NC=26 incl. the origin_slot plane) without materializing the
+    unpacked schema — the full-trace replay compacts at high-water marks
+    where holding both layouts would double HBM."""
     f = partial(_compact_packed_one, unit_refs=unit_refs, gc_ranges=gc_ranges)
     return jax.vmap(f, in_axes=(1, 0), out_axes=(1, 0))(cols, meta)
 
@@ -413,6 +421,7 @@ def grow_packed(cols, meta, new_capacity: int):
         MSC,
         MV,
         OC,
+        OS,
         PA,
         RC,
         RF,
@@ -429,7 +438,11 @@ def grow_packed(cols, meta, new_capacity: int):
     # move ownership/bound clients/priority (COL_DEFAULTS parity)
     neg = (
         jnp.zeros((NC_,), I32)
-        .at[jnp.array([CL, OC, RC, LT, RT, RF, KEY, PA, HD, MV, MSC, MEC, MPR])]
+        .at[
+            jnp.array(
+                [CL, OC, RC, LT, RT, RF, KEY, PA, HD, MV, MSC, MEC, MPR, OS]
+            )
+        ]
         .set(-1)
     )
     pad = pad + neg[:, None, None]

@@ -73,8 +73,14 @@ I32 = jnp.int32
     MEK,  # move rows: range-end id clock
     MEA,  # move rows: end assoc
     MPR,  # move rows: conflict priority
-) = range(25)
-NC = 25
+    OS,  # cached origin slot (batch_doc.BlockCols.origin_slot). The kernel
+    # itself neither reads nor writes this plane — it rides the packed
+    # state so the XLA chunk lane (replay._xla_chunk_step) carries the
+    # live cache through pack/unpack at zero cost; kernel-created rows
+    # leave it stale, so the fused lane recomputes it wholesale at
+    # unpack (apply_update_stream_fused).
+) = range(26)
+NC = 26
 
 # meta columns in the packed [D, 8] array (padded to a TPU-friendly lane dim)
 # M_MDIRTY: move ownership must be recomputed for this doc at step end (a
@@ -116,6 +122,7 @@ def pack_state(state: DocStateBatch) -> Tuple[jax.Array, jax.Array]:
             bl.mv_ek,
             bl.mv_ea,
             bl.mv_prio,
+            bl.origin_slot,
         ]
     )  # [NC, D, C]
     D = state.start.shape[0]
@@ -157,6 +164,7 @@ def unpack_state(
         mv_ek=cols[MEK],
         mv_ea=cols[MEA],
         mv_prio=cols[MPR],
+        origin_slot=cols[OS],
     )
     return DocStateBatch(
         blocks=blocks,
@@ -902,12 +910,12 @@ def _run(
         input_output_aliases={3: 0, 4: 1},
         interpret=interpret,
         # the doc tile ([NC, d_block, C] i32) plus the conflict-scan's
-        # [d_block, C] temporaries are the VMEM tenants. With NC=25 (move
-        # columns included) a d_block=128/C=2048 tile is ~26MB + scan
-        # temporaries; the pre-move measured sweet spot (d_block=128 at
-        # ~56MB total under NC=17) now lands near the 64MB limit, so
-        # re-measure on hardware — d_block<=96 is the safe default at
-        # C=2048 if allocation fails
+        # [d_block, C] temporaries are the VMEM tenants. With NC=26 (move
+        # columns + the pass-through origin_slot plane) a d_block=128/
+        # C=2048 tile is ~27MB + scan temporaries; the pre-move measured
+        # sweet spot (d_block=128 at ~56MB total under NC=17) now lands
+        # near the 64MB limit, so re-measure on hardware — d_block<=96 is
+        # the safe default at C=2048 if allocation fails
         compiler_params=None
         if interpret
         else pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024),
@@ -922,6 +930,7 @@ def apply_update_stream_fused(
     d_block: int = 32,
     interpret: bool = False,
     guard: bool = True,
+    refresh_cache: bool = True,
     _debug_phases: int = 3,
     _debug_row_phase: int = 4,
 ) -> DocStateBatch:
@@ -932,7 +941,10 @@ def apply_update_stream_fused(
     `batch_doc._recompute_moves`, parity: moving.rs:149-227).
 
     `guard` is kept for call-site compatibility; it no longer excludes
-    anything. `_debug_phases` / `_debug_row_phase` truncate the kernel for
+    anything. `refresh_cache=False` skips the O(D*B^2) origin_slot
+    recompute at unpack — pass it when chaining further fused applies
+    that never read the cache, and recompute once at the end.
+    `_debug_phases` / `_debug_row_phase` truncate the kernel for
     hardware bisection only (see `_kernel`); never pass them in production
     — partial kernels corrupt state by design."""
     del guard
@@ -952,7 +964,16 @@ def apply_update_stream_fused(
         cols, meta, (rows, dels, client_rank), d_block, interpret,
         _debug_phases, _debug_row_phase,
     )
-    return unpack_state(cols, meta, state)
+    out = unpack_state(cols, meta, state)
+    if not refresh_cache:
+        # chained fused applies never read the cache plane — the caller
+        # recomputes once at its final unpack boundary (FusedReplay shape)
+        return out
+    # the kernel does not maintain the origin_slot cache plane (see OS):
+    # rebuild it so downstream XLA-lane applies read a valid cache
+    from ytpu.models.batch_doc import recompute_origin_slot
+
+    return recompute_origin_slot(out)
 
 
 def _register_programs():

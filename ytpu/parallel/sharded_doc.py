@@ -104,8 +104,10 @@ from ytpu.models.batch_doc import (
     _clean_end,
     _clean_start,
     _conflict_scan,
+    _find_slot,
     _set,
     init_state,
+    recompute_origin_slot,
 )
 
 I32 = jnp.int32
@@ -243,6 +245,21 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
 
     anchor_missing = (linkable & (a_oc >= 0) & (left_idx < 0)) | (
         linkable & (a_rc >= 0) & (right_idx < 0)
+    )
+
+    # origin_slot cache: the containing slot of the STORED (wire-true)
+    # origin — resolved with one containment find at insert time, NOT per
+    # scan trip. The localized anchor (a_*) cannot stand in for it:
+    # boundary-resolved rows are re-issued with a_o = the YATA-final left
+    # neighbor's last id, which differs from s_o even when the true origin
+    # is shard-local (code-review r5). A non-local origin resolves to -1,
+    # which the shared conflict scan reads as "origin precedes the scanned
+    # region" — the same break case the replaced per-trip find returned.
+    origin_slot_j = _find_slot(
+        state.blocks,
+        state.n_blocks,
+        jnp.where(linkable & has_origin, s_oc, -2),
+        s_ok,
     )
 
     safe = lambda idx: jnp.maximum(idx, 0)
@@ -387,6 +404,7 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
         mv_ek=_set(bl.mv_ek, wj, jnp.where(is_move_row, r_mv_ek, 0)),
         mv_ea=_set(bl.mv_ea, wj, jnp.where(is_move_row, r_mv_ea, 0)),
         mv_prio=_set(bl.mv_prio, wj, jnp.where(is_move_row, r_mv_prio, -1)),
+        origin_slot=_set(bl.origin_slot, wj, origin_slot_j),
     )
     # a map row that became its chain's tail is the key's new live value;
     # the previous winner — its immediate left — gets tombstoned (parity:
@@ -1949,12 +1967,17 @@ class ShardedDoc:
                     )
                 li += len(chain)
             n_blocks[s] = li
+
         self.state = DocStateBatch(
             blocks=BlockCols(**{n: jnp.asarray(a) for n, a in arrays.items()}),
             start=jnp.asarray(start),
             n_blocks=jnp.asarray(n_blocks),
             error=jnp.zeros(self.S, I32),
         )
+        # the re-cut rewrote every slot index (and the row dicts copied the
+        # OLD cached values): rebuild the origin_slot cache with the
+        # canonical containment recompute; non-local origins resolve -1
+        self.state = recompute_origin_slot(self.state)
         self.capacity = cap
         self._n_rows = n_blocks.astype(np.int64)
         self._invalidate()
