@@ -58,21 +58,36 @@ D_BLOCK = min(128, N_DOCS)  # [14, 128, 2048] i32 tile = 14MB + scan temps
 ROWS_PER_STEP = 4
 DELS_PER_STEP = 8
 
-# full-trace metric: the whole 259,778-op B4 editing session with capacity
-# growth + compaction in the loop (VERDICT r1 #2)
+# full-trace metric: the whole 259,778-op B4 editing session with
+# compaction in the loop (VERDICT r1 #2). The defaults are the
+# empirically SAFE envelope measured on the tunneled v5e (2026-08-01):
+# 1024-doc integrate programs and the growth path (capacity-retrace at
+# 512x65536) both CRASH the TPU worker process, while 256 docs at a
+# fixed 65536 capacity completed the full trace (peak_blocks=51,555 —
+# 32768 is insufficient; growth stays disabled by matching CAP0=MAXCAP).
+# See benches/flagship_bisect*.py for the attribution ladder.
 N_UPDATES = int(os.environ.get("YTPU_BENCH_UPDATES", "0")) or None  # None=all
-FULL_DOCS = int(os.environ.get("YTPU_BENCH_FULL_DOCS", "1024"))
+FULL_DOCS = int(os.environ.get("YTPU_BENCH_FULL_DOCS", "256"))
 FULL_CHUNK = int(os.environ.get("YTPU_BENCH_FULL_CHUNK", "8192"))
-FULL_CAP0 = int(os.environ.get("YTPU_BENCH_FULL_CAP0", "8192"))
+FULL_CAP0 = int(os.environ.get("YTPU_BENCH_FULL_CAP0", str(1 << 16)))
 FULL_MAXCAP = int(os.environ.get("YTPU_BENCH_FULL_MAXCAP", str(1 << 16)))
 FULL_DBLOCK = int(os.environ.get("YTPU_BENCH_FULL_DBLOCK", "8"))
+# warmup chunks before the timed full pass: enough to hit every compiled
+# program when growth is disabled (decode, chunk step, compaction —
+# compaction is warmed explicitly); a FULL warmup replay would double the
+# ~22-min capture and overrun the device-phase budget
+FULL_WARMUP_CHUNKS = int(os.environ.get("YTPU_BENCH_FULL_WARMUP_CHUNKS", "2"))
 
 TRACE_PATH = "/root/reference/assets/bench-input/b4-editing-trace.bin"
 LOG_CACHE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "benches", "data", "b4_log.pkl.gz"
 )
 
-DEVICE_TIMEOUT = float(os.environ.get("YTPU_BENCH_DEVICE_TIMEOUT", "2400"))
+# device-phase child budget: the flagship full-B4 capture alone is ~27
+# min at the safe 256x65536 envelope (prefix warmup + 22-min timed pass),
+# so the old 2400s default starved it; partial flushes survive an outer
+# kill either way
+DEVICE_TIMEOUT = float(os.environ.get("YTPU_BENCH_DEVICE_TIMEOUT", "3600"))
 CFG_DOCS = int(os.environ.get("YTPU_BENCH_CFG_DOCS", "2048"))
 CFG5_DOCS = int(os.environ.get("YTPU_BENCH_CFG5_DOCS", "10240"))
 
@@ -227,7 +242,12 @@ def device_replay(log, expect: str):
         lens = jnp.asarray(lens_np)
         stream, flags = decode(buf, lens)
         state = apply_update_stream_fused(
-            state, stream, rank, d_block=D_BLOCK, guard=False, interpret=interpret
+            state, stream, rank, d_block=D_BLOCK, guard=False,
+            interpret=interpret,
+            # kernel-throughput metric: the origin_slot recompute is
+            # downstream-XLA plumbing, not integrate work — keep it out
+            # of the timed window (the text readback never needs it)
+            refresh_cache=False,
         )
         return state, flags
 
@@ -307,6 +327,9 @@ def device_step_latency(log, n_steps: int = 200, n_docs: int = 256):
     }
 
 
+_PREFIX_ORACLE: dict = {}
+
+
 def device_replay_full(log, expect, lane="fused"):
     """Full-stream chunked replay with compaction + growth in the timed
     loop (ytpu/models/replay.py). `lane="fused"` drives the Pallas kernel;
@@ -327,12 +350,25 @@ def device_replay_full(log, expect, lane="fused"):
 
     docs = FULL_DOCS
     last_err = None
+    # warmup policy: a FULL_WARMUP_CHUNKS-chunk prefix triggers every
+    # compile the timed pass will hit when growth is disabled (the
+    # default: CAP0 == MAXCAP, so chunk shapes never change; compaction
+    # is warmed explicitly below) — a full warmup replay would double the
+    # ~22-min capture and overrun the device-phase budget. When an env
+    # override RE-ENABLES growth, the prefix cannot visit the grown-
+    # capacity programs, so fall back to the full warmup replay rather
+    # than let re-compiles land inside the timed pass.
+    full_warmup = FULL_MAXCAP > FULL_CAP0
+    prefix = log if full_warmup else log[: FULL_WARMUP_CHUNKS * FULL_CHUNK]
+    if full_warmup:
+        expect_prefix = expect
+    else:
+        key = (id(log), len(prefix))
+        if _PREFIX_ORACLE.get("key") != key:  # both lanes share one replay
+            _PREFIX_ORACLE.update(key=key, text=host_replay(prefix)[1])
+        expect_prefix = _PREFIX_ORACLE["text"]
     for attempt in range(2):
         try:
-            # warmup pass: triggers every compile the timed pass will hit
-            # (chunk shapes are fixed; capacity growth re-traces per size,
-            # and the growth schedule is deterministic, so a full warmup
-            # replay visits exactly the same set of compiled programs)
             warm = FusedReplay(
                 n_docs=docs,
                 plan=plan,
@@ -343,14 +379,18 @@ def device_replay_full(log, expect, lane="fused"):
                 interpret=interpret,
                 lane=lane,
             )
-            warm.run(log)
+            warm.run(prefix)
             got = warm.get_string(0)
-            if got != expect:
+            if got != expect_prefix:
                 raise Mismatch(
-                    f"full-replay text mismatch: {got[:50]!r} != {expect[:50]!r}"
+                    f"warmup-prefix text mismatch: "
+                    f"{got[:50]!r} != {expect_prefix[:50]!r}"
                 )
-            if warm.get_string(docs - 1) != expect:
-                raise Mismatch("full-replay text mismatch in last doc")
+            from ytpu.ops.compaction import compact_packed
+
+            warm.cols, warm.meta = compact_packed(
+                warm.cols, warm.meta, unit_refs=True, gc_ranges=True
+            )
             del warm
 
             rep = FusedReplay(
@@ -366,6 +406,15 @@ def device_replay_full(log, expect, lane="fused"):
             t0 = time.perf_counter()
             stats = rep.run(log)
             dt = time.perf_counter() - t0
+            # parity check AFTER the clock stops (readbacks don't pollute
+            # the measurement; a mismatch still voids it via Mismatch)
+            got = rep.get_string(0)
+            if got != expect:
+                raise Mismatch(
+                    f"full-replay text mismatch: {got[:50]!r} != {expect[:50]!r}"
+                )
+            if rep.get_string(docs - 1) != expect:
+                raise Mismatch("full-replay text mismatch in last doc")
             chunk_ms = sorted(1e3 * s for s in stats.chunk_seconds)
             p99 = chunk_ms[min(len(chunk_ms) - 1, int(0.99 * len(chunk_ms)))]
             return {
@@ -470,12 +519,57 @@ def _device_phase_child(in_path: str, out_path: str) -> None:
         if devs[0].platform == "cpu":
             jax.clear_caches()
 
-    # Capture order is crash-risk order: the XLA-lane phases (configs,
-    # un-fused full replay) are known-good on this backend and land first;
-    # the Pallas fused lane runs LAST because a Mosaic miscompile can
-    # crash the TPU worker process and take the tunnel down for hours —
-    # everything flushed before that survives (observed round 3).
+    # Capture order is value-at-risk order (revised after the round-5
+    # windows): the FLAGSHIP full-B4 replay goes absolutely first — in
+    # round 4/5 the micro+config phases burned the 2400s child budget
+    # before the flagship phase ever started. Then latency (cheap,
+    # serving-SLO evidence), configs, sp, micro; the Pallas fused lane
+    # stays LAST because a Mosaic miscompile
+    # can crash the TPU worker and take the tunnel down for hours
+    # (observed round 3) — everything flushed before it survives.
+    if devs[0].platform == "cpu" and N_UPDATES is None:
+        # CPU rehearsals prove the capture plumbing, not the number —
+        # run the flagship phase only when YTPU_BENCH_UPDATES truncates
+        # the trace, else it would starve every later phase
+        result["xla_full_error"] = "skipped: cpu rehearsal on untruncated trace"
+    else:
+        try:
+            xla = device_replay_full(job["log"], job["expect"], lane="xla")
+            result.update({f"xla_{k}": v for k, v in xla.items()})
+        except Exception as e:
+            result["xla_full_error"] = f"{type(e).__name__}: {e}"[:300]
+    flush()
+    phase_gc()
+    try:
+        # p50/p99 per-apply dispatch latency (BASELINE metric 2), right
+        # after the flagship so serving-SLO evidence survives short windows
+        result.update(device_step_latency(job["log"]))
+    except Exception as e:
+        result["latency_error"] = f"{type(e).__name__}: {e}"[:300]
+    flush()
+    phase_gc()
     _device_configs(result, flush)
+    phase_gc()
+    try:
+        # sequence-parallel axis (SURVEY §5.7; VERDICT r3 #6): B4-prefix
+        # replay on a 1- vs 8-shard ShardedDoc
+        import importlib.util as _ilu2
+
+        _sp_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benches", "sp_axis.py"
+        )
+        _sp_spec = _ilu2.spec_from_file_location("ytpu_bench_sp", _sp_path)
+        _sp = _ilu2.module_from_spec(_sp_spec)
+        _sp_spec.loader.exec_module(_sp)
+        sp_log, sp_expect = _sp.b4_prefix_updates(1200)
+        sp = {}
+        for n in (1, 8):
+            sp[f"shards_{n}"] = _sp.run_shards(sp_log, sp_expect, n)
+            result["sp"] = sp
+            flush()
+    except Exception as e:
+        result["sp_error"] = f"{type(e).__name__}: {e}"[:300]
+    flush()
     phase_gc()
     if devs[0].platform == "cpu":
         # the 512-doc decode-machine programs take tens of minutes in the
@@ -508,43 +602,6 @@ def _device_phase_child(in_path: str, out_path: str) -> None:
             result.setdefault("micro_device", {})["error"] = (
                 f"{type(e).__name__}: {e}"[:300]
             )
-    flush()
-    phase_gc()
-    try:
-        xla = device_replay_full(job["log"], job["expect"], lane="xla")
-        result.update({f"xla_{k}": v for k, v in xla.items()})
-    except Exception as e:
-        result["xla_full_error"] = f"{type(e).__name__}: {e}"[:300]
-    flush()
-    phase_gc()
-    try:
-        # p50/p99 per-apply dispatch latency (BASELINE metric 2). AFTER the
-        # flagship capture: 200 serial blocking round-trips on a flaky
-        # tunnel must not burn the window before xla_full lands.
-        result.update(device_step_latency(job["log"]))
-    except Exception as e:
-        result["latency_error"] = f"{type(e).__name__}: {e}"[:300]
-    flush()
-    phase_gc()
-    try:
-        # sequence-parallel axis (SURVEY §5.7; VERDICT r3 #6): B4-prefix
-        # replay on a 1- vs 8-shard ShardedDoc
-        import importlib.util as _ilu2
-
-        _sp_path = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "benches", "sp_axis.py"
-        )
-        _sp_spec = _ilu2.spec_from_file_location("ytpu_bench_sp", _sp_path)
-        _sp = _ilu2.module_from_spec(_sp_spec)
-        _sp_spec.loader.exec_module(_sp)
-        sp_log, sp_expect = _sp.b4_prefix_updates(1200)
-        sp = {}
-        for n in (1, 8):
-            sp[f"shards_{n}"] = _sp.run_shards(sp_log, sp_expect, n)
-            result["sp"] = sp
-            flush()
-    except Exception as e:
-        result["sp_error"] = f"{type(e).__name__}: {e}"[:300]
     flush()
     phase_gc()
     if os.environ.get("YTPU_BENCH_FUSED", "1") != "0":
@@ -703,9 +760,15 @@ def main():
         rate = len(log) * docs / res[f"{prefix}full_dt"]
         out["value"] = round(rate, 1)
         out["lane"] = lane_name
+        grew = res.get(f"{prefix}growths", 0) > 0
+        cap_note = (
+            "+ growth"
+            if grew
+            else f"(fixed {res.get(f'{prefix}final_capacity', FULL_MAXCAP)} capacity)"
+        )
         out["unit"] = (
             f"updates/s over {docs}-doc batch, full {trace} with "
-            f"device decode + compaction + growth ({lane_name} lane)"
+            f"device decode + compaction {cap_note} ({lane_name} lane)"
         )
         out["vs_baseline"] = round(rate / baseline, 2)
         out["vs_py_oracle"] = round(rate / host_rate, 2)

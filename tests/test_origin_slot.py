@@ -34,21 +34,19 @@ from ytpu.models.batch_doc import (
 )
 
 
-def _invariant_violations(state, strict: bool = True):
+def _invariant_violations(state):
     """Compare the maintained origin_slot column against a brute-force
-    recompute.  strict=True demands exact equality on every active slot;
-    strict=False permits maintained == -1 where the recompute found a slot
-    (the unlinked-row carve-out)."""
+    recompute, demanding exact equality on every active slot.  (The
+    unlinked-row carve-out — maintained -1 where a recompute would
+    resolve — is covered by test_pallas_kernel.assert_same_state, whose
+    workloads include GC carriers; these fixtures contain none.)"""
     recomputed = recompute_origin_slot(state)
     got = np.asarray(state.blocks.origin_slot)
     want = np.asarray(recomputed.blocks.origin_slot)
     D, B = got.shape
     n = np.asarray(state.n_blocks)
     active = np.arange(B)[None, :] < n[:, None]
-    if strict:
-        bad = active & (got != want)
-    else:
-        bad = active & (got != want) & (got != -1)
+    bad = active & (got != want)
     return [
         (int(d), int(s), int(got[d, s]), int(want[d, s]))
         for d, s in zip(*np.nonzero(bad))

@@ -43,12 +43,30 @@ def assert_same_state(a, b):
     for name in a.blocks._fields:
         va, vb = np.asarray(getattr(a.blocks, name)), np.asarray(getattr(b.blocks, name))
         if name == "origin_slot":
-            # cache contract: the maintained column may hold -1 on rows
-            # that never linked (GC carriers); the recompute resolves
-            # those too. Anywhere the XLA lane cached a slot, the fused
-            # recompute must agree exactly.
+            # cache contract (batch_doc.BlockCols.origin_slot): anywhere
+            # the XLA lane cached a slot, the fused recompute must agree
+            # exactly; and the XLA lane may hold -1 ONLY on rows that
+            # never sequence-linked (GC carriers, error-flagged docs) —
+            # a cache-wipe regression must not pass as "conservative".
             assert np.array_equal(np.where(va >= 0, va, vb), vb), (
                 "column origin_slot diverged"
+            )
+            kind = np.asarray(a.blocks.kind)
+            oc = np.asarray(a.blocks.origin_client)
+            nb = np.asarray(a.n_blocks)
+            err = np.asarray(a.error)
+            D, B = va.shape
+            active = np.arange(B)[None, :] < nb[:, None]
+            from ytpu.core.content import BLOCK_GC
+
+            must_cache = (
+                active
+                & (oc >= 0)
+                & (kind != BLOCK_GC)
+                & (err[:, None] == 0)
+            )
+            assert np.all(va[must_cache] >= 0), (
+                "origin_slot cache wiped on linked rows"
             )
             continue
         assert np.array_equal(va, vb), f"column {name} diverged"

@@ -327,10 +327,11 @@ def recompute_origin_slot(state: DocStateBatch) -> DocStateBatch:
     `_split` / `_integrate_row` / compaction's remap).
 
     Used at boundaries where the cache cannot ride along: fused-kernel
-    unpack (the packed [NC, D, C] domain has no origin_slot column),
-    pre-origin_slot checkpoint restore, and ShardedDoc.rebalance. Docs are
-    processed sequentially (`lax.map`) so the [B, B] containment compare
-    never materializes across the whole batch."""
+    unpack (the packed domain CARRIES an OS plane, but the kernel itself
+    never maintains it — see integrate_kernel.OS), pre-origin_slot
+    checkpoint restore, and ShardedDoc.rebalance. Docs are processed
+    sequentially (`lax.map`) so the [B, B] containment compare never
+    materializes across the whole batch."""
 
     def one_doc(args):
         bl, n = args
