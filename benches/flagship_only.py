@@ -1,4 +1,10 @@
-"""Run ONLY the flagship full-B4 XLA-lane device replay (+ latency).
+"""Run ONLY the flagship full-B4 device replay (+ latency).
+
+Lane selection: YTPU_FLAGSHIP_LANE=xla (default) | fused. The fused lane
+became silicon-viable on 2026-08-01 (aliased-output init fix in
+integrate_kernel._kernel; byte-exact vs the XLA lane on hardware —
+benches/rung9_bisect.json); mind VMEM when choosing YTPU_BENCH_FULL_DBLOCK
+(26 * d_block * capacity * 4B must stay well under the 64MB limit).
 
 Contingency runner for a short tunnel window: bench.py's device child
 spends its budget on configs + micro lanes before the flagship phase; if
@@ -58,16 +64,18 @@ def main() -> int:
             json.dump(res, f, indent=1)
 
     flush()
+    lane = os.environ.get("YTPU_FLAGSHIP_LANE", "xla")
+    res["lane"] = lane
     try:
-        xla = bench.device_replay_full(log, expect, lane="xla")
-        res.update({f"xla_{k}": v for k, v in xla.items()})
-        rate = len(log) * xla["full_docs"] / xla["full_dt"]
-        res["xla_full_updates_per_sec"] = round(rate, 1)
+        stats = bench.device_replay_full(log, expect, lane=lane)
+        res.update({f"{lane}_{k}": v for k, v in stats.items()})
+        rate = len(log) * stats["full_docs"] / stats["full_dt"]
+        res[f"{lane}_full_updates_per_sec"] = round(rate, 1)
         if native_rate:
             res["vs_native"] = round(rate / native_rate, 2)
         res["vs_py_oracle"] = round(rate / host_rate, 2)
     except Exception as e:  # noqa: BLE001 — record, keep the window
-        res["xla_full_error"] = f"{type(e).__name__}: {e}"[:300]
+        res[f"{lane}_full_error"] = f"{type(e).__name__}: {e}"[:300]
     flush()
     try:
         res.update(bench.device_step_latency(log))

@@ -1,0 +1,89 @@
+"""Fused vs XLA lane on an identical long-B4-prefix workload (hardware).
+
+The fused kernel became silicon-correct on 2026-08-01 (aliased-output
+init fix; byte-exact vs the XLA lane, benches/rung9_bisect.json), but a
+full-B4 tile needs C=65536 — a ~54MB block the axon Pallas backend
+refuses/hangs on. C=32768 (27MB) is in the proven-legal family and holds
+a deep prefix of the trace, so the honest fused evidence is a same-config
+ratio: both lanes replay the SAME prefix at docs x 32768, fused first
+(fresh worker), then xla.
+
+Usage: python benches/fused_vs_xla_prefix.py [n_updates] [docs]
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+OUT = os.path.join(HERE, "benches", "fused_vs_xla_prefix.json")
+state: dict = {}
+
+
+def flush():
+    with open(OUT, "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def main() -> int:
+    n_updates = int(sys.argv[1]) if len(sys.argv) > 1 else 160_000
+    docs = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+
+    os.environ.setdefault("YTPU_BENCH_FULL_DOCS", str(docs))
+    os.environ.setdefault("YTPU_BENCH_FULL_CAP0", "32768")
+    os.environ.setdefault("YTPU_BENCH_FULL_MAXCAP", "32768")
+    os.environ.setdefault("YTPU_BENCH_FULL_DBLOCK", "8")
+    os.environ.setdefault("YTPU_FUSED_VMEM_MB", "100")
+
+    spec = importlib.util.spec_from_file_location(
+        "ytpu_bench_main", os.path.join(HERE, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    full_log, _, trace = bench.load_full_log()
+    log = full_log[:n_updates]
+    _, expect = bench.host_replay(log)
+
+    import jax
+
+    state.update(
+        platform=jax.devices()[0].platform,
+        trace=f"{trace}[:{n_updates}]",
+        docs=docs,
+        capacity=32768,
+    )
+    flush()
+
+    for lane in ("fused", "xla"):
+        t0 = time.time()
+        try:
+            r = bench.device_replay_full(log, expect, lane=lane)
+            rate = len(log) * r["full_docs"] / r["full_dt"]
+            state[lane] = {
+                "updates_per_sec": round(rate, 1),
+                **{k: (round(v, 2) if isinstance(v, float) else v) for k, v in r.items()},
+            }
+        except Exception as e:  # noqa: BLE001
+            state[lane] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        state[lane]["wall_s"] = round(time.time() - t0, 1)
+        flush()
+    if "updates_per_sec" in state.get("fused", {}) and "updates_per_sec" in state.get(
+        "xla", {}
+    ):
+        state["fused_vs_xla"] = round(
+            state["fused"]["updates_per_sec"] / state["xla"]["updates_per_sec"], 2
+        )
+    flush()
+    print(json.dumps(state))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,155 @@
+"""Minimal repro: 3D-ref plane read-modify-write on the axon Mosaic path.
+
+The fused kernel stores state as one [NC, DB, C] i32 ref and updates
+plane i with `ref[i] = where(mask, val, ref[i])`. On silicon (TPU v5
+lite via axon) this corrupts the plane's tail 128-lane group and
+neighboring planes even when mask is all-False (benches/rung9_shapes
+.json); interpret mode is byte-exact. Three candidate idioms per case:
+
+  a_static3d : ref[i] = where(mask, val, ref[i])          (kernel today)
+  b_loadstore: pl.load/pl.store with explicit (i, :, :)
+  c_flat2d   : state as [NC*DB, C] 2D ref, row-offset math
+
+Each case writes ONE plane of a known pattern with an all-False mask —
+the output must equal the input exactly.  Run:
+  python benches/plane_rmw_repro.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+OUT = os.path.join(HERE, "benches", "plane_rmw_repro.json")
+state: dict = {"cases": {}}
+
+
+def flush():
+    with open(OUT, "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+
+    state["platform"] = jax.devices()[0].platform
+    flush()
+
+    NC, DB, C = 26, 8, 512
+    I32 = jnp.int32
+    x_np = (
+        np.arange(NC * DB * C, dtype=np.int32).reshape(NC, DB, C) % 997
+    )
+
+    def run_case(name, kernel, shape):
+        state["cases"][name] = {"status": "running"}
+        flush()
+        t0 = time.time()
+        try:
+            x = jnp.asarray(x_np.reshape(shape))
+            out = pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(shape, I32),
+                input_output_aliases={0: 0},
+            )(x)
+            got = np.asarray(out).reshape(NC, DB, C)
+            bad = np.nonzero(got != x_np)
+            n_bad = int(bad[0].size)
+            first = (
+                [int(bad[k][0]) for k in range(3)] if n_bad else None
+            )
+            state["cases"][name] = {
+                "status": "ok" if n_bad == 0 else "CORRUPT",
+                "n_bad": n_bad,
+                "first_bad_ncd": first,
+                "seconds": round(time.time() - t0, 1),
+            }
+        except Exception as e:  # noqa: BLE001
+            state["cases"][name] = {
+                "status": "fail",
+                "error": f"{type(e).__name__}: {e}"[:250],
+                "seconds": round(time.time() - t0, 1),
+            }
+        flush()
+
+    iota_c_ = None
+
+    # --- a: the kernel's exact idiom: masked all-False RMW of plane 7 ----
+    def k_a(x_ref, o_ref):
+        iota_c = jax.lax.broadcasted_iota(I32, (DB, C), 1)
+        idx = jnp.full((DB,), -1, I32)  # invalid slot -> mask all False
+        active = jnp.ones((DB,), bool)
+        mask = (iota_c == idx[:, None]) & (
+            active.astype(I32)[:, None] > 0
+        ) & (idx[:, None] >= 0)
+        val = jnp.zeros((DB,), I32)
+        o_ref[7] = jnp.where(mask, val[:, None], x_ref[7])
+        # copy every other plane through unchanged, same as the kernel's
+        # aliased in-place update leaves them
+        for i in range(NC):
+            if i != 7:
+                o_ref[i] = x_ref[i]
+
+    run_case("a_static3d_allfalse", k_a, (NC, DB, C))
+
+    # --- a2: same but mask hits slot 0 (a real write) ---------------------
+    def k_a2(x_ref, o_ref):
+        iota_c = jax.lax.broadcasted_iota(I32, (DB, C), 1)
+        idx = jnp.zeros((DB,), I32)
+        active = jnp.ones((DB,), bool)
+        mask = (iota_c == idx[:, None]) & (
+            active.astype(I32)[:, None] > 0
+        ) & (idx[:, None] >= 0)
+        val = jnp.full((DB,), 555, I32)
+        o_ref[7] = jnp.where(mask, val[:, None], x_ref[7])
+        for i in range(NC):
+            if i != 7:
+                o_ref[i] = x_ref[i]
+
+    def check_a2(got):
+        want = x_np.copy()
+        want[7, :, 0] = 555
+        return got, want
+
+    state["cases"]["a2_static3d_slot0"] = {"status": "running"}
+    flush()
+    t0 = time.time()
+    try:
+        x = jnp.asarray(x_np)
+        out = pl.pallas_call(
+            k_a2,
+            out_shape=jax.ShapeDtypeStruct((NC, DB, C), I32),
+            input_output_aliases={0: 0},
+        )(x)
+        got = np.asarray(out)
+        want = x_np.copy()
+        want[7, :, 0] = 555
+        bad = np.nonzero(got != want)
+        state["cases"]["a2_static3d_slot0"] = {
+            "status": "ok" if bad[0].size == 0 else "CORRUPT",
+            "n_bad": int(bad[0].size),
+            "first_bad_ncd": (
+                [int(bad[k][0]) for k in range(3)] if bad[0].size else None
+            ),
+            "seconds": round(time.time() - t0, 1),
+        }
+    except Exception as e:  # noqa: BLE001
+        state["cases"]["a2_static3d_slot0"] = {
+            "status": "fail", "error": f"{type(e).__name__}: {e}"[:250],
+        }
+    flush()
+
+    print(json.dumps(state))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,138 @@
+"""Repro 2: the fused kernel's exact pallas_call config vs a 2D layout.
+
+rung9_phase.py showed the hardware tile holds ZEROS in tail lane groups
+before the kernel writes anything (interp=-1 vs hw=0 at slot>=384 even
+with the kernel truncated to row_phase=1): the [NC, d_block, C] aliased
+input block DMAs incompletely on the axon backend. Cases:
+
+  g3d  : grid + [NC, DB, C] block + aliasing + trivial passthrough kernel
+         (expected CORRUPT if the DMA theory is right)
+  g3dna: same without input_output_aliases (is aliasing required?)
+  g2d  : [D, NC*C] layout, block (DB, NC*C), plane = static lane slice
+         (candidate workaround)
+
+Usage: python benches/plane_rmw_repro2.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+OUT = os.path.join(HERE, "benches", "plane_rmw_repro2.json")
+state: dict = {"cases": {}}
+
+
+def flush():
+    with open(OUT, "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+
+    state["platform"] = jax.devices()[0].platform
+    flush()
+
+    I32 = jnp.int32
+    NC, D, C, DB = 26, 8, 512, 8
+    x3 = (np.arange(NC * D * C, dtype=np.int32).reshape(NC, D, C) % 997) - 400
+
+    def record(name, fn):
+        state["cases"][name] = {"status": "running"}
+        flush()
+        t0 = time.time()
+        try:
+            n_bad, first = fn()
+            state["cases"][name] = {
+                "status": "ok" if n_bad == 0 else "CORRUPT",
+                "n_bad": n_bad,
+                "first_bad": first,
+            }
+        except Exception as e:  # noqa: BLE001
+            state["cases"][name] = {
+                "status": "fail", "error": f"{type(e).__name__}: {e}"[:250],
+            }
+        state["cases"][name]["seconds"] = round(time.time() - t0, 1)
+        flush()
+
+    def g3d(alias):
+        def k(x_ref, o_ref):
+            # the kernel's plane RMW with an all-False mask: semantics are
+            # identity, so any output change is a layout/DMA bug
+            iota_c = jax.lax.broadcasted_iota(I32, (DB, C), 1)
+            idx = jnp.full((DB,), -1, I32)
+            mask = (iota_c == idx[:, None]) & (idx[:, None] >= 0)
+            for i in range(NC):
+                o_ref[i] = jnp.where(mask, 0, x_ref[i])
+
+        def run():
+            out = pl.pallas_call(
+                k,
+                grid=(D // DB,),
+                in_specs=[pl.BlockSpec((NC, DB, C), lambda d: (0, d, 0))],
+                out_specs=pl.BlockSpec((NC, DB, C), lambda d: (0, d, 0)),
+                out_shape=jax.ShapeDtypeStruct((NC, D, C), I32),
+                input_output_aliases={0: 0} if alias else {},
+            )(jnp.asarray(x3))
+            got = np.asarray(out)
+            bad = np.nonzero(got != x3)
+            first = (
+                [[int(bad[j][k]) for j in range(3)]
+                 + [int(x3[bad[0][k], bad[1][k], bad[2][k]]),
+                    int(got[bad[0][k], bad[1][k], bad[2][k]])]
+                 for k in range(min(4, bad[0].size))]
+                if bad[0].size else None
+            )
+            return int(bad[0].size), first
+
+        return run
+
+    record("g3d_alias", g3d(True))
+    record("g3d_noalias", g3d(False))
+
+    x2 = np.ascontiguousarray(np.transpose(x3, (1, 0, 2)).reshape(D, NC * C))
+
+    def g2d():
+        def k(x_ref, o_ref):
+            iota_c = jax.lax.broadcasted_iota(I32, (DB, C), 1)
+            idx = jnp.full((DB,), -1, I32)
+            mask = (iota_c == idx[:, None]) & (idx[:, None] >= 0)
+            for i in range(NC):
+                sl = slice(i * C, (i + 1) * C)
+                o_ref[:, sl] = jnp.where(mask, 0, x_ref[:, sl])
+
+        out = pl.pallas_call(
+            k,
+            grid=(D // DB,),
+            in_specs=[pl.BlockSpec((DB, NC * C), lambda d: (d, 0))],
+            out_specs=pl.BlockSpec((DB, NC * C), lambda d: (d, 0)),
+            out_shape=jax.ShapeDtypeStruct((D, NC * C), I32),
+            input_output_aliases={0: 0},
+        )(jnp.asarray(x2))
+        got = np.asarray(out)
+        bad = np.nonzero(got != x2)
+        first = (
+            [[int(bad[j][k]) for j in range(2)]
+             + [int(x2[bad[0][k], bad[1][k]]), int(got[bad[0][k], bad[1][k]])]
+             for k in range(min(4, bad[0].size))]
+            if bad[0].size else None
+        )
+        return int(bad[0].size), first
+
+    record("g2d_flat", g2d)
+
+    print(json.dumps(state))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

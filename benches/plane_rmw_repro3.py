@@ -1,0 +1,182 @@
+"""Repro 3: layer in the _run context pieces until the corruption fires.
+
+At _debug_row_phase=1 the kernel writes meta only — cols_ref is NEVER
+written — yet the aliased cols output returns with zeroed tail lane
+groups on hardware. Candidate triggers vs the clean micro:
+
+  v_vmem  : + CompilerParams(vmem_limit_bytes=64MB)
+  v_multi : + 5 inputs / 2 outputs with {3:0, 4:1} aliasing (_run shape)
+  v_body  : + S*U fori + pl.when + a [DB,C] masked-max reduce + meta RMW
+  v_full  : all of the above (minus any cols_ref write)
+
+Usage: python benches/plane_rmw_repro3.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+OUT = os.path.join(HERE, "benches", "plane_rmw_repro3.json")
+state: dict = {"cases": {}}
+
+
+def flush():
+    with open(OUT, "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import partial
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    state["platform"] = jax.devices()[0].platform
+    flush()
+
+    I32 = jnp.int32
+    NC, D, C, DB = 26, 8, 512, 8
+    S, U, W = 1, 4, 23
+    M_PAD = 8
+    x3 = (np.arange(NC * D * C, dtype=np.int32).reshape(NC, D, C) % 997) - 400
+    rows_np = np.arange(S * U * W, dtype=np.int32).reshape(S, U, W) % 7
+    rows_np[:, :, 14] = 1  # valid flag
+    dels_np = np.zeros((S, 4, 4), np.int32)
+    rank_np = np.arange(256, dtype=np.int32).reshape(1, 256)
+    meta_np = np.zeros((D, M_PAD), np.int32)
+
+    def record(name, fn):
+        state["cases"][name] = {"status": "running"}
+        flush()
+        t0 = time.time()
+        try:
+            n_bad, first = fn()
+            state["cases"][name] = {
+                "status": "ok" if n_bad == 0 else "CORRUPT",
+                "n_bad": n_bad,
+                "first_bad": first,
+            }
+        except Exception as e:  # noqa: BLE001
+            state["cases"][name] = {
+                "status": "fail", "error": f"{type(e).__name__}: {e}"[:250],
+            }
+        state["cases"][name]["seconds"] = round(time.time() - t0, 1)
+        flush()
+
+    def diff3(got):
+        bad = np.nonzero(got != x3)
+        first = (
+            [[int(bad[j][k]) for j in range(3)]
+             + [int(x3[bad[0][k], bad[1][k], bad[2][k]]),
+                int(got[bad[0][k], bad[1][k], bad[2][k]])]
+             for k in range(min(4, bad[0].size))]
+            if bad[0].size else None
+        )
+        return int(bad[0].size), first
+
+    def passthrough_k(x_ref, o_ref):
+        for i in range(NC):
+            o_ref[i] = x_ref[i]
+
+    def v_vmem():
+        out = pl.pallas_call(
+            passthrough_k,
+            grid=(D // DB,),
+            in_specs=[pl.BlockSpec((NC, DB, C), lambda d: (0, d, 0))],
+            out_specs=pl.BlockSpec((NC, DB, C), lambda d: (0, d, 0)),
+            out_shape=jax.ShapeDtypeStruct((NC, D, C), I32),
+            input_output_aliases={0: 0},
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=64 * 1024 * 1024
+            ),
+        )(jnp.asarray(x3))
+        return diff3(np.asarray(out))
+
+    record("v_vmem", v_vmem)
+
+    def multi_call(body, name):
+        def k(rows_ref, dels_ref, rank_ref, x_ref, meta_ref, o_ref, mo_ref):
+            body(rows_ref, dels_ref, rank_ref, x_ref, meta_ref, mo_ref)
+            # NOTE: cols output (o_ref) is intentionally NEVER written —
+            # with aliasing {3:0} it must come back as the input
+
+        def run():
+            out, mo = pl.pallas_call(
+                k,
+                grid=(D // DB,),
+                in_specs=[
+                    pl.BlockSpec(rows_np.shape, lambda d: (0, 0, 0)),
+                    pl.BlockSpec(dels_np.shape, lambda d: (0, 0, 0)),
+                    pl.BlockSpec(rank_np.shape, lambda d: (0, 0)),
+                    pl.BlockSpec((NC, DB, C), lambda d: (0, d, 0)),
+                    pl.BlockSpec((DB, M_PAD), lambda d: (d, 0)),
+                ],
+                out_specs=[
+                    pl.BlockSpec((NC, DB, C), lambda d: (0, d, 0)),
+                    pl.BlockSpec((DB, M_PAD), lambda d: (d, 0)),
+                ],
+                out_shape=[
+                    jax.ShapeDtypeStruct((NC, D, C), I32),
+                    jax.ShapeDtypeStruct((D, M_PAD), I32),
+                ],
+                input_output_aliases={3: 0, 4: 1},
+                compiler_params=pltpu.CompilerParams(
+                    vmem_limit_bytes=64 * 1024 * 1024
+                ),
+            )(
+                jnp.asarray(rows_np),
+                jnp.asarray(dels_np),
+                jnp.asarray(rank_np),
+                jnp.asarray(x3),
+                jnp.asarray(meta_np),
+            )
+            return diff3(np.asarray(out))
+
+        record(name, run)
+
+    def body_noop(rows_ref, dels_ref, rank_ref, x_ref, meta_ref, mo_ref):
+        mo_ref[:, :] = meta_ref[:, :]
+
+    multi_call(body_noop, "v_multi")
+
+    def body_full(rows_ref, dels_ref, rank_ref, x_ref, meta_ref, mo_ref):
+        mo_ref[:, :] = meta_ref[:, :]
+        iota_c = jax.lax.broadcasted_iota(I32, (DB, C), 1)
+
+        def client_clock(client_v):
+            m = (iota_c < mo_ref[:, 1][:, None]) & (
+                x_ref[0] == client_v[:, None]
+            )
+            return jnp.max(jnp.where(m, x_ref[1] + x_ref[2], 0), axis=1)
+
+        def step(s, _):
+            def row_body(u, __):
+                @pl.when(rows_ref[s, u, 14] == 1)
+                def _():
+                    local = client_clock(rows_ref[s, u, 0])
+                    missing = ~(local >= rows_ref[s, u, 1])
+                    mo_ref[:, 2] = mo_ref[:, 2] | jnp.where(missing, 2, 0)
+
+                return 0
+
+            jax.lax.fori_loop(0, U, row_body, 0)
+            return 0
+
+        jax.lax.fori_loop(0, S, step, 0)
+
+    multi_call(body_full, "v_body")
+
+    print(json.dumps(state))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

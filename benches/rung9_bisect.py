@@ -1,0 +1,126 @@
+"""Bisect the fused kernel's rung-9 hardware divergence.
+
+mosaic_ladder rung 9 (200-op text replay through the fused kernel on
+silicon) died in the move-aware walk with a cycle, while rung 8 (1 op)
+and rung 10 (moves, 6 ops) pass, and interpret-mode parity is green in
+CI — a silicon-only divergence. This driver:
+
+  1. replays N ops through BOTH lanes on hardware (fused vs un-fused
+     XLA) for growing N until they diverge;
+  2. at the first failing N, reports the first divergent doc/slot/column
+     so the miscompiled construct can be attributed.
+
+Usage: python benches/rung9_bisect.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+OUT = os.path.join(HERE, "benches", "rung9_bisect.json")
+state: dict = {"steps": {}}
+
+
+def flush():
+    with open(OUT, "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    state["platform"] = jax.devices()[0].platform
+    flush()
+
+    from ytpu.core import Doc
+    from ytpu.models.batch_doc import apply_update_stream, init_state
+    from ytpu.ops.decode_kernel import (
+        decode_updates_v1,
+        identity_rank,
+        pack_updates,
+    )
+    from ytpu.ops.integrate_kernel import apply_update_stream_fused
+    from functools import partial
+
+    def replay_log(n_ops):
+        doc = Doc(client_id=1)
+        log = []
+        doc.observe_update_v1(lambda p, o, t: log.append(p))
+        txt = doc.get_text("text")
+        for i in range(n_ops):
+            with doc.transact() as txn:
+                txt.insert(txn, i % max(1, min(i, 40)), f"w{i % 7}")
+        return log, txt.get_string()
+
+    rank = identity_rank(256)
+
+    def run_n(n_ops, n_docs=8, cap=512):
+        log, expect = replay_log(n_ops)
+        buf_np, lens_np = pack_updates(log)
+        decode = jax.jit(partial(decode_updates_v1, max_rows=4, max_dels=8))
+        stream, flags = decode(jnp.asarray(buf_np), jnp.asarray(lens_np))
+        xla = apply_update_stream(init_state(n_docs, cap), stream, rank)
+        fused = apply_update_stream_fused(
+            init_state(n_docs, cap), stream, rank,
+            d_block=min(8, n_docs), guard=False, refresh_cache=False,
+        )
+        err_x = int(np.asarray(xla.error).max())
+        err_f = int(np.asarray(fused.error).max())
+        divergent = []
+        for name in xla.blocks._fields:
+            if name == "origin_slot":
+                continue  # fused lane leaves the cache plane stale by design
+            va = np.asarray(getattr(xla.blocks, name))
+            vb = np.asarray(getattr(fused.blocks, name))
+            if not np.array_equal(va, vb):
+                d, s = [int(x[0]) for x in np.nonzero(va != vb)[:2]]
+                divergent.append(
+                    {
+                        "col": name,
+                        "doc": d,
+                        "slot": s,
+                        "xla": int(va[d, s]),
+                        "fused": int(vb[d, s]),
+                    }
+                )
+        same_meta = {
+            "start": bool(np.array_equal(np.asarray(xla.start), np.asarray(fused.start))),
+            "n_blocks": bool(
+                np.array_equal(np.asarray(xla.n_blocks), np.asarray(fused.n_blocks))
+            ),
+        }
+        return {
+            "n_ops": n_ops,
+            "err_xla": err_x,
+            "err_fused": err_f,
+            "divergent_cols": divergent[:8],
+            "meta_equal": same_meta,
+        }
+
+    for n in (1, 25, 50, 100, 150, 200):
+        t0 = time.time()
+        try:
+            r = run_n(n)
+        except Exception as e:  # noqa: BLE001
+            r = {"n_ops": n, "error": f"{type(e).__name__}: {e}"[:300]}
+        r["seconds"] = round(time.time() - t0, 1)
+        state["steps"][str(n)] = r
+        flush()
+        if r.get("divergent_cols") or r.get("error"):
+            state["first_divergence"] = n
+            flush()
+            break
+    print(json.dumps(state))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,131 @@
+"""Phase-level bisection of the fused kernel's silicon divergence.
+
+Oracle: the SAME truncated kernel (``_debug_phases`` / ``_debug_row_phase``)
+run in interpret mode vs on hardware — any diff is a Mosaic miscompile of
+whatever the truncation includes. Each case runs in a SUBPROCESS so a TPU
+worker crash cannot poison later cases (the in-process jax client never
+reconnects after UNAVAILABLE).
+
+Usage:
+  python benches/rung9_phase.py            # sweep
+  python benches/rung9_phase.py one P RP   # single case (child mode)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+OUT = os.path.join(HERE, "benches", "rung9_phase.json")
+
+
+def one_case(phases: int, row_phase: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import partial
+
+    from ytpu.core import Doc
+    from ytpu.models.batch_doc import init_state
+    from ytpu.ops.decode_kernel import (
+        decode_updates_v1,
+        identity_rank,
+        pack_updates,
+    )
+    from ytpu.ops.integrate_kernel import M_PAD, _run, pack_state, pack_stream
+
+    doc = Doc(client_id=1)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    txt = doc.get_text("text")
+    with doc.transact() as txn:
+        txt.insert(txn, 0, "hello")
+
+    buf_np, lens_np = pack_updates(log)
+    decode = jax.jit(partial(decode_updates_v1, max_rows=4, max_dels=8))
+    stream, flags = decode(jnp.asarray(buf_np), jnp.asarray(lens_np))
+    rank = identity_rank(256)
+    rows, dels = pack_stream(stream)
+
+    def run(interpret):
+        cols, meta = pack_state(init_state(8, 512))
+        return _run(
+            cols, meta, (rows, dels, rank), 8, interpret, phases, row_phase
+        )
+
+    ci, mi = run(True)
+    ci, mi = np.asarray(ci), np.asarray(mi)
+    ch, mh = run(False)
+    ch, mh = np.asarray(ch), np.asarray(mh)
+    bad = np.nonzero(ci != ch)
+    meta_bad = np.nonzero(mi != mh)
+    out = {
+        "phases": phases,
+        "row_phase": row_phase,
+        "n_bad_cols": int(bad[0].size),
+        "n_bad_meta": int(meta_bad[0].size),
+    }
+    if bad[0].size:
+        # first few divergent (plane, doc, slot, interp, hw)
+        out["first_bad"] = [
+            [
+                int(bad[0][k]),
+                int(bad[1][k]),
+                int(bad[2][k]),
+                int(ci[bad[0][k], bad[1][k], bad[2][k]]),
+                int(ch[bad[0][k], bad[1][k], bad[2][k]]),
+            ]
+            for k in range(min(6, bad[0].size))
+        ]
+    return out
+
+
+def main() -> int:
+    if len(sys.argv) == 4 and sys.argv[1] == "one":
+        print(json.dumps(one_case(int(sys.argv[2]), int(sys.argv[3]))))
+        return 0
+
+    state: dict = {"cases": {}}
+
+    def flush():
+        with open(OUT, "w") as f:
+            json.dump(state, f, indent=1)
+
+    for phases, row_phase in (
+        (1, 1),
+        (1, 2),
+        (1, 3),
+        (1, 4),
+        (2, 4),
+        (3, 4),
+    ):
+        key = f"p{phases}_rp{row_phase}"
+        t0 = time.time()
+        try:
+            res = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "one",
+                 str(phases), str(row_phase)],
+                capture_output=True, text=True, timeout=420, cwd=HERE,
+            )
+            line = res.stdout.strip().splitlines()[-1] if res.stdout.strip() else ""
+            state["cases"][key] = (
+                json.loads(line)
+                if line.startswith("{")
+                else {"error": (res.stderr or res.stdout)[-250:]}
+            )
+        except Exception as e:  # noqa: BLE001
+            state["cases"][key] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        state["cases"][key]["seconds"] = round(time.time() - t0, 1)
+        flush()
+    print(json.dumps(state))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,105 @@
+"""Shape sweep for the fused kernel's silicon divergence (rung 9).
+
+rung9_bisect.py found full-column divergence at n_ops=1: the fused lane
+writes slot0.left = 0 (a self-pointer -> the walk cycle) and plane-
+shifted garbage at slot C-128 on hardware, while interpret mode is
+byte-identical. This sweeps (C, d_block, n_docs) on a 1-op stream to map
+which tile shapes miscompile.
+
+Usage: python benches/rung9_shapes.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+OUT = os.path.join(HERE, "benches", "rung9_shapes.json")
+state: dict = {"cases": {}}
+
+
+def flush():
+    with open(OUT, "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    state["platform"] = jax.devices()[0].platform
+    flush()
+
+    from ytpu.core import Doc
+    from ytpu.models.batch_doc import apply_update_stream, init_state
+    from ytpu.ops.decode_kernel import (
+        decode_updates_v1,
+        identity_rank,
+        pack_updates,
+    )
+    from ytpu.ops.integrate_kernel import apply_update_stream_fused
+
+    doc = Doc(client_id=1)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    txt = doc.get_text("text")
+    with doc.transact() as txn:
+        txt.insert(txn, 0, "hello")
+
+    buf_np, lens_np = pack_updates(log)
+    decode = jax.jit(partial(decode_updates_v1, max_rows=4, max_dels=8))
+    stream, flags = decode(jnp.asarray(buf_np), jnp.asarray(lens_np))
+    rank = identity_rank(256)
+
+    def case(n_docs, cap, d_block):
+        xla = apply_update_stream(init_state(n_docs, cap), stream, rank)
+        fused = apply_update_stream_fused(
+            init_state(n_docs, cap), stream, rank,
+            d_block=d_block, guard=False, refresh_cache=False,
+        )
+        bad = {}
+        for name in xla.blocks._fields:
+            if name == "origin_slot":
+                continue
+            va = np.asarray(getattr(xla.blocks, name))
+            vb = np.asarray(getattr(fused.blocks, name))
+            if not np.array_equal(va, vb):
+                docs_b, slots_b = np.nonzero(va != vb)
+                bad[name] = sorted(set(int(s) for s in slots_b))[:6]
+        return bad
+
+    for n_docs, cap, d_block in (
+        (8, 512, 8),
+        (8, 256, 8),
+        (8, 128, 8),
+        (8, 1024, 8),
+        (8, 512, 4),
+        (8, 512, 2),
+        (8, 512, 1),
+        (16, 512, 16),
+        (4, 512, 4),
+    ):
+        key = f"docs{n_docs}_cap{cap}_db{d_block}"
+        t0 = time.time()
+        try:
+            bad = case(n_docs, cap, d_block)
+            state["cases"][key] = {
+                "divergent": bad or None,
+                "seconds": round(time.time() - t0, 1),
+            }
+        except Exception as e:  # noqa: BLE001
+            state["cases"][key] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        flush()
+    print(json.dumps(state))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -202,32 +202,26 @@ class ReplayStats:
 _XLA_STEP = None
 
 
-_DECODERS: dict = {}
-
-
 def _decoder(max_rows: int, max_dels: int, n_steps: int, max_sections: int):
-    """Module-cached jitted chunk decoder, keyed by its static shape
-    params. `FusedReplay.run` used to build `jax.jit(partial(...))` per
-    call, so the warmup instance's compile never carried over to the
-    timed instance — the timed pass's first chunk re-traced and
-    re-compiled the decode machine, polluting p99_chunk_ms with compile
-    time (code-review r5)."""
-    key = (max_rows, max_dels, n_steps, max_sections)
-    if key not in _DECODERS:
-        import jax
+    """Chunk decoder bound to its static shape params. `FusedReplay.run`
+    used to build a FRESH `jax.jit(partial(...))` per call, so the warmup
+    instance's compile never carried over to the timed instance — the
+    timed pass's first chunk re-traced and re-compiled the decode
+    machine, polluting p99_chunk_ms with compile time (code-review r5).
+    `decode_updates_v1` is already routed through the module-level jit
+    (`decode_kernel._decode_updates_v1_jit`, static-keyed and registered
+    with the progbudget resident-program registry), so binding the
+    statics with `partial` shares that cache across instances — an outer
+    jit here would hold unevictable duplicate executables."""
+    from ytpu.ops.decode_kernel import decode_updates_v1
 
-        from ytpu.ops.decode_kernel import decode_updates_v1
-
-        _DECODERS[key] = jax.jit(
-            partial(
-                decode_updates_v1,
-                max_rows=max_rows,
-                max_dels=max_dels,
-                n_steps=n_steps,
-                max_sections=max_sections,
-            )
-        )
-    return _DECODERS[key]
+    return partial(
+        decode_updates_v1,
+        max_rows=max_rows,
+        max_dels=max_dels,
+        n_steps=n_steps,
+        max_sections=max_sections,
+    )
 
 
 def _xla_chunk_step(cols, meta, stream, rank):

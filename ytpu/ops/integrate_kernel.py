@@ -22,6 +22,8 @@ from __future__ import annotations
 from functools import partial
 from typing import Tuple
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -232,8 +234,7 @@ def _kernel(
 
     cols_ref: [NC, DB, C] out-ref aliased to the input (holds the state),
     meta_ref: [DB, 8] aliased; rows_ref: [S, U, 23], dels_ref: [S, R, 4],
-    rank_ref: [1, K]. The plain in-refs are shadows of the aliased buffers
-    and are unused.
+    rank_ref: [1, K].
 
     `phases` / `row_phase` are HARDWARE-BISECT hooks (trace-time static,
     threaded from `apply_update_stream_fused`): they truncate the kernel
@@ -246,6 +247,20 @@ def _kernel(
     R = dels_ref.shape[1]
     DB = cols_ref.shape[1]
     C = cols_ref.shape[2]
+
+    # Initialize the aliased out-refs EXPLICITLY from the in-refs. On
+    # standard backends (and in interpret mode) an aliased output's VMEM
+    # window starts pre-filled with the input block, so this copy is a
+    # no-op; the axon remote backend instead hands the output a buffer
+    # whose writeback reads 128 lanes off when the kernel never stores it
+    # (bisected 2026-08-01: benches/plane_rmw_repro3.py `v_multi` — a
+    # never-stored aliased output returns the whole tile rotated by one
+    # lane group; the state-column corruption of mosaic_ladder rung 9
+    # was exactly this). Reading the IN-refs is reliable on both.
+    for _i in range(cols_ref.shape[0]):
+        cols_ref[_i] = _cols_in[_i]
+    meta_ref[:, :] = _meta_in[:, :]
+
     iota_c = jax.lax.broadcasted_iota(I32, (DB, C), 1)
 
     def col(i):
@@ -918,7 +933,16 @@ def _run(
         # the safe default at C=2048 if allocation fails
         compiler_params=None
         if interpret
-        else pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024),
+        else pltpu.CompilerParams(
+            # v5e VMEM is 128MB; the default guard stays conservative.
+            # Big-capacity tiles (the fused full-B4 at C=65536 needs a
+            # ~54MB state tile + scan temporaries) raise it via env.
+            vmem_limit_bytes=int(
+                os.environ.get("YTPU_FUSED_VMEM_MB", "64")
+            )
+            * 1024
+            * 1024
+        ),
     )(rows, dels, rank, cols, meta)
     return out
 
