@@ -205,9 +205,16 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
 
     do = r_valid
     is_anchor = do & (r_kind == BLOCK_ROOT_ANCHOR)
+    # claim-mirror rows (content_ref == -2): a cross-segment move's local
+    # claimant on a shard other than the move row's home. They carry the
+    # move's REAL id (tie-breaks) and localized bounds, participate in
+    # the ownership recompute like any CONTENT_MOVE row, but never link
+    # into the sequence (an origin-less linked row would become the
+    # segment head) and have no wire identity on this shard.
+    is_mirror = do & (r_kind == CONTENT_MOVE) & (r_ref == -2)
     has_origin = s_oc >= 0
     has_ror = s_rc >= 0
-    linkable = do & ~is_anchor
+    linkable = do & ~is_anchor & ~is_mirror
 
     # move rows: the range-bound repair splits (moving.rs:100-111 —
     # assoc After cleans the bound's start, Before its end) happen on
@@ -628,6 +635,9 @@ class ShardedDoc:
         self._parent_index: Dict[Tuple[int, int], Tuple[int, int]] = {}
         self._root_anchor_shard: Dict[int, int] = {}  # root key -> shard
         self._has_moves = False  # live move rows anywhere (rebalance guard)
+        # cross-segment moves: (interned client, clock) of a move row ->
+        # shards holding its claim mirrors (tombstone propagation)
+        self._move_mirrors: Dict[Tuple[int, int], List[int]] = {}
         self._queue_rows: List[List[tuple]] = [[] for _ in range(n_shards)]
         self._queue_dels: List[List[tuple]] = [[] for _ in range(n_shards)]
         self._queued = 0
@@ -822,36 +832,139 @@ class ShardedDoc:
             )
         return owner
 
-    def _check_move_local(self, mv_fields, target: int) -> None:
-        """A move's claimed range must live WHOLE on the move row's shard
-        (segments are contiguous, so both bounds on `target` implies the
-        range is): cross-segment ranges would need cross-shard moved-flag
-        propagation the sp engine does not model. Branch-scoped bounds
-        (client -1 = sequence head/tail) are fine for shard-affine
-        branches; for the SEGMENTED primary root they span every shard,
-        so they only pass while the doc still lives on one shard."""
-        sc_i, sk_i, _sa, ec_i, ek_i, _ea, _pr = mv_fields
-        for bc, bk in ((sc_i, sk_i), (ec_i, ek_i)):
-            if bc >= 0:
-                owner = self.dir.owner(bc, bk)
-                if owner is not None and owner != target:
-                    raise NotImplementedError(
-                        "sharded docs: move range crosses shard segments "
-                        f"(bound on shard {owner}, move on {target}); "
-                        "cross-shard moves need the unsharded engine"
-                    )
+    def _plan_move_mirrors(
+        self, mv_fields, target: int, c: int, clock: int, nested: bool = False
+    ):
+        """Localize a move's claimed range per shard (r5: cross-SEGMENT
+        ranges supported via claim mirrors).
+
+        Segments are contiguous in document order, so a range spanning
+        shards [lo..hi] covers the middle segments WHOLE. Per shard the
+        local claim is expressed with the existing bound encoding:
+          - lo (owns the start id): original start bound, end = segment
+            tail (branch-scoped -1);
+          - middle: both bounds branch-scoped (head..tail);
+          - hi (owns the end id): start = segment head, original end;
+          - a branch-scoped original bound spans first..last non-empty.
+        Returns (fields_for_target, [(shard, fields), ...] mirrors) —
+        when the move row's home shard lies outside [lo..hi] its local
+        claim must be EMPTY, which is encoded as self-referential bounds
+        (own id, assoc After, both ends): they resolve locally (the row
+        itself), so `_claim_move` raises no missing-dep flag, and the
+        walk terminates immediately (start == exclusive end). The wire
+        encode is unaffected either way — it re-emits the ORIGINAL
+        ContentMove payload, never the localized device columns."""
+        sc_i, sk_i, sa_i, ec_i, ek_i, ea_i, pr_i = mv_fields
+        if nested:
+            # shard-affine branches live WHOLE on one shard: the range is
+            # local by construction, and branch-scoped bounds mean the
+            # BRANCH's head/tail (resolved against the parent row's head
+            # column on device), never the segmented primary root
+            return mv_fields, []
+        nonempty = [s for s in range(self.S) if self._n_rows[s] > 0 or self._queue_rows[s]]
+        if not nonempty:
+            return mv_fields, []
+        if sc_i >= 0:
+            lo = self.dir.owner(sc_i, sk_i)
+            if lo is None:
+                # bound not integrated yet (carrier-order edge): the host
+                # partition already checked dependencies, so treat as
+                # local-only — the claim resolves empty until retry
+                return mv_fields, []
+        else:
+            lo = nonempty[0]
+        if ec_i >= 0:
+            hi = self.dir.owner(ec_i, ek_i)
+            if hi is None:
+                return mv_fields, []
+        else:
+            hi = nonempty[-1]
+        # the claim walks the YATA sequence from the start bound and stops
+        # at the end bound OR the sequence tail if the end is BEHIND the
+        # start (moving.rs:149-227 `while start != end && start != None`
+        # — visible-index ranges can yield YATA-inverted sticky bounds
+        # after earlier moves). Segment shards are YATA-ordered, so
+        # hi < lo means unreachable; hi == lo with both bounds id-scoped
+        # needs a local reachability walk to decide.
+        end_unreachable = hi < lo or (
+            hi == lo
+            and sc_i >= 0
+            and ec_i >= 0
+            and not self._end_reachable(lo, (sc_i, sk_i), (ec_i, ek_i))
+        )
+        if end_unreachable:
+            hi = nonempty[-1]
+
+        def fields_for(s: int):
+            f_sc, f_sk, f_sa = (sc_i, sk_i, sa_i) if s == lo else (-1, 0, 0)
+            if end_unreachable:
+                # the end id stays in the LO fields when lo == hi == s so
+                # the local walk semantics match the unsharded engine
+                # (start..local tail either way); later shards take
+                # head..tail
+                f_ec, f_ek, f_ea = (-1, 0, 0)
             else:
-                others = [
-                    s
-                    for s in range(self.S)
-                    if s != target and self._n_rows[s] > 0
-                ]
-                if others:
-                    raise NotImplementedError(
-                        "sharded docs: branch-scoped move bound spans the "
-                        "segmented primary root; cross-shard moves need "
-                        "the unsharded engine"
-                    )
+                f_ec, f_ek, f_ea = (ec_i, ek_i, ea_i) if s == hi else (-1, 0, 0)
+            return (f_sc, f_sk, f_sa, f_ec, f_ek, f_ea, pr_i)
+
+        mirrors = [
+            (s, fields_for(s))
+            for s in nonempty
+            if lo <= s <= hi and s != target
+        ]
+        if lo <= target <= hi:
+            local = fields_for(target)
+        else:
+            local = (c, clock, 0, c, clock, 0, pr_i)  # empty local claim
+        return local, mirrors
+
+    def _end_reachable(self, shard: int, start_id, end_id) -> bool:
+        """Is the row containing `end_id` reachable from the one containing
+        `start_id` by right-links on `shard`? Decides claim-walk direction
+        for same-shard id-scoped move bounds (rare: only moves whose both
+        bounds share a shard ever need it). Pulls the shard's columns."""
+        self.flush()
+        st = self._pull()
+        bl = st.blocks
+        n = int(np.asarray(st.n_blocks)[shard])
+        cl = np.asarray(bl.client[shard])[:n]
+        ck = np.asarray(bl.clock[shard])[:n]
+        ln = np.asarray(bl.length[shard])[:n]
+        right = np.asarray(bl.right[shard])[:n]
+
+        def covering(cid, k):
+            m = np.nonzero((cl == cid) & (ck <= k) & (k < ck + ln))[0]
+            return int(m[0]) if len(m) else -1
+
+        cur = covering(*start_id)
+        endr = covering(*end_id)
+        if cur < 0 or endr < 0:
+            return False
+        seen = 0
+        while cur >= 0 and seen <= n + 1:
+            if cur == endr:
+                return True
+            cur = int(right[cur])
+            seen += 1
+        return False
+
+    def _emit_move_mirrors(self, c, clock, length, mirrors) -> None:
+        """Enqueue claim-mirror rows (content_ref -2, no origins, no wire
+        bookkeeping: mirrors never journal, register in the directory, or
+        advance the state vector — the real row on its home shard does)."""
+        from ytpu.core.content import CONTENT_MOVE
+
+        if not mirrors:
+            return
+        for shard, fields in mirrors:
+            self._enqueue_row(
+                shard,
+                self._make_row(
+                    c, clock, length, None, None, None, None,
+                    CONTENT_MOVE, -2, 0, mv=fields,
+                ),
+            )
+        self._move_mirrors[(c, clock)] = [s for s, _ in mirrors]
 
     def _first_nonempty(self) -> int:
         queued = [len(q) for q in self._queue_rows]
@@ -1082,13 +1195,17 @@ class ShardedDoc:
                     raise RuntimeError(
                         "nested right-origin off its branch shard (routing bug)"
                     )
+            move_mirrors = []
             if kind == CONTENT_MOVE:
-                self._check_move_local(mv_fields, target)
+                mv_fields, move_mirrors = self._plan_move_mirrors(
+                    mv_fields, target, c, clock, nested=True
+                )
             row = self._make_row(
                 c, clock, length, s_o, s_r, s_o, s_r, kind, ref, offset,
                 parent=parent_ref, mv=mv_fields,
             )
             self._enqueue_row(target, row)
+            self._emit_move_mirrors(c, clock, length, move_mirrors)
             self._journal_row(c, clock, length, s_o, s_r, kind)
             self.dir.add(c, clock, clock + length, target)
             self.sv.set_max(real_client, clock + length)
@@ -1115,28 +1232,32 @@ class ShardedDoc:
                 if self._queue_rows[r_owner]:
                     # queued rows may have changed the neighbor head: the
                     # safe-tail equivalence needs device state — resolve
-                    self._resolve_boundary(item, c, clock, length, s_o, s_r, kind, ref, offset)
+                    self._resolve_boundary(item, c, clock, length, s_o, s_r, kind, ref, offset, mv_fields)
                     return
                 if s_r == self._shard_first_id(r_owner):
                     a_r = None  # segment tail ≡ "before next shard's head"
                 else:
-                    self._resolve_boundary(item, c, clock, length, s_o, s_r, kind, ref, offset)
+                    self._resolve_boundary(item, c, clock, length, s_o, s_r, kind, ref, offset, mv_fields)
                     return
             else:
-                self._resolve_boundary(item, c, clock, length, s_o, s_r, kind, ref, offset)
+                self._resolve_boundary(item, c, clock, length, s_o, s_r, kind, ref, offset, mv_fields)
                 return
         else:
             if not self._shards_empty_after(target):
-                self._resolve_boundary(item, c, clock, length, s_o, s_r, kind, ref, offset)
+                self._resolve_boundary(item, c, clock, length, s_o, s_r, kind, ref, offset, mv_fields)
                 return
 
+        move_mirrors = []
         if kind == CONTENT_MOVE:
-            self._check_move_local(mv_fields, target)
+            mv_fields, move_mirrors = self._plan_move_mirrors(
+                mv_fields, target, c, clock
+            )
         row = self._make_row(
             c, clock, length, s_o, s_r, s_o, a_r, kind, ref, offset,
             mv=mv_fields,
         )
         self._enqueue_row(target, row)
+        self._emit_move_mirrors(c, clock, length, move_mirrors)
         self._journal_row(c, clock, length, s_o, s_r, kind)
         self.dir.add(c, clock, clock + length, target)
         self.sv.set_max(real_client, clock + length)
@@ -1293,7 +1414,8 @@ class ShardedDoc:
         return runs
 
     def _resolve_boundary(
-        self, item, c, clock, length, s_o, s_r, kind, ref, off
+        self, item, c, clock, length, s_o, s_r, kind, ref, off,
+        mv_fields=(-1, 0, 0, -1, 0, 0, -1),
     ) -> None:
         """Host-side exact placement for a boundary-straddling insert.
 
@@ -1412,8 +1534,16 @@ class ShardedDoc:
             if owner is not None:
                 self._queue_dels[owner].append((an[0], at, at))
                 self._queued += 1
-        row = self._make_row(c, clock, length, s_o, s_r, a_o, a_r, kind, ref, off)
+        move_mirrors = []
+        if kind == CONTENT_MOVE:
+            mv_fields, move_mirrors = self._plan_move_mirrors(
+                mv_fields, target, c, clock
+            )
+        row = self._make_row(
+            c, clock, length, s_o, s_r, a_o, a_r, kind, ref, off, mv=mv_fields
+        )
         self._enqueue_row(target, row)
+        self._emit_move_mirrors(c, clock, length, move_mirrors)
         self._journal_row(c, clock, length, s_o, s_r, kind, anchor_o=a_o)
         self.dir.add(c, clock, clock + length, target)
         self.sv.set_max(self.enc.interner.from_idx[c], clock + length)
@@ -1494,6 +1624,19 @@ class ShardedDoc:
             for shard, lo, hi in self.dir.clip(c, start, known):
                 self._queue_dels[shard].append((c, lo, hi))
                 self._queued += 1
+            # a tombstoned move releases its claims everywhere: propagate
+            # the range to shards holding the move's claim mirrors (they
+            # share the real id, so the device delete range hits them; the
+            # hit_move path then marks those shards move-dirty)
+            dead = [
+                (mc, mk)
+                for (mc, mk) in self._move_mirrors
+                if mc == c and start <= mk < known
+            ]
+            for mc, mk in dead:
+                for shard in self._move_mirrors.pop((mc, mk)):
+                    self._queue_dels[shard].append((c, mk, mk + 1))
+                    self._queued += 1
         if end > known:
             self.pending_ds.setdefault(real_client, []).append((max(start, known), end))
 
@@ -1545,6 +1688,11 @@ class ShardedDoc:
         from ytpu.models.batch_doc import get_string
 
         self.flush()
+        if self._move_mirrors:
+            return "".join(
+                t
+                for _s, _r, t in self._global_visible_content(text_only=True)
+            )
         return "".join(
             get_string(self.state, s, self.enc.payloads) for s in range(self.S)
         )
@@ -1553,10 +1701,142 @@ class ShardedDoc:
         from ytpu.models.batch_doc import get_values
 
         self.flush()
+        if self._move_mirrors:
+            return [v for _s, _r, v in self._global_visible_content(text_only=False)]
         out: list = []
         for s in range(self.S):
             out.extend(get_values(self.state, s, self.enc.payloads))
         return out
+
+    # ----------------------------------------------- cross-segment rendering
+
+    def _global_visible_content(self, text_only: bool):
+        """Move-aware walk over the WHOLE sharded sequence (host mirror of
+        `batch_doc._visible_walk`, generalized to (shard, slot) nodes).
+
+        Needed exactly when cross-segment moves exist: a claimed row on
+        shard X renders at its move row's position on shard Y, which no
+        per-shard device walk can see. Ownership scopes compare by the
+        claimant's LOGICAL id (real move row and its claim mirrors share
+        it); only real move rows (content_ref != -2) descend."""
+        st = self._pull()
+        bl = st.blocks
+        n = [int(x) for x in np.asarray(st.n_blocks)]
+        starts = [int(x) for x in np.asarray(st.start)]
+        nonempty = [s for s in range(self.S) if n[s] > 0 and starts[s] >= 0]
+
+        def next_shard_head(s):
+            for t in nonempty:
+                if t > s:
+                    return (t, starts[t])
+            return None
+
+        def succ(node):
+            s, r = node
+            nxt = int(bl.right[s, r])
+            if nxt >= 0:
+                return (s, nxt)
+            return next_shard_head(s)
+
+        def covering(c, k):
+            sh = self.dir.owner(c, k)
+            if sh is None:
+                return None
+            m = np.nonzero(
+                (np.asarray(bl.client[sh])[: n[sh]] == c)
+                & (np.asarray(bl.clock[sh])[: n[sh]] <= k)
+                & (k < np.asarray(bl.clock[sh])[: n[sh]] + np.asarray(bl.length[sh])[: n[sh]])
+            )[0]
+            return (sh, int(m[0])) if len(m) else None
+
+        head = (nonempty[0], starts[nonempty[0]]) if nonempty else None
+
+        def move_bounds(node):
+            # the device mv columns hold LOCALIZED bounds (claim mirrors /
+            # empty-claim self-bounds): resolve the ORIGINAL range from
+            # the stored wire ContentMove payload instead
+            s, r = node
+            mv = self.enc.payloads.items[int(bl.content_ref[s, r])][1].move
+            to_idx = self.enc.interner.to_idx
+            if mv.start.id is None:
+                i = head
+            else:
+                c_i = to_idx.get(mv.start.id.client, -1)
+                i = covering(c_i, mv.start.id.clock)
+                if mv.start.assoc < 0 and i is not None:
+                    i = succ(i)
+            if mv.end.id is None:
+                j = None  # sequence tail
+            else:
+                c_j = to_idx.get(mv.end.id.client, -1)
+                j = covering(c_j, mv.end.id.clock)
+                if mv.end.assoc < 0 and j is not None:
+                    j = succ(j)
+            return i, j
+
+        def owner_id(node):
+            s, r = node
+            m = int(bl.moved[s, r])
+            if m < 0:
+                return None
+            return (int(bl.client[s, m]), int(bl.clock[s, m]))
+
+        n_moves = sum(
+            int(
+                np.sum(
+                    (np.asarray(bl.kind[s])[: n[s]] == CONTENT_MOVE)
+                    & ~np.asarray(bl.deleted[s])[: n[s]]
+                    & (np.asarray(bl.content_ref[s])[: n[s]] != -2)
+                )
+            )
+            for s in range(self.S)
+        )
+        total = sum(n)
+        steps, limit = 0, (total + 2) * (n_moves + 2)
+
+        stack: list = []
+        cur, scope_id, scope_end = head, None, None
+        while True:
+            if cur is None or (scope_end is not None and cur == scope_end):
+                if stack:
+                    cur, scope_id, scope_end = stack.pop()
+                    continue
+                break
+            steps += 1
+            if steps > limit:
+                raise RuntimeError("cycle detected in move-aware walk")
+            s, r = cur
+            kind = int(bl.kind[s, r])
+            is_real_move = kind == CONTENT_MOVE and int(bl.content_ref[s, r]) != -2
+            if (
+                is_real_move
+                and not bool(bl.deleted[s, r])
+                and owner_id(cur) == scope_id
+            ):
+                i, j = move_bounds(cur)
+                stack.append((succ(cur), scope_id, scope_end))
+                scope_id = (int(bl.client[s, r]), int(bl.clock[s, r]))
+                scope_end = j
+                cur = i
+                continue
+            if owner_id(cur) == scope_id and kind != CONTENT_MOVE:
+                if not bool(bl.deleted[s, r]):
+                    ref = int(bl.content_ref[s, r])
+                    off = int(bl.content_off[s, r])
+                    ln = int(bl.length[s, r])
+                    if text_only:
+                        if kind == CONTENT_STRING:
+                            yield s, r, self.enc.payloads.slice_text(ref, off, ln)
+                    elif kind in (CONTENT_STRING, CONTENT_ANY):
+                        if kind == CONTENT_STRING:
+                            # device get_values parity: one element per
+                            # character, not one per block
+                            for ch in self.enc.payloads.slice_text(ref, off, ln):
+                                yield s, r, ch
+                        else:
+                            for v in self.enc.payloads.slice_values(ref, off, ln):
+                                yield s, r, v
+            cur = succ(cur)
 
     def get_map(self) -> dict:
         """The root map component's live values (chain tails; LWW)."""
@@ -1792,8 +2072,22 @@ class ShardedDoc:
                 a, b = items[a_key], items[b_key]
                 (sa_, ra_), (sb_, rb_) = run[gi], run[gi + 1]
                 mv_a, mv_b = int(bl_mv[sa_, ra_]), int(bl_mv[sb_, rb_])
+                # cross-shard junction rows owned by the SAME LOGICAL move
+                # (the real row on one shard, its claim mirror on the
+                # other — both carry the move's real id) compare by owner
+                # identity, not local slot (r5 cross-segment moves)
+                same_logical = (
+                    mv_a >= 0
+                    and mv_b >= 0
+                    and int(st.blocks.client[sa_, mv_a])
+                    == int(st.blocks.client[sb_, mv_b])
+                    and int(st.blocks.clock[sa_, mv_a])
+                    == int(st.blocks.clock[sb_, mv_b])
+                )
                 moved_ok = (
-                    mv_a == mv_b if sa_ == sb_ else (mv_a == -1 and mv_b == -1)
+                    mv_a == mv_b
+                    if sa_ == sb_
+                    else ((mv_a == -1 and mv_b == -1) or same_logical)
                 )
                 # a junction both of whose sides are owned by the SAME
                 # live move was a claim-merge candidate at that move's
@@ -1803,9 +2097,8 @@ class ShardedDoc:
                 # Released ownership (owner deleted / None-None) keeps
                 # repair splits standing, like the oracle's delete path.
                 claim_merged = (
-                    sa_ == sb_
-                    and mv_a >= 0
-                    and mv_a == mv_b
+                    mv_a >= 0
+                    and (mv_a == mv_b if sa_ == sb_ else same_logical)
                     and not bool(st.blocks.deleted[sa_, mv_a])
                 )
                 if (
