@@ -457,15 +457,28 @@ def _device_configs(result: dict, flush) -> None:
         cfgs["error"] = f"load benches/device.py: {type(e).__name__}: {e}"[:300]
         flush()
         return
+    deferred = []
     for key, fn, docs in (
         ("config3", mod.bench_config3, CFG_DOCS),
         ("config4", mod.bench_config4, CFG_DOCS),
         ("config5", mod.bench_config5, CFG5_DOCS),
     ):
         try:
-            cfgs[key] = fn(docs)
+            res = fn(docs)
+            fused_fn = res.pop("_fused", None)
+            cfgs[key] = res
+            if fused_fn is not None:
+                deferred.append((res, fused_fn))
         except Exception as e:
             cfgs[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        flush()
+    # fused lanes LAST (a Pallas fault can kill the worker; every XLA
+    # number is flushed by now, so only the fused extras are at risk)
+    for res, fused_fn in deferred:
+        try:
+            mod.merge_fused_lane(res, fused_fn)
+        except Exception as e:
+            res["fused_error"] = f"{type(e).__name__}: {e}"[:200]
         flush()
 
 

@@ -39,6 +39,71 @@ def capture(doc):
     return log
 
 
+def fused_lane_rate(make_state, stream, rank, n_docs, n_updates, validate):
+    """Measure the fused Pallas lane on the same stream (r5: the kernel is
+    silicon-correct after the aliased-output init fix; rung9_bisect.json).
+    Runs AFTER the XLA measure — crash order — and only on real devices
+    (interpret mode would take hours on CPU; set YTPU_CFG_FUSED=1 to
+    force). Returns (updates_per_sec | None, error | None)."""
+    import jax
+
+    if (
+        jax.devices()[0].platform == "cpu"
+        and os.environ.get("YTPU_CFG_FUSED") != "1"
+    ):
+        return None, "skipped on cpu"
+    from ytpu.ops.integrate_kernel import apply_update_stream_fused
+
+    try:
+        d_block = int(os.environ.get("YTPU_CFG_FUSED_DBLOCK", "32")) or 32
+        while n_docs % d_block:
+            d_block //= 2
+        interpret = jax.devices()[0].platform == "cpu"
+
+        def run(st):
+            return apply_update_stream_fused(
+                st, stream, rank, d_block=d_block, interpret=interpret,
+                guard=False, refresh_cache=False,
+            )
+
+        st = run(make_state())  # compile + warm
+        err = int(np.asarray(st.error).max())
+        if err != 0:
+            return None, f"error flags {err}"
+        validate(st)
+        st = make_state()
+        np.asarray(st.n_blocks)
+        t0 = time.perf_counter()
+        st = run(st)
+        np.asarray(st.n_blocks)
+        return n_updates * n_docs / (time.perf_counter() - t0), None
+    except Exception as e:  # noqa: BLE001 — a fused fault must not void the XLA capture
+        return None, f"{type(e).__name__}: {e}"[:200]
+
+
+def merge_fused_lane(result, fused_fn):
+    """Run a deferred fused-lane measurement and fold it into a config's
+    result dict (headline = best VALIDATED lane; both rates reported).
+    Call AFTER every config's XLA measure has flushed — a fused Pallas
+    fault can kill the TPU worker process, which no try/except catches."""
+    fused_rate, fused_err = fused_fn()
+    result["fused_updates_per_sec"] = (
+        round(fused_rate, 1) if fused_rate else None
+    )
+    result["fused_error"] = fused_err
+    if fused_rate and fused_rate > result["xla_updates_per_sec"]:
+        result["value"] = round(fused_rate, 1)
+        result["lane"] = "fused"
+        native = result.get("native_updates_per_sec")
+        if native:
+            result["vs_native"] = round(fused_rate / native, 2)
+            result["vs_baseline"] = result["vs_native"]
+        py = result.get("py_oracle_updates_per_sec")
+        if py:
+            result["vs_py_oracle"] = round(fused_rate / py, 2)
+    return result
+
+
 def timed_host_replay(log):
     doc = Doc(client_id=0xBEEF)
     t0 = time.perf_counter()
@@ -186,20 +251,35 @@ def bench_config3(n_docs: int):
     rate = len(log) * n_docs / dt
     py_rate = len(log) / host_dt
     native_rate = timed_native_replay(log, [("a", "seq", expect)])
+
+    def _validate(st):
+        assert get_values(st, 0, enc.payloads) == expect
+
     # the honest baseline is the native-speed single-core CPU engine
     # (VERDICT r4 missing #2); the Python-oracle ratio stays visible but
     # never headlines
-    return {
+    result = {
         "metric": "config3_array_256client_updates_per_sec",
         "value": round(rate, 1),
+        "lane": "xla",
         "unit": f"updates/s over {n_docs}-doc batch (256-client concurrent array)",
         "vs_baseline": round(rate / (native_rate or py_rate), 2),
         "baseline_kind": "native_cpp" if native_rate else "py_oracle_SOFT",
         "vs_native": round(rate / native_rate, 2) if native_rate else None,
         "vs_py_oracle": round(rate / py_rate, 2),
         "native_updates_per_sec": round(native_rate, 1) if native_rate else None,
+        "py_oracle_updates_per_sec": round(py_rate, 1),
+        "xla_updates_per_sec": round(rate, 1),
         "conflict_scan_width": scan_stats,
+        # crash-ordered fused lane: callers run this AFTER every config's
+        # XLA measure has flushed (merge_fused_lane); json-flush callers
+        # must pop it first
+        "_fused": lambda: fused_lane_rate(
+            lambda: init_state(n_docs, 2048),
+            stream, rank, n_docs, len(log), _validate,
+        ),
     }
+    return result
 
 
 def bench_config4(n_docs: int):
@@ -257,15 +337,28 @@ def bench_config4(n_docs: int):
             ("x", "seq", host_xml),
         ],
     )
+
+    def _validate(st):
+        assert (
+            get_tree(st, 0, enc.payloads, enc.keys)["map"]
+            == host_doc.get_map("m").to_json()
+        )
+
     return {
         "metric": "config4_map_xml_updates_per_sec",
         "value": round(rate, 1),
+        "lane": "xla",
         "unit": f"updates/s over {n_docs}-doc batch (map+xml tenants)",
         "vs_baseline": round(rate / (native_rate or py_rate), 2),
         "baseline_kind": "native_cpp" if native_rate else "py_oracle_SOFT",
         "vs_native": round(rate / native_rate, 2) if native_rate else None,
         "vs_py_oracle": round(rate / py_rate, 2),
         "native_updates_per_sec": round(native_rate, 1) if native_rate else None,
+        "py_oracle_updates_per_sec": round(py_rate, 1),
+        "xla_updates_per_sec": round(rate, 1),
+        "_fused": lambda: fused_lane_rate(
+            seed, stream, rank, n_docs, len(log), _validate
+        ),
     }
 
 
@@ -431,9 +524,19 @@ def main():
     args = ap.parse_args()
     runners = {"3": bench_config3, "4": bench_config4, "5": bench_config5}
     chosen = ["3", "4", "5"] if args.config == "all" else [args.config]
+    results, deferred = [], []
     for key in chosen:
         n_docs = args.docs if key != "4" else min(args.docs, 4096)
-        print(json.dumps(runners[key](n_docs)))
+        res = runners[key](n_docs)
+        fused_fn = res.pop("_fused", None)
+        results.append(res)
+        if fused_fn is not None:
+            deferred.append((res, fused_fn))
+        print(json.dumps(res))
+    # the crash-risky fused lane runs only after EVERY XLA measure printed
+    for res, fused_fn in deferred:
+        merge_fused_lane(res, fused_fn)
+        print(json.dumps(res))
 
 
 if __name__ == "__main__":
