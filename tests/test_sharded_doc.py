@@ -529,6 +529,141 @@ def test_cross_segment_move_renders_and_encodes():
     assert sd.encode_state_as_update_v1() == oracle.encode_state_as_update_v1()
 
 
+def _gcify(payload: bytes) -> bytes:
+    """Rewrite a full-state update the way a gc-enabled yrs peer encodes
+    it: deleted items become position-free GC carriers (BlockCell::GC)."""
+    from collections import deque
+
+    from ytpu.core.block import GCRange
+    from ytpu.core.content import CONTENT_DELETED
+    from ytpu.core.update import Update
+
+    u = Update.decode_v1(payload)
+    blocks = {}
+    for cl, q in u.blocks.items():
+        out = deque()
+        for carr in q:
+            if (
+                getattr(carr, "is_item", False)
+                and carr.content.kind == CONTENT_DELETED
+            ):
+                out.append(GCRange(carr.id, carr.len))
+            else:
+                out.append(carr)
+        blocks[cl] = out
+    return Update(blocks=blocks, delete_set=u.delete_set).encode_v1()
+
+
+def test_gc_carriers_registry_and_encode():
+    """Round 5 (second session): GC carriers from gc-enabled peers
+    integrate (id-index registry, like BlockCell::GC — no sequence
+    position) instead of raising; they advance the SV, re-emit at encode
+    in per-client clock order, and land in the delete set — byte-exact
+    vs a host replica that applied the same GC'd state."""
+    a = Doc(client_id=1)
+    t = a.get_text("t")
+    with a.transact() as txn:
+        t.insert(txn, 0, "hello cruel world")
+    with a.transact() as txn:
+        t.remove_range(txn, 5, 6)  # " cruel"
+    payload = _gcify(a.encode_state_as_update_v1())
+
+    sd = ShardedDoc(n_shards=4, capacity=256, root_name="t")
+    sd.apply_update_v1(payload)
+    sd.flush()
+    replica = Doc(client_id=9)
+    replica.apply_update_v1(payload)
+    # reference-faithful: " world"'s only anchor is GC'd, so the carrier
+    # DEGRADES to a GC range (update.rs unresolvable-parent rule) — the
+    # oracle keeps just "hello", and so must the sharded engine
+    assert replica.get_text("t").get_string() == "hello"
+    assert sd.get_string() == "hello"
+    assert sd._gc_ranges, "GC carriers should populate the registry"
+    assert sd.encode_state_as_update_v1() == replica.encode_state_as_update_v1()
+
+
+@pytest.mark.parametrize(
+    "insert_at",
+    [
+        3,  # origin 'c' + ror 'd' both GC'd -> the carrier DEGRADES to a
+        #     GC range (reference update.rs unresolvable-parent rule)
+        4,  # origin 'd' GC'd, ror 'e' live -> parent via the right
+        #     anchor, host boundary scan places the row
+        1,  # origin 'a' live, ror 'b' GC'd -> left-only integration,
+        #     scan to the tail (reference right=None behavior)
+    ],
+)
+def test_item_anchored_into_gcd_region(insert_at):
+    """A stale peer's insert whose anchors were since GC'd: parity vs the
+    host oracle applying the same updates in the same order (the oracle
+    IS the ported reference semantics, incl. the degrade-to-GC rule)."""
+    a = Doc(client_id=1)
+    b = Doc(client_id=2)
+    ta = a.get_text("t")
+    with a.transact() as txn:
+        ta.insert(txn, 0, "abcdef")
+    pre_gc = a.encode_state_as_update_v1()
+    b.apply_update_v1(pre_gc)
+    tb = b.get_text("t")
+    with b.transact() as txn:
+        tb.insert(txn, insert_at, "XY")
+    b_update = b.encode_state_as_update_v1(a.state_vector())
+    with a.transact() as txn:
+        ta.remove_range(txn, 1, 3)  # "bcd"
+    gc_state = _gcify(a.encode_state_as_update_v1())
+
+    sd = ShardedDoc(n_shards=4, capacity=256, root_name="t")
+    sd.apply_update_v1(gc_state)
+    sd.apply_update_v1(b_update)
+    sd.flush()
+    oracle = Doc(client_id=9)
+    oracle.apply_update_v1(gc_state)
+    oracle.apply_update_v1(b_update)
+    assert sd.get_string() == oracle.get_text("t").get_string(), insert_at
+    assert (
+        sd.encode_state_as_update_v1() == oracle.encode_state_as_update_v1()
+    ), insert_at
+
+
+def test_gc_carrier_through_pending_stash():
+    """A GC carrier arriving BEFORE the clocks below it (out-of-order
+    delivery) stashes in pending and must dispatch through the GC
+    registry on retry, not crash in _route_row (code-review r5)."""
+    a = Doc(client_id=1)
+    t = a.get_text("t")
+    log = capture(a)
+    with a.transact() as txn:
+        t.insert(txn, 0, "base")
+    with a.transact() as txn:
+        t.insert(txn, 4, "-tail")
+    with a.transact() as txn:
+        t.remove_range(txn, 4, 5)  # "-tail" -> deleted
+    full = _gcify(a.encode_state_as_update_v1())
+    # deliver the LATER update (containing the GC range over "-tail")
+    # first: its carriers stash; then the base fills the gap
+    from collections import deque as _dq
+
+    from ytpu.core.update import Update
+
+    sd = ShardedDoc(n_shards=2, capacity=128, root_name="t")
+    u = Update.decode_v1(full)
+
+    later = {
+        cl: _dq(c for c in q if c.id.clock >= 4) for cl, q in u.blocks.items()
+    }
+    earlier = {
+        cl: _dq(c for c in q if c.id.clock < 4) for cl, q in u.blocks.items()
+    }
+    sd.apply_update(Update(blocks=later, delete_set=u.delete_set))
+    assert sd.pending  # stashed on the clock gap
+    sd.apply_update(Update(blocks=earlier))
+    sd.flush()
+    replica = Doc(client_id=9)
+    replica.apply_update_v1(full)
+    assert sd.get_string() == replica.get_text("t").get_string() == "base"
+    assert sd.encode_state_as_update_v1() == replica.encode_state_as_update_v1()
+
+
 def test_nested_branch_move_beside_multishard_root():
     """A move INSIDE a shard-affine nested branch while the primary root
     spans 4 segments: branch-scoped bounds mean the BRANCH head/tail, so

@@ -638,6 +638,13 @@ class ShardedDoc:
         # cross-segment moves: (interned client, clock) of a move row ->
         # shards holding its claim mirrors (tombstone propagation)
         self._move_mirrors: Dict[Tuple[int, int], List[int]] = {}
+        # GC carriers (BlockCell::GC): id-index-only ranges, like the
+        # reference — GC cells have no sequence position, so they live in
+        # a host registry (interned client -> sorted merged [start, end)),
+        # advance the SV, resolve origin lookups (an item anchored into a
+        # GC'd region scan-integrates from the parent head, exactly the
+        # reference's repair-to-GC behavior), and re-emit at encode
+        self._gc_ranges: Dict[int, List[List[int]]] = {}
         self._queue_rows: List[List[tuple]] = [[] for _ in range(n_shards)]
         self._queue_dels: List[List[tuple]] = [[] for _ in range(n_shards)]
         self._queued = 0
@@ -948,6 +955,48 @@ class ShardedDoc:
             seen += 1
         return False
 
+    def _apply_carrier(self, carrier) -> None:
+        """Dispatch one dedup/trimmed carrier: Skip is a no-op, GC ranges
+        are id-index-only (BlockCell::GC — registry + SV advance; the
+        known prefix is a duplicate, trimmed like the reference's offset
+        dedup at update.rs:197-225), Items route. Shared by apply_update
+        and the pending retry loop (a stashed GC carrier must not reach
+        _route_row — code-review r5)."""
+        if isinstance(carrier, SkipRange):
+            return
+        if isinstance(carrier, GCRange):
+            c = self.enc.interner.intern(carrier.id.client)
+            start, end = carrier.id.clock, carrier.id.clock + carrier.len
+            known = self.sv.get(carrier.id.client)
+            if end > known:
+                self._register_gc(c, max(start, known), end)
+                self.sv.set_max(carrier.id.client, end)
+            return
+        self._route_row(carrier)
+
+    def _register_gc(self, c: int, start: int, end: int) -> None:
+        """Record a GC range [start, end) for interned client c. Only true
+        OVERLAPS merge (idempotent re-delivery); ADJACENT ranges stay
+        separate cells — the oracle keeps separately-arrived GC carriers
+        distinct at encode (byte parity), like the reference's block
+        array does until a squash pass happens to visit them."""
+        rs = self._gc_ranges.setdefault(c, [])
+        rs.append([start, end])
+        rs.sort()
+        merged: List[List[int]] = []
+        for s_, e_ in rs:
+            if merged and s_ < merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], e_)
+            else:
+                merged.append([s_, e_])
+        self._gc_ranges[c] = merged
+
+    def _covered_by_gc(self, c: int, k: int) -> bool:
+        for s_, e_ in self._gc_ranges.get(c, []):
+            if s_ <= k < e_:
+                return True
+        return False
+
     def _emit_move_mirrors(self, c, clock, length, mirrors) -> None:
         """Enqueue claim-mirror rows (content_ref -2, no origins, no wire
         bookkeeping: mirrors never journal, register in the directory, or
@@ -1141,6 +1190,15 @@ class ShardedDoc:
             if s_o is not None:
                 target = self.dir.owner(*s_o)
                 if target is None:
+                    if self._covered_by_gc(*s_o):
+                        # a map write anchored on a GC'd chain member:
+                        # mirroring the reference's chain-head rescan
+                        # through the registry is unbuilt — fail LOUDLY
+                        # rather than silently diverge from the oracle
+                        raise NotImplementedError(
+                            "sharded docs: map-chain anchor was GC'd; "
+                            "replay through the unsharded engine"
+                        )
                     raise RuntimeError(
                         f"map origin {s_o} not in directory (routing bug)"
                     )
@@ -1184,6 +1242,11 @@ class ShardedDoc:
             if s_o is not None:
                 target = self.dir.owner(*s_o)
                 if target is None:
+                    if self._covered_by_gc(*s_o):
+                        raise NotImplementedError(
+                            "sharded docs: nested-branch anchor was GC'd; "
+                            "replay through the unsharded engine"
+                        )
                     raise RuntimeError(
                         f"nested origin {s_o} not in directory (routing bug)"
                     )
@@ -1216,13 +1279,53 @@ class ShardedDoc:
         if s_o is not None:
             target = self.dir.owner(*s_o)
             if target is None:
+                if self._covered_by_gc(*s_o):
+                    # origin GC'd: repair leaves left unresolved
+                    # (block.rs:1287-1292 via get_item -> None on a GC
+                    # cell). If the right origin resolves, the parent
+                    # inherits from it and the reference scan places the
+                    # row (host boundary resolver = that scan, wire
+                    # origin preserved on the stored row). With NO
+                    # resolvable anchor the parent stays Unknown and the
+                    # carrier DEGRADES to a GC range — the reference's
+                    # update.rs unresolvable-parent rule, observed on the
+                    # host oracle (tests/test_sharded_doc.py gc tests).
+                    ror_live = (
+                        s_r is not None and self.dir.owner(*s_r) is not None
+                    )
+                    if ror_live:
+                        self._resolve_boundary(
+                            item, c, clock, length, s_o, s_r, kind, ref,
+                            offset, mv_fields,
+                        )
+                    else:
+                        self._register_gc(c, clock, clock + length)
+                        self.sv.set_max(real_client, clock + length)
+                    return
                 raise RuntimeError(f"origin {s_o} not in directory (routing bug)")
         else:
             target = self._first_nonempty()
             self.first_id[target] = None  # a new head may arrive
 
         a_r: Optional[Tuple[int, int]] = None
-        if s_r is not None:
+        ror_gc = (
+            s_r is not None
+            and self.dir.owner(*s_r) is None
+            and self._covered_by_gc(*s_r)
+        )
+        if ror_gc:
+            # right origin GC'd: integrate with the left anchor only (the
+            # reference's right=None behavior; the stored row keeps the
+            # wire ror). The scan then runs to the GLOBAL tail — only the
+            # local segment's tail is reachable on device, so when later
+            # segments hold rows, resolve the exact placement on host.
+            if not self._shards_empty_after(target):
+                self._resolve_boundary(
+                    item, c, clock, length, s_o, s_r, kind, ref, offset,
+                    mv_fields,
+                )
+                return
+        elif s_r is not None:
             r_owner = self.dir.owner(*s_r)
             if r_owner is None:
                 raise RuntimeError(f"right origin {s_r} not in directory")
@@ -1559,13 +1662,7 @@ class ShardedDoc:
         stash/retry pending semantics run on the host router)."""
         applicable, leftover = self.enc.partition_carriers(update, local_sv=self.sv)
         for carrier in applicable:
-            if isinstance(carrier, SkipRange):
-                continue
-            if isinstance(carrier, GCRange):
-                raise NotImplementedError(
-                    "GC carriers need gc-enabled peers; sharded docs keep tombstones"
-                )
-            self._route_row(carrier)
+            self._apply_carrier(carrier)
         self.pending.extend(leftover)
         for client, ranges in update.delete_set.clients.items():
             for s_, e_ in sorted(ranges):
@@ -1656,7 +1753,7 @@ class ShardedDoc:
                 )
                 for carrier in applicable:
                     if not isinstance(carrier, SkipRange):
-                        self._route_row(carrier)
+                        self._apply_carrier(carrier)
                         progress = True
                 self.pending = leftover
             if self.pending_ds:
@@ -2122,14 +2219,27 @@ class ShardedDoc:
                     merged_into[b_key] = a_key
                     del items[b_key]
 
+        # GC carriers re-emit from the registry, merged into each client's
+        # clock-sorted carrier list (the reference stores GC cells in the
+        # same per-client block array and encodes them in clock order)
+        carriers: List[object] = list(items.values())
+        for c_i, ranges in self._gc_ranges.items():
+            real = self.enc.interner.from_idx[c_i]
+            for s_, e_ in ranges:
+                carriers.append(GCRange(ID(real, s_), e_ - s_))
         blocks: Dict[int, deque] = {}
-        for key in sorted(items, key=lambda k: (items[k].id.client, items[k].id.clock)):
-            it = items[key]
+        for it in sorted(carriers, key=lambda k: (k.id.client, k.id.clock)):
             blocks.setdefault(it.id.client, deque()).append(it)
         ds = DeleteSet()
         for it_key, it in items.items():
             if it.deleted:
                 ds.insert_range(it.id.client, it.id.clock, it.id.clock + it.len)
+        # GC ranges count as deleted content in the delete set, matching
+        # the oracle (store.py:344-345)
+        for c_i, ranges in self._gc_ranges.items():
+            real = self.enc.interner.from_idx[c_i]
+            for s_, e_ in ranges:
+                ds.insert_range(real, s_, e_)
         update = Update(blocks=blocks, delete_set=ds)
         if remote_sv is None:
             return update.encode_v1()
