@@ -1,0 +1,20 @@
+#!/bin/bash
+# Round-5 recovery capture: when the tunnel returns, run the full
+# crash-ordered bench (flagship-first, fused config lanes last) and then
+# the fused-vs-xla prefix ratio. One healthy window lands everything.
+cd /root/repo
+while true; do
+  if timeout 60 python -c "import jax, jax.numpy as j; j.ones((4,4)).sum().block_until_ready()" 2>/dev/null; then
+    echo "$(date +%H:%M:%S) TUNNEL UP - full bench" >> benches/recovery_capture.log
+    YTPU_BENCH_DEVICE_TIMEOUT=5400 timeout 7200 python bench.py \
+      > benches/bench_recovery.out 2>&1
+    tail -1 benches/bench_recovery.out > BENCH_r05_midsession2.json
+    echo "$(date +%H:%M:%S) bench done - fused_vs_xla_prefix" >> benches/recovery_capture.log
+    timeout 3600 python benches/fused_vs_xla_prefix.py 160000 64 \
+      > benches/fused_vs_xla_prefix.log 2>&1
+    echo "$(date +%H:%M:%S) all done" >> benches/recovery_capture.log
+    exit 0
+  fi
+  echo "$(date +%H:%M:%S) down" >> benches/recovery_capture.log
+  sleep 90
+done

@@ -1774,7 +1774,12 @@ class ShardedDoc:
 
     def find_position(self, pos: int) -> Tuple[int, int]:
         """(shard, local offset) for a visible position — prefix sum over
-        shard lengths instead of the reference's O(doc) item walk."""
+        shard lengths instead of the reference's O(doc) item walk.
+
+        Caveat: while CROSS-SEGMENT move claims exist (`_move_mirrors`),
+        visible order interleaves across segments and this positional map
+        is approximate — exact positions then come from the global
+        move-aware walk (`_global_visible_content`)."""
         lens = self.shard_lengths()
         cum = np.concatenate([[0], np.cumsum(lens)])
         shard = int(np.searchsorted(cum[1:], pos, side="right"))
