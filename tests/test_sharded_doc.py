@@ -625,6 +625,56 @@ def test_item_anchored_into_gcd_region(insert_at):
     ), insert_at
 
 
+def test_rebalance_with_live_moves():
+    """Round 5 (second session): rebalance no longer refuses live moves —
+    claim mirrors drop with the old layout and every live move re-plans
+    its localized bounds + mirrors against the fresh cuts, followed by a
+    full ownership recompute. Byte-exact + value parity vs the oracle
+    across two re-cuts with moves before, between, and after."""
+    rng = random.Random(31)
+    d = Doc(client_id=1, skip_gc=True)
+    log = capture(d)
+    arr = d.get_array("a")
+    with d.transact() as txn:
+        arr.insert_range(txn, 0, list(range(14)))
+    sd = ShardedDoc(n_shards=4, capacity=1024, root_name="a")
+    sd.apply_update_v1(log[0])
+    sd.rebalance()
+
+    def random_op(step):
+        with d.transact() as txn:
+            n = len(arr)
+            r = rng.random()
+            if r < 0.45 and n > 2:
+                s = rng.randrange(n)
+                t = rng.randrange(n)
+                if t not in (s, s + 1):
+                    arr.move_to(txn, s, t)
+            elif r < 0.6 and n > 5:
+                a0 = rng.randrange(n - 3)
+                a1 = a0 + rng.randrange(1, min(3, n - a0 - 1))
+                t = rng.choice(
+                    [x for x in range(n) if x < a0 or x > a1 + 1] or [0]
+                )
+                arr.move_range_to(txn, a0, a1, t)
+            else:
+                arr.insert(txn, rng.randrange(n + 1), 200 + step)
+        sd.apply_update_v1(log[-1])
+
+    for step in range(6):
+        random_op(step)
+    sd.rebalance()  # live moves present: re-plan + recompute
+    for step in range(6, 12):
+        random_op(step)
+    sd.rebalance()
+    sd.flush()
+    oracle = Doc(client_id=9, skip_gc=True)
+    for p in log:
+        oracle.apply_update_v1(p)
+    assert sd.get_values() == oracle.get_array("a").to_json()
+    assert sd.encode_state_as_update_v1() == oracle.encode_state_as_update_v1()
+
+
 def test_gc_carrier_through_pending_stash():
     """A GC carrier arriving BEFORE the clocks below it (out-of-order
     delivery) stashes in pending and must dispatch through the GC

@@ -645,6 +645,16 @@ class ShardedDoc:
         # GC'd region scan-integrates from the parent head, exactly the
         # reference's repair-to-GC behavior), and re-emit at encode
         self._gc_ranges: Dict[int, List[List[int]]] = {}
+        # (interned client, junction clock) pairs standing at a rebalance
+        # re-plan whose sides were NOT same-move-claimed then: later claim
+        # recomputes may make them same-owned, but the oracle's
+        # commit-step-7 squash never revisits them — the encode keeps
+        # them split. KNOWN LIMITATION: a post-rebalance NEW move whose
+        # commit claims across such a junction would have been squashed
+        # by the oracle; the standing veto then under-merges (narrower
+        # and rarer than the over-merge it prevents, which any recompute
+        # could trigger)
+        self._post_replan_boundaries: set = set()
         self._queue_rows: List[List[tuple]] = [[] for _ in range(n_shards)]
         self._queue_dels: List[List[tuple]] = [[] for _ in range(n_shards)]
         self._queued = 0
@@ -840,7 +850,12 @@ class ShardedDoc:
         return owner
 
     def _plan_move_mirrors(
-        self, mv_fields, target: int, c: int, clock: int, nested: bool = False
+        self,
+        mv_fields,
+        target: int,
+        c: int,
+        clock: int,
+        nested: bool = False,
     ):
         """Localize a move's claimed range per shard (r5: cross-SEGMENT
         ranges supported via claim mirrors).
@@ -868,7 +883,11 @@ class ShardedDoc:
             # BRANCH's head/tail (resolved against the parent row's head
             # column on device), never the segmented primary root
             return mv_fields, []
-        nonempty = [s for s in range(self.S) if self._n_rows[s] > 0 or self._queue_rows[s]]
+        nonempty = [
+            s
+            for s in range(self.S)
+            if self._n_rows[s] > 0 or self._queue_rows[s]
+        ]
         if not nonempty:
             return mv_fields, []
         if sc_i >= 0:
@@ -2198,10 +2217,18 @@ class ShardedDoc:
                 # oracle re-merged it, so the journal boundary yields.
                 # Released ownership (owner deleted / None-None) keeps
                 # repair splits standing, like the oracle's delete path.
+                owner_alive = mv_a >= 0 and not bool(
+                    st.blocks.deleted[sa_, mv_a]
+                )
                 claim_merged = (
-                    mv_a >= 0
+                    owner_alive
                     and (mv_a == mv_b if sa_ == sb_ else same_logical)
-                    and not bool(st.blocks.deleted[sa_, mv_a])
+                    # commit-step-7 squash happened at the CLAIMING commit
+                    # only if the pair was adjacent then; ownership that
+                    # became adjacent later (e.g. after a rebalance
+                    # re-plan) keeps its recorded split standing
+                    and (interned.get(a.id.client, -1), b.id.clock)
+                    not in self._post_replan_boundaries
                 )
                 if (
                     moved_ok
@@ -2264,16 +2291,13 @@ class ShardedDoc:
         encode time, so wire parity is preserved. Anchors that later
         straddle the new boundaries either hit the exact-first-id fast
         path or the host resolver."""
-        if self._parent_index or self._root_anchor_shard or self._has_moves:
+        if self._parent_index or self._root_anchor_shard:
             # nested branches / secondary roots are shard-AFFINE (not
             # segment-cut); re-cutting would strand children from their
-            # parent row — and would split shard-local move ranges.
-            # Rebalance currently re-cuts the primary root only, so
-            # refuse when affine rows or moves exist.
+            # parent row. Rebalance re-cuts the primary root only.
             raise NotImplementedError(
-                "rebalance with nested branches / secondary roots / "
-                "moves: affine rows must move with their parent and "
-                "move ranges must stay whole"
+                "rebalance with nested branches / secondary roots: "
+                "affine rows must move with their parent"
             )
         self.flush()
         st = self._pull()
@@ -2281,7 +2305,13 @@ class ShardedDoc:
         bl = st.blocks
         rows: List[Dict[str, int]] = []
         for s, r in order:
-            rows.append({n: int(getattr(bl, n)[s, r]) for n in BlockCols._fields})
+            row = {n: int(getattr(bl, n)[s, r]) for n in BlockCols._fields}
+            # ownership slots and localized move bounds are layout-bound:
+            # reset here, re-derived after the re-cut (claim mirrors are
+            # unlinked so `_global_rows` drops them; live moves re-plan
+            # from their ORIGINAL payload bounds below)
+            row["moved"] = -1
+            rows.append(row)
         # map key chains hold no doc position: they stay on their key
         # shard (key id % S), re-appended after the sequence re-cut
         chains: List[List[Dict[str, int]]] = []
@@ -2389,6 +2419,106 @@ class ShardedDoc:
         self.capacity = cap
         self._n_rows = n_blocks.astype(np.int64)
         self._invalidate()
+
+        # --- re-plan move claims over the fresh layout (r5) --------------
+        # old claim mirrors were dropped by the walk (unlinked); every
+        # LIVE move row re-derives its localized bounds + mirrors from
+        # its ORIGINAL payload bounds against the new segment cuts
+        self._move_mirrors = {}
+        if self._has_moves:
+            from ytpu.core.content import CONTENT_MOVE as _MV
+
+            to_idx = self.enc.interner.to_idx
+            planned = []  # (shard, slot, local_fields, c, clock, mirrors)
+            for s in range(self.S):
+                for li in range(int(n_blocks[s])):
+                    if (
+                        int(arrays["kind"][s, li]) != _MV
+                        or arrays["deleted"][s, li]
+                        or int(arrays["content_ref"][s, li]) == -2
+                    ):
+                        continue
+                    mv = self.enc.payloads.items[
+                        int(arrays["content_ref"][s, li])
+                    ][1].move
+                    sc_i, sk_i, sa_i = -1, 0, mv.start.assoc
+                    if mv.start.id is not None:
+                        sc_i = to_idx.get(mv.start.id.client, -1)
+                        sk_i = mv.start.id.clock
+                    ec_i, ek_i, ea_i = -1, 0, mv.end.assoc
+                    if mv.end.id is not None:
+                        ec_i = to_idx.get(mv.end.id.client, -1)
+                        ek_i = mv.end.id.clock
+                    fields = (
+                        sc_i, sk_i, sa_i, ec_i, ek_i, ea_i,
+                        max(mv.priority, 0),
+                    )
+                    c_i = int(arrays["client"][s, li])
+                    ck_i = int(arrays["clock"][s, li])
+                    local, mirrors = self._plan_move_mirrors(
+                        fields, s, c_i, ck_i
+                    )
+                    planned.append((s, li, local, c_i, ck_i, mirrors))
+            if planned:
+                bl2 = self.state.blocks
+                upd = {
+                    n: np.array(getattr(bl2, n))  # writable copies
+                    for n in (
+                        "mv_sc", "mv_sk", "mv_sa", "mv_ec", "mv_ek", "mv_ea",
+                    )
+                }
+                for s, li, local, _c, _ck, _m in planned:
+                    (
+                        upd["mv_sc"][s, li], upd["mv_sk"][s, li],
+                        upd["mv_sa"][s, li], upd["mv_ec"][s, li],
+                        upd["mv_ek"][s, li], upd["mv_ea"][s, li],
+                    ) = local[:6]
+                self.state = self.state._replace(
+                    blocks=bl2._replace(
+                        **{n: jnp.asarray(a) for n, a in upd.items()}
+                    )
+                )
+                for s, li, _local, c_i, ck_i, mirrors in planned:
+                    self._emit_move_mirrors(c_i, ck_i, 1, mirrors)
+                self.flush()
+            # ownership recompute on EVERY shard (claims were reset;
+            # shards without fresh mirrors get no step-dirty signal)
+            from ytpu.models.batch_doc import _recompute_moves
+
+            rank = self._rank()
+            self.state = jax.vmap(
+                lambda st: _recompute_moves(st, jnp.array(True), rank)
+            )(self.state)
+            self._n_rows = np.asarray(self.state.n_blocks).astype(np.int64)
+            self._invalidate()
+
+            # standing-junction audit for encode parity: pairs adjacent
+            # NOW but not same-claimed NOW can only become same-claimed
+            # through post-hoc recomputes the oracle's commit squash
+            # never saw (see _post_replan_boundaries)
+            st2 = self._pull()
+            bl3 = st2.blocks
+            order2 = self._global_rows(st2)
+            mvc = np.asarray(bl3.moved)
+            clc = np.asarray(bl3.client)
+            ckc = np.asarray(bl3.clock)
+            lnc = np.asarray(bl3.length)
+            for (sa2, ra2), (sb2, rb2) in zip(order2, order2[1:]):
+                if clc[sa2, ra2] != clc[sb2, rb2]:
+                    continue
+                if ckc[sa2, ra2] + lnc[sa2, ra2] != ckc[sb2, rb2]:
+                    continue
+                ma, mb = int(mvc[sa2, ra2]), int(mvc[sb2, rb2])
+                same_owned = (
+                    ma >= 0
+                    and mb >= 0
+                    and clc[sa2, ma] == clc[sb2, mb]
+                    and ckc[sa2, ma] == ckc[sb2, mb]
+                )
+                if not same_owned:
+                    self._post_replan_boundaries.add(
+                        (int(clc[sb2, rb2]), int(ckc[sb2, rb2]))
+                    )
 
     # ------------------------------------------------------------------ mesh
 
