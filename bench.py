@@ -36,6 +36,15 @@ must always print exactly ONE JSON line):
   (benches/device.py) and their JSON rides along under "configs".
 - On any device failure the JSON line still carries the host-oracle
   number plus an "error" field, so a round always records a measurement.
+- Every run embeds a `phases` breakdown (per-stage compile_s / execute_s
+  / transfer bytes, ytpu.utils.phases — parent host stages merged with
+  the child's device stages) and a `metrics` snapshot, so BENCH_r*.json
+  records WHERE time went, not just the total. `--dry-run` is the
+  host-only smoke (synthetic stream, no device child) that still prints
+  one JSON line with both keys — the exporter-regression guard
+  (tests/test_metrics_trace.py). With YTPU_TRACE=<path> set (use %p for
+  the pid), a dying device child dumps its flight-recorder ring as a
+  Chrome trace before exiting.
 """
 
 from __future__ import annotations
@@ -487,12 +496,21 @@ def _device_phase_child(in_path: str, out_path: str) -> None:
     progressively so a timeout kill keeps whatever phases finished —
     including phase 0 (backend init), whose timings tell a timed-out round
     exactly how far device bring-up got."""
+    from ytpu.utils import metrics, phases
+
+    phases.enable()
     with open(in_path, "rb") as f:
         job = pickle.load(f)
     result = {}
     t_start = time.perf_counter()
 
     def flush():
+        # per-stage compile/execute/transfer breakdown + metric snapshot
+        # ride every flush, so even a timeout-killed round records where
+        # device time went (the flight-recorder counterpart is the
+        # YTPU_TRACE ring dumped by _child_guard on exception)
+        result["phases"] = phases.snapshot()
+        result["metrics"] = metrics.snapshot()
         with open(out_path + ".tmp", "w") as f:
             json.dump(result, f)
         os.replace(out_path + ".tmp", out_path)
@@ -679,14 +697,33 @@ def _run_device_phase(job: dict, timeout: float = DEVICE_TIMEOUT):
             return None, err or f"device phase wrote no result: {e}"
 
 
-def main():
-    log, expect, trace = load_full_log()
-    if N_UPDATES and N_UPDATES < len(log):
-        log = log[:N_UPDATES]
-        trace += f"[:{N_UPDATES}]"
-        expect = None  # recomputed from the host replay below
+def main(dry_run: bool = False):
+    from ytpu.utils import metrics, phases
 
-    host_dt, host_text = host_replay(log)
+    phases.enable()
+    if dry_run:
+        # host-only exporter smoke: a small synthetic stream, no device
+        # child, still exactly ONE JSON line with the phases + metrics
+        # keys — the CI guard that catches exporter regressions before a
+        # real bench round burns a device window
+        n = int(os.environ.get("YTPU_BENCH_DRY_OPS", "400"))
+        with phases.span("host.build_log"):
+            ops = synthetic_ops(n)
+            log, expect = build_updates(ops)
+        trace = f"synthetic[{n}]"
+    else:
+        with phases.span("host.load_log"):
+            log, expect, trace = load_full_log()
+        if N_UPDATES and N_UPDATES < len(log):
+            log = log[:N_UPDATES]
+            trace += f"[:{N_UPDATES}]"
+            expect = None  # recomputed from the host replay below
+
+    with phases.span("host.replay"):
+        host_dt, host_text = host_replay(log)
+    metrics.counter("bench.updates_replayed").inc(len(log))
+    metrics.gauge("bench.wire_bytes").set(sum(len(p) for p in log))
+    metrics.histogram("bench.host_replay").observe(host_dt)
     cache_note = None
     if expect is not None and host_text != expect:
         # stale committed cache (older engine build): the live host replay
@@ -695,7 +732,8 @@ def main():
     expect = host_text
     host_rate = len(log) / host_dt
 
-    native = native_replay(log)
+    with phases.span("host.native_replay"):
+        native = native_replay(log)
     native_rate = None
     if native is not None:
         native_dt, native_text = native
@@ -711,6 +749,22 @@ def main():
         "quick_log": quick_log,
         "quick_expect": quick_expect,
     }
+
+    if dry_run:
+        out = {
+            "metric": "updates_integrated_per_sec_full_b4_trace",
+            "dry_run": True,
+            "host_oracle_updates_per_sec": round(host_rate, 1),
+            "value": round(native_rate or host_rate, 1),
+            "unit": f"updates/s single-doc host dry-run ({trace})",
+            "vs_baseline": 1.0,
+        }
+        if native_rate is not None:
+            out["native_updates_per_sec"] = round(native_rate, 1)
+        out["phases"] = phases.snapshot()
+        out["metrics"] = metrics.snapshot()
+        print(json.dumps(out))
+        return
 
     # Device phase: one child with the whole budget (no fail-fast probe —
     # device init alone can exceed 540s on the tunneled backend). Retry
@@ -841,11 +895,30 @@ def main():
         out["device_phase_error"] = err
     if cache_note:
         out["note"] = cache_note
+    # where the time went: child device stages (decode/integrate/compact,
+    # compile vs execute vs transfer bytes) + parent host stages, and a
+    # metrics snapshot — BENCH_r*.json finally records the breakdown, not
+    # just the total (stage names are disjoint, so the merge is lossless)
+    out["phases"] = {**((res or {}).get("phases") or {}), **phases.snapshot()}
+    out["metrics"] = {
+        **((res or {}).get("metrics") or {}),
+        **metrics.snapshot(),
+    }
     print(json.dumps(out))
 
 
 if __name__ == "__main__":
     if len(sys.argv) == 4 and sys.argv[1] == "--device-phase":
-        _device_phase_child(sys.argv[2], sys.argv[3])
+        try:
+            _device_phase_child(sys.argv[2], sys.argv[3])
+        except BaseException as e:
+            # flight-recorder hook: a dying child leaves a replayable
+            # Chrome trace (YTPU_TRACE, %p -> pid) instead of only a
+            # stderr tail. A SIGKILL timeout still skips this, but the
+            # progressive flush() above has the phase breakdown by then.
+            from ytpu.utils import tracer
+
+            tracer.dump_on_error(error=e)
+            raise
     else:
-        main()
+        main(dry_run="--dry-run" in sys.argv[1:])

@@ -58,3 +58,11 @@ import pytest  # noqa: F401 — fixtures may be added below
 # points register with `ytpu.utils.progbudget`, whose per-function
 # eviction (`fn.clear_cache()` on the largest holders) keeps the LLVM
 # arena bounded from inside the serving paths. No test fixture needed.
+
+
+def pytest_configure(config):
+    # the tier-1 gate runs `-m 'not slow'`; register the marker so slow
+    # smoke tests (bench exporter guard) don't warn as unknown
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate (-m 'not slow')"
+    )

@@ -1,6 +1,12 @@
-"""Metrics + tracing subsystem (SURVEY §5.1/§5.5 greenfield additions)."""
+"""Metrics + tracing + phase-timer subsystem (SURVEY §5.1/§5.5)."""
 
 import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
 
 from ytpu.utils import MetricsRegistry, Tracer
 
@@ -73,4 +79,284 @@ def test_server_records_apply_metrics():
     assert snap["sync.updates_applied"] == 1
     assert snap["sync.apply_update.count"] == 1
     assert snap["sync.apply_update.p99_s"] > 0
+    assert snap['sync.tenant_updates_applied{tenant="room"}'] == 1
+    assert snap["sync.sessions"] == 1
     assert server.doc("room").get_text("t").get_string() == "hi"
+
+
+# --- labeled metrics + gauges + Prometheus exposition -----------------------
+
+
+def test_labeled_counter_children():
+    reg = MetricsRegistry()
+    fam = reg.counter("req", labelnames=("tenant",))
+    fam.labels("a").inc()
+    fam.labels("a").inc(2)
+    fam.labels(tenant="b").inc()
+    assert fam.labels("a") is fam.labels("a")  # children are cached
+    snap = reg.snapshot()
+    assert snap['req{tenant="a"}'] == 3
+    assert snap['req{tenant="b"}'] == 1
+    # a labeled family refuses direct value ops
+    with pytest.raises(ValueError):
+        fam.inc()
+    # re-registering under a different schema is a conflict
+    with pytest.raises(ValueError):
+        reg.gauge("req")
+    with pytest.raises(ValueError):
+        reg.counter("req", labelnames=("other",))
+
+
+def test_gauge_set_inc_dec_and_max():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth")
+    g.set(4)
+    g.inc()
+    g.dec(2)
+    assert g.value == 3
+    g.set_max(10)
+    g.set_max(7)  # ratchet: lower values don't regress the mark
+    assert g.value == 10
+    lg = reg.gauge("slots", labelnames=("pool",))
+    lg.labels("x").set(5)
+    assert reg.snapshot()['slots{pool="x"}'] == 5
+
+
+_PROM_LINE = re.compile(
+    r"^(?:"
+    r"# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ?.*"
+    r"|"
+    r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r'(?:\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r" [0-9.eE+-]+(?:[0-9.eE+-]*)?"
+    r")$"
+)
+
+
+def test_prometheus_text_round_trips_format_validity():
+    reg = MetricsRegistry()
+    reg.counter("ops.total").inc(7)
+    reg.gauge("queue.depth").set(3)
+    fam = reg.counter("tenant.ops", labelnames=("tenant",))
+    fam.labels('we"ird\\name').inc()
+    h = reg.histogram("lat")
+    for ms in (1, 2, 5, 80):
+        h.observe(ms / 1000)
+    text = reg.prometheus_text()
+    lines = text.strip().splitlines()
+    assert lines, "empty exposition"
+    for ln in lines:
+        assert _PROM_LINE.match(ln), f"invalid exposition line: {ln!r}"
+    # TYPE headers name the SAMPLE family (counters sample as _total,
+    # so the header declares the _total name — prometheus_client parity)
+    assert "# TYPE ops_total_total counter" in text
+    assert "ops_total_total 7" in text
+    assert "# TYPE tenant_ops_total counter" in text
+    assert "# TYPE queue_depth gauge" in text
+    assert "# TYPE lat histogram" in text
+    # histogram contract: cumulative buckets, +Inf == _count, sum in s
+    buckets = [
+        float(ln.rsplit(" ", 1)[1])
+        for ln in lines
+        if ln.startswith("lat_bucket")
+    ]
+    assert buckets == sorted(buckets), "bucket series must be cumulative"
+    inf_line = [ln for ln in lines if 'le="+Inf"' in ln]
+    assert len(inf_line) == 1 and inf_line[0].endswith(" 4")
+    count_line = [ln for ln in lines if ln.startswith("lat_count")][0]
+    assert count_line.endswith(" 4")
+    sum_line = [ln for ln in lines if ln.startswith("lat_sum")][0]
+    assert abs(float(sum_line.rsplit(" ", 1)[1]) - 0.088) < 1e-6
+    # escaped label values survive
+    assert 'tenant="we\\"ird\\\\name"' in text
+
+
+def test_histogram_labeled_children():
+    reg = MetricsRegistry()
+    fam = reg.histogram("apply", labelnames=("lane",))
+    fam.labels("fast").observe(0.002)
+    fam.labels("fast").observe(0.004)
+    fam.labels("slow").observe(0.1)
+    snap = reg.snapshot()
+    assert snap['apply.count{lane="fast"}'] == 2
+    assert snap['apply.count{lane="slow"}'] == 1
+    assert snap['apply.p99_s{lane="slow"}'] >= 0.05
+
+
+# --- flight recorder: bounded ring + error dump -----------------------------
+
+
+def test_tracer_ring_buffer_evicts_oldest():
+    tr = Tracer(enabled=True, max_events=4)
+    for i in range(10):
+        with tr.span(f"s{i}"):
+            pass
+    payload = json.loads(tr.export_chrome_trace())
+    names = [e["name"] for e in payload["traceEvents"]]
+    assert names == ["s6", "s7", "s8", "s9"]  # drop-oldest, bounded
+    assert len(tr) == 4
+
+
+def test_tracer_instant_events_ride_the_ring():
+    tr = Tracer(enabled=True, max_events=8)
+    tr.instant("marker", stage="probe")
+    payload = json.loads(tr.export_chrome_trace())
+    (ev,) = payload["traceEvents"]
+    assert ev["ph"] == "i" and ev["args"] == {"stage": "probe"}
+
+
+def test_dump_on_error_writes_loadable_chrome_trace(tmp_path):
+    tr = Tracer(enabled=True, max_events=16)
+    with tr.span("decode"):
+        pass
+    path = str(tmp_path / "crash.json")
+    got = tr.dump_on_error(path, error=RuntimeError("kernel abort"))
+    assert got == path
+    data = json.loads(open(path).read())
+    names = [e["name"] for e in data["traceEvents"]]
+    assert names == ["decode", "error"]
+    err = data["traceEvents"][-1]
+    assert err["args"]["type"] == "RuntimeError"
+    assert "kernel abort" in err["args"]["message"]
+
+
+def test_dump_on_error_resolves_path_from_env(tmp_path, monkeypatch):
+    tr = Tracer(enabled=False, max_events=16)  # never enabled: still dumps
+    template = str(tmp_path / "t-%p.json")
+    monkeypatch.setenv("YTPU_TRACE", template)
+    got = tr.dump_on_error(error=ValueError("x"))
+    assert got == template.replace("%p", str(os.getpid()))
+    assert json.loads(open(got).read())["traceEvents"]
+    assert tr.enabled is False  # the dump didn't leave tracing on
+    monkeypatch.delenv("YTPU_TRACE")
+    assert tr.dump_on_error(error=ValueError("x")) is None
+
+
+def test_tracer_disabled_span_is_shared_noop():
+    tr = Tracer(enabled=False)
+    a = tr.span("x", big="arg")
+    b = tr.span("y")
+    assert a is b  # singleton: no per-call allocation when disabled
+
+
+# --- device-phase timers ----------------------------------------------------
+
+
+def test_phase_recorder_compile_vs_execute_attribution():
+    from ytpu.utils import PhaseRecorder
+
+    rec = PhaseRecorder(enabled=True)
+    with rec.span("stage", key=("shape", 1)):
+        pass
+    with rec.span("stage", key=("shape", 1)):
+        pass
+    with rec.span("stage", key=("shape", 2)):  # new compiled key
+        pass
+    with rec.span("hostonly"):  # key=None: execute-only stage
+        pass
+    rec.transfer("stage", 100, "h2d")
+    rec.transfer("stage", 40, "d2h")
+    snap = rec.snapshot()
+    st = snap["stage"]
+    assert st["calls"] == 3 and st["compile_calls"] == 2
+    assert st["h2d_bytes"] == 100 and st["d2h_bytes"] == 40
+    assert st["transfer_bytes"] == 140
+    assert snap["hostonly"]["compile_calls"] == 0
+    # disabled: the shared no-op context, zero recording
+    rec2 = PhaseRecorder(enabled=False)
+    assert rec2.span("s") is rec2.span("t")
+    rec2.transfer("s", 10)
+    assert rec2.snapshot() == {}
+
+
+def test_instrumented_ingest_integrate_records_phase_spans():
+    """The ingest→integrate path must attribute first-call compile vs
+    steady-state execute at the jit boundary, using the cheap
+    (n_docs=2, capacity=256) device shapes tier-1 already compiles."""
+    pytest.importorskip("jax")
+    from ytpu.core import Doc
+    from ytpu.models.ingest import BatchIngestor
+    from ytpu.utils import phases
+
+    doc = Doc(client_id=3)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    t = doc.get_text("text")
+    for i, word in enumerate(["hi ", "there ", "friend"]):
+        with doc.transact() as txn:
+            t.insert(txn, len(t.get_string()), word)
+
+    phases.reset()
+    phases.enable()
+    try:
+        ing = BatchIngestor(2, 256)
+        for p in log:
+            ing.apply_bytes([p, None])
+    finally:
+        phases.disable()
+    snap = phases.snapshot()
+    st = snap["integrate.xla_batch"]
+    assert st["calls"] == len(log)
+    # same (state, batch) shapes each step: exactly one first-call
+    # compile charge, the rest land in the execute bucket
+    assert st["compile_calls"] == 1
+    assert st["calls"] - st["compile_calls"] == len(log) - 1
+    assert st["compile_s"] > 0 and st["execute_s"] > 0
+    assert "ingest.plan" in snap and snap["ingest.plan"]["calls"] == len(log)
+    if ing.fast_docs:  # native lane present: wire bytes were counted
+        assert snap["decode.v1"]["h2d_bytes"] > 0
+        assert snap["ingest.fast_lane"]["h2d_bytes"] > 0
+    phases.reset()
+
+
+def test_ingest_metrics_counters_mirror_lane_stats():
+    pytest.importorskip("jax")
+    from ytpu.core import Doc
+    from ytpu.models.ingest import BatchIngestor
+    from ytpu.utils import metrics
+
+    metrics.reset()
+    doc = Doc(client_id=9)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    with doc.transact() as txn:
+        doc.get_text("text").insert(txn, 0, "m")
+    ing = BatchIngestor(2, 256)
+    ing.apply_bytes([log[0], None])
+    snap = metrics.snapshot()
+    assert snap["ingest.fast_docs"] + snap["ingest.slow_docs"] == 1
+    assert snap["ingest.fast_docs"] == ing.fast_docs
+    assert snap["ingest.slow_docs"] == ing.slow_docs
+
+
+# --- bench exporter smoke (CI guard; excluded from the tier-1 gate) ---------
+
+
+@pytest.mark.slow
+def test_bench_dry_run_emits_phases_and_metrics():
+    """`bench.py --dry-run` is host-only (no jax, no device child) and
+    must print exactly one JSON line carrying the `phases` + `metrics`
+    keys — the exporter-regression guard before a real bench round."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, YTPU_BENCH_DRY_OPS="120", JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py"), "--dry-run"],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        cwd=root,
+        env=env,
+    )
+    assert res.returncode == 0, res.stderr[-800:]
+    lines = [ln for ln in res.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected ONE JSON line, got {len(lines)}"
+    out = json.loads(lines[0])
+    assert out["dry_run"] is True
+    assert "value" in out and out["host_oracle_updates_per_sec"] > 0
+    ph = out["phases"]
+    assert "host.replay" in ph
+    for st in ph.values():
+        for k in ("compile_s", "execute_s", "transfer_bytes", "calls"):
+            assert k in st
+    assert isinstance(out["metrics"], dict)

@@ -192,8 +192,70 @@ def test_checkpoint_roundtrip_and_format2_backcompat(tmp_path):
     )
 
 
-def test_fused_lane_unpack_recomputes(monkeypatch):
+def test_lazy_origin_slot_refresh_machinery():
+    """ADVICE r5 #1: the O(D·B²) wholesale rebuild is LAZY — a state
+    marked stale (the fused lane's unpack does this) is refreshed by
+    `ensure_origin_slot`, and the XLA apply entry points do it
+    implicitly before their conflict scan reads the cache. Verified
+    here kernel-free by wiping + marking an XLA-lane state."""
     pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from ytpu.models.batch_doc import (
+        ensure_origin_slot,
+        mark_origin_slot_stale,
+        origin_slot_is_stale,
+    )
+
+    log, _ = _concurrent_log(seed=19, n_ops=24)
+    enc = BatchEncoder()
+    steps = [enc.build_step(Update.decode_v1(p), 16, 16) for p in log]
+    stream = BatchEncoder.stack_steps(steps)
+    rank = enc.interner.rank_table()
+    state, _enc2 = _replay(log, capacity=512, rows=16, dels=16)
+
+    # simulate the fused unpack: cache plane wiped, state marked stale
+    wiped = state._replace(
+        blocks=state.blocks._replace(
+            origin_slot=jnp.full_like(state.blocks.origin_slot, -1)
+        )
+    )
+    assert not origin_slot_is_stale(wiped)
+    mark_origin_slot_stale(wiped)
+    assert origin_slot_is_stale(wiped)
+    assert not origin_slot_is_stale(state)  # identity-keyed, no aliasing
+
+    refreshed = ensure_origin_slot(wiped)
+    assert not origin_slot_is_stale(refreshed)
+    assert _invariant_violations(refreshed) == []
+    # ensure on a never-stale state is a no-op passthrough
+    assert ensure_origin_slot(refreshed) is refreshed
+
+    # chaining into the XLA lane refreshes implicitly (the reader's
+    # entry point calls ensure_origin_slot before the conflict scan);
+    # a no-op step proves the refresh without re-integrating rows
+    noop = BatchEncoder.stack_steps(
+        [
+            steps[0]._replace(
+                valid=jnp.zeros_like(steps[0].valid),
+                del_valid=jnp.zeros_like(steps[0].del_valid),
+            )
+        ]
+    )
+    chained = apply_update_stream(wiped, noop, rank)
+    assert _invariant_violations(chained) == []
+
+
+def test_fused_lane_default_defers_and_marks_stale():
+    """End-to-end fused contract: default refresh_cache=False marks the
+    unpacked state stale; refresh_cache=True keeps the eager rebuild.
+    Skips where interpret-mode Pallas cannot run (jax builds missing
+    discharge rules — the kernel itself is hardware-validated)."""
+    pytest.importorskip("jax")
+    from ytpu.models.batch_doc import (
+        ensure_origin_slot,
+        origin_slot_is_stale,
+    )
     from ytpu.ops.integrate_kernel import apply_update_stream_fused
 
     log, _ = _concurrent_log(seed=19, n_ops=24)
@@ -201,10 +263,20 @@ def test_fused_lane_unpack_recomputes(monkeypatch):
     steps = [enc.build_step(Update.decode_v1(p), 16, 16) for p in log]
     stream = BatchEncoder.stack_steps(steps)
     rank = enc.interner.rank_table()
-    fused = apply_update_stream_fused(
-        init_state(4, 512), stream, rank, d_block=2, interpret=True
+    try:
+        fused = apply_update_stream_fused(
+            init_state(4, 512), stream, rank, d_block=2, interpret=True
+        )
+    except NotImplementedError:
+        pytest.skip("interpret-mode Pallas unavailable in this jax build")
+    assert origin_slot_is_stale(fused)
+    assert _invariant_violations(ensure_origin_slot(fused)) == []
+    eager = apply_update_stream_fused(
+        init_state(4, 512), stream, rank, d_block=2, interpret=True,
+        refresh_cache=True,
     )
-    assert _invariant_violations(fused) == []
+    assert not origin_slot_is_stale(eager)
+    assert _invariant_violations(eager) == []
 
 
 def test_sharded_cache_is_minus_one_only_for_nonlocal_origins():

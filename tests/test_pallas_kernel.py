@@ -77,8 +77,12 @@ def assert_same_state(a, b):
 
 def run_both(stream, rank, n_docs=8, capacity=128, d_block=4):
     xla_state = apply_update_stream(init_state(n_docs, capacity), stream, rank)
+    # refresh_cache=True: assert_same_state compares the origin_slot
+    # cache column, so opt into the eager rebuild (the default is the
+    # lazy stale-marked contract — tests/test_origin_slot.py covers it)
     fused_state = apply_update_stream_fused(
-        init_state(n_docs, capacity), stream, rank, d_block=d_block, interpret=True
+        init_state(n_docs, capacity), stream, rank, d_block=d_block,
+        interpret=True, refresh_cache=True,
     )
     return xla_state, fused_state
 
@@ -278,7 +282,7 @@ def test_fused_multi_root_anchor_rows():
 
     xla_state = apply_update_stream(seed(), stream, rank)
     fused_state = apply_update_stream_fused(
-        seed(), stream, rank, d_block=4, interpret=True
+        seed(), stream, rank, d_block=4, interpret=True, refresh_cache=True
     )
     assert_same_state(xla_state, fused_state)
     assert int(np.asarray(fused_state.error).max()) == 0

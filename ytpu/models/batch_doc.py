@@ -66,6 +66,9 @@ __all__ = [
     "ensure_root_anchor",
     "ensure_root_anchor_all",
     "recompute_origin_slot",
+    "mark_origin_slot_stale",
+    "origin_slot_is_stale",
+    "ensure_origin_slot",
     "get_string",
     "get_map",
     "get_tree",
@@ -346,6 +349,44 @@ def recompute_origin_slot(state: DocStateBatch) -> DocStateBatch:
 
     os_col = jax.lax.map(one_doc, (state.blocks, state.n_blocks))
     return state._replace(blocks=state.blocks._replace(origin_slot=os_col))
+
+
+# --- lazy origin_slot refresh (ADVICE r5 #1) --------------------------------
+# The fused kernel passes the origin_slot plane through without
+# maintaining it; the wholesale recompute above is O(D·B²), so fused
+# applies no longer run it eagerly. Instead the fused unpack marks its
+# output STALE here (host-side dirty flag keyed on the cache array's
+# identity — jax arrays are immutable, so identity pins the exact value)
+# and the cache's readers refresh on first touch via
+# `ensure_origin_slot`. `weakref.finalize` retires ids when the array
+# dies, so a recycled id can never alias a fresh array as stale.
+
+_STALE_ORIGIN_SLOT: set = set()
+
+
+def mark_origin_slot_stale(state: DocStateBatch) -> None:
+    """Flag `state.blocks.origin_slot` as stale (fused-lane output)."""
+    import weakref
+
+    arr = state.blocks.origin_slot
+    key = id(arr)
+    if key not in _STALE_ORIGIN_SLOT:
+        _STALE_ORIGIN_SLOT.add(key)
+        weakref.finalize(arr, _STALE_ORIGIN_SLOT.discard, key)
+
+
+def origin_slot_is_stale(state: DocStateBatch) -> bool:
+    """One set lookup — the hot-path cost of the lazy refresh."""
+    return id(state.blocks.origin_slot) in _STALE_ORIGIN_SLOT
+
+
+def ensure_origin_slot(state: DocStateBatch) -> DocStateBatch:
+    """Recompute the cache iff this state was marked stale; the readers'
+    entry points (XLA-lane applies, checkpoint save) call this so chained
+    fused applies pay the O(D·B²) rebuild at most once."""
+    if origin_slot_is_stale(state):
+        return recompute_origin_slot(state)
+    return state
 
 
 def _split(state: DocStateBatch, i: jax.Array, off: jax.Array):
@@ -2532,19 +2573,45 @@ _apply_update_stream_jit = apply_update_stream
 def apply_update_batch(
     state: DocStateBatch, batch: UpdateBatch, client_rank: jax.Array
 ) -> DocStateBatch:
+    from ytpu.utils.phases import NULL_SPAN, phases
     from ytpu.utils.progbudget import tick
 
     tick()
-    return _apply_update_batch_jit(state, batch, client_rank)
+    # lazy origin_slot refresh: the conflict scan reads the cache, so a
+    # fused-lane (stale-marked) state rebuilds it here, on first read.
+    # Under jit tracing (tracer args) the id lookup misses — correct, the
+    # traced program's operands are maintained by the XLA lane itself.
+    state = ensure_origin_slot(state)
+    span = (
+        phases.span(
+            "integrate.xla_batch",
+            (state.blocks.client.shape, batch.client.shape),
+        )
+        if phases.enabled
+        else NULL_SPAN
+    )
+    with span:
+        return _apply_update_batch_jit(state, batch, client_rank)
 
 
 def apply_update_stream(
     state: DocStateBatch, stream: UpdateBatch, client_rank: jax.Array
 ) -> DocStateBatch:
+    from ytpu.utils.phases import NULL_SPAN, phases
     from ytpu.utils.progbudget import tick
 
     tick()
-    return _apply_update_stream_jit(state, stream, client_rank)
+    state = ensure_origin_slot(state)
+    span = (
+        phases.span(
+            "integrate.xla_stream",
+            (state.blocks.client.shape, stream.client.shape),
+        )
+        if phases.enabled
+        else NULL_SPAN
+    )
+    with span:
+        return _apply_update_stream_jit(state, stream, client_rank)
 
 
 apply_update_batch.__doc__ = _apply_update_batch_jit.__doc__

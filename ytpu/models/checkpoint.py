@@ -112,6 +112,11 @@ def save_ingestor(path: str, ing: BatchIngestor, extra: Optional[dict] = None) -
     """Persist a BatchIngestor: device state + encoder + pending stashes.
     `extra` (JSON-serializable) rides the sidecar for embedding layers
     (e.g. DeviceSyncServer tenant metadata)."""
+    from ytpu.models.batch_doc import ensure_origin_slot
+
+    # refresh a stale cache ONCE and write it back: save-then-continue
+    # must not pay the O(D·B²) rebuild again on the next apply
+    ing.state = ensure_origin_slot(ing.state)
     side = {
         "extra": extra or {},
         "format": _FORMAT,
@@ -169,6 +174,11 @@ def load_ingestor_with_extra(path: str) -> Tuple[BatchIngestor, dict]:
     ing.slow_docs = 0
     ing.fast_recoveries = 0
     ing._last_fast_flags = None
+    from ytpu.utils import metrics
+
+    ing._m_fast = metrics.counter("ingest.fast_docs")
+    ing._m_slow = metrics.counter("ingest.slow_docs")
+    ing._m_recoveries = metrics.counter("ingest.fast_recoveries")
     # rebuild the device hash tables from the restored interners
     ing._key_hashes = {}
     ing._key_collisions = set()
@@ -255,7 +265,13 @@ def _save(path: str, state: DocStateBatch, sidecar: dict) -> None:
     fixed path must behave the same with and without orbax."""
     import shutil
 
+    from ytpu.models.batch_doc import ensure_origin_slot
+
     os.makedirs(path, exist_ok=True)
+    # format-3 checkpoints persist the origin_slot cache as authoritative;
+    # a fused-lane state deferred its rebuild (lazy dirty-flag), so
+    # refresh here iff it is marked stale
+    state = ensure_origin_slot(state)
     flat = _state_to_numpy(state)
     arrays_dir = os.path.join(path, "arrays")
     npz_path = os.path.join(path, "arrays.npz")

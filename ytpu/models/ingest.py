@@ -91,6 +91,13 @@ class BatchIngestor:
         self.fast_docs = 0
         self.slow_docs = 0
         self.fast_recoveries = 0  # flagged fast lanes replayed via host lane
+        # process-wide mirrors of the lane stats (cached metric objects:
+        # O(1) increments, no per-step lookups — SURVEY §5.5)
+        from ytpu.utils import metrics
+
+        self._m_fast = metrics.counter("ingest.fast_docs")
+        self._m_slow = metrics.counter("ingest.slow_docs")
+        self._m_recoveries = metrics.counter("ingest.fast_recoveries")
         self._last_fast_flags: Optional[np.ndarray] = None
         # device key hashing (map rows on the fast lane): hash -> key idx;
         # keys whose hash collides with a different key take the host lane
@@ -485,7 +492,12 @@ class BatchIngestor:
             raise ValueError(f"expected {self.n_docs} payload slots")
         self._last_fast_flags = None
         from ytpu.native import available, decode_update_columns
+        from ytpu.utils.phases import phases
 
+        # keyless span: phases.span() itself returns the shared no-op
+        # when disabled — no extra guard needed without a key tuple
+        plan_span = phases.span("ingest.plan")
+        plan_span.__enter__()
         native = available()
         fast_idx: List[int] = []
         fast_payloads: List[bytes] = []
@@ -545,6 +557,11 @@ class BatchIngestor:
         n_rows = _bucket(max(max_fast_rows, 1, max(len(r) for r in all_rows)))
         n_dels = _bucket(max(max_fast_dels, 1, max(len(d_) for d_ in all_dels)))
         batch = self.enc.batch_from_rows(all_rows, all_dels, n_rows, n_dels)
+        # end of the host planning phase (an exception above simply drops
+        # the span — the recorder holds no resources)
+        plan_span.__exit__(None, None, None)
+        self._m_fast.inc(len(fast_idx))
+        self._m_slow.inc(sum(1 for u in slow_updates if u is not None))
 
         flags = None
         chunk_base = None
@@ -574,6 +591,7 @@ class BatchIngestor:
                 bad_lanes = set(np.nonzero(f & FLAG_ERRORS)[0].tolist())
                 bad = [fast_idx[i] for i in bad_lanes]
                 self.fast_recoveries += len(bad)
+                self._m_recoveries.inc(len(bad))
                 # release the retained wire chunk if every string-bearing
                 # lane in it was flagged (their refs never went live); a
                 # partially-flagged chunk keeps the surviving lanes' bytes
@@ -628,6 +646,13 @@ class BatchIngestor:
         maxlen = max(len(p) for p in fast_payloads)
         buf, lens = pack_updates(fast_payloads, pad_to=_bucket(maxlen + 16, 64))
         S, L = buf.shape
+        from ytpu.utils.phases import phases
+
+        if phases.enabled:
+            # padded wire matrix shipped to HBM (the fast lane's only
+            # host→device payload; decode.v1 counts it again at the jit
+            # boundary — this stage attributes it to ingest)
+            phases.transfer("ingest.fast_lane", buf.nbytes + lens.nbytes, "h2d")
         # Retain only the wire bytes of lanes that emitted string rows
         # (lens-trimmed, concatenated) — refs are rebased from the padded
         # s*L layout onto the compact one. Lanes without string rows have

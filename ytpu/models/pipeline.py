@@ -56,10 +56,17 @@ class UpdatePipeline:
 
     def _chunks(self, payloads: Iterable[bytes]):
         """Decode + build padded micro-chunks (runs on the worker thread)."""
+        from ytpu.utils.phases import phases
+
         steps: List[UpdateBatch] = []
         for p in payloads:
-            u = Update.decode_v2(p) if self.decode_v2 else Update.decode_v1(p)
-            steps.append(self.enc.build_step(u, self.n_rows, self.n_dels))
+            with phases.span("pipeline.decode"):
+                u = (
+                    Update.decode_v2(p)
+                    if self.decode_v2
+                    else Update.decode_v1(p)
+                )
+                steps.append(self.enc.build_step(u, self.n_rows, self.n_dels))
             if len(steps) == self.chunk_steps:
                 yield BatchEncoder.stack_steps(steps)
                 steps = []

@@ -29,6 +29,7 @@ padded chunk buffer. Decode, integrate, squash, and GC all run on device.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -367,12 +368,19 @@ class FusedReplay:
                 )
             if self.lane == "fused":
                 rows, dels = pack_stream(stream)
+                # YTPU_FUSED_VMEM_MB rides `_run` as a STATIC arg (read
+                # per chunk): a changed limit forces a retrace instead of
+                # silently reusing the old compiled guard (ADVICE r5 #2)
+                vmem_mb = int(os.environ.get("YTPU_FUSED_VMEM_MB", "64"))
                 self.cols, self.meta = _run(
                     self.cols,
                     self.meta,
                     (rows, dels, rank),
                     self.d_block,
                     self.interpret,
+                    3,
+                    4,
+                    vmem_mb,
                 )
             else:
                 # XLA lane: the un-fused integrate path (batch_doc's
@@ -384,6 +392,10 @@ class FusedReplay:
             # high-water check (forces the step to complete: the readback
             # doubles as the per-chunk latency fence)
             meta_np = np.asarray(self.meta)
+            from ytpu.utils.phases import phases as _phases
+
+            if _phases.enabled:
+                _phases.transfer("replay.readback", meta_np.nbytes, "d2h")
             if (meta_np[:, M_ERROR] != 0).any():
                 raise RuntimeError(
                     f"device error flags "

@@ -451,7 +451,8 @@ def grow_packed(cols, meta, new_capacity: int):
 
 def grow_state(state: DocStateBatch, new_capacity: int) -> DocStateBatch:
     """Widen every doc's block capacity (host-side repad; index columns are
-    slot-based so they survive unchanged)."""
+    slot-based so they survive unchanged). A stale origin_slot flag
+    (identity-keyed) propagates to the repadded output."""
     B = state.blocks.client.shape[-1]
     if new_capacity < B:
         raise ValueError(f"cannot shrink capacity {B} -> {new_capacity}")
@@ -464,14 +465,71 @@ def grow_state(state: DocStateBatch, new_capacity: int) -> DocStateBatch:
         col = getattr(state.blocks, name)
         ext = jnp.full(col.shape[:-1] + (pad,), fill, dtype=col.dtype)
         cols[name] = jnp.concatenate([col, ext], axis=-1)
-    return state._replace(blocks=BlockCols(**cols))
+    out = state._replace(blocks=BlockCols(**cols))
+    from ytpu.models.batch_doc import (
+        mark_origin_slot_stale,
+        origin_slot_is_stale,
+    )
+
+    if origin_slot_is_stale(state):
+        mark_origin_slot_stale(out)
+    return out
+
+
+# --- phase-timer wrappers (observability layer) -----------------------------
+# The jitted bodies stay module-level (progbudget needs the jit objects);
+# the public names grow thin host wrappers that attribute first-call
+# compile vs steady-state dispatch per compiled key. Disabled path: one
+# attribute check, no allocation (SURVEY §5.5 hot-path rule).
+
+_compact_state_jit = compact_state
+_compact_packed_jit = compact_packed
+
+
+def compact_state(state: DocStateBatch) -> DocStateBatch:
+    from ytpu.models.batch_doc import (
+        mark_origin_slot_stale,
+        origin_slot_is_stale,
+    )
+    from ytpu.utils.phases import NULL_SPAN, phases
+
+    # staleness is identity-keyed on the cache array; the defragment
+    # remap builds a NEW array, so a stale input must re-mark its output
+    # or the unrefreshed cache would launder into a "clean" wrong one
+    stale = origin_slot_is_stale(state)
+    span = (
+        phases.span("compact.state", (state.blocks.client.shape,))
+        if phases.enabled
+        else NULL_SPAN
+    )
+    with span:
+        out = _compact_state_jit(state)
+    if stale:
+        mark_origin_slot_stale(out)
+    return out
+
+
+def compact_packed(cols, meta, unit_refs: bool = False, gc_ranges: bool = False):
+    from ytpu.utils.phases import NULL_SPAN, phases
+
+    span = (
+        phases.span("compact.packed", (cols.shape, unit_refs, gc_ranges))
+        if phases.enabled
+        else NULL_SPAN
+    )
+    with span:
+        return _compact_packed_jit(cols, meta, unit_refs, gc_ranges)
+
+
+compact_state.__doc__ = _compact_state_jit.__doc__
+compact_packed.__doc__ = _compact_packed_jit.__doc__
 
 
 def _register_programs():
     from ytpu.utils import progbudget
 
-    progbudget.register("compact_state", compact_state)
-    progbudget.register("compact_packed", compact_packed)
+    progbudget.register("compact_state", _compact_state_jit)
+    progbudget.register("compact_packed", _compact_packed_jit)
 
 
 _register_programs()

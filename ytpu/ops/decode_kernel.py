@@ -1447,21 +1447,42 @@ def decode_updates_v1(
     client_hash_table=None,
     primary_root_hash=None,
 ):
+    from ytpu.utils.phases import NULL_SPAN, phases
     from ytpu.utils.progbudget import tick
 
     tick()
-    return _decode_updates_v1_jit(
-        buf,
-        lens,
-        max_rows=max_rows,
-        max_dels=max_dels,
-        n_steps=n_steps,
-        client_table=client_table,
-        max_sections=max_sections,
-        key_table=key_table,
-        client_hash_table=client_hash_table,
-        primary_root_hash=primary_root_hash,
-    )
+    if phases.enabled:
+        # wire bytes shipped to HBM this step (buf may already be a device
+        # array — either way these bytes crossed or will cross the link).
+        # size*itemsize, not .nbytes: callers sometimes wrap this entry in
+        # an outer jax.jit (bench probes), and tracers carry shape/dtype
+        # but not nbytes
+        phases.transfer(
+            "decode.v1",
+            buf.size * buf.dtype.itemsize + lens.size * lens.dtype.itemsize,
+            "h2d",
+        )
+        span = phases.span(
+            "decode.v1",
+            (buf.shape, max_rows, max_dels, n_steps, max_sections,
+             client_table is not None, key_table is not None,
+             client_hash_table is not None, primary_root_hash is not None),
+        )
+    else:
+        span = NULL_SPAN
+    with span:
+        return _decode_updates_v1_jit(
+            buf,
+            lens,
+            max_rows=max_rows,
+            max_dels=max_dels,
+            n_steps=n_steps,
+            client_table=client_table,
+            max_sections=max_sections,
+            key_table=key_table,
+            client_hash_table=client_hash_table,
+            primary_root_hash=primary_root_hash,
+        )
 
 
 decode_updates_v1.__doc__ = _decode_updates_v1_impl.__doc__

@@ -895,10 +895,10 @@ def _kernel(
     jax.lax.fori_loop(0, S, step, 0)
 
 
-@partial(jax.jit, static_argnums=(3, 4, 5, 6), donate_argnums=(0, 1))
+@partial(jax.jit, static_argnums=(3, 4, 5, 6, 7), donate_argnums=(0, 1))
 def _run(
     cols, meta, packed, d_block: int, interpret: bool,
-    phases: int = 3, row_phase: int = 4,
+    phases: int = 3, row_phase: int = 4, vmem_limit_mb: int = 64,
 ):
     rows, dels, rank = packed
     NC_, D, C = cols.shape
@@ -936,12 +936,14 @@ def _run(
         else pltpu.CompilerParams(
             # v5e VMEM is 128MB; the default guard stays conservative.
             # Big-capacity tiles (the fused full-B4 at C=65536 needs a
-            # ~54MB state tile + scan temporaries) raise it via env.
-            vmem_limit_bytes=int(
-                os.environ.get("YTPU_FUSED_VMEM_MB", "64")
-            )
-            * 1024
-            * 1024
+            # ~54MB state tile + scan temporaries) raise it via the
+            # YTPU_FUSED_VMEM_MB env var, which the public entry points
+            # re-read PER CALL and thread here as a STATIC argument — a
+            # changed value forces a retrace instead of being silently
+            # ignored for already-compiled (shape, d_block) keys
+            # (ADVICE r5 #2: the old trace-time env read misled
+            # VMEM-limit bisection).
+            vmem_limit_bytes=vmem_limit_mb * 1024 * 1024
         ),
     )(rows, dels, rank, cols, meta)
     return out
@@ -954,7 +956,7 @@ def apply_update_stream_fused(
     d_block: int = 32,
     interpret: bool = False,
     guard: bool = True,
-    refresh_cache: bool = True,
+    refresh_cache: bool = False,
     _debug_phases: int = 3,
     _debug_row_phase: int = 4,
 ) -> DocStateBatch:
@@ -965,9 +967,23 @@ def apply_update_stream_fused(
     `batch_doc._recompute_moves`, parity: moving.rs:149-227).
 
     `guard` is kept for call-site compatibility; it no longer excludes
-    anything. `refresh_cache=False` skips the O(D*B^2) origin_slot
-    recompute at unpack — pass it when chaining further fused applies
-    that never read the cache, and recompute once at the end.
+    anything.
+
+    origin_slot cache (ADVICE r5 #1): the kernel passes the cache plane
+    through without maintaining it, so a wholesale rebuild
+    (`recompute_origin_slot`) is needed before anything READS it — and
+    that rebuild is O(D·B²) compares with a multi-GB vmapped
+    intermediate per doc at flagship capacities (C=65536, ~51k blocks:
+    billions of compares). It therefore no longer runs eagerly on every
+    fused apply. The default `refresh_cache=False` marks the returned
+    state's cache STALE (`batch_doc.mark_origin_slot_stale`); the
+    XLA-lane entry points (`apply_update_batch`/`apply_update_stream`)
+    and checkpoint save — the cache's only readers — refresh lazily via
+    `batch_doc.ensure_origin_slot`, so chained fused applies pay the
+    rebuild at most once, at the boundary where the cache is actually
+    consumed. Pass `refresh_cache=True` to opt back into the eager
+    rebuild (callers that hand the state to out-of-tree cache readers).
+
     `_debug_phases` / `_debug_row_phase` truncate the kernel for
     hardware bisection only (see `_kernel`); never pass them in production
     — partial kernels corrupt state by design."""
@@ -977,6 +993,7 @@ def apply_update_stream_fused(
     # possible compile, not just on the periodic tick (the r5 no-crutch
     # suite segfaulted compiling exactly this program at ~73%)
     from ytpu.utils import progbudget
+    from ytpu.utils.phases import NULL_SPAN, phases as _phases
 
     progbudget.enforce()
     cols, meta = pack_state(state)
@@ -984,17 +1001,34 @@ def apply_update_stream_fused(
     if D % d_block != 0:
         raise ValueError(f"n_docs {D} must be a multiple of d_block {d_block}")
     rows, dels = pack_stream(stream)
-    cols, meta = _run(
-        cols, meta, (rows, dels, client_rank), d_block, interpret,
-        _debug_phases, _debug_row_phase,
-    )
+    vmem_mb = int(os.environ.get("YTPU_FUSED_VMEM_MB", "64"))
+    if _phases.enabled:
+        _phases.transfer(
+            "integrate.fused",
+            rows.size * rows.dtype.itemsize + dels.size * dels.dtype.itemsize,
+            "h2d",
+        )
+        span = _phases.span(
+            "integrate.fused",
+            (cols.shape, rows.shape, dels.shape, d_block, interpret,
+             _debug_phases, _debug_row_phase, vmem_mb),
+        )
+    else:
+        span = NULL_SPAN
+    with span:
+        cols, meta = _run(
+            cols, meta, (rows, dels, client_rank), d_block, interpret,
+            _debug_phases, _debug_row_phase, vmem_mb,
+        )
     out = unpack_state(cols, meta, state)
     if not refresh_cache:
-        # chained fused applies never read the cache plane — the caller
-        # recomputes once at its final unpack boundary (FusedReplay shape)
+        # lazy dirty-flag: the XLA apply wrappers / checkpoint save run
+        # recompute_origin_slot on first read of a stale cache
+        from ytpu.models.batch_doc import mark_origin_slot_stale
+
+        mark_origin_slot_stale(out)
         return out
-    # the kernel does not maintain the origin_slot cache plane (see OS):
-    # rebuild it so downstream XLA-lane applies read a valid cache
+    # eager opt-in: rebuild so even out-of-tree readers see a valid cache
     from ytpu.models.batch_doc import recompute_origin_slot
 
     return recompute_origin_slot(out)

@@ -1795,10 +1795,29 @@ class ShardedDoc:
         """(shard, local offset) for a visible position — prefix sum over
         shard lengths instead of the reference's O(doc) item walk.
 
-        Caveat: while CROSS-SEGMENT move claims exist (`_move_mirrors`),
-        visible order interleaves across segments and this positional map
-        is approximate — exact positions then come from the global
-        move-aware walk (`_global_visible_content`)."""
+        While CROSS-SEGMENT move claims exist (`_move_mirrors`), visible
+        order interleaves across segments and the prefix-sum map is
+        approximate, so the lookup routes through the exact global
+        move-aware walk instead — the same guard `get_string`/
+        `get_values` use (ADVICE r5 #3; previously this API silently
+        returned the approximation and a placement caller would
+        mis-anchor). The guarded offset counts the owning shard's
+        elements in GLOBAL visible order, i.e. it indexes the same
+        position space `get_string`/`get_values` render."""
+        if self._move_mirrors:
+            self.flush()
+            consumed = [0] * self.S
+            remaining = int(pos)
+            last = None
+            for s, _r, _v in self._global_visible_content(text_only=False):
+                if remaining == 0:
+                    return s, consumed[s]
+                remaining -= 1
+                consumed[s] += 1
+                last = s
+            # past-the-end (tail insertion point): anchor after the last
+            # visible element; an empty doc anchors at (0, 0)
+            return (last, consumed[last]) if last is not None else (0, 0)
         lens = self.shard_lengths()
         cum = np.concatenate([[0], np.cumsum(lens)])
         shard = int(np.searchsorted(cum[1:], pos, side="right"))

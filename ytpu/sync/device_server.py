@@ -72,6 +72,13 @@ class DeviceSyncServer(SyncServer):
         # the ingestor is the single source of truth for the slot count
         self.ingestor = ingestor
         self.device_authoritative = device_authoritative
+        from ytpu.utils import metrics
+
+        self._diffs_encoded = metrics.counter(
+            "sync.diffs_encoded", labelnames=("tenant",)
+        )
+        self._slots_gauge = metrics.gauge("sync.device_slots_assigned")
+        self._queue_depth = metrics.gauge("sync.device_queue_depth")
         self._slot_of: Dict[str, int] = {}
         # per-tenant wire root name (the batch engine maps any single-root
         # tenant onto one device branch; the name must round-trip on the
@@ -112,6 +119,7 @@ class DeviceSyncServer(SyncServer):
                     f"device batch is full ({self.ingestor.n_docs} tenant slots)"
                 )
             self._slot_of[tenant_name] = slot
+            self._slots_gauge.set(len(self._slot_of))
         return slot
 
     def tenant(self, name: str):
@@ -140,6 +148,7 @@ class DeviceSyncServer(SyncServer):
         self._next_session += 1
         session = Session(self._next_session, tenant_name, self)
         t.sessions.append(session)
+        self._sessions_gauge.inc()
         # greeting SyncStep1 carries the DEVICE state vector (flush first
         # so queued updates are reflected in the mirror)
         self.flush_device()
@@ -173,6 +182,7 @@ class DeviceSyncServer(SyncServer):
                     self._note_roots(session.tenant, sub.payload)
                     self._queues[slot].append(sub.payload)
                     self._applied.inc()
+                    t.applied.inc()
                     # broadcast at-least-once (idempotent CRDT updates;
                     # the host path dedups via observer events, the device
                     # path trades that for never touching a host doc)
@@ -250,6 +260,7 @@ class DeviceSyncServer(SyncServer):
         doc.apply_update_v1(diff)
         # reclaim the device slot for future tenants
         slot = self._slot_of.pop(tenant)
+        self._slots_gauge.set(len(self._slot_of))
         self.ingestor.reset_slot(slot)
         self._free_slots.append(slot)
 
@@ -318,6 +329,7 @@ class DeviceSyncServer(SyncServer):
                 # stashed delete ranges must reach fresh replicas too
                 extras.append(_U({}, pending_ds).encode_v1())
             payload = merge_updates(payload, *extras)
+        self._diffs_encoded.labels(tenant_name).inc()
         return payload
 
     # --- device dispatch -------------------------------------------------------
@@ -331,19 +343,33 @@ class DeviceSyncServer(SyncServer):
         Returns the number of batch steps dispatched. Slots with deeper
         queues keep shipping while others ride as no-ops (the engine's
         padding rows), so a chatty tenant never blocks a quiet one.
+
+        Observability: the `sync.device_queue_depth` gauge tracks the
+        total queued updates before/after each flush, and a device-step
+        failure dumps the tracer's flight-recorder ring (`YTPU_TRACE`)
+        before re-raising — a kernel abort leaves a replayable trace.
         """
+        from ytpu.utils import tracer
+
+        depth_gauge = self._queue_depth
+        depth_gauge.set(sum(len(q) for q in self._queues))
         steps = 0
         while any(self._queues) and (max_steps is None or steps < max_steps):
             # peek, apply, THEN pop — a failing step must not drop the other
             # slots' already-dequeued updates. The apply histogram times the
             # real device step here (the SLO metric), not the enqueue.
             payloads = [q[0] if q else None for q in self._queues]
-            with self._apply_hist.time():
-                self.ingestor.apply_bytes(payloads)
+            try:
+                with self._apply_hist.time():
+                    self.ingestor.apply_bytes(payloads)
+            except Exception as e:
+                tracer.dump_on_error(error=e)
+                raise
             for q in self._queues:
                 if q:
                     q.pop(0)
             steps += 1
+        depth_gauge.set(sum(len(q) for q in self._queues))
         return steps
 
     def device_text(self, tenant_name: str) -> str:

@@ -36,6 +36,14 @@ from ytpu.sync.protocol import (
     message_reader,
 )
 from ytpu.sync.server import DeviceBatchFull, SyncServer
+from ytpu.utils import metrics
+
+# transport series (module-cached children: zero lookups per frame)
+_FRAMES_IN = metrics.counter("net.frames_in")
+_FRAMES_OUT = metrics.counter("net.frames_out")
+_BYTES_IN = metrics.counter("net.bytes_in")
+_BYTES_OUT = metrics.counter("net.bytes_out")
+_CONNECTIONS = metrics.gauge("net.connections")
 
 # protocol-level garbage from a peer tears the connection down quietly
 _PEER_ERRORS = (
@@ -87,13 +95,19 @@ async def read_frame(
             raise ConnectionError("eof inside frame header")
     if size > _MAX_FRAME:
         raise ConnectionError(f"frame of {size} bytes exceeds limit")
-    return await reader.readexactly(size)
+    data = await reader.readexactly(size)
+    _FRAMES_IN.inc()
+    _BYTES_IN.inc(len(data))
+    return data
 
 
 def write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
     w = Writer()
     w.write_buf(payload)
-    writer.write(w.to_bytes())
+    buf = w.to_bytes()
+    _FRAMES_OUT.inc()
+    _BYTES_OUT.inc(len(buf))
+    writer.write(buf)
 
 
 async def serve(
@@ -115,6 +129,7 @@ async def serve(
     async def handle(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         session = None
         frames_seen = 0
+        _CONNECTIONS.inc()
         try:
             hello = await read_frame(reader)
             if hello is None:
@@ -149,6 +164,7 @@ async def serve(
         except _PEER_ERRORS:
             pass
         finally:
+            _CONNECTIONS.dec()
             if session is not None:
                 server.disconnect(session)
             writer.close()

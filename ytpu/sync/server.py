@@ -31,7 +31,7 @@ class DeviceBatchFull(RuntimeError):
 
 
 class Session:
-    __slots__ = ("id", "tenant", "server", "outbox", "dead")
+    __slots__ = ("id", "tenant", "server", "outbox", "dead", "_depth_gauge")
 
     #: broadcast frames a session may hold undelivered before it is
     #: declared a slow consumer and evicted (its transport handler sees
@@ -45,6 +45,9 @@ class Session:
         self.server = server
         self.outbox: List[bytes] = []
         self.dead = False
+        # cached gauge child: the push hot path updates a high-water mark
+        # with one O(1) call, no name lookups (SURVEY §5.5)
+        self._depth_gauge = server._outbox_depth
 
     def push(self, frame: bytes) -> None:
         """Queue a broadcast frame, evicting the session when it is too
@@ -53,17 +56,20 @@ class Session:
         if self.dead:
             return
         self.outbox.append(frame)
+        self._depth_gauge.set_max(len(self.outbox))
         if len(self.outbox) > self.OUTBOX_CAP:
             self.dead = True
             self.outbox = []
+            self.server._evictions.inc()
 
 
 class _Tenant:
-    __slots__ = ("awareness", "sessions")
+    __slots__ = ("awareness", "sessions", "applied")
 
     def __init__(self, doc: Doc):
         self.awareness = Awareness(doc)
         self.sessions: List[Session] = []
+        self.applied = None  # per-tenant labeled counter child (set by server)
 
 
 class SyncServer:
@@ -76,6 +82,14 @@ class SyncServer:
         self._next_session = 0
         self._apply_hist = metrics.histogram("sync.apply_update")
         self._applied = metrics.counter("sync.updates_applied")
+        # per-tenant apply series (labeled family; children cached per
+        # tenant at first touch) + session/queue-depth gauges
+        self._tenant_applied = metrics.counter(
+            "sync.tenant_updates_applied", labelnames=("tenant",)
+        )
+        self._sessions_gauge = metrics.gauge("sync.sessions")
+        self._outbox_depth = metrics.gauge("sync.outbox_depth")
+        self._evictions = metrics.counter("sync.slow_consumer_evictions")
 
     # --- tenant / doc management ----------------------------------------------
 
@@ -84,6 +98,7 @@ class SyncServer:
         if t is None:
             doc = self._doc_factory(name)
             t = _Tenant(doc)
+            t.applied = self._tenant_applied.labels(name)
             self.tenants[name] = t
             # live update broadcast: one observer per tenant doc
             def broadcast(payload: bytes, origin, txn, _name=name):
@@ -116,12 +131,14 @@ class SyncServer:
         self._next_session += 1
         session = Session(self._next_session, tenant_name, self)
         t.sessions.append(session)
+        self._sessions_gauge.inc()
         return session, self.protocol.start_messages(t.awareness)
 
     def disconnect(self, session: Session) -> None:
         t = self.tenants.get(session.tenant)
         if t and session in t.sessions:
             t.sessions.remove(session)
+            self._sessions_gauge.dec()
 
     # --- message pumping --------------------------------------------------------
 
@@ -152,6 +169,7 @@ class SyncServer:
                         msg.body.payload, origin=session
                     )
                 applied.inc()
+                t.applied.inc()
                 continue
             if msg.kind == 1:  # Awareness: apply + broadcast to others
                 t.awareness.apply_update(msg.body)

@@ -1,13 +1,17 @@
-"""Host-side utilities: metrics and tracing (SURVEY §5.1/§5.5 greenfield)."""
+"""Host-side utilities: metrics, tracing, phase timers (SURVEY §5.1/§5.5)."""
 
-from .metrics import Counter, Histogram, MetricsRegistry, metrics
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, metrics
+from .phases import PhaseRecorder, phases
 from .trace import Tracer, trace_span, tracer
 
 __all__ = [
     "Counter",
+    "Gauge",
     "Histogram",
     "MetricsRegistry",
     "metrics",
+    "PhaseRecorder",
+    "phases",
     "Tracer",
     "trace_span",
     "tracer",

@@ -1,0 +1,159 @@
+"""Device-phase timers: compile-vs-execute attribution + transfer bytes.
+
+The host→device pipeline's wall time hides three very different costs:
+first-call XLA/Mosaic compilation, steady-state dispatch/execute, and
+host↔device transfers. Kernel-optimization rounds kept bisecting them
+from ad-hoc logs; this recorder separates them at the jit boundaries
+(`ops/decode_kernel`, `ops/integrate_kernel`, `ops/compaction`,
+`models/batch_doc`, `models/ingest`, `models/pipeline`) so `bench.py`
+can embed a per-stage breakdown in its one-line JSON.
+
+Attribution model: every instrumented call passes a hashable ``key``
+describing the compiled-program identity (static args + operand shapes).
+The FIRST call with an unseen (stage, key) is charged to ``compile_s``
+(that wall time includes trace + compile + the first execute); later
+calls with the same key charge ``execute_s``. ``key=None`` marks a
+host-only stage with no compile phase. Because JAX dispatch is async,
+``execute_s`` measures dispatch (plus any blocking the callee already
+does) — the recorder itself NEVER adds a device sync, so it is safe on
+the hot path.
+
+Disabled-path contract (the default): one attribute check, zero
+allocation — call sites guard with ``if phases.enabled:`` before
+building keys, and ``span()`` hands back a shared no-op context
+manager. Enable via ``YTPU_PHASES=1`` or ``phases.enable()``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["PhaseRecorder", "phases", "NULL_SPAN"]
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Stage:
+    __slots__ = (
+        "calls",
+        "compile_calls",
+        "compile_s",
+        "execute_s",
+        "h2d_bytes",
+        "d2h_bytes",
+    )
+
+    def __init__(self):
+        self.calls = 0
+        self.compile_calls = 0
+        self.compile_s = 0.0
+        self.execute_s = 0.0
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+
+
+class _PhaseSpan:
+    __slots__ = ("_rec", "_stage", "_key", "_start")
+
+    def __init__(self, rec: "PhaseRecorder", stage: str, key):
+        self._rec = rec
+        self._stage = stage
+        self._key = key
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._start
+        rec = self._rec
+        with rec._lock:
+            st = rec._stages.get(self._stage)
+            if st is None:
+                st = rec._stages[self._stage] = _Stage()
+            st.calls += 1
+            if self._key is not None and (
+                (self._stage, self._key) not in rec._seen
+            ):
+                rec._seen.add((self._stage, self._key))
+                st.compile_calls += 1
+                st.compile_s += dt
+            else:
+                st.execute_s += dt
+        return False
+
+
+class PhaseRecorder:
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self._stages: Dict[str, _Stage] = {}
+        self._seen: set = set()
+        self._lock = threading.Lock()
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stages.clear()
+            self._seen.clear()
+
+    def span(self, stage: str, key=None):
+        """Time one call of `stage`. `key` identifies the compiled
+        program (first sighting = compile); None = host-only stage."""
+        if not self.enabled:
+            return NULL_SPAN
+        return _PhaseSpan(self, stage, key)
+
+    def transfer(
+        self, stage: str, nbytes: int, direction: str = "h2d"
+    ) -> None:
+        """Count host↔device bytes against `stage` (`direction` is
+        "h2d" or "d2h"). No-op (one attribute check) when disabled."""
+        if not self.enabled:
+            return
+        with self._lock:
+            st = self._stages.get(stage)
+            if st is None:
+                st = self._stages[stage] = _Stage()
+            if direction == "h2d":
+                st.h2d_bytes += int(nbytes)
+            else:
+                st.d2h_bytes += int(nbytes)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Per-stage breakdown: calls / compile_calls / compile_s /
+        execute_s / h2d_bytes / d2h_bytes / transfer_bytes (sum)."""
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            for name, st in self._stages.items():
+                out[name] = {
+                    "calls": st.calls,
+                    "compile_calls": st.compile_calls,
+                    "compile_s": round(st.compile_s, 6),
+                    "execute_s": round(st.execute_s, 6),
+                    "h2d_bytes": st.h2d_bytes,
+                    "d2h_bytes": st.d2h_bytes,
+                    "transfer_bytes": st.h2d_bytes + st.d2h_bytes,
+                }
+        return out
+
+
+phases = PhaseRecorder(enabled=bool(os.environ.get("YTPU_PHASES")))

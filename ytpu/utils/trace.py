@@ -1,27 +1,88 @@
-"""Host span tracing with Chrome-trace export (SURVEY §5.1 greenfield).
+"""Flight-recorder host tracing with Chrome-trace export (SURVEY §5.1).
 
 The reference's only introspection is Debug/Display dumps; here spans wrap
 the host stages (decode, dispatch, encode, commit) and export to the
 chrome://tracing / Perfetto JSON format. Device-side profiling remains
 jax.profiler's job — `trace_span` nests correctly under its host annotations
 because both use wall-clock.
+
+Flight-recorder semantics: the event store is a BOUNDED ring (drop-oldest,
+`max_events`), so a long-lived server can leave tracing on and always
+holds the most recent window — the thing you want after a crash. Two exit
+paths write it out:
+
+- ``YTPU_TRACE=<path>`` in the environment enables the process-wide
+  tracer at import and registers an atexit Chrome-trace dump to that
+  path (``%p`` in the path expands to the pid — use it when parent and
+  child processes share the variable, e.g. bench.py's device child).
+  Processes that recorded nothing skip the write, so an instrumented
+  child's dump is not clobbered by an idle parent.
+- ``tracer.dump_on_error(error=e)`` — the hook the bench device child
+  and `DeviceSyncServer.flush_device` call from exception paths: appends
+  an instant "error" event and writes immediately (atexit never runs
+  when a process is SIGKILLed by a timeout), so a tunnel-down or
+  kernel-abort round leaves a replayable trace instead of a stderr tail.
+
+Disabled-path cost: `span()` returns a shared no-op context manager —
+no allocation, no string formatting (SURVEY §5.5 hot-path rule).
 """
 
 from __future__ import annotations
 
+import atexit
 import json
+import os
 import threading
 import time
-from contextlib import contextmanager
-from typing import List, Optional
+from collections import deque
+from typing import Optional
+
+from .phases import NULL_SPAN as _NULL_SPAN  # shared no-op span singleton
 
 __all__ = ["Tracer", "trace_span", "tracer"]
 
+DEFAULT_MAX_EVENTS = 65536
+
+
+class _Span:
+    __slots__ = ("_tracer", "_name", "_args", "_start")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Optional[dict]):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        end = time.perf_counter()
+        tr = self._tracer
+        ev = {
+            "name": self._name,
+            "ph": "X",  # complete event
+            "ts": (self._start - tr._t0) * 1e6,
+            "dur": (end - self._start) * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() % 1_000_000,
+        }
+        if self._args:
+            ev["args"] = self._args
+        with tr._lock:
+            tr._events.append(ev)  # deque(maxlen=...): drop-oldest
+        return False
+
 
 class Tracer:
-    def __init__(self, enabled: bool = False):
+    """Bounded-ring span recorder (drop-oldest at `max_events`)."""
+
+    def __init__(
+        self, enabled: bool = False, max_events: int = DEFAULT_MAX_EVENTS
+    ):
         self.enabled = enabled
-        self._events: List[dict] = []
+        self.max_events = max_events
+        self._events: deque = deque(maxlen=max_events)
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
 
@@ -36,35 +97,75 @@ class Tracer:
             self._events.clear()
         self._t0 = time.perf_counter()
 
-    @contextmanager
+    def __len__(self) -> int:
+        return len(self._events)
+
     def span(self, name: str, **args):
+        """Context manager recording one complete event; the disabled
+        path returns a shared no-op (zero per-call allocation)."""
         if not self.enabled:
-            yield
+            return _NULL_SPAN
+        return _Span(self, name, args or None)
+
+    def instant(self, name: str, **args) -> None:
+        """One point-in-time marker event (phase transitions, errors)."""
+        if not self.enabled:
             return
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            end = time.perf_counter()
-            ev = {
-                "name": name,
-                "ph": "X",  # complete event
-                "ts": (start - self._t0) * 1e6,
-                "dur": (end - start) * 1e6,
-                "pid": 0,
-                "tid": threading.get_ident() % 1_000_000,
-            }
-            if args:
-                ev["args"] = args
-            with self._lock:
-                self._events.append(ev)
+        ev = {
+            "name": name,
+            "ph": "i",
+            "s": "p",  # process-scoped instant
+            "ts": (time.perf_counter() - self._t0) * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() % 1_000_000,
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
 
     def export_chrome_trace(self, path: Optional[str] = None) -> str:
-        payload = json.dumps({"traceEvents": list(self._events)})
+        with self._lock:
+            events = list(self._events)
+        payload = json.dumps({"traceEvents": events})
         if path:
-            with open(path, "w") as f:
+            tmp = f"{path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
                 f.write(payload)
+            os.replace(tmp, path)  # atomic: a reader never sees a torn file
         return payload
+
+    def dump_on_error(
+        self, path: Optional[str] = None, error: Optional[BaseException] = None
+    ) -> Optional[str]:
+        """Crash hook: write the ring NOW (atexit may never run — bench
+        timeouts SIGKILL the child). Resolution order for the output
+        path: explicit arg, then ``YTPU_TRACE`` (``%p`` → pid). Returns
+        the written path, or None when no destination is configured.
+
+        Writes even when the tracer was never enabled: an empty trace
+        carrying the error instant still timestamps the failure."""
+        if path is None:
+            path = _env_trace_path()
+        if path is None:
+            return None
+        was_enabled = self.enabled
+        self.enabled = True
+        try:
+            self.instant(
+                "error",
+                type=type(error).__name__ if error is not None else "unknown",
+                message=str(error)[:500] if error is not None else "",
+            )
+        finally:
+            self.enabled = was_enabled
+        try:
+            self.export_chrome_trace(path)
+        except OSError:
+            # both call sites re-raise the ORIGINAL exception right after
+            # this hook — a bad trace path must never replace it
+            return None
+        return path
 
 
 tracer = Tracer()
@@ -73,3 +174,24 @@ tracer = Tracer()
 def trace_span(name: str, **args):
     """Span on the process-wide tracer (no-op unless tracer.enable())."""
     return tracer.span(name, **args)
+
+
+def _env_trace_path() -> Optional[str]:
+    path = os.environ.get("YTPU_TRACE")
+    if not path:
+        return None
+    return path.replace("%p", str(os.getpid()))
+
+
+def _atexit_dump() -> None:
+    path = _env_trace_path()
+    if path and len(tracer):
+        try:
+            tracer.export_chrome_trace(path)
+        except OSError:
+            pass  # never let a bad trace path break process exit
+
+
+if os.environ.get("YTPU_TRACE"):
+    tracer.enable()
+    atexit.register(_atexit_dump)
