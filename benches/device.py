@@ -112,25 +112,50 @@ def timed_host_replay(log):
     return time.perf_counter() - t0, doc
 
 
-def timed_native_replay(log, checks):
+# Native baselines are PINNED once per capture session (VERDICT r5 Weak
+# #4: config3's single-shot denominator swung 4.4x between same-day
+# captures — the driver, the watcher and the suite time-share 1 vCPU, so
+# one replay's timing is mostly scheduler noise). Keyed per config; the
+# per-trial rates ride the result JSON under "native_baseline" so the
+# pin is auditable from the artifact alone.
+_NATIVE_PIN: dict = {}
+
+
+def timed_native_replay(log, checks, key=None, trials=3):
     """Native single-core denominator (VERDICT r4 #3): replay through the
     C++ engine (ytpu/native/engine.cpp) and validate its visible state
     against the host oracle. `checks` = [(root, shape, expected), ...].
-    Returns updates/s, or None when the native path is unavailable or the
-    stream is out of the engine's scope."""
+    Returns updates/s (best of `trials` replays — the least-contended
+    estimate of the engine's true rate), or None when the native path is
+    unavailable or the stream is out of the engine's scope. With `key`,
+    the first measurement pins for the rest of the session."""
+    if key is not None and key in _NATIVE_PIN:
+        return _NATIVE_PIN[key]["rate"]
     try:
         from ytpu.native import NativeEngine
 
-        eng = NativeEngine()
-        t0 = time.perf_counter()
-        for p in log:
-            eng.apply_update_v1(p)
-        dt = time.perf_counter() - t0
-        for root, shape, expected in checks:
-            got = eng.root_json(root, shape)
-            assert got == expected, f"native {root} diverged from oracle"
-        eng.close()
-        return len(log) / dt if dt > 0 else None
+        rates = []
+        for t in range(trials):
+            eng = NativeEngine()
+            t0 = time.perf_counter()
+            for p in log:
+                eng.apply_update_v1(p)
+            dt = time.perf_counter() - t0
+            if t == 0:  # validate once; the re-runs only time
+                for root, shape, expected in checks:
+                    got = eng.root_json(root, shape)
+                    assert got == expected, f"native {root} diverged from oracle"
+            eng.close()
+            if dt > 0:
+                rates.append(len(log) / dt)
+        rate = max(rates) if rates else None
+        if key is not None:
+            _NATIVE_PIN[key] = {
+                "rate": rate,
+                "trials": [round(r, 1) for r in rates],
+                "pinned": True,
+            }
+        return rate
     except Exception:
         return None
 
@@ -250,7 +275,7 @@ def bench_config3(n_docs: int):
     dt = time.perf_counter() - t0
     rate = len(log) * n_docs / dt
     py_rate = len(log) / host_dt
-    native_rate = timed_native_replay(log, [("a", "seq", expect)])
+    native_rate = timed_native_replay(log, [("a", "seq", expect)], key="config3")
 
     def _validate(st):
         assert get_values(st, 0, enc.payloads) == expect
@@ -268,6 +293,7 @@ def bench_config3(n_docs: int):
         "vs_native": round(rate / native_rate, 2) if native_rate else None,
         "vs_py_oracle": round(rate / py_rate, 2),
         "native_updates_per_sec": round(native_rate, 1) if native_rate else None,
+        "native_baseline": _NATIVE_PIN.get("config3"),
         "py_oracle_updates_per_sec": round(py_rate, 1),
         "xla_updates_per_sec": round(rate, 1),
         "conflict_scan_width": scan_stats,
@@ -336,6 +362,7 @@ def bench_config4(n_docs: int):
             ("m", "map", host_doc.get_map("m").to_json()),
             ("x", "seq", host_xml),
         ],
+        key="config4",
     )
 
     def _validate(st):
@@ -354,6 +381,7 @@ def bench_config4(n_docs: int):
         "vs_native": round(rate / native_rate, 2) if native_rate else None,
         "vs_py_oracle": round(rate / py_rate, 2),
         "native_updates_per_sec": round(native_rate, 1) if native_rate else None,
+        "native_baseline": _NATIVE_PIN.get("config4"),
         "py_oracle_updates_per_sec": round(py_rate, 1),
         "xla_updates_per_sec": round(rate, 1),
         "_fused": lambda: fused_lane_rate(
@@ -430,10 +458,20 @@ def bench_config5(n_docs: int, n_clients: int = 64):
             }
             for d in range(host_n)
         ]
-        t0 = time.perf_counter()
-        for sv in svs:
-            neng.encode_diff_v1(sv)
-        native_dt = (time.perf_counter() - t0) / host_n
+        # best-of-3 (VERDICT r5 Weak #4): the per-SV loop is short enough
+        # that box contention dominates a single shot
+        trial_dts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for sv in svs:
+                neng.encode_diff_v1(sv)
+            trial_dts.append((time.perf_counter() - t0) / host_n)
+        native_dt = min(trial_dts)
+        _NATIVE_PIN["config5"] = {
+            "rate": 1.0 / native_dt,
+            "trials": [round(1.0 / d, 1) for d in trial_dts],
+            "pinned": True,
+        }
         def coverage(payload):
             upd = Update.decode_v1(payload)
             cov = {}
@@ -510,6 +548,7 @@ def bench_config5(n_docs: int, n_clients: int = 64):
         "vs_native": round(native_dt / e2e_dt, 2) if native_dt else None,
         "vs_py_oracle": round(host_dt / e2e_dt, 2),
         "native_diffs_per_sec": round(1.0 / native_dt, 1) if native_dt else None,
+        "native_baseline": _NATIVE_PIN.get("config5"),
         "selection_docs_per_sec": round(n_docs / sel_dt, 1),
         "finisher_native_docs_per_sec": round(1.0 / nat_dt, 1),
         "finisher_python_docs_per_sec": round(1.0 / py_dt, 1),

@@ -1,0 +1,156 @@
+"""Flagship fused CHUNKED replay: full B4 at C=32768 via between-chunk
+device compaction (ISSUE-4 tentpole bench config).
+
+Why this config exists: full B4 peaks at 51,555 resident blocks. The
+fused kernel's C=65536 tile violates Pallas block-shape limits on the
+axon backend and C=32768 overflowed in round 5 because the old driver
+replayed 8192-update chunks (~26k worst-case adds — more than compaction
+can ever reclaim at that capacity). The chunk PLANNER
+(`ytpu.models.replay.plan_chunks`) sizes chunks to the shared
+CompactionPolicy budget so one compaction's headroom always admits the
+next chunk, and the chunked driver compacts the packed state on device
+between chunks — the trace never leaves VMEM-resident capacity.
+
+Modes:
+- CPU (or `--dry-run`): asserts the CHUNK/COMPACTION PLAN, not
+  throughput — the planner must produce a feasible plan at C=32768
+  (per-chunk worst-case adds within budget) that requires ≥1 compaction
+  for the full trace. No device work; runs in CI.
+- hardware: replays the FULL trace on both lanes at the same
+  docs×32768 config — xla first (its number flushes before the
+  crash-risky Pallas lane runs), then fused — and reports the same-config
+  ratio plus text parity.
+
+Usage: python benches/flagship_fused_chunked.py [--dry-run] [docs]
+Artifact: benches/flagship_fused_chunked.json (flushed per phase).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+OUT = os.path.join(HERE, "benches", "flagship_fused_chunked.json")
+CAPACITY = int(os.environ.get("YTPU_BENCH_FC_CAP", "32768"))
+state: dict = {}
+
+
+def flush():
+    with open(OUT + ".tmp", "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(OUT + ".tmp", OUT)
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "ytpu_bench_main", os.path.join(HERE, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench
+
+
+def assert_plan(plan_obj) -> dict:
+    """The CPU-checkable contract: a feasible fixed-capacity plan that
+    needs (and therefore exercises) between-chunk compaction."""
+    from ytpu.models.replay import plan_chunks
+
+    cp = plan_chunks(plan_obj.adds, CAPACITY, max_chunk=8192)
+    assert cp.feasible, (
+        f"chunk plan infeasible at C={CAPACITY}: worst chunk adds "
+        f"{cp.max_chunk_adds} > budget {cp.budget}"
+    )
+    assert cp.needs_compaction, (
+        "full B4 must exceed one capacity of worst-case adds — "
+        "compaction would never fire"
+    )
+    assert cp.chunk >= 256, f"degenerate chunk {cp.chunk}"
+    return {
+        "chunk": cp.chunk,
+        "n_chunks": cp.n_chunks,
+        "max_chunk_adds": cp.max_chunk_adds,
+        "budget": cp.budget,
+        "capacity": cp.capacity,
+        "needs_compaction": cp.needs_compaction,
+    }
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:] if a != "--dry-run"]
+    dry = "--dry-run" in sys.argv[1:]
+    docs = int(args[0]) if args else int(
+        os.environ.get("YTPU_BENCH_FULL_DOCS", "256")
+    )
+
+    os.environ.setdefault("YTPU_FUSED_VMEM_MB", "100")
+    # the batch size must be pinned BEFORE bench.py loads (it reads
+    # YTPU_BENCH_FULL_DOCS into a module constant at import)
+    os.environ["YTPU_BENCH_FULL_DOCS"] = str(docs)
+    bench = _load_bench()
+
+    full_log, expect, trace = bench.load_full_log()
+    state.update(trace=trace, docs=docs, capacity=CAPACITY)
+    flush()
+
+    from ytpu.models.replay import plan_replay
+
+    t0 = time.perf_counter()
+    plan = plan_replay(full_log)
+    state["plan_dt"] = round(time.perf_counter() - t0, 1)
+    state["chunk_plan"] = assert_plan(plan)
+    state["plan_ok"] = True
+    flush()
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    state["platform"] = platform
+    flush()
+    if dry or platform == "cpu":
+        # plan-assert mode: the acceptance contract is the plan, not
+        # throughput (interpret-mode Pallas is unavailable here anyway)
+        state["mode"] = "dry-run (chunk/compaction plan asserted)"
+        flush()
+        print(json.dumps(state))
+        return 0
+
+    chunk = state["chunk_plan"]["chunk"]
+    # xla lane FIRST: its number must be on disk before the crash-risky
+    # Pallas lane compiles (a Mosaic fault can kill the TPU worker)
+    for lane in ("xla", "fused"):
+        try:
+            t0 = time.perf_counter()
+            res = bench.device_replay_full(
+                full_log,
+                expect,
+                lane=lane,
+                cap0=CAPACITY,
+                maxcap=CAPACITY,
+                chunk=chunk,
+            )
+            res["updates_per_sec"] = round(
+                len(full_log) * res["full_docs"] / res["full_dt"], 1
+            )
+            state[lane] = res
+        except Exception as e:  # noqa: BLE001 — artifact survival over purity
+            state[f"{lane}_error"] = f"{type(e).__name__}: {e}"[:300]
+        flush()
+    if "xla" in state and "fused" in state:
+        state["fused_vs_xla"] = round(
+            state["fused"]["updates_per_sec"]
+            / state["xla"]["updates_per_sec"],
+            2,
+        )
+        flush()
+    print(json.dumps(state))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

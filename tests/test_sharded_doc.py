@@ -790,3 +790,34 @@ def test_cross_segment_move_fuzz_byte_exact():
         assert (
             sd.encode_state_as_update_v1() == oracle.encode_state_as_update_v1()
         ), seed
+
+
+def test_end_reachable_reuses_cached_pull():
+    """ADVICE r5 #5 regression: `_end_reachable` sits on the routing path
+    for same-shard id-scoped move bounds — when nothing was enqueued for
+    that shard since the last flush it must answer from the cached host
+    pull, never dispatching a new flush (the old code forced a full
+    flush + device pull per call, serializing async routing bursts)."""
+    log, _ = sequential_log(40, seed=11)
+    sd = ShardedDoc(n_shards=2, capacity=256)
+    for p in log:
+        sd.apply_update_v1(p)
+    sd.flush()
+    st = sd._pull()  # builds the host cache; queues are empty now
+
+    # two doc-order-adjacent rows on shard 0: head and its right link
+    head = int(np.asarray(st.start)[0])
+    assert head >= 0
+    nxt = int(st.blocks.right[0, head])
+    assert nxt >= 0
+    a = (int(st.blocks.client[0, head]), int(st.blocks.clock[0, head]))
+    b = (int(st.blocks.client[0, nxt]), int(st.blocks.clock[0, nxt]))
+
+    flushes = []
+    orig_flush = sd.flush
+    sd.flush = lambda: (flushes.append(1), orig_flush())[1]
+    cache_before = sd._host_cache
+    assert sd._end_reachable(0, a, b) is True
+    assert sd._end_reachable(0, b, a) is False  # right-links are one-way
+    assert not flushes, "cached path dispatched a flush"
+    assert sd._host_cache is cache_before, "cached pull was rebuilt"

@@ -57,6 +57,9 @@ __all__ = [
     "DocStateBatch",
     "UpdateBatch",
     "init_state",
+    "CompactionPolicy",
+    "DEFAULT_COMPACTION_POLICY",
+    "stream_worst_case_adds",
     "apply_update_batch",
     "ClientInterner",
     "KeyInterner",
@@ -212,6 +215,63 @@ def init_state(n_docs: int, capacity: int) -> DocStateBatch:
         n_blocks=full((n_docs,), 0),
         error=full((n_docs,), 0),
     )
+
+
+class CompactionPolicy(NamedTuple):
+    """When does a chunked replay lane compact / grow its block state?
+
+    One policy object serves BOTH device lanes (the fused Pallas driver
+    and the packed-XLA chunk step): the round-5 flagship capture showed
+    the XLA lane surviving full B4 only through mid-replay compactions
+    while the fused lane had no compaction story at all — the policies
+    must not diverge again. Mirrors the reference's commit-time squash
+    cadence (block_store.rs:155-270): compaction is not an emergency
+    valve, it runs whenever occupancy crosses the high-watermark so the
+    NEXT chunk integrates into a mostly-empty tile.
+
+    - ``high_watermark``: occupancy fraction above which a between-chunk
+      compaction fires even when the next chunk would still fit.
+    - ``chunk_budget``: fraction of capacity a single chunk's WORST-CASE
+      adds may consume — the chunk planner (`replay.plan_chunks`) sizes
+      chunks so one compaction's headroom (1 - high_watermark is the
+      floor it restores when content is mostly tombstones) always admits
+      the next chunk.
+    """
+
+    high_watermark: float = 0.85
+    chunk_budget: float = 0.15
+
+    def occupancy_trips(self, occupancy: int, capacity: int) -> bool:
+        """High-watermark check (ISSUE-4 policy: n_blocks/C > 0.85)."""
+        return occupancy > self.high_watermark * capacity
+
+    def should_compact(self, occupancy: int, margin: int, capacity: int) -> bool:
+        """Compact before the next chunk? True when projected growth
+        (`margin` = the chunk's worst-case adds) would overflow, or the
+        high-watermark already tripped."""
+        return occupancy + margin > capacity or self.occupancy_trips(
+            occupancy, capacity
+        )
+
+    def chunk_add_budget(self, capacity: int) -> int:
+        """Worst-case adds one chunk may carry under this policy."""
+        return max(1, int(self.chunk_budget * capacity))
+
+
+DEFAULT_COMPACTION_POLICY = CompactionPolicy()
+
+
+def stream_worst_case_adds(stream: UpdateBatch) -> np.ndarray:
+    """[S] worst-case block-slot growth per step of a stacked stream.
+
+    Each valid row can cost 3 slots (itself + two anchor splits), each
+    valid delete range 2 (edge splits) — the same accounting as
+    `replay.ReplayPlan.adds` and `sharded_doc.flush`'s pre-grow. Drives
+    the chunk planner's occupancy projection; host-side (numpy) so the
+    projection never touches the device."""
+    rows = np.asarray(stream.valid).sum(axis=-1).astype(np.int64)
+    dels = np.asarray(stream.del_valid).sum(axis=-1).astype(np.int64)
+    return 3 * rows + 2 * dels
 
 
 @jax.jit

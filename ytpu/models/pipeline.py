@@ -18,6 +18,7 @@ import threading
 from typing import Iterable, List, Optional, Tuple
 
 import jax
+import numpy as np
 
 from ytpu.core import Update
 from ytpu.models.batch_doc import (
@@ -36,6 +37,23 @@ class UpdatePipeline:
     Chunks are `chunk_steps` updates stacked into one `[S, ...]` stream
     (each step broadcast to every doc slot, the multi-tenant replay shape);
     one `lax.scan` program integrates a whole chunk per dispatch.
+
+    `lane` routes the integrate stage:
+
+    - ``"xla"`` (default) — the classic `apply_update_stream` dispatch per
+      chunk on the unpacked state.
+    - ``"fused"`` — OPT-IN: chunks feed the chunked fused driver
+      (`integrate_kernel.PackedReplayDriver`): the state stays in the
+      kernel's packed [NC, D, C] layout for the whole run, each chunk
+      integrates in-VMEM, and between chunks the shared
+      `CompactionPolicy` squashes the state on device when the
+      high-watermark trips — long sessions survive at fixed capacity the
+      way the flagship replay does. The returned state's origin_slot
+      cache is stale-marked (fused-lane contract).
+    - ``"packed_xla"`` — the driver with the XLA chunk step instead of
+      the Pallas kernel: identical chunk routing + compaction policy,
+      runnable where Mosaic (or interpret-mode Pallas) isn't — the
+      CPU-testable twin of ``"fused"``.
     """
 
     def __init__(
@@ -46,13 +64,27 @@ class UpdatePipeline:
         chunk_steps: int = 64,
         depth: int = 2,
         decode_v2: bool = False,
+        lane: str = "xla",
+        d_block: int = 8,
+        interpret: bool = False,
+        policy=None,
+        max_capacity: Optional[int] = None,
     ):
+        if lane not in ("xla", "fused", "packed_xla"):
+            raise ValueError(
+                f"lane must be 'xla', 'fused' or 'packed_xla', got {lane!r}"
+            )
         self.enc = enc
         self.n_rows = n_rows
         self.n_dels = n_dels
         self.chunk_steps = chunk_steps
         self.depth = depth
         self.decode_v2 = decode_v2
+        self.lane = lane
+        self.d_block = d_block
+        self.interpret = interpret
+        self.policy = policy
+        self.max_capacity = max_capacity
 
     def _chunks(self, payloads: Iterable[bytes]):
         """Decode + build padded micro-chunks (runs on the worker thread)."""
@@ -124,6 +156,7 @@ class UpdatePipeline:
         n = 0
         rank = client_rank
         rank_clients = -1
+        driver = None
         try:
             while True:
                 chunk = q.get()
@@ -134,7 +167,13 @@ class UpdatePipeline:
                     # padding keeps the compiled program stable meanwhile
                     rank_clients = len(self.enc.interner)
                     rank = self.enc.interner.rank_table()
-                state = apply_update_stream(state, chunk, rank)
+                if self.lane == "xla":
+                    state = apply_update_stream(state, chunk, rank)
+                else:
+                    if driver is None:
+                        driver = self._make_driver(state, rank)
+                    driver.rank = rank  # a grown table retraces, like xla
+                    driver.step(chunk)
                 n += 1
         finally:
             stop.set()
@@ -146,4 +185,43 @@ class UpdatePipeline:
             t.join()
         if err:
             raise err[0]
+        if driver is not None:
+            state = self._finish_driver(driver, state)
         return state, n
+
+    # ------------------------------------------------- packed-lane plumbing
+
+    def _make_driver(self, state: DocStateBatch, rank):
+        from ytpu.models.batch_doc import ensure_origin_slot
+        from ytpu.ops.integrate_kernel import PackedReplayDriver, pack_state
+
+        kernel_lane = "fused" if self.lane == "fused" else "xla"
+        if kernel_lane == "xla":
+            # the packed XLA chunk step's conflict scan reads the
+            # origin_slot cache plane: refresh a stale one up front
+            state = ensure_origin_slot(state)
+        cols, meta = pack_state(state)
+        return PackedReplayDriver(
+            cols,
+            meta,
+            rank,
+            d_block=self.d_block,
+            interpret=self.interpret,
+            lane=kernel_lane,
+            policy=self.policy,
+            max_capacity=self.max_capacity,
+            initial_occupancy=int(np.asarray(state.n_blocks).max()),
+        )
+
+    def _finish_driver(self, driver, state: DocStateBatch) -> DocStateBatch:
+        from ytpu.models.batch_doc import mark_origin_slot_stale
+        from ytpu.ops.integrate_kernel import unpack_state
+
+        cols, meta = driver.finish()
+        out = unpack_state(cols, meta, state)
+        if self.lane == "fused":
+            # fused kernel rows leave the cache plane stale (same contract
+            # as apply_update_stream_fused); the packed-XLA step maintains
+            # it in-kernel
+            mark_origin_slot_stale(out)
+        return out

@@ -29,7 +29,6 @@ padded chunk buffer. Decode, integrate, squash, and GC all run on device.
 
 from __future__ import annotations
 
-import os
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -37,7 +36,14 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["ReplayPlan", "UnitArenaView", "plan_replay", "FusedReplay"]
+__all__ = [
+    "ReplayPlan",
+    "UnitArenaView",
+    "plan_replay",
+    "FusedReplay",
+    "ChunkPlan",
+    "plan_chunks",
+]
 
 
 @dataclass
@@ -200,7 +206,68 @@ class ReplayStats:
     chunk_seconds: List[float] = field(default_factory=list)
 
 
-_XLA_STEP = None
+@dataclass(frozen=True)
+class ChunkPlan:
+    """Host-side chunk/compaction plan for a fixed-capacity chunked replay.
+
+    `chunk` is the fixed steps-per-dispatch (one compiled program serves
+    every chunk); `max_chunk_adds` the worst-case block-slot growth any
+    single chunk can cause; `budget` the policy's per-chunk growth
+    allowance at this capacity; `needs_compaction` whether the stream's
+    total worst-case growth exceeds one capacity (≥1 between-chunk
+    compaction is then guaranteed in the plan)."""
+
+    chunk: int
+    n_chunks: int
+    max_chunk_adds: int
+    budget: int
+    capacity: int
+    needs_compaction: bool
+
+    @property
+    def feasible(self) -> bool:
+        """Every chunk's worst-case growth fits the policy budget — the
+        dry-run assertion of `benches/flagship_fused_chunked.py`."""
+        return self.max_chunk_adds <= self.budget
+
+
+def plan_chunks(adds, capacity: int, max_chunk: int = 8192, policy=None) -> ChunkPlan:
+    """Size the fixed replay chunk so between-chunk compaction suffices.
+
+    The round-5 flagship failure mode was exactly a mis-sized chunk: at
+    C=32768 an 8192-update B4 chunk carries ~26k worst-case adds, so even
+    a perfect compaction can't make room and the replay dies with "state
+    full at max capacity". This planner picks the largest power-of-two
+    chunk ≤ `max_chunk` whose worst consecutive window of per-update adds
+    (`adds`, the `ReplayPlan.adds` accounting) fits the shared
+    `CompactionPolicy`'s chunk budget — compaction restores at least
+    `1 - high_watermark` of the capacity whenever the policy fires, so a
+    budget-sized chunk always has room. Both device lanes plan with this
+    one function (shared-policy requirement of ISSUE-4)."""
+    from ytpu.models.batch_doc import DEFAULT_COMPACTION_POLICY
+
+    policy = policy or DEFAULT_COMPACTION_POLICY
+    adds = np.asarray(adds, dtype=np.int64)
+    S = int(adds.shape[0])
+    budget = policy.chunk_add_budget(capacity)
+    cum = np.concatenate([[0], np.cumsum(adds)])
+
+    def worst_window(chunk: int) -> int:
+        starts = np.arange(0, S, chunk)
+        ends = np.minimum(starts + chunk, S)
+        return int((cum[ends] - cum[starts]).max(initial=0))
+
+    chunk = 1 << max(0, int(max_chunk).bit_length() - 1)  # pow2 round-down
+    while chunk > 1 and worst_window(chunk) > budget:
+        chunk //= 2
+    return ChunkPlan(
+        chunk=chunk,
+        n_chunks=(S + chunk - 1) // chunk,
+        max_chunk_adds=worst_window(chunk),
+        budget=budget,
+        capacity=capacity,
+        needs_compaction=int(adds.sum()) > capacity,
+    )
 
 
 def _decoder(max_rows: int, max_dels: int, n_steps: int, max_sections: int):
@@ -226,36 +293,28 @@ def _decoder(max_rows: int, max_dels: int, n_steps: int, max_sections: int):
 
 
 def _xla_chunk_step(cols, meta, stream, rank):
-    """One chunk of stream steps through the un-fused XLA integrate path,
-    on the packed kernel state (unpack → apply_update_stream → repack,
-    all inside one jit so XLA fuses the repacks away). The jitted step is
-    a module singleton — a per-call closure would retrace every chunk."""
-    global _XLA_STEP
-    if _XLA_STEP is None:
-        import jax
+    """Back-compat shim: the packed-XLA chunk step moved to
+    `integrate_kernel.xla_chunk_step` so the chunked driver and this
+    module share ONE compiled singleton (two copies would hold duplicate
+    unevictable executables under the progbudget registry)."""
+    from ytpu.ops.integrate_kernel import xla_chunk_step
 
-        from ytpu.models.batch_doc import apply_update_stream
-        from ytpu.ops.integrate_kernel import pack_state, unpack_state
-
-        def step(cols, meta, stream, rank):
-            state = unpack_state(cols, meta, None)
-            state = apply_update_stream(state, stream, rank)
-            return pack_state(state)
-
-        # donate like the fused _run: the packed state updates in place
-        # instead of holding two full copies at grown capacity
-        _XLA_STEP = jax.jit(step, donate_argnums=(0, 1))
-    return _XLA_STEP(cols, meta, stream, rank)
+    return xla_chunk_step(cols, meta, stream, rank)
 
 
 class FusedReplay:
     """Chunked fused replay of one shared update stream over a doc batch.
 
-    Capacity management: after each chunk the high-water block count is
-    read back; if the next chunk might not fit, the state compacts
-    (`compact_packed`), and if compaction alone can't make room it grows
-    (`grow_packed`). `margin` is the worst-case rows a chunk can add
-    (rows + 2 splits per delete range)."""
+    Capacity management now rides the shared chunked driver
+    (`integrate_kernel.PackedReplayDriver`): before each chunk the driver
+    checks the `CompactionPolicy` — projected worst-case growth (`margin`
+    = rows·3 + delete ranges·2, `ReplayPlan.adds`) against capacity AND
+    the high-watermark — compacting (`compact_packed`) and, only when
+    compaction can't make room, growing (`grow_packed`). Both kernel
+    lanes ("fused" Pallas / "xla" packed fallback) share the one policy;
+    `sync_per_chunk=False` switches to the lazy occupancy readout (no
+    device sync per chunk — chunk_seconds then measure dispatch, not
+    execution)."""
 
     def __init__(
         self,
@@ -267,6 +326,8 @@ class FusedReplay:
         chunk: int = 8192,
         interpret: bool = False,
         lane: str = "fused",
+        policy=None,
+        sync_per_chunk: bool = True,
     ):
         import jax.numpy as jnp
 
@@ -282,24 +343,42 @@ class FusedReplay:
         self.interpret = interpret
         self.lane = lane
         self.max_capacity = max_capacity
+        self.policy = policy
+        self.sync_per_chunk = sync_per_chunk
         self.cols, self.meta = pack_state(init_state(n_docs, capacity))
         self.stats = ReplayStats(capacity=capacity)
+        self._hi = 0  # occupancy upper bound carried across run()/compact()
         self._jnp = jnp
 
     def _capacity(self) -> int:
         return self.cols.shape[2]
 
+    def _make_driver(self, rank):
+        from ytpu.ops.integrate_kernel import PackedReplayDriver
+
+        return PackedReplayDriver(
+            self.cols,
+            self.meta,
+            rank,
+            d_block=self.d_block,
+            interpret=self.interpret,
+            lane=self.lane,
+            policy=self.policy,
+            unit_refs=True,
+            gc_ranges=True,
+            max_capacity=self.max_capacity,
+            sync_every_chunk=self.sync_per_chunk,
+            initial_occupancy=self._hi,
+        )
+
     def run(self, payloads: List[bytes], client_rank=None) -> ReplayStats:
-        import jax
         import jax.numpy as jnp
 
-        from ytpu.ops.compaction import compact_packed, grow_packed
         from ytpu.ops.decode_kernel import (
             FLAG_ERRORS,
             identity_rank,
             pack_updates,
         )
-        from ytpu.ops.integrate_kernel import M_ERROR, M_NBLOCKS, _run, pack_stream
 
         plan = self.plan
         if client_rank is None:
@@ -312,36 +391,15 @@ class FusedReplay:
                     "explicit client_rank table"
                 )
             client_rank = identity_rank(256)
-        rank = client_rank
         decode = _decoder(
             plan.max_rows, plan.max_dels, plan.max_steps, plan.max_sections
         )
+        driver = self._make_driver(client_rank)
         S = len(payloads)
         pos = 0
-        hi = 0  # high-water block count from the previous chunk's readback
         while pos < S:
             t0 = time.perf_counter()
             end = min(pos + self.chunk, S)
-            # worst-case state rows this chunk can add: compact/grow BEFORE
-            # integrating so ERR_CAPACITY (which corrupts the tile) cannot
-            # fire mid-chunk
-            margin = int(plan.adds[pos:end].sum()) + 8
-            if hi + margin > self._capacity():
-                self.cols, self.meta = compact_packed(
-                    self.cols, self.meta, unit_refs=True, gc_ranges=True
-                )
-                self.stats.compactions += 1
-                hi = int(np.asarray(self.meta)[:, M_NBLOCKS].max())
-                while hi + margin > self._capacity():
-                    new_cap = min(self._capacity() * 2, self.max_capacity)
-                    if new_cap == self._capacity():
-                        raise RuntimeError(
-                            f"state full at max capacity {new_cap}"
-                        )
-                    self.cols, self.meta = grow_packed(
-                        self.cols, self.meta, new_cap
-                    )
-                    self.stats.growths += 1
             batch = payloads[pos:end]
             if len(batch) < self.chunk:
                 batch = batch + [b"\x00\x00"] * (self.chunk - len(batch))
@@ -366,48 +424,24 @@ class FusedReplay:
                     f"device decode flagged updates "
                     f"{(pos + bad[:8]).tolist()}: flags {f[bad[:8]].tolist()}"
                 )
-            if self.lane == "fused":
-                rows, dels = pack_stream(stream)
-                # YTPU_FUSED_VMEM_MB rides `_run` as a STATIC arg (read
-                # per chunk): a changed limit forces a retrace instead of
-                # silently reusing the old compiled guard (ADVICE r5 #2)
-                vmem_mb = int(os.environ.get("YTPU_FUSED_VMEM_MB", "64"))
-                self.cols, self.meta = _run(
-                    self.cols,
-                    self.meta,
-                    (rows, dels, rank),
-                    self.d_block,
-                    self.interpret,
-                    3,
-                    4,
-                    vmem_mb,
-                )
-            else:
-                # XLA lane: the un-fused integrate path (batch_doc's
-                # apply_update_stream) on the same packed state — the
-                # HBM-bound fallback when Mosaic can't take the kernel
-                self.cols, self.meta = _xla_chunk_step(
-                    self.cols, self.meta, stream, rank
-                )
-            # high-water check (forces the step to complete: the readback
-            # doubles as the per-chunk latency fence)
-            meta_np = np.asarray(self.meta)
-            from ytpu.utils.phases import phases as _phases
-
-            if _phases.enabled:
-                _phases.transfer("replay.readback", meta_np.nbytes, "d2h")
-            if (meta_np[:, M_ERROR] != 0).any():
-                raise RuntimeError(
-                    f"device error flags "
-                    f"{meta_np[meta_np[:, M_ERROR] != 0][:4]}"
-                )
-            hi = int(meta_np[:, M_NBLOCKS].max())
-            self.stats.peak_blocks = max(self.stats.peak_blocks, hi)
+            # worst-case state rows this chunk can add: the driver
+            # compacts/grows BEFORE integrating so ERR_CAPACITY (which
+            # corrupts the tile) cannot fire mid-chunk; with
+            # sync_every_chunk the post-step readout drain doubles as the
+            # per-chunk latency fence
+            driver.step(stream, margin=int(plan.adds[pos:end].sum()) + 8)
+            self.cols, self.meta = driver.cols, driver.meta
             self.stats.chunk_seconds.append(time.perf_counter() - t0)
-            self.stats.chunks += 1
             pos = end
+        self.cols, self.meta = driver.finish()
+        d = driver.stats
+        self.stats.chunks += d.chunks
+        self.stats.compactions += d.compactions
+        self.stats.growths += d.growths
+        self.stats.peak_blocks = max(self.stats.peak_blocks, d.peak_blocks)
         self.stats.capacity = self._capacity()
-        self.stats.final_blocks = int(np.asarray(self.meta)[:, M_NBLOCKS].max())
+        self.stats.final_blocks = d.final_blocks
+        self._hi = d.final_blocks
         return self.stats
 
     def compact(self) -> int:
@@ -420,7 +454,8 @@ class FusedReplay:
             self.cols, self.meta, unit_refs=True, gc_ranges=True
         )
         self.stats.compactions += 1
-        return int(np.asarray(self.meta)[:, M_NBLOCKS].max())
+        self._hi = int(np.asarray(self.meta)[:, M_NBLOCKS].max())
+        return self._hi
 
     def get_string(self, doc: int) -> str:
         """Final text of one doc slot (host walk over the readback rows)."""

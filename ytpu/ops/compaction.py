@@ -200,15 +200,29 @@ def compact_state(state: DocStateBatch) -> DocStateBatch:
 def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
     """Squash + GC one doc in the fused kernel's packed domain.
 
-    `cols` is the kernel's [NC, C] column stack (root-sequence domain: no
-    key/parent/move linkage by construction), `meta` its [M_PAD] row.
+    `cols` is the kernel's [NC, C] column stack, `meta` its [M_PAD] row.
+    The full fused-lane schema is honored — map keys, nested parents,
+    move ownership/range planes and the origin_slot cache plane all
+    survive (slot-valued planes remap through the defrag permutation) —
+    so this pass is safe to run at a CHUNK BOUNDARY of the chunked
+    replay driver (`integrate_kernel.PackedReplayDriver`): rows the NEXT
+    chunk will split (an origin landing mid-block of a squashed run) or
+    claim (a live move whose range spans the boundary) keep every
+    invariant the kernel's find_slot/claim walks rely on, because merges
+    preserve clock-range containment and never cross a difference in
+    deleted/moved/key/parent state.
 
     Two rules beyond `_compact_one`:
     - `gc_ranges`: tombstones become origin-free BLOCK_GC ranges and merge
       under clock contiguity + sequence adjacency alone — the reference's
       default-GC behavior (gc.rs:11-65 drops the item wholesale;
       squash_left_range_compaction block_store.rs:155-235 collapses runs),
-      vs the softer skip_gc-style CONTENT_DELETED conversion.
+      vs the softer skip_gc-style CONTENT_DELETED conversion. A
+      tombstoned MOVE row converts like any other: its range planes clear
+      with it (the reference drops the move item wholesale), so it can
+      merge into adjacent GC runs instead of lingering as an unmergeable
+      pseudo-move — safe because the end-of-chunk `recompute_moves` never
+      leaves a live claim pointing at a tombstoned owner.
     - `unit_refs`: string content refs are absolute UTF-16-unit offsets
       into a content arena, so runs from *different* updates merge when
       `b.ref + b.off == a.ref + a.off + a.len` — the device equivalent of
@@ -268,6 +282,16 @@ def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
     rk = jnp.where(convert & gc_ranges, 0, cols[RK])
     # origin cleared -> cached origin slot cleared with it (cache contract)
     os_c = jnp.where(convert & gc_ranges, -1, cols[OS])
+    # converted dead moves drop their range planes (see docstring): the
+    # MPR >= 0 squash veto below then no longer pins them apart from the
+    # surrounding GC run
+    msc = jnp.where(convert & gc_ranges, -1, cols[MSC])
+    msk = jnp.where(convert & gc_ranges, 0, cols[MSK])
+    msa = jnp.where(convert & gc_ranges, 0, cols[MSA])
+    mec = jnp.where(convert & gc_ranges, -1, cols[MEC])
+    mek = jnp.where(convert & gc_ranges, 0, cols[MEK])
+    mea = jnp.where(convert & gc_ranges, 0, cols[MEA])
+    mpr = jnp.where(convert & gc_ranges, -1, cols[MPR])
 
     cl, ck, ln, lt, rt = cols[CL], cols[CK], cols[LN], cols[LT], cols[RT]
 
@@ -293,8 +317,8 @@ def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
         # — rows owned by different moves (or one owned, one not) never
         # merge, and move rows themselves (length-1 ranges) don't either
         & (cols[MV] == g(cols[MV]))
-        & (cols[MPR] < 0)
-        & (g(cols[MPR]) < 0)
+        & (mpr < 0)
+        & (mpr[sb] < 0)
     )
     gcish = kind == BLOCK_GC
     # ContentType rows carry live child-sequence heads even when deleted;
@@ -383,13 +407,13 @@ def _compact_packed_one(cols, meta, unit_refs: bool, gc_ranges: bool):
             pack(remap(pa_c), -1),  # PA
             pack(remap(cols[HD]), -1),  # HD
             pack(remap(cols[MV]), -1),  # MV (slot index: defrag remap)
-            pack(cols[MSC], -1),  # MSC
-            pack(cols[MSK], 0),  # MSK
-            pack(cols[MSA], 0),  # MSA
-            pack(cols[MEC], -1),  # MEC
-            pack(cols[MEK], 0),  # MEK
-            pack(cols[MEA], 0),  # MEA
-            pack(cols[MPR], -1),  # MPR
+            pack(msc, -1),  # MSC
+            pack(msk, 0),  # MSK
+            pack(msa, 0),  # MSA
+            pack(mec, -1),  # MEC
+            pack(mek, 0),  # MEK
+            pack(mea, 0),  # MEA
+            pack(mpr, -1),  # MPR
             pack(remap(os_c), -1),  # OS (slot index: defrag remap)
         ]
     )

@@ -948,9 +948,27 @@ class ShardedDoc:
         """Is the row containing `end_id` reachable from the one containing
         `start_id` by right-links on `shard`? Decides claim-walk direction
         for same-shard id-scoped move bounds (rare: only moves whose both
-        bounds share a shard ever need it). Pulls the shard's columns."""
-        self.flush()
-        st = self._pull()
+        bounds share a shard ever need it).
+
+        This sits on the ROUTING path, so it must not become a hidden
+        serialization point (ADVICE r5 #5): the walk only reads `shard`'s
+        right-links, which queued work for OTHER shards cannot change —
+        flush only when THIS shard has pending rows/deletes, and reuse
+        the cached host pull when one exists (queued-but-unflushed rows
+        are host-side only, so the cache still reflects device truth)."""
+        if self._queue_rows[shard] or self._queue_dels[shard]:
+            self.flush()
+        st = self._host_cache
+        if st is None:
+            # no cached pull: sync (surfacing sticky error flags) and read
+            # the columns WITHOUT dispatching other shards' queues. The
+            # read stays LOCAL unless the global queue is empty: a cached
+            # `_host_cache` promises "fully flushed" to `_pull`'s other
+            # readers, which rows still queued on OTHER shards would break
+            self._sync()
+            st = jax.tree.map(np.asarray, self.state)
+            if self._queued == 0:
+                self._host_cache = st
         bl = st.blocks
         n = int(np.asarray(st.n_blocks)[shard])
         cl = np.asarray(bl.client[shard])[:n]
