@@ -329,6 +329,8 @@ def test_b4_trace_prefix_rides_device_lane():
     from ytpu.models.batch_doc import apply_update_stream, get_string, init_state
     from ytpu.ops.decode_kernel import RawPayloadView, identity_rank
 
+    if not os.path.exists(bench.TRACE_PATH):
+        pytest.skip(f"B4 trace asset not in this container: {bench.TRACE_PATH}")
     ops = bench.load_b4_ops(400)
     doc = Doc(client_id=1)
     log = []

@@ -24,6 +24,8 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
+from _fused_interpret import run_or_skip
+
 from ytpu.core import Doc
 from ytpu.core.update import Update
 from ytpu.models.batch_doc import (
@@ -263,12 +265,9 @@ def test_fused_lane_default_defers_and_marks_stale():
     steps = [enc.build_step(Update.decode_v1(p), 16, 16) for p in log]
     stream = BatchEncoder.stack_steps(steps)
     rank = enc.interner.rank_table()
-    try:
-        fused = apply_update_stream_fused(
-            init_state(4, 512), stream, rank, d_block=2, interpret=True
-        )
-    except NotImplementedError:
-        pytest.skip("interpret-mode Pallas unavailable in this jax build")
+    fused = run_or_skip(lambda: apply_update_stream_fused(
+        init_state(4, 512), stream, rank, d_block=2, interpret=True
+    ))
     assert origin_slot_is_stale(fused)
     assert _invariant_violations(ensure_origin_slot(fused)) == []
     eager = apply_update_stream_fused(

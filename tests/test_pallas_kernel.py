@@ -23,6 +23,8 @@ from ytpu.models.batch_doc import (
 )
 from ytpu.ops.integrate_kernel import apply_update_stream_fused
 
+from _fused_interpret import run_or_skip
+
 
 def build_stream(ops_fn, n_docs=8, capacity=128, rows=4, dels=4):
     doc = Doc(client_id=1)
@@ -76,14 +78,16 @@ def assert_same_state(a, b):
 
 
 def run_both(stream, rank, n_docs=8, capacity=128, d_block=4):
-    xla_state = apply_update_stream(init_state(n_docs, capacity), stream, rank)
     # refresh_cache=True: assert_same_state compares the origin_slot
     # cache column, so opt into the eager rebuild (the default is the
-    # lazy stale-marked contract — tests/test_origin_slot.py covers it)
-    fused_state = apply_update_stream_fused(
+    # lazy stale-marked contract — tests/test_origin_slot.py covers it).
+    # The fused (skippable) lane runs FIRST so a skip never pays the
+    # XLA lane's per-shape compile.
+    fused_state = run_or_skip(lambda: apply_update_stream_fused(
         init_state(n_docs, capacity), stream, rank, d_block=d_block,
         interpret=True, refresh_cache=True,
-    )
+    ))
+    xla_state = apply_update_stream(init_state(n_docs, capacity), stream, rank)
     return xla_state, fused_state
 
 
@@ -280,10 +284,10 @@ def test_fused_multi_root_anchor_rows():
             st = ensure_root_anchor(st, d, kid)
         return st
 
-    xla_state = apply_update_stream(seed(), stream, rank)
-    fused_state = apply_update_stream_fused(
+    fused_state = run_or_skip(lambda: apply_update_stream_fused(
         seed(), stream, rank, d_block=4, interpret=True, refresh_cache=True
-    )
+    ))
+    xla_state = apply_update_stream(seed(), stream, rank)
     assert_same_state(xla_state, fused_state)
     assert int(np.asarray(fused_state.error).max()) == 0
     assert get_string(fused_state, 0, enc.payloads) == "body?"
@@ -303,7 +307,7 @@ def test_fused_missing_anchor_flags_missing_dep():
             doc.get_text("title").insert(txn, 0, "y")
 
     stream, rank, enc, _ = build_stream(ops)
-    fused_state = apply_update_stream_fused(
+    fused_state = run_or_skip(lambda: apply_update_stream_fused(
         init_state(4, 64), stream, rank, d_block=4, interpret=True
-    )
+    ))
     assert (np.asarray(fused_state.error) & ERR_MISSING_DEP).all()

@@ -18,12 +18,13 @@ from ytpu.core import Doc, Update
 from ytpu.models.batch_doc import (
     BatchEncoder,
     CompactionPolicy,
-    apply_update_stream,
     get_string,
     get_values,
     init_state,
 )
 from ytpu.ops.integrate_kernel import replay_stream_fused
+
+from _fused_interpret import run_or_skip
 
 
 def _capture(doc):
@@ -61,15 +62,17 @@ def _text_stream(rounds=8, typed=20, erased=18):
 def test_chunked_xla_compaction_parity_text():
     """Multi-chunk stream whose total row growth exceeds the chunked
     capacity: ≥1 between-chunk compaction must fire and the final text
-    must match both the unchunked XLA lane and the host oracle."""
+    must match the host oracle — which IS the unchunked XLA lane's
+    output (their equality is asserted suite-wide by test_batch_doc;
+    compaction permutes slots, so decoded output, not raw state, is the
+    byte-exact surface)."""
     stream, enc, expect = _text_stream()
     rank = enc.interner.rank_table()
 
-    # unchunked reference lane at a capacity that holds every raw row
-    ref = apply_update_stream(init_state(2, 256), stream, rank)
-    assert int(np.asarray(ref.error).max()) == 0
-    assert get_string(ref, 0, enc.payloads) == expect
-    raw_rows = int(np.asarray(ref.n_blocks).max())
+    # every valid stream row integrates to one resident block and
+    # deletes only tombstone, so the encoded row count is a strict lower
+    # bound on uncompacted residency — no device reference run needed
+    raw_rows = int(np.asarray(stream.valid).sum())
 
     st, stats = replay_stream_fused(
         init_state(2, 96),
@@ -134,7 +137,12 @@ def test_chunk_boundary_splits_after_compaction():
 def test_chunk_boundary_compaction_with_live_moves():
     """Compaction landing mid-stream with LIVE move ranges spanning the
     chunk boundary: the packed pass must remap the MV plane and keep the
-    move-range planes intact for later chunks' claim recomputes."""
+    move-range planes intact for later chunks' claim recomputes.
+
+    Shapes deliberately reuse the (chunk=16, rows=4, dels=4, C=96)
+    family the tests above already compiled — one program serves the
+    whole file, and distinct big programs are the suite's scarce
+    resource (conftest.py LLVM-arena note)."""
     doc = Doc(client_id=1)
     log = _capture(doc)
     arr = doc.get_array("a")
@@ -144,14 +152,14 @@ def test_chunk_boundary_compaction_with_live_moves():
     for r in range(8):
         with doc.transact() as txn:
             arr.move_range_to(txn, 1, 3, len(arr) - 1)
-        with doc.transact() as txn:
-            for v in range(6):
+        for v in range(4):  # one row per txn: fits the 4-row bucket
+            with doc.transact() as txn:
                 arr.insert(txn, 2, 100 * r + v)
         with doc.transact() as txn:
             arr.remove_range(txn, 3, 5)
     expect = arr.to_json()
     enc = BatchEncoder(root_name="a")
-    steps = [enc.build_step(Update.decode_v1(p), 12, 4) for p in log]
+    steps = [enc.build_step(Update.decode_v1(p), 4, 4) for p in log]
     stream = BatchEncoder.stack_steps(steps)
     rank = enc.interner.rank_table()
 
@@ -159,12 +167,13 @@ def test_chunk_boundary_compaction_with_live_moves():
         init_state(2, 96),
         stream,
         rank,
-        chunk_steps=8,
+        chunk_steps=16,
         lane="xla",
-        max_capacity=2048,  # move churn pins rows: growth stays available
-        policy=CompactionPolicy(high_watermark=0.5, chunk_budget=0.5),
+        max_capacity=96,
+        policy=CompactionPolicy(high_watermark=0.3, chunk_budget=0.5),
     )
     assert stats.compactions >= 1, stats
+    assert stats.growths == 0, stats  # pins the shape-reuse property
     assert int(np.asarray(st.error).max()) == 0
     assert get_values(st, 0, enc.payloads) == expect
     assert get_values(st, 1, enc.payloads) == expect
@@ -213,19 +222,16 @@ def test_replay_stream_fused_interpret_or_skip():
     steps = [enc.build_step(Update.decode_v1(p), 4, 4) for p in log]
     stream = BatchEncoder.stack_steps(steps)
     rank = enc.interner.rank_table()
-    try:
-        st, stats = replay_stream_fused(
-            init_state(2, 96),
-            stream,
-            rank,
-            chunk_steps=16,
-            d_block=2,
-            interpret=True,
-            lane="fused",
-            max_capacity=96,
-        )
-    except NotImplementedError as e:
-        pytest.skip(f"interpret-mode Pallas unavailable in this jax: {e}")
+    st, stats = run_or_skip(lambda: replay_stream_fused(
+        init_state(2, 96),
+        stream,
+        rank,
+        chunk_steps=16,
+        d_block=2,
+        interpret=True,
+        lane="fused",
+        max_capacity=96,
+    ))
     assert int(np.asarray(st.error).max()) == 0
     assert get_string(st, 0, enc.payloads) == expect
 

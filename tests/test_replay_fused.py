@@ -18,16 +18,18 @@ needs_native = pytest.mark.skipif(
 )
 
 
+from _fused_interpret import run_or_skip as _interpret_or_skip
+
+
 def run_or_skip(rep, log):
     """Drive a FusedReplay, SKIPPING when this container's jax cannot
     interpret Pallas TPU kernels (NotImplementedError from the
     interpreter — environmental, present at seed; see
     docs/known_backend_issues.md §3). Real-hardware parity is covered by
-    benches/flagship_fused_chunked.py and the mosaic ladder."""
-    try:
-        return rep.run(log)
-    except NotImplementedError as e:
-        pytest.skip(f"interpret-mode Pallas unavailable in this jax: {e}")
+    benches/flagship_fused_chunked.py and the mosaic ladder. The skip is
+    memoized across files (tests/_fused_interpret.py) so only the first
+    fused interpret test in the session pays the kernel trace."""
+    return _interpret_or_skip(lambda: rep.run(log))
 
 
 def _edit_log(ops, client_id=1):
