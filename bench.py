@@ -340,7 +340,8 @@ _PREFIX_ORACLE: dict = {}
 
 
 def device_replay_full(
-    log, expect, lane="fused", cap0=None, maxcap=None, chunk=None, d_block=None
+    log, expect, lane="fused", cap0=None, maxcap=None, chunk=None,
+    d_block=None, overlap=False,
 ):
     """Full-stream chunked replay with compaction + growth in the timed
     loop (ytpu/models/replay.py). `lane="fused"` drives the Pallas kernel;
@@ -403,6 +404,7 @@ def device_replay_full(
                 chunk=chunk,
                 interpret=interpret,
                 lane=lane,
+                overlap=overlap,
             )
             warm.run(prefix)
             got = warm.get_string(0)
@@ -427,6 +429,7 @@ def device_replay_full(
                 chunk=chunk,
                 interpret=interpret,
                 lane=lane,
+                overlap=overlap,
             )
             t0 = time.perf_counter()
             stats = rep.run(log)
@@ -456,6 +459,15 @@ def device_replay_full(
                 "final_blocks": stats.final_blocks,
                 "p99_chunk_ms": round(p99, 2),
             }
+            if overlap:
+                out["overlap"] = {
+                    "syncs": stats.syncs,
+                    "stage_s": round(stats.stage_s, 3),
+                    "stall_s": round(stats.stall_s, 3),
+                    "overlap_ratio": round(stats.overlap_ratio, 3),
+                    "max_inflight": stats.max_inflight,
+                    "buffer_reuses": stats.buffer_reuses,
+                }
             if chunk_plan is not None:
                 out["chunk_plan"] = {
                     "chunk": chunk_plan.chunk,
@@ -473,6 +485,98 @@ def device_replay_full(
             if docs < 8:
                 break
     raise RuntimeError(f"full replay failed: {last_err}")
+
+
+def overlap_dry_run(log, chunk: int = 256, depth: int = 2) -> dict:
+    """Host-only staging rehearsal of the async replay pipeline (no jax,
+    no device): drive the shared overlap engine (`replay.OverlapPipeline`)
+    over the stream with a SIMULATED per-chunk dispatch cost, ASSERTING
+    the staging plan — dispatch depth capped at `depth`, exactly `depth`
+    preallocated buffers, every later chunk re-packing a recycled one —
+    and that staging genuinely hides behind dispatch
+    (`overlap_ratio > 0`). That ratio is the non-vacuous CI guard: a
+    regression that serializes the engine pins it at exactly 0, whereas
+    modeled_speedup = (stage + dispatch) / max(stage, dispatch) is ≥ 1
+    by algebra and only reports the size of the win. Both sides sleep a
+    deterministic floor (staging 1ms, dispatch 2ms per chunk) so
+    scheduler jitter can't flip the ratio assertion on a loaded CI box.
+    Catches overlap-plumbing regressions before a real bench round burns
+    a device window."""
+    import queue as _queue
+
+    import numpy as np
+
+    from ytpu.models.replay import OverlapPipeline, _StagingSlot, plan_overlap
+
+    oplan = plan_overlap(len(log), chunk, depth=depth)
+    width = max((len(p) for p in log), default=0) + 16
+    slots = [_StagingSlot(chunk, width, 1) for _ in range(oplan.buffers)]
+    free: "_queue.Queue" = _queue.Queue()
+    for s in slots:
+        free.put(s)
+    acquisitions = 0
+    consume_s = 0.0
+    held = []
+    # distinct prefix: the documented replay.* phase keys stay reserved
+    # for REAL async replays — these values are simulated-sleep artifacts
+    pipe = OverlapPipeline(depth=depth, stage_prefix="rehearsal")
+
+    def produce():
+        nonlocal acquisitions
+        for pos in range(0, len(log), chunk):
+            while True:
+                try:
+                    slot = free.get(timeout=0.1)
+                    break
+                except _queue.Empty:
+                    # same bail as FusedReplay._run_overlap: a dead
+                    # consumer never frees slots — don't strand join()
+                    if pipe.stopping:
+                        return
+            end = min(pos + chunk, len(log))
+            for i, p in enumerate(log[pos:end]):
+                slot.buf[i, : len(p)] = np.frombuffer(p, dtype=np.uint8)
+                slot.lens[i] = len(p)
+            slot.pos, slot.end = pos, end
+            time.sleep(0.001)  # staging floor — see docstring
+            acquisitions += 1
+            yield slot
+
+    def consume(slot):
+        nonlocal consume_s
+        t0 = time.perf_counter()
+        time.sleep(0.002)  # simulated device dispatch — see docstring
+        held.append(slot)
+        if len(held) >= depth:
+            free.put(held.pop(0))
+        consume_s += time.perf_counter() - t0
+
+    stats = pipe.run(produce(), consume)
+    reuses = max(0, acquisitions - len(slots))
+    assert stats.consumed == oplan.n_chunks, (stats, oplan)
+    assert stats.max_depth <= depth, f"depth cap violated: {stats.max_depth}"
+    assert reuses == oplan.buffer_reuses, (reuses, oplan)
+    # the non-vacuous guard: a serialized engine waits out ALL staging
+    # (stall == stage → ratio exactly 0); any real overlap lifts it.
+    # A 1-chunk stream has no chunk k+1 to hide, so its ratio is an
+    # inherent 0, not a regression — only assert when overlap is possible
+    if oplan.n_chunks >= 2:
+        assert stats.overlap_ratio > 0.0, (
+            f"no staging hidden behind dispatch: {stats}"
+        )
+    total = stats.stage_s + consume_s
+    speedup = total / max(stats.stage_s, consume_s, 1e-9)
+    return {
+        "depth": oplan.depth,
+        "buffers": oplan.buffers,
+        "n_chunks": oplan.n_chunks,
+        "buffer_reuses": reuses,
+        "max_inflight": stats.max_depth,
+        "overlap_ratio": round(stats.overlap_ratio, 3),
+        "stage_s": round(stats.stage_s, 4),
+        "modeled_speedup": round(speedup, 3),  # ≥ 1 by algebra; the
+        # regression guard is the overlap_ratio assertion above
+    }
 
 
 def _device_configs(result: dict, flush) -> None:
@@ -685,8 +789,12 @@ def _device_phase_child(in_path: str, out_path: str) -> None:
                 "skipped: cpu rehearsal on untruncated trace"
             )
         else:
+            fc_cap = int(os.environ.get("YTPU_BENCH_FC_CAP", "32768"))
+            # overlap ON first (the designed flagship path — its number
+            # must be on disk before anything else risks the worker),
+            # then the serial loop at the same config so the round
+            # records the overlap win as a measured ratio, not a claim
             try:
-                fc_cap = int(os.environ.get("YTPU_BENCH_FC_CAP", "32768"))
                 fc = device_replay_full(
                     job["log"],
                     job["expect"],
@@ -694,10 +802,33 @@ def _device_phase_child(in_path: str, out_path: str) -> None:
                     cap0=fc_cap,
                     maxcap=fc_cap,
                     chunk="auto",
+                    overlap=True,
                 )
                 result.update({f"fused_chunked_{k}": v for k, v in fc.items()})
             except Exception as e:
                 result["fused_chunked_error"] = f"{type(e).__name__}: {e}"[:300]
+            flush()
+            try:
+                fs = device_replay_full(
+                    job["log"],
+                    job["expect"],
+                    lane="fused",
+                    cap0=fc_cap,
+                    maxcap=fc_cap,
+                    chunk="auto",
+                    overlap=False,
+                )
+                result.update(
+                    {f"fused_chunked_serial_{k}": v for k, v in fs.items()}
+                )
+                if "fused_chunked_full_dt" in result:
+                    result["fused_chunked_overlap_speedup"] = round(
+                        fs["full_dt"] / result["fused_chunked_full_dt"], 3
+                    )
+            except Exception as e:
+                result["fused_chunked_serial_error"] = (
+                    f"{type(e).__name__}: {e}"[:300]
+                )
         flush()
 
 
@@ -959,6 +1090,11 @@ def main(dry_run: bool = False):
         }
         if native_rate is not None:
             out["native_updates_per_sec"] = round(native_rate, 1)
+        # async-replay staging plan, asserted host-only (ISSUE-5): the
+        # double-buffer depth/reuse contract plus a modeled overlap win
+        with phases.span("host.overlap_rehearsal"):
+            out["overlap_plan"] = overlap_dry_run(log, chunk=64)
+        out["overlap_speedup"] = out["overlap_plan"]["modeled_speedup"]
         out["phases"] = phases.snapshot()
         out["metrics"] = metrics.snapshot()
         print(json.dumps(out))
@@ -1059,9 +1195,19 @@ def main(dry_run: bool = False):
     if res and "fused_chunked_full_dt" in res:
         fr = len(log) * res["fused_chunked_full_docs"] / res["fused_chunked_full_dt"]
         out["fused_chunked_updates_per_sec"] = round(fr, 1)
-        for k in ("chunk_steps", "capacity0", "compactions", "chunk_plan"):
+        for k in ("chunk_steps", "capacity0", "compactions", "chunk_plan",
+                  "overlap"):
             if f"fused_chunked_{k}" in res:
                 out[f"fused_chunked_{k}"] = res[f"fused_chunked_{k}"]
+        if "fused_chunked_serial_full_dt" in res:
+            sr = (
+                len(log)
+                * res["fused_chunked_serial_full_docs"]
+                / res["fused_chunked_serial_full_dt"]
+            )
+            out["fused_chunked_serial_updates_per_sec"] = round(sr, 1)
+        if "fused_chunked_overlap_speedup" in res:
+            out["overlap_speedup"] = res["fused_chunked_overlap_speedup"]
     elif res and "fused_chunked_error" in res:
         out["fused_chunked_error"] = res["fused_chunked_error"]
     if res and "full_dt" in res:

@@ -13,8 +13,6 @@ wall-clock approaches max(decode, integrate) instead of their sum.
 
 from __future__ import annotations
 
-import queue
-import threading
 from typing import Iterable, List, Optional, Tuple
 
 import jax
@@ -121,70 +119,44 @@ class UpdatePipeline:
     ) -> Tuple[DocStateBatch, int]:
         """Integrate every payload; returns (state, chunks_dispatched).
 
-        The decode worker stays `depth` chunks ahead at most (bounded queue
-        = backpressure), the main thread dispatches device work and
-        immediately returns to pull the next chunk.
+        The decode worker stays `depth` chunks ahead at most (bounded
+        queue = backpressure), the main thread dispatches device work
+        and immediately returns to pull the next chunk. The loop rides
+        the shared overlap engine (`replay.OverlapPipeline`, the same
+        machinery as the async packed replay): the hand-rolled
+        worker/queue it replaces dropped its end-of-stream sentinel when
+        the queue was full and the consumer slow (compiling chunk 1),
+        deadlocking the consumer in `q.get()` forever.
         """
-        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
-        SENTINEL = object()
-        err: List[BaseException] = []
-        stop = threading.Event()
+        from ytpu.models.replay import OverlapPipeline
 
-        def worker():
-            try:
-                for chunk in self._chunks(payloads):
-                    # bounded put, re-checked so a dying consumer (see the
-                    # finally below) can never strand this thread
-                    while not stop.is_set():
-                        try:
-                            q.put(chunk, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-                    if stop.is_set():
-                        return
-            except BaseException as e:  # surface decode errors on the caller
-                err.append(e)
-            finally:
-                try:
-                    q.put_nowait(SENTINEL)
-                except queue.Full:
-                    pass  # consumer is draining; stop flag ends it
-
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
+        holder = {"state": state, "rank": client_rank}
         n = 0
-        rank = client_rank
         rank_clients = -1
         driver = None
-        try:
-            while True:
-                chunk = q.get()
-                if chunk is SENTINEL:
-                    break
-                if client_rank is None and len(self.enc.interner) != rank_clients:
-                    # rebuilt only when a new client appeared; power-of-two
-                    # padding keeps the compiled program stable meanwhile
-                    rank_clients = len(self.enc.interner)
-                    rank = self.enc.interner.rank_table()
-                if self.lane == "xla":
-                    state = apply_update_stream(state, chunk, rank)
-                else:
-                    if driver is None:
-                        driver = self._make_driver(state, rank)
-                    driver.rank = rank  # a grown table retraces, like xla
-                    driver.step(chunk)
-                n += 1
-        finally:
-            stop.set()
-            while True:  # unblock the worker if it is mid-put
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
-            t.join()
-        if err:
-            raise err[0]
+
+        def consume(chunk):
+            nonlocal n, rank_clients, driver
+            if client_rank is None and len(self.enc.interner) != rank_clients:
+                # rebuilt only when a new client appeared; power-of-two
+                # padding keeps the compiled program stable meanwhile
+                rank_clients = len(self.enc.interner)
+                holder["rank"] = self.enc.interner.rank_table()
+            if self.lane == "xla":
+                holder["state"] = apply_update_stream(
+                    holder["state"], chunk, holder["rank"]
+                )
+            else:
+                if driver is None:
+                    driver = self._make_driver(holder["state"], holder["rank"])
+                driver.rank = holder["rank"]  # a grown table retraces, like xla
+                driver.step(chunk)
+            n += 1
+
+        OverlapPipeline(depth=self.depth, stage_prefix="pipeline").run(
+            self._chunks(payloads), consume
+        )
+        state = holder["state"]
         if driver is not None:
             state = self._finish_driver(driver, state)
         return state, n

@@ -76,6 +76,8 @@ from ytpu.models.batch_doc import UpdateBatch
 
 __all__ = [
     "pack_updates",
+    "pack_updates_into",
+    "EMPTY_UPDATE",
     "decode_updates_v1",
     "default_steps",
     "exact_steps",
@@ -180,6 +182,40 @@ def pack_updates(
     for i, p in enumerate(payloads):
         buf[i, : len(p)] = np.frombuffer(p, dtype=np.uint8)
     return buf, lens
+
+
+# the minimal well-formed V1 update (0 client sections, empty delete set):
+# what staging pads short tail chunks with so every chunk keeps the one
+# compiled [S, L] shape
+EMPTY_UPDATE = b"\x00\x00"
+
+
+def pack_updates_into(
+    payloads: List[bytes], buf: np.ndarray, lens: np.ndarray
+) -> None:
+    """`pack_updates` into CALLER-PROVIDED staging buffers (in place).
+
+    The async replay pipeline reuses a pair of preallocated ``[S, L]``
+    u8 / ``[S]`` i32 staging buffers across chunks instead of allocating
+    a fresh matrix per chunk; rows past ``len(payloads)`` are filled
+    with `EMPTY_UPDATE` so a short tail chunk decodes as no-ops at the
+    compiled shape. Each row's tail is zeroed only up to the previous
+    occupant's length — the buffers never shrink, so stale bytes beyond
+    `lens` can never alias into a later decode (the decoder's gather
+    guard reads at most `_PAD` past `lens`, which stays zeroed)."""
+    S, L = buf.shape
+    if len(payloads) > S:
+        raise ValueError(f"chunk of {len(payloads)} exceeds staging rows {S}")
+    for i in range(S):
+        p = payloads[i] if i < len(payloads) else EMPTY_UPDATE
+        n = len(p)
+        if n + _PAD > L:
+            raise ValueError(f"payload of {n} bytes exceeds staging width {L}")
+        prev = int(lens[i])
+        buf[i, :n] = np.frombuffer(p, dtype=np.uint8)
+        if prev + _PAD > n:
+            buf[i, n : prev + _PAD] = 0
+        lens[i] = n
 
 
 def identity_rank(k: int) -> jax.Array:

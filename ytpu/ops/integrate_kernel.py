@@ -20,7 +20,7 @@ tests/test_pallas_kernel.py against both the XLA path and the host oracle.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional, Tuple
 
 import os
@@ -46,6 +46,7 @@ __all__ = [
     "pack_stream",
     "apply_update_stream_fused",
     "xla_chunk_step",
+    "replay_chunk_program",
     "PackedReplayDriver",
     "ReplayChunkStats",
     "replay_stream_fused",
@@ -900,8 +901,7 @@ def _kernel(
     jax.lax.fori_loop(0, S, step, 0)
 
 
-@partial(jax.jit, static_argnums=(3, 4, 5, 6, 7), donate_argnums=(0, 1))
-def _run(
+def _run_body(
     cols, meta, packed, d_block: int, interpret: bool,
     phases: int = 3, row_phase: int = 4, vmem_limit_mb: int = 64,
 ):
@@ -952,6 +952,14 @@ def _run(
         ),
     )(rows, dels, rank, cols, meta)
     return out
+
+
+# the standalone jitted entry (donated state); the async chunk program
+# composes `_run_body` directly inside its own jit instead, so donation
+# applies to the OUTER program's state operands
+_run = partial(jax.jit, static_argnums=(3, 4, 5, 6, 7), donate_argnums=(0, 1))(
+    _run_body
+)
 
 
 def apply_update_stream_fused(
@@ -1064,11 +1072,15 @@ def xla_chunk_step(cols, meta, stream, rank):
     executables."""
     global _XLA_CHUNK_STEP
     if _XLA_CHUNK_STEP is None:
-        from ytpu.models.batch_doc import apply_update_stream
+        # the RAW body, not the instrumented wrapper: tracing through the
+        # wrapper recorded a phantom `integrate.xla_stream` compile_s
+        # entry in bench JSON (PR-4 review) — the only real dispatch here
+        # is this chunk step, already attributed to `replay.chunk_xla`
+        from ytpu.models.batch_doc import apply_update_stream_raw
 
         def step(cols, meta, stream, rank):
             state = unpack_state(cols, meta, None)
-            state = apply_update_stream(state, stream, rank)
+            state = apply_update_stream_raw(state, stream, rank)
             return pack_state(state)
 
         # donate like the fused _run: the packed state updates in place
@@ -1078,15 +1090,111 @@ def xla_chunk_step(cols, meta, stream, rank):
 
 
 @jax.jit
-def _chunk_readout(meta):
-    """[2] i32 (max n_blocks, max sticky error) — the per-chunk occupancy/
-    error readout. Dispatched after every chunk but NOT materialized: the
-    host keeps the device future and only blocks on it when its own
-    optimistic occupancy bound trips the watermark, so steady-state chunks
-    never pay a sync (the round-5 FusedReplay synced every chunk)."""
+def _chunk_readout(meta, err):
+    """[3] i32 (max n_blocks, max sticky integrate error, sticky decode
+    flags) — the per-chunk occupancy/error readout. Dispatched after
+    every chunk but NOT materialized: the host keeps the device future
+    and only blocks on it when its own optimistic occupancy bound trips
+    the watermark, so steady-state chunks never pay a sync (the round-5
+    FusedReplay synced every chunk). Decode FLAG_ERRORS ride the same
+    word (`err`, OR-reduced on device by `replay_chunk_program`), so the
+    async lane's per-chunk `np.asarray(flags)` block is gone too."""
     return jnp.stack(
-        [jnp.max(meta[:, M_NBLOCKS]), jnp.max(meta[:, M_ERROR])]
+        [jnp.max(meta[:, M_NBLOCKS]), jnp.max(meta[:, M_ERROR]), err]
     )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "lane",
+        "max_rows",
+        "max_dels",
+        "n_steps",
+        "max_sections",
+        "d_block",
+        "interpret",
+        "vmem_mb",
+    ),
+    donate_argnums=(0, 1, 2),
+)
+def replay_chunk_program(
+    cols,
+    meta,
+    err,
+    buf,
+    lens,
+    refs,
+    rank,
+    *,
+    lane: str,
+    max_rows: int,
+    max_dels: int,
+    n_steps: int,
+    max_sections: int,
+    d_block: int,
+    interpret: bool,
+    vmem_mb: int,
+):
+    """One replay chunk straight from padded wire bytes, as ONE compiled
+    dispatch: device decode (`decode_updates_v1` body) → global unit-ref
+    rebase (`refs`, -1 = keep the decoded in-chunk ref) → integrate
+    (fused Pallas tile or the packed-XLA scan) → `[3]` readout.
+
+    Fusing the stages kills the two host hops the serial loop paid per
+    chunk — the decoded-stream round trip between the decode and
+    integrate programs, and the blocking `np.asarray(flags)` error check
+    (replay.py:419/420 pre-PR5): per-lane decode FLAG_ERRORS are
+    OR-reduced into the sticky `err` scalar on device, and flagged lanes
+    already integrate as no-ops (the decoder zeroes their valid masks),
+    so the host materializes nothing in steady state. `donate_argnums`
+    on cols/meta lets XLA update the ~NC·D·C state in place instead of
+    copying it every chunk."""
+    from ytpu.ops.decode_kernel import FLAG_ERRORS, _decode_updates_v1_impl
+
+    stream, flags = _decode_updates_v1_impl(
+        buf,
+        lens,
+        max_rows=max_rows,
+        max_dels=max_dels,
+        n_steps=n_steps,
+        max_sections=max_sections,
+    )
+    stream = stream._replace(
+        content_ref=jnp.where(refs >= 0, refs, stream.content_ref)
+    )
+    err = err | jax.lax.reduce(
+        flags & FLAG_ERRORS, np.int32(0), jax.lax.bitwise_or, (0,)
+    )
+    if lane == "fused":
+        rows, dels = pack_stream(stream)
+        cols, meta = _run_body(
+            cols, meta, (rows, dels, rank), d_block, interpret, 3, 4, vmem_mb
+        )
+    else:
+        from ytpu.models.batch_doc import apply_update_stream_raw
+
+        state = unpack_state(cols, meta, None)
+        state = apply_update_stream_raw(state, stream, rank)
+        cols, meta = pack_state(state)
+    readout = jnp.stack(
+        [jnp.max(meta[:, M_NBLOCKS]), jnp.max(meta[:, M_ERROR]), err]
+    )
+    return cols, meta, err, readout
+
+
+@lru_cache(maxsize=1)
+def _transfer_aliases_host() -> bool:
+    """True when `jnp.asarray` of a numpy array shares its memory instead
+    of copying (the CPU PJRT client's zero-copy path). The async replay's
+    staging-slot reuse gate assumes the h2d transfer made the input
+    private; on an aliasing backend the bytes must be copied host-side
+    first or a re-packed slot races the chunk program still reading it."""
+    probe = np.zeros(8, dtype=np.uint8)
+    dev = jnp.asarray(probe)
+    dev.block_until_ready()
+    probe[0] = 1
+    return bool(np.asarray(dev)[0] == 1)
 
 
 @dataclass
@@ -1160,7 +1268,15 @@ class PackedReplayDriver:
         self.sync_every_chunk = sync_every_chunk
         self.stats = ReplayChunkStats(capacity=cols.shape[2])
         self._hi_bound = int(initial_occupancy)
-        self._pending = []  # un-materialized [2] readout futures
+        self._pending = []  # un-materialized [3] readout futures
+        # sticky decode-error scalar, kept ON DEVICE: replay_chunk_program
+        # ORs each chunk's FLAG_ERRORS into it so the host never blocks on
+        # per-chunk flags; materialized only at drains/finish
+        self._err = jnp.zeros((), I32)
+        # optional hook raised INSTEAD of the generic decode error: the
+        # async replay loop re-identifies the offending chunk/update
+        # indices host-side for the same message the sync lane raises
+        self.on_decode_error = None
 
     @property
     def capacity(self) -> int:
@@ -1177,12 +1293,16 @@ class PackedReplayDriver:
         if self._pending:
             if _phases.enabled:
                 _phases.transfer(
-                    "replay.readout", 8 * len(self._pending), "d2h"
+                    "replay.readout", 12 * len(self._pending), "d2h"
                 )
             for fut in self._pending:
-                occ, err = (int(x) for x in np.asarray(fut))
+                vals = np.asarray(fut)
+                occ, kerr = int(vals[0]), int(vals[1])
+                derr = int(vals[2]) if vals.shape[0] > 2 else 0
                 self.stats.peak_blocks = max(self.stats.peak_blocks, occ)
-                if err != 0:
+                if derr != 0:
+                    self._raise_decode_error(derr)
+                if kerr != 0:
                     self._raise_device_error()
                 hi = occ
             self._pending.clear()
@@ -1195,6 +1315,15 @@ class PackedReplayDriver:
         bad = meta_np[meta_np[:, M_ERROR] != 0][:4]
         raise RuntimeError(f"device error flags {bad}")
 
+    def _raise_decode_error(self, flags_or: int):
+        if self.on_decode_error is not None:
+            self.on_decode_error(flags_or)  # expected to raise
+        raise RuntimeError(
+            f"device decode flagged errors in a deferred chunk (sticky "
+            f"flags {flags_or}); replay with sync_every_chunk=True to "
+            "localize the update"
+        )
+
     # ------------------------------------------------------- compact/grow
 
     def compact(self) -> int:
@@ -1206,7 +1335,7 @@ class PackedReplayDriver:
             self.cols, self.meta, self.unit_refs, self.gc_ranges
         )
         self.stats.compactions += 1
-        self._pending.append(_chunk_readout(self.meta))
+        self._pending.append(_chunk_readout(self.meta, self._err))
         return self._drain_readouts()
 
     def ensure_room(self, margin: int) -> None:
@@ -1221,8 +1350,17 @@ class PackedReplayDriver:
         hi = self.compact()
         while hi + margin > self.capacity:
             new_cap = min(self.capacity * 2, self.max_capacity)
-            if new_cap == self.capacity:
-                raise RuntimeError(f"state full at max capacity {new_cap}")
+            if new_cap <= self.capacity:
+                # `<=`, not `==`: a max_capacity BELOW the current
+                # capacity used to fall through into grow_packed and
+                # raise its misleading "cannot shrink" (PR-4 review) —
+                # either way the real condition is capacity exhaustion
+                raise RuntimeError(
+                    f"state needs {hi + margin} block slots but replay "
+                    f"is capacity-exhausted: max_capacity "
+                    f"{self.max_capacity} (current capacity "
+                    f"{self.capacity})"
+                )
             from ytpu.ops.compaction import grow_packed
 
             self.cols, self.meta = grow_packed(self.cols, self.meta, new_cap)
@@ -1285,11 +1423,81 @@ class PackedReplayDriver:
                 self.cols, self.meta = xla_chunk_step(
                     self.cols, self.meta, stream, self.rank
                 )
-        self._pending.append(_chunk_readout(self.meta))
+        self._pending.append(_chunk_readout(self.meta, self._err))
         self._hi_bound += margin
         self.stats.chunks += 1
         if self.sync_every_chunk:
             self._drain_readouts()
+
+    def step_bytes(self, buf, lens, refs, dims, margin: int):
+        """Integrate one chunk straight from padded wire bytes: decode →
+        unit-ref rebase → integrate → readout as ONE dispatch
+        (`replay_chunk_program`, donated state) — the async replay
+        loop's zero-sync steady state. `dims` is the decode-shape tuple
+        ``(max_rows, max_dels, n_steps, max_sections)`` (from
+        `ReplayPlan`); `refs` the chunk's ``[S, U]`` global unit-ref
+        rows (-1 = keep the decoded ref); `margin` the chunk's
+        worst-case slot growth. Decode errors fold into the sticky
+        device scalar and surface at the next drain / `finish()`.
+
+        Returns the device input arrays: the caller gates reuse of the
+        numpy staging buffers on their transfer completing
+        (`block_until_ready` on an INPUT waits for the h2d copy only —
+        it is not a result materialization). On a backend whose
+        "transfer" is zero-copy (CPU jax aliases the numpy buffer), the
+        arrays are copied host-side first so a re-packed slot can never
+        race the program still reading it."""
+        from ytpu.utils import progbudget
+        from ytpu.utils.phases import NULL_SPAN, phases as _phases
+
+        progbudget.tick()
+        self.ensure_room(margin)
+        vmem_mb = int(os.environ.get("YTPU_FUSED_VMEM_MB", "64"))
+        if _transfer_aliases_host():
+            buf, lens, refs = buf.copy(), lens.copy(), refs.copy()
+        d_buf = jnp.asarray(buf)
+        d_lens = jnp.asarray(lens)
+        d_refs = jnp.asarray(refs)
+        if _phases.enabled:
+            _phases.transfer(
+                "replay.chunk_async",
+                d_buf.size * d_buf.dtype.itemsize
+                + d_lens.size * d_lens.dtype.itemsize
+                + d_refs.size * d_refs.dtype.itemsize,
+                "h2d",
+            )
+            span = _phases.span(
+                "replay.chunk_async",
+                (self.cols.shape, d_buf.shape, d_refs.shape, tuple(dims),
+                 self.lane, self.d_block, vmem_mb),
+            )
+        else:
+            span = NULL_SPAN
+        max_rows, max_dels, n_steps, max_sections = dims
+        with span:
+            self.cols, self.meta, self._err, readout = replay_chunk_program(
+                self.cols,
+                self.meta,
+                self._err,
+                d_buf,
+                d_lens,
+                d_refs,
+                self.rank,
+                lane=self.lane,
+                max_rows=max_rows,
+                max_dels=max_dels,
+                n_steps=n_steps,
+                max_sections=max_sections,
+                d_block=self.d_block,
+                interpret=self.interpret,
+                vmem_mb=vmem_mb,
+            )
+        self._pending.append(readout)
+        self._hi_bound += margin
+        self.stats.chunks += 1
+        if self.sync_every_chunk:
+            self._drain_readouts()
+        return d_buf, d_lens, d_refs
 
     def finish(self):
         """Drain every pending readout (surfacing sticky errors) and
@@ -1392,6 +1600,10 @@ def _register_programs():
     from ytpu.utils import progbudget
 
     progbudget.register("fused_run", _run)
+    # the chunk program (fused decode+rebase+integrate) is now the
+    # largest executable in the process — one per (chunk, width, refs,
+    # state) shape family; it must ride the same bounded-arena budget
+    progbudget.register("replay_chunk_program", replay_chunk_program)
 
 
 _register_programs()

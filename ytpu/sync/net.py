@@ -82,7 +82,9 @@ async def read_frame(
         return None  # clean EOF between frames
     shift = 0
     size = 0
+    header = 0
     while True:
+        header += 1
         size |= (b[0] & 0x7F) << shift
         shift += 7
         if b[0] < 0x80:
@@ -97,7 +99,9 @@ async def read_frame(
         raise ConnectionError(f"frame of {size} bytes exceeds limit")
     data = await reader.readexactly(size)
     _FRAMES_IN.inc()
-    _BYTES_IN.inc(len(data))
+    # header + payload, matching bytes_out (which counts the framed
+    # write): the two series used to disagree by the varint prefix
+    _BYTES_IN.inc(header + len(data))
     return data
 
 

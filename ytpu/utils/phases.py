@@ -55,6 +55,7 @@ class _Stage:
         "execute_s",
         "h2d_bytes",
         "d2h_bytes",
+        "value",
     )
 
     def __init__(self):
@@ -64,6 +65,7 @@ class _Stage:
         self.execute_s = 0.0
         self.h2d_bytes = 0
         self.d2h_bytes = 0
+        self.value = None  # scalar gauge (overlap_ratio, in-flight depth)
 
 
 class _PhaseSpan:
@@ -138,6 +140,42 @@ class PhaseRecorder:
             else:
                 st.d2h_bytes += int(nbytes)
 
+    def add_time(self, stage: str, seconds: float, calls: int = 1) -> None:
+        """Accumulate already-measured wall time against a host-only
+        stage. The overlap engine times its staging/stall work with bare
+        perf_counter reads on the worker/main threads (a span object per
+        chunk would allocate on the hot path) and folds the totals in
+        here at loop exit."""
+        if not self.enabled:
+            return
+        with self._lock:
+            st = self._stages.get(stage)
+            if st is None:
+                st = self._stages[stage] = _Stage()
+            st.calls += int(calls)
+            st.execute_s += float(seconds)
+
+    def set_value(self, stage: str, value: float) -> None:
+        """Record a scalar gauge under `stage` (snapshot key "value") —
+        e.g. ``replay.overlap_ratio``, ``replay.inflight_depth``."""
+        if not self.enabled:
+            return
+        with self._lock:
+            st = self._stages.get(stage)
+            if st is None:
+                st = self._stages[stage] = _Stage()
+            st.value = float(value)
+
+    def set_max(self, stage: str, value: float) -> None:
+        """Ratchet a scalar gauge upward (high-water depth tracking)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            st = self._stages.get(stage)
+            if st is None:
+                st = self._stages[stage] = _Stage()
+            st.value = value if st.value is None else max(st.value, value)
+
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         """Per-stage breakdown: calls / compile_calls / compile_s /
         execute_s / h2d_bytes / d2h_bytes / transfer_bytes (sum)."""
@@ -153,6 +191,8 @@ class PhaseRecorder:
                     "d2h_bytes": st.d2h_bytes,
                     "transfer_bytes": st.h2d_bytes + st.d2h_bytes,
                 }
+                if st.value is not None:
+                    out[name]["value"] = round(st.value, 6)
         return out
 
 
