@@ -579,6 +579,198 @@ def overlap_dry_run(log, chunk: int = 256, depth: int = 2) -> dict:
     }
 
 
+def _chaos_net_smoke() -> dict:
+    """Transport fault classes over real localhost sockets: a truncated
+    server frame must trip the whole-frame deadline (`FrameTimeout`) and
+    recover via reconnect-with-resync; a dropped/delayed frame must
+    still converge through the state-vector handshake."""
+    import asyncio
+
+    from ytpu.core import Doc
+    from ytpu.sync.net import FrameTimeout, SyncClient, serve
+    from ytpu.sync.server import SyncServer
+    from ytpu.utils.faults import faults
+
+    async def main():
+        server = SyncServer()
+        seed = server.doc("chaos")
+        with seed.transact() as txn:
+            seed.get_text("text").insert(txn, 0, "chaos baseline")
+        srv, port = await serve(server, idle_flush=0.05)
+
+        # net.truncate: sync a client cleanly, then truncate the NEXT
+        # server write — the broadcast of a server-side edit, after
+        # which the server has nothing else to send, so the client is
+        # genuinely stalled mid-frame (a truncated greeting would be
+        # "completed" by the bytes of the frames behind it)
+        faults.clear()
+        c = SyncClient(Doc(client_id=91))
+        await c.connect("127.0.0.1", port, "chaos")
+        await c.pump(max_frames=4, timeout=0.3)
+        faults.arm("net.truncate")
+        with seed.transact() as txn:
+            seed.get_text("text").insert(txn, len("chaos baseline"), "!")
+        timed_out = False
+        try:
+            await c.pump(max_frames=2, timeout=1.0, frame_timeout=0.5)
+        except FrameTimeout:
+            timed_out = True
+        faults.clear()
+        await c.reconnect()
+        await c.pump(max_frames=4, timeout=0.5)
+        truncate_ok = c.doc.get_text("text").get_string() == "chaos baseline!"
+        await c.close()
+
+        # net.drop (server greeting step1 swallowed) + net.delay (one
+        # stalled read): the client's own step1 still reaches the
+        # server, whose SyncStep2 carries the full state — the handshake
+        # is the retransmission path
+        faults.arm("net.drop", after=2)
+        faults.arm("net.delay", ms=5)
+        d = SyncClient(Doc(client_id=92))
+        await d.connect("127.0.0.1", port, "chaos")
+        await d.pump(max_frames=4, timeout=0.5)
+        faults.clear()
+        if d.doc.get_text("text").get_string() != "chaos baseline!":
+            await d.reconnect()
+            await d.pump(max_frames=4, timeout=0.5)
+        drop_ok = d.doc.get_text("text").get_string() == "chaos baseline!"
+        await d.close()
+        srv.close()
+        await srv.wait_closed()
+        return {
+            "frame_timeout_tripped": timed_out,
+            "truncate_recovered": truncate_ok,
+            "drop_delay_recovered": drop_ok,
+        }
+
+    return asyncio.run(main())
+
+
+def chaos_smoke() -> dict:
+    """Host-only chaos phase (ISSUE-6 CI smoke): inject ONE fault per
+    class through `ytpu.utils.faults` and assert the recovery machinery
+    actually recovered — non-zero recovery counters AND byte parity with
+    the clean run.  Every fault is deterministic (seeded injector), every
+    replay shares one small (n_docs=2, d_block=2) shape family, and the
+    fused-lane dispatch fault fires BEFORE the kernel runs, so the class
+    exercises the demotion ladder on hosts with no Mosaic at all."""
+    from ytpu.models.replay import FusedReplay, plan_replay
+    from ytpu.ops import integrate_kernel as ik
+    from ytpu.utils import metrics
+    from ytpu.utils.faults import faults
+
+    ops = []
+    length = 0
+    for _ in range(6):
+        for i in range(20):
+            ops.append(("i", length, "abcdef"[i % 6]))
+            length += 1
+        ops.append(("d", length - 18, 18))
+        length -= 18
+    log, expect = build_updates(ops)
+    expect_minus_last = build_updates(ops[:-1])[1]
+    plan = plan_replay(log)
+
+    def replay(lane="xla", capacity=256, max_capacity=256, **kw):
+        r = FusedReplay(
+            n_docs=2,
+            plan=plan,
+            capacity=capacity,
+            max_capacity=max_capacity,
+            d_block=2,
+            chunk=16,
+            lane=lane,
+            **kw,
+        )
+        r.run(log)
+        return r
+
+    def counters(*names):
+        return {n: metrics.counter(n).value for n in names}
+
+    base = counters("lane.demotions", "replay.recoveries", "faults.injected")
+    faults.clear()
+    ik.reset_lane_health()
+    clean_text = replay().get_string(0)
+    assert clean_text == expect, "chaos clean-run parity"
+    classes = {}
+
+    # class: fused-lane dispatch failure → sticky demotion, in-place
+    # retry (the acceptance path: completes via the demoted lane)
+    ik.reset_lane_health()
+    faults.arm("dispatch.fail", lane="fused")
+    r = replay(lane="fused")
+    assert r.get_string(0) == clean_text, "dispatch.fail parity"
+    assert r.stats.demotions >= 1 and r.stats.recoveries >= 1, r.stats
+    classes["dispatch.fail"] = {
+        "demotions": r.stats.demotions,
+        "recoveries": r.stats.recoveries,
+        "final_lane": r.stats.final_lane,
+    }
+
+    # class: mid-replay worker kill → checkpoint resume
+    ik.reset_lane_health()
+    faults.clear()
+    faults.arm("replay.kill", after=2)
+    r = replay(checkpoint_every=2)
+    assert r.get_string(0) == clean_text, "replay.kill parity"
+    assert r.stats.checkpoints >= 1 and r.stats.resumes, r.stats
+    assert r.stats.resumes[0] > 0, "kill resumed from scratch, not a ckpt"
+    classes["replay.kill"] = {
+        "checkpoints": r.stats.checkpoints,
+        "resumed_at": r.stats.resumes[0],
+    }
+
+    # class: staging-thread exception (async overlap lane)
+    ik.reset_lane_health()
+    faults.clear()
+    faults.arm("stage.raise", prefix="replay")
+    r = replay(overlap=True)
+    assert r.get_string(0) == clean_text, "stage.raise parity"
+    assert r.stats.recoveries >= 1, r.stats
+    classes["stage.raise"] = {"recoveries": r.stats.recoveries}
+
+    # class: grow_packed OOM — capacity 16 cannot hold even one chunk's
+    # worst-case adds, so the very first ensure_room must grow (and the
+    # armed spec turns that growth into a simulated device OOM)
+    ik.reset_lane_health()
+    faults.clear()
+    faults.arm("grow.oom")
+    r = replay(capacity=16, max_capacity=1024)
+    assert r.stats.growths >= 1, r.stats
+    assert r.get_string(0) == clean_text, "grow.oom parity"
+    assert r.stats.recoveries >= 1, r.stats
+    classes["grow.oom"] = {"recoveries": r.stats.recoveries}
+
+    # class: poison update (corrupt wire bytes → quarantine, not abort);
+    # the LAST update is the poison target so no healthy update depends
+    # on it — parity target is the stream minus that update
+    ik.reset_lane_health()
+    faults.clear()
+    faults.arm("update.corrupt", after=len(log) - 1)
+    r = replay(quarantine=True)
+    assert r.get_string(0) == expect_minus_last, "quarantine parity"
+    assert r.stats.quarantined == [len(log) - 1], r.stats.quarantined
+    classes["update.corrupt"] = {"quarantined": r.stats.quarantined}
+
+    # classes: net frame drop / delay / truncation over real sockets
+    faults.clear()
+    classes["net"] = _chaos_net_smoke()
+    assert classes["net"]["frame_timeout_tripped"], classes["net"]
+    assert classes["net"]["truncate_recovered"], classes["net"]
+    assert classes["net"]["drop_delay_recovered"], classes["net"]
+
+    faults.clear()
+    ik.reset_lane_health()
+    after = counters("lane.demotions", "replay.recoveries", "faults.injected")
+    delta = {k: after[k] - base[k] for k in after}
+    assert delta["lane.demotions"] >= 1, delta
+    assert delta["replay.recoveries"] >= 1, delta
+    assert delta["faults.injected"] >= len(classes), delta
+    return {"classes": classes, "recovered": True, **delta}
+
+
 def _device_configs(result: dict, flush) -> None:
     """North-star configs #3-#5 (benches/device.py), run inside the same
     child so their compile/measure cost shares the single device budget.
@@ -1095,6 +1287,12 @@ def main(dry_run: bool = False):
         with phases.span("host.overlap_rehearsal"):
             out["overlap_plan"] = overlap_dry_run(log, chunk=64)
         out["overlap_speedup"] = out["overlap_plan"]["modeled_speedup"]
+        # chaos smoke (ISSUE-6): one injected fault per class, each run
+        # must RECOVER (counters non-zero + byte parity vs the clean
+        # run) — lane.demotions / replay.recoveries land in the metrics
+        # snapshot below, the acceptance surface
+        with phases.span("host.chaos_smoke"):
+            out["chaos"] = chaos_smoke()
         out["phases"] = phases.snapshot()
         out["metrics"] = metrics.snapshot()
         print(json.dumps(out))

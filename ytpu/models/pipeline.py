@@ -52,6 +52,18 @@ class UpdatePipeline:
       the Pallas kernel: identical chunk routing + compaction policy,
       runnable where Mosaic (or interpret-mode Pallas) isn't — the
       CPU-testable twin of ``"fused"``.
+
+    Resilience (ISSUE-6, docs/robustness.md): the packed lanes ride the
+    shape family's sticky lane-health ladder.  A dispatch/compile
+    failure first retries the chunk in place one rung down inside
+    `PackedReplayDriver`; a fault the driver cannot absorb (state
+    buffers lost to donation, ladder exhausted, injected worker kill)
+    surfaces as `ReplayFault` and — when `payloads` is a replayable
+    sequence — restarts the WHOLE run from the caller's initial state on
+    the demoted lane (`pipeline.restarts` metric).  A family whose
+    sticky floor reaches the ladder's ``host`` rung is carried by the
+    classic unpacked ``"xla"`` chunk scan, this pipeline's serial
+    reference lane.
     """
 
     def __init__(
@@ -111,6 +123,24 @@ class UpdatePipeline:
                 steps.append(pad)
             yield BatchEncoder.stack_steps(steps)
 
+    def _effective_lane(self, state: DocStateBatch) -> str:
+        """This run's lane after the shape family's sticky health floor:
+        ``fused`` demotes to ``packed_xla``, and a floor at the ladder's
+        ``host`` rung routes to the classic unpacked ``xla`` scan (the
+        pipeline's serial reference — there is no per-payload host-doc
+        oracle for a populated `DocStateBatch`)."""
+        if self.lane == "xla":
+            return "xla"
+        from ytpu.ops.integrate_kernel import effective_lane, lane_family
+
+        # shape is host-side metadata: no device sync on the entry path
+        family = lane_family(int(state.n_blocks.shape[0]), self.d_block)
+        req = "fused" if self.lane == "fused" else "xla"
+        eff = effective_lane(family, req)
+        if eff == "host":
+            return "xla"
+        return "fused" if eff == "fused" else "packed_xla"
+
     def run(
         self,
         state: DocStateBatch,
@@ -127,9 +157,48 @@ class UpdatePipeline:
         worker/queue it replaces dropped its end-of-stream sentinel when
         the queue was full and the consumer slow (compiling chunk 1),
         deadlocking the consumer in `q.get()` forever.
+
+        A `ReplayFault` the packed driver could not absorb in place (and
+        an injected staging fault) restarts the run from the caller's
+        `state` on the ladder-demoted lane when `payloads` is a
+        replayable sequence; one-shot iterators re-raise — their
+        already-consumed updates cannot be re-staged.
         """
+        from ytpu.ops.integrate_kernel import ReplayFault
+        from ytpu.utils import metrics
+        from ytpu.utils.faults import FaultError
+
+        replayable = isinstance(payloads, (list, tuple))
+        attempts = 0
+        while True:
+            try:
+                return self._run_once(state, payloads, client_rank)
+            except (ReplayFault, FaultError) as e:
+                attempts += 1
+                # the classic-xla lane DONATES the caller's state on its
+                # first chunk (apply_update_stream donate_argnums=0) —
+                # a restart can only reuse `state` while its buffers are
+                # alive (the packed lanes never consume them)
+                from ytpu.ops.integrate_kernel import _buffers_alive
+
+                alive = _buffers_alive(*jax.tree_util.tree_leaves(state))
+                # ladder depth bounds useful restarts: fused → packed_xla
+                # → classic-xla, plus one slot for a transient staging
+                # fault that leaves the lane floor unchanged
+                if not replayable or attempts > 3 or not alive:
+                    raise
+                metrics.counter("pipeline.restarts").inc()
+                metrics.counter("replay.recoveries").inc()
+
+    def _run_once(
+        self,
+        state: DocStateBatch,
+        payloads: Iterable[bytes],
+        client_rank: Optional[jax.Array] = None,
+    ) -> Tuple[DocStateBatch, int]:
         from ytpu.models.replay import OverlapPipeline
 
+        lane = self._effective_lane(state)
         holder = {"state": state, "rank": client_rank}
         n = 0
         rank_clients = -1
@@ -142,13 +211,15 @@ class UpdatePipeline:
                 # padding keeps the compiled program stable meanwhile
                 rank_clients = len(self.enc.interner)
                 holder["rank"] = self.enc.interner.rank_table()
-            if self.lane == "xla":
+            if lane == "xla":
                 holder["state"] = apply_update_stream(
                     holder["state"], chunk, holder["rank"]
                 )
             else:
                 if driver is None:
-                    driver = self._make_driver(holder["state"], holder["rank"])
+                    driver = self._make_driver(
+                        holder["state"], holder["rank"], lane
+                    )
                 driver.rank = holder["rank"]  # a grown table retraces, like xla
                 driver.step(chunk)
             n += 1
@@ -158,16 +229,16 @@ class UpdatePipeline:
         )
         state = holder["state"]
         if driver is not None:
-            state = self._finish_driver(driver, state)
+            state = self._finish_driver(driver, state, lane)
         return state, n
 
     # ------------------------------------------------- packed-lane plumbing
 
-    def _make_driver(self, state: DocStateBatch, rank):
+    def _make_driver(self, state: DocStateBatch, rank, lane: str):
         from ytpu.models.batch_doc import ensure_origin_slot
         from ytpu.ops.integrate_kernel import PackedReplayDriver, pack_state
 
-        kernel_lane = "fused" if self.lane == "fused" else "xla"
+        kernel_lane = "fused" if lane == "fused" else "xla"
         if kernel_lane == "xla":
             # the packed XLA chunk step's conflict scan reads the
             # origin_slot cache plane: refresh a stale one up front
@@ -185,15 +256,18 @@ class UpdatePipeline:
             initial_occupancy=int(np.asarray(state.n_blocks).max()),
         )
 
-    def _finish_driver(self, driver, state: DocStateBatch) -> DocStateBatch:
+    def _finish_driver(
+        self, driver, state: DocStateBatch, lane: str
+    ) -> DocStateBatch:
         from ytpu.models.batch_doc import mark_origin_slot_stale
         from ytpu.ops.integrate_kernel import unpack_state
 
         cols, meta = driver.finish()
         out = unpack_state(cols, meta, state)
-        if self.lane == "fused":
+        if lane == "fused" and driver.lane == "fused":
             # fused kernel rows leave the cache plane stale (same contract
             # as apply_update_stream_fused); the packed-XLA step maintains
-            # it in-kernel
+            # it in-kernel (an in-place demotion mid-run already refreshed
+            # the plane, so the demoted driver's output is NOT stale)
             mark_origin_slot_stale(out)
         return out

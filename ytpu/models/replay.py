@@ -218,6 +218,28 @@ class ReplayStats:
     overlap_ratio: float = 0.0
     max_inflight: int = 0
     buffer_reuses: int = 0
+    # resilience (ISSUE-6): caller-level resumes + driver-level in-place
+    # retries, sticky lane demotions, chunk-boundary checkpoints taken,
+    # update indices quarantined instead of aborting, and positions the
+    # replay restarted from after a fault (empty = no fault)
+    recoveries: int = 0
+    demotions: int = 0
+    checkpoints: int = 0
+    quarantined: List[int] = field(default_factory=list)
+    resumes: List[int] = field(default_factory=list)
+    final_lane: str = ""
+
+
+@dataclass
+class _ReplayCheckpoint:
+    """Chunk-boundary snapshot of the packed state (host numpy copies —
+    survives donation, worker death, and lane demotion)."""
+
+    cols: np.ndarray
+    meta: np.ndarray
+    pos: int  # first un-integrated update index
+    hi: int  # actual occupancy at the snapshot (post-drain)
+    lane: str  # lane the snapshot was produced under
 
 
 @dataclass(frozen=True)
@@ -358,9 +380,12 @@ class OverlapPipeline:
         stats = OverlapStats()
 
         def worker():
+            from ytpu.utils.faults import faults
+
             try:
                 it = iter(produce)
                 while not stop.is_set():
+                    faults.maybe_raise("stage.raise", prefix=self.stage_prefix)
                     t0 = time.perf_counter()
                     try:
                         item = next(it)
@@ -392,6 +417,13 @@ class OverlapPipeline:
                 item = q.get()
                 stats.stall_s += time.perf_counter() - t0
                 if item is SENTINEL:
+                    break
+                if err:
+                    # staging died: abandon the staged backlog NOW rather
+                    # than integrating ahead of an error that voids the
+                    # run anyway — the finally below drains the queue and
+                    # the stop event releases any producer-held buffers,
+                    # so a raising producer never strands the consumer
                     break
                 # qsize()+1 races a worker put landing between the get
                 # and this read; the queue cap bounds TRUE in-flight at
@@ -533,6 +565,9 @@ class FusedReplay:
         policy=None,
         sync_per_chunk: bool = True,
         overlap: bool = False,
+        checkpoint_every: int = 0,
+        quarantine: bool = False,
+        max_recoveries: int = 3,
     ):
         import jax.numpy as jnp
 
@@ -551,6 +586,16 @@ class FusedReplay:
         self.policy = policy
         self.sync_per_chunk = sync_per_chunk
         self.overlap = overlap
+        # resilience knobs (ISSUE-6): `checkpoint_every` > 0 pulls a host
+        # snapshot of the packed state every N chunks so a mid-replay
+        # fault resumes there instead of from scratch (each snapshot is a
+        # blocking d2h pull — the default 0 keeps the healthy steady
+        # state zero-sync); `quarantine` records poison updates instead
+        # of aborting; `max_recoveries` bounds fault-resume attempts.
+        self.checkpoint_every = checkpoint_every
+        self.quarantine = quarantine
+        self.max_recoveries = max_recoveries
+        self.capacity0 = capacity
         self.cols, self.meta = pack_state(init_state(n_docs, capacity))
         self.stats = ReplayStats(capacity=capacity)
         self._hi = 0  # occupancy upper bound carried across run()/compact()
@@ -558,6 +603,17 @@ class FusedReplay:
         # chunk ranges dispatched through the async lane, for deferred
         # decode-error re-identification (sticky flags name no update)
         self._dispatched_ranges: List[Tuple[int, int]] = []
+        self._ckpt: Optional[_ReplayCheckpoint] = None
+        self._corrupted: dict = {}  # idx -> injected-corrupt wire bytes
+        self._qset: set = set()  # quarantined update indices (dedup)
+        self._host_text: Optional[str] = None
+        self._host_doc = None  # host-oracle rung: survives across run()s
+        self._host_name: Optional[str] = None
+        self._recoveries_used = 0
+        self._needs_restore = False
+        self._resumed_ckpt: Optional[_ReplayCheckpoint] = None
+        self._base_hi = 0  # occupancy carried into the CURRENT run()
+        self._driver = None
 
     def _capacity(self) -> int:
         return self.cols.shape[2]
@@ -579,6 +635,7 @@ class FusedReplay:
             # overlap mode is the zero-sync pipeline by definition
             sync_every_chunk=self.sync_per_chunk and not self.overlap,
             initial_occupancy=self._hi,
+            quarantine=self.quarantine,
         )
 
     def _resolve_rank(self, client_rank):
@@ -597,24 +654,67 @@ class FusedReplay:
         return client_rank
 
     def run(self, payloads: List[bytes], client_rank=None) -> ReplayStats:
+        """Replay `payloads`, surviving mid-replay faults: dispatch and
+        compile failures demote the shape family down the lane-health
+        ladder (fused → packed-XLA, sticky), unrecoverable faults resume
+        from the last chunk-boundary checkpoint (or the initial state),
+        and when even the packed-XLA rung is demoted the serial host
+        oracle carries the stream to completion (docs/robustness.md)."""
+        from ytpu.ops.integrate_kernel import (
+            ReplayFault,
+            effective_lane,
+            lane_family,
+        )
+        from ytpu.utils.faults import FaultError
+
+        client_rank = self._resolve_rank(client_rank)
+        fam = lane_family(self.n_docs, self.d_block)
+        self._recoveries_used = 0
+        # per-run recovery bookkeeping: checkpoint positions and
+        # corrupted-byte records index into THIS call's payload list — a
+        # snapshot carried over from a previous run() would resume at
+        # the wrong position in the new stream
+        self._ckpt = None
+        self._corrupted.clear()
+        self._qset.clear()  # quarantine dedup is per-run too: index 5 of
+        # THIS stream is not index 5 of the last one
+        self._base_hi = self._hi
+        if self._hi and self.checkpoint_every and self._host_text is None:
+            # continuation replay (the state carries content from an
+            # earlier run): snapshot the ENTRY state so a fault before
+            # the first chunk-boundary checkpoint cannot reset to empty
+            self._checkpoint_now(pos=0)
+        while True:
+            if (
+                self._host_text is not None
+                or effective_lane(fam, self.lane) == "host"
+            ):
+                return self._run_host(payloads)
+            try:
+                if self.overlap:
+                    return self._run_overlap(payloads, client_rank)
+                return self._run_serial(payloads, client_rank)
+            except (ReplayFault, FaultError) as e:
+                self._recover(e)
+
+    def _run_serial(self, payloads: List[bytes], client_rank) -> ReplayStats:
         import jax.numpy as jnp
 
         from ytpu.ops.decode_kernel import FLAG_ERRORS, pack_updates
 
         plan = self.plan
-        client_rank = self._resolve_rank(client_rank)
-        if self.overlap:
-            return self._run_overlap(payloads, client_rank)
         decode = _decoder(
             plan.max_rows, plan.max_dels, plan.max_steps, plan.max_sections
         )
-        driver = self._make_driver(client_rank)
+        start = self._restore_state()
+        driver = self._driver = self._make_driver(client_rank)
+        self._post_restore(driver)
         S = len(payloads)
-        pos = 0
+        pos = start
         while pos < S:
             t0 = time.perf_counter()
             end = min(pos + self.chunk, S)
-            batch = payloads[pos:end]
+            batch = self._stage_batch(payloads, pos, end)
             if len(batch) < self.chunk:
                 batch = batch + [b"\x00\x00"] * (self.chunk - len(batch))
             buf, lens = pack_updates(batch, pad_to=plan.max_len + 16)
@@ -634,10 +734,19 @@ class FusedReplay:
             f = np.asarray(flags)[: end - pos] & FLAG_ERRORS
             if f.any():
                 bad = np.nonzero(f)[0]
-                raise RuntimeError(
-                    f"device decode flagged updates "
-                    f"{(pos + bad[:8]).tolist()}: flags {f[bad[:8]].tolist()}"
-                )
+                if self.quarantine:
+                    # the decoder zeroed the flagged lanes' valid masks,
+                    # so the stream integrates them as no-ops — record
+                    # and carry on (poison-update quarantine)
+                    self._note_quarantined(
+                        [int(pos + b) for b in bad], count_metric=True
+                    )
+                else:
+                    raise RuntimeError(
+                        f"device decode flagged updates "
+                        f"{(pos + bad[:8]).tolist()}: "
+                        f"flags {f[bad[:8]].tolist()}"
+                    )
             # worst-case state rows this chunk can add: the driver
             # compacts/grows BEFORE integrating so ERR_CAPACITY (which
             # corrupts the tile) cannot fire mid-chunk; with
@@ -647,8 +756,10 @@ class FusedReplay:
             self.cols, self.meta = driver.cols, driver.meta
             self.stats.chunk_seconds.append(time.perf_counter() - t0)
             pos = end
+            self._maybe_checkpoint(driver, pos)
         self.cols, self.meta = driver.finish()
         self._merge_driver_stats(driver)
+        self._driver = None
         return self.stats
 
     def _merge_driver_stats(self, driver) -> None:
@@ -660,7 +771,247 @@ class FusedReplay:
         self.stats.peak_blocks = max(self.stats.peak_blocks, d.peak_blocks)
         self.stats.capacity = self._capacity()
         self.stats.final_blocks = d.final_blocks
+        self.stats.demotions += d.demotions
+        self.stats.recoveries += d.recoveries
+        self.stats.final_lane = driver.lane
         self._hi = d.final_blocks
+
+    # ------------------------------------------- fault recovery (ISSUE-6)
+
+    def _recover(self, e: BaseException) -> None:
+        """Roll back to the last chunk-boundary checkpoint (or the
+        initial state).  The sticky lane floor already records any
+        demotion, so the next `run()` attempt enters with the demoted
+        lane — including the host-oracle bottom rung."""
+        from ytpu.utils import metrics
+
+        if self._driver is not None:
+            self._merge_driver_stats(self._driver)
+            self._driver = None
+        self._recoveries_used += 1
+        if self._recoveries_used > self.max_recoveries:
+            raise e
+        if self._ckpt is None and self._base_hi:
+            # continuation replay with no checkpoint (checkpoint_every=0
+            # skips the entry snapshot): the scratch rebuild below would
+            # silently discard everything integrated BEFORE this run() —
+            # surfacing the fault is the only honest recovery
+            raise e
+        self.stats.recoveries += 1
+        metrics.counter("replay.recoveries").inc()
+        self._needs_restore = True
+        self.stats.resumes.append(self._ckpt.pos if self._ckpt else 0)
+
+    def _restore_state(self) -> int:
+        """(Re)build the packed state for a fresh driver attempt; returns
+        the update index to resume from (0 on the first attempt, or when
+        no checkpoint was taken before the fault)."""
+        self._resumed_ckpt = None
+        if not self._needs_restore:
+            return 0
+        import jax.numpy as jnp
+
+        from ytpu.models.batch_doc import init_state
+        from ytpu.ops.integrate_kernel import pack_state
+
+        self._needs_restore = False
+        ck = self._ckpt
+        if ck is None:
+            self.cols, self.meta = pack_state(
+                init_state(self.n_docs, self.capacity0)
+            )
+            self._hi = 0
+            return 0
+        # jnp.array COPIES: on a zero-copy backend jnp.asarray would
+        # alias the checkpoint's numpy memory, and the next donation
+        # would corrupt the checkpoint for any second resume
+        self.cols = jnp.array(ck.cols)
+        self.meta = jnp.array(ck.meta)
+        self._hi = ck.hi
+        self._resumed_ckpt = ck
+        return ck.pos
+
+    def _post_restore(self, driver) -> None:
+        """A checkpoint taken under the fused kernel carries a stale
+        origin_slot plane; rebuild it before the first packed-XLA chunk
+        of a demoted resume (including a pos=0 entry-state resume)."""
+        ck = self._resumed_ckpt
+        if ck is not None and ck.lane == "fused" and driver.lane != "fused":
+            driver._refresh_origin_slot_packed()
+
+    def _checkpoint_now(self, pos: int, driver=None) -> None:
+        """Snapshot the packed state as host numpy copies (they survive
+        donation and simulated worker death).  With a driver, drain its
+        readouts first so errors/quarantine surface before the snapshot
+        can be trusted; without one, snapshot this object's carried
+        state (the run()-entry snapshot of a continuation replay)."""
+        from ytpu.utils.phases import phases
+
+        if driver is not None:
+            hi = driver._drain_readouts()
+            cols, meta, lane = driver.cols, driver.meta, driver.lane
+        else:
+            hi, cols, meta = self._hi, self.cols, self.meta
+            lane = self.stats.final_lane or self.lane
+        cols_np = np.array(cols)
+        meta_np = np.array(meta)
+        self._ckpt = _ReplayCheckpoint(
+            cols=cols_np, meta=meta_np, pos=pos, hi=hi, lane=lane
+        )
+        self.stats.checkpoints += 1
+        if phases.enabled:
+            phases.transfer(
+                "replay.checkpoint", cols_np.nbytes + meta_np.nbytes, "d2h"
+            )
+
+    def _maybe_checkpoint(self, driver, pos: int) -> None:
+        if (
+            not self.checkpoint_every
+            or driver.stats.chunks % self.checkpoint_every
+        ):
+            return
+        self._checkpoint_now(pos, driver=driver)
+
+    def _stage_batch(self, payloads: List[bytes], pos: int, end: int):
+        """One chunk's wire payloads, through the `update.corrupt`
+        injection site.  Injected corruption is remembered per index so
+        deferred re-identification and checkpoint re-runs see the SAME
+        bytes the device integrated."""
+        from ytpu.utils.faults import faults
+
+        if not faults.active and not self._corrupted:
+            return payloads[pos:end]
+        batch = list(payloads[pos:end])
+        for i in range(len(batch)):
+            idx = pos + i
+            prev = self._corrupted.get(idx)
+            if prev is not None:
+                batch[i] = prev
+                continue
+            if faults.active:
+                c = faults.corrupt("update.corrupt", batch[i])
+                if c is not batch[i]:
+                    self._corrupted[idx] = c
+                    batch[i] = c
+        return batch
+
+    def _note_quarantined(self, idxs: List[int], count_metric: bool):
+        newly = [i for i in idxs if i not in self._qset]
+        self._qset.update(newly)
+        self.stats.quarantined.extend(newly)
+        if newly and count_metric:
+            from ytpu.utils import metrics
+
+            metrics.counter("replay.quarantined").inc(len(newly))
+        return newly
+
+    def _flagged_chunks(self, payloads: List[bytes]):
+        """Re-decode the dispatched chunk ranges against the bytes the
+        device actually saw (injected corruption included, not the
+        caller's clean payloads); yields (pos, bad_offsets, flags) for
+        every chunk carrying ≥1 FLAG_ERRORS lane.  Shared by the
+        deferred error-message path and the quarantine path so the
+        padding/substitution contract cannot silently diverge."""
+        import jax.numpy as jnp
+
+        from ytpu.ops.decode_kernel import FLAG_ERRORS, pack_updates
+
+        plan = self.plan
+        decode = _decoder(
+            plan.max_rows, plan.max_dels, plan.max_steps, plan.max_sections
+        )
+        for pos, end in self._dispatched_ranges:
+            batch = [
+                self._corrupted.get(i, payloads[i]) for i in range(pos, end)
+            ]
+            if len(batch) < self.chunk:
+                batch = batch + [b"\x00\x00"] * (self.chunk - len(batch))
+            buf, lens = pack_updates(batch, pad_to=plan.max_len + 16)
+            _, flags = decode(jnp.asarray(buf), jnp.asarray(lens))
+            f = np.asarray(flags)[: end - pos] & FLAG_ERRORS
+            if f.any():
+                yield pos, np.nonzero(f)[0], f
+
+    def _quarantine_collect(self, payloads: List[bytes], flags_or: int):
+        """Driver quarantine hook (async lane): re-decode the dispatched
+        ranges host-side and record every newly flagged update index —
+        the device already integrated flagged lanes as no-ops, so
+        recording IS the recovery.  The driver counts the metric."""
+        idxs = [
+            int(pos + b)
+            for pos, bad, _ in self._flagged_chunks(payloads)
+            for b in bad
+        ]
+        self._dispatched_ranges.clear()
+        return self._note_quarantined(idxs, count_metric=False)
+
+    @staticmethod
+    def _root_name(payloads: List[bytes]) -> Optional[str]:
+        """The stream's wire root name, or None when no named root
+        appears (the host-oracle rung needs it to read the final text
+        back).  Uses the native columnar prescan, falling back to the
+        host decoder where the native library is absent — the degraded
+        hosts most likely to reach the host rung must not silently
+        default to the wrong root."""
+        from ytpu.native import decode_update_columns
+
+        for p in payloads:
+            cols = decode_update_columns(p)
+            if cols is not None and not cols.error:
+                for i in range(cols.n_blocks):
+                    n = cols.parent_name(i)
+                    if n:
+                        return n
+                continue
+            from ytpu.core.update import Update
+
+            try:
+                up = Update.decode_v1(p)
+            except Exception:
+                continue
+            for blocks in up.blocks.values():
+                for b in blocks:
+                    n = getattr(b, "parent", None)
+                    if isinstance(n, str) and n:
+                        return n
+        return None
+
+    def _run_host(self, payloads: List[bytes]) -> ReplayStats:
+        """The ladder's bottom rung: the serial host oracle replays the
+        stream on ONE host doc (the stream is broadcast to every slot, so
+        one doc IS every slot's content) and `get_string` serves its text
+        afterwards.  Slow, but alive — the rung's contract is survival,
+        not throughput.  The doc persists across run()s so continuation
+        replays keep accumulating; a DEMOTION to this rung mid-way
+        through a continuation sequence (packed content exists but no
+        host doc does) is refused rather than silently dropped."""
+        from ytpu.core import Doc
+
+        if self._host_doc is None:
+            if self._base_hi:
+                raise RuntimeError(
+                    "host-oracle rung cannot serve a continuation replay:"
+                    " the packed state carries content integrated before"
+                    " this run() and there is no host doc to continue"
+                    " from — re-run the full stream on a fresh replay"
+                )
+            self._host_doc = Doc()
+        doc = self._host_doc
+        name = self._root_name(payloads) or self._host_name or "text"
+        self._host_name = name
+        bad: List[int] = []
+        for i, p in enumerate(payloads):
+            p = self._corrupted.get(i, p)
+            try:
+                doc.apply_update_v1(p)
+            except Exception:
+                if not self.quarantine:
+                    raise
+                bad.append(i)
+        self._note_quarantined(bad, count_metric=True)
+        self._host_text = doc.get_text(name).get_string()
+        self.stats.final_lane = "host"
+        return self.stats
 
     # ------------------------------------------------ async overlap lane
 
@@ -688,7 +1039,9 @@ class FusedReplay:
         width = plan.max_len + 16  # == the serial loop's pad_to
         dims = (plan.max_rows, plan.max_dels, plan.max_steps,
                 plan.max_sections)
-        driver = self._make_driver(client_rank)
+        start = self._restore_state()
+        driver = self._driver = self._make_driver(client_rank)
+        self._post_restore(driver)
         # fresh per run(): the error path re-decodes these ranges against
         # THIS run's payloads; carried-over ranges would index stale data
         # (and N-fold the rescan on continuation replays)
@@ -696,6 +1049,7 @@ class FusedReplay:
         driver.on_decode_error = partial(
             self._reidentify_decode_error, payloads
         )
+        driver.on_quarantine = partial(self._quarantine_collect, payloads)
         oplan = self.overlap_plan(S)
         pipe = OverlapPipeline(depth=oplan.depth, stage_prefix="replay")
         slots = [
@@ -710,7 +1064,7 @@ class FusedReplay:
 
         def produce():
             nonlocal acquisitions
-            for pos in range(0, S, chunk):
+            for pos in range(start, S, chunk):
                 while True:
                     try:
                         slot = free_q.get(timeout=0.1)
@@ -721,7 +1075,9 @@ class FusedReplay:
                         if pipe.stopping:
                             return
                 end = min(pos + chunk, S)
-                pack_updates_into(payloads[pos:end], slot.buf, slot.lens)
+                pack_updates_into(
+                    self._stage_batch(payloads, pos, end), slot.buf, slot.lens
+                )
                 slot.refs[: end - pos] = plan.unit_refs[pos:end]
                 slot.refs[end - pos :] = -1
                 slot.pos, slot.end = pos, end
@@ -746,6 +1102,7 @@ class FusedReplay:
                     a.block_until_ready()
                 free_q.put(old_slot)
             self.stats.chunk_seconds.append(time.perf_counter() - t0)
+            self._maybe_checkpoint(driver, slot.end)
 
         ostats = pipe.run(produce(), consume)
         while inflight:
@@ -755,6 +1112,7 @@ class FusedReplay:
             free_q.put(slot)
         self.cols, self.meta = driver.finish()
         self._merge_driver_stats(driver)
+        self._driver = None
         self.stats.stage_s += ostats.stage_s
         self.stats.stall_s += ostats.stall_s
         self.stats.overlap_ratio = ostats.overlap_ratio
@@ -768,27 +1126,11 @@ class FusedReplay:
         the dispatched ranges synchronously (error path, perf
         irrelevant) and raise the SAME message the serial loop produces
         at the offending chunk."""
-        import jax.numpy as jnp
-
-        from ytpu.ops.decode_kernel import FLAG_ERRORS, pack_updates
-
-        plan = self.plan
-        decode = _decoder(
-            plan.max_rows, plan.max_dels, plan.max_steps, plan.max_sections
-        )
-        for pos, end in self._dispatched_ranges:
-            batch = payloads[pos:end]
-            if len(batch) < self.chunk:
-                batch = batch + [b"\x00\x00"] * (self.chunk - len(batch))
-            buf, lens = pack_updates(batch, pad_to=plan.max_len + 16)
-            _, flags = decode(jnp.asarray(buf), jnp.asarray(lens))
-            f = np.asarray(flags)[: end - pos] & FLAG_ERRORS
-            if f.any():
-                bad = np.nonzero(f)[0]
-                raise RuntimeError(
-                    f"device decode flagged updates "
-                    f"{(pos + bad[:8]).tolist()}: flags {f[bad[:8]].tolist()}"
-                )
+        for pos, bad, f in self._flagged_chunks(payloads):
+            raise RuntimeError(
+                f"device decode flagged updates "
+                f"{(pos + bad[:8]).tolist()}: flags {f[bad[:8]].tolist()}"
+            )
         raise RuntimeError(
             f"device decode flagged errors (sticky flags {flags_or}) but "
             "the host re-scan found none — payloads mutated mid-replay?"
@@ -808,7 +1150,11 @@ class FusedReplay:
         return self._hi
 
     def get_string(self, doc: int) -> str:
-        """Final text of one doc slot (host walk over the readback rows)."""
+        """Final text of one doc slot (host walk over the readback rows;
+        after a host-oracle demotion, the oracle's text serves every
+        slot — the stream is broadcast, so all slots are identical)."""
+        if self._host_text is not None:
+            return self._host_text
         from ytpu.ops.integrate_kernel import (
             CN,
             DL,

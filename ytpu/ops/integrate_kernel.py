@@ -24,6 +24,7 @@ from functools import lru_cache, partial
 from typing import Optional, Tuple
 
 import os
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +51,13 @@ __all__ = [
     "PackedReplayDriver",
     "ReplayChunkStats",
     "replay_stream_fused",
+    "LANE_LADDER",
+    "ReplayFault",
+    "lane_family",
+    "effective_lane",
+    "demote_lane",
+    "reset_lane_health",
+    "is_device_fault",
 ]
 
 I32 = jnp.int32
@@ -1209,6 +1217,120 @@ class ReplayChunkStats:
     peak_blocks: int = 0  # max occupancy OBSERVED at readouts (lazy: the
     # true peak between syncs may be higher but is bounded by the margin)
     final_blocks: int = 0
+    # resilience counters (ISSUE-6): lane demotions this driver performed,
+    # in-place chunk retries that succeeded on a demoted lane, and decode
+    # errors quarantined (skip-and-record) instead of aborting the replay
+    demotions: int = 0
+    recoveries: int = 0
+    quarantined: int = 0
+
+
+# --- lane-health ladder + typed replay faults (ISSUE-6 tentpole) -------------
+# A hostile shape family (e.g. the 1024-doc integrate programs that kill
+# the TPU worker, ROADMAP item 1) must not take the process down on every
+# retry: the first dispatch/compile failure demotes the family one rung —
+# fused Pallas → packed-XLA chunk step → (caller-level) serial host
+# oracle — and the demotion is STICKY per shape family, so later drivers
+# for the same family skip the known-bad lane entirely.
+
+from ytpu.utils import metrics as _metrics
+from ytpu.utils.faults import FaultError, faults
+
+LANE_LADDER = ("fused", "xla", "host")
+
+_DEMOTIONS = _metrics.counter("lane.demotions")
+_DEMOTIONS_BY = _metrics.counter(
+    "lane.demotions_by_lane", labelnames=("from_lane", "to_lane")
+)
+_RECOVERIES = _metrics.counter("replay.recoveries")
+_QUARANTINED = _metrics.counter("replay.quarantined")
+
+# shape family -> lowest healthy rung (absent = full health)
+_lane_floor: dict = {}
+_lane_floor_lock = threading.Lock()
+
+
+def lane_family(n_docs: int, d_block: int) -> Tuple[int, int]:
+    """The sticky-health key: capacity grows mid-replay, so only the doc
+    axis and kernel tiling identify a compiled shape family."""
+    return (int(n_docs), int(d_block))
+
+
+def effective_lane(family, requested: str) -> str:
+    """`requested` demoted to the family's sticky floor, if any."""
+    floor = _lane_floor.get(family)
+    if floor is None:
+        return requested
+    if LANE_LADDER.index(floor) > LANE_LADDER.index(requested):
+        return floor
+    return requested
+
+
+def demote_lane(family, from_lane: str) -> Optional[str]:
+    """Record a sticky demotion one rung below `from_lane`; returns the
+    new rung (``None`` when already at the ladder's end)."""
+    idx = LANE_LADDER.index(from_lane)
+    if idx + 1 >= len(LANE_LADDER):
+        return None
+    nxt = LANE_LADDER[idx + 1]
+    with _lane_floor_lock:
+        cur = _lane_floor.get(family)
+        if cur is None or LANE_LADDER.index(nxt) > LANE_LADDER.index(cur):
+            _lane_floor[family] = nxt
+    _DEMOTIONS.inc()
+    _DEMOTIONS_BY.labels(from_lane, nxt).inc()
+    return nxt
+
+
+def reset_lane_health() -> None:
+    """Test/ops hook: forget every sticky demotion."""
+    with _lane_floor_lock:
+        _lane_floor.clear()
+
+
+class ReplayFault(RuntimeError):
+    """A mid-replay device fault the driver could NOT absorb in place
+    (state buffers lost to donation, simulated worker death, or the
+    ladder exhausted).  `recoverable` callers (FusedReplay) restore the
+    last chunk-boundary checkpoint — or the initial state — and re-run;
+    the sticky lane floor already records any demotion."""
+
+    def __init__(self, msg: str, *, chunk: int, lane: str,
+                 cause: Optional[BaseException] = None):
+        super().__init__(msg)
+        self.chunk = chunk
+        self.lane = lane
+        self.cause = cause
+
+
+def is_device_fault(e: BaseException) -> bool:
+    """True for failures that indict the DEVICE LANE (injected faults,
+    XLA runtime/compile errors, Mosaic failures) — never for host-side
+    programming errors, and never for the interpret-mode
+    NotImplementedError that `tests/_fused_interpret` must see raw."""
+    if isinstance(e, FaultError):
+        return True
+    if isinstance(e, (NotImplementedError, MemoryError, KeyboardInterrupt)):
+        return False
+    mod = type(e).__module__ or ""
+    return (
+        "jaxlib" in mod
+        or "mosaic" in mod.lower()
+        or type(e).__name__ == "XlaRuntimeError"
+    )
+
+
+def _buffers_alive(*arrays) -> bool:
+    """True when every jax array still owns its buffer (donation marks
+    consumed inputs deleted — a failed dispatch that already consumed the
+    state cannot be retried in place)."""
+    for a in arrays:
+        try:
+            if a.is_deleted():
+                return False
+        except AttributeError:
+            pass
+    return True
 
 
 class PackedReplayDriver:
@@ -1245,6 +1367,7 @@ class PackedReplayDriver:
         max_capacity: Optional[int] = None,
         sync_every_chunk: bool = False,
         initial_occupancy: int = 0,
+        quarantine: bool = False,
     ):
         from ytpu.models.batch_doc import DEFAULT_COMPACTION_POLICY
 
@@ -1255,9 +1378,16 @@ class PackedReplayDriver:
             raise ValueError(
                 f"n_docs {D} must be a multiple of d_block {d_block}"
             )
+        # sticky lane health: a family demoted by an earlier driver (or an
+        # earlier chunk of this replay) never re-tries the known-bad lane;
+        # the "host" rung is the CALLER's (serial oracle) — the driver
+        # itself bottoms out at the packed-XLA step
+        self._family = lane_family(D, d_block)
+        eff = effective_lane(self._family, lane)
         self.cols = cols
         self.meta = meta
         self.rank = client_rank
+        lane = "xla" if eff == "host" else eff
         self.d_block = d_block
         self.interpret = interpret
         self.lane = lane
@@ -1277,6 +1407,14 @@ class PackedReplayDriver:
         # async replay loop re-identifies the offending chunk/update
         # indices host-side for the same message the sync lane raises
         self.on_decode_error = None
+        # poison-update quarantine (opt-in): a tripped sticky decode
+        # error is RECORDED and cleared instead of aborting the replay —
+        # the decoder already integrates flagged lanes as no-ops, so the
+        # stream's healthy updates are untouched. `on_quarantine(flags)`
+        # (set by FusedReplay) re-identifies the offending update
+        # indices host-side and returns the newly recorded ones.
+        self.quarantine = quarantine
+        self.on_quarantine = None
 
     @property
     def capacity(self) -> int:
@@ -1295,19 +1433,49 @@ class PackedReplayDriver:
                 _phases.transfer(
                     "replay.readout", 12 * len(self._pending), "d2h"
                 )
+            sticky_derr = 0
             for fut in self._pending:
-                vals = np.asarray(fut)
+                try:
+                    vals = np.asarray(fut)
+                except Exception as e:
+                    # an async dispatch whose EXECUTION died surfaces
+                    # here, not at the dispatch call — the packed state
+                    # downstream of it is unusable, so record the sticky
+                    # demotion and hand the caller the resume path
+                    if not is_device_fault(e):
+                        raise
+                    demote_lane(self._family, self.lane)
+                    self.stats.demotions += 1
+                    self._pending.clear()
+                    raise ReplayFault(
+                        f"deferred device fault at readout on lane "
+                        f"{self.lane!r} ({type(e).__name__}: {e})",
+                        chunk=self.stats.chunks,
+                        lane=self.lane,
+                        cause=e,
+                    ) from e
                 occ, kerr = int(vals[0]), int(vals[1])
                 derr = int(vals[2]) if vals.shape[0] > 2 else 0
                 self.stats.peak_blocks = max(self.stats.peak_blocks, occ)
                 if derr != 0:
-                    self._raise_decode_error(derr)
+                    if self.quarantine and self.on_quarantine is not None:
+                        sticky_derr |= derr  # handled once after the loop
+                    else:
+                        self._raise_decode_error(derr)
                 if kerr != 0:
                     self._raise_device_error()
                 hi = occ
             self._pending.clear()
             self.stats.syncs += 1
             self._hi_bound = hi
+            if sticky_derr:
+                # skip-and-record: flagged lanes already integrated as
+                # no-ops on device, so recording the offenders and
+                # clearing the sticky scalar IS the recovery
+                newly = self.on_quarantine(sticky_derr) or []
+                self.stats.quarantined += len(newly)
+                _QUARANTINED.inc(len(newly))
+                self._err = jnp.zeros((), I32)
         return hi
 
     def _raise_device_error(self):
@@ -1323,6 +1491,70 @@ class PackedReplayDriver:
             f"flags {flags_or}); replay with sync_every_chunk=True to "
             "localize the update"
         )
+
+    # ------------------------------------------- lane ladder (ISSUE-6)
+
+    def _refresh_origin_slot_packed(self) -> None:
+        """Demotion repair: chunks run by the fused kernel leave the
+        packed origin_slot cache plane stale, and the packed-XLA chunk
+        step's conflict scan READS that plane — rebuild it before the
+        first post-demotion XLA chunk (rare failure path; the O(D·B²)
+        rebuild cost is irrelevant next to the fault it recovers from)."""
+        from ytpu.models.batch_doc import recompute_origin_slot
+
+        state = unpack_state(self.cols, self.meta, None)
+        state = recompute_origin_slot(state)
+        self.cols, self.meta = pack_state(state)
+
+    def _absorb_lane_fault(self, e: BaseException) -> None:
+        """Classify one dispatch failure: demote-and-return when the SAME
+        chunk can retry in place on the next rung, else raise
+        `ReplayFault` for the caller's checkpoint-resume path.  Host-side
+        programming errors re-raise untouched."""
+        if not is_device_fault(e):
+            raise e
+        kill = isinstance(e, FaultError) and bool(e.spec.args.get("kill"))
+        alive = _buffers_alive(self.cols, self.meta, self._err)
+        nxt = demote_lane(self._family, self.lane)
+        if nxt is not None:
+            self.stats.demotions += 1
+        if kill or not alive or nxt is None or nxt == "host":
+            raise ReplayFault(
+                f"device dispatch failed on lane {self.lane!r} "
+                f"({type(e).__name__}: {e})"
+                + ("" if alive else " — state buffers lost to donation"),
+                chunk=self.stats.chunks,
+                lane=self.lane,
+                cause=e,
+            ) from e
+        if self.lane == "fused":
+            self._refresh_origin_slot_packed()
+        self.lane = nxt
+        self.stats.recoveries += 1
+        _RECOVERIES.inc()
+
+    def _dispatch(self, fn):
+        """Run one chunk dispatch under the lane-health ladder: an
+        injected or real dispatch/compile failure demotes the family one
+        rung (sticky) and retries the SAME chunk in place while the state
+        buffers survive; past the driver's rungs — or on simulated worker
+        death (`replay.kill`) — it raises `ReplayFault` instead."""
+        while True:
+            try:
+                faults.maybe_raise("dispatch.fail", lane=self.lane)
+                out = fn(self.lane)
+            except Exception as e:
+                self._absorb_lane_fault(e)
+                continue
+            spec = faults.fire("replay.kill", lane=self.lane)
+            if spec is not None:
+                raise ReplayFault(
+                    "injected mid-replay kill (state treated as lost)",
+                    chunk=self.stats.chunks,
+                    lane=self.lane,
+                    cause=FaultError("replay.kill", spec),
+                )
+            return out
 
     # ------------------------------------------------------- compact/grow
 
@@ -1363,7 +1595,24 @@ class PackedReplayDriver:
                 )
             from ytpu.ops.compaction import grow_packed
 
-            self.cols, self.meta = grow_packed(self.cols, self.meta, new_cap)
+            try:
+                faults.maybe_raise("grow.oom")
+                self.cols, self.meta = grow_packed(
+                    self.cols, self.meta, new_cap
+                )
+            except Exception as e:
+                if not is_device_fault(e):
+                    raise
+                # a failed growth (device OOM) leaves the pre-grow state
+                # valid but the next chunk unservable — checkpoint-resume
+                # territory, not an in-place retry
+                raise ReplayFault(
+                    f"grow to capacity {new_cap} failed "
+                    f"({type(e).__name__}: {e})",
+                    chunk=self.stats.chunks,
+                    lane=self.lane,
+                    cause=e,
+                ) from e
             self.stats.growths += 1
             self.stats.capacity = new_cap
 
@@ -1380,37 +1629,39 @@ class PackedReplayDriver:
         if margin is None:
             margin = int(stream_worst_case_adds(stream).sum()) + 8
         self.ensure_room(margin)
-        if self.lane == "fused":
-            rows, dels = pack_stream(stream)
-            # YTPU_FUSED_VMEM_MB rides `_run` as a STATIC arg (read per
-            # chunk): a changed limit forces a retrace instead of silently
-            # reusing the old compiled guard (ADVICE r5 #2)
-            vmem_mb = int(os.environ.get("YTPU_FUSED_VMEM_MB", "64"))
-            if _phases.enabled:
-                _phases.transfer(
-                    "replay.chunk_fused",
-                    rows.size * rows.dtype.itemsize
-                    + dels.size * dels.dtype.itemsize,
-                    "h2d",
-                )
-                span = _phases.span(
-                    "replay.chunk_fused",
-                    (self.cols.shape, rows.shape, dels.shape, self.d_block),
-                )
-            else:
-                span = NULL_SPAN
-            with span:
-                self.cols, self.meta = _run(
-                    self.cols,
-                    self.meta,
-                    (rows, dels, self.rank),
-                    self.d_block,
-                    self.interpret,
-                    3,
-                    4,
-                    vmem_mb,
-                )
-        else:
+
+        def dispatch(lane):
+            if lane == "fused":
+                rows, dels = pack_stream(stream)
+                # YTPU_FUSED_VMEM_MB rides `_run` as a STATIC arg (read
+                # per chunk): a changed limit forces a retrace instead of
+                # silently reusing the old compiled guard (ADVICE r5 #2)
+                vmem_mb = int(os.environ.get("YTPU_FUSED_VMEM_MB", "64"))
+                if _phases.enabled:
+                    _phases.transfer(
+                        "replay.chunk_fused",
+                        rows.size * rows.dtype.itemsize
+                        + dels.size * dels.dtype.itemsize,
+                        "h2d",
+                    )
+                    span = _phases.span(
+                        "replay.chunk_fused",
+                        (self.cols.shape, rows.shape, dels.shape,
+                         self.d_block),
+                    )
+                else:
+                    span = NULL_SPAN
+                with span:
+                    return _run(
+                        self.cols,
+                        self.meta,
+                        (rows, dels, self.rank),
+                        self.d_block,
+                        self.interpret,
+                        3,
+                        4,
+                        vmem_mb,
+                    )
             span = (
                 _phases.span(
                     "replay.chunk_xla",
@@ -1420,9 +1671,11 @@ class PackedReplayDriver:
                 else NULL_SPAN
             )
             with span:
-                self.cols, self.meta = xla_chunk_step(
+                return xla_chunk_step(
                     self.cols, self.meta, stream, self.rank
                 )
+
+        self.cols, self.meta = self._dispatch(dispatch)
         self._pending.append(_chunk_readout(self.meta, self._err))
         self._hi_bound += margin
         self.stats.chunks += 1
@@ -1466,32 +1719,38 @@ class PackedReplayDriver:
                 + d_refs.size * d_refs.dtype.itemsize,
                 "h2d",
             )
-            span = _phases.span(
-                "replay.chunk_async",
-                (self.cols.shape, d_buf.shape, d_refs.shape, tuple(dims),
-                 self.lane, self.d_block, vmem_mb),
-            )
-        else:
-            span = NULL_SPAN
         max_rows, max_dels, n_steps, max_sections = dims
-        with span:
-            self.cols, self.meta, self._err, readout = replay_chunk_program(
-                self.cols,
-                self.meta,
-                self._err,
-                d_buf,
-                d_lens,
-                d_refs,
-                self.rank,
-                lane=self.lane,
-                max_rows=max_rows,
-                max_dels=max_dels,
-                n_steps=n_steps,
-                max_sections=max_sections,
-                d_block=self.d_block,
-                interpret=self.interpret,
-                vmem_mb=vmem_mb,
+
+        def dispatch(lane):
+            span = (
+                _phases.span(
+                    "replay.chunk_async",
+                    (self.cols.shape, d_buf.shape, d_refs.shape,
+                     tuple(dims), lane, self.d_block, vmem_mb),
+                )
+                if _phases.enabled
+                else NULL_SPAN
             )
+            with span:
+                return replay_chunk_program(
+                    self.cols,
+                    self.meta,
+                    self._err,
+                    d_buf,
+                    d_lens,
+                    d_refs,
+                    self.rank,
+                    lane=lane,
+                    max_rows=max_rows,
+                    max_dels=max_dels,
+                    n_steps=n_steps,
+                    max_sections=max_sections,
+                    d_block=self.d_block,
+                    interpret=self.interpret,
+                    vmem_mb=vmem_mb,
+                )
+
+        self.cols, self.meta, self._err, readout = self._dispatch(dispatch)
         self._pending.append(readout)
         self._hi_bound += margin
         self.stats.chunks += 1

@@ -159,6 +159,37 @@ class DeviceSyncServer(SyncServer):
         ]
 
     def receive_frames(self, session: Session, data: bytes) -> List[bytes]:
+        """Like `SyncServer.receive_frames`, but malformed-frame errors
+        are isolated to the offending session (ISSUE-6): a frame that
+        fails to parse or apply marks THIS session dead (`net.bad_frames`
+        counter) and returns no replies instead of propagating into the
+        serving loop — one hostile peer cannot take down a device batch
+        that is serving every other tenant.  Device-step failures raised
+        by `flush_device` are NOT caught here: those indict the batch,
+        not a session, and keep their flight-recorder dump semantics."""
+        try:
+            return self._receive_frames_unsafe(session, data)
+        except Exception as e:
+            from ytpu.utils import metrics, tracer
+
+            metrics.counter("net.bad_frames").inc()
+            # the flight-recorder ring keeps WHAT threw (bounded,
+            # drop-oldest: a hostile peer can't grow it) — a real
+            # server-side bug must stay distinguishable from peer junk
+            tracer.instant(
+                "net.bad_frame",
+                error=repr(e),
+                tenant=session.tenant,
+                session=session.id,
+            )
+            session.dead = True
+            session.outbox = []
+            self.disconnect(session)
+            return []
+
+    def _receive_frames_unsafe(
+        self, session: Session, data: bytes
+    ) -> List[bytes]:
         if not self.device_authoritative or session.tenant in self._host_tenants:
             return super().receive_frames(session, data)
         t = self.tenant(session.tenant)

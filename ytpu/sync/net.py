@@ -25,6 +25,7 @@ to the device batch.
 from __future__ import annotations
 
 import asyncio
+import random
 from typing import Optional, Tuple
 
 from ytpu.encoding.lib0 import EncodingError, Writer
@@ -36,7 +37,8 @@ from ytpu.sync.protocol import (
     message_reader,
 )
 from ytpu.sync.server import DeviceBatchFull, SyncServer
-from ytpu.utils import metrics
+from ytpu.utils import metrics, tracer
+from ytpu.utils.faults import faults
 
 # transport series (module-cached children: zero lookups per frame)
 _FRAMES_IN = metrics.counter("net.frames_in")
@@ -44,8 +46,22 @@ _FRAMES_OUT = metrics.counter("net.frames_out")
 _BYTES_IN = metrics.counter("net.bytes_in")
 _BYTES_OUT = metrics.counter("net.bytes_out")
 _CONNECTIONS = metrics.gauge("net.connections")
+# resilience series (ISSUE-6, docs/robustness.md)
+_FRAME_TIMEOUTS = metrics.counter("net.frame_timeouts")
+_BAD_FRAMES = metrics.counter("net.bad_frames")
+_CONNECT_RETRIES = metrics.counter("net.connect_retries")
+_RECONNECTS = metrics.counter("net.reconnects")
+
+
+class FrameTimeout(ConnectionError):
+    """A peer stalled mid-frame past the whole-frame deadline.  The
+    stream is desynced by construction (part of the frame was consumed)
+    — the connection must be dropped; a reconnect resyncs via the
+    state-vector handshake."""
+
 
 # protocol-level garbage from a peer tears the connection down quietly
+# (FrameTimeout is a ConnectionError: a stalled peer is peer-local too)
 _PEER_ERRORS = (
     asyncio.IncompleteReadError,
     ConnectionError,
@@ -56,20 +72,40 @@ _PEER_ERRORS = (
     ValueError,
 )
 
-__all__ = ["serve", "SyncClient", "read_frame", "write_frame"]
+__all__ = [
+    "serve",
+    "SyncClient",
+    "FrameTimeout",
+    "read_frame",
+    "write_frame",
+]
 
 _MAX_FRAME = 64 * 1024 * 1024
 
+#: whole-frame deadline default: generous enough for a 64 MiB frame on a
+#: slow link, small enough that a wedged peer frees its session the same
+#: minute (override per call site)
+FRAME_DEADLINE = 30.0
+
 
 async def read_frame(
-    reader: asyncio.StreamReader, first_byte_timeout: Optional[float] = None
+    reader: asyncio.StreamReader,
+    first_byte_timeout: Optional[float] = None,
+    frame_timeout: Optional[float] = FRAME_DEADLINE,
 ) -> Optional[bytes]:
     """One varint-length-prefixed frame; None on clean EOF or first-byte
     timeout.
 
-    The timeout applies ONLY to the first byte: once a frame has started,
-    the read runs to completion — cancelling mid-frame would leave
-    consumed bytes behind and desync the stream."""
+    `first_byte_timeout` is the idle poll: no frame has started, so
+    timing out is clean (None).  `frame_timeout` is the whole-frame
+    deadline covering everything AFTER the first byte — a peer that
+    stalls mid-frame used to hang the reader forever (the old timeout
+    covered only the first byte).  Hitting it raises `FrameTimeout`: the
+    partially-consumed frame has desynced the stream, so the connection
+    is unusable and must be dropped (counted in `net.frame_timeouts`)."""
+    stall = faults.delay_s("net.delay")
+    if stall:
+        await asyncio.sleep(stall)
     first = reader.read(1)
     if first_byte_timeout is not None:
         try:
@@ -80,32 +116,59 @@ async def read_frame(
         b = await first
     if not b:
         return None  # clean EOF between frames
-    shift = 0
-    size = 0
-    header = 0
-    while True:
-        header += 1
-        size |= (b[0] & 0x7F) << shift
-        shift += 7
-        if b[0] < 0x80:
-            break
-        if shift > 63:
-            raise ConnectionError("oversized frame varint")
-        b = await reader.read(1)
-        if not b:
-            # EOF inside a length prefix is truncation, not a clean close
-            raise ConnectionError("eof inside frame header")
-    if size > _MAX_FRAME:
-        raise ConnectionError(f"frame of {size} bytes exceeds limit")
-    data = await reader.readexactly(size)
-    _FRAMES_IN.inc()
-    # header + payload, matching bytes_out (which counts the framed
-    # write): the two series used to disagree by the varint prefix
-    _BYTES_IN.inc(header + len(data))
-    return data
+
+    async def rest() -> bytes:
+        nonlocal b
+        shift = 0
+        size = 0
+        header = 0
+        while True:
+            header += 1
+            size |= (b[0] & 0x7F) << shift
+            shift += 7
+            if b[0] < 0x80:
+                break
+            if shift > 63:
+                raise ConnectionError("oversized frame varint")
+            b = await reader.read(1)
+            if not b:
+                # EOF inside a length prefix is truncation, not a clean
+                # close
+                raise ConnectionError("eof inside frame header")
+        if size > _MAX_FRAME:
+            raise ConnectionError(f"frame of {size} bytes exceeds limit")
+        data = await reader.readexactly(size)
+        _FRAMES_IN.inc()
+        # header + payload, matching bytes_out (which counts the framed
+        # write): the two series used to disagree by the varint prefix
+        _BYTES_IN.inc(header + len(data))
+        return data
+
+    if frame_timeout is None:
+        return await rest()
+    try:
+        return await asyncio.wait_for(rest(), frame_timeout)
+    except asyncio.TimeoutError:
+        _FRAME_TIMEOUTS.inc()
+        raise FrameTimeout(
+            f"peer stalled mid-frame past the {frame_timeout}s deadline"
+        ) from None
 
 
 def write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
+    if faults.active:
+        if faults.fire("net.drop") is not None:
+            return  # injected frame loss: nothing reaches the wire
+        if faults.fire("net.truncate") is not None:
+            # header + half the payload: the reader sees a started frame
+            # that never completes — the whole-frame deadline's shape
+            w = Writer()
+            w.write_buf(payload)
+            buf = w.to_bytes()
+            cut = buf[: max(1, len(buf) - max(1, len(payload) // 2))]
+            _BYTES_OUT.inc(len(cut))
+            writer.write(cut)
+            return
     w = Writer()
     w.write_buf(payload)
     buf = w.to_bytes()
@@ -120,6 +183,7 @@ async def serve(
     port: int = 0,
     flush_every: int = 1,
     idle_flush: float = 0.2,
+    frame_deadline: Optional[float] = FRAME_DEADLINE,
 ) -> Tuple[asyncio.AbstractServer, int]:
     """Start serving; returns (asyncio server, bound port).
 
@@ -128,14 +192,29 @@ async def serve(
     socket — a broadcast enqueued by another connection's frame (or by an
     in-process write: server-side transaction, replica link) ships on this
     connection's next frame or idle wakeup. One writer per task means no
-    two coroutines ever await drain() on the same transport."""
+    two coroutines ever await drain() on the same transport.
+
+    Error isolation (ISSUE-6): every failure inside one connection's
+    handler — peer garbage, a mid-frame stall past `frame_deadline`, or
+    an unexpected server-side exception while processing a frame — is
+    confined to that session: the session is dropped (and counted in
+    `net.bad_frames` when a frame triggered it) while the accept loop
+    and every other session keep serving."""
 
     async def handle(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         session = None
         frames_seen = 0
         _CONNECTIONS.inc()
         try:
-            hello = await read_frame(reader)
+            # the hello needs a FIRST-byte deadline too: frame_timeout
+            # only starts after byte one, so a connect-and-say-nothing
+            # peer would otherwise pin this handler (and its socket)
+            # forever
+            hello = await read_frame(
+                reader,
+                first_byte_timeout=frame_deadline,
+                frame_timeout=frame_deadline,
+            )
             if hello is None:
                 return
             tenant = hello.decode("utf-8")
@@ -147,13 +226,36 @@ async def serve(
                 write_frame(writer, frame)
             await writer.drain()
             while True:
-                frame = await read_frame(reader, first_byte_timeout=idle_flush)
+                frame = await read_frame(
+                    reader,
+                    first_byte_timeout=idle_flush,
+                    frame_timeout=frame_deadline,
+                )
                 if frame is None:
                     if reader.at_eof():
                         break
                 else:
-                    for f in server.receive_frames(session, frame):
-                        write_frame(writer, f)
+                    try:
+                        for f in server.receive_frames(session, frame):
+                            write_frame(writer, f)
+                    except _PEER_ERRORS:
+                        # malformed frame: this session's problem only
+                        _BAD_FRAMES.inc()
+                        break
+                    except Exception as e:
+                        # a server-side bug triggered by one frame must
+                        # not escape into asyncio's exception handler N
+                        # times per reconnect storm; the session drops,
+                        # the accept loop lives — and the flight
+                        # recorder keeps what threw (bounded ring)
+                        _BAD_FRAMES.inc()
+                        tracer.instant(
+                            "net.bad_frame",
+                            error=repr(e),
+                            tenant=session.tenant,
+                            session=session.id,
+                        )
+                        break
                     frames_seen += 1
                     if flush_every and frames_seen % flush_every == 0:
                         flush = getattr(server, "flush_device", None)
@@ -191,9 +293,42 @@ class SyncClient:
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
         self._unsub = None
+        self._endpoint: Optional[Tuple[str, int, str]] = None
 
-    async def connect(self, host: str, port: int, tenant: str) -> None:
-        self.reader, self.writer = await asyncio.open_connection(host, port)
+    async def connect(
+        self,
+        host: str,
+        port: int,
+        tenant: str,
+        retries: int = 4,
+        backoff: float = 0.05,
+        backoff_max: float = 2.0,
+    ) -> None:
+        """Open the connection and start the handshake.
+
+        A refused/unreachable connect retries up to `retries` times with
+        exponential backoff + full jitter (`backoff`·2^k, capped at
+        `backoff_max`, each multiplied by U[0.5, 1.5)) so a thundering
+        herd of reconnecting clients spreads out (`net.connect_retries`
+        counts the re-attempts).  The SyncStep1 sent here carries the
+        doc's CURRENT state vector, so the same call is the resync path:
+        after a reconnect the server's SyncStep2 fills exactly the gap."""
+        delay = backoff
+        attempt = 0
+        while True:
+            try:
+                self.reader, self.writer = await asyncio.open_connection(
+                    host, port
+                )
+                break
+            except OSError:
+                if attempt >= retries:
+                    raise
+                attempt += 1
+                _CONNECT_RETRIES.inc()
+                await asyncio.sleep(delay * (0.5 + random.random()))
+                delay = min(delay * 2, backoff_max)
+        self._endpoint = (host, port, tenant)
         write_frame(self.writer, tenant.encode("utf-8"))
         write_frame(
             self.writer,
@@ -211,11 +346,41 @@ class SyncClient:
 
         self._unsub = self.doc.observe_update_v1(on_update)
 
-    async def pump(self, max_frames: int = 1, timeout: float = 2.0) -> int:
-        """Process up to `max_frames` inbound frames; returns the count."""
+    async def reconnect(self, **connect_kw) -> None:
+        """Reconnect-with-resync after a dropped/desynced connection
+        (FrameTimeout, eviction, transport error): tear down the old
+        transport and redo `connect` to the remembered endpoint — the
+        state-vector handshake pulls whatever this client missed while
+        disconnected, and pending local edits re-ship on the doc's next
+        update (counted in `net.reconnects`)."""
+        if self._endpoint is None:
+            raise RuntimeError("reconnect before a successful connect")
+        host, port, tenant = self._endpoint
+        await self.close()
+        await self.connect(host, port, tenant, **connect_kw)
+        # counted only once connect() succeeded: the metric's contract
+        # is reconnect-with-resync, not reconnect attempts
+        _RECONNECTS.inc()
+
+    async def pump(
+        self,
+        max_frames: int = 1,
+        timeout: float = 2.0,
+        frame_timeout: Optional[float] = FRAME_DEADLINE,
+    ) -> int:
+        """Process up to `max_frames` inbound frames; returns the count.
+
+        `timeout` is the idle first-byte poll (no frame = return early);
+        `frame_timeout` is the whole-frame deadline — a server that
+        stalls mid-frame raises `FrameTimeout` instead of hanging this
+        client forever (reconnect() is the recovery)."""
         n = 0
         while n < max_frames:
-            frame = await read_frame(self.reader, first_byte_timeout=timeout)
+            frame = await read_frame(
+                self.reader,
+                first_byte_timeout=timeout,
+                frame_timeout=frame_timeout,
+            )
             if frame is None:
                 break
             for msg in message_reader(frame):

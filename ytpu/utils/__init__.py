@@ -1,11 +1,17 @@
-"""Host-side utilities: metrics, tracing, phase timers (SURVEY §5.1/§5.5)."""
+"""Host-side utilities: metrics, tracing, phase timers, fault injection
+(SURVEY §5.1/§5.5; docs/robustness.md)."""
 
+from .faults import FaultError, FaultInjector, FaultSpec, faults
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry, metrics
 from .phases import PhaseRecorder, phases
 from .trace import Tracer, trace_span, tracer
 
 __all__ = [
     "Counter",
+    "FaultError",
+    "FaultInjector",
+    "FaultSpec",
+    "faults",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
