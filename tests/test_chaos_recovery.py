@@ -245,13 +245,29 @@ def test_poison_update_quarantined_not_aborted():
     assert metrics.counter("replay.quarantined").value == base + 1
 
     # same stream through the overlap lane's deferred sticky-error path
+    # on the RAW ingest lane (ISSUE-7): the corruption lands in the wire
+    # table, the ON-DEVICE varint decode flags the lane into the sticky
+    # scalar, and deferred host re-identification quarantines the same
+    # update index the serial loop names
     faults.clear()
     ik.reset_lane_health()
     faults.arm("update.corrupt", after=poison)
-    r2 = _make(overlap=True, quarantine=True)
+    r2 = _make(overlap=True, ingest="raw", quarantine=True)
     r2.run(log)
+    assert r2.stats.ingest == "raw", r2.stats
     assert r2.stats.quarantined == [poison]
     assert r2.get_string(0) == expect_m1
+
+    # and through the host-packed fallback rung (ingest="packed" — the
+    # PR-5 staging the PR-6 ladder keeps): identical quarantine outcome
+    faults.clear()
+    ik.reset_lane_health()
+    faults.arm("update.corrupt", after=poison)
+    r3 = _make(overlap=True, ingest="packed", quarantine=True)
+    r3.run(log)
+    assert r3.stats.ingest == "packed", r3.stats
+    assert r3.stats.quarantined == [poison]
+    assert r3.get_string(0) == expect_m1
 
 
 @needs_native

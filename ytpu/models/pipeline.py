@@ -35,6 +35,13 @@ class UpdatePipeline:
     Chunks are `chunk_steps` updates stacked into one `[S, ...]` stream
     (each step broadcast to every doc slot, the multi-tenant replay shape);
     one `lax.scan` program integrates a whole chunk per dispatch.
+    `depth` bounds how far the decode worker runs ahead (the shared
+    `OverlapPipeline` cap); > 2 is supported and useful when per-chunk
+    dispatch latency is jittery — for raw-byte text-stream replays use
+    `FusedReplay(overlap=True, ingest="raw", depth=...)`, whose staging
+    is a memcpy instead of this pipeline's per-payload host decode
+    (ISSUE-7; this pipeline keeps host decode because it supports every
+    content kind through the encoder's payload store).
 
     `lane` routes the integrate stage:
 
@@ -84,6 +91,8 @@ class UpdatePipeline:
             raise ValueError(
                 f"lane must be 'xla', 'fused' or 'packed_xla', got {lane!r}"
             )
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
         self.enc = enc
         self.n_rows = n_rows
         self.n_dels = n_dels
@@ -97,11 +106,18 @@ class UpdatePipeline:
         self.max_capacity = max_capacity
 
     def _chunks(self, payloads: Iterable[bytes]):
-        """Decode + build padded micro-chunks (runs on the worker thread)."""
+        """Decode + build padded micro-chunks (runs on the worker thread).
+
+        Byte accounting rides the shared staging gauges (ISSUE-7): the
+        payload bytes this producer decodes land in `_staged_bytes`, so
+        `pipeline.stage_bytes` is comparable with the raw replay lane's
+        `replay.stage_bytes` — the ratio of bytes to `*.stage` seconds
+        is the staging throughput the raw lane collapses to memcpy rate."""
         from ytpu.utils.phases import phases
 
         steps: List[UpdateBatch] = []
         for p in payloads:
+            self._staged_bytes += len(p)
             with phases.span("pipeline.decode"):
                 u = (
                     Update.decode_v2(p)
@@ -197,12 +213,14 @@ class UpdatePipeline:
         client_rank: Optional[jax.Array] = None,
     ) -> Tuple[DocStateBatch, int]:
         from ytpu.models.replay import OverlapPipeline
+        from ytpu.utils.phases import phases
 
         lane = self._effective_lane(state)
         holder = {"state": state, "rank": client_rank}
         n = 0
         rank_clients = -1
         driver = None
+        self._staged_bytes = 0
 
         def consume(chunk):
             nonlocal n, rank_clients, driver
@@ -227,6 +245,8 @@ class UpdatePipeline:
         OverlapPipeline(depth=self.depth, stage_prefix="pipeline").run(
             self._chunks(payloads), consume
         )
+        if phases.enabled and self._staged_bytes:
+            phases.add_value("pipeline.stage_bytes", self._staged_bytes)
         state = holder["state"]
         if driver is not None:
             state = self._finish_driver(driver, state, lane)

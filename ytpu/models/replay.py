@@ -23,8 +23,11 @@ stream) by running the engine the way a long-lived server would:
   host-driven, exactly like a server reacting to tenant growth.
 
 Host work per update is bounded and small: the native columnar pre-scan
-(the same control plane the ingest fast lane uses) plus a memcpy into the
-padded chunk buffer. Decode, integrate, squash, and GC all run on device.
+(the same control plane the ingest fast lane uses) plus — on the async
+raw ingest lane (ISSUE-7, the default) — a slice copy of the stream's
+concatenated wire bytes; the per-update padding/packing happens on
+device (`gather_raw_lanes`). Decode, integrate, squash, and GC all run
+on device.
 """
 
 from __future__ import annotations
@@ -50,6 +53,8 @@ __all__ = [
     "OverlapStats",
     "OverlapPlan",
     "plan_overlap",
+    "build_wire_table",
+    "raw_chunk_cap",
 ]
 
 
@@ -218,6 +223,14 @@ class ReplayStats:
     overlap_ratio: float = 0.0
     max_inflight: int = 0
     buffer_reuses: int = 0
+    # raw ingest lane (ISSUE-7): which staging path ran ("raw" ships
+    # concatenated bytes + an offsets table, "packed" the per-update
+    # host-packed [S, L] matrix), how many payload bytes staging copied,
+    # and the one-time wire-table build cost (NOT counted in stage_s —
+    # it is not per-chunk work and cannot be hidden behind dispatch)
+    ingest: str = ""
+    stage_bytes: int = 0
+    prescan_s: float = 0.0
     # resilience (ISSUE-6): caller-level resumes + driver-level in-place
     # retries, sticky lane demotions, chunk-boundary checkpoints taken,
     # update indices quarantined instead of aborting, and positions the
@@ -496,6 +509,57 @@ class _StagingSlot:
         self.end = 0
 
 
+class _RawStagingSlot:
+    """One reusable RAW-ingest staging buffer (ISSUE-7): a plain byte
+    buffer holding the chunk's concatenated wire bytes, the tiny
+    per-update offset/length tables, and the chunk's global unit-ref
+    rows. Staging into it is a memcpy (`pack_raw_updates_into`) — the
+    per-update padding/packing of `_StagingSlot` moved on device
+    (`gather_raw_lanes`)."""
+
+    __slots__ = ("raw", "offs", "lens", "refs", "pos", "end")
+
+    def __init__(self, raw_cap: int, chunk: int, u: int):
+        self.raw = np.zeros((raw_cap,), dtype=np.uint8)
+        self.offs = np.zeros((chunk,), dtype=np.int32)
+        self.lens = np.zeros((chunk,), dtype=np.int32)
+        self.refs = np.full((chunk, u), -1, dtype=np.int32)
+        self.pos = 0
+        self.end = 0
+
+
+def build_wire_table(payloads) -> Tuple[np.ndarray, np.ndarray]:
+    """Flatten a payload sequence into the raw ingest lane's wire table:
+    ``(wire, wire_offsets)`` with ``wire`` the concatenated u8 bytes and
+    ``wire_offsets`` the ``[S+1]`` prefix table. One C-speed join + one
+    cumsum — the only per-update host work left on the raw path is the
+    ``len()`` reads of this prescan; per-CHUNK staging afterwards is
+    pure slice copies (`pack_raw_updates_into`)."""
+    n = len(payloads)
+    lens = np.fromiter((len(p) for p in payloads), dtype=np.int64, count=n)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    wire = np.frombuffer(b"".join(payloads), dtype=np.uint8)
+    return wire, offsets
+
+
+def raw_chunk_cap(wire_offsets: np.ndarray, chunk: int) -> int:
+    """Staging-buffer capacity for the raw lane: the worst byte span of
+    ANY ``chunk``-update window (sliding, not just stride-aligned — a
+    checkpoint resume shifts the window grid) plus the staged
+    `EMPTY_UPDATE` tail, bucketed to 64 so near-identical streams share
+    one compiled `replay_chunk_program_raw` family."""
+    from ytpu.ops.decode_kernel import EMPTY_UPDATE
+
+    S = len(wire_offsets) - 1
+    if S <= 0:
+        return 64
+    ends = np.minimum(np.arange(S, dtype=np.int64) + chunk, S)
+    worst = int((wire_offsets[ends] - wire_offsets[:S]).max())
+    cap = worst + len(EMPTY_UPDATE)
+    return -(-cap // 64) * 64
+
+
 def _decoder(max_rows: int, max_dels: int, n_steps: int, max_sections: int):
     """Chunk decoder bound to its static shape params. `FusedReplay.run`
     used to build a FRESH `jax.jit(partial(...))` per call, so the warmup
@@ -542,15 +606,24 @@ class FusedReplay:
     device sync per chunk — chunk_seconds then measure dispatch, not
     execution).
 
-    `overlap=True` selects the ASYNC double-buffered pipeline (ISSUE-5):
-    a staging thread packs chunk k+1's wire bytes + unit refs into a
-    reusable buffer pair while the device decodes+integrates chunk k as
-    ONE fused dispatch (`integrate_kernel.replay_chunk_program`, donated
-    state), decode-error checking folds into the driver's sticky device
-    scalar, and the steady-state loop performs ZERO blocking device
-    syncs — errors surface at watermark drains or `finish()`, with the
-    offending update re-identified host-side for the same message the
-    serial loop raises. `sync_per_chunk` is ignored in overlap mode."""
+    `overlap=True` selects the ASYNC pipelined lane (ISSUE-5): a staging
+    thread preps chunk k+1 into a reusable slot while the device
+    decodes+integrates chunk k as ONE fused dispatch (donated state),
+    decode-error checking folds into the driver's sticky device scalar,
+    and the steady-state loop performs ZERO blocking device syncs —
+    errors surface at watermark drains or `finish()`, with the offending
+    update re-identified host-side for the same message the serial loop
+    raises. `sync_per_chunk` is ignored in overlap mode.
+
+    Under the default `ingest="raw"` (ISSUE-7) staging is a MEMCPY: the
+    host ships the chunk's raw concatenated wire bytes plus a tiny
+    per-update offsets table, and the device gathers the update lanes
+    and decodes the varints itself (`replay_chunk_program_raw`) — the
+    per-update Python packing + its `[S, L]` padded h2d transfer are
+    gone, so `depth` > 2 pipelining is essentially free.
+    `ingest="packed"` keeps the PR-5 `pack_updates_into` staging
+    (`replay_chunk_program`) as the host-packed fallback rung; the
+    serial and checkpoint/host-oracle paths keep it unconditionally."""
 
     def __init__(
         self,
@@ -565,6 +638,8 @@ class FusedReplay:
         policy=None,
         sync_per_chunk: bool = True,
         overlap: bool = False,
+        ingest: str = "raw",
+        depth: int = 2,
         checkpoint_every: int = 0,
         quarantine: bool = False,
         max_recoveries: int = 3,
@@ -576,6 +651,12 @@ class FusedReplay:
 
         if lane not in ("fused", "xla"):
             raise ValueError(f"lane must be 'fused' or 'xla', got {lane!r}")
+        if ingest not in ("raw", "packed"):
+            raise ValueError(
+                f"ingest must be 'raw' or 'packed', got {ingest!r}"
+            )
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
         self.plan = plan
         self.n_docs = n_docs
         self.d_block = d_block
@@ -586,6 +667,17 @@ class FusedReplay:
         self.policy = policy
         self.sync_per_chunk = sync_per_chunk
         self.overlap = overlap
+        # raw ingest knobs (ISSUE-7): `ingest="raw"` (default) collapses
+        # the async lane's host staging to a memcpy — concatenated wire
+        # bytes + a per-update offsets table, lanes gathered on device by
+        # `replay_chunk_program_raw`. `ingest="packed"` keeps the PR-5
+        # per-update `pack_updates_into` staging (the fallback rung the
+        # PR-6 ladder and the serial/checkpoint paths also keep).
+        # `depth` sizes the overlap pipeline: >2 is essentially free
+        # under raw staging (each extra slot is wire-bytes-sized, and
+        # staging is no longer the critical path).
+        self.ingest = ingest
+        self.depth = depth
         # resilience knobs (ISSUE-6): `checkpoint_every` > 0 pulls a host
         # snapshot of the packed state every N chunks so a mid-replay
         # fault resumes there instead of from scratch (each snapshot is a
@@ -1017,21 +1109,49 @@ class FusedReplay:
 
     def overlap_plan(self, n_updates: Optional[int] = None) -> OverlapPlan:
         """The static staging plan the async lane will execute (dry-run
-        assertion surface)."""
+        assertion surface) — `depth` slots, depth > 2 supported (and
+        essentially free under raw ingest)."""
         return plan_overlap(
             self.plan.n_updates if n_updates is None else n_updates,
             self.chunk,
+            depth=self.depth,
         )
 
+    def _build_wire(self, payloads: List[bytes]):
+        """The raw lane's per-run wire table — one C-speed join + cumsum
+        over the CALLER'S payloads (never the plan's: run() may replay a
+        mutated list, e.g. the deferred-error tests). When corruption
+        faults are armed (or were injected on an earlier attempt) the
+        table is built from the corrupted batch so the device integrates
+        the SAME bytes the fault path re-identifies against — the
+        `update.corrupt` site fires here once per update, in stream
+        order, exactly like the per-chunk packed staging does."""
+        from ytpu.utils.faults import faults
+
+        t0 = time.perf_counter()
+        if faults.active or self._corrupted:
+            batch = self._stage_batch(payloads, 0, len(payloads))
+        else:
+            batch = payloads
+        wire, offsets = build_wire_table(batch)
+        self.stats.prescan_s += time.perf_counter() - t0
+        return wire, offsets
+
     def _run_overlap(self, payloads: List[bytes], client_rank) -> ReplayStats:
-        """ISSUE-5 tentpole loop: staging thread packs chunk k+1 into a
-        reusable slot pair while the device runs chunk k through the
-        fused decode→rebase→integrate program; ZERO blocking device
-        syncs in steady state (readouts stay futures until a watermark
-        drain or `finish()`)."""
+        """ISSUE-5/7 tentpole loop: staging thread preps chunk k+1 into a
+        reusable slot while the device runs chunk k through the fused
+        decode→rebase→integrate program; ZERO blocking device syncs in
+        steady state (readouts stay futures until a watermark drain or
+        `finish()`). Under the default `ingest="raw"` the staging work
+        is a memcpy — slice-copy the chunk's concatenated wire bytes +
+        offset/length tables into a plain byte buffer — and the device
+        gathers the update lanes itself (`replay_chunk_program_raw`);
+        `ingest="packed"` keeps the PR-5 per-update `pack_updates_into`
+        packing as the host-packed fallback rung."""
         import jax.numpy as jnp  # noqa: F401 — device runtime must be up
 
         from ytpu.ops.decode_kernel import pack_updates_into
+        from ytpu.utils.phases import phases
 
         plan = self.plan
         S = len(payloads)
@@ -1039,6 +1159,7 @@ class FusedReplay:
         width = plan.max_len + 16  # == the serial loop's pad_to
         dims = (plan.max_rows, plan.max_dels, plan.max_steps,
                 plan.max_sections)
+        use_raw = self.ingest == "raw"
         start = self._restore_state()
         driver = self._driver = self._make_driver(client_rank)
         self._post_restore(driver)
@@ -1052,18 +1173,29 @@ class FusedReplay:
         driver.on_quarantine = partial(self._quarantine_collect, payloads)
         oplan = self.overlap_plan(S)
         pipe = OverlapPipeline(depth=oplan.depth, stage_prefix="replay")
-        slots = [
-            _StagingSlot(chunk, width, plan.unit_refs.shape[1])
-            for _ in range(oplan.buffers)
-        ]
+        if use_raw:
+            wire, woffs = self._build_wire(payloads)
+            cap = raw_chunk_cap(woffs, chunk)  # one O(S) scan, not per slot
+            slots = [
+                _RawStagingSlot(cap, chunk, plan.unit_refs.shape[1])
+                for _ in range(oplan.buffers)
+            ]
+        else:
+            slots = [
+                _StagingSlot(chunk, width, plan.unit_refs.shape[1])
+                for _ in range(oplan.buffers)
+            ]
         free_q: "queue.Queue" = queue.Queue()
         for s in slots:
             free_q.put(s)
         inflight: deque = deque()
         acquisitions = 0
+        staged_bytes = 0
 
         def produce():
-            nonlocal acquisitions
+            nonlocal acquisitions, staged_bytes
+            from ytpu.ops.decode_kernel import pack_raw_updates_into
+
             for pos in range(start, S, chunk):
                 while True:
                     try:
@@ -1075,9 +1207,15 @@ class FusedReplay:
                         if pipe.stopping:
                             return
                 end = min(pos + chunk, S)
-                pack_updates_into(
-                    self._stage_batch(payloads, pos, end), slot.buf, slot.lens
-                )
+                if use_raw:
+                    staged_bytes += pack_raw_updates_into(
+                        wire, woffs, pos, end,
+                        slot.raw, slot.offs, slot.lens, width=width,
+                    )
+                else:
+                    batch = self._stage_batch(payloads, pos, end)
+                    pack_updates_into(batch, slot.buf, slot.lens)
+                    staged_bytes += sum(len(p) for p in batch)
                 slot.refs[: end - pos] = plan.unit_refs[pos:end]
                 slot.refs[end - pos :] = -1
                 slot.pos, slot.end = pos, end
@@ -1087,9 +1225,15 @@ class FusedReplay:
         def consume(slot):
             t0 = time.perf_counter()
             margin = int(plan.adds[slot.pos : slot.end].sum()) + 8
-            inputs = driver.step_bytes(
-                slot.buf, slot.lens, slot.refs, dims, margin=margin
-            )
+            if use_raw:
+                inputs = driver.step_raw(
+                    slot.raw, slot.offs, slot.lens, slot.refs, dims,
+                    width, margin=margin,
+                )
+            else:
+                inputs = driver.step_bytes(
+                    slot.buf, slot.lens, slot.refs, dims, margin=margin
+                )
             self._dispatched_ranges.append((slot.pos, slot.end))
             self.cols, self.meta = driver.cols, driver.meta
             inflight.append((slot, inputs))
@@ -1118,6 +1262,15 @@ class FusedReplay:
         self.stats.overlap_ratio = ostats.overlap_ratio
         self.stats.max_inflight = max(self.stats.max_inflight, ostats.max_depth)
         self.stats.buffer_reuses += max(0, acquisitions - len(slots))
+        self.stats.ingest = "raw" if use_raw else "packed"
+        self.stats.stage_bytes += staged_bytes
+        if phases.enabled:
+            phases.add_value("replay.stage_bytes", staged_bytes)
+            if ostats.stage_s > 0:
+                phases.set_value(
+                    "replay.stage_bytes_per_s",
+                    staged_bytes / ostats.stage_s,
+                )
         return self.stats
 
     def _reidentify_decode_error(self, payloads: List[bytes], flags_or: int):

@@ -77,6 +77,8 @@ from ytpu.models.batch_doc import UpdateBatch
 __all__ = [
     "pack_updates",
     "pack_updates_into",
+    "pack_raw_updates_into",
+    "gather_raw_lanes",
     "EMPTY_UPDATE",
     "decode_updates_v1",
     "default_steps",
@@ -216,6 +218,78 @@ def pack_updates_into(
         if prev + _PAD > n:
             buf[i, n : prev + _PAD] = 0
         lens[i] = n
+
+
+_EMPTY_NP = np.frombuffer(EMPTY_UPDATE, dtype=np.uint8)
+
+
+def pack_raw_updates_into(
+    wire: np.ndarray,
+    wire_offsets: np.ndarray,
+    pos: int,
+    end: int,
+    raw: np.ndarray,
+    offs: np.ndarray,
+    lens: np.ndarray,
+    width: Optional[int] = None,
+) -> int:
+    """Stage one chunk of the RAW ingest lane (ISSUE-7): a slice copy of
+    the run's concatenated wire bytes plus vectorized offset/length
+    tables — NO per-update Python work (the memcpy-staging invariant the
+    bench dry-run asserts). ``wire`` is the whole stream's concatenated
+    payload bytes, ``wire_offsets`` its ``[S+1]`` prefix table (update i
+    occupies ``wire[wire_offsets[i]:wire_offsets[i+1]]``); the chunk
+    ``[pos, end)`` lands in the reusable ``raw`` byte buffer with
+    in-chunk ``offs``/``lens`` rows the device lane-gather consumes.
+    Rows past ``end - pos`` point at a staged `EMPTY_UPDATE` tail so a
+    short tail chunk decodes as no-ops at the compiled shape. Stale raw
+    bytes from a previous occupant are harmless: the device gather
+    (`gather_raw_lanes`) zero-masks every byte at or past each lane's
+    length. Returns the staged byte count. ``width`` (the decode lane
+    width) enables the same oversized-payload check `pack_updates_into`
+    performs."""
+    n = end - pos
+    if n > offs.shape[0]:
+        raise ValueError(f"chunk of {n} exceeds staging rows {offs.shape[0]}")
+    b0 = int(wire_offsets[pos])
+    b1 = int(wire_offsets[end])
+    nb = b1 - b0
+    if nb + len(EMPTY_UPDATE) > raw.shape[0]:
+        raise ValueError(
+            f"chunk of {nb} wire bytes exceeds staging capacity {raw.shape[0]}"
+        )
+    chunk_lens = wire_offsets[pos : end + 1]
+    if width is not None and n:
+        longest = int((chunk_lens[1:] - chunk_lens[:-1]).max())
+        if longest + _PAD > width:
+            raise ValueError(
+                f"payload of {longest} bytes exceeds staging width {width}"
+            )
+    raw[:nb] = wire[b0:b1]
+    raw[nb : nb + len(EMPTY_UPDATE)] = _EMPTY_NP
+    offs[:n] = chunk_lens[:-1] - b0
+    lens[:n] = chunk_lens[1:] - chunk_lens[:-1]
+    offs[n:] = nb
+    lens[n:] = len(EMPTY_UPDATE)
+    return nb + len(EMPTY_UPDATE)
+
+
+def gather_raw_lanes(raw, offs, lens, width: int):
+    """``[RC]`` raw concatenated bytes + per-update offsets → the padded
+    ``[S, L]`` lane matrix `pack_updates` builds on host, materialized ON
+    DEVICE: one clamped lane-parallel gather + zero mask (the Stream-
+    VByte-style control/data split — the offsets table is the control
+    stream, the byte arena the data stream, and every update lane peels
+    its window simultaneously). Bytes at ``j >= lens[s]`` are zeroed so
+    the matrix is byte-identical to a freshly host-packed one — the
+    varint state machine's prefix sums, gather guard, and key-hash
+    windows read them, so the mask is what guarantees raw-vs-packed
+    decode parity for every content kind (tests/test_async_raw_ingest).
+    """
+    iota = jnp.arange(width, dtype=I32)[None, :]
+    idx = jnp.clip(offs[:, None].astype(I32) + iota, 0, raw.shape[0] - 1)
+    lanes = jnp.take(raw, idx)
+    return jnp.where(iota < lens[:, None].astype(I32), lanes, 0)
 
 
 def identity_rank(k: int) -> jax.Array:

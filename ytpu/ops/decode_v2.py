@@ -83,7 +83,12 @@ from .decode_kernel import (
     pack_updates,
 )
 
-__all__ = ["pack_updates_v2", "decode_updates_v2"]
+__all__ = [
+    "pack_updates_v2",
+    "pack_updates_v2_raw",
+    "decode_updates_v2",
+    "decode_updates_v2_raw",
+]
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -244,6 +249,74 @@ def pack_updates_v2(
             spans[s] = 0  # cold walk failed: flag the lane malformed
     lens = np.asarray([len(p) for p in payloads], dtype=np.int32)
     return buf, lens, spans, sidecar
+
+
+def pack_updates_v2_raw(payloads: List[bytes]):
+    """`pack_updates_v2` for the RAW ingest lane (ISSUE-7): the spans
+    prescan (eleven varint reads per update — the control stream of the
+    Stream-VByte-style split) runs unchanged, but the data stream ships
+    as CONCATENATED wire bytes + a per-update offsets table instead of a
+    host-padded ``[S, L]`` matrix — the lane matrix is materialized on
+    device by `decode_kernel.gather_raw_lanes`, feeding the same
+    bulk-varint expanders (`_bulk_uvarints`, `_expand_*`).
+
+    Returns ``(wire, offsets, row_lens, lens, spans, sidecar, width)``:
+    ``wire`` the flat u8 arena (each update's bytes followed by its
+    V1-form cold sidecars, exactly the packed row layout), ``offsets``
+    the ``[S]`` i32 arena starts, ``row_lens`` the ``[S]`` i32 staged
+    extent per lane (payload + sidecars — the gather's zero-mask bound,
+    which must NOT clip sidecar refs past the payload), ``lens`` the
+    ``[S]`` payload lengths `decode_updates_v2` consumes, and ``width``
+    the static per-lane window (== the packed ``L``)."""
+    buf, lens, spans, sidecar = pack_updates_v2(payloads)
+    S, L = buf.shape
+    if sidecar is None:
+        row_lens = lens.copy()
+    else:
+        # staged extent = payload + transcoded sidecars (the row tail of
+        # the packed matrix past `lens`); derive it from the pack itself
+        # so the two layouts cannot diverge. Only sidecar-carrying lanes
+        # (cold content — rare) pay the per-row tail scan; plain lanes'
+        # extent IS their payload length. A V2 end-to-end raw wiring
+        # should fold the extent into the prescan instead (ROADMAP #2).
+        row_lens = lens.copy()
+        for s in np.nonzero(sidecar[:, 0] >= 0)[0]:
+            nz = buf[s].nonzero()[0]
+            last = int(nz[-1]) + 1 if nz.size else 0
+            row_lens[s] = max(int(lens[s]), last)
+    offsets = np.zeros(S, dtype=np.int32)
+    if S > 1:
+        offsets[1:] = np.cumsum(row_lens[:-1])
+    total = int(row_lens.sum())
+    wire = np.zeros(max(total, 1), dtype=np.uint8)
+    for s in range(S):
+        o, n = int(offsets[s]), int(row_lens[s])
+        wire[o : o + n] = buf[s, :n]
+    return wire, offsets, row_lens, lens, spans, sidecar, L
+
+
+def decode_updates_v2_raw(
+    wire,
+    offsets,
+    row_lens,
+    lens,
+    spans,
+    width: int,
+    **kw,
+):
+    """V2 decode over the raw concatenated arena: gather the ``[S, L]``
+    lane matrix on device (`gather_raw_lanes`, zero-masked at each
+    lane's STAGED extent so cold sidecars survive), then run the normal
+    `decode_updates_v2` bulk expanders on it. Keyword args pass through
+    (tables, sidecar, primary_root_hash)."""
+    import jax.numpy as jnp
+
+    from ytpu.ops.decode_kernel import gather_raw_lanes
+
+    buf = gather_raw_lanes(
+        jnp.asarray(wire), jnp.asarray(offsets), jnp.asarray(row_lens), width
+    )
+    return decode_updates_v2(buf, jnp.asarray(lens), spans, **kw)
 
 
 # --- vectorized varint helpers ----------------------------------------------

@@ -48,6 +48,7 @@ __all__ = [
     "apply_update_stream_fused",
     "xla_chunk_step",
     "replay_chunk_program",
+    "replay_chunk_program_raw",
     "PackedReplayDriver",
     "ReplayChunkStats",
     "replay_stream_fused",
@@ -1112,6 +1113,62 @@ def _chunk_readout(meta, err):
     )
 
 
+def _chunk_core(
+    cols,
+    meta,
+    err,
+    buf,
+    lens,
+    refs,
+    rank,
+    *,
+    lane: str,
+    max_rows: int,
+    max_dels: int,
+    n_steps: int,
+    max_sections: int,
+    d_block: int,
+    interpret: bool,
+    vmem_mb: int,
+):
+    """Traceable body shared by `replay_chunk_program` (host-packed
+    ``[S, L]`` lanes) and `replay_chunk_program_raw` (device-gathered
+    lanes): device decode (`decode_updates_v1` body) → global unit-ref
+    rebase (`refs`, -1 = keep the decoded in-chunk ref) → integrate
+    (fused Pallas tile or the packed-XLA scan) → `[3]` readout."""
+    from ytpu.ops.decode_kernel import FLAG_ERRORS, _decode_updates_v1_impl
+
+    stream, flags = _decode_updates_v1_impl(
+        buf,
+        lens,
+        max_rows=max_rows,
+        max_dels=max_dels,
+        n_steps=n_steps,
+        max_sections=max_sections,
+    )
+    stream = stream._replace(
+        content_ref=jnp.where(refs >= 0, refs, stream.content_ref)
+    )
+    err = err | jax.lax.reduce(
+        flags & FLAG_ERRORS, np.int32(0), jax.lax.bitwise_or, (0,)
+    )
+    if lane == "fused":
+        rows, dels = pack_stream(stream)
+        cols, meta = _run_body(
+            cols, meta, (rows, dels, rank), d_block, interpret, 3, 4, vmem_mb
+        )
+    else:
+        from ytpu.models.batch_doc import apply_update_stream_raw
+
+        state = unpack_state(cols, meta, None)
+        state = apply_update_stream_raw(state, stream, rank)
+        cols, meta = pack_state(state)
+    readout = jnp.stack(
+        [jnp.max(meta[:, M_NBLOCKS]), jnp.max(meta[:, M_ERROR]), err]
+    )
+    return cols, meta, err, readout
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -1157,38 +1214,101 @@ def replay_chunk_program(
     already integrate as no-ops (the decoder zeroes their valid masks),
     so the host materializes nothing in steady state. `donate_argnums`
     on cols/meta lets XLA update the ~NC·D·C state in place instead of
-    copying it every chunk."""
-    from ytpu.ops.decode_kernel import FLAG_ERRORS, _decode_updates_v1_impl
+    copying it every chunk.
 
-    stream, flags = _decode_updates_v1_impl(
+    This is the HOST-PACKED lane: staging built the `[S, L]` matrix with
+    `pack_updates_into` (per-update Python packing). The raw ingest lane
+    (`replay_chunk_program_raw`) moves that packing on device too; this
+    program stays as the fallback/checkpoint rung of the PR-6 ladder."""
+    return _chunk_core(
+        cols,
+        meta,
+        err,
         buf,
         lens,
+        refs,
+        rank,
+        lane=lane,
         max_rows=max_rows,
         max_dels=max_dels,
         n_steps=n_steps,
         max_sections=max_sections,
+        d_block=d_block,
+        interpret=interpret,
+        vmem_mb=vmem_mb,
     )
-    stream = stream._replace(
-        content_ref=jnp.where(refs >= 0, refs, stream.content_ref)
-    )
-    err = err | jax.lax.reduce(
-        flags & FLAG_ERRORS, np.int32(0), jax.lax.bitwise_or, (0,)
-    )
-    if lane == "fused":
-        rows, dels = pack_stream(stream)
-        cols, meta = _run_body(
-            cols, meta, (rows, dels, rank), d_block, interpret, 3, 4, vmem_mb
-        )
-    else:
-        from ytpu.models.batch_doc import apply_update_stream_raw
 
-        state = unpack_state(cols, meta, None)
-        state = apply_update_stream_raw(state, stream, rank)
-        cols, meta = pack_state(state)
-    readout = jnp.stack(
-        [jnp.max(meta[:, M_NBLOCKS]), jnp.max(meta[:, M_ERROR]), err]
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "width",
+        "lane",
+        "max_rows",
+        "max_dels",
+        "n_steps",
+        "max_sections",
+        "d_block",
+        "interpret",
+        "vmem_mb",
+    ),
+    donate_argnums=(0, 1, 2),
+)
+def replay_chunk_program_raw(
+    cols,
+    meta,
+    err,
+    raw,
+    offs,
+    lens,
+    refs,
+    rank,
+    *,
+    width: int,
+    lane: str,
+    max_rows: int,
+    max_dels: int,
+    n_steps: int,
+    max_sections: int,
+    d_block: int,
+    interpret: bool,
+    vmem_mb: int,
+):
+    """One replay chunk straight from RAW CONCATENATED wire bytes plus a
+    tiny per-update offsets table (ISSUE-7 tentpole): the device gathers
+    each update's byte lane out of the flat arena
+    (`decode_kernel.gather_raw_lanes` — the Stream-VByte control/data
+    split: offsets are the control stream, the byte arena the data
+    stream), then runs the same lane-parallel varint decode → unit-ref
+    rebase → integrate → readout as `replay_chunk_program`.
+
+    What this buys over the host-packed program: staging collapses to a
+    memcpy (one slice copy + two vectorized table writes, no per-update
+    Python), and the h2d transfer shrinks from ``S·L`` padded bytes to
+    the actual wire bytes + ``2·S`` table words — so pipeline depth > 2
+    is essentially free and `replay.overlap_ratio` → 1.0. The gather's
+    zero mask makes the on-device lane matrix byte-identical to a
+    host-packed one, so raw-vs-packed byte parity is structural."""
+    from ytpu.ops.decode_kernel import gather_raw_lanes
+
+    buf = gather_raw_lanes(raw, offs, lens, width)
+    return _chunk_core(
+        cols,
+        meta,
+        err,
+        buf,
+        lens,
+        refs,
+        rank,
+        lane=lane,
+        max_rows=max_rows,
+        max_dels=max_dels,
+        n_steps=n_steps,
+        max_sections=max_sections,
+        d_block=d_block,
+        interpret=interpret,
+        vmem_mb=vmem_mb,
     )
-    return cols, meta, err, readout
 
 
 @lru_cache(maxsize=1)
@@ -1682,6 +1802,67 @@ class PackedReplayDriver:
         if self.sync_every_chunk:
             self._drain_readouts()
 
+    def _step_one_dispatch(self, stage, host_arrays, margin, span_tail,
+                           program, **program_kw):
+        """Shared mechanics of the one-dispatch byte lanes (`step_bytes`
+        / `step_raw`): progbudget tick, pre-chunk room check, the
+        zero-copy-backend host copy, h2d accounting, the lane-laddered
+        dispatch, and the readout/occupancy-bound epilogue — one copy,
+        so a fix to any of them (e.g. the `_transfer_aliases_host` race
+        guard) can never reach one lane and miss the other. The program
+        is called as ``program(cols, meta, err, *device_arrays, rank,
+        lane=..., ...program_kw..., d_block/interpret/vmem_mb)``;
+        `span_tail` extends the phases span key with the lane-specific
+        shape statics. Returns the device input arrays (the caller's
+        slot-reuse gate)."""
+        from ytpu.utils import progbudget
+        from ytpu.utils.phases import NULL_SPAN, phases as _phases
+
+        progbudget.tick()
+        self.ensure_room(margin)
+        vmem_mb = int(os.environ.get("YTPU_FUSED_VMEM_MB", "64"))
+        if _transfer_aliases_host():
+            host_arrays = tuple(a.copy() for a in host_arrays)
+        dev = tuple(jnp.asarray(a) for a in host_arrays)
+        if _phases.enabled:
+            _phases.transfer(
+                stage,
+                sum(a.size * a.dtype.itemsize for a in dev),
+                "h2d",
+            )
+
+        def dispatch(lane):
+            span = (
+                _phases.span(
+                    stage,
+                    (self.cols.shape, *span_tail, lane, self.d_block,
+                     vmem_mb),
+                )
+                if _phases.enabled
+                else NULL_SPAN
+            )
+            with span:
+                return program(
+                    self.cols,
+                    self.meta,
+                    self._err,
+                    *dev,
+                    self.rank,
+                    lane=lane,
+                    d_block=self.d_block,
+                    interpret=self.interpret,
+                    vmem_mb=vmem_mb,
+                    **program_kw,
+                )
+
+        self.cols, self.meta, self._err, readout = self._dispatch(dispatch)
+        self._pending.append(readout)
+        self._hi_bound += margin
+        self.stats.chunks += 1
+        if self.sync_every_chunk:
+            self._drain_readouts()
+        return dev
+
     def step_bytes(self, buf, lens, refs, dims, margin: int):
         """Integrate one chunk straight from padded wire bytes: decode →
         unit-ref rebase → integrate → readout as ONE dispatch
@@ -1700,63 +1881,42 @@ class PackedReplayDriver:
         "transfer" is zero-copy (CPU jax aliases the numpy buffer), the
         arrays are copied host-side first so a re-packed slot can never
         race the program still reading it."""
-        from ytpu.utils import progbudget
-        from ytpu.utils.phases import NULL_SPAN, phases as _phases
-
-        progbudget.tick()
-        self.ensure_room(margin)
-        vmem_mb = int(os.environ.get("YTPU_FUSED_VMEM_MB", "64"))
-        if _transfer_aliases_host():
-            buf, lens, refs = buf.copy(), lens.copy(), refs.copy()
-        d_buf = jnp.asarray(buf)
-        d_lens = jnp.asarray(lens)
-        d_refs = jnp.asarray(refs)
-        if _phases.enabled:
-            _phases.transfer(
-                "replay.chunk_async",
-                d_buf.size * d_buf.dtype.itemsize
-                + d_lens.size * d_lens.dtype.itemsize
-                + d_refs.size * d_refs.dtype.itemsize,
-                "h2d",
-            )
         max_rows, max_dels, n_steps, max_sections = dims
+        return self._step_one_dispatch(
+            "replay.chunk_async",
+            (buf, lens, refs),
+            margin,
+            (buf.shape, refs.shape, tuple(dims)),
+            replay_chunk_program,
+            max_rows=max_rows,
+            max_dels=max_dels,
+            n_steps=n_steps,
+            max_sections=max_sections,
+        )
 
-        def dispatch(lane):
-            span = (
-                _phases.span(
-                    "replay.chunk_async",
-                    (self.cols.shape, d_buf.shape, d_refs.shape,
-                     tuple(dims), lane, self.d_block, vmem_mb),
-                )
-                if _phases.enabled
-                else NULL_SPAN
-            )
-            with span:
-                return replay_chunk_program(
-                    self.cols,
-                    self.meta,
-                    self._err,
-                    d_buf,
-                    d_lens,
-                    d_refs,
-                    self.rank,
-                    lane=lane,
-                    max_rows=max_rows,
-                    max_dels=max_dels,
-                    n_steps=n_steps,
-                    max_sections=max_sections,
-                    d_block=self.d_block,
-                    interpret=self.interpret,
-                    vmem_mb=vmem_mb,
-                )
-
-        self.cols, self.meta, self._err, readout = self._dispatch(dispatch)
-        self._pending.append(readout)
-        self._hi_bound += margin
-        self.stats.chunks += 1
-        if self.sync_every_chunk:
-            self._drain_readouts()
-        return d_buf, d_lens, d_refs
+    def step_raw(self, raw, offs, lens, refs, dims, width: int, margin: int):
+        """Integrate one chunk straight from RAW CONCATENATED wire bytes
+        + a per-update offsets table: device lane-gather → decode →
+        unit-ref rebase → integrate → readout as ONE dispatch
+        (`replay_chunk_program_raw`, donated state) — the raw ingest
+        lane whose host staging is a memcpy (ISSUE-7). ``width`` is the
+        static per-lane window (the host-packed lane's ``pad_to``), the
+        other arguments mirror `step_bytes`, including the returned
+        device inputs for the caller's slot-reuse gate and the
+        zero-copy-backend host copy."""
+        max_rows, max_dels, n_steps, max_sections = dims
+        return self._step_one_dispatch(
+            "replay.chunk_raw",
+            (raw, offs, lens, refs),
+            margin,
+            (raw.shape, refs.shape, tuple(dims), width),
+            replay_chunk_program_raw,
+            width=width,
+            max_rows=max_rows,
+            max_dels=max_dels,
+            n_steps=n_steps,
+            max_sections=max_sections,
+        )
 
     def finish(self):
         """Drain every pending readout (surfacing sticky errors) and
@@ -1859,10 +2019,12 @@ def _register_programs():
     from ytpu.utils import progbudget
 
     progbudget.register("fused_run", _run)
-    # the chunk program (fused decode+rebase+integrate) is now the
-    # largest executable in the process — one per (chunk, width, refs,
-    # state) shape family; it must ride the same bounded-arena budget
+    # the chunk programs (fused decode+rebase+integrate, host-packed and
+    # raw-gather variants) are the largest executables in the process —
+    # one per (chunk, width, refs, state) shape family; they must ride
+    # the same bounded-arena budget
     progbudget.register("replay_chunk_program", replay_chunk_program)
+    progbudget.register("replay_chunk_program_raw", replay_chunk_program_raw)
 
 
 _register_programs()

@@ -40,13 +40,20 @@ call site passes (e.g. ``lane``) must match for the pass to be eligible.
 Standard sites (see docs/robustness.md for the full taxonomy):
 
 ====================  =======================================================
-``update.corrupt``    truncate/flip one staged update's wire bytes
+``update.corrupt``    truncate/flip one staged update's wire bytes — fires
+                      on BOTH ingest lanes: per-chunk in the host-packed
+                      staging, and at the wire-table build of the raw
+                      lane (same once-per-update stream order, so an
+                      ``after=k`` spec poisons the same update either way;
+                      on-device varint decode flags the corrupt lane)
 ``dispatch.fail``     raise before a device chunk dispatch (args: ``lane``,
                       ``kill``)
 ``replay.kill``       raise after a chunk dispatch with state treated as
                       lost (mid-replay worker death → checkpoint resume)
 ``stage.raise``       raise inside the overlap staging thread (args:
-                      ``prefix`` = OverlapPipeline stage_prefix)
+                      ``prefix`` = OverlapPipeline stage_prefix; covers
+                      the raw memcpy staging and the packed staging alike
+                      — the site lives in the shared engine's worker)
 ``grow.oom``          raise in place of `grow_packed` (device OOM)
 ``net.drop``          swallow one outbound frame
 ``net.truncate``      write a frame header + half the payload (stalls the

@@ -166,6 +166,22 @@ class PhaseRecorder:
                 st = self._stages[stage] = _Stage()
             st.value = float(value)
 
+    def add_value(self, stage: str, delta: float) -> None:
+        """Accumulate a scalar gauge (snapshot key "value") — e.g.
+        ``replay.stage_bytes``, the raw-ingest lane's total staged
+        payload bytes. Unlike `transfer` this counts HOST-side copy
+        volume (staging is a host memcpy, not an h2d transfer — the
+        chunk programs count their own h2d bytes), and unlike
+        `set_value` it survives multi-run accumulation (a checkpoint
+        resume re-enters the overlap loop)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            st = self._stages.get(stage)
+            if st is None:
+                st = self._stages[stage] = _Stage()
+            st.value = (st.value or 0.0) + float(delta)
+
     def set_max(self, stage: str, value: float) -> None:
         """Ratchet a scalar gauge upward (high-water depth tracking)."""
         if not self.enabled:
