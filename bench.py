@@ -969,6 +969,165 @@ def chaos_smoke() -> dict:
     return {"classes": classes, "recovered": True, **delta}
 
 
+def soak_dry_run() -> dict:
+    """CPU rehearsal of the multi-tenant serving soak (ISSUE-9): the
+    acceptance surface for the serving subsystem, asserted end to end —
+
+    - **scenario determinism**: the same seeded config generates the
+      byte-identical event schedule twice (digest equality), and two
+      full soak RUNS of it land byte-equal final tenant states;
+    - **failover parity**: a run that takes a mid-soak checkpoint →
+      restore AND a live tenant→slot rebalance lands the same
+      state digest as the clean run;
+    - **admission control**: a queue-bounded run answers overload with
+      protocol-level Busy replies (counters prove it) and — under the
+      defer policy — still converges to the clean run's state;
+    - **SLO fields**: sustained updates/s plus p50/p99 apply latency
+      from the `sync.apply_update` series, raw AND with the per-run
+      idle-echo RTT floor subtracted (docs/serving.md §SLOs).
+
+    The first (warmup) run eats the one-time XLA compiles so the scored
+    runs' percentiles describe serving, not tracing."""
+    from ytpu.serving import (
+        AdmissionController,
+        Scenario,
+        ScenarioConfig,
+        SoakDriver,
+    )
+    from ytpu.sync.device_server import DeviceSyncServer
+
+    cfg = ScenarioConfig(
+        n_tenants=3,
+        n_sessions=8,
+        events_per_session=8,
+        seed=int(os.environ.get("YTPU_BENCH_SOAK_SEED", "5")),
+    )
+    assert Scenario(cfg).digest() == Scenario(cfg).digest(), (
+        "scenario generation is not deterministic"
+    )
+
+    def fresh():
+        return DeviceSyncServer(n_docs=4, capacity=256)
+
+    warm = SoakDriver(fresh(), Scenario(cfg), flush_every=4).run()
+    clean = SoakDriver(fresh(), Scenario(cfg), flush_every=4).run()
+    assert clean["state_digest"] == warm["state_digest"], (
+        "same-seed soak replay diverged"
+    )
+    assert clean["complete"] and clean.get("mirror_parity", True), clean
+    churn = SoakDriver(
+        fresh(),
+        Scenario(cfg),
+        flush_every=4,
+        checkpoint_at=0.45,
+        rebalance_at=0.7,
+    ).run()
+    assert churn.get("checkpoints", 0) >= 1, churn
+    assert churn.get("rebalances", 0) >= 1, churn
+    assert churn.get("rebalance_parity_failures", 0) == 0, churn
+    assert churn["state_digest"] == clean["state_digest"], (
+        "checkpoint/restore + rebalance broke byte parity"
+    )
+    busy = SoakDriver(
+        fresh(),
+        Scenario(cfg),
+        admission=AdmissionController(max_queue=2, policy="defer"),
+        flush_every=64,
+    ).run()
+    assert busy.get("busy_replies", 0) >= 1, busy
+    assert busy["admission"]["rejected_queue_full"] >= 1, busy
+    assert busy["state_digest"] == clean["state_digest"], (
+        "Busy-deferred updates failed to converge"
+    )
+    return {
+        "updates_per_s": clean["updates_per_s"],
+        "events": clean.get("events", 0),
+        "sessions": clean.get("sessions", 0),
+        "reconnects": clean.get("reconnects", 0),
+        "broadcast_frames": clean.get("broadcast_frames", 0),
+        "rtt_floor_ms": clean["rtt_floor_ms"],
+        **{
+            k: clean[k]
+            for k in (
+                "apply_p50_ms",
+                "apply_p99_ms",
+                "apply_p50_ms_adj",
+                "apply_p99_ms_adj",
+                "diff_p50_ms",
+                "diff_p99_ms",
+            )
+        },
+        "checkpoints": churn["checkpoints"],
+        "rebalances": churn["rebalances"],
+        "failover_parity": True,
+        "replay_determinism": True,
+        "busy_replies": busy["busy_replies"],
+        "busy_retries": busy.get("busy_retries", 0),
+        "admission": busy["admission"],
+        "admission_parity": True,
+        "scenario_digest": clean["scenario_digest"],
+        "state_digest": clean["state_digest"],
+    }
+
+
+def _soak_phase(budget_s: float) -> dict:
+    """Device-phase soak (ISSUE-9): multi-round sustained traffic against
+    a DeviceSyncServer for `budget_s` wall seconds, with one mid-soak
+    checkpoint/restore and one live rebalance in round 0.  Emits the
+    serving SLO headline (`soak_updates_per_s`, p50/p99 raw + RTT-floor-
+    subtracted) next to the replay-shaped flagship numbers."""
+    from ytpu.serving import Scenario, ScenarioConfig, SoakDriver
+    from ytpu.sync.device_server import DeviceSyncServer
+
+    cfg = ScenarioConfig(
+        n_tenants=int(os.environ.get("YTPU_BENCH_SOAK_TENANTS", "6")),
+        n_sessions=int(os.environ.get("YTPU_BENCH_SOAK_SESSIONS", "24")),
+        events_per_session=int(
+            os.environ.get("YTPU_BENCH_SOAK_EVENTS", "16")
+        ),
+        seed=9,
+    )
+    server = DeviceSyncServer(n_docs=8, capacity=512)
+    rep = SoakDriver(
+        server,
+        Scenario(cfg),
+        flush_every=8,
+        checkpoint_at=0.5,
+        rebalance_at=0.75,
+        budget_s=budget_s,
+        rounds=10_000,  # budget-bound, not count-bound
+    ).run()
+    out = {
+        "soak_updates_per_s": rep["updates_per_s"],
+        "soak_p50_ms": rep["apply_p50_ms"],
+        "soak_p99_ms": rep["apply_p99_ms"],
+        "soak_p50_ms_adj": rep["apply_p50_ms_adj"],
+        "soak_p99_ms_adj": rep["apply_p99_ms_adj"],
+        "soak": {
+            k: rep[k]
+            for k in (
+                "rounds",
+                "events",
+                "applied",
+                "rtt_floor_ms",
+                "checkpoints",
+                "rebalances",
+                "reconnects",
+                "wall_s",
+                "diff_p50_ms",
+                "diff_p99_ms",
+                "state_digest",
+            )
+            if k in rep
+        },
+    }
+    if rep.get("rebalance_parity_failures"):
+        out["soak"]["rebalance_parity_failures"] = rep[
+            "rebalance_parity_failures"
+        ]
+    return out
+
+
 def _device_configs(result: dict, flush) -> None:
     """North-star configs #3-#5 (benches/device.py), run inside the same
     child so their compile/measure cost shares the single device budget.
@@ -1098,6 +1257,17 @@ def _device_phase_child(in_path: str, out_path: str) -> None:
         result.update(device_step_latency(job["log"]))
     except Exception as e:
         result["latency_error"] = f"{type(e).__name__}: {e}"[:300]
+    flush()
+    phase_gc()
+    try:
+        # multi-tenant serving soak (ISSUE-9): sustained session traffic
+        # with mid-soak checkpoint/restore + live rebalance — the serving
+        # SLO counterpart to the replay-shaped flagship above
+        result.update(
+            _soak_phase(float(os.environ.get("YTPU_BENCH_SOAK_S", "45")))
+        )
+    except Exception as e:
+        result["soak_error"] = f"{type(e).__name__}: {e}"[:300]
     flush()
     phase_gc()
     _device_configs(result, flush)
@@ -1498,6 +1668,19 @@ def main(dry_run: bool = False):
         # snapshot below, the acceptance surface
         with phases.span("host.chaos_smoke"):
             out["chaos"] = chaos_smoke()
+        # serving soak rehearsal (ISSUE-9): deterministic scenario replay,
+        # checkpoint/restore + live-rebalance byte parity, admission Busy
+        # counters, and the SLO headline fields (raw + RTT-floor-adjusted)
+        with phases.span("host.soak_rehearsal"):
+            out["soak"] = soak_dry_run()
+        out["soak_updates_per_s"] = out["soak"]["updates_per_s"]
+        for k in (
+            "soak_p50_ms",
+            "soak_p99_ms",
+            "soak_p50_ms_adj",
+            "soak_p99_ms_adj",
+        ):
+            out[k] = out["soak"][k.replace("soak_", "apply_")]
         out["phases"] = phases.snapshot()
         out["metrics"] = metrics.snapshot()
         print(json.dumps(out))
@@ -1547,6 +1730,18 @@ def main(dry_run: bool = False):
                 out[k] = res[k]
         if "latency_error" in res:
             out["latency_error"] = res["latency_error"]
+        for k in (
+            "soak",
+            "soak_updates_per_s",
+            "soak_p50_ms",
+            "soak_p99_ms",
+            "soak_p50_ms_adj",
+            "soak_p99_ms_adj",
+        ):
+            if k in res:
+                out[k] = res[k]
+        if "soak_error" in res:
+            out["soak_error"] = res["soak_error"]
         if "sp" in res:
             out["sp"] = res["sp"]
         if "sp_error" in res:
@@ -1679,6 +1874,7 @@ def main(dry_run: bool = False):
             "fused_vs_xla_prefix",
             "flagship_overlap_speedup_post_pr5",
             "flagship_raw_ingest_uplift_pr7",
+            "soak_slo_pr9",
         ]
     # where the time went: child device stages (decode/integrate/compact,
     # compile vs execute vs transfer bytes) + parent host stages, and a

@@ -122,6 +122,7 @@ def save_ingestor(path: str, ing: BatchIngestor, extra: Optional[dict] = None) -
         "format": _FORMAT,
         "enc": _enc_sidecar(ing.enc),
         "n_docs": ing.n_docs,
+        "ingest": ing.ingest,
         "svs": [dict(sv.clocks) for sv in ing.svs],
         "pending": [
             {c: list(q) for c, q in stash.items()} for stash in ing._pending
@@ -160,6 +161,9 @@ def load_ingestor_with_extra(path: str) -> Tuple[BatchIngestor, dict]:
     ing = BatchIngestor.__new__(BatchIngestor)
     ing.enc = _enc_restore(side["enc"])
     ing.n_docs = side["n_docs"]
+    # pre-PR-9 checkpoints predate the fast-lane wire-shipping knob;
+    # they restore onto the current default
+    ing.ingest = side.get("ingest", "raw")
     ing.state = state
     ing.svs = [StateVector(dict(c)) for c in side["svs"]]
     ing._pending = [dict(p) for p in side["pending"]]

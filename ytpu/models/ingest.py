@@ -76,9 +76,23 @@ class BatchIngestor:
         n_docs: int,
         capacity: int,
         enc: Optional[BatchEncoder] = None,
+        ingest: str = "raw",
     ):
+        if ingest not in ("raw", "packed"):
+            raise ValueError(f"ingest must be 'raw' or 'packed', got {ingest!r}")
         self.enc = enc or BatchEncoder()
         self.n_docs = n_docs
+        #: fast-lane wire shipping (ISSUE-9 satellite, ROADMAP item 2):
+        #: ``"raw"`` (default) ships the eligible docs' updates as ONE
+        #: flat concatenated byte arena + a tiny offsets table and
+        #: materializes the padded lane matrix ON DEVICE
+        #: (`decode_kernel.gather_raw_lanes` — h2d shrinks from padded
+        #: S·L to the actual wire bytes); ``"packed"`` keeps the
+        #: host-padded `pack_updates` matrix.  The gather zero-masks
+        #: past each lane's length, so the two paths feed the decoder
+        #: BYTE-IDENTICAL matrices — parity is structural
+        #: (tests/test_serving_soak.py asserts it end to end).
+        self.ingest = ingest
         self.state: DocStateBatch = init_state(n_docs, capacity)
         self.svs: List[StateVector] = [StateVector() for _ in range(n_docs)]
         # per-doc stash: carriers waiting for dependencies + deferred deletes
@@ -644,15 +658,51 @@ class BatchIngestor:
         )
 
         maxlen = max(len(p) for p in fast_payloads)
-        buf, lens = pack_updates(fast_payloads, pad_to=_bucket(maxlen + 16, 64))
-        S, L = buf.shape
         from ytpu.utils.phases import phases
 
-        if phases.enabled:
-            # padded wire matrix shipped to HBM (the fast lane's only
-            # host→device payload; decode.v1 counts it again at the jit
-            # boundary — this stage attributes it to ingest)
-            phases.transfer("ingest.fast_lane", buf.nbytes + lens.nbytes, "h2d")
+        if self.ingest == "raw":
+            # RAW lane: ship the actual wire bytes + offsets, gather the
+            # padded [S, L] matrix on device (byte-identical to the
+            # packed matrix — gather_raw_lanes zero-masks past lens)
+            from ytpu.ops.decode_kernel import gather_raw_lanes
+
+            S = len(fast_payloads)
+            L = _bucket(maxlen + 16, 64)
+            lens = np.asarray(
+                [len(p) for p in fast_payloads], dtype=np.int32
+            )
+            offsets = np.zeros(S, dtype=np.int32)
+            if S > 1:
+                offsets[1:] = np.cumsum(lens[:-1])
+            flat = b"".join(fast_payloads)
+            # the gather specializes on the arena LENGTH: pad it to a
+            # bucket so a long soak's ever-varying flush sizes reuse a
+            # handful of compiled gathers (the zero tail is masked out,
+            # exactly like the padded matrix's row tails)
+            wire = np.zeros(_bucket(len(flat), 256), dtype=np.uint8)
+            wire[: len(flat)] = np.frombuffer(flat, dtype=np.uint8)
+            if phases.enabled:
+                phases.transfer(
+                    "ingest.fast_lane",
+                    wire.nbytes + offsets.nbytes + lens.nbytes,
+                    "h2d",
+                )
+            dev_buf = gather_raw_lanes(
+                jnp.asarray(wire), jnp.asarray(offsets), jnp.asarray(lens), L
+            )
+        else:
+            buf, lens = pack_updates(
+                fast_payloads, pad_to=_bucket(maxlen + 16, 64)
+            )
+            S, L = buf.shape
+            if phases.enabled:
+                # padded wire matrix shipped to HBM (the fast lane's only
+                # host→device payload; decode.v1 counts it again at the
+                # jit boundary — this stage attributes it to ingest)
+                phases.transfer(
+                    "ingest.fast_lane", buf.nbytes + lens.nbytes, "h2d"
+                )
+            dev_buf = jnp.asarray(buf)
         # Retain only the wire bytes of lanes that emitted string rows
         # (lens-trimmed, concatenated) — refs are rebased from the padded
         # s*L layout onto the compact one. Lanes without string rows have
@@ -681,7 +731,7 @@ class BatchIngestor:
             if name is not None:
                 prim_hash[s_i] = key_hash_host(name.encode("utf-8"))
         stream, flags = decode_updates_v1(
-            jnp.asarray(buf),
+            dev_buf,
             jnp.asarray(lens),
             n_rows,
             n_dels,

@@ -86,6 +86,7 @@ class UpdatePipeline:
         interpret: bool = False,
         policy=None,
         max_capacity: Optional[int] = None,
+        admission=None,
     ):
         if lane not in ("xla", "fused", "packed_xla"):
             raise ValueError(
@@ -104,6 +105,13 @@ class UpdatePipeline:
         self.interpret = interpret
         self.policy = policy
         self.max_capacity = max_capacity
+        #: optional `ytpu.serving.AdmissionController` (ISSUE-9): the
+        #: staging producer calls `throttle(chunk_steps)` before handing
+        #: each chunk to the overlap engine, so a rate-limited pipeline
+        #: blocks its PRODUCER instead of growing the staged backlog —
+        #: backpressure at the source, the same valve the sync servers
+        #: apply per inbound update
+        self.admission = admission
 
     def _chunks(self, payloads: Iterable[bytes]):
         """Decode + build padded micro-chunks (runs on the worker thread).
@@ -126,9 +134,13 @@ class UpdatePipeline:
                 )
                 steps.append(self.enc.build_step(u, self.n_rows, self.n_dels))
             if len(steps) == self.chunk_steps:
+                if self.admission is not None:
+                    self.admission.throttle(len(steps))
                 yield BatchEncoder.stack_steps(steps)
                 steps = []
         if steps:
+            if self.admission is not None:
+                self.admission.throttle(len(steps))
             # pad the tail chunk to the same S so one compiled program serves
             # every chunk (padding steps carry valid=False rows only)
             pad = steps[-1]._replace(

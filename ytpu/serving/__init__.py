@@ -1,0 +1,26 @@
+"""Serving subsystem (ISSUE-9): scenario traffic generation, admission
+control + backpressure, and the multi-tenant soak driver that scores the
+sync stack against SLOs (docs/serving.md)."""
+
+from .admission import (
+    AdmissionController,
+    Overload,
+    QueueFull,
+    RateLimited,
+    TokenBucket,
+)
+from .scenario import Event, Scenario, ScenarioConfig
+from .soak import SoakDriver, run_soak_tcp
+
+__all__ = [
+    "AdmissionController",
+    "Event",
+    "Overload",
+    "QueueFull",
+    "RateLimited",
+    "Scenario",
+    "ScenarioConfig",
+    "SoakDriver",
+    "TokenBucket",
+    "run_soak_tcp",
+]

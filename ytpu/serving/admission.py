@@ -1,0 +1,212 @@
+"""Admission control + backpressure for the serving path (ISSUE-9).
+
+The sync servers accept whatever arrives: a hot tenant can grow its
+device queue without bound and a reconnect storm can outrun the flush
+loop.  This module is the valve in front of `UpdatePipeline` /
+`flush_device`:
+
+- **bounded per-tenant queues** — an update whose tenant already has
+  ``max_queue`` updates waiting for the device is not enqueued;
+- **token-bucket rate limiting** — a global updates/s budget with a
+  burst allowance (deterministic given an injected clock, so tests and
+  the bench rehearsal can assert exact decisions);
+- **typed overload errors** — `QueueFull` / `RateLimited` (both
+  `Overload`) carry the tenant, the reason, and a ``retry_after_s``
+  hint, and surface to clients as protocol-level **Busy replies**
+  (`protocol.busy_message`) instead of killed sessions.
+
+Three policies decide what an overloaded update costs:
+
+============  ===============================================================
+``defer``     (default) reply Busy; the client re-sends after
+              ``retry_after_s`` — no data loss, latency absorbs the spike
+``drop``      discard the update silently (counted) — CRDT idempotence
+              means a later full resync repairs it; cheapest, lossy
+``shed``      kill the offending session (`net.sessions_dropped{reason=
+              "shed"}`) — a reconnect resyncs via the state-vector
+              handshake; sheds the *connection* cost, not just the update
+============  ===============================================================
+
+The controller is transport-agnostic: `SyncServer.receive_frames`
+consults it per inbound update (queue depth comes from the server), and
+`UpdatePipeline` calls `throttle()` from its staging producer so a bulk
+replay's staging thread blocks instead of overrunning the device
+(producer-side backpressure).
+
+Fault site (docs/robustness.md): ``admission.reject`` forces the next
+admit() to raise `QueueFull` — soak chaos runs use it to exercise the
+Busy path without actually saturating a queue.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ytpu.utils import metrics
+from ytpu.utils.faults import faults
+
+__all__ = [
+    "Overload",
+    "QueueFull",
+    "RateLimited",
+    "TokenBucket",
+    "AdmissionController",
+]
+
+_ADMITTED = metrics.counter("admission.admitted")
+_REJECTED = metrics.counter("admission.rejected", labelnames=("reason",))
+_THROTTLE_WAITS = metrics.counter("admission.throttle_waits")
+_THROTTLE_WAIT_HIST = metrics.histogram("admission.throttle_wait")
+
+
+class Overload(RuntimeError):
+    """An update the admission layer refused.  ``retry_after_s`` is the
+    hint a Busy reply carries back to the client."""
+
+    reason = "overload"
+
+    def __init__(self, tenant: str, detail: str, retry_after_s: float = 0.05):
+        super().__init__(f"{self.reason} for tenant {tenant!r}: {detail}")
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+
+
+class QueueFull(Overload):
+    reason = "queue_full"
+
+
+class RateLimited(Overload):
+    reason = "rate_limited"
+
+
+class TokenBucket:
+    """Deterministic token bucket: ``rate`` tokens/s, ``burst`` capacity.
+
+    `deficit(n)` returns 0.0 when ``n`` tokens were taken, else the
+    seconds until they would be available (tokens are NOT taken on
+    failure).  The clock is injectable so decisions are a pure function
+    of (config, clock readings).  Thread-safe: one controller is shared
+    between the server's accept loop and a pipeline's staging worker, so
+    the read-modify-write on the token count takes a lock (same rule as
+    every metric in `ytpu.utils.metrics`)."""
+
+    def __init__(
+        self,
+        rate: float,
+        burst: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0, got {rate}")
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None else rate)
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def _refill_locked(self) -> None:
+        now = self._clock()
+        self._tokens = min(
+            self.burst, self._tokens + (now - self._last) * self.rate
+        )
+        self._last = now
+
+    def deficit(self, n: float = 1.0) -> float:
+        with self._lock:
+            self._refill_locked()
+            if self._tokens >= n:
+                self._tokens -= n
+                return 0.0
+            return (n - self._tokens) / self.rate
+
+    def take_debt(self, n: float = 1.0) -> float:
+        """Consume ``n`` unconditionally (tokens may go NEGATIVE — debt)
+        and return the seconds the caller should sleep to amortize it.
+        This is the producer-throttle primitive: waiting for ``n`` whole
+        tokens can never finish when ``n > burst``, whereas debt keeps
+        long-run throughput converging to ``rate`` for any chunk size."""
+        with self._lock:
+            self._refill_locked()
+            self._tokens -= n
+            return max(0.0, -self._tokens) / self.rate
+
+
+class AdmissionController:
+    """Per-tenant queue bounds + a global token bucket, one policy.
+
+    ``max_queue``: per-tenant device-queue depth bound (None = unbounded).
+    ``rate``/``burst``: global token bucket (None = no rate limit).
+    ``policy``: "defer" | "drop" | "shed" (see module docstring).
+    ``clock``/``sleep``: injectable for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        max_queue: Optional[int] = 64,
+        rate: Optional[float] = None,
+        burst: Optional[float] = None,
+        policy: str = "defer",
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if policy not in ("defer", "drop", "shed"):
+            raise ValueError(f"policy must be defer/drop/shed, got {policy!r}")
+        self.max_queue = max_queue
+        self.policy = policy
+        self.bucket = (
+            TokenBucket(rate, burst, clock) if rate is not None else None
+        )
+        self._sleep = sleep
+
+    # --- server-side admission (per inbound update) ---------------------------
+
+    def admit(self, tenant: str, queue_depth: int = 0, n: int = 1) -> None:
+        """Admit ``n`` updates for ``tenant`` or raise a typed Overload.
+        ``queue_depth`` is the tenant's CURRENT device-queue depth (the
+        server passes it; depth shrinks via flush, so there is no
+        release() to forget)."""
+        if faults.active and faults.fire("admission.reject", tenant=tenant):
+            _REJECTED.labels("injected").inc()
+            raise QueueFull(tenant, "injected admission fault")
+        if self.max_queue is not None and queue_depth + n > self.max_queue:
+            _REJECTED.labels("queue_full").inc()
+            raise QueueFull(
+                tenant, f"queue depth {queue_depth} at bound {self.max_queue}"
+            )
+        if self.bucket is not None:
+            wait = self.bucket.deficit(n)
+            if wait > 0.0:
+                _REJECTED.labels("rate_limited").inc()
+                raise RateLimited(
+                    tenant, f"over rate {self.bucket.rate}/s", retry_after_s=wait
+                )
+        _ADMITTED.inc(n)
+
+    # --- producer-side backpressure (UpdatePipeline staging hook) -------------
+
+    def throttle(self, n: int = 1) -> float:
+        """Block the calling producer until ``n`` updates fit the rate
+        budget; returns the seconds waited.  Queue bounds don't apply —
+        a staging producer IS the queue; slowing it is the point.
+        Debt-based (`TokenBucket.take_debt`), so a chunk larger than the
+        burst sleeps proportionally instead of spinning forever."""
+        if self.bucket is None:
+            return 0.0
+        wait = self.bucket.take_debt(n)
+        if wait > 0.0:
+            _THROTTLE_WAITS.inc()
+            self._sleep(wait)
+            _THROTTLE_WAIT_HIST.observe(wait)
+        return wait
+
+    # --- reply rendering ------------------------------------------------------
+
+    @staticmethod
+    def busy_reply(exc: Overload) -> bytes:
+        """The encoded protocol-level Busy frame for one Overload."""
+        from ytpu.sync.protocol import busy_message
+
+        return busy_message(exc.reason, exc.retry_after_s).encode_v1()

@@ -127,14 +127,19 @@ class DeviceSyncServer(SyncServer):
         if first_touch:
             # reserve the slot FIRST: exhaustion must fail before the tenant
             # registers, or retries would create an unmirrored ghost tenant
-            slot = self._assign_slot(name)
+            self._assign_slot(name)
         t = super().tenant(name)
         if first_touch and not self.device_authoritative:
             # mirrored mode: shadow every host apply into the device queue
             # (device-authoritative tenants queue in receive_frames and
-            # never touch the host doc)
-            def mirror(payload: bytes, origin, txn, _slot=slot):
-                self._queues[_slot].append(payload)
+            # never touch the host doc).  The slot is resolved per event,
+            # not captured — a live rebalance moves the tenant's slot out
+            # from under this observer (ISSUE-9); a demoted host-resident
+            # tenant has no slot and mirrors nothing
+            def mirror(payload: bytes, origin, txn, _name=name):
+                slot = self._slot_of.get(_name)
+                if slot is not None:
+                    self._queues[slot].append(payload)
 
             t.awareness.doc.observe_update_v1(mirror)
         return t
@@ -182,6 +187,7 @@ class DeviceSyncServer(SyncServer):
                 tenant=session.tenant,
                 session=session.id,
             )
+            self._dropped.labels("bad_frame").inc()
             session.dead = True
             session.outbox = []
             self.disconnect(session)
@@ -205,6 +211,13 @@ class DeviceSyncServer(SyncServer):
                         Message.sync(SyncMessage.step2(diff)).encode_v1()
                     )
                 else:  # SyncStep2 / Update: straight to the device slot
+                    ok, busy = self._admit_update(session)
+                    if not ok:
+                        if busy is not None:
+                            replies.append(busy)
+                        if session.dead:
+                            break  # shed
+                        continue
                     # record the tenant's root names (the first becomes the
                     # wire primary); non-primary roots stay device-resident
                     # via the ingestor's BLOCK_ROOT_ANCHOR rows — multi-root
@@ -294,6 +307,81 @@ class DeviceSyncServer(SyncServer):
         self._slots_gauge.set(len(self._slot_of))
         self.ingestor.reset_slot(slot)
         self._free_slots.append(slot)
+
+    def _tenant_queue_depth(self, tenant_name: str) -> int:
+        """Admission input (ISSUE-9): this tenant's pending device-queue
+        depth (0 for unassigned/host tenants — nothing device-bound)."""
+        slot = self._slot_of.get(tenant_name)
+        return 0 if slot is None else len(self._queues[slot])
+
+    def rebalance_tenant(
+        self, tenant_name: str, to_slot: Optional[int] = None
+    ) -> int:
+        """Move a tenant to a different device slot LIVE (ISSUE-9): the
+        mid-soak rebalance a real multi-tenant pod performs when one
+        batch slot runs hot.  Returns the new slot.
+
+        Parity-safe by construction: the tenant's full device state
+        (pending stash folded in, exactly `device_encode_diff` vs the
+        empty state vector) re-ingests into the fresh slot as one wire
+        update, whose host planning rebuilds the slot's SV mirror — so
+        the move rides the same exactness contract as any other update.
+        Mirrored tenants re-ingest from the authoritative host doc
+        instead.  Sessions stay connected (slot identity is server
+        internal); queued updates flush first so nothing is re-homed
+        mid-queue."""
+        from ytpu.utils import metrics
+
+        old = self.slot_of(tenant_name)
+        if tenant_name in self._host_tenants:
+            raise ValueError(f"tenant {tenant_name!r} is host-resident")
+        self.flush_device()
+        if self.device_authoritative:
+            payload = self.device_encode_diff(tenant_name, StateVector())
+        else:
+            payload = self.doc(tenant_name).encode_state_as_update_v1()
+        # allocate the destination BEFORE releasing the source: a full
+        # batch must fail the rebalance, not strand the tenant slotless
+        if to_slot is None:
+            if self._free_slots:
+                to_slot = self._free_slots.pop()
+            elif self._next_slot < self.ingestor.n_docs:
+                to_slot = self._next_slot
+                self._next_slot += 1
+            else:
+                raise DeviceBatchFull(
+                    "no free slot to rebalance into "
+                    f"({self.ingestor.n_docs} tenant slots)"
+                )
+        else:
+            if not 0 <= to_slot < self.ingestor.n_docs:
+                raise ValueError(
+                    f"slot {to_slot} out of range "
+                    f"({self.ingestor.n_docs} tenant slots)"
+                )
+            if any(
+                t != tenant_name and s == to_slot
+                for t, s in self._slot_of.items()
+            ):
+                raise ValueError(f"slot {to_slot} is already assigned")
+            # claim the explicit destination out of the allocator so a
+            # later _assign_slot can never hand it to a second tenant:
+            # pull it from the free list, or — when it lies beyond the
+            # allocation frontier — advance the frontier past it,
+            # freeing the slots skipped over
+            if to_slot in self._free_slots:
+                self._free_slots.remove(to_slot)
+            elif to_slot >= self._next_slot:
+                self._free_slots.extend(range(self._next_slot, to_slot))
+                self._next_slot = to_slot + 1
+        self.ingestor.reset_slot(old)
+        if old != to_slot:
+            self._free_slots.append(old)
+        self._slot_of[tenant_name] = to_slot
+        self._queues[to_slot].append(payload)
+        self.flush_device()
+        metrics.counter("sync.rebalances").inc()
+        return to_slot
 
     def tenant_state_vector(self, tenant_name: str) -> StateVector:
         if not self.device_authoritative or tenant_name in self._host_tenants:

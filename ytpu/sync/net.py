@@ -51,6 +51,17 @@ _FRAME_TIMEOUTS = metrics.counter("net.frame_timeouts")
 _BAD_FRAMES = metrics.counter("net.bad_frames")
 _CONNECT_RETRIES = metrics.counter("net.connect_retries")
 _RECONNECTS = metrics.counter("net.reconnects")
+# per-session serving series (ISSUE-9): how many sessions are live right
+# now, and — when one drops — WHY, so soak shed decisions are
+# attributable from the one-line bench JSON (reasons: "bad_frame" for
+# frames that failed to parse/apply, "timeout" for mid-frame stalls,
+# "disconnect" for abortive transport closes that sent no bad frame,
+# "shed" from admission/slow-consumer eviction in sync/server,
+# "update_drop" for policy=drop refusals that keep the session)
+_SESSIONS_ACTIVE = metrics.gauge("net.sessions_active")
+_SESSIONS_DROPPED = metrics.counter(
+    "net.sessions_dropped", labelnames=("reason",)
+)
 
 
 class FrameTimeout(ConnectionError):
@@ -222,6 +233,7 @@ async def serve(
                 session, greeting = server.connect_frames(tenant)
             except DeviceBatchFull:
                 return  # capacity: reject quietly
+            _SESSIONS_ACTIVE.inc()
             for frame in greeting:
                 write_frame(writer, frame)
             await writer.drain()
@@ -241,6 +253,7 @@ async def serve(
                     except _PEER_ERRORS:
                         # malformed frame: this session's problem only
                         _BAD_FRAMES.inc()
+                        _SESSIONS_DROPPED.labels("bad_frame").inc()
                         break
                     except Exception as e:
                         # a server-side bug triggered by one frame must
@@ -249,6 +262,7 @@ async def serve(
                         # the accept loop lives — and the flight
                         # recorder keeps what threw (bounded ring)
                         _BAD_FRAMES.inc()
+                        _SESSIONS_DROPPED.labels("bad_frame").inc()
                         tracer.instant(
                             "net.bad_frame",
                             error=repr(e),
@@ -267,11 +281,23 @@ async def serve(
                 await writer.drain()
                 if session.dead:
                     break  # slow consumer: evicted by Session.push
+        except FrameTimeout:
+            # mid-frame stall past the deadline: attributable separately
+            # from peer garbage (FrameTimeout IS a ConnectionError, so it
+            # must be caught before the generic peer-error band)
+            if session is not None:
+                _SESSIONS_DROPPED.labels("timeout").inc()
         except _PEER_ERRORS:
-            pass
+            # this band is mostly abortive transport closes (RST, EOF
+            # inside a header) — a real malformed FRAME is counted
+            # bad_frame at the receive loop above; conflating the two
+            # would mis-attribute plain peer deaths in a churny soak
+            if session is not None:
+                _SESSIONS_DROPPED.labels("disconnect").inc()
         finally:
             _CONNECTIONS.dec()
             if session is not None:
+                _SESSIONS_ACTIVE.dec()
                 server.disconnect(session)
             writer.close()
 

@@ -27,6 +27,9 @@ __all__ = [
     "MSG_AWARENESS",
     "MSG_AUTH",
     "MSG_QUERY_AWARENESS",
+    "MSG_BUSY",
+    "busy_message",
+    "decode_busy",
     "MSG_SYNC_STEP_1",
     "MSG_SYNC_STEP_2",
     "MSG_SYNC_UPDATE",
@@ -42,6 +45,13 @@ MSG_SYNC = 0
 MSG_AWARENESS = 1
 MSG_AUTH = 2
 MSG_QUERY_AWARENESS = 3
+# ytpu extension (ISSUE-9 admission control): a server under overload
+# answers an Update with a Busy message instead of silently killing the
+# session — body is lib0 [var_uint retry_after_ms][string reason].  Rides
+# the generic custom-tag encode/decode path, so peers that predate it see
+# an unknown-tag Message they may ignore (SyncClient.pump skips non-sync
+# kinds by design).
+MSG_BUSY = 4
 
 PERMISSION_DENIED = 0
 PERMISSION_GRANTED = 1
@@ -184,6 +194,24 @@ class Message:
     def __repr__(self):
         names = {0: "Sync", 1: "Awareness", 2: "Auth", 3: "AwarenessQuery"}
         return f"Message.{names.get(self.kind, self.kind)}({self.body!r})"
+
+
+def busy_message(reason: str, retry_after_s: float = 0.0) -> Message:
+    """Protocol-level overload reply (ISSUE-9): ``Busy(retry_after_ms,
+    reason)``.  Sent instead of applying an update when admission control
+    rejects it — the session stays alive and the client may re-send after
+    ``retry_after_ms``."""
+    w = Writer()
+    w.write_var_uint(max(0, int(retry_after_s * 1e3)))
+    w.write_string(reason)
+    return Message.custom(MSG_BUSY, w.to_bytes())
+
+
+def decode_busy(body: bytes) -> Tuple[float, str]:
+    """(retry_after_s, reason) from a Busy message body."""
+    cur = Cursor(body)
+    retry_ms = cur.read_var_uint()
+    return retry_ms / 1e3, cur.read_string()
 
 
 def message_reader(data: bytes) -> Iterator[Message]:

@@ -61,6 +61,9 @@ class Session:
             self.dead = True
             self.outbox = []
             self.server._evictions.inc()
+            # a slow-consumer eviction is a shed: attributable in the
+            # per-reason drop series next to admission sheds (ISSUE-9)
+            self.server._dropped.labels("shed").inc()
 
 
 class _Tenant:
@@ -90,6 +93,17 @@ class SyncServer:
         self._sessions_gauge = metrics.gauge("sync.sessions")
         self._outbox_depth = metrics.gauge("sync.outbox_depth")
         self._evictions = metrics.counter("sync.slow_consumer_evictions")
+        # per-reason session-drop attribution (ISSUE-9 satellite; shared
+        # family with sync/net.py so transport- and server-layer drops
+        # land in one series)
+        self._dropped = metrics.counter(
+            "net.sessions_dropped", labelnames=("reason",)
+        )
+        self._busy_replies = metrics.counter("sync.busy_replies")
+        #: optional `ytpu.serving.AdmissionController` consulted per
+        #: inbound update; None (default) admits everything — the
+        #: pre-ISSUE-9 behavior, zero cost on the hot path
+        self.admission = None
 
     # --- tenant / doc management ----------------------------------------------
 
@@ -140,6 +154,46 @@ class SyncServer:
             t.sessions.remove(session)
             self._sessions_gauge.dec()
 
+    # --- admission (ISSUE-9) ----------------------------------------------------
+
+    def _tenant_queue_depth(self, tenant_name: str) -> int:
+        """Current device-queue depth for a tenant (0 on a host-only
+        server — there is no device queue to bound; the rate limiter
+        still applies).  `DeviceSyncServer` overrides."""
+        return 0
+
+    def _admit_update(self, session: Session):
+        """Consult the admission controller for ONE inbound update.
+
+        Returns ``(admitted, reply)``: admitted updates proceed; refused
+        ones either carry a Busy ``reply`` (policy "defer"), drop
+        silently ("drop"), or shed the session ("shed" — the session is
+        marked dead and disconnected, `net.sessions_dropped{reason=
+        "shed"}`)."""
+        adm = self.admission
+        if adm is None:
+            return True, None
+        from ytpu.serving.admission import Overload
+
+        try:
+            adm.admit(
+                session.tenant,
+                queue_depth=self._tenant_queue_depth(session.tenant),
+            )
+            return True, None
+        except Overload as e:
+            if adm.policy == "shed":
+                session.dead = True
+                session.outbox = []
+                self.disconnect(session)
+                self._dropped.labels("shed").inc()
+                return False, None
+            if adm.policy == "drop":
+                self._dropped.labels("update_drop").inc()
+                return False, None
+            self._busy_replies.inc()
+            return False, adm.busy_reply(e)
+
     # --- message pumping --------------------------------------------------------
 
     def receive(self, session: Session, data: bytes) -> bytes:
@@ -161,6 +215,13 @@ class SyncServer:
         applied = self._applied
         for msg in message_reader(data):
             if msg.kind == 0 and msg.body.tag in (1, 2):  # SyncStep2 / Update
+                ok, busy = self._admit_update(session)
+                if not ok:
+                    if busy is not None:
+                        replies.append(busy)
+                    if session.dead:
+                        break  # shed: the transport sees dead and closes
+                    continue
                 # apply with the session as origin so we don't echo it back
                 with hist.time(), trace_span(
                     "apply_update", tenant=session.tenant

@@ -4,6 +4,7 @@
 from .faults import FaultError, FaultInjector, FaultSpec, faults
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry, metrics
 from .phases import PhaseRecorder, phases
+from .slo import HistogramWindow, slo_report
 from .trace import Tracer, trace_span, tracer
 
 __all__ = [
@@ -14,10 +15,12 @@ __all__ = [
     "faults",
     "Gauge",
     "Histogram",
+    "HistogramWindow",
     "MetricsRegistry",
     "metrics",
     "PhaseRecorder",
     "phases",
+    "slo_report",
     "Tracer",
     "trace_span",
     "tracer",

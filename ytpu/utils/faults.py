@@ -59,6 +59,13 @@ Standard sites (see docs/robustness.md for the full taxonomy):
 ``net.truncate``      write a frame header + half the payload (stalls the
                       reader mid-frame)
 ``net.delay``         stall a frame read (args: ``ms``, default 50)
+``session.kill``      soak-time (ISSUE-9): force-drop the current event's
+                      serving session mid-soak — the driver reconnects it
+                      and the state-vector handshake resyncs
+``admission.reject``  soak-time (ISSUE-9): force the next admission
+                      decision to refuse (typed `QueueFull` → protocol
+                      Busy reply / drop / shed per the armed policy;
+                      args: ``tenant`` restricts to one tenant)
 ====================  =======================================================
 
 Every fired injection increments the ``faults.injected`` counter (plus a
