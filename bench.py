@@ -100,6 +100,18 @@ DEVICE_TIMEOUT = float(os.environ.get("YTPU_BENCH_DEVICE_TIMEOUT", "3600"))
 CFG_DOCS = int(os.environ.get("YTPU_BENCH_CFG_DOCS", "2048"))
 CFG5_DOCS = int(os.environ.get("YTPU_BENCH_CFG5_DOCS", "10240"))
 
+# The captures the first TPU window owes (ROADMAP standing items) —
+# emitted by BOTH the dry-run and any device round that lands no
+# platform:"tpu" capture; one list so the two can't drift.
+TUNNEL_QUEUE = [
+    "micro_b1_b2",
+    "fused_vs_xla_prefix",
+    "flagship_overlap_speedup_post_pr5",
+    "flagship_raw_ingest_uplift_pr7",
+    "soak_slo_pr9",
+    "config5_diff_pipeline_pr10",
+]
+
 
 def load_b4_ops(limit: int):
     """(tag, pos, payload) ops from the B4 trace (format: benches.rs:478-504)."""
@@ -1028,6 +1040,27 @@ def soak_dry_run() -> dict:
     assert churn["state_digest"] == clean["state_digest"], (
         "checkpoint/restore + rebalance broke byte parity"
     )
+    # device-authoritative leg (ISSUE-10): the serving mode where the
+    # device batch answers SyncStep1s — every diff routes through the
+    # encode DiffPipeline, and the run must land the SAME state digest
+    # as the mirrored clean run (the pipeline produced the pinned bytes).
+    # Without the native finisher the pipeline serves per-doc Python
+    # (pipeline_runs still counts, but the batched-path asserts don't
+    # apply) — only the digest must still hold.
+    from ytpu.native import available as _native_available
+
+    auth = SoakDriver(
+        DeviceSyncServer(n_docs=4, capacity=256, device_authoritative=True),
+        Scenario(cfg),
+        flush_every=4,
+    ).run()
+    if _native_available():
+        assert auth["diff_pipeline_runs"] >= auth["diffs"] > 0, auth
+        assert auth["encode_demotions"] == 0, auth
+    assert auth["state_digest"] == clean["state_digest"], (
+        "device-authoritative (pipelined-diff) soak diverged from the "
+        "mirrored clean run"
+    )
     busy = SoakDriver(
         fresh(),
         Scenario(cfg),
@@ -1060,6 +1093,14 @@ def soak_dry_run() -> dict:
         "checkpoints": churn["checkpoints"],
         "rebalances": churn["rebalances"],
         "failover_parity": True,
+        "device_diff": {
+            "diffs": auth["diffs"],
+            "diff_pipeline_runs": auth["diff_pipeline_runs"],
+            "encode_demotions": auth["encode_demotions"],
+            "diff_p50_ms": auth["diff_p50_ms"],
+            "diff_p99_ms": auth["diff_p99_ms"],
+            "digest_matches_mirrored": True,
+        },
         "replay_determinism": True,
         "busy_replies": busy["busy_replies"],
         "busy_retries": busy.get("busy_retries", 0),
@@ -1067,6 +1108,136 @@ def soak_dry_run() -> dict:
         "admission_parity": True,
         "scenario_digest": clean["scenario_digest"],
         "state_digest": clean["state_digest"],
+    }
+
+
+def diff_overlap_dry_run(
+    n_docs: int = 12, sub_batch: int = 4, depth: int = 2
+) -> dict:
+    """CPU rehearsal of the pipelined encode/diff path (ISSUE-10): the
+    acceptance surface a device round would otherwise have to trust —
+
+    - **sub-batch plan**: pow2 sub-batch width, depth cap, ONE reusable
+      (donated) index slot, every later sub-batch re-filling it;
+    - **byte parity**: pipelined payloads byte-equal the serial
+      `finish_encode_diff_batch` output over the same selection;
+    - **zero extra syncs**: exactly n_sub + 1 host materializations (one
+      counts pull + one drain per sub-batch), nothing per doc;
+    - **fault degradation** (the chaos classes): `diff.d2h_fail` and
+      `finisher.raise` each demote their sub-batch to the serial per-doc
+      finisher — counted via `encode.demotions` — with parity intact.
+
+    `modeled_speedup` is the three stages fully overlapped vs run back to
+    back (≥ 1 by algebra); the non-vacuous guards are the parity, sync
+    and demotion asserts.
+
+    Hosts without the native finisher (no C++ toolchain) have no batched
+    path to pipeline against — the rehearsal reports itself skipped
+    instead of asserting stats the Python-only fallback never produces."""
+    import numpy as np
+
+    from ytpu.core import Doc, Update
+    from ytpu.native import available as _native_available
+
+    if not _native_available():
+        return {"skipped": "native finisher unavailable (no C++ toolchain)"}
+    from ytpu.models.batch_doc import (
+        BatchEncoder,
+        DiffPipeline,
+        apply_update_batch,
+        encode_diff_batch,
+        finish_encode_diff_batch,
+        init_state,
+        plan_diff_pipeline,
+    )
+    from ytpu.utils import metrics
+    from ytpu.utils.faults import faults
+
+    docs, logs = [], []
+    for i in range(n_docs):
+        d = Doc(client_id=i + 1)
+        log = []
+        d.observe_update_v1(lambda p, o, t, log=log: log.append(p))
+        t = d.get_text("text")
+        with d.transact() as txn:
+            t.insert(txn, 0, f"doc-{i} diff pipeline")
+        with d.transact() as txn:
+            t.insert(txn, 4, "🙂✓" if i % 3 == 0 else "xy")
+        if i % 4 == 1:
+            with d.transact() as txn:
+                t.remove_range(txn, 2, 3)
+        docs.append(d)
+        logs.append(log)
+    enc = BatchEncoder()
+    state = init_state(n_docs, 128)
+    for step in range(max(len(lg) for lg in logs)):
+        ups = [
+            Update.decode_v1(lg[step]) if step < len(lg) else None
+            for lg in logs
+        ]
+        batch = enc.build_batch(ups, n_rows=8, n_dels=4)
+        state = apply_update_batch(state, batch, enc.interner.rank_table())
+    assert int(np.asarray(state.error).max()) == 0
+    n_clients = max(8, len(enc.interner))
+    remote = np.zeros((n_docs, n_clients), dtype=np.int32)
+    sel = list(range(n_docs))
+    ship, offsets, _sv, deleted = encode_diff_batch(state, remote, n_clients)
+
+    plan = plan_diff_pipeline(n_docs, sub_batch=sub_batch, depth=depth)
+    assert plan.n_sub >= 2 and plan.depth == depth, plan
+    assert plan.idx_buffers == 1, plan
+    assert plan.buffer_reuses == plan.n_sub - 1, plan
+    assert plan.donate_idx, plan
+    assert plan.sub & (plan.sub - 1) == 0, f"sub width not pow2: {plan}"
+
+    serial = finish_encode_diff_batch(state, sel, ship, offsets, deleted, enc)
+    pipe = DiffPipeline(sub_batch=sub_batch, depth=depth)
+    pipe.run(state, sel, ship, offsets, deleted, enc)  # warm the family
+    piped = pipe.run(state, sel, ship, offsets, deleted, enc)
+    assert piped == serial, "pipelined vs serial diff payloads diverged"
+    st = pipe.stats
+    assert st.n_sub == plan.n_sub and st.demotions == 0, st
+    assert st.syncs == st.n_sub + 1, f"per-doc device syncs crept in: {st}"
+    stages = (st.select_s, st.d2h_s, st.finish_s)
+    modeled = sum(stages) / max(max(stages), 1e-9)
+    assert modeled >= 1.0, (modeled, st)
+
+    chaos = {}
+    for site in ("diff.d2h_fail", "finisher.raise"):
+        faults.clear()
+        spec = faults.arm(site)
+        base = metrics.counter("encode.demotions").value
+        cp = DiffPipeline(sub_batch=sub_batch, depth=depth)
+        got = cp.run(state, sel, ship, offsets, deleted, enc)
+        faults.clear()
+        assert spec.fired == 1, (site, spec)
+        assert got == serial, f"{site}: degraded sub-batch broke parity"
+        delta = metrics.counter("encode.demotions").value - base
+        assert delta >= 1 and cp.stats.demotions >= 1, (site, cp.stats)
+        chaos[site] = {"demotions": cp.stats.demotions, "recovered": True}
+
+    return {
+        "n_docs": n_docs,
+        "sub": plan.sub,
+        "n_sub": plan.n_sub,
+        "depth": plan.depth,
+        "idx_buffers": plan.idx_buffers,
+        "buffer_reuses": plan.buffer_reuses,
+        "donate_idx": plan.donate_idx,
+        "R": st.R,
+        "total_rows": st.total_rows,
+        "syncs": st.syncs,
+        "modeled_speedup": round(modeled, 3),
+        "overlap_ratio": round(st.overlap_ratio, 3),
+        "stages": {
+            "select_s": round(st.select_s, 6),
+            "d2h_s": round(st.d2h_s, 6),
+            "finish_s": round(st.finish_s, 6),
+            "stall_s": round(st.stall_s, 6),
+            "d2h_bytes": st.d2h_bytes,
+        },
+        "byte_parity": True,
+        "chaos": chaos,
     }
 
 
@@ -1087,7 +1258,13 @@ def _soak_phase(budget_s: float) -> dict:
         ),
         seed=9,
     )
-    server = DeviceSyncServer(n_docs=8, capacity=512)
+    # device-authoritative: the serving mode where the batch engine adds
+    # capacity instead of shadowing host docs — updates integrate once
+    # and SyncStep1 answers route through the encode DiffPipeline
+    # (ISSUE-10), so soak.diff_latency scores the pipelined path
+    server = DeviceSyncServer(
+        n_docs=8, capacity=512, device_authoritative=True
+    )
     rep = SoakDriver(
         server,
         Scenario(cfg),
@@ -1116,6 +1293,8 @@ def _soak_phase(budget_s: float) -> dict:
                 "wall_s",
                 "diff_p50_ms",
                 "diff_p99_ms",
+                "diff_pipeline_runs",
+                "encode_demotions",
                 "state_digest",
             )
             if k in rep
@@ -1681,6 +1860,18 @@ def main(dry_run: bool = False):
             "soak_p99_ms_adj",
         ):
             out[k] = out["soak"][k.replace("soak_", "apply_")]
+        # pipelined encode/diff rehearsal (ISSUE-10): sub-batch plan +
+        # pipelined-vs-serial byte parity + fault degradation asserted;
+        # the modeled speedup headlines next to overlap_speedup and the
+        # encode.select/encode.d2h_bytes/encode.finish stage breakdown
+        # rides the phases snapshot below
+        with phases.span("host.diff_overlap_rehearsal"):
+            out["diff_overlap"] = diff_overlap_dry_run()
+        if "modeled_speedup" in out["diff_overlap"]:
+            out["diff_pipeline_speedup"] = out["diff_overlap"][
+                "modeled_speedup"
+            ]
+        out["tunnel_queue"] = list(TUNNEL_QUEUE)
         out["phases"] = phases.snapshot()
         out["metrics"] = metrics.snapshot()
         print(json.dumps(out))
@@ -1725,6 +1916,15 @@ def main(dry_run: bool = False):
             out["probe"] = probe
         if "configs" in res:
             out["configs"] = res["configs"]
+            # ISSUE-10 headline keys: the pipelined-vs-serial finisher
+            # ratio and its stage breakdown, lifted next to
+            # overlap_speedup so the one-line JSON carries the encode
+            # side's number without digging into configs
+            cfg5 = res["configs"].get("config5") or {}
+            if "diff_pipeline_speedup" in cfg5:
+                out["diff_pipeline_speedup"] = cfg5["diff_pipeline_speedup"]
+            if "pipeline" in cfg5:
+                out["config5_pipeline"] = cfg5["pipeline"]
         for k in ("p50_apply_ms", "p99_apply_ms", "latency_steps", "latency_docs"):
             if k in res:
                 out[k] = res[k]
@@ -1869,13 +2069,7 @@ def main(dry_run: bool = False):
         carried = _freshest_tpu_capture()
         if carried:
             out["carried_device_capture"] = carried
-        out["tunnel_queue"] = [
-            "micro_b1_b2",
-            "fused_vs_xla_prefix",
-            "flagship_overlap_speedup_post_pr5",
-            "flagship_raw_ingest_uplift_pr7",
-            "soak_slo_pr9",
-        ]
+        out["tunnel_queue"] = list(TUNNEL_QUEUE)
     # where the time went: child device stages (decode/integrate/compact,
     # compile vs execute vs transfer bytes) + parent host stages, and a
     # metrics snapshot — BENCH_r*.json finally records the breakdown, not

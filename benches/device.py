@@ -534,15 +534,38 @@ def bench_config5(n_docs: int, n_clients: int = 64):
     assert nat_payloads[:py_n] == py_payloads  # byte parity
     finisher_speedup = py_dt / nat_dt if nat_dt > 0 else float("inf")
 
-    # headline = END-TO-END serving rate (selection + finisher), the number
-    # an operator gets per sync round (VERDICT r3 weak #9: the old value
-    # reported device selection alone and hid the finisher bottleneck)
-    e2e_dt = sel_dt / n_docs + nat_dt
+    # ISSUE-10: the staged pipeline — device compaction of sub-batch k+1
+    # ‖ async D2H of k ‖ batched native finisher on k−1 — measured against
+    # the serial finisher handoff above on the SAME selection, with byte
+    # parity asserted.  This is the serving path (DeviceSyncServer routes
+    # every SyncStep1 through it), so it headlines the config.
+    from ytpu.models.batch_doc import DiffPipeline
+
+    # default sub-batch: 512 at production doc counts (the 10240-doc
+    # north-star runs 20 sub-batches), shrinking on small rehearsals so
+    # the pipeline still actually overlaps (≥4 sub-batches)
+    sub_env = os.environ.get("YTPU_CFG5_SUB")
+    sub_batch = int(sub_env) if sub_env else min(512, max(8, n_docs // 4))
+    pipe = DiffPipeline(sub_batch=sub_batch, depth=2)
+    pipe.run(state, all_docs, ship, offsets, deleted, enc)  # warm the family
+    t0 = time.perf_counter()
+    pipe_payloads = pipe.run(state, all_docs, ship, offsets, deleted, enc)
+    pipe_dt = (time.perf_counter() - t0) / n_docs
+    assert pipe_payloads == nat_payloads  # pipelined-vs-serial byte parity
+    st = pipe.stats
+    diff_pipeline_speedup = nat_dt / pipe_dt if pipe_dt > 0 else float("inf")
+
+    # headline = END-TO-END serving rate (selection + pipelined finisher),
+    # the number an operator gets per sync round (VERDICT r3 weak #9: the
+    # old value reported device selection alone and hid the finisher)
+    e2e_dt = sel_dt / n_docs + pipe_dt
+    serial_e2e_dt = sel_dt / n_docs + nat_dt
     return {
         "metric": "config5_encode_diff_batch_docs_per_sec",
         "value": round(1.0 / e2e_dt, 1),
         "unit": f"doc-diffs/s END-TO-END over {n_docs} docs x {C} clients "
-        "(device selection + native finisher, byte parity asserted)",
+        "(device selection + PIPELINED native finisher, byte parity "
+        "asserted vs serial)",
         "vs_baseline": round((1.0 / e2e_dt) / (1.0 / (native_dt or host_dt)), 2),
         "baseline_kind": "native_cpp" if native_dt else "py_oracle_SOFT",
         "vs_native": round(native_dt / e2e_dt, 2) if native_dt else None,
@@ -550,9 +573,27 @@ def bench_config5(n_docs: int, n_clients: int = 64):
         "native_diffs_per_sec": round(1.0 / native_dt, 1) if native_dt else None,
         "native_baseline": _NATIVE_PIN.get("config5"),
         "selection_docs_per_sec": round(n_docs / sel_dt, 1),
+        "serial_docs_per_sec": round(1.0 / serial_e2e_dt, 1),
         "finisher_native_docs_per_sec": round(1.0 / nat_dt, 1),
         "finisher_python_docs_per_sec": round(1.0 / py_dt, 1),
         "finisher_native_vs_python": round(finisher_speedup, 2),
+        "diff_pipeline_speedup": round(diff_pipeline_speedup, 2),
+        "pipeline": {
+            "sub": st.sub,
+            "n_sub": st.n_sub,
+            "depth": st.depth,
+            "R": st.R,
+            "total_rows": st.total_rows,
+            "threads": st.threads,
+            "select_s": round(st.select_s, 6),
+            "d2h_s": round(st.d2h_s, 6),
+            "finish_s": round(st.finish_s, 6),
+            "stall_s": round(st.stall_s, 6),
+            "d2h_bytes": st.d2h_bytes,
+            "overlap_ratio": round(st.overlap_ratio, 3),
+            "demotions": st.demotions,
+            "fallback_docs": st.fallback_docs,
+        },
     }
 
 

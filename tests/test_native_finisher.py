@@ -74,28 +74,16 @@ def assert_parity(state, docs_idx, ship, offsets, deleted, enc, payloads=None):
 
 
 def native_statuses(state, docs_idx, ship, offsets, deleted, enc, payloads=None):
-    """Which docs the C++ core handled itself (0) vs punted (1)."""
+    """Which docs the C++ core handled itself (0) vs punted (1).  Reads
+    the module's `LAST_FINISH_STATUSES` introspection surface — the
+    vectorized span readout (ISSUE-10) no longer makes per-doc
+    `ytpu_finish_status` calls a spy could intercept."""
     from ytpu.models import batch_doc as bd
-    from ytpu import native as nat
 
-    lib = nat.load()
-    statuses = []
-    orig = lib.ytpu_finish_status
-    recorded = []
-
-    def spy(handle, i):
-        rc = orig(handle, i)
-        recorded.append(rc)
-        return rc
-
-    lib.ytpu_finish_status = spy
-    try:
-        bd.finish_encode_diff_batch(
-            state, docs_idx, ship, offsets, deleted, enc, payloads
-        )
-    finally:
-        lib.ytpu_finish_status = orig
-    return recorded
+    bd.finish_encode_diff_batch(
+        state, docs_idx, ship, offsets, deleted, enc, payloads
+    )
+    return list(bd.LAST_FINISH_STATUSES)
 
 
 @needs_native

@@ -340,6 +340,87 @@ def test_session_kill_fault_reconnects_with_parity():
     assert rep["state_digest"] == clean["state_digest"]
 
 
+# --------------------------------------- diff path through the pipeline
+
+
+@needs_native
+def test_soak_diff_path_through_pipeline_matches_serial():
+    """ISSUE-10: in the device-authoritative serving mode — the one
+    where the device batch answers SyncStep1s — every soak diff routes
+    through the encode `DiffPipeline`, the run lands the SAME state
+    digest as the mirrored clean run (the pipeline produced the pinned
+    digest), and re-answering each tenant's step1 is byte-equal to the
+    serial `finish_encode_diff_batch` path.  (Mirrored-mode soaks keep
+    answering diffs from the authoritative HOST doc by design — their
+    `diff_pipeline_runs` reads 0.)"""
+    import jax.numpy as jnp
+
+    from ytpu.core import StateVector
+    from ytpu.models import batch_doc as bd
+    from ytpu.serving import Scenario, SoakDriver
+    from ytpu.sync.device_server import DeviceSyncServer
+
+    clean = _clean_soak()["report"]
+    assert clean["diff_pipeline_runs"] == 0  # mirrored mode: host path
+    driver = SoakDriver(
+        DeviceSyncServer(
+            n_docs=N_DOCS, capacity=CAPACITY, device_authoritative=True
+        ),
+        Scenario(_cfg()),
+        flush_every=4,
+    )
+    rep = driver.run()
+    server = driver.server
+    assert rep["diffs"] > 0
+    # each diff event (plus the RTT idle-echo probes) ran the pipeline,
+    # and none of them had to demote off the native batched path
+    assert rep["diff_pipeline_runs"] >= rep["diffs"]
+    assert rep["encode_demotions"] == 0
+    assert rep["state_digest"] == clean["state_digest"]
+    for t in sorted(server.tenants):
+        try:
+            slot = server.slot_of(t)
+        except KeyError:
+            continue  # host-resident tenant: no device diff to compare
+        piped = server.device_encode_diff(t, StateVector())
+        remote, n_clients = server._remote_matrix([(slot, StateVector())])
+        ship, offsets, _sv, deleted = bd.encode_diff_batch(
+            server.ingestor.state, jnp.asarray(remote), n_clients
+        )
+        serial = bd.finish_encode_diff_batch(
+            server.ingestor.state,
+            [slot],
+            ship,
+            offsets,
+            deleted,
+            server.ingestor.enc,
+            payloads=server.ingestor.payloads,
+            root_name=server._root_names.get(t),
+        )[0]
+        assert piped == server._merge_pending(slot, serial), t
+
+
+@needs_native
+def test_device_encode_diff_many_fanout_parity():
+    """The batched fan-out entry answers many tenants in one pipelined
+    pass, byte-equal to the per-tenant path; duplicate tenants are
+    rejected (they would collide on the slot's remote-clock row)."""
+    from ytpu.core import StateVector
+
+    server = _clean_soak()["server"]
+    tenants = [t for t in sorted(server.tenants) if t in server._slot_of]
+    assert len(tenants) >= 2
+    many = server.device_encode_diff_many(
+        [(t, StateVector()) for t in tenants]
+    )
+    for t, payload in zip(tenants, many):
+        assert payload == server.device_encode_diff(t, StateVector()), t
+    with pytest.raises(ValueError, match="one request per tenant"):
+        server.device_encode_diff_many(
+            [(tenants[0], StateVector()), (tenants[0], StateVector())]
+        )
+
+
 # -------------------------------------------------- chaos over sockets
 
 

@@ -6,10 +6,15 @@ with `apply_update_batch` / `encode_diff_batch` as jitted programs.
 
 from .batch_doc import (
     BatchEncoder,
+    DiffPipeline,
+    DiffPlan,
+    DiffStats,
     apply_update_stream,
+    compact_finisher_rows,
     encode_diff_batch,
     finish_encode_diff,
     finish_encode_diff_batch,
+    plan_diff_pipeline,
     BlockCols,
     ClientInterner,
     DocStateBatch,
@@ -28,10 +33,15 @@ from .batch_doc import (
 
 __all__ = [
     "BatchEncoder",
+    "DiffPipeline",
+    "DiffPlan",
+    "DiffStats",
     "apply_update_stream",
+    "compact_finisher_rows",
     "encode_diff_batch",
     "finish_encode_diff",
     "finish_encode_diff_batch",
+    "plan_diff_pipeline",
     "BlockCols",
     "ClientInterner",
     "DocStateBatch",

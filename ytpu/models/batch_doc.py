@@ -27,6 +27,8 @@ tests/test_batch_device.py, test_batch_map.py and test_batch_tree.py.
 
 from __future__ import annotations
 
+import time
+from dataclasses import dataclass
 from functools import partial
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
@@ -66,7 +68,14 @@ __all__ = [
     "KeyInterner",
     "PayloadStore",
     "BatchEncoder",
+    "finish_encode_diff",
     "finish_encode_diff_batch",
+    "compact_finisher_rows",
+    "DiffPlan",
+    "DiffStats",
+    "DiffPipeline",
+    "plan_diff_pipeline",
+    "FINISHER_MT_MIN_ROWS",
     "ensure_root_anchor",
     "ensure_root_anchor_all",
     "recompute_origin_slot",
@@ -1553,8 +1562,7 @@ def _finish_counts(parent, ship, deleted, idx):
     return jnp.sum(incl, axis=1, dtype=jnp.int32)
 
 
-@partial(jax.jit, static_argnums=(5,))
-def _finish_pack(bl, ship, offsets, deleted, idx, R):
+def _compact_finisher_rows_impl(bl, ship, offsets, deleted, idx, R):
     """Compact the finisher's row set to [Dsel, 15, R] i32 ON DEVICE.
 
     The tunnel-dominated cost of the old path was pulling every [D, B]
@@ -1563,7 +1571,13 @@ def _finish_pack(bl, ship, offsets, deleted, idx, R):
     those into R slots per doc and ships ONE packed tensor. The parent
     column is remapped into the compacted index space (valid for every
     shipped row by construction; -1 elsewhere — never read by the C++
-    side, which only dereferences parents of shipped rows)."""
+    side, which only dereferences parents of shipped rows).
+
+    This is the per-sub-batch device stage of the `DiffPipeline`
+    (ISSUE-10): one compiled program per (doc-width, R) shape family —
+    both dims pow2-bucketed by the callers — serves every sub-batch, and
+    the per-dispatch `idx` selection buffer is donated (it is never read
+    again after the dispatch consumes it)."""
     g = lambda a: jnp.take(a, idx, axis=0)
     ship = g(ship)
     offsets = g(offsets).astype(jnp.int32)
@@ -1589,90 +1603,88 @@ def _finish_pack(bl, ship, offsets, deleted, idx, R):
     return jnp.stack(packed, axis=1)
 
 
-def finish_encode_diff_batch(
-    state: DocStateBatch,
-    docs,
-    ship: np.ndarray,
-    offsets: np.ndarray,
-    deleted: np.ndarray,
-    enc: "BatchEncoder",
-    payloads=None,
-    root_name: Optional[str] = None,
-) -> List[bytes]:
-    """Batched native finisher: selected device rows -> v1 payloads for
-    many docs in one C++ call (VERDICT r2 #6; reference equivalent:
-    store.rs:204-248 compiled). Byte-identical to `finish_encode_diff`;
-    docs holding a row outside the native scope (wire-ref Format/Embed,
-    unknown kinds) fall back to the Python finisher individually; wire
-    ContentType spans re-emit natively (verbatim copy).
-    `root_name` overrides the batch root branch name on the wire for this
-    call (per-tenant serving; all selected docs share it).
-    """
-    import ctypes
+# two compiled variants: donation of the per-dispatch idx buffer only
+# where the backend can actually alias it (device). The CPU backend
+# cannot, and XLA would warn "Some donated buffers were not usable"
+# once per compiled (sub, R) family — a process-global filterwarnings
+# would hide the (advisory, but useful) hint from the APPLICATION's own
+# jax code too, so route around the warning instead of silencing it.
+_compact_rows_donated = partial(
+    jax.jit, static_argnums=(5,), donate_argnums=(4,)
+)(_compact_finisher_rows_impl)
+_compact_rows_plain = partial(jax.jit, static_argnums=(5,))(
+    _compact_finisher_rows_impl
+)
 
-    from ytpu import native as _native
-    from ytpu.ops.decode_kernel import ChunkedWirePayloads
 
-    if payloads is None:
-        payloads = enc.payloads
-    docs = list(docs)
-    lib = _native.load()
-    if lib is None or not getattr(lib, "finisher_ok", False):
-        return [
-            finish_encode_diff(
-                state, d, ship, offsets, deleted, enc, payloads, root_name
-            )
-            for d in docs
-        ]
+def _donation_usable() -> bool:
+    return jax.default_backend() != "cpu"
 
-    if isinstance(payloads, ChunkedWirePayloads):
-        store = payloads.store
-        wire = _wire_concat(payloads)
-    else:
-        store = payloads
-        wire = np.empty(0, dtype=np.uint8)
-    ar = _payload_native_arenas(store)
 
-    bl = state.blocks
-    D, B = bl.client.shape
-    col_names = _FINISH_COLS
+def compact_finisher_rows(bl, ship, offsets, deleted, idx, R):
+    """Dispatch `_compact_finisher_rows_impl`, donating `idx` on device
+    backends (it is never read again after the dispatch consumes it)."""
+    fn = _compact_rows_donated if _donation_usable() else _compact_rows_plain
+    return fn(bl, ship, offsets, deleted, idx, R)
 
-    # Device-side row compaction (VERDICT r3 #3): only shipped/deleted/
-    # parent rows cross the device->host boundary, as ONE [Dsel, 15, R]
-    # tensor — R is the largest per-doc row set, bucketed to a power of
-    # two to bound recompiles (as is the doc-selection length).
-    ship_j = ship if isinstance(ship, jax.Array) else jnp.asarray(ship)
-    off_j = offsets if isinstance(offsets, jax.Array) else jnp.asarray(offsets)
-    del_j = deleted if isinstance(deleted, jax.Array) else jnp.asarray(deleted)
-    n_sel = len(docs)
-    sel_np = np.asarray(docs, dtype=np.int32)
-    if n_sel and (sel_np.min() < 0 or sel_np.max() >= D):
+
+def _compact_rows_cache_size() -> int:
+    """Compiled-instance count across both variants (retrace-bound
+    tests; only one variant is ever populated per process backend)."""
+    return (
+        _compact_rows_donated._cache_size() + _compact_rows_plain._cache_size()
+    )
+
+
+def _compact_rows_clear_cache() -> None:
+    _compact_rows_donated.clear_cache()
+    _compact_rows_plain.clear_cache()
+
+
+# progbudget/test surface: the dispatch wrapper reports and evicts the
+# union of both variants' executable caches
+compact_finisher_rows._cache_size = _compact_rows_cache_size
+compact_finisher_rows.clear_cache = _compact_rows_clear_cache
+_finish_pack = compact_finisher_rows  # back-compat internal name
+
+
+# Native finisher threading threshold (ISSUE-10 small fix): total
+# selected rows below this run single-threaded (spawn overhead dominates);
+# at/above it the C++ side fans docs across hardware threads.
+FINISHER_MT_MIN_ROWS = 4096
+
+# Test-introspection surface: per-active-doc status codes of the LAST
+# native finisher call (0 = native core encoded it, 1 = fell back to the
+# per-doc Python finisher).  Written by `_FinisherContext.finish` on the
+# calling thread only.
+LAST_FINISH_STATUSES: List[int] = []
+
+
+def _finisher_threads(total_rows: int) -> int:
+    """Native finisher threading decision: 0 = thread pool (hardware
+    concurrency), 1 = single thread.  Keyed on the TOTAL selected rows of
+    the call, not the doc count (ISSUE-10): the old ``len(docs) >= 128``
+    rule let a handful of huge docs — one hot tenant shipping its whole
+    history — run single-threaded, while a thousand near-empty docs paid
+    pool overhead for nothing."""
+    return 0 if int(total_rows) >= FINISHER_MT_MIN_ROWS else 1
+
+
+def _check_doc_selection(sel_np: np.ndarray, n_docs: int) -> None:
+    if sel_np.size and (sel_np.min() < 0 or sel_np.max() >= n_docs):
         # jnp.take clamps OOB indices — without this check a stale slot id
         # would silently encode the LAST doc's diff for the wrong tenant
         raise IndexError(
             f"doc selection out of range: {sel_np.min()}..{sel_np.max()} "
-            f"for {D} docs"
+            f"for {n_docs} docs"
         )
-    # no clamp to D: `docs` may legally repeat slots, so n_sel can exceed
-    # the doc capacity; padding entries repeat the first SELECTED doc so R
-    # (the packed width) is sized by the actual selection, not by doc 0
-    d_pad = _next_pow2(n_sel)
-    idx_np = np.full(d_pad, sel_np[0] if n_sel else 0, dtype=np.int32)
-    idx_np[:n_sel] = sel_np
-    idx = jnp.asarray(idx_np)
-    counts = np.asarray(_finish_counts(bl.parent, ship_j, del_j, idx))
-    R = min(_next_pow2(int(counts.max(initial=1))), B)
-    arr = np.asarray(_finish_pack(bl, ship_j, off_j, del_j, idx, R))
-    cols = {
-        name: np.ascontiguousarray(arr[:, k, :])
-        for k, name in enumerate(col_names)
-    }
-    ship_u8 = np.ascontiguousarray(arr[:, 12, :], dtype=np.uint8)
-    offsets_i32 = np.ascontiguousarray(arr[:, 13, :])
-    deleted_u8 = np.ascontiguousarray(arr[:, 14, :], dtype=np.uint8)
-    sel = np.arange(n_sel, dtype=np.int32)
-    D, B = d_pad, R
-    # interner/key tables are append-only: rebuild only when they grew
+
+
+def _interner_tables(enc: "BatchEncoder") -> dict:
+    """Interner/key-name tables for the native finisher, cached on the
+    encoder — both are append-only, so rebuild only when they grew (a
+    long-lived server answering single-doc syncs must not re-copy them
+    per reply)."""
     tables = getattr(enc, "_nat_tables", None)
     n_keys = len(enc.keys)
     if tables is None or tables["key"] != (len(enc.interner), n_keys):
@@ -1694,109 +1706,557 @@ def finish_encode_diff_batch(
             ),
         }
         enc._nat_tables = tables
-    from_idx = tables["from_idx"]
-    key_blob = tables["key_blob"]
-    key_off = tables["key_off"]
-    if root_name is not None:
-        root_bytes = root_name.encode("utf-8")
-        root = np.frombuffer(root_bytes or b"\0", dtype=np.uint8)
-    else:
-        root_bytes = enc.root_name.encode("utf-8")
-        root = tables["root"]
+    return tables
 
-    nparr = ar["np"]
-    text_arena = nparr["text"]
-    blob_arena = nparr["blob"]
-    elem_arena = nparr["elem"]
-    item_text_off = nparr["text_off"]
-    item_text_units = nparr["text_units"]
-    item_blob_off = nparr["blob_off"]
-    item_blob_len = nparr["blob_len"]
-    item_elem_base = nparr["elem_base"]
-    item_elem_count = nparr["elem_count"]
-    elem_off = nparr["elem_off"]
-    wire = np.ascontiguousarray(wire, dtype=np.uint8)
-    if wire.size == 0:
-        wire = np.zeros(1, dtype=np.uint8)
 
-    def p_i32(a):
-        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+class _FinisherContext:
+    """One finisher invocation family's host-side context, shared by the
+    serial batched entry and the `DiffPipeline` consumer stage: the
+    native library, the payload arenas + retained-wire buffer, and the
+    interner/key tables, resolved ONCE per call family.  `finish()`
+    turns a HOST copy of the packed [Dsel, 15, R] tensor into wire
+    payloads in one native call — through the zero-copy strided arena
+    entry (`ytpu_finish_batch_strided`) when the library carries it,
+    else the classic per-plane-copy path of older builds."""
 
-    def p_i64(a):
-        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    def __init__(self, enc: "BatchEncoder", payloads=None):
+        from ytpu import native as _native
+        from ytpu.ops.decode_kernel import ChunkedWirePayloads
 
-    def p_u8(a):
-        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        self.enc = enc
+        self.payloads = enc.payloads if payloads is None else payloads
+        self._native = _native
+        lib = _native.load()
+        self.lib = lib
+        self.ok = lib is not None and getattr(lib, "finisher_ok", False)
+        if not self.ok:
+            return
+        if isinstance(self.payloads, ChunkedWirePayloads):
+            self.store = self.payloads.store
+            wire = _wire_concat(self.payloads)
+        else:
+            self.store = self.payloads
+            wire = np.empty(0, dtype=np.uint8)
+        self.ar = _payload_native_arenas(self.store)
+        wire = np.ascontiguousarray(wire, dtype=np.uint8)
+        if wire.size == 0:
+            wire = np.zeros(1, dtype=np.uint8)
+        self.wire = wire
+        self.tables = _interner_tables(enc)
 
-    fin = _native.FinishIn(
-        n_docs_total=D,
-        n_blocks_cap=B,
-        client=p_i32(cols["client"]),
-        clock=p_i32(cols["clock"]),
-        length=p_i32(cols["length"]),
-        origin_client=p_i32(cols["origin_client"]),
-        origin_clock=p_i32(cols["origin_clock"]),
-        ror_client=p_i32(cols["ror_client"]),
-        ror_clock=p_i32(cols["ror_clock"]),
-        kind=p_i32(cols["kind"]),
-        content_ref=p_i32(cols["content_ref"]),
-        content_off=p_i32(cols["content_off"]),
-        key=p_i32(cols["key"]),
-        parent=p_i32(cols["parent"]),
-        ship=p_u8(ship_u8),
-        offsets=p_i32(offsets_i32),
-        deleted=p_u8(deleted_u8),
-        sel=p_i32(sel),
-        n_sel=len(docs),
-        from_idx=p_i64(from_idx),
-        n_interned=len(enc.interner),
-        key_blob=p_u8(key_blob),
-        key_off=p_i64(key_off),
-        n_keys=n_keys,
-        root_name=p_u8(root),
-        root_name_len=len(root_bytes),
-        text_arena=p_u8(text_arena),
-        text_arena_len=len(ar["text"]),
-        item_text_off=p_i64(item_text_off),
-        item_text_units=p_i64(item_text_units),
-        blob_arena=p_u8(blob_arena),
-        blob_arena_len=len(ar["blob"]),
-        item_blob_off=p_i64(item_blob_off),
-        item_blob_len=p_i64(item_blob_len),
-        item_elem_base=p_i64(item_elem_base),
-        item_elem_count=p_i64(item_elem_count),
-        elem_off=p_i64(elem_off),
-        elem_arena=p_u8(elem_arena),
-        elem_arena_len=len(ar["elem"]),
-        n_items=ar["n"],
-        wire=p_u8(wire),
-        wire_len=int(getattr(payloads, "total_bytes", 0)),
+    def finish(
+        self,
+        arr: np.ndarray,
+        n_active: int,
+        root_name: Optional[str],
+        n_threads: int,
+    ) -> List[Optional[bytes]]:
+        """`arr`: C-contiguous [d_pad, 15, R] i32 host tensor (a drained
+        `compact_finisher_rows` output).  Returns one entry per ACTIVE
+        doc: wire bytes, or None where the native core punted (the
+        caller peels those per doc through the Python finisher)."""
+        import ctypes
+
+        global LAST_FINISH_STATUSES
+        if n_active == 0:
+            LAST_FINISH_STATUSES = []  # never report a previous call's
+            return []
+        lib = self.lib
+        enc, ar, tables = self.enc, self.ar, self.tables
+        d_pad, _planes, R = arr.shape
+
+        def p_i32(a):
+            return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+        def p_i64(a):
+            return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+        def p_u8(a):
+            return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+        if root_name is not None:
+            root_bytes = root_name.encode("utf-8")
+            root = np.frombuffer(root_bytes or b"\0", dtype=np.uint8)
+        else:
+            root_bytes = enc.root_name.encode("utf-8")
+            root = tables["root"]
+        sel = np.arange(n_active, dtype=np.int32)
+        strided = bool(getattr(lib, "finisher_strided_ok", False))
+        keep_alive = []  # classic path's per-plane copies, alive past call
+        if strided:
+            # zero-copy column pointers straight into the packed arena:
+            # plane k of doc 0 sits at base + k*R int32s, consecutive
+            # docs 15*R apart (the strided entry's doc_stride); the
+            # ship/offsets/deleted planes stay i32 — no u8 conversions
+            base = arr.ctypes.data
+
+            def plane(k, typ=ctypes.c_int32):
+                return ctypes.cast(base + k * R * 4, ctypes.POINTER(typ))
+
+            cols = {name: plane(k) for k, name in enumerate(_FINISH_COLS)}
+            ship_p = plane(12, ctypes.c_uint8)
+            off_p = plane(13)
+            del_p = plane(14, ctypes.c_uint8)
+        else:
+            host_cols = {
+                name: np.ascontiguousarray(arr[:, k, :])
+                for k, name in enumerate(_FINISH_COLS)
+            }
+            ship_u8 = np.ascontiguousarray(arr[:, 12, :], dtype=np.uint8)
+            offsets_i32 = np.ascontiguousarray(arr[:, 13, :])
+            deleted_u8 = np.ascontiguousarray(arr[:, 14, :], dtype=np.uint8)
+            keep_alive = [host_cols, ship_u8, offsets_i32, deleted_u8]
+            cols = {n: p_i32(a) for n, a in host_cols.items()}
+            ship_p = p_u8(ship_u8)
+            off_p = p_i32(offsets_i32)
+            del_p = p_u8(deleted_u8)
+        nparr = ar["np"]
+        fin = self._native.FinishIn(
+            n_docs_total=d_pad,
+            n_blocks_cap=R,
+            client=cols["client"],
+            clock=cols["clock"],
+            length=cols["length"],
+            origin_client=cols["origin_client"],
+            origin_clock=cols["origin_clock"],
+            ror_client=cols["ror_client"],
+            ror_clock=cols["ror_clock"],
+            kind=cols["kind"],
+            content_ref=cols["content_ref"],
+            content_off=cols["content_off"],
+            key=cols["key"],
+            parent=cols["parent"],
+            ship=ship_p,
+            offsets=off_p,
+            deleted=del_p,
+            sel=p_i32(sel),
+            n_sel=n_active,
+            from_idx=p_i64(tables["from_idx"]),
+            n_interned=len(enc.interner),
+            key_blob=p_u8(tables["key_blob"]),
+            key_off=p_i64(tables["key_off"]),
+            n_keys=len(enc.keys),
+            root_name=p_u8(root),
+            root_name_len=len(root_bytes),
+            text_arena=p_u8(nparr["text"]),
+            text_arena_len=len(ar["text"]),
+            item_text_off=p_i64(nparr["text_off"]),
+            item_text_units=p_i64(nparr["text_units"]),
+            blob_arena=p_u8(nparr["blob"]),
+            blob_arena_len=len(ar["blob"]),
+            item_blob_off=p_i64(nparr["blob_off"]),
+            item_blob_len=p_i64(nparr["blob_len"]),
+            item_elem_base=p_i64(nparr["elem_base"]),
+            item_elem_count=p_i64(nparr["elem_count"]),
+            elem_off=p_i64(nparr["elem_off"]),
+            elem_arena=p_u8(nparr["elem"]),
+            elem_arena_len=len(ar["elem"]),
+            n_items=ar["n"],
+            wire=p_u8(self.wire),
+            wire_len=int(getattr(self.payloads, "total_bytes", 0)),
+        )
+        if strided:
+            handle = lib.ytpu_finish_batch_strided(
+                ctypes.byref(fin), 15 * R, n_threads
+            )
+        else:
+            handle = lib.ytpu_finish_batch_mt(ctypes.byref(fin), n_threads)
+        try:
+            data_ptr = lib.ytpu_finish_data(handle)
+            if strided:
+                # vectorized offset/length-table handling (ISSUE-10): one
+                # native call fills the span/status tables, one copy lifts
+                # the output arena, and per-doc payloads are cheap bytes
+                # slices — replacing 3 ctypes round-trips PER DOC
+                offs = np.empty(n_active, dtype=np.int64)
+                lens = np.empty(n_active, dtype=np.int64)
+                stat = np.empty(n_active, dtype=np.int32)
+                lib.ytpu_finish_spans(
+                    handle, p_i64(offs), p_i64(lens), p_i32(stat)
+                )
+                total = int(lib.ytpu_finish_total_len(handle))
+                blob = ctypes.string_at(data_ptr, total) if total else b""
+                LAST_FINISH_STATUSES = stat.tolist()
+                return [
+                    blob[o : o + n] if s == 0 else None
+                    for o, n, s in zip(
+                        offs.tolist(), lens.tolist(), LAST_FINISH_STATUSES
+                    )
+                ]
+            out: List[Optional[bytes]] = []
+            statuses: List[int] = []
+            off = ctypes.c_int64()
+            ln = ctypes.c_int64()
+            for i in range(n_active):
+                rc = int(lib.ytpu_finish_status(handle, i))
+                statuses.append(rc)
+                if rc == 0:
+                    lib.ytpu_finish_span(
+                        handle, i, ctypes.byref(off), ctypes.byref(ln)
+                    )
+                    out.append(
+                        ctypes.string_at(
+                            ctypes.addressof(data_ptr.contents) + off.value,
+                            ln.value,
+                        )
+                    )
+                else:
+                    out.append(None)
+            LAST_FINISH_STATUSES = statuses
+            del keep_alive
+            return out
+        finally:
+            lib.ytpu_finish_free(handle)
+
+
+def finish_encode_diff_batch(
+    state: DocStateBatch,
+    docs,
+    ship: np.ndarray,
+    offsets: np.ndarray,
+    deleted: np.ndarray,
+    enc: "BatchEncoder",
+    payloads=None,
+    root_name: Optional[str] = None,
+) -> List[bytes]:
+    """Batched native finisher: selected device rows -> v1 payloads for
+    many docs in one C++ call (VERDICT r2 #6; reference equivalent:
+    store.rs:204-248 compiled). Byte-identical to `finish_encode_diff`;
+    docs holding a row outside the native scope (wire-ref Format/Embed,
+    unknown kinds) fall back to the Python finisher individually; wire
+    ContentType spans re-emit natively (verbatim copy).
+    `root_name` overrides the batch root branch name on the wire for this
+    call (per-tenant serving; all selected docs share it).
+    """
+    docs = list(docs)
+    ctx = _FinisherContext(enc, payloads)
+    if not ctx.ok:
+        return [
+            finish_encode_diff(
+                state, d, ship, offsets, deleted, enc, ctx.payloads, root_name
+            )
+            for d in docs
+        ]
+
+    bl = state.blocks
+    D, B = bl.client.shape
+
+    # Device-side row compaction (VERDICT r3 #3): only shipped/deleted/
+    # parent rows cross the device->host boundary, as ONE [Dsel, 15, R]
+    # tensor — R is the largest per-doc row set, bucketed to a power of
+    # two to bound recompiles (as is the doc-selection length).
+    ship_j = ship if isinstance(ship, jax.Array) else jnp.asarray(ship)
+    off_j = offsets if isinstance(offsets, jax.Array) else jnp.asarray(offsets)
+    del_j = deleted if isinstance(deleted, jax.Array) else jnp.asarray(deleted)
+    n_sel = len(docs)
+    sel_np = np.asarray(docs, dtype=np.int32)
+    _check_doc_selection(sel_np, D)
+    # no clamp to D: `docs` may legally repeat slots, so n_sel can exceed
+    # the doc capacity; padding entries repeat the first SELECTED doc so R
+    # (the packed width) is sized by the actual selection, not by doc 0
+    d_pad = _next_pow2(n_sel)
+    idx_np = np.full(d_pad, sel_np[0] if n_sel else 0, dtype=np.int32)
+    idx_np[:n_sel] = sel_np
+    idx = jnp.asarray(idx_np)
+    counts = np.asarray(_finish_counts(bl.parent, ship_j, del_j, idx))
+    R = min(_next_pow2(int(counts.max(initial=1))), B)
+    arr = np.asarray(compact_finisher_rows(bl, ship_j, off_j, del_j, idx, R))
+    # threading keys on TOTAL selected rows, not doc count (ISSUE-10)
+    threads = _finisher_threads(int(counts[:n_sel].sum()))
+    res = ctx.finish(arr, n_sel, root_name, threads)
+    return [
+        p
+        if p is not None
+        else finish_encode_diff(
+            state, d, ship, offsets, deleted, enc, ctx.payloads, root_name
+        )
+        for p, d in zip(res, docs)
+    ]
+
+
+# --- pipelined encode/diff (ISSUE-10 tentpole) ------------------------------
+
+
+@dataclass(frozen=True)
+class DiffPlan:
+    """Host-checkable sub-batch plan of a pipelined encode/diff run —
+    the dry-run assertion surface (`bench.py --dry-run`'s `diff_overlap`
+    rehearsal), mirroring `replay.OverlapPlan` for the apply side."""
+
+    n_docs: int
+    sub: int  # docs per sub-batch = the compiled doc width (pow2)
+    n_sub: int
+    depth: int  # max in-flight sub-batches per stage boundary
+    idx_buffers: int  # preallocated host index slots (donated per dispatch)
+    buffer_reuses: int  # times the index slot is re-filled after first use
+    donate_idx: bool = True  # the device selection buffer is donated
+
+
+def plan_diff_pipeline(
+    n_docs: int, sub_batch: int = 512, depth: int = 2
+) -> DiffPlan:
+    """Size the encode pipeline's sub-batches: the sub-batch doc width is
+    pow2 (ONE compiled `compact_finisher_rows` family per (sub, R) pair)
+    and never exceeds the pow2 bucket of the selection itself.  One host
+    index slot serves every sub-batch — `jnp.asarray` copies it at
+    dispatch and the device-side copy is donated into the pack program."""
+    n = max(0, int(n_docs))
+    if n == 0:
+        return DiffPlan(0, 0, 0, depth, 0, 0)
+    sub = min(_next_pow2(int(sub_batch), 1), _next_pow2(n, 1))
+    n_sub = -(-n // sub)
+    return DiffPlan(
+        n_docs=n,
+        sub=sub,
+        n_sub=n_sub,
+        depth=depth,
+        idx_buffers=1,
+        buffer_reuses=max(0, n_sub - 1),
     )
-    # many-doc batches fan out across cores (docs encode independently);
-    # small selections stay single-threaded to avoid spawn overhead
-    handle = lib.ytpu_finish_batch_mt(ctypes.byref(fin), 0 if len(docs) >= 128 else 1)
-    try:
-        data_ptr = lib.ytpu_finish_data(handle)
-        out: List[bytes] = []
-        off = ctypes.c_int64()
-        ln = ctypes.c_int64()
-        for i, d in enumerate(docs):
-            if lib.ytpu_finish_status(handle, i) == 0:
-                lib.ytpu_finish_span(handle, i, ctypes.byref(off), ctypes.byref(ln))
-                out.append(
-                    ctypes.string_at(
-                        ctypes.addressof(data_ptr.contents) + off.value, ln.value
-                    )
+
+
+@dataclass
+class DiffStats:
+    """One `DiffPipeline.run`: per-stage attribution + integrity counters."""
+
+    n_docs: int = 0
+    sub: int = 0
+    n_sub: int = 0
+    depth: int = 0
+    R: int = 0  # compiled finisher row width (pow2)
+    total_rows: int = 0  # selected rows across the whole call
+    threads: int = 0  # native n_threads decision (0 = pool, 1 = single)
+    select_s: float = 0.0  # device selection+compaction dispatch (staging)
+    d2h_s: float = 0.0  # blocking D2H drains (the middle stage)
+    finish_s: float = 0.0  # native finisher + per-doc peeling
+    stall_s: float = 0.0  # consumer waited on upstream (not hidden)
+    d2h_bytes: int = 0
+    overlap_ratio: float = 0.0
+    max_inflight: int = 0
+    syncs: int = 0  # blocking host materializations (counts pull + drains)
+    demotions: int = 0  # sub-batches degraded to the serial per-doc path
+    fallback_docs: int = 0  # rows peeled per doc by the Python finisher
+    buffer_reuses: int = 0
+
+
+class DiffPipeline:
+    """Staged encode/diff pipeline (ISSUE-10 tentpole): the device runs
+    selection + `compact_finisher_rows` for doc sub-batch k+1 while an
+    async D2H (the `OverlapPipeline` drain stage) pulls sub-batch k's
+    compacted [sub, 15, R] rows and the native finisher consumes
+    sub-batch k−1 — finisher calls batched per sub-batch instead of per
+    doc, D2H overlapped with device encode, and the per-doc Python glue
+    collapsed to vectorized offset/length tables (the encode-side replay
+    of PR 5's apply overlap + PR 7's memcpy staging, in the D2H
+    direction).
+
+    Exactly ONE jitted selection→compaction program per (sub, R) shape
+    family serves every sub-batch (both dims pow2-bucketed; the idx
+    selection buffer is donated per dispatch), and ONE blocking counts
+    pull sizes R for the whole call — so a run performs `n_sub + 1` host
+    materializations total, nothing per doc.
+
+    Degradation (fault sites `diff.d2h_fail` / `finisher.raise`, plus
+    any real D2H/native failure): the failing SUB-BATCH demotes to the
+    serial per-doc Python finisher path — counted by `encode.demotions`
+    — instead of dropping the diff; byte output is identical either way.
+
+    Gauges (docs/observability.md §Encode pipeline): `encode.select`,
+    `encode.d2h_bytes`, `encode.finish`, plus the engine's
+    `encode.stage`/`encode.drain`/`encode.stall`/`encode.overlap_ratio`/
+    `encode.inflight_depth` when ≥2 sub-batches actually pipeline."""
+
+    def __init__(self, sub_batch: int = 512, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        if sub_batch < 1:
+            raise ValueError(f"sub_batch must be >= 1, got {sub_batch}")
+        self.sub_batch = sub_batch
+        self.depth = depth
+        self.stats = DiffStats()
+
+    def plan(self, n_docs: int) -> DiffPlan:
+        return plan_diff_pipeline(n_docs, self.sub_batch, self.depth)
+
+    def run(
+        self,
+        state: DocStateBatch,
+        docs,
+        ship,
+        offsets,
+        deleted,
+        enc: "BatchEncoder",
+        payloads=None,
+        root_name: Optional[str] = None,
+    ) -> List[bytes]:
+        """Drop-in replacement for `finish_encode_diff_batch` over the
+        same selection outputs; byte-identical payloads, pipelined."""
+        from ytpu.models.replay import OverlapPipeline
+        from ytpu.utils import metrics
+        from ytpu.utils.faults import faults
+        from ytpu.utils.phases import phases
+
+        docs = list(docs)
+        n_sel = len(docs)
+        stats = self.stats = DiffStats(
+            n_docs=n_sel, depth=self.depth
+        )
+        if n_sel == 0:
+            return []
+        metrics.counter("encode.pipeline_runs").inc()
+        ctx = _FinisherContext(enc, payloads)
+        if not ctx.ok:
+            # no native finisher → nothing to batch against; the per-doc
+            # Python path serves the whole selection (parity unchanged)
+            stats.fallback_docs = n_sel
+            return [
+                finish_encode_diff(
+                    state, d, ship, offsets, deleted, enc, ctx.payloads,
+                    root_name,
                 )
-            else:
-                out.append(
-                    finish_encode_diff(
-                        state, d, ship, offsets, deleted, enc, payloads, root_name
-                    )
-                )
-        return out
-    finally:
-        lib.ytpu_finish_free(handle)
+                for d in docs
+            ]
+        bl = state.blocks
+        D, B = bl.client.shape
+        ship_j = ship if isinstance(ship, jax.Array) else jnp.asarray(ship)
+        off_j = (
+            offsets if isinstance(offsets, jax.Array) else jnp.asarray(offsets)
+        )
+        del_j = (
+            deleted if isinstance(deleted, jax.Array) else jnp.asarray(deleted)
+        )
+        sel_np = np.asarray(docs, dtype=np.int32)
+        _check_doc_selection(sel_np, D)
+        plan = self.plan(n_sel)
+        sub, n_sub = plan.sub, plan.n_sub
+        stats.sub, stats.n_sub = sub, n_sub
+
+        # ONE counts pull for the whole selection (a single blocking
+        # sync); R is shared by every sub-batch so one compiled pack
+        # family serves the run
+        d_pad = _next_pow2(n_sel)
+        idx_full = np.full(d_pad, sel_np[0], dtype=np.int32)
+        idx_full[:n_sel] = sel_np
+        t0 = time.perf_counter()
+        counts = np.asarray(
+            _finish_counts(bl.parent, ship_j, del_j, jnp.asarray(idx_full))
+        )[:n_sel]
+        stats.select_s += time.perf_counter() - t0
+        stats.syncs += 1
+        R = min(_next_pow2(int(counts.max(initial=1))), B)
+        stats.R = R
+        stats.total_rows = int(counts.sum())
+        stats.threads = _finisher_threads(stats.total_rows)
+        stats.buffer_reuses = plan.buffer_reuses
+
+        out: List[Optional[bytes]] = [None] * n_sel
+        host_full: dict = {}
+
+        def host_arrays() -> dict:
+            # degraded-path only: the serial per-doc finisher reads the
+            # full [D, B] selection arrays on host (one extra sync each,
+            # cached for the rest of the run)
+            if not host_full:
+                host_full["ship"] = np.asarray(ship_j)
+                host_full["offsets"] = np.asarray(off_j)
+                host_full["deleted"] = np.asarray(del_j)
+                stats.syncs += 3
+            return host_full
+
+        def py_doc(d: int) -> bytes:
+            h = host_arrays()
+            return finish_encode_diff(
+                state, d, h["ship"], h["offsets"], h["deleted"], enc,
+                ctx.payloads, root_name,
+            )
+
+        def finish_sub(lo: int, hi: int, host: Optional[np.ndarray]) -> None:
+            if host is None:
+                # demoted sub-batch: serial per-doc finisher — the diff
+                # still ships, slower
+                for j in range(lo, hi):
+                    out[j] = py_doc(docs[j])
+                return
+            threads = _finisher_threads(int(counts[lo:hi].sum()))
+            res = ctx.finish(host, hi - lo, root_name, threads)
+            for j, payload in enumerate(res):
+                if payload is None:
+                    stats.fallback_docs += 1
+                    out[lo + j] = py_doc(docs[lo + j])
+                else:
+                    out[lo + j] = payload
+
+        idx_host = np.empty(sub, dtype=np.int32)  # the ONE reusable slot
+
+        def produce():
+            for k in range(n_sub):
+                lo = k * sub
+                hi = min(lo + sub, n_sel)
+                idx_host[: hi - lo] = sel_np[lo:hi]
+                idx_host[hi - lo :] = sel_np[lo]  # pad repeats a SELECTED doc
+                # jnp.asarray copies → the host slot is reusable at once;
+                # the device copy is donated into the pack program
+                idx = jnp.asarray(idx_host)
+                arr = compact_finisher_rows(bl, ship_j, off_j, del_j, idx, R)
+                yield (lo, hi, arr)
+
+        # stats-field ownership is per stage/thread (no locks needed):
+        # drain (worker thread) only touches syncs/d2h_bytes, consume
+        # (caller thread) owns demotions/fallback_docs/finish_s — a
+        # failed drain hands a None marker down and the CONSUMER counts
+        # the demotion, so the two threads never race one field
+        def drain(item):
+            lo, hi, arr = item
+            try:
+                faults.maybe_raise("diff.d2h_fail")
+                host = np.asarray(arr)  # the pipelined D2H: blocks HERE,
+                # overlapped with both neighbor stages
+            except Exception:
+                return (lo, hi, None)
+            stats.syncs += 1
+            stats.d2h_bytes += host.nbytes
+            return (lo, hi, host)
+
+        def consume(item):
+            lo, hi, host = item
+            t0 = time.perf_counter()
+            try:
+                if host is None:
+                    raise RuntimeError("d2h drain failed")  # demote below
+                faults.maybe_raise("finisher.raise")
+                finish_sub(lo, hi, host)
+            except Exception:
+                stats.demotions += 1
+                metrics.counter("encode.demotions").inc()
+                finish_sub(lo, hi, None)
+            stats.finish_s += time.perf_counter() - t0
+
+        if n_sub == 1:
+            # nothing to overlap (the serving server's single-tenant
+            # SyncStep1 answer): run the three stages inline — no threads,
+            # no queue hops, same gauges minus the overlap ratio
+            gen = produce()
+            t0 = time.perf_counter()
+            item = next(gen)
+            stats.select_s += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            drained = drain(item)
+            stats.d2h_s += time.perf_counter() - t0
+            consume(drained)
+        else:
+            pipe = OverlapPipeline(depth=self.depth, stage_prefix="encode")
+            ostats = pipe.run(produce(), consume, drain=drain)
+            stats.select_s += ostats.stage_s
+            stats.d2h_s += ostats.drain_s
+            stats.stall_s += ostats.stall_s
+            stats.overlap_ratio = ostats.overlap_ratio
+            stats.max_inflight = ostats.max_depth
+        if phases.enabled:
+            phases.add_time("encode.select", stats.select_s, n_sub)
+            phases.add_time("encode.finish", stats.finish_s, n_sub)
+            phases.add_value("encode.d2h_bytes", stats.d2h_bytes)
+            phases.transfer("encode.d2h", stats.d2h_bytes, "d2h")
+        return out  # type: ignore[return-value]  — every slot is filled
 
 
 @partial(jax.jit, static_argnums=1)

@@ -332,6 +332,12 @@ class OverlapStats:
     stall_s: float = 0.0  # main thread: waited on staging (not hidden)
     max_depth: int = 0  # high-water staged-but-unconsumed chunks
     overlap_ratio: float = 0.0  # fraction of stage_s hidden behind dispatch
+    # consumer-side drain stage (ISSUE-10): items that passed through the
+    # optional `drain` callable and the wall time it spent — the encode
+    # pipeline uses it for the async D2H pull, so device→host transfer
+    # time attributes separately from both staging and the finisher
+    drained: int = 0
+    drain_s: float = 0.0
 
 
 class OverlapPipeline:
@@ -342,11 +348,21 @@ class OverlapPipeline:
     chunk k to the device — wall-clock approaches max(stage, dispatch)
     instead of their sum.
 
-    `run(produce, consume)`: `produce` is an iterator driven on the
-    worker thread (each `next()` is timed as staging); `consume(item)`
-    runs on the calling thread. The queue holds at most `depth` staged
-    items (backpressure). Exceptions from either side cancel the other
-    and re-raise on the caller.
+    `run(produce, consume, drain=None)`: `produce` is an iterator driven
+    on the worker thread (each `next()` is timed as staging);
+    `consume(item)` runs on the calling thread. The queue holds at most
+    `depth` staged items (backpressure). Exceptions from any side cancel
+    the others and re-raise on the caller.
+
+    `drain` (ISSUE-10) inserts a CONSUMER-SIDE middle stage on its own
+    worker thread: staged items pass through `drain(item)` before the
+    caller's `consume` sees the result, with the drain wall time
+    attributed separately (`stats.drain_s`, `<prefix>.drain` phase). The
+    encode pipeline runs the blocking D2H pull there, so sub-batch k's
+    device→host transfer overlaps BOTH the device compaction of k+1
+    (produce) and the native finisher of k−1 (consume) — a three-stage
+    pipeline with per-stage attribution. Each stage boundary holds at
+    most `depth` items.
 
     The end-of-stream sentinel is enqueued with the same blocking
     stop-checked loop as items: the previous `UpdatePipeline` machinery
@@ -379,18 +395,37 @@ class OverlapPipeline:
         dead consumer will never free) must poll this and bail."""
         return self._stop.is_set()
 
-    def run(self, produce: Iterable, consume: Callable) -> OverlapStats:
+    def run(
+        self,
+        produce: Iterable,
+        consume: Callable,
+        drain: Optional[Callable] = None,
+    ) -> OverlapStats:
         from ytpu.utils.phases import phases
 
         # fresh per run(): teardown sets the event, and a stale set event
         # would skip the worker's sentinel-put on reuse — stranding the
         # caller in q.get() forever
         self._stop = threading.Event()
-        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        q_in: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        # the drain stage gets its own boundary queue; without one the
+        # consumer reads the staging queue directly (PR-5 shape)
+        q_out: "queue.Queue" = (
+            q_in if drain is None else queue.Queue(maxsize=self.depth)
+        )
         SENTINEL = object()
         err: List[BaseException] = []
         stop = self._stop
         stats = OverlapStats()
+
+        def _put(q, item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
             from ytpu.utils.faults import faults
@@ -406,64 +441,86 @@ class OverlapPipeline:
                         return
                     stats.stage_s += time.perf_counter() - t0
                     stats.staged += 1
-                    while not stop.is_set():
-                        try:
-                            q.put(item, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
+                    if not _put(q_in, item):
+                        return
             except BaseException as e:  # surface staging errors on caller
                 err.append(e)
             finally:
+                _put(q_in, SENTINEL)
+
+        def drainer():
+            try:
                 while not stop.is_set():
                     try:
-                        q.put(SENTINEL, timeout=0.1)
-                        break
-                    except queue.Full:
+                        item = q_in.get(timeout=0.1)
+                    except queue.Empty:
                         continue
+                    if item is SENTINEL:
+                        return
+                    t0 = time.perf_counter()
+                    out = drain(item)
+                    stats.drain_s += time.perf_counter() - t0
+                    stats.drained += 1
+                    if not _put(q_out, out):
+                        return
+            except BaseException as e:  # surface drain errors on caller
+                err.append(e)
+            finally:
+                _put(q_out, SENTINEL)
 
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
+        threads = [threading.Thread(target=worker, daemon=True)]
+        if drain is not None:
+            threads.append(threading.Thread(target=drainer, daemon=True))
+        for t in threads:
+            t.start()
         try:
             while True:
                 t0 = time.perf_counter()
-                item = q.get()
+                item = q_out.get()
                 stats.stall_s += time.perf_counter() - t0
                 if item is SENTINEL:
                     break
                 if err:
-                    # staging died: abandon the staged backlog NOW rather
-                    # than integrating ahead of an error that voids the
-                    # run anyway — the finally below drains the queue and
-                    # the stop event releases any producer-held buffers,
-                    # so a raising producer never strands the consumer
+                    # an upstream stage died: abandon the staged backlog
+                    # NOW rather than integrating ahead of an error that
+                    # voids the run anyway — the finally below drains the
+                    # queues and the stop event releases any producer-held
+                    # buffers, so a raising stage never strands the caller
                     break
                 # qsize()+1 races a worker put landing between the get
                 # and this read; the queue cap bounds TRUE in-flight at
-                # depth, so clamp the gauge to what is actually possible
+                # depth PER STAGE BOUNDARY, so clamp the gauge to what is
+                # actually possible at the consumer-facing boundary
                 stats.max_depth = max(
-                    stats.max_depth, min(self.depth, q.qsize() + 1)
+                    stats.max_depth, min(self.depth, q_out.qsize() + 1)
                 )
                 consume(item)
                 stats.consumed += 1
         finally:
             stop.set()
-            while True:  # unblock a worker mid-put
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
-            t.join()
+            for q in (q_in, q_out):
+                while True:  # unblock a worker mid-put
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        break
+            for t in threads:
+                t.join()
         if err:
             raise err[0]
-        if stats.stage_s > 0:
+        hideable = stats.stage_s + stats.drain_s
+        if hideable > 0:
+            # with a drain stage, the hideable host work is staging PLUS
+            # the D2H drain; stall still measures what the caller waited
             stats.overlap_ratio = max(
-                0.0, min(1.0, 1.0 - stats.stall_s / stats.stage_s)
+                0.0, min(1.0, 1.0 - stats.stall_s / hideable)
             )
         if phases.enabled:
             p = self.stage_prefix
             phases.add_time(f"{p}.stage", stats.stage_s, stats.staged)
             phases.add_time(f"{p}.stall", stats.stall_s, max(1, stats.consumed))
+            if drain is not None:
+                phases.add_time(f"{p}.drain", stats.drain_s, stats.drained)
             phases.set_value(f"{p}.overlap_ratio", stats.overlap_ratio)
             phases.set_max(f"{p}.inflight_depth", stats.max_depth)
         return stats

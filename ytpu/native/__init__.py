@@ -287,6 +287,31 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_int64),
             ]
             lib.ytpu_finish_free.argtypes = [ctypes.c_void_p]
+            # ISSUE-10 additions: the strided packed-arena entry (one
+            # host tensor, zero per-plane copies) and the vectorized
+            # span/status readout. A stale .so that predates them (no
+            # compiler to rebuild) degrades to the classic per-column /
+            # per-doc path — `finisher_strided_ok` gates the callers.
+            try:
+                lib.ytpu_finish_batch_strided.restype = ctypes.c_void_p
+                lib.ytpu_finish_batch_strided.argtypes = [
+                    ctypes.POINTER(FinishIn),
+                    ctypes.c_int64,
+                    ctypes.c_int32,
+                ]
+                lib.ytpu_finish_total_len.restype = ctypes.c_int64
+                lib.ytpu_finish_total_len.argtypes = [ctypes.c_void_p]
+                lib.ytpu_finish_spans.argtypes = [
+                    ctypes.c_void_p,
+                    ctypes.POINTER(ctypes.c_int64),
+                    ctypes.POINTER(ctypes.c_int64),
+                    ctypes.POINTER(ctypes.c_int32),
+                ]
+                lib.finisher_strided_ok = True
+            except AttributeError:
+                lib.finisher_strided_ok = False
+        else:
+            lib.finisher_strided_ok = False
         _lib = lib
         return _lib
 

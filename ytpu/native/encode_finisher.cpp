@@ -470,7 +470,31 @@ struct FinishOut {
 
 class DocEncoder {
  public:
-  DocEncoder(const FinishIn& in, int32_t doc) : in_(in), base_(static_cast<int64_t>(doc) * in.n_blocks_cap) {}
+  // doc_stride < 0: classic column layout — every column is a dense
+  // [n_docs, n_blocks_cap] array, ship/deleted are u8.  doc_stride >= 0:
+  // STRIDED packed-arena layout (ISSUE-10) — the column pointers all
+  // point into ONE host copy of the device's packed [D, 15, R] i32
+  // tensor (pointer for plane k = arena + k*R), consecutive docs are
+  // doc_stride (= 15*R) apart, and the ship/offsets/deleted planes are
+  // i32 like everything else (no per-plane u8 conversion copies).
+  DocEncoder(const FinishIn& in, int32_t doc, int64_t doc_stride)
+      : in_(in),
+        base_(static_cast<int64_t>(doc) *
+              (doc_stride < 0 ? in.n_blocks_cap : doc_stride)),
+        ship32_(doc_stride < 0
+                    ? nullptr
+                    : reinterpret_cast<const int32_t*>(in.ship)),
+        del32_(doc_stride < 0
+                   ? nullptr
+                   : reinterpret_cast<const int32_t*>(in.deleted)) {}
+
+  bool ship_at(int32_t r) const {
+    return ship32_ ? ship32_[base_ + r] != 0 : in_.ship[base_ + r] != 0;
+  }
+
+  bool deleted_at(int32_t r) const {
+    return del32_ ? del32_[base_ + r] != 0 : in_.deleted[base_ + r] != 0;
+  }
 
   // returns false → caller must fall back to the Python finisher
   bool run(Buf& out) {
@@ -479,7 +503,7 @@ class DocEncoder {
     std::vector<int32_t> rows;
     rows.reserve(64);
     for (int32_t r = 0; r < B; r++)
-      if (in_.ship[base_ + r]) rows.push_back(r);
+      if (ship_at(r)) rows.push_back(r);
     // client set, ordered by real id descending
     std::vector<int32_t> clients;
     for (int32_t r : rows) {
@@ -727,7 +751,7 @@ class DocEncoder {
     };
     std::vector<Entry> entries;
     for (int32_t r = 0; r < B; r++) {
-      if (!in_.deleted[base_ + r]) continue;
+      if (!deleted_at(r)) continue;
       const int32_t c = in_.client[base_ + r];
       if (c < 0 || c >= in_.n_interned) return false;
       const int64_t real = in_.from_idx[c];
@@ -770,6 +794,8 @@ class DocEncoder {
 
   const FinishIn& in_;
   const int64_t base_;
+  const int32_t* ship32_;  // strided mode only (else null → u8 masks)
+  const int32_t* del32_;
   std::string scratch_;
 };
 
@@ -782,7 +808,8 @@ struct Shard {
   std::vector<int32_t> status;
 };
 
-void encode_range(const FinishIn& in, int32_t lo, int32_t hi, Shard& sh) {
+void encode_range(const FinishIn& in, int64_t doc_stride, int32_t lo,
+                  int32_t hi, Shard& sh) {
   const int32_t n = hi - lo;
   sh.off.assign(n, 0);
   sh.len.assign(n, 0);
@@ -791,7 +818,7 @@ void encode_range(const FinishIn& in, int32_t lo, int32_t hi, Shard& sh) {
   for (int32_t i = lo; i < hi; i++) {
     const int32_t doc = in.sel[i];
     const size_t start = buf.b.size();
-    DocEncoder enc(in, doc);
+    DocEncoder enc(in, doc, doc_stride);
     if (doc < 0 || doc >= in.n_docs_total || !enc.run(buf)) {
       buf.b.resize(start);  // drop partial output
       continue;
@@ -814,9 +841,13 @@ int64_t ytpu_finish_in_sizeof() { return static_cast<int64_t>(sizeof(FinishIn));
 
 // Docs encode independently (FinishIn is read-only; each DocEncoder owns
 // its scratch), so the batch splits into contiguous chunks of `sel`, one
-// per worker. n_threads <= 0 means hardware concurrency. Called with the
-// GIL released (ctypes drops it around foreign calls).
-void* ytpu_finish_batch_mt(const FinishIn* in, int32_t n_threads) {
+// per worker. n_threads <= 0 means hardware concurrency — the Python
+// caller decides whether a pool is worth spawning (it thresholds on
+// TOTAL selected rows, not doc count, so a few huge docs still fan out);
+// this side only caps workers at one doc per chunk. Called with the GIL
+// released (ctypes drops it around foreign calls).
+void* finish_batch_impl(const FinishIn* in, int64_t doc_stride,
+                        int32_t n_threads) {
   auto* out = new FinishOut();
   const int32_t n = in->n_sel;
   out->span_off.resize(n);
@@ -825,12 +856,13 @@ void* ytpu_finish_batch_mt(const FinishIn* in, int32_t n_threads) {
   if (n == 0) return out;
   int32_t hw = static_cast<int32_t>(std::thread::hardware_concurrency());
   if (hw <= 0) hw = 1;
+  // one doc per chunk at minimum granularity; the max() keeps a direct
+  // ABI caller with a degenerate n_sel from ever sizing zero shards
   int32_t t = n_threads <= 0 ? hw : std::min(n_threads, hw);
-  // ~64 docs per chunk keeps thread spawn cost irrelevant for small calls
-  t = std::min(t, std::max(int32_t{1}, n / 64));
+  t = std::max(int32_t{1}, std::min(t, n));
   std::vector<Shard> shards(t);
   if (t <= 1) {
-    encode_range(*in, 0, n, shards[0]);
+    encode_range(*in, doc_stride, 0, n, shards[0]);
   } else {
     std::vector<std::thread> pool;
     pool.reserve(t);
@@ -838,7 +870,7 @@ void* ytpu_finish_batch_mt(const FinishIn* in, int32_t n_threads) {
       const int32_t lo = static_cast<int32_t>(static_cast<int64_t>(n) * k / t);
       const int32_t hi =
           static_cast<int32_t>(static_cast<int64_t>(n) * (k + 1) / t);
-      pool.emplace_back(encode_range, std::cref(*in), lo, hi,
+      pool.emplace_back(encode_range, std::cref(*in), doc_stride, lo, hi,
                         std::ref(shards[k]));
     }
     for (auto& th : pool) th.join();
@@ -859,6 +891,21 @@ void* ytpu_finish_batch_mt(const FinishIn* in, int32_t n_threads) {
   return out;
 }
 
+void* ytpu_finish_batch_mt(const FinishIn* in, int32_t n_threads) {
+  return finish_batch_impl(in, -1, n_threads);
+}
+
+// ISSUE-10: the packed-arena entry — the column pointers in `in` point
+// into one contiguous host copy of the device's packed [D, 15, R] i32
+// tensor (plane k's pointer = arena + k*R) and consecutive docs sit
+// `doc_stride` (= 15*R) int32s apart.  Saves the 15 per-plane
+// `ascontiguousarray` copies the classic entry needs; the ship/offsets/
+// deleted planes are read as i32.
+void* ytpu_finish_batch_strided(const FinishIn* in, int64_t doc_stride,
+                                int32_t n_threads) {
+  return finish_batch_impl(in, doc_stride, n_threads);
+}
+
 void* ytpu_finish_batch(const FinishIn* in) {
   return ytpu_finish_batch_mt(in, 1);
 }
@@ -876,6 +923,22 @@ void ytpu_finish_span(void* h, int32_t i, int64_t* off, int64_t* len) {
   auto* o = static_cast<FinishOut*>(h);
   *off = o->span_off[i];
   *len = o->span_len[i];
+}
+
+int64_t ytpu_finish_total_len(void* h) {
+  return static_cast<int64_t>(static_cast<FinishOut*>(h)->data.size());
+}
+
+// ISSUE-10: vectorized span/status readout — one call fills the caller's
+// offset/length/status tables for the whole batch, replacing the 3
+// ctypes round-trips PER DOC of the span/status getters (the "per-doc
+// Python glue" half of the old finisher handoff).
+void ytpu_finish_spans(void* h, int64_t* off, int64_t* len, int32_t* status) {
+  auto* o = static_cast<FinishOut*>(h);
+  const size_t n = o->status.size();
+  std::memcpy(off, o->span_off.data(), n * sizeof(int64_t));
+  std::memcpy(len, o->span_len.data(), n * sizeof(int64_t));
+  std::memcpy(status, o->status.data(), n * sizeof(int32_t));
 }
 
 void ytpu_finish_free(void* h) { delete static_cast<FinishOut*>(h); }

@@ -286,6 +286,11 @@ class SoakDriver:
             self.server.admission = self.admission
         adm_before = _admission_values()
         applied_server_before = metrics.counter("sync.updates_applied").value
+        # the diff path routes through the encode pipeline (ISSUE-10):
+        # score how many answers it served and whether any sub-batch had
+        # to demote to the serial per-doc finisher
+        diff_pipe_before = metrics.counter("encode.pipeline_runs").value
+        enc_demotions_before = metrics.counter("encode.demotions").value
         scenario = self.scenario
         self._preregister_clients(scenario)
         rtt_floor_s = self._measure_rtt_floor(scenario)
@@ -383,6 +388,12 @@ class SoakDriver:
         report["admission"] = {
             k: adm_after[k] - adm_before[k] for k in adm_after
         }
+        report["diff_pipeline_runs"] = (
+            metrics.counter("encode.pipeline_runs").value - diff_pipe_before
+        )
+        report["encode_demotions"] = (
+            metrics.counter("encode.demotions").value - enc_demotions_before
+        )
         mirror = self._mirror_parity()
         if mirror is not None:
             report["mirror_parity"] = mirror

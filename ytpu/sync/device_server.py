@@ -15,8 +15,9 @@ Two serving modes:
   bottleneck.
 - **device-authoritative** (`device_authoritative=True`): the device
   batch IS the document store. SyncStep1 is answered from device state
-  via `encode_diff_batch` + `finish_encode_diff` (store.rs:204-248
-  semantics over block columns), incoming updates are queued straight to
+  via `encode_diff_batch` + the pipelined finisher
+  (`batch_doc.DiffPipeline`, ISSUE-10; store.rs:204-248 semantics over
+  block columns), incoming updates are queued straight to
   the slot without a host apply, and the host tenant doc is demoted to
   an awareness/metadata anchor that never sees document content. This is
   the serving loop where the batch engine adds capacity instead of
@@ -62,6 +63,8 @@ class DeviceSyncServer(SyncServer):
         capacity: int = 2048,
         ingestor: Optional[BatchIngestor] = None,
         device_authoritative: bool = False,
+        diff_sub_batch: int = 512,
+        diff_depth: int = 2,
         **kwargs,
     ):
         super().__init__(**kwargs)
@@ -80,6 +83,15 @@ class DeviceSyncServer(SyncServer):
         self._slots_gauge = metrics.gauge("sync.device_slots_assigned")
         self._queue_depth = metrics.gauge("sync.device_queue_depth")
         self._slot_of: Dict[str, int] = {}
+        # pipelined encode/diff driver (ISSUE-10): every SyncStep1 answer
+        # and batched fan-out routes through it — single-tenant calls take
+        # its inline one-sub-batch path, many-tenant fan-outs overlap
+        # device compaction / D2H / native finisher as staged sub-batches
+        from ytpu.models.batch_doc import DiffPipeline
+
+        self._diff_pipeline = DiffPipeline(
+            sub_batch=diff_sub_batch, depth=diff_depth
+        )
         # per-tenant wire root name (the batch engine maps any single-root
         # tenant onto one device branch; the name must round-trip on the
         # wire — doc.rs root branches are keyed by name). Learned from the
@@ -393,39 +405,64 @@ class DeviceSyncServer(SyncServer):
         slot = self.slot_of(tenant_name)
         return StateVector(dict(self.ingestor.svs[slot].clocks))
 
+    def _remote_matrix(self, slot_svs) -> "tuple[np.ndarray, int]":
+        """One [n_docs, n_clients] remote-clock matrix over interned
+        clients (n_clients pow2 to bound `encode_diff_batch` retraces),
+        with each (slot, StateVector) pair filling its slot's row."""
+        interner = self.ingestor.enc.interner
+        n_clients = 1
+        while n_clients < max(2, len(interner)):
+            n_clients *= 2
+        remote = np.zeros((self.ingestor.n_docs, n_clients), dtype=np.int32)
+        for slot, sv in slot_svs:
+            for client, clock in sv:
+                idx = interner.to_idx.get(client)
+                if idx is not None and idx < n_clients:
+                    remote[slot, idx] = clock
+        return remote, n_clients
+
+    def _merge_pending(self, slot: int, payload: bytes) -> bytes:
+        """Fold a slot's pending stash into an encoded diff, exactly like
+        the reference's merge_pending (transaction.rs:247-263)."""
+        ing = self.ingestor
+        pending = ing.pending_update(slot)
+        pending_ds = ing.pending_ds(slot)
+        if pending is None and pending_ds is None:
+            return payload
+        from ytpu.compat import merge_updates
+        from ytpu.core.update import Update as _U
+
+        extras = []
+        if pending is not None:
+            extras.append(pending.encode_v1())
+        if pending_ds is not None:
+            # stashed delete ranges must reach fresh replicas too
+            extras.append(_U({}, pending_ds).encode_v1())
+        return merge_updates(payload, *extras)
+
     def device_encode_diff(
         self, tenant_name: str, remote_sv: StateVector
     ) -> bytes:
         """Sync step 2 answered from device state: `encode_diff_batch`
-        masks/offsets on device, the host finisher emits wire bytes from
-        the block columns + payload buffers, and any pending stash folds
-        in exactly like the reference's merge_pending (transaction.rs:
-        247-263)."""
+        masks/offsets on device, the pipelined finisher (`DiffPipeline`,
+        ISSUE-10) compacts the shipped rows on device and emits wire
+        bytes from ONE packed host tensor, and any pending stash folds in
+        exactly like the reference's merge_pending (transaction.rs:
+        247-263).  A single tenant takes the pipeline's inline
+        one-sub-batch path (no thread hops); `device_encode_diff_many`
+        is the fan-out entry that actually overlaps the stages."""
         import jax.numpy as jnp
 
-        from ytpu.models.batch_doc import (
-            encode_diff_batch,
-            finish_encode_diff_batch,
-        )
+        from ytpu.models.batch_doc import encode_diff_batch
 
         self.flush_device()
         ing = self.ingestor
         slot = self.slot_of(tenant_name)
-        interner = ing.enc.interner
-        n_clients = 1
-        while n_clients < max(2, len(interner)):
-            n_clients *= 2
-        remote = np.zeros((ing.n_docs, n_clients), dtype=np.int32)
-        for client, clock in remote_sv:
-            idx = interner.to_idx.get(client)
-            if idx is not None and idx < n_clients:
-                remote[slot, idx] = clock
+        remote, n_clients = self._remote_matrix([(slot, remote_sv)])
         ship, offsets, _local, deleted = encode_diff_batch(
             ing.state, jnp.asarray(remote), n_clients
         )
-        # device arrays stay device-resident: the finisher compacts the
-        # shipped rows on device and pulls ONE packed tensor to host
-        payload = finish_encode_diff_batch(
+        payload = self._diff_pipeline.run(
             ing.state,
             [slot],
             ship,
@@ -435,21 +472,62 @@ class DeviceSyncServer(SyncServer):
             payloads=ing.payloads,
             root_name=self._root_names.get(tenant_name),
         )[0]
-        pending = ing.pending_update(slot)
-        pending_ds = ing.pending_ds(slot)
-        if pending is not None or pending_ds is not None:
-            from ytpu.compat import merge_updates
-            from ytpu.core.update import Update as _U
-
-            extras = []
-            if pending is not None:
-                extras.append(pending.encode_v1())
-            if pending_ds is not None:
-                # stashed delete ranges must reach fresh replicas too
-                extras.append(_U({}, pending_ds).encode_v1())
-            payload = merge_updates(payload, *extras)
+        payload = self._merge_pending(slot, payload)
         self._diffs_encoded.labels(tenant_name).inc()
         return payload
+
+    def device_encode_diff_many(self, requests) -> List[bytes]:
+        """Batched sync-step-2 fan-out (ISSUE-10): answer MANY tenants'
+        SyncStep1s in one device selection + one pipelined finisher pass
+        — the shape a million-user fan-out actually ships.  `requests`
+        is an iterable of (tenant_name, StateVector); returns the v1
+        payloads in request order.  One request per tenant (two SVs for
+        one tenant would collide on the slot's remote-clock row — issue
+        separate calls for that)."""
+        requests = list(requests)
+        if not requests:
+            return []
+        import jax.numpy as jnp
+
+        from ytpu.models.batch_doc import encode_diff_batch
+
+        self.flush_device()
+        ing = self.ingestor
+        slots = [self.slot_of(t) for t, _ in requests]
+        if len(set(slots)) != len(slots):
+            raise ValueError(
+                "device_encode_diff_many takes one request per tenant; "
+                "duplicate tenants collide on the slot's remote-clock row"
+            )
+        remote, n_clients = self._remote_matrix(
+            [(s, sv) for s, (_, sv) in zip(slots, requests)]
+        )
+        ship, offsets, _local, deleted = encode_diff_batch(
+            ing.state, jnp.asarray(remote), n_clients
+        )
+        # the native finisher call carries ONE root name: group requests
+        # by their tenant's wire root (usually a single group) and run
+        # the pipeline per group
+        out: List[Optional[bytes]] = [None] * len(requests)
+        groups: Dict[Optional[str], List[int]] = {}
+        for i, (t, _) in enumerate(requests):
+            groups.setdefault(self._root_names.get(t), []).append(i)
+        for root, idxs in groups.items():
+            res = self._diff_pipeline.run(
+                ing.state,
+                [slots[i] for i in idxs],
+                ship,
+                offsets,
+                deleted,
+                ing.enc,
+                payloads=ing.payloads,
+                root_name=root,
+            )
+            for i, p in zip(idxs, res):
+                out[i] = self._merge_pending(slots[i], p)
+        for t, _ in requests:
+            self._diffs_encoded.labels(t).inc()
+        return out  # type: ignore[return-value]
 
     # --- device dispatch -------------------------------------------------------
 

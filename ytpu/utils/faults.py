@@ -66,6 +66,14 @@ Standard sites (see docs/robustness.md for the full taxonomy):
                       decision to refuse (typed `QueueFull` → protocol
                       Busy reply / drop / shed per the armed policy;
                       args: ``tenant`` restricts to one tenant)
+``diff.d2h_fail``     encode pipeline (ISSUE-10): fail one sub-batch's
+                      device→host drain of the compacted finisher rows —
+                      the sub-batch demotes to the serial per-doc
+                      finisher path (``encode.demotions``) instead of
+                      dropping the diff
+``finisher.raise``    encode pipeline (ISSUE-10): raise in place of the
+                      batched native finisher call for one sub-batch —
+                      same serial per-doc demotion, byte output intact
 ====================  =======================================================
 
 Every fired injection increments the ``faults.injected`` counter (plus a

@@ -22,6 +22,13 @@ Disabled-path contract (the default): one attribute check, zero
 allocation — call sites guard with ``if phases.enabled:`` before
 building keys, and ``span()`` hands back a shared no-op context
 manager. Enable via ``YTPU_PHASES=1`` or ``phases.enable()``.
+
+Stage namespaces: ``replay.*`` is the async apply pipeline (stage /
+stall / overlap_ratio / inflight_depth / stage_bytes...), ``encode.*``
+the pipelined diff finisher (select / drain / finish / stall /
+overlap_ratio / d2h_bytes — ISSUE-10, docs/observability.md §Encode
+pipeline); ``rehearsal*.*`` keys come from bench dry-run simulations,
+never from real runs.
 """
 
 from __future__ import annotations
