@@ -1111,6 +1111,137 @@ def soak_dry_run() -> dict:
     }
 
 
+def telemetry_dry_run() -> dict:
+    """CPU rehearsal of the LIVE telemetry plane (ISSUE-11): a mini-soak
+    scraped over real HTTP *mid-run*, asserting the scrape agrees with
+    the final report —
+
+    - **in-proc leg**: a `SoakDriver(telemetry_port=0)` probes itself at
+      50% of the schedule: `/healthz` answers, `/snapshot`'s live
+      ``soak`` section shows the run in flight, and its windowed
+      ``apply_e2e_count`` is a prefix of (≤) the final report's count,
+      which in turn equals the registry delta — the mid-run view and the
+      post-hoc view are the same numbers at two times;
+    - **TCP leg**: `run_soak_tcp(telemetry_port=0)` with a mid-soak
+      `/metrics` scrape — the Prometheus text carries real ``net_*``
+      series whose mid-run sample is ≤ the final counter, and the final
+      ``net.frames_in`` delta covers every frame the driver sent.
+
+    Shares the (n_docs=4, capacity=256) device family the soak rehearsal
+    already compiled, so the plane costs no extra traces."""
+    import urllib.request
+
+    from ytpu.serving import Scenario, ScenarioConfig, SoakDriver
+    from ytpu.serving.soak import run_soak_tcp
+    from ytpu.sync.device_server import DeviceSyncServer
+    from ytpu.utils import metrics
+
+    def get(port: int, path: str) -> str:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as r:
+            assert r.status == 200, (path, r.status)
+            return r.read().decode()
+
+    def prom_sample(text: str, name: str) -> float:
+        for ln in text.splitlines():
+            if ln.startswith(name + " ") or ln.startswith(name + "{"):
+                return float(ln.rsplit(" ", 1)[1])
+        raise AssertionError(f"{name} not in /metrics exposition")
+
+    cfg = ScenarioConfig(
+        n_tenants=3, n_sessions=8, events_per_session=8, seed=5
+    )
+    e2e_hist = metrics.histogram("soak.apply_e2e")
+    e2e_before = e2e_hist.count
+    scraped = {}
+
+    def probe():
+        port = drv.telemetry.port
+        scraped["metrics_text"] = get(port, "/metrics")
+        scraped["snapshot"] = json.loads(get(port, "/snapshot"))
+        scraped["healthz"] = json.loads(get(port, "/healthz"))
+
+    drv = SoakDriver(
+        DeviceSyncServer(n_docs=4, capacity=256),
+        Scenario(cfg),
+        flush_every=4,
+        telemetry_port=0,
+        probe_at=0.5,
+        probe=probe,
+    )
+    try:
+        rep = drv.run()
+    finally:
+        drv.telemetry.stop()
+    assert scraped, "mid-soak probe never fired"
+    assert scraped["healthz"]["status"] == "ok", scraped["healthz"]
+    assert "lane_ladder" in scraped["healthz"]
+    live = scraped["snapshot"]["soak"]
+    assert live["running"] is True, "scrape was not mid-run"
+    mid_e2e = live["apply_e2e_count"]
+    assert 0 < mid_e2e <= rep["apply_e2e_count"], (mid_e2e, rep)
+    assert rep["apply_e2e_count"] == e2e_hist.count - e2e_before, (
+        "final report disagrees with the registry window"
+    )
+    # the scrape sees the same registry: mid-run counter ≤ final value
+    mid_applied = prom_sample(
+        scraped["metrics_text"], "sync_updates_applied_total"
+    )
+    final_applied = metrics.counter("sync.updates_applied").value
+    assert 0 < mid_applied <= final_applied, (mid_applied, final_applied)
+    assert "soak_apply_e2e_count" in scraped["metrics_text"]
+
+    # --- TCP leg: real sockets, net.* series on the wire ---------------------
+    frames_in = metrics.counter("net.frames_in")
+    net_before = frames_in.value
+    tcp_scraped = {}
+
+    def tcp_probe(port):
+        tcp_scraped["metrics_text"] = get(port, "/metrics")
+        tcp_scraped["healthz"] = json.loads(get(port, "/healthz"))
+
+    counts = run_soak_tcp(
+        DeviceSyncServer(n_docs=4, capacity=256),
+        Scenario(
+            ScenarioConfig(
+                n_tenants=2, n_sessions=4, events_per_session=5, seed=7
+            )
+        ),
+        budget_s=20.0,
+        telemetry_port=0,
+        probe=tcp_probe,
+        probe_at_events=6,
+    )
+    assert counts["survived"] and counts["sent"] > 0, counts
+    assert tcp_scraped, "TCP mid-soak probe never fired"
+    assert tcp_scraped["healthz"]["status"] == "ok"
+    mid_frames = prom_sample(
+        tcp_scraped["metrics_text"], "net_frames_in_total"
+    )
+    net_delta = frames_in.value - net_before
+    # every driver-sent frame crossed the wire into the counter, and the
+    # mid-run sample can never exceed the final cumulative value
+    assert net_delta >= counts["sent"], (net_delta, counts)
+    assert mid_frames <= frames_in.value, (mid_frames, frames_in.value)
+    return {
+        "inproc": {
+            "port_probed": True,
+            "mid_apply_e2e_count": mid_e2e,
+            "final_apply_e2e_count": rep["apply_e2e_count"],
+            "mid_updates_applied": mid_applied,
+            "final_updates_applied": final_applied,
+        },
+        "tcp": {
+            "sent": counts["sent"],
+            "net_frames_in_delta": net_delta,
+            "mid_net_frames_in": mid_frames,
+            "telemetry_port": counts.get("telemetry_port"),
+        },
+        "consistent": True,
+    }
+
+
 def diff_overlap_dry_run(
     n_docs: int = 12, sub_batch: int = 4, depth: int = 2
 ) -> dict:
@@ -1265,7 +1396,11 @@ def _soak_phase(budget_s: float) -> dict:
     server = DeviceSyncServer(
         n_docs=8, capacity=512, device_authoritative=True
     )
-    rep = SoakDriver(
+    # live telemetry plane (ISSUE-11): YTPU_BENCH_SOAK_TELEMETRY=<port>
+    # (0 = any free port) makes the device soak scrapeable while it
+    # runs — the watchability knob for long tunnel windows
+    tport = os.environ.get("YTPU_BENCH_SOAK_TELEMETRY")
+    drv = SoakDriver(
         server,
         Scenario(cfg),
         flush_every=8,
@@ -1273,7 +1408,14 @@ def _soak_phase(budget_s: float) -> dict:
         rebalance_at=0.75,
         budget_s=budget_s,
         rounds=10_000,  # budget-bound, not count-bound
-    ).run()
+        telemetry_port=int(tport) if tport is not None else None,
+    )
+    try:
+        rep = drv.run()
+    finally:
+        if drv.telemetry is not None:
+            rep_port = drv.telemetry.port
+            drv.telemetry.stop()
     out = {
         "soak_updates_per_s": rep["updates_per_s"],
         "soak_p50_ms": rep["apply_p50_ms"],
@@ -1304,6 +1446,8 @@ def _soak_phase(budget_s: float) -> dict:
         out["soak"]["rebalance_parity_failures"] = rep[
             "rebalance_parity_failures"
         ]
+    if tport is not None:
+        out["soak"]["telemetry_port"] = rep_port
     return out
 
 
@@ -1765,6 +1909,20 @@ def roofline_report(path=None):
     print(json.dumps(out))
 
 
+def _lift_scan_width(out: dict) -> None:
+    """Headline the conflict-tail attribution (ISSUE-11): lift the
+    `integrate.scan_width_p50/p99/max` phase gauges next to the
+    throughput keys so ROADMAP item 2's two-tier-scan work has a
+    regression surface in the one-line JSON itself (dry-run: the chaos
+    replays emit them; device: the flagship replay's readout drains
+    do)."""
+    ph = out.get("phases") or {}
+    for q in ("p50", "p99", "max"):
+        st = ph.get(f"integrate.scan_width_{q}")
+        if st and "value" in st:
+            out[f"scan_width_{q}"] = st["value"]
+
+
 def main(dry_run: bool = False):
     from ytpu.utils import metrics, phases
 
@@ -1871,9 +2029,15 @@ def main(dry_run: bool = False):
             out["diff_pipeline_speedup"] = out["diff_overlap"][
                 "modeled_speedup"
             ]
+        # live telemetry rehearsal (ISSUE-11): a mini-soak scraped over
+        # real HTTP mid-run, asserting the scrape is consistent with the
+        # final report (in-proc soak.* windows + TCP net.* counters)
+        with phases.span("host.telemetry_rehearsal"):
+            out["telemetry"] = telemetry_dry_run()
         out["tunnel_queue"] = list(TUNNEL_QUEUE)
         out["phases"] = phases.snapshot()
         out["metrics"] = metrics.snapshot()
+        _lift_scan_width(out)
         print(json.dumps(out))
         return
 
@@ -2079,6 +2243,7 @@ def main(dry_run: bool = False):
         **((res or {}).get("metrics") or {}),
         **metrics.snapshot(),
     }
+    _lift_scan_width(out)
     print(json.dumps(out))
 
 

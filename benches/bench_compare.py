@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Diff two bench one-line JSON captures field by field (ISSUE-11).
+
+Every bench round emits one JSON line (`bench.py`, committed as
+`BENCH_r*.json`), but comparing rounds has been eyeball work — and the
+ROADMAP's "no worse than" criteria have no mechanical check. This tool
+is that check::
+
+    python benches/bench_compare.py BENCH_r04.json BENCH_r05.json
+    python benches/bench_compare.py old.json new.json --tol value=0.25
+    python benches/bench_compare.py a.json b.json --default-tol 0.15
+
+Semantics:
+
+- both files hold one JSON object (a bench one-line capture; a file
+  with multiple lines uses its LAST non-empty line, matching how bench
+  output is teed into logs);
+- nested dicts flatten to dotted keys (``soak.rounds``,
+  ``phases.host.replay.execute_s``); only numeric leaves compare —
+  strings/bools are checked for equality and reported (never a
+  regression: units and notes legitimately drift);
+- a numeric change beyond tolerance is a **regression** only when the
+  key's direction is known: higher-is-better keys (throughput, speedups,
+  ``vs_*`` ratios) regress when B < A, lower-is-better keys (latency
+  ``*_ms`` / ``*_s`` quantiles) regress when B > A. Unknown-direction
+  numeric drift is reported as NEUTRAL and never fails the run —
+  exactly like a human reviewer treats `chunks` changing.
+- exit code: 0 = no regression, 1 = ≥1 regression, 2 = usage/load error.
+
+`--json` emits the full diff as one JSON line (for tooling); default
+output is a human-readable table of changed fields.
+
+The tool itself is gated: `tests/test_bench_compare.py` pins direction/
+tolerance semantics on synthetic captures, and a slow-marked test runs a
+real `bench.py --dry-run` and asserts self-comparison is a zero diff
+with exit 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["flatten", "classify", "compare", "load_capture", "main"]
+
+#: default relative tolerance for numeric fields (|b-a| / max(|a|,eps))
+DEFAULT_REL_TOL = 0.10
+
+#: key-substring → direction. First match wins (checked in order), so
+#: more specific fragments come first. "up" = higher is better, "down" =
+#: lower is better. Everything else is neutral: reported, never failing.
+_DIRECTION_RULES: Tuple[Tuple[str, str], ...] = (
+    ("stall_fraction", "down"),
+    ("_per_s", "up"),
+    ("_per_sec", "up"),
+    ("updates_per_s", "up"),
+    ("speedup", "up"),
+    ("overlap_ratio", "up"),
+    ("vs_baseline", "up"),
+    ("vs_native", "up"),
+    ("vs_py_oracle", "up"),
+    ("scan_width", "down"),  # conflict-scan tail: narrower is better
+    ("p50_ms", "down"),
+    ("p99_ms", "down"),
+    ("p999_ms", "down"),
+    ("max_ms", "down"),
+    ("rtt_floor_ms", "down"),
+    ("_dt", "down"),
+    ("p99_chunk_ms", "down"),
+    ("p50_apply_ms", "down"),
+    ("p99_apply_ms", "down"),
+)
+
+#: keys whose drift is pure noise at small scales — compared with a wider
+#: default tolerance unless the caller overrides per key
+_NOISY_DEFAULTS = {
+    "rtt_floor_ms": 1.0,  # scheduler noise floor on loopback
+    "wall_s": 1.0,
+}
+
+
+#: exact flattened keys with a known direction (the bench headline is
+#: literally called "value"; a substring rule would misfire on the
+#: phases gauges that also flatten to `.value` leaves)
+_FULL_KEY_DIRECTION = {"value": "up", "parsed.value": "up"}
+
+
+def classify(key: str) -> str:
+    """'up' | 'down' | 'neutral' for a flattened key."""
+    d = _FULL_KEY_DIRECTION.get(key)
+    if d is not None:
+        return d
+    leaf = key.rsplit(".", 1)[-1]
+    for frag, direction in _DIRECTION_RULES:
+        if frag in leaf:
+            return direction
+    return "neutral"
+
+
+def flatten(obj, prefix: str = "") -> Dict[str, object]:
+    """Nested dicts → dotted scalar leaves. Lists compare as JSON text
+    (order is meaningful in bench captures, e.g. `tunnel_queue`)."""
+    out: Dict[str, object] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(flatten(v, f"{prefix}{k}."))
+    elif isinstance(obj, list):
+        out[prefix[:-1]] = json.dumps(obj)
+    else:
+        out[prefix[:-1]] = obj
+    return out
+
+
+def compare(
+    a: Dict,
+    b: Dict,
+    tolerances: Optional[Dict[str, float]] = None,
+    default_rel: float = DEFAULT_REL_TOL,
+) -> Dict:
+    """Field-by-field diff of two captures. Returns
+    ``{"regressions": [...], "improvements": [...], "changes": [...],
+    "added": [...], "removed": [...]}`` where each entry is a dict with
+    key / a / b / rel_change / direction."""
+    tolerances = dict(tolerances or {})
+    fa, fb = flatten(a), flatten(b)
+    regressions: List[Dict] = []
+    improvements: List[Dict] = []
+    changes: List[Dict] = []
+    added = sorted(set(fb) - set(fa))
+    removed = sorted(set(fa) - set(fb))
+    for key in sorted(set(fa) & set(fb)):
+        va, vb = fa[key], fb[key]
+        if isinstance(va, bool) or isinstance(vb, bool) or not (
+            isinstance(va, (int, float)) and isinstance(vb, (int, float))
+        ):
+            if va != vb:
+                changes.append(
+                    {"key": key, "a": va, "b": vb, "direction": "neutral"}
+                )
+            continue
+        if va == vb:
+            continue
+        leaf = key.rsplit(".", 1)[-1]
+        tol = tolerances.get(
+            key, tolerances.get(leaf, _NOISY_DEFAULTS.get(leaf, default_rel))
+        )
+        rel = (vb - va) / max(abs(va), 1e-12)
+        entry = {
+            "key": key,
+            "a": va,
+            "b": vb,
+            "rel_change": round(rel, 4),
+            "direction": classify(key),
+            "tol": tol,
+        }
+        if abs(rel) <= tol:
+            continue  # within tolerance: not even a change worth listing
+        if entry["direction"] == "up":
+            (regressions if rel < 0 else improvements).append(entry)
+        elif entry["direction"] == "down":
+            (regressions if rel > 0 else improvements).append(entry)
+        else:
+            changes.append(entry)
+    return {
+        "regressions": regressions,
+        "improvements": improvements,
+        "changes": changes,
+        "added": added,
+        "removed": removed,
+    }
+
+
+def load_capture(path: str) -> Dict:
+    """One JSON object from `path` — a `BENCH_*.json` capture or any log
+    whose LAST non-empty line is the bench one-line JSON."""
+    with open(path) as f:
+        text = f.read().strip()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        lines = [ln for ln in text.splitlines() if ln.strip()]
+        if not lines:
+            raise
+        return json.loads(lines[-1])
+
+
+def _render(diff: Dict, a_name: str, b_name: str) -> str:
+    rows = []
+    for kind, entries in (
+        ("REGRESSION", diff["regressions"]),
+        ("improvement", diff["improvements"]),
+        ("change", diff["changes"]),
+    ):
+        for e in entries:
+            rel = e.get("rel_change")
+            rel_s = f"{rel * 100:+.1f}%" if isinstance(rel, float) else ""
+            rows.append(
+                f"{kind:<12} {e['key']:<48} {e['a']!r:>16} -> "
+                f"{e['b']!r:<16} {rel_s}"
+            )
+    for k in diff["added"]:
+        rows.append(f"{'added':<12} {k}")
+    for k in diff["removed"]:
+        rows.append(f"{'removed':<12} {k}")
+    head = (
+        f"bench_compare: A={a_name} B={b_name} — "
+        f"{len(diff['regressions'])} regression(s), "
+        f"{len(diff['improvements'])} improvement(s), "
+        f"{len(diff['changes'])} neutral change(s)"
+    )
+    return "\n".join([head] + rows)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("a", help="baseline capture (JSON file)")
+    p.add_argument("b", help="candidate capture (JSON file)")
+    p.add_argument(
+        "--tol",
+        action="append",
+        default=[],
+        metavar="KEY=FRAC",
+        help="per-key relative tolerance (key may be a flattened key or "
+        "a leaf name); repeatable",
+    )
+    p.add_argument(
+        "--default-tol",
+        type=float,
+        default=DEFAULT_REL_TOL,
+        help=f"relative tolerance for keys without a --tol "
+        f"(default {DEFAULT_REL_TOL})",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="emit the diff as one JSON line"
+    )
+    args = p.parse_args(argv)
+    tolerances: Dict[str, float] = {}
+    for spec in args.tol:
+        if "=" not in spec:
+            print(f"bad --tol {spec!r} (want KEY=FRAC)", file=sys.stderr)
+            return 2
+        k, v = spec.split("=", 1)
+        try:
+            tolerances[k] = float(v)
+        except ValueError:
+            print(f"bad --tol fraction {v!r}", file=sys.stderr)
+            return 2
+    try:
+        a = load_capture(args.a)
+        b = load_capture(args.b)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"load error: {e}", file=sys.stderr)
+        return 2
+    diff = compare(a, b, tolerances, args.default_tol)
+    if args.json:
+        print(json.dumps(diff))
+    else:
+        print(_render(diff, args.a, args.b))
+    return 1 if diff["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,146 @@
+"""benches/bench_compare.py (ISSUE-11 satellite): field-by-field bench
+capture diffing with per-metric tolerance and directional regression
+semantics — the tool that turns "no worse than" from eyeball work into
+an exit code. The tool itself is gated here: synthetic captures pin the
+direction/tolerance rules, committed BENCH captures pin self-comparison
+as a zero diff, and a slow-marked test runs a real `bench.py --dry-run`
+and self-compares its output through the CLI entry."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "benches"))
+
+import bench_compare as bc  # noqa: E402
+
+
+def test_self_compare_is_zero_diff_and_rc0(tmp_path, capsys):
+    cap = {
+        "value": 1000.0,
+        "soak": {"updates_per_s": 50.0, "apply_p99_ms": 3.0},
+        "tunnel_queue": ["a", "b"],
+    }
+    p = tmp_path / "cap.json"
+    p.write_text(json.dumps(cap))
+    rc = bc.main([str(p), str(p)])
+    assert rc == 0
+    diff = bc.compare(cap, cap)
+    assert diff["regressions"] == diff["improvements"] == diff["changes"] == []
+    assert diff["added"] == diff["removed"] == []
+
+
+def test_directional_regressions_and_tolerance():
+    a = {
+        "value": 1000.0,
+        "overlap_speedup": 2.0,
+        "soak": {"apply_p99_ms": 4.0},
+        "chunks": 19,
+    }
+    # throughput -24% = regression; p99 +50% = regression; chunks drift
+    # is neutral (reported, never failing)
+    b = {
+        "value": 760.0,
+        "overlap_speedup": 2.0,
+        "soak": {"apply_p99_ms": 6.0},
+        "chunks": 24,
+    }
+    diff = bc.compare(a, b)
+    keys = {e["key"] for e in diff["regressions"]}
+    assert keys == {"value", "soak.apply_p99_ms"}
+    assert {e["key"] for e in diff["changes"]} == {"chunks"}
+    # a wide-enough per-key tolerance absorbs the latency regression
+    diff = bc.compare(a, b, tolerances={"apply_p99_ms": 0.6})
+    assert {e["key"] for e in diff["regressions"]} == {"value"}
+    # within the default 10% band nothing fires at all
+    diff = bc.compare({"value": 100.0}, {"value": 95.0})
+    assert not diff["regressions"]
+
+
+def test_improvements_and_added_removed_fields():
+    a = {"value": 100.0, "gone": 1}
+    b = {"value": 200.0, "new_key": {"x": 1}}
+    diff = bc.compare(a, b)
+    assert [e["key"] for e in diff["improvements"]] == ["value"]
+    assert diff["added"] == ["new_key.x"]
+    assert diff["removed"] == ["gone"]
+
+
+def test_direction_classification_rules():
+    assert bc.classify("value") == "up"
+    assert bc.classify("soak.updates_per_s") == "up"
+    assert bc.classify("diff_pipeline_speedup") == "up"
+    assert bc.classify("soak.apply_p999_ms") == "down"
+    assert bc.classify("apply_max_ms") == "down"
+    assert bc.classify("scan_width_p99") == "down"
+    assert bc.classify("phases.replay.stage.execute_s") == "neutral"
+    assert bc.classify("chunks") == "neutral"
+
+
+def test_cli_exit_codes_and_last_line_loading(tmp_path):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text("noise line\n" + json.dumps({"value": 100.0}) + "\n")
+    b.write_text(json.dumps({"value": 50.0}))
+    tool = os.path.join(ROOT, "benches", "bench_compare.py")
+    res = subprocess.run(
+        [sys.executable, tool, str(a), str(b)],
+        capture_output=True,
+        text=True,
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "REGRESSION" in res.stdout
+    res = subprocess.run(
+        [sys.executable, tool, str(a), str(a), "--json"],
+        capture_output=True,
+        text=True,
+    )
+    assert res.returncode == 0
+    assert json.loads(res.stdout)["regressions"] == []
+    res = subprocess.run(
+        [sys.executable, tool, str(a), "/nonexistent.json"],
+        capture_output=True,
+        text=True,
+    )
+    assert res.returncode == 2
+
+
+def test_committed_capture_self_compares_clean():
+    """The freshest committed TPU capture is a valid input and a fixed
+    point of the tool."""
+    cap = os.path.join(ROOT, "BENCH_r05_midsession.json")
+    if not os.path.exists(cap):
+        pytest.skip("no committed capture in this checkout")
+    rc = bc.main([cap, cap])
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_dry_run_self_compare_through_cli(tmp_path):
+    """Satellite acceptance: a real `bench.py --dry-run` output compared
+    against itself through the CLI is a zero diff with exit 0."""
+    env = dict(os.environ, YTPU_BENCH_DRY_OPS="120", JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--dry-run"],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=ROOT,
+        env=env,
+    )
+    assert res.returncode == 0, res.stderr[-800:]
+    out = tmp_path / "dry.json"
+    out.write_text(res.stdout)
+    tool = os.path.join(ROOT, "benches", "bench_compare.py")
+    cmp_res = subprocess.run(
+        [sys.executable, tool, str(out), str(out), "--json"],
+        capture_output=True,
+        text=True,
+    )
+    assert cmp_res.returncode == 0, cmp_res.stdout + cmp_res.stderr
+    diff = json.loads(cmp_res.stdout)
+    assert diff["regressions"] == [] and diff["changes"] == []

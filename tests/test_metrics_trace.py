@@ -184,6 +184,117 @@ def test_histogram_labeled_children():
     assert snap['apply.p99_s{lane="slow"}'] >= 0.05
 
 
+# --- label handling: escaping + name validation (ISSUE-11 satellite) --------
+
+
+def test_label_value_escaping_survives_hostile_tenant_names():
+    """Regression pin: label VALUES containing backslashes, quotes and
+    real newlines must escape into single, spec-valid exposition lines —
+    reachable now that tenant ids ride labels on the live `/metrics`
+    endpoint."""
+    reg = MetricsRegistry()
+    fam = reg.counter("tenant.ops", labelnames=("tenant",))
+    hostile = 'room"1\\end\nnext'
+    fam.labels(hostile).inc(2)
+    text = reg.prometheus_text()
+    lines = text.strip().splitlines()
+    # the newline did NOT split the sample line
+    sample = [ln for ln in lines if ln.startswith("tenant_ops_total{")]
+    assert len(sample) == 1, lines
+    assert sample[0] == (
+        'tenant_ops_total{tenant="room\\"1\\\\end\\nnext"} 2'
+    )
+    for ln in lines:
+        assert _PROM_LINE.match(ln), f"invalid exposition line: {ln!r}"
+    # the JSON snapshot escapes identically (one shared escaper)
+    key = 'tenant.ops{tenant="room\\"1\\\\end\\nnext"}'
+    assert reg.snapshot()[key] == 2
+
+
+def test_label_name_with_trailing_newline_is_rejected():
+    """`$` matches before a trailing newline, so "tenant\\n" used to
+    validate as a label NAME and emit a torn exposition line; the
+    validator now anchors with \\Z."""
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError, match="invalid label name"):
+        reg.counter("bad.family", labelnames=("tenant\n",))
+    with pytest.raises(ValueError, match="invalid label name"):
+        reg.gauge("bad.family2", labelnames=("with space",))
+
+
+# --- SLO windows: max/p999 + window reset (ISSUE-11 satellite) ---------------
+
+
+def test_slo_report_carries_p999_and_max():
+    from ytpu.utils import HistogramWindow, slo_report
+
+    reg = MetricsRegistry()
+    h = reg.histogram("lat")
+    w = HistogramWindow(h)
+    for ms in [1.0] * 997 + [40.0, 40.0, 900.0]:
+        h.observe(ms / 1000)
+    rep = slo_report(w, prefix="apply_")
+    assert rep["apply_count"] == 1000
+    # p999 must NOT collapse into the p99 key (int(99.9) == 99 bug shape)
+    assert "apply_p999_ms" in rep and "apply_p99_ms" in rep
+    assert rep["apply_p99_ms"] < rep["apply_p999_ms"] <= rep["apply_max_ms"]
+    # the 40/900ms outliers are invisible at p99 (the 990th sample is
+    # still a 1ms one) but own p999/max — the tail surface the two-tier
+    # scan work regresses against
+    assert rep["apply_p99_ms"] < 10
+    assert rep["apply_p999_ms"] >= 30
+    assert rep["apply_max_ms"] >= 900
+    assert rep["apply_max_ms_adj"] <= rep["apply_max_ms"]
+    # windowed max is bucket-resolution and empty-safe
+    assert HistogramWindow(h).max_s == 0.0
+
+
+def test_histogram_window_reset_between_soak_rounds():
+    """Pin the window-reset contract: a window opened AFTER round 1
+    scores only round 2's samples — a stale window would silently blend
+    both rounds' percentiles (the drift the soak driver guards against
+    by re-opening windows per run)."""
+    from ytpu.utils import HistogramWindow, slo_report
+
+    reg = MetricsRegistry()
+    h = reg.histogram("lat")
+    # round 1: slow regime
+    for _ in range(50):
+        h.observe(0.200)
+    stale = HistogramWindow(h)  # opened at the boundary
+    r1 = slo_report(HistogramWindow(h), prefix="r1_")
+    assert r1["r1_count"] == 0  # fresh window sees nothing yet
+    # round 2: fast regime
+    for _ in range(50):
+        h.observe(0.001)
+    fresh = slo_report(stale, prefix="r2_")
+    assert fresh["r2_count"] == 50
+    # only round 2's regime: p99 AND max stay ~1ms, nowhere near 200ms
+    assert fresh["r2_p99_ms"] < 50
+    assert fresh["r2_max_ms"] < 50
+    # the cumulative histogram would have blended (its p50 spans rounds)
+    assert h.count == 100
+
+
+def test_soak_driver_windows_do_not_blend_across_runs():
+    """The driver-level version of the reset pin: two back-to-back
+    `SoakDriver.run()`s on one process share the process-global
+    histograms, but each report windows ONLY its own run."""
+    pytest.importorskip("jax")
+    from ytpu.serving import Scenario, ScenarioConfig, SoakDriver
+    from ytpu.sync.server import SyncServer
+
+    cfg = ScenarioConfig(
+        n_tenants=2, n_sessions=3, events_per_session=5, seed=23
+    )
+    r1 = SoakDriver(SyncServer(), Scenario(cfg), flush_every=4).run()
+    r2 = SoakDriver(SyncServer(), Scenario(cfg), flush_every=4).run()
+    # same deterministic scenario, fresh window: the second run's counts
+    # equal the first's instead of doubling (a stale window would show
+    # run1+run2 samples in run 2's report)
+    assert r2["apply_e2e_count"] == r1["apply_e2e_count"] > 0
+
+
 # --- flight recorder: bounded ring + error dump -----------------------------
 
 

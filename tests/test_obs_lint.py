@@ -1,0 +1,155 @@
+"""Metric-name lint (ISSUE-11 satellite): every counter/gauge/histogram
+family and every phases stage a dry-run-style exercise emits must appear
+in docs/observability.md — the doc PRs 7/9/10 each had to patch by hand
+after the fact. The test fails naming exactly the missing entries, so
+adding a metric without documenting it is a one-line fix at review time,
+not doc drift discovered two PRs later.
+
+Also the home of the conflict-scan-width assertions (ISSUE-11 tentpole
+a): the exercise below runs a real XLA-lane overlap replay, so the same
+compiled (2, 256, 16) family serves the lint's phase-key collection AND
+the scan-width behavior pins.
+
+Ordering note: this file sorts between test_metrics_trace and
+test_pallas_*, after test_async_overlap / test_device_server have
+compiled the shared shape families — the exercise re-uses their cached
+programs and adds none.
+"""
+
+import os
+import re
+import sys
+
+import pytest
+
+from ytpu.utils import metrics, phases
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DOCS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs",
+    "observability.md",
+)
+
+# phase-key normalization: per-lane suffixed gauges document as the base
+# name; rehearsal namespaces are bench-simulation-only by contract
+_LANE_SUFFIX = re.compile(r"\.(fused|xla|host)$")
+
+
+def _normalize_phase(key: str):
+    if key.startswith("rehearsal"):
+        return None  # documented as the rehearsal.* namespace rule
+    return _LANE_SUFFIX.sub("", key)
+
+
+def _exercise():
+    """A compact dry-run-shaped workout touching every subsystem that
+    registers series: transport + device serving + soak + admission +
+    async replay + telemetry. Reuses the suite's compiled families."""
+    pytest.importorskip("jax")
+    import bench as _bench
+    from ytpu.models.replay import FusedReplay, plan_replay
+    from ytpu.serving import (
+        AdmissionController,
+        Scenario,
+        ScenarioConfig,
+        SoakDriver,
+    )
+    from ytpu.sync.device_server import DeviceSyncServer
+    from ytpu.utils.telemetry import TelemetryServer
+
+    phases.reset()
+    phases.enable()
+    try:
+        # serving leg: device server + admission + soak series
+        cfg = ScenarioConfig(
+            n_tenants=2, n_sessions=4, events_per_session=6, seed=29
+        )
+        SoakDriver(
+            DeviceSyncServer(n_docs=4, capacity=256),
+            Scenario(cfg),
+            admission=AdmissionController(max_queue=4096),
+            flush_every=4,
+        ).run()
+
+        # replay leg: the async XLA-lane pipeline (scan-width surface)
+        ops = []
+        length = 0
+        for _ in range(14):
+            for i in range(20):
+                ops.append(("i", length, "abcdef"[i % 6]))
+                length += 1
+            ops.append(("d", length - 18, 18))
+            length -= 18
+        log, expect = _bench.build_updates(ops)
+        r = FusedReplay(
+            n_docs=2,
+            plan=plan_replay(log),
+            capacity=256,
+            max_capacity=256,
+            d_block=2,
+            chunk=16,
+            lane="xla",
+            overlap=True,
+        )
+        stats = r.run(log)
+        assert r.get_string(0) == expect
+
+        # telemetry leg: one scrape registers the plane's own series
+        with TelemetryServer(port=0) as t:
+            import urllib.request
+
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{t.port}/metrics", timeout=5
+            ).read()
+        snap = phases.snapshot()
+    finally:
+        phases.disable()
+        phases.reset()
+    return stats, snap
+
+
+def test_scan_width_histogram_rides_the_readout():
+    """Tentpole (a) pins: the scan-width record materializes with the
+    existing readout (totals + max + bucket-quantiles), the gauges land
+    in phases (base + lane-suffixed), and the bucket math is coherent."""
+    from ytpu.models.batch_doc import SCAN_WIDTH_BUCKETS
+
+    stats, snap = _exercise()
+    assert len(stats.scan_hist) == SCAN_WIDTH_BUCKETS
+    total = sum(stats.scan_hist)
+    assert total > 0, "no conflict scans recorded over a 294-update replay"
+    assert 0 <= stats.scan_p50 <= stats.scan_p99 <= max(stats.scan_max, 1)
+    # gauges: base keys + the per-lane twins, all in the phases snapshot
+    for q in ("p50", "p99", "max"):
+        assert f"integrate.scan_width_{q}" in snap, sorted(snap)
+        assert f"integrate.scan_width_{q}.xla" in snap
+    # the histogram words rode the SAME readout future: their d2h bytes
+    # are accounted under integrate.scan_hist, while replay.readout kept
+    # its historical 12-bytes-per-readout accounting (the zero-sync
+    # invariant test in test_async_overlap passes unchanged)
+    assert snap["integrate.scan_hist"]["d2h_bytes"] == 4 * (
+        SCAN_WIDTH_BUCKETS + 1
+    ) * (snap["replay.readout"]["d2h_bytes"] // 12)
+
+
+def test_every_emitted_metric_and_phase_name_is_documented():
+    _, snap = _exercise()
+    with open(DOCS) as f:
+        doc = f.read()
+    # metric families: every registered family name (the exercise above
+    # touched every subsystem; module-level families register at import)
+    missing = []
+    for name in sorted(metrics._families):
+        if name not in doc:
+            missing.append(f"metric: {name}")
+    for key in sorted(snap):
+        base = _normalize_phase(key)
+        if base is not None and base not in doc:
+            missing.append(f"phase: {key}")
+    assert not missing, (
+        "undocumented observability names (add them to "
+        "docs/observability.md §Metric name index):\n  "
+        + "\n  ".join(missing)
+    )

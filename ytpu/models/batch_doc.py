@@ -548,6 +548,59 @@ def _origins_equal(ha, ca, ka, hb, cb, kb):
     return both_none | both_same
 
 
+# --- conflict-scan-width attribution (ISSUE-11) ------------------------------
+# Fixed pow2 histogram shared by BOTH integrate lanes (the fused Pallas
+# kernel accumulates the same buckets into its meta tile): bucket 0 holds
+# widths 0-1, bucket k holds [2^k, 2^{k+1}) for k < SCAN_WIDTH_BUCKETS-1,
+# the last bucket is unbounded above (the p99=337 tail lands there; the
+# separate max word records the true extreme). Counting is pure vector
+# arithmetic folded into the integrate program — never a device sync; the
+# totals ride the replay driver's existing lazy readout.
+
+SCAN_WIDTH_BUCKETS = 8
+SCAN_WIDTH_THRESHOLDS = (2, 4, 8, 16, 32, 64, 128)
+#: inclusive upper bound of each bucket (the quantile representative);
+#: the last bucket has no bound — report the observed max there
+SCAN_WIDTH_UPPER = (1, 3, 7, 15, 31, 63, 127)
+
+
+def scan_width_bucket(w):
+    """Bucket index of one width sample (traced jnp value)."""
+    b = (w >= SCAN_WIDTH_THRESHOLDS[0]).astype(I32)
+    for t in SCAN_WIDTH_THRESHOLDS[1:]:
+        b = b + (w >= t).astype(I32)
+    return b
+
+
+def _fold_scan_width(hist, w):
+    """Fold one row's scan-width sample (``-1`` = no scan) into a
+    ``[SCAN_WIDTH_BUCKETS + 1]`` record: bucket counts + max width."""
+    scanned = w >= 0
+    wc = jnp.maximum(w, 0)
+    b = scan_width_bucket(wc)
+    hist = hist.at[b].add(scanned.astype(I32))
+    return hist.at[SCAN_WIDTH_BUCKETS].max(jnp.where(scanned, wc, 0))
+
+
+def scan_width_quantile(counts, q: float, observed_max: int) -> int:
+    """Host-side quantile over materialized bucket counts: the inclusive
+    upper bound of the bucket holding the q-th sample (the unbounded last
+    bucket reports the observed max). 0 when no scans were recorded."""
+    counts = [int(c) for c in counts]
+    total = sum(counts)
+    if total == 0:
+        return 0
+    target = q * total
+    acc = 0
+    for k, c in enumerate(counts):
+        acc += c
+        if acc >= target:
+            if k < len(SCAN_WIDTH_UPPER):
+                return min(SCAN_WIDTH_UPPER[k], int(observed_max))
+            return int(observed_max)
+    return int(observed_max)
+
+
 def _conflict_scan(
     state: DocStateBatch,
     client_rank: jax.Array,
@@ -568,9 +621,13 @@ def _conflict_scan(
     Walks candidates from `o0` toward `right_idx` (or the sequence tail),
     resolving the final left neighbor: same-origin candidates tie-break on
     real client rank (case 1); candidates anchored inside the scanned
-    region fold per the before/conflicting set rules (case 2). Returns the
-    scanned left slot (callers apply it only where their `need_scan`
-    predicate held).
+    region fold per the before/conflicting set rules (case 2). Returns
+    ``(left_scanned, width)``: the scanned left slot (callers apply it
+    only where their `need_scan` predicate held) and the number of
+    candidates the walk visited — the conflict-tail attribution sample
+    (ISSUE-11) the integrate lanes fold into the lazy scan-width
+    histogram. Callers that don't track widths discard the second value
+    (XLA dead-code-eliminates the counter).
 
     Cost model (VERDICT r4 #9): each while trip is ~8 capacity-wide
     vector ops; before round 5 it was dominated by the unconditional
@@ -587,11 +644,11 @@ def _conflict_scan(
     safe = lambda idx: jnp.maximum(idx, 0)
 
     def scan_cond(carry):
-        o, left, conflicting, before, brk = carry
+        o, left, conflicting, before, brk, width = carry
         return (o >= 0) & (o != right_idx) & ~brk
 
     def scan_body(carry):
-        o, left, conflicting, before, brk = carry
+        o, left, conflicting, before, brk, width = carry
         so = safe(o)
         before = before.at[so].set(True)
         conflicting = conflicting.at[so].set(True)
@@ -633,13 +690,15 @@ def _conflict_scan(
         conflicting = jnp.where(take, jnp.zeros_like(conflicting), conflicting)
         brk = case1_break | case2_break
         o = jnp.where(brk, o, bl.right[so])
-        return (o, left, conflicting, before, brk)
+        return (o, left, conflicting, before, brk, width + 1)
 
     zeros = jnp.zeros((B,), bool)
-    _, left_scanned, _, _, _ = jax.lax.while_loop(
-        scan_cond, scan_body, (o0, left_idx, zeros, zeros, jnp.array(False))
+    _, left_scanned, _, _, _, width = jax.lax.while_loop(
+        scan_cond,
+        scan_body,
+        (o0, left_idx, zeros, zeros, jnp.array(False), I32(0)),
     )
-    return left_scanned
+    return left_scanned, width
 
 
 def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array):
@@ -649,9 +708,12 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array):
     order — the YATA tie-break (block.rs:571-580) is defined on real ids,
     which interning does not preserve.
 
-    Returns (state, moves_dirty): dirty is True when move ownership must be
-    recomputed (a move row arrived, or an insert landed between rows owned
-    by *different* moves — the reconciliation case of block.rs:677-702).
+    Returns (state, moves_dirty, scan_width): dirty is True when move
+    ownership must be recomputed (a move row arrived, or an insert landed
+    between rows owned by *different* moves — the reconciliation case of
+    block.rs:677-702); scan_width is the conflict-scan width sample for
+    this row (-1 when no scan was needed — the cheap path), feeding the
+    ISSUE-11 scan-width histogram.
     """
     (
         r_client,
@@ -793,7 +855,7 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array):
         anchor0,
     )
     o0 = jnp.where(need_scan, o0, -1)
-    left_scanned = _conflict_scan(
+    left_scanned, scan_w = _conflict_scan(
         state,
         client_rank,
         r_client,
@@ -808,6 +870,7 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array):
         left_idx,
     )
     left_idx = jnp.where(need_scan, left_scanned, left_idx)
+    scan_width = jnp.where(need_scan, scan_w, I32(-1))
 
     # --- link in (parity: block.rs:614-659) ---
     j = state.n_blocks
@@ -901,7 +964,7 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array):
         n_blocks=state.n_blocks + do.astype(I32),
         error=error,
     )
-    return out, moves_dirty
+    return out, moves_dirty, scan_width
 
 
 def _apply_delete_range(state: DocStateBatch, client, start, end, valid):
@@ -1137,12 +1200,17 @@ def _recompute_moves(
 
 def _apply_update_one_doc(
     state: DocStateBatch, batch: UpdateBatch, client_rank: jax.Array
-) -> DocStateBatch:
+):
+    """Returns ``(state, scan_hist)`` — scan_hist is the per-doc
+    conflict-scan-width record ``[SCAN_WIDTH_BUCKETS + 1]`` i32 (pow2
+    bucket counts + max width, ISSUE-11) accumulated over this batch's
+    rows; callers that only want the state drop it (XLA DCEs the
+    counter when the output is unused)."""
     U = batch.client.shape[-1]
     R = batch.del_client.shape[-1]
 
     def blk_body(i, carry):
-        st, dirty = carry
+        st, dirty, hist = carry
         row = (
             batch.client[i],
             batch.clock[i],
@@ -1170,16 +1238,17 @@ def _apply_update_one_doc(
         )
         # padding rows skip all work; with a broadcast (unbatched) update the
         # predicate is scalar, so XLA executes only one branch
-        st, d = jax.lax.cond(
+        st, d, w = jax.lax.cond(
             batch.valid[i],
             lambda s: _integrate_row(s, row, client_rank),
-            lambda s: (s, jnp.array(False)),
+            lambda s: (s, jnp.array(False), I32(-1)),
             st,
         )
-        return st, dirty | d
+        return st, dirty | d, _fold_scan_width(hist, w)
 
-    state, moves_dirty = jax.lax.fori_loop(
-        0, U, blk_body, (state, jnp.array(False))
+    hist0 = jnp.zeros((SCAN_WIDTH_BUCKETS + 1,), I32)
+    state, moves_dirty, scan_hist = jax.lax.fori_loop(
+        0, U, blk_body, (state, jnp.array(False), hist0)
     )
 
     def del_body(r, carry):
@@ -1203,7 +1272,7 @@ def _apply_update_one_doc(
     state, moves_dirty = jax.lax.fori_loop(
         0, R, del_body, (state, moves_dirty)
     )
-    return _recompute_moves(state, moves_dirty, client_rank)
+    return _recompute_moves(state, moves_dirty, client_rank), scan_hist
 
 
 @jax.jit
@@ -1214,31 +1283,58 @@ def apply_update_batch(
 
     `client_rank` is the [C] interned-client rank table (shared by all docs).
     """
-    return jax.vmap(_apply_update_one_doc, in_axes=(0, 0, None))(
+    state, _hist = jax.vmap(_apply_update_one_doc, in_axes=(0, 0, None))(
         state, batch, client_rank
     )
+    return state
 
 
-@partial(jax.jit, donate_argnums=0)
-def apply_update_stream(
+def _apply_update_stream_hist_body(
     state: DocStateBatch, stream: UpdateBatch, client_rank: jax.Array
-) -> DocStateBatch:
+):
     """Integrate a whole stream of updates per doc in one compiled program.
 
     `stream` leaves carry a leading step axis [S, ...] *without* a doc axis:
     each step's update is broadcast to every doc slot (the multi-tenant
     replay shape of BASELINE.md config #2). `lax.scan` amortizes dispatch —
     wall-clock per step is pure device time.
-    """
 
-    def step(st, batch):
-        st = jax.vmap(_apply_update_one_doc, in_axes=(0, None, None))(
+    Returns ``(state, scan_hist)``: scan_hist is the per-doc
+    ``[D, SCAN_WIDTH_BUCKETS + 1]`` conflict-scan-width record (bucket
+    counts summed over the stream + per-doc max, ISSUE-11). The public
+    wrapper discards it; the replay chunk programs fold it into the meta
+    tile so it rides the lazy readout.
+    """
+    D = state.start.shape[0]
+
+    def step(carry, batch):
+        st, hist = carry
+        st, h = jax.vmap(_apply_update_one_doc, in_axes=(0, None, None))(
             st, batch, client_rank
         )
-        return st, None
+        hist = jnp.concatenate(
+            [
+                hist[:, :SCAN_WIDTH_BUCKETS] + h[:, :SCAN_WIDTH_BUCKETS],
+                jnp.maximum(
+                    hist[:, SCAN_WIDTH_BUCKETS:], h[:, SCAN_WIDTH_BUCKETS:]
+                ),
+            ],
+            axis=1,
+        )
+        return (st, hist), None
 
-    state, _ = jax.lax.scan(step, state, stream)
-    return state
+    hist0 = jnp.zeros((D, SCAN_WIDTH_BUCKETS + 1), I32)
+    (state, scan_hist), _ = jax.lax.scan(step, (state, hist0), stream)
+    return state, scan_hist
+
+
+# the tuple-returning jit: its ONLY callers trace through it inside the
+# chunk programs (`xla_chunk_step`, `replay_chunk_program*`), so no
+# standalone executable compiles for it in practice
+apply_update_stream = partial(jax.jit, donate_argnums=0)(
+    _apply_update_stream_hist_body
+)
+apply_update_stream.__doc__ = _apply_update_stream_hist_body.__doc__
 
 
 @partial(jax.jit, static_argnums=2)
@@ -3091,6 +3187,18 @@ _apply_update_batch_jit = apply_update_batch
 _apply_update_stream_jit = apply_update_stream
 
 
+# state-only twin for the PUBLIC stream entry: the scan-width record is
+# dropped INSIDE the jit, so XLA dead-code-eliminates the whole counter
+# carry on the classic stream lane — a standalone caller pays nothing
+# for the attribution it isn't reading (the chunk programs, which DO
+# read it, trace through the tuple body instead)
+_apply_update_stream_state_jit = partial(jax.jit, donate_argnums=0)(
+    lambda state, stream, client_rank: _apply_update_stream_hist_body(
+        state, stream, client_rank
+    )[0]
+)
+
+
 def apply_update_batch(
     state: DocStateBatch, batch: UpdateBatch, client_rank: jax.Array
 ) -> DocStateBatch:
@@ -3132,7 +3240,10 @@ def apply_update_stream(
         else NULL_SPAN
     )
     with span:
-        return _apply_update_stream_jit(state, stream, client_rank)
+        # state-only compiled variant: the scan-width record (ISSUE-11)
+        # is dropped in-jit and DCE'd — the chunk programs are the
+        # consumers that fold the histogram into the lazy readout
+        return _apply_update_stream_state_jit(state, stream, client_rank)
 
 
 apply_update_batch.__doc__ = _apply_update_batch_jit.__doc__
@@ -3155,6 +3266,9 @@ def _register_programs():
 
     progbudget.register("apply_update_batch", _apply_update_batch_jit)
     progbudget.register("apply_update_stream", _apply_update_stream_jit)
+    progbudget.register(
+        "apply_update_stream_state", _apply_update_stream_state_jit
+    )
     progbudget.register("encode_diff_batch", encode_diff_batch)
     progbudget.register("finish_pack", _finish_pack)
     progbudget.register("finish_counts", _finish_counts)

@@ -241,6 +241,15 @@ class ReplayStats:
     quarantined: List[int] = field(default_factory=list)
     resumes: List[int] = field(default_factory=list)
     final_lane: str = ""
+    # conflict-tail attribution (ISSUE-11): the scan-width record pulled
+    # with the driver's final readout drain — pow2 bucket counts, the
+    # observed max, and the bucket-quantile p50/p99 (docs/observability.md
+    # §Conflict-tail attribution). Zero extra syncs: the words ride the
+    # same lazy readout future the occupancy protocol already drains.
+    scan_hist: tuple = ()
+    scan_max: int = 0
+    scan_p50: int = 0
+    scan_p99: int = 0
 
 
 @dataclass
@@ -554,9 +563,11 @@ def plan_overlap(n_updates: int, chunk: int, depth: int = 2) -> OverlapPlan:
 class _StagingSlot:
     """One reusable staging buffer: padded wire bytes + lens + the
     chunk's global unit-ref rows. A pair of these (the double buffer)
-    serves the whole replay."""
+    serves the whole replay. ``trace`` carries the staging request's
+    trace id (ISSUE-11) across the thread hand-off — ContextVars don't
+    cross into the consumer thread, the slot does."""
 
-    __slots__ = ("buf", "lens", "refs", "pos", "end")
+    __slots__ = ("buf", "lens", "refs", "pos", "end", "trace")
 
     def __init__(self, chunk: int, width: int, u: int):
         self.buf = np.zeros((chunk, width), dtype=np.uint8)
@@ -564,6 +575,7 @@ class _StagingSlot:
         self.refs = np.full((chunk, u), -1, dtype=np.int32)
         self.pos = 0
         self.end = 0
+        self.trace = None
 
 
 class _RawStagingSlot:
@@ -574,7 +586,7 @@ class _RawStagingSlot:
     per-update padding/packing of `_StagingSlot` moved on device
     (`gather_raw_lanes`)."""
 
-    __slots__ = ("raw", "offs", "lens", "refs", "pos", "end")
+    __slots__ = ("raw", "offs", "lens", "refs", "pos", "end", "trace")
 
     def __init__(self, raw_cap: int, chunk: int, u: int):
         self.raw = np.zeros((raw_cap,), dtype=np.uint8)
@@ -583,6 +595,7 @@ class _RawStagingSlot:
         self.refs = np.full((chunk, u), -1, dtype=np.int32)
         self.pos = 0
         self.end = 0
+        self.trace = None
 
 
 def build_wire_table(payloads) -> Tuple[np.ndarray, np.ndarray]:
@@ -923,6 +936,11 @@ class FusedReplay:
         self.stats.demotions += d.demotions
         self.stats.recoveries += d.recoveries
         self.stats.final_lane = driver.lane
+        if d.scan_hist:
+            self.stats.scan_hist = d.scan_hist
+            self.stats.scan_max = d.scan_max
+            self.stats.scan_p50 = d.scan_p50
+            self.stats.scan_p99 = d.scan_p99
         self._hi = d.final_blocks
 
     # ------------------------------------------- fault recovery (ISSUE-6)
@@ -1249,6 +1267,14 @@ class FusedReplay:
         acquisitions = 0
         staged_bytes = 0
 
+        # request-tracing hand-off (ISSUE-11): the staging generator runs
+        # on the engine's worker thread where the caller's ContextVar
+        # context is invisible — capture the ambient trace id HERE and
+        # let each staged slot carry it to the dispatch span
+        from ytpu.utils.trace import current_trace_id, tracer
+
+        ambient_trace = current_trace_id()
+
         def produce():
             nonlocal acquisitions, staged_bytes
             from ytpu.ops.decode_kernel import pack_raw_updates_into
@@ -1264,33 +1290,46 @@ class FusedReplay:
                         if pipe.stopping:
                             return
                 end = min(pos + chunk, S)
-                if use_raw:
-                    staged_bytes += pack_raw_updates_into(
-                        wire, woffs, pos, end,
-                        slot.raw, slot.offs, slot.lens, width=width,
-                    )
-                else:
-                    batch = self._stage_batch(payloads, pos, end)
-                    pack_updates_into(batch, slot.buf, slot.lens)
-                    staged_bytes += sum(len(p) for p in batch)
-                slot.refs[: end - pos] = plan.unit_refs[pos:end]
-                slot.refs[end - pos :] = -1
-                slot.pos, slot.end = pos, end
+                with tracer.span(
+                    "replay.stage_slot",
+                    first=pos,
+                    last=end - 1,
+                    trace=ambient_trace,
+                ):
+                    if use_raw:
+                        staged_bytes += pack_raw_updates_into(
+                            wire, woffs, pos, end,
+                            slot.raw, slot.offs, slot.lens, width=width,
+                        )
+                    else:
+                        batch = self._stage_batch(payloads, pos, end)
+                        pack_updates_into(batch, slot.buf, slot.lens)
+                        staged_bytes += sum(len(p) for p in batch)
+                    slot.refs[: end - pos] = plan.unit_refs[pos:end]
+                    slot.refs[end - pos :] = -1
+                    slot.pos, slot.end = pos, end
+                    slot.trace = ambient_trace
                 acquisitions += 1
                 yield slot
 
         def consume(slot):
             t0 = time.perf_counter()
             margin = int(plan.adds[slot.pos : slot.end].sum()) + 8
-            if use_raw:
-                inputs = driver.step_raw(
-                    slot.raw, slot.offs, slot.lens, slot.refs, dims,
-                    width, margin=margin,
-                )
-            else:
-                inputs = driver.step_bytes(
-                    slot.buf, slot.lens, slot.refs, dims, margin=margin
-                )
+            with tracer.span(
+                "replay.dispatch_slot",
+                first=slot.pos,
+                last=slot.end - 1,
+                trace=slot.trace,
+            ):
+                if use_raw:
+                    inputs = driver.step_raw(
+                        slot.raw, slot.offs, slot.lens, slot.refs, dims,
+                        width, margin=margin,
+                    )
+                else:
+                    inputs = driver.step_bytes(
+                        slot.buf, slot.lens, slot.refs, dims, margin=margin
+                    )
             self._dispatched_ranges.append((slot.pos, slot.end))
             self.cols, self.meta = driver.cols, driver.meta
             inflight.append((slot, inputs))

@@ -25,6 +25,7 @@ from typing import Optional, Tuple
 
 import os
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -39,7 +40,14 @@ from ytpu.core.content import (
     CONTENT_FORMAT,
     CONTENT_MOVE,
 )
-from ytpu.models.batch_doc import BlockCols, DocStateBatch, UpdateBatch
+from ytpu.models.batch_doc import (
+    SCAN_WIDTH_BUCKETS,
+    BlockCols,
+    DocStateBatch,
+    UpdateBatch,
+    scan_width_bucket,
+    scan_width_quantile,
+)
 
 __all__ = [
     "pack_state",
@@ -58,7 +66,9 @@ __all__ = [
     "effective_lane",
     "demote_lane",
     "reset_lane_health",
+    "lane_health",
     "is_device_fault",
+    "N_READOUT",
 ]
 
 I32 = jnp.int32
@@ -99,12 +109,22 @@ I32 = jnp.int32
 ) = range(26)
 NC = 26
 
-# meta columns in the packed [D, 8] array (padded to a TPU-friendly lane dim)
+# meta columns in the packed [D, 16] array (padded to a TPU-friendly lane dim)
 # M_MDIRTY: move ownership must be recomputed for this doc at step end (a
 # move row arrived, an insert straddled differently-owned neighbors, or a
 # delete tombstoned a live move — the moves_dirty of batch_doc)
 M_START, M_NBLOCKS, M_ERROR, M_MDIRTY = 0, 1, 2, 3
-M_PAD = 8
+# conflict-scan-width attribution (ISSUE-11): per-doc pow2 bucket counts
+# + max width ride the meta tile, accumulated INSIDE the integrate scan
+# (both lanes) so the totals survive chunking/compaction/growth for free
+# and surface only through the existing lazy readout — never a new sync.
+M_HIST0 = 4
+M_SCANW_MAX = M_HIST0 + SCAN_WIDTH_BUCKETS  # 12
+M_PAD = 16
+
+#: words in the per-chunk lazy readout: the original [3] occupancy/error
+#: words + the scan-width bucket totals + the max-width word
+N_READOUT = 3 + SCAN_WIDTH_BUCKETS + 1
 
 ERR_CAPACITY = 1
 ERR_MISSING_DEP = 2
@@ -248,8 +268,9 @@ def _kernel(
     """One doc tile: integrate the whole stream in VMEM.
 
     cols_ref: [NC, DB, C] out-ref aliased to the input (holds the state),
-    meta_ref: [DB, 8] aliased; rows_ref: [S, U, 23], dels_ref: [S, R, 4],
-    rank_ref: [1, K].
+    meta_ref: [DB, M_PAD=16] aliased (cols 0-3 start/n_blocks/error/
+    mdirty; cols M_HIST0..M_SCANW_MAX the scan-width record); rows_ref:
+    [S, U, 23], dels_ref: [S, R, 4], rank_ref: [1, K].
 
     `phases` / `row_phase` are HARDWARE-BISECT hooks (trace-time static,
     threaded from `apply_update_stream_fused`): they truncate the kernel
@@ -553,13 +574,14 @@ def _kernel(
             return (~ha & ~hb) | (ha & hb & (ca == cb) & (ka == kb))
 
         def scan_cond(carry):
-            o, left, conflicting, before, brk = carry
+            o, left, conflicting, before, brk, width = carry
             active = (o >= 0) & (o != right_idx) & (brk == 0)
             return jnp.any(active)
 
         def scan_body(carry):
-            o, left, conflicting, before, brk = carry
+            o, left, conflicting, before, brk, width = carry
             active = (o >= 0) & (o != right_idx) & (brk == 0)
+            width = width + active.astype(I32)
             onehot_o = ((iota_c == o[:, None]) & mrow(active)).astype(I32)
             before = before | onehot_o
             conflicting = conflicting | onehot_o
@@ -595,15 +617,32 @@ def _kernel(
             brk = brk | ((case1_break | case2_break) & active).astype(I32)
             o_next = gather(RT, o, -1)
             o = jnp.where(active & (brk == 0), o_next, o)
-            return (o, left, conflicting, before, brk)
+            return (o, left, conflicting, before, brk, width)
 
         zeros = jnp.zeros((DB, C), I32)
-        _, left_scanned, _, _, _ = jax.lax.while_loop(
+        _, left_scanned, _, _, _, scan_width = jax.lax.while_loop(
             scan_cond,
             scan_body,
-            (o0, left_idx, zeros, zeros, jnp.zeros((DB,), I32)),
+            (o0, left_idx, zeros, zeros, jnp.zeros((DB,), I32),
+             jnp.zeros((DB,), I32)),
         )
         left_idx = jnp.where(need_scan, left_scanned, left_idx)
+        # conflict-tail attribution (ISSUE-11): fold this row's per-doc
+        # scan width into the pow2 histogram riding the meta tile — a
+        # handful of (DB,)-wide compares per row, no extra HBM traffic,
+        # materialized host-side only when the lazy readout is pulled
+        wb = jnp.maximum(scan_width, 0)
+        # the SAME bucket function as the packed-XLA lane (pure jnp ops,
+        # vectorizes over the doc sublanes) — one definition, so the two
+        # lanes' histograms can never drift apart
+        bucket = scan_width_bucket(wb)
+        for _k in range(SCAN_WIDTH_BUCKETS):
+            meta_ref[:, M_HIST0 + _k] = meta_ref[:, M_HIST0 + _k] + (
+                need_scan & (bucket == _k)
+            ).astype(I32)
+        meta_ref[:, M_SCANW_MAX] = jnp.maximum(
+            meta_ref[:, M_SCANW_MAX], jnp.where(need_scan, wb, 0)
+        )
         if row_phase < 4:
             return
 
@@ -1088,9 +1127,15 @@ def xla_chunk_step(cols, meta, stream, rank):
         from ytpu.models.batch_doc import apply_update_stream_raw
 
         def step(cols, meta, stream, rank):
+            # pack_state zeroes the meta padding, so the carried
+            # scan-width record (ISSUE-11) is read out first and folded
+            # back in with this chunk's contribution
+            carried = meta[:, M_HIST0 : M_SCANW_MAX + 1]
             state = unpack_state(cols, meta, None)
-            state = apply_update_stream_raw(state, stream, rank)
-            return pack_state(state)
+            state, dhist = apply_update_stream_raw(state, stream, rank)
+            cols, meta = pack_state(state)
+            meta = _fold_scan_meta(meta, carried, dhist)
+            return cols, meta
 
         # donate like the fused _run: the packed state updates in place
         # instead of holding two full copies at grown capacity
@@ -1098,19 +1143,49 @@ def xla_chunk_step(cols, meta, stream, rank):
     return _XLA_CHUNK_STEP(cols, meta, stream, rank)
 
 
+def _fold_scan_meta(meta, carried, dhist):
+    """Fold an XLA-lane chunk's scan-width record (``dhist``
+    ``[D, SCAN_WIDTH_BUCKETS + 1]``) plus the pre-chunk carried meta
+    columns back into a freshly packed meta (whose padding pack_state
+    zeroed): bucket counts add, the max word maxes."""
+    buckets = (
+        carried[:, :SCAN_WIDTH_BUCKETS] + dhist[:, :SCAN_WIDTH_BUCKETS]
+    )
+    mx = jnp.maximum(carried[:, SCAN_WIDTH_BUCKETS], dhist[:, SCAN_WIDTH_BUCKETS])
+    meta = meta.at[:, M_HIST0:M_SCANW_MAX].set(buckets)
+    return meta.at[:, M_SCANW_MAX].set(mx)
+
+
+def _readout_words(meta, err):
+    """``[N_READOUT]`` i32: (max n_blocks, max sticky integrate error,
+    sticky decode flags, scan-width bucket totals summed over docs, max
+    scan width) — everything the host learns per drain, one future."""
+    hist = jnp.sum(meta[:, M_HIST0:M_SCANW_MAX], axis=0)
+    return jnp.concatenate(
+        [
+            jnp.stack(
+                [jnp.max(meta[:, M_NBLOCKS]), jnp.max(meta[:, M_ERROR]), err]
+            ),
+            hist,
+            jnp.max(meta[:, M_SCANW_MAX])[None],
+        ]
+    )
+
+
 @jax.jit
 def _chunk_readout(meta, err):
-    """[3] i32 (max n_blocks, max sticky integrate error, sticky decode
-    flags) — the per-chunk occupancy/error readout. Dispatched after
-    every chunk but NOT materialized: the host keeps the device future
-    and only blocks on it when its own optimistic occupancy bound trips
-    the watermark, so steady-state chunks never pay a sync (the round-5
-    FusedReplay synced every chunk). Decode FLAG_ERRORS ride the same
-    word (`err`, OR-reduced on device by `replay_chunk_program`), so the
-    async lane's per-chunk `np.asarray(flags)` block is gone too."""
-    return jnp.stack(
-        [jnp.max(meta[:, M_NBLOCKS]), jnp.max(meta[:, M_ERROR]), err]
-    )
+    """[N_READOUT] i32 (max n_blocks, max sticky integrate error, sticky
+    decode flags, + the scan-width histogram words) — the per-chunk
+    occupancy/error readout. Dispatched after every chunk but NOT
+    materialized: the host keeps the device future and only blocks on it
+    when its own optimistic occupancy bound trips the watermark, so
+    steady-state chunks never pay a sync (the round-5 FusedReplay synced
+    every chunk). Decode FLAG_ERRORS ride the same word (`err`,
+    OR-reduced on device by `replay_chunk_program`), so the async lane's
+    per-chunk `np.asarray(flags)` block is gone too. The ISSUE-11
+    scan-width words (bucket totals + max) ride the SAME future — zero
+    additional materializations."""
+    return _readout_words(meta, err)
 
 
 def _chunk_core(
@@ -1160,12 +1235,12 @@ def _chunk_core(
     else:
         from ytpu.models.batch_doc import apply_update_stream_raw
 
+        carried = meta[:, M_HIST0 : M_SCANW_MAX + 1]
         state = unpack_state(cols, meta, None)
-        state = apply_update_stream_raw(state, stream, rank)
+        state, dhist = apply_update_stream_raw(state, stream, rank)
         cols, meta = pack_state(state)
-    readout = jnp.stack(
-        [jnp.max(meta[:, M_NBLOCKS]), jnp.max(meta[:, M_ERROR]), err]
-    )
+        meta = _fold_scan_meta(meta, carried, dhist)
+    readout = _readout_words(meta, err)
     return cols, meta, err, readout
 
 
@@ -1343,6 +1418,13 @@ class ReplayChunkStats:
     demotions: int = 0
     recoveries: int = 0
     quarantined: int = 0
+    # conflict-tail attribution (ISSUE-11): the scan-width record as of
+    # the freshest materialized readout — pow2 bucket counts, observed
+    # max, and the bucket-quantile p50/p99 (0s until the first drain)
+    scan_hist: tuple = ()
+    scan_max: int = 0
+    scan_p50: int = 0
+    scan_p99: int = 0
 
 
 # --- lane-health ladder + typed replay faults (ISSUE-6 tentpole) -------------
@@ -1406,6 +1488,20 @@ def reset_lane_health() -> None:
     """Test/ops hook: forget every sticky demotion."""
     with _lane_floor_lock:
         _lane_floor.clear()
+
+
+def lane_health() -> dict:
+    """JSON-safe view of the sticky lane-demotion ladder: shape-family
+    key (``"{n_docs}x{d_block}"``) → lowest healthy rung. Empty = full
+    health. The telemetry plane's `/healthz` endpoint serves this."""
+    with _lane_floor_lock:
+        return {f"{fam[0]}x{fam[1]}": floor for fam, floor in _lane_floor.items()}
+
+
+#: wall-clock of the most recent successful chunk dispatch, for the
+#: telemetry `/healthz` last-dispatch age — a wedged device shows up as a
+#: growing age while the HTTP plane stays serveable (its own thread)
+_LAST_DISPATCH = _metrics.gauge("integrate.last_dispatch_unix")
 
 
 class ReplayFault(RuntimeError):
@@ -1550,8 +1646,17 @@ class PackedReplayDriver:
         hi = self._hi_bound
         if self._pending:
             if _phases.enabled:
+                # the original [3]-word occupancy/error readout keeps its
+                # historical 12-byte accounting (the zero-sync invariant
+                # test pins it); the scan-width words riding the SAME
+                # future attribute separately — one future, no new sync
                 _phases.transfer(
                     "replay.readout", 12 * len(self._pending), "d2h"
+                )
+                _phases.transfer(
+                    "integrate.scan_hist",
+                    4 * (SCAN_WIDTH_BUCKETS + 1) * len(self._pending),
+                    "d2h",
                 )
             sticky_derr = 0
             for fut in self._pending:
@@ -1576,6 +1681,13 @@ class PackedReplayDriver:
                     ) from e
                 occ, kerr = int(vals[0]), int(vals[1])
                 derr = int(vals[2]) if vals.shape[0] > 2 else 0
+                if vals.shape[0] >= N_READOUT:
+                    # meta carries the CUMULATIVE record, so the freshest
+                    # readout supersedes earlier ones in the same drain
+                    self._record_scan_width(
+                        vals[3 : 3 + SCAN_WIDTH_BUCKETS],
+                        int(vals[3 + SCAN_WIDTH_BUCKETS]),
+                    )
                 self.stats.peak_blocks = max(self.stats.peak_blocks, occ)
                 if derr != 0:
                     if self.quarantine and self.on_quarantine is not None:
@@ -1597,6 +1709,34 @@ class PackedReplayDriver:
                 _QUARANTINED.inc(len(newly))
                 self._err = jnp.zeros((), I32)
         return hi
+
+    def _record_scan_width(self, buckets, observed_max: int) -> None:
+        """Fold one materialized readout's scan-width words into the
+        driver stats and the `integrate.scan_width_*` phase gauges
+        (ISSUE-11). Called only from drains — the record arrives on the
+        readout future the host was already blocking on, so this adds
+        ZERO device syncs. Gauges land twice: the base key and a
+        `.{lane}`-suffixed key, so fused- and packed-XLA-lane
+        distributions stay separately regressable."""
+        from ytpu.utils.phases import phases as _phases
+
+        counts = [int(c) for c in buckets]
+        mx = int(observed_max)
+        st = self.stats
+        st.scan_hist = tuple(counts)
+        st.scan_max = mx
+        st.scan_p50 = scan_width_quantile(counts, 0.50, mx)
+        st.scan_p99 = scan_width_quantile(counts, 0.99, mx)
+        if _phases.enabled and sum(counts):
+            for name, v in (
+                ("p50", st.scan_p50),
+                ("p99", st.scan_p99),
+                ("max", st.scan_max),
+            ):
+                _phases.set_value(f"integrate.scan_width_{name}", v)
+                _phases.set_value(
+                    f"integrate.scan_width_{name}.{self.lane}", v
+                )
 
     def _raise_device_error(self):
         meta_np = np.asarray(self.meta)
@@ -1674,6 +1814,7 @@ class PackedReplayDriver:
                     lane=self.lane,
                     cause=FaultError("replay.kill", spec),
                 )
+            _LAST_DISPATCH.set(time.time())
             return out
 
     # ------------------------------------------------------- compact/grow

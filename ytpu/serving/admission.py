@@ -167,23 +167,36 @@ class AdmissionController:
         """Admit ``n`` updates for ``tenant`` or raise a typed Overload.
         ``queue_depth`` is the tenant's CURRENT device-queue depth (the
         server passes it; depth shrinks via flush, so there is no
-        release() to forget)."""
-        if faults.active and faults.fire("admission.reject", tenant=tenant):
-            _REJECTED.labels("injected").inc()
-            raise QueueFull(tenant, "injected admission fault")
-        if self.max_queue is not None and queue_depth + n > self.max_queue:
-            _REJECTED.labels("queue_full").inc()
-            raise QueueFull(
-                tenant, f"queue depth {queue_depth} at bound {self.max_queue}"
-            )
-        if self.bucket is not None:
-            wait = self.bucket.deficit(n)
-            if wait > 0.0:
-                _REJECTED.labels("rate_limited").inc()
-                raise RateLimited(
-                    tenant, f"over rate {self.bucket.rate}/s", retry_after_s=wait
+        release() to forget).
+
+        Tracing (ISSUE-11): the decision emits an ``admission.admit``
+        span carrying the ambient request trace context, so a refused
+        frame's Busy reply is attributable in the Chrome trace next to
+        its transport and dispatch spans."""
+        from ytpu.utils import tracer
+
+        with tracer.span("admission.admit", depth=queue_depth, n=n):
+            if faults.active and faults.fire(
+                "admission.reject", tenant=tenant
+            ):
+                _REJECTED.labels("injected").inc()
+                raise QueueFull(tenant, "injected admission fault")
+            if self.max_queue is not None and queue_depth + n > self.max_queue:
+                _REJECTED.labels("queue_full").inc()
+                raise QueueFull(
+                    tenant,
+                    f"queue depth {queue_depth} at bound {self.max_queue}",
                 )
-        _ADMITTED.inc(n)
+            if self.bucket is not None:
+                wait = self.bucket.deficit(n)
+                if wait > 0.0:
+                    _REJECTED.labels("rate_limited").inc()
+                    raise RateLimited(
+                        tenant,
+                        f"over rate {self.bucket.rate}/s",
+                        retry_after_s=wait,
+                    )
+            _ADMITTED.inc(n)
 
     # --- producer-side backpressure (UpdatePipeline staging hook) -------------
 

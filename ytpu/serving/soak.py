@@ -89,6 +89,9 @@ class SoakDriver:
         ckpt_dir: Optional[str] = None,
         rtt_probes: int = 16,
         max_busy_retries: int = 200,
+        telemetry_port: Optional[int] = None,
+        probe_at: Optional[float] = None,
+        probe=None,
     ):
         self.server = server
         self.scenario = scenario
@@ -101,10 +104,49 @@ class SoakDriver:
         self.ckpt_dir = ckpt_dir
         self.rtt_probes = rtt_probes
         self.max_busy_retries = max_busy_retries
+        #: mid-soak observation hook: at fraction ``probe_at`` of round
+        #: 0's schedule, ``probe()`` is called — the telemetry rehearsal
+        #: scrapes the live HTTP endpoints there, mid-run by construction
+        self.probe_at = probe_at
+        self.probe = probe
         self._sessions: Dict[int, object] = {}
         self._counts: Dict[str, int] = {}
         self._apply_hist = metrics.histogram("soak.apply_e2e")
         self._diff_hist = metrics.histogram("soak.diff_latency")
+        # live telemetry plane (ISSUE-11): the DRIVER owns the endpoint
+        # (not the server object — a mid-soak checkpoint/restore swaps
+        # the server out; the driver survives), exposing the in-flight
+        # SLO windows under `/snapshot`'s "soak" section
+        self._live = None  # (apply_w, e2e_w, diff_w, floor_s) during run
+        self._running = False
+        self.telemetry = None
+        if telemetry_port is not None:
+            from ytpu.utils.telemetry import TelemetryServer
+
+            self.telemetry = TelemetryServer(port=telemetry_port)
+            self.telemetry.add_provider("soak", self._live_slo)
+            self.telemetry.start()
+
+    def _live_slo(self) -> Dict:
+        """`/snapshot`'s "soak" section: the CURRENT run's SLO windows
+        (what the final report will score), readable mid-run."""
+        if self._live is None:
+            return {"running": False}
+        apply_w, e2e_w, diff_w, floor_s = self._live
+        try:
+            # read from the scrape thread while run() mutates: a resize
+            # mid-copy surfaces as RuntimeError — skip counts this scrape
+            # rather than fail it (the SLO windows are lock-protected)
+            counts = dict(self._counts)
+        except RuntimeError:
+            counts = {}
+        return {
+            "running": self._running,
+            **{k: v for k, v in sorted(counts.items())},
+            **slo_report(apply_w, floor_s, "apply_"),
+            **slo_report(e2e_w, floor_s, "apply_e2e_"),
+            **slo_report(diff_w, floor_s, "diff_"),
+        }
 
     # --- plumbing --------------------------------------------------------------
 
@@ -294,9 +336,15 @@ class SoakDriver:
         scenario = self.scenario
         self._preregister_clients(scenario)
         rtt_floor_s = self._measure_rtt_floor(scenario)
+        # fresh delta windows per run(): back-to-back soak runs (or
+        # rounds driven as separate runs) must never blend percentiles —
+        # the windows below this line see ONLY this run's samples
+        # (pinned by tests/test_metrics_trace.py window-reset test)
         apply_w = HistogramWindow(metrics.histogram("sync.apply_update"))
         e2e_w = HistogramWindow(self._apply_hist)
         diff_w = HistogramWindow(self._diff_hist)
+        self._live = (apply_w, e2e_w, diff_w, rtt_floor_s)
+        self._running = True
         self._counts = {}
         self._applies_by_tenant: Dict[str, int] = {}
         complete = True
@@ -331,6 +379,13 @@ class SoakDriver:
                 if rnd == 0 and self.rebalance_at is not None
                 else None
             )
+            probe_idx = (
+                int(total * self.probe_at)
+                if rnd == 0
+                and self.probe_at is not None
+                and self.probe is not None
+                else None
+            )
             backlog: List = []  # Busy-deferred (event, retries)
             for i, ev in enumerate(schedule):
                 if over_budget():
@@ -340,6 +395,8 @@ class SoakDriver:
                     self._checkpoint_restore()
                 if reb_idx is not None and i == reb_idx:
                     self._rebalance()
+                if probe_idx is not None and i == probe_idx:
+                    self.probe()
                 self._handle(ev, 0, backlog)
                 self._bump("events")
             # drain the Busy backlog: defer policy converges because the
@@ -355,6 +412,7 @@ class SoakDriver:
                 break
             rounds_done += 1
         wall_s = time.perf_counter() - t_start
+        self._running = False  # windows stay scrapeable, marked final
         self._flush()
         self._drain_all()
         for sess in self._sessions.values():
@@ -454,16 +512,32 @@ def run_soak_tcp(
     budget_s: float = 30.0,
     idle_flush: float = 0.05,
     frame_deadline: float = 2.0,
+    telemetry_port: Optional[int] = None,
+    probe=None,
+    probe_at_events: int = 0,
 ) -> Dict:
     """Transport-level soak: the same scenario over real localhost
     sockets (`sync.net.serve`), for chaos runs — ``arm`` is called after
     every session's handshake completes, so armed ``net.drop`` /
     ``net.delay`` / ``net.truncate`` specs hit steady-state traffic, not
     the hello.  Scores survivability, not parity (dropped frames are the
-    point); the server must outlive every injected transport fault."""
+    point); the server must outlive every injected transport fault.
+
+    ``telemetry_port`` starts a live `TelemetryServer` for the run (the
+    returned counts carry the bound port); ``probe`` is called ONCE when
+    ``probe_at_events`` events have shipped — the telemetry rehearsal
+    scrapes `/metrics` mid-soak there, with real `net.*` traffic on the
+    wire by construction."""
     import asyncio
 
     from ytpu.sync.net import FrameTimeout, read_frame, serve, write_frame
+
+    telemetry = None
+    if telemetry_port is not None:
+        from ytpu.utils.telemetry import TelemetryServer
+
+        telemetry = TelemetryServer(port=telemetry_port)
+        telemetry.start()
 
     async def main():
         srv, port = await serve(
@@ -521,6 +595,16 @@ def run_soak_tcp(
                 write_frame(writer, msg.encode_v1())
                 await writer.drain()
                 counts["sent"] += 1
+                if probe is not None and counts["sent"] == max(
+                    1, probe_at_events
+                ):
+                    # mid-soak scrape: the telemetry thread answers while
+                    # this loop blocks — exactly the liveness the plane
+                    # exists to provide. The probe gets the bound port
+                    # (None when the caller brought their own endpoint).
+                    probe(
+                        telemetry.port if telemetry is not None else None
+                    )
                 # opportunistic pump keeps both sockets' buffers drained
                 try:
                     await read_frame(
@@ -538,10 +622,17 @@ def run_soak_tcp(
         await srv.wait_closed()
         return counts
 
-    counts = asyncio.run(main())
+    try:
+        counts = asyncio.run(main())
+    finally:
+        if telemetry is not None:
+            counts_port = telemetry.port
+            telemetry.stop()
     flush = getattr(server, "flush_device", None)
     if flush is not None:
         with faults.suspended():
             flush()
+    if telemetry is not None:
+        counts["telemetry_port"] = counts_port
     counts["survived"] = True
     return counts

@@ -65,6 +65,7 @@ class DeviceSyncServer(SyncServer):
         device_authoritative: bool = False,
         diff_sub_batch: int = 512,
         diff_depth: int = 2,
+        telemetry_port: Optional[int] = None,
         **kwargs,
     ):
         super().__init__(**kwargs)
@@ -108,6 +109,42 @@ class DeviceSyncServer(SyncServer):
         self._queues: List[List[bytes]] = [
             [] for _ in range(ingestor.n_docs)
         ]
+        # per-queued-update request trace ids, in lockstep with _queues
+        # (ISSUE-11): the device-dispatch span names the requests whose
+        # updates it ships, closing the net → admission → dispatch chain
+        self._queue_traces: List[List[Optional[str]]] = [
+            [] for _ in range(ingestor.n_docs)
+        ]
+        self._last_dispatch = metrics.gauge("sync.last_dispatch_unix")
+        # live telemetry plane (ISSUE-11): `telemetry_port` starts the
+        # scrapeable HTTP endpoint on its own daemon thread (0 = any
+        # free port; None = off). docs/observability.md §Live telemetry.
+        self.telemetry = None
+        if telemetry_port is not None:
+            from ytpu.utils.telemetry import TelemetryServer
+
+            self.telemetry = TelemetryServer(port=telemetry_port)
+            self.telemetry.add_provider("server", self._telemetry_provider)
+            self.telemetry.start()
+
+    def _telemetry_provider(self) -> Dict:
+        """`/snapshot` extras: the serving-side state a scraper wants
+        next to the raw metrics (JSON-safe, lock-free reads)."""
+        return {
+            "tenants": len(self.tenants),
+            "slots_assigned": len(self._slot_of),
+            "n_docs": self.ingestor.n_docs,
+            "queued_updates": self.pending_device_updates(),
+            "device_authoritative": self.device_authoritative,
+        }
+
+    def _enqueue(self, slot: int, payload: bytes) -> None:
+        """Queue one update for a slot, recording the ambient request
+        trace id (None outside a traced request) in lockstep."""
+        from ytpu.utils.trace import current_trace_id
+
+        self._queues[slot].append(payload)
+        self._queue_traces[slot].append(current_trace_id())
 
     # --- slot management -------------------------------------------------------
 
@@ -151,7 +188,7 @@ class DeviceSyncServer(SyncServer):
             def mirror(payload: bytes, origin, txn, _name=name):
                 slot = self._slot_of.get(_name)
                 if slot is not None:
-                    self._queues[slot].append(payload)
+                    self._enqueue(slot, payload)
 
             t.awareness.doc.observe_update_v1(mirror)
         return t
@@ -236,7 +273,7 @@ class DeviceSyncServer(SyncServer):
                     # tenants are served from the batch like any other
                     # (doc.rs:156-228 is the reference's normal doc shape)
                     self._note_roots(session.tenant, sub.payload)
-                    self._queues[slot].append(sub.payload)
+                    self._enqueue(slot, sub.payload)
                     self._applied.inc()
                     t.applied.inc()
                     # broadcast at-least-once (idempotent CRDT updates;
@@ -390,7 +427,7 @@ class DeviceSyncServer(SyncServer):
         if old != to_slot:
             self._free_slots.append(old)
         self._slot_of[tenant_name] = to_slot
-        self._queues[to_slot].append(payload)
+        self._enqueue(to_slot, payload)
         self.flush_device()
         metrics.counter("sync.rebalances").inc()
         return to_slot
@@ -546,6 +583,8 @@ class DeviceSyncServer(SyncServer):
         failure dumps the tracer's flight-recorder ring (`YTPU_TRACE`)
         before re-raising — a kernel abort leaves a replayable trace.
         """
+        import time as _time
+
         from ytpu.utils import tracer
 
         depth_gauge = self._queue_depth
@@ -556,16 +595,44 @@ class DeviceSyncServer(SyncServer):
             # slots' already-dequeued updates. The apply histogram times the
             # real device step here (the SLO metric), not the enqueue.
             payloads = [q[0] if q else None for q in self._queues]
+            # dispatch span (ISSUE-11): names the request trace ids whose
+            # updates this batch step ships, so the Chrome trace links a
+            # frame's net/admission spans to the device dispatch that
+            # integrated it (plus the ambient ctx of whoever flushed)
+            span = (
+                tracer.span(
+                    "sync.dispatch",
+                    step=steps,
+                    traces=[
+                        t[0] for t in self._queue_traces if t and t[0]
+                    ],
+                )
+                if tracer.enabled
+                else None
+            )
             try:
                 with self._apply_hist.time():
-                    self.ingestor.apply_bytes(payloads)
+                    if span is not None:
+                        with span:
+                            self.ingestor.apply_bytes(payloads)
+                    else:
+                        self.ingestor.apply_bytes(payloads)
             except Exception as e:
                 tracer.dump_on_error(error=e)
                 raise
             for q in self._queues:
                 if q:
                     q.pop(0)
+            for t in self._queue_traces:
+                if t:
+                    t.pop(0)
             steps += 1
+        if steps:
+            # only a REAL dispatch refreshes the freshness gauge: the
+            # serve loop flushes on every frame/idle tick, and an
+            # empty-queue flush must not make /healthz report a device
+            # that never dispatched as fresh
+            self._last_dispatch.set(_time.time())
         depth_gauge.set(sum(len(q) for q in self._queues))
         return steps
 

@@ -37,7 +37,7 @@ from ytpu.sync.protocol import (
     message_reader,
 )
 from ytpu.sync.server import DeviceBatchFull, SyncServer
-from ytpu.utils import metrics, tracer
+from ytpu.utils import metrics, trace_context, tracer
 from ytpu.utils.faults import faults
 
 # transport series (module-cached children: zero lookups per frame)
@@ -247,34 +247,49 @@ async def serve(
                     if reader.at_eof():
                         break
                 else:
-                    try:
-                        for f in server.receive_frames(session, frame):
-                            write_frame(writer, f)
-                    except _PEER_ERRORS:
-                        # malformed frame: this session's problem only
-                        _BAD_FRAMES.inc()
-                        _SESSIONS_DROPPED.labels("bad_frame").inc()
-                        break
-                    except Exception as e:
-                        # a server-side bug triggered by one frame must
-                        # not escape into asyncio's exception handler N
-                        # times per reconnect storm; the session drops,
-                        # the accept loop lives — and the flight
-                        # recorder keeps what threw (bounded ring)
-                        _BAD_FRAMES.inc()
-                        _SESSIONS_DROPPED.labels("bad_frame").inc()
-                        tracer.instant(
-                            "net.bad_frame",
-                            error=repr(e),
-                            tenant=session.tenant,
-                            session=session.id,
-                        )
-                        break
-                    frames_seen += 1
-                    if flush_every and frames_seen % flush_every == 0:
-                        flush = getattr(server, "flush_device", None)
-                        if flush is not None:
-                            flush()
+                    # end-to-end request tracing (ISSUE-11): ONE trace id
+                    # per inbound frame, carried by the ambient context
+                    # through admission → apply/queue → device dispatch →
+                    # reply, so a YTPU_TRACE dump shows the frame's full
+                    # host-side life. Disabled tracer = shared no-op
+                    # context, zero per-frame allocation.
+                    with trace_context(tenant=tenant, session=session.id):
+                        try:
+                            with tracer.span("net.frame", bytes=len(frame)):
+                                replies = server.receive_frames(
+                                    session, frame
+                                )
+                            with tracer.span(
+                                "net.reply", frames=len(replies)
+                            ):
+                                for f in replies:
+                                    write_frame(writer, f)
+                        except _PEER_ERRORS:
+                            # malformed frame: this session's problem only
+                            _BAD_FRAMES.inc()
+                            _SESSIONS_DROPPED.labels("bad_frame").inc()
+                            break
+                        except Exception as e:
+                            # a server-side bug triggered by one frame
+                            # must not escape into asyncio's exception
+                            # handler N times per reconnect storm; the
+                            # session drops, the accept loop lives — and
+                            # the flight recorder keeps what threw
+                            # (bounded ring)
+                            _BAD_FRAMES.inc()
+                            _SESSIONS_DROPPED.labels("bad_frame").inc()
+                            tracer.instant(
+                                "net.bad_frame",
+                                error=repr(e),
+                                tenant=session.tenant,
+                                session=session.id,
+                            )
+                            break
+                        frames_seen += 1
+                        if flush_every and frames_seen % flush_every == 0:
+                            flush = getattr(server, "flush_device", None)
+                            if flush is not None:
+                                flush()
                 # own outbox only (frame processed or idle wakeup)
                 for payload in server.drain(session):
                     write_frame(writer, payload)

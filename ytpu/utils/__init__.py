@@ -5,10 +5,21 @@ from .faults import FaultError, FaultInjector, FaultSpec, faults
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry, metrics
 from .phases import PhaseRecorder, phases
 from .slo import HistogramWindow, slo_report
-from .trace import Tracer, trace_span, tracer
+from .telemetry import TelemetryServer
+from .trace import (
+    Tracer,
+    current_trace,
+    current_trace_id,
+    new_trace_id,
+    trace_context,
+    trace_span,
+    tracer,
+)
 
 __all__ = [
     "Counter",
+    "current_trace",
+    "current_trace_id",
     "FaultError",
     "FaultInjector",
     "FaultSpec",
@@ -18,9 +29,12 @@ __all__ = [
     "HistogramWindow",
     "MetricsRegistry",
     "metrics",
+    "new_trace_id",
     "PhaseRecorder",
     "phases",
     "slo_report",
+    "TelemetryServer",
+    "trace_context",
     "Tracer",
     "trace_span",
     "tracer",

@@ -40,7 +40,10 @@ __all__ = [
     "metrics",
 ]
 
-_LABEL_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
+# \Z, not $: `$` matches BEFORE a trailing newline, so "tenant\n" used to
+# validate as a label name and emit a malformed exposition line (the label
+# VALUE escaping below never saw it — names are emitted verbatim)
+_LABEL_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
 
 
 def _escape(v) -> str:

@@ -68,24 +68,47 @@ class HistogramWindow:
                 return Histogram.bucket_upper_s(b)
         return Histogram.bucket_upper_s(Histogram.N_BUCKETS - 1)
 
+    @property
+    def max_s(self) -> float:
+        """Windowed maximum, at bucket resolution: the upper bound of
+        the highest delta bucket holding ≥1 sample (the histogram stores
+        bucket counts, not raw samples — a windowed exact max is not
+        derivable from a cumulative max, so this reports the same
+        upper-bucket bound the quantiles use). 0.0 for an empty
+        window."""
+        counts, n, _ = self._delta()
+        if n <= 0:
+            return 0.0
+        last = max((b for b, c in enumerate(counts) if c), default=0)
+        return Histogram.bucket_upper_s(last)
+
 
 def slo_report(
     window: HistogramWindow,
     floor_s: float = 0.0,
     prefix: str = "",
-    quantiles=(0.50, 0.99),
+    quantiles=(0.50, 0.99, 0.999),
 ) -> Dict[str, float]:
     """One histogram window → flat SLO dict (ms, 3 decimals).
 
-    Keys: ``{prefix}p50_ms`` / ``{prefix}p99_ms`` (raw) and
-    ``{prefix}p50_ms_adj`` / ``{prefix}p99_ms_adj`` (RTT-floor-subtracted,
-    clamped at 0) plus ``{prefix}count``.  ``floor_s`` is the idle-echo
-    round-trip floor the soak driver measured for THIS run.
+    Keys: ``{prefix}p50_ms`` / ``{prefix}p99_ms`` / ``{prefix}p999_ms``
+    / ``{prefix}max_ms`` (raw) and their ``_adj`` twins
+    (RTT-floor-subtracted, clamped at 0) plus ``{prefix}count``.
+    ``floor_s`` is the idle-echo round-trip floor the soak driver
+    measured for THIS run.  p999/max exist because the p99 alone hides
+    exactly the conflict-scan tail ROADMAP item 2 targets — a soak can
+    regress its extreme tail 10× without moving p99 at these sample
+    counts.
     """
     out: Dict[str, float] = {f"{prefix}count": window.count}
     for q in quantiles:
-        name = f"p{int(q * 100)}"
+        # 0.999 must NOT collapse into "p99" (int(99.9) == 99): format
+        # via %g and strip the dot — 0.5→p50, 0.99→p99, 0.999→p999
+        name = "p" + f"{q * 100:g}".replace(".", "")
         raw = window.quantile(q)
         out[f"{prefix}{name}_ms"] = round(raw * 1e3, 3)
         out[f"{prefix}{name}_ms_adj"] = round(max(0.0, raw - floor_s) * 1e3, 3)
+    mx = window.max_s
+    out[f"{prefix}max_ms"] = round(mx * 1e3, 3)
+    out[f"{prefix}max_ms_adj"] = round(max(0.0, mx - floor_s) * 1e3, 3)
     return out

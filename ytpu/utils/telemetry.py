@@ -1,0 +1,250 @@
+"""Live telemetry plane: a scrapeable HTTP endpoint over the flight
+recorder (ISSUE-11; docs/observability.md §Live telemetry).
+
+Everything the repo measured before this module was post-hoc: metrics and
+phase timers only surfaced in `bench.py`'s one-line JSON after the run
+ended. `TelemetryServer` is the missing listener — a stdlib
+`http.server` on its OWN daemon thread, so a soak, a serving pod, or a
+long replay is watchable live while the main thread stays on the data
+path. Three endpoints:
+
+- ``/metrics`` — Prometheus text exposition 0.0.4, straight from
+  `MetricsRegistry.prometheus_text()` (so a real Prometheus scrape
+  works unmodified);
+- ``/snapshot`` — one JSON object merging `metrics.snapshot()`,
+  `phases.snapshot()` and any registered *providers* (e.g. the soak
+  driver's live SLO windows, a device server's slot/queue view);
+- ``/healthz`` — liveness + the degradation surface: the sticky
+  lane-demotion ladder (`integrate_kernel.lane_health()`) and the age
+  of the last device dispatch. A wedged device shows as a growing
+  ``last_dispatch_age_s`` while this endpoint keeps answering (its
+  thread never touches the data path), which is exactly what a probe
+  wants to distinguish "slow" from "dead".
+
+Design constraints honored:
+
+- **zero data-path cost**: nothing here is called from the hot path;
+  handlers read the same lock-protected registries the exporters always
+  read.
+- **no heavy imports**: `/healthz` reads the lane ladder only when
+  `ytpu.ops.integrate_kernel` is ALREADY loaded (`sys.modules` probe) —
+  a host-only process scraping its telemetry never drags jax in.
+- **ephemeral by default**: ``port=0`` binds any free port (the bound
+  port is on `server.port` after `start()`), so parallel soaks/tests
+  never collide.
+
+Attach points: ``DeviceSyncServer(telemetry_port=...)``,
+``SoakDriver(telemetry_port=...)`` / ``run_soak_tcp(telemetry_port=...)``,
+or standalone::
+
+    from ytpu.utils.telemetry import TelemetryServer
+    t = TelemetryServer(port=9100)
+    t.add_provider("pool", lambda: {"sessions": n_live})
+    t.start()
+    ...
+    t.stop()
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from .metrics import metrics
+from .phases import phases
+
+__all__ = ["TelemetryServer"]
+
+#: metrics the plane records about itself (scrape visibility is also an
+#: observability surface — a dashboard that stops updating should be
+#: distinguishable from a process that stopped serving)
+_SCRAPES = metrics.counter("telemetry.scrapes", labelnames=("endpoint",))
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "ytpu-telemetry/1"
+
+    # set per TelemetryServer via the handler subclass it builds
+    telemetry: "TelemetryServer"
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+    def _reply(self, status: int, content_type: str, body: bytes) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib handler contract)
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                _SCRAPES.labels("metrics").inc()
+                self._reply(
+                    200,
+                    "text/plain; version=0.0.4; charset=utf-8",
+                    metrics.prometheus_text().encode("utf-8"),
+                )
+            elif path == "/snapshot":
+                _SCRAPES.labels("snapshot").inc()
+                self._reply(
+                    200,
+                    "application/json",
+                    json.dumps(self.telemetry.snapshot()).encode("utf-8"),
+                )
+            elif path in ("/healthz", "/health"):
+                _SCRAPES.labels("healthz").inc()
+                self._reply(
+                    200,
+                    "application/json",
+                    json.dumps(self.telemetry.healthz()).encode("utf-8"),
+                )
+            else:
+                self._reply(404, "text/plain", b"not found\n")
+        except BrokenPipeError:
+            pass  # scraper went away mid-reply: its problem, not ours
+        except Exception as e:  # a provider bug must not kill the plane
+            try:
+                self._reply(
+                    500,
+                    "application/json",
+                    json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"[:300]}
+                    ).encode("utf-8"),
+                )
+            except Exception:
+                pass
+
+
+class TelemetryServer:
+    """Scrapeable telemetry endpoint on a daemon thread (see module
+    docstring). ``providers`` are named zero-arg callables whose
+    JSON-safe return values merge into ``/snapshot`` under their name —
+    the hook the soak driver uses to expose its live SLO windows."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        providers: Optional[Dict[str, Callable[[], object]]] = None,
+    ):
+        self.host = host
+        self.requested_port = port
+        self.port: Optional[int] = None
+        self._providers: Dict[str, Callable[[], object]] = dict(
+            providers or {}
+        )
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = time.time()
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self) -> int:
+        """Bind + serve on a daemon thread; returns the bound port."""
+        if self._httpd is not None:
+            return self.port  # idempotent
+        outer = self
+
+        class Handler(_Handler):
+            telemetry = outer
+
+        httpd = ThreadingHTTPServer((self.host, self.requested_port), Handler)
+        httpd.daemon_threads = True
+        self._httpd = httpd
+        self.port = httpd.server_address[1]
+        self._t0 = time.time()
+        self._thread = threading.Thread(
+            target=httpd.serve_forever,
+            kwargs={"poll_interval": 0.2},
+            name=f"ytpu-telemetry:{self.port}",
+            daemon=True,
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._httpd = None
+        self._thread = None
+
+    def __enter__(self) -> "TelemetryServer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # --- payload assembly ----------------------------------------------------
+
+    def add_provider(self, name: str, fn: Callable[[], object]) -> None:
+        """Register (or replace) a named `/snapshot` section."""
+        self._providers[name] = fn
+
+    def remove_provider(self, name: str) -> None:
+        self._providers.pop(name, None)
+
+    def snapshot(self) -> Dict:
+        """The `/snapshot` JSON body: metrics + phases + providers. A
+        raising provider degrades to an ``{"error": ...}`` section
+        instead of failing the scrape — the plane outlives its
+        tenants' bugs."""
+        out: Dict = {
+            "time_unix": time.time(),
+            "metrics": metrics.snapshot(),
+            "phases": phases.snapshot(),
+        }
+        for name, fn in list(self._providers.items()):
+            try:
+                out[name] = fn()
+            except Exception as e:
+                out[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        return out
+
+    def healthz(self) -> Dict:
+        """The `/healthz` JSON body. Never imports jax: the lane ladder
+        is read only when the kernel module is already loaded."""
+        out: Dict = {
+            "status": "ok",
+            "uptime_s": round(time.time() - self._t0, 3),
+            "lane_ladder": {},
+        }
+        ik = sys.modules.get("ytpu.ops.integrate_kernel")
+        if ik is not None:
+            try:
+                out["lane_ladder"] = ik.lane_health()
+            except Exception as e:
+                out["lane_ladder"] = {
+                    "error": f"{type(e).__name__}: {e}"[:200]
+                }
+        # last-dispatch age: the freshest of the serving-loop flush
+        # (sync.last_dispatch_unix) and the replay driver's chunk
+        # dispatch (integrate.last_dispatch_unix); absent until either
+        # path dispatched once. Read the two gauges directly — /healthz
+        # is the highest-frequency probe and must stay O(1), not
+        # O(registry) (gauge() get-or-creates, so reading before the
+        # serving layer registers them just sees 0)
+        last = 0.0
+        for key in ("sync.last_dispatch_unix", "integrate.last_dispatch_unix"):
+            last = max(last, float(metrics.gauge(key).value))
+        if last > 0:
+            out["last_dispatch_age_s"] = round(
+                max(0.0, time.time() - last), 3
+            )
+        return out

@@ -30,6 +30,8 @@ no allocation, no string formatting (SURVEY §5.5 hot-path rule).
 from __future__ import annotations
 
 import atexit
+import contextvars
+import itertools
 import json
 import os
 import threading
@@ -39,9 +41,82 @@ from typing import Optional
 
 from .phases import NULL_SPAN as _NULL_SPAN  # shared no-op span singleton
 
-__all__ = ["Tracer", "trace_span", "tracer"]
+__all__ = [
+    "Tracer",
+    "trace_span",
+    "tracer",
+    "trace_context",
+    "current_trace",
+    "current_trace_id",
+    "new_trace_id",
+]
 
 DEFAULT_MAX_EVENTS = 65536
+
+# --- request trace context (ISSUE-11 end-to-end tracing) ---------------------
+# One ContextVar carries the ambient request identity (trace id + tenant/
+# session args) through a request's host-side life: the transport handler
+# opens a `trace_context()` per inbound frame, and every span/instant the
+# request's processing emits — admission, apply, device dispatch, reply —
+# automatically merges the context into its args, so a Chrome-trace dump
+# correlates one frame across all layers without hand-threading ids.
+# ContextVars propagate across awaits within an asyncio task (each
+# connection handler is one task), but NOT into worker threads — thread
+# hand-offs (OverlapPipeline staging slots, device queues) carry the id
+# explicitly instead.
+
+_TRACE_CTX: "contextvars.ContextVar[Optional[dict]]" = contextvars.ContextVar(
+    "ytpu_trace_ctx", default=None
+)
+_TRACE_IDS = itertools.count(1)
+
+
+def new_trace_id() -> str:
+    """Process-unique request trace id (pid-scoped counter: cheap, and
+    distinct across the processes sharing one YTPU_TRACE template)."""
+    return f"t{os.getpid():x}-{next(_TRACE_IDS):x}"
+
+
+def current_trace() -> Optional[dict]:
+    """The ambient trace context fields, or None outside any request."""
+    return _TRACE_CTX.get()
+
+
+def current_trace_id() -> Optional[str]:
+    """The ambient request's trace id, or None outside any request."""
+    ctx = _TRACE_CTX.get()
+    return None if ctx is None else ctx.get("trace")
+
+
+class _TraceContext:
+    __slots__ = ("_fields", "_token", "fields")
+
+    def __init__(self, fields: dict):
+        self._fields = fields
+
+    def __enter__(self) -> dict:
+        outer = _TRACE_CTX.get()
+        merged = {**outer, **self._fields} if outer else self._fields
+        self.fields = merged
+        self._token = _TRACE_CTX.set(merged)
+        return merged
+
+    def __exit__(self, *exc):
+        _TRACE_CTX.reset(self._token)
+        return False
+
+
+def trace_context(trace: Optional[str] = None, **fields):
+    """Context manager installing a request trace context: ``trace`` is
+    the request id (minted fresh when omitted); extra ``fields``
+    (tenant=..., session=...) ride every span emitted inside. Nested
+    contexts merge (inner keys win). When the tracer is disabled this
+    returns the shared no-op context — zero allocation per frame."""
+    if not tracer.enabled:
+        return _NULL_SPAN
+    if trace is None:
+        trace = new_trace_id()
+    return _TraceContext({"trace": trace, **fields})
 
 
 class _Span:
@@ -102,15 +177,24 @@ class Tracer:
 
     def span(self, name: str, **args):
         """Context manager recording one complete event; the disabled
-        path returns a shared no-op (zero per-call allocation)."""
+        path returns a shared no-op (zero per-call allocation). An
+        active `trace_context()` merges its fields (trace id, tenant,
+        session) into the span args — explicit args win on collision."""
         if not self.enabled:
             return _NULL_SPAN
+        ctx = _TRACE_CTX.get()
+        if ctx is not None:
+            args = {**ctx, **args}
         return _Span(self, name, args or None)
 
     def instant(self, name: str, **args) -> None:
-        """One point-in-time marker event (phase transitions, errors)."""
+        """One point-in-time marker event (phase transitions, errors).
+        Merges the active `trace_context()` fields like `span`."""
         if not self.enabled:
             return
+        ctx = _TRACE_CTX.get()
+        if ctx is not None:
+            args = {**ctx, **args}
         ev = {
             "name": name,
             "ph": "i",
