@@ -110,6 +110,7 @@ TUNNEL_QUEUE = [
     "flagship_raw_ingest_uplift_pr7",
     "soak_slo_pr9",
     "config5_diff_pipeline_pr10",
+    "scan_two_tier_pr12",
 ]
 
 
@@ -1242,6 +1243,29 @@ def telemetry_dry_run() -> dict:
     }
 
 
+def scan_tiers_dry_run() -> dict:
+    """Two-tier conflict-scan rehearsal (ISSUE-12): adversarial p50- and
+    p99-shaped concurrent same-origin streams through the packed-XLA
+    lane, asserting the tier plan (the cheap tier carries the p50 mass
+    at unchanged trip cost; the vectorized wide tier fires on the deep
+    tail), the MEASURED ≥4× serial-`while_loop`-trip compression on the
+    p99-shaped stream, and host-oracle byte parity — the CPU-checkable
+    acceptance surface of benches/scan_tiers.py, whose device mode adds
+    the fused-lane per-update step timing (`scan_two_tier_pr12` in
+    `tunnel_queue`)."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benches", "scan_tiers.py"
+    )
+    spec = importlib.util.spec_from_file_location(
+        "ytpu_bench_scan_tiers", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.dry_run()
+
+
 def diff_overlap_dry_run(
     n_docs: int = 12, sub_batch: int = 4, depth: int = 2
 ) -> dict:
@@ -1910,17 +1934,23 @@ def roofline_report(path=None):
 
 
 def _lift_scan_width(out: dict) -> None:
-    """Headline the conflict-tail attribution (ISSUE-11): lift the
-    `integrate.scan_width_p50/p99/max` phase gauges next to the
-    throughput keys so ROADMAP item 2's two-tier-scan work has a
-    regression surface in the one-line JSON itself (dry-run: the chaos
-    replays emit them; device: the flagship replay's readout drains
-    do)."""
+    """Headline the conflict-tail attribution (ISSUE-11/12): lift the
+    `integrate.scan_width_p50/p99/max` phase gauges — whose MEANING is
+    unchanged by the two-tier scan: width still counts visited
+    candidates — plus the ISSUE-12 tier-occupancy and dispatch-trip
+    gauges next to the throughput keys, so ROADMAP item 2's scan work
+    has a regression surface in the one-line JSON itself (dry-run: the
+    chaos/scan_tiers replays emit them; device: the flagship replay's
+    readout drains do)."""
     ph = out.get("phases") or {}
     for q in ("p50", "p99", "max"):
         st = ph.get(f"integrate.scan_width_{q}")
         if st and "value" in st:
             out[f"scan_width_{q}"] = st["value"]
+    for q in ("tier_cheap", "tier_wide", "trips_serial", "trips_two_tier"):
+        st = ph.get(f"integrate.scan_{q}")
+        if st and "value" in st:
+            out[f"scan_{q}"] = st["value"]
 
 
 def main(dry_run: bool = False):
@@ -2034,6 +2064,13 @@ def main(dry_run: bool = False):
         # final report (in-proc soak.* windows + TCP net.* counters)
         with phases.span("host.telemetry_rehearsal"):
             out["telemetry"] = telemetry_dry_run()
+        # two-tier conflict-scan rehearsal (ISSUE-12): tier occupancy +
+        # the measured dispatch-trip compression on a p99-shaped deep-
+        # conflict stream, at host-oracle byte parity; runs LAST among
+        # the replay legs so the lifted scan_* gauges reflect it
+        with phases.span("host.scan_tiers_rehearsal"):
+            out["scan_tiers"] = scan_tiers_dry_run()
+        out["scan_trip_reduction"] = out["scan_tiers"]["scan_trip_reduction"]
         out["tunnel_queue"] = list(TUNNEL_QUEUE)
         out["phases"] = phases.snapshot()
         out["metrics"] = metrics.snapshot()

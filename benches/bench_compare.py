@@ -61,7 +61,13 @@ _DIRECTION_RULES: Tuple[Tuple[str, str], ...] = (
     ("vs_baseline", "up"),
     ("vs_native", "up"),
     ("vs_py_oracle", "up"),
+    ("scan_trip_reduction", "up"),  # two-tier dispatch compression factor
     ("scan_width", "down"),  # conflict-scan tail: narrower is better
+    # two-tier scan dispatch-trip counts (ISSUE-12): like latency, a
+    # rise on the same workload is a regression — more serial while
+    # trips per integrate. (Tier OCCUPANCY `scan_tier_*` stays neutral:
+    # the cheap/wide split is workload shape, not better/worse.)
+    ("scan_trips", "down"),
     ("p50_ms", "down"),
     ("p99_ms", "down"),
     ("p999_ms", "down"),

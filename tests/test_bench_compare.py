@@ -61,6 +61,37 @@ def test_directional_regressions_and_tolerance():
     assert not diff["regressions"]
 
 
+def test_scan_width_and_trip_rows_regress_like_latency():
+    """ISSUE-12 satellite: a conflict-scan width-p99 rise or a dispatch-
+    trip-count rise is a REGRESSION (like latency); a trip-reduction
+    drop regresses like a speedup; tier occupancy only drifts neutral."""
+    a = {
+        "scan_width_p99": 120,
+        "scan_trips_serial": 67000,
+        "scan_trips_two_tier": 16000,
+        "scan_trip_reduction": 4.2,
+        "scan_tier_wide": 400,
+    }
+    b = {
+        "scan_width_p99": 340,  # tail widened: regression
+        "scan_trips_serial": 67000,
+        "scan_trips_two_tier": 67000,  # compression lost: regression
+        "scan_trip_reduction": 1.0,  # factor collapsed: regression
+        "scan_tier_wide": 500,  # occupancy shift: neutral drift only
+    }
+    diff = bc.compare(a, b)
+    keys = {e["key"] for e in diff["regressions"]}
+    assert keys == {
+        "scan_width_p99",
+        "scan_trips_two_tier",
+        "scan_trip_reduction",
+    }, diff
+    assert {e["key"] for e in diff["changes"]} == {"scan_tier_wide"}
+    # and the inverse direction reports as improvements, never failures
+    diff = bc.compare(b, a)
+    assert not diff["regressions"], diff
+
+
 def test_improvements_and_added_removed_fields():
     a = {"value": 100.0, "gone": 1}
     b = {"value": 200.0, "new_key": {"x": 1}}
@@ -77,6 +108,17 @@ def test_direction_classification_rules():
     assert bc.classify("soak.apply_p999_ms") == "down"
     assert bc.classify("apply_max_ms") == "down"
     assert bc.classify("scan_width_p99") == "down"
+    assert bc.classify("scan_width_p50") == "down"
+    assert bc.classify("scan_width_max") == "down"
+    # two-tier scan (ISSUE-12): dispatch-trip counts regress when they
+    # RISE (like latency), the compression factor when it DROPS (like a
+    # speedup), and tier occupancy is reported-neutral workload shape
+    assert bc.classify("scan_trips_serial") == "down"
+    assert bc.classify("scan_trips_two_tier") == "down"
+    assert bc.classify("scan_tiers.p99.scan_trips_two_tier") == "down"
+    assert bc.classify("scan_trip_reduction") == "up"
+    assert bc.classify("scan_tier_cheap") == "neutral"
+    assert bc.classify("scan_tier_wide") == "neutral"
     assert bc.classify("phases.replay.stage.execute_s") == "neutral"
     assert bc.classify("chunks") == "neutral"
 

@@ -111,27 +111,38 @@ def _exercise():
 
 
 def test_scan_width_histogram_rides_the_readout():
-    """Tentpole (a) pins: the scan-width record materializes with the
-    existing readout (totals + max + bucket-quantiles), the gauges land
-    in phases (base + lane-suffixed), and the bucket math is coherent."""
-    from ytpu.models.batch_doc import SCAN_WIDTH_BUCKETS
+    """Tentpole (a) pins: the scan record materializes with the
+    existing readout (totals + max + bucket-quantiles + the ISSUE-12
+    tier/trip words), the gauges land in phases (base + lane-suffixed),
+    and the bucket math is coherent."""
+    from ytpu.models.batch_doc import SCAN_REC_WORDS, SCAN_WIDTH_BUCKETS
 
     stats, snap = _exercise()
     assert len(stats.scan_hist) == SCAN_WIDTH_BUCKETS
     total = sum(stats.scan_hist)
     assert total > 0, "no conflict scans recorded over a 294-update replay"
     assert 0 <= stats.scan_p50 <= stats.scan_p99 <= max(stats.scan_max, 1)
+    # ISSUE-12 tier occupancy: every scan resolved in exactly one tier,
+    # and the two-tier dispatch can never pay MORE trips than the
+    # serial-equivalent loop (the accounting words ride the same record)
+    assert stats.scan_tier_cheap + stats.scan_tier_wide == total, stats
+    # (a scan can legitimately visit zero candidates — its entry slot is
+    # already the resolved neighbor — so the trip words may both be 0)
+    assert (
+        0 <= stats.scan_trips_two_tier <= stats.scan_trips_serial
+    ), stats
     # gauges: base keys + the per-lane twins, all in the phases snapshot
-    for q in ("p50", "p99", "max"):
-        assert f"integrate.scan_width_{q}" in snap, sorted(snap)
-        assert f"integrate.scan_width_{q}.xla" in snap
-    # the histogram words rode the SAME readout future: their d2h bytes
+    for q in ("width_p50", "width_p99", "width_max", "tier_cheap",
+              "tier_wide", "trips_serial", "trips_two_tier"):
+        assert f"integrate.scan_{q}" in snap, sorted(snap)
+        assert f"integrate.scan_{q}.xla" in snap
+    # the record words rode the SAME readout future: their d2h bytes
     # are accounted under integrate.scan_hist, while replay.readout kept
     # its historical 12-bytes-per-readout accounting (the zero-sync
     # invariant test in test_async_overlap passes unchanged)
-    assert snap["integrate.scan_hist"]["d2h_bytes"] == 4 * (
-        SCAN_WIDTH_BUCKETS + 1
-    ) * (snap["replay.readout"]["d2h_bytes"] // 12)
+    assert snap["integrate.scan_hist"]["d2h_bytes"] == (
+        4 * SCAN_REC_WORDS * (snap["replay.readout"]["d2h_bytes"] // 12)
+    )
 
 
 def test_every_emitted_metric_and_phase_name_is_documented():

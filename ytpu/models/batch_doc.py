@@ -27,6 +27,7 @@ tests/test_batch_device.py, test_batch_map.py and test_batch_tree.py.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 from functools import partial
@@ -86,6 +87,8 @@ __all__ = [
     "get_map",
     "get_tree",
     "state_vectors",
+    "scan_tier_plan",
+    "merge_scan_records",
 ]
 
 I32 = jnp.int32
@@ -563,6 +566,60 @@ SCAN_WIDTH_THRESHOLDS = (2, 4, 8, 16, 32, 64, 128)
 #: the last bucket has no bound — report the observed max there
 SCAN_WIDTH_UPPER = (1, 3, 7, 15, 31, 63, 127)
 
+# --- two-tier conflict scan (ISSUE-12) ---------------------------------------
+# The serial `lax.while_loop` dispatch — not the scan's find itself —
+# owned the p99 integrate tail (p50=32 / p99=337 trips). The scan now
+# runs in two tiers shared by both integrate lanes: a CHEAP tier (the
+# original one-candidate-per-trip loop, bounded at `cheap` trips — covers
+# the p50 mass with zero extra work) and a vectorized WIDE tier whose
+# while body unrolls `unroll` candidate steps per trip (the Stream-VByte
+# move: fixed-unroll block processing replaces per-element dispatch), so
+# a width-337 scan costs 32 + ceil(305/8) = 71 trips instead of 337.
+#
+# Knob + retrace implications: the (cheap, unroll) pair is a TRACE-TIME
+# static — the chunk programs and the fused kernel thread it as a static
+# argument (like YTPU_FUSED_VMEM_MB), so the driver re-reads the env
+# per chunk and a changed value forces a retrace of the dispatch
+# programs; the bare `apply_update_batch`/`apply_update_stream` wrappers
+# AND the sequence-parallel lane (`sharded_doc`'s inline `_conflict_scan`
+# caller) read it once at first trace and keep the baked value for
+# already-compiled shapes (set the env before first dispatch, or go
+# through the replay drivers). Width SEMANTICS are tier-independent:
+# `width` counts visited candidates exactly as the single-tier loop did,
+# so the scan-width histogram and `scan_width_p50/p99/max` keep their
+# meaning.
+
+SCAN_TIER_CHEAP_DEFAULT = 32
+SCAN_WIDE_UNROLL_DEFAULT = 8
+
+
+def scan_tier_plan() -> tuple:
+    """Resolve the (cheap_bound, wide_unroll) tier plan from the
+    environment (``YTPU_SCAN_TIER_CHEAP`` / ``YTPU_SCAN_WIDE_UNROLL``).
+    ``cheap=0`` disables the cheap tier (every scan goes wide — the
+    bench's forcing knob); ``unroll=1`` degenerates the wide tier to the
+    pre-ISSUE-12 serial loop."""
+    cheap = int(
+        os.environ.get("YTPU_SCAN_TIER_CHEAP", SCAN_TIER_CHEAP_DEFAULT)
+    )
+    unroll = int(
+        os.environ.get("YTPU_SCAN_WIDE_UNROLL", SCAN_WIDE_UNROLL_DEFAULT)
+    )
+    return (max(0, cheap), max(1, unroll))
+
+
+# per-doc scan-record word layout (rides the chunk programs' meta tile
+# at integrate_kernel.M_HIST0.. and the lazy readout): pow2 bucket
+# counts, the observed max width, then the ISSUE-12 tier-occupancy and
+# trip-accounting words. All words ADD under merge except the max.
+SCAN_REC_MAX = SCAN_WIDTH_BUCKETS  # observed max width
+SCAN_REC_CHEAP = SCAN_WIDTH_BUCKETS + 1  # scans resolved in the cheap tier
+SCAN_REC_WIDE = SCAN_WIDTH_BUCKETS + 2  # scans that escalated to the wide tier
+SCAN_REC_CHEAP_TRIPS = SCAN_WIDTH_BUCKETS + 3  # Σ min(width, cheap_bound)
+SCAN_REC_WIDE_TRIPS = SCAN_WIDTH_BUCKETS + 4  # Σ wide-tier block trips
+SCAN_REC_WIDTH_SUM = SCAN_WIDTH_BUCKETS + 5  # Σ width = serial-equiv trips
+SCAN_REC_WORDS = SCAN_WIDTH_BUCKETS + 6
+
 
 def scan_width_bucket(w):
     """Bucket index of one width sample (traced jnp value)."""
@@ -572,14 +629,39 @@ def scan_width_bucket(w):
     return b
 
 
-def _fold_scan_width(hist, w):
-    """Fold one row's scan-width sample (``-1`` = no scan) into a
-    ``[SCAN_WIDTH_BUCKETS + 1]`` record: bucket counts + max width."""
+def _fold_scan_width(hist, w, wide_trips, cheap_bound: int):
+    """Fold one row's scan sample (``w = -1`` = no scan; ``wide_trips``
+    the wide-tier block trips it took) into a ``[SCAN_REC_WORDS]``
+    record: bucket counts, max width, tier occupancy (resolved-cheap vs
+    escalated-wide), and the exact trip accounting — ``Σ min(w, cheap)``
+    cheap trips + ``Σ wide_trips`` block trips is the two-tier dispatch
+    cost, ``Σ w`` the serial-equivalent cost the pre-ISSUE-12 loop paid
+    (one trip per visited candidate), so their ratio IS the measured
+    dispatch-trip compression."""
     scanned = w >= 0
     wc = jnp.maximum(w, 0)
     b = scan_width_bucket(wc)
     hist = hist.at[b].add(scanned.astype(I32))
-    return hist.at[SCAN_WIDTH_BUCKETS].max(jnp.where(scanned, wc, 0))
+    hist = hist.at[SCAN_REC_MAX].max(jnp.where(scanned, wc, 0))
+    wide = scanned & (wide_trips > 0)
+    hist = hist.at[SCAN_REC_CHEAP].add((scanned & ~wide).astype(I32))
+    hist = hist.at[SCAN_REC_WIDE].add(wide.astype(I32))
+    hist = hist.at[SCAN_REC_CHEAP_TRIPS].add(
+        jnp.where(scanned, jnp.minimum(wc, cheap_bound), 0)
+    )
+    hist = hist.at[SCAN_REC_WIDE_TRIPS].add(jnp.where(scanned, wide_trips, 0))
+    return hist.at[SCAN_REC_WIDTH_SUM].add(jnp.where(scanned, wc, 0))
+
+
+def merge_scan_records(a, b):
+    """Combine two scan records (or ``[..., SCAN_REC_WORDS]`` stacks):
+    every word adds except the observed-max word, which maxes. One
+    definition shared by the stream body and the chunk programs'
+    meta-fold so the merge rule can never drift."""
+    out = a + b
+    return out.at[..., SCAN_REC_MAX].set(
+        jnp.maximum(a[..., SCAN_REC_MAX], b[..., SCAN_REC_MAX])
+    )
 
 
 def scan_width_quantile(counts, q: float, observed_max: int) -> int:
@@ -614,6 +696,7 @@ def _conflict_scan(
     right_idx,
     o0,
     left_idx,
+    scan_plan: Optional[tuple] = None,
 ):
     """The YATA conflict scan (parity: block.rs:537-602), shared by the
     batched engine and the sequence-parallel engine (`sharded_doc`).
@@ -622,14 +705,28 @@ def _conflict_scan(
     resolving the final left neighbor: same-origin candidates tie-break on
     real client rank (case 1); candidates anchored inside the scanned
     region fold per the before/conflicting set rules (case 2). Returns
-    ``(left_scanned, width)``: the scanned left slot (callers apply it
-    only where their `need_scan` predicate held) and the number of
+    ``(left_scanned, width, wide_trips)``: the scanned left slot (callers
+    apply it only where their `need_scan` predicate held), the number of
     candidates the walk visited — the conflict-tail attribution sample
     (ISSUE-11) the integrate lanes fold into the lazy scan-width
-    histogram. Callers that don't track widths discard the second value
-    (XLA dead-code-eliminates the counter).
+    histogram — and the number of WIDE-TIER block trips the walk took
+    (0 = resolved entirely in the cheap tier; the ISSUE-12 tier-occupancy
+    sample). Callers that don't track widths discard the extra values
+    (XLA dead-code-eliminates the counters).
 
-    Cost model (VERDICT r4 #9): each while trip is ~8 capacity-wide
+    Two-tier dispatch (ISSUE-12): `scan_plan = (cheap_bound, unroll)`
+    (default: `scan_tier_plan()`, read at trace time). The CHEAP tier is
+    the original loop — one candidate per `while_loop` trip — bounded at
+    `cheap_bound` trips, which covers the p50=32 mass at zero extra cost.
+    A scan still unresolved after the bound escalates to the WIDE tier,
+    whose while body unrolls `unroll` candidate steps per trip: each
+    sub-step is fully masked by its own `active` predicate, so a scan
+    that resolves mid-block no-ops through the remaining sub-steps. Per-
+    candidate work is IDENTICAL to the single-tier loop — what shrinks is
+    the serial `while_loop` trip count (the measured owner of the p99
+    integrate tail), from `w` to `min(w, cheap) + ceil((w-cheap)/unroll)`.
+
+    Cost model (VERDICT r4 #9): each candidate step is ~8 capacity-wide
     vector ops; before round 5 it was dominated by the unconditional
     case-2 origin resolution (`_find_slot`, an O(B) compare per trip —
     measured width distribution on the 256-client concurrent-array
@@ -639,19 +736,22 @@ def _conflict_scan(
     origin), repaired on splits with one vector op, and remapped by
     compaction's permutation (absorbed rows redirect to their chain head,
     whose widened range still contains the origin clock)."""
+    cheap_bound, unroll = scan_plan if scan_plan is not None else scan_tier_plan()
     bl = state.blocks
     B = _capacity(bl)
     safe = lambda idx: jnp.maximum(idx, 0)
 
-    def scan_cond(carry):
+    def scan_step(carry):
+        """One candidate step, fully masked by `active` so it composes
+        both as a whole while trip (cheap tier) and as one sub-step of a
+        fixed-unroll wide-tier block (an inactive step is a no-op)."""
         o, left, conflicting, before, brk, width = carry
-        return (o >= 0) & (o != right_idx) & ~brk
-
-    def scan_body(carry):
-        o, left, conflicting, before, brk, width = carry
+        active = (o >= 0) & (o != right_idx) & ~brk
         so = safe(o)
-        before = before.at[so].set(True)
-        conflicting = conflicting.at[so].set(True)
+        # guarded scatters: an inactive step must not touch slot 0
+        wslot = jnp.where(active, so, B)
+        before = before.at[wslot].set(True, mode="drop")
+        conflicting = conflicting.at[wslot].set(True, mode="drop")
         same_origin = _origins_equal(
             has_origin,
             origin_client,
@@ -685,35 +785,64 @@ def _conflict_scan(
         case2_take = ~same_origin & in_before & ~in_conflicting
         case2_break = ~same_origin & ~in_before
 
-        take = case1_take | case2_take
+        take = (case1_take | case2_take) & active
         left = jnp.where(take, o, left)
         conflicting = jnp.where(take, jnp.zeros_like(conflicting), conflicting)
-        brk = case1_break | case2_break
-        o = jnp.where(brk, o, bl.right[so])
-        return (o, left, conflicting, before, brk, width + 1)
+        brk = brk | ((case1_break | case2_break) & active)
+        o = jnp.where(active & ~brk, bl.right[so], o)
+        return (o, left, conflicting, before, brk, width + active.astype(I32))
+
+    def cheap_cond(carry):
+        o, left, conflicting, before, brk, width = carry
+        # `width` doubles as the cheap-tier trip counter: the tier admits
+        # exactly one candidate per trip, so width == trips here
+        return (o >= 0) & (o != right_idx) & ~brk & (width < cheap_bound)
 
     zeros = jnp.zeros((B,), bool)
-    _, left_scanned, _, _, _, width = jax.lax.while_loop(
-        scan_cond,
-        scan_body,
+    carry = jax.lax.while_loop(
+        cheap_cond,
+        scan_step,
         (o0, left_idx, zeros, zeros, jnp.array(False), I32(0)),
     )
-    return left_scanned, width
+
+    def wide_cond(carry):
+        inner, wtrips = carry
+        o, left, conflicting, before, brk, width = inner
+        return (o >= 0) & (o != right_idx) & ~brk
+
+    def wide_body(carry):
+        inner, wtrips = carry
+        for _ in range(unroll):
+            inner = scan_step(inner)
+        return inner, wtrips + 1
+
+    (_, left_scanned, _, _, _, width), wide_trips = jax.lax.while_loop(
+        wide_cond, wide_body, (carry, I32(0))
+    )
+    return left_scanned, width, wide_trips
 
 
-def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array):
+def _integrate_row(
+    state: DocStateBatch,
+    row,
+    client_rank: jax.Array,
+    scan_plan: Optional[tuple] = None,
+):
     """Integrate one incoming block row (YATA; parity: block.rs:482-769).
 
     `client_rank[c]` is the rank of interned client c in *real client id*
     order — the YATA tie-break (block.rs:571-580) is defined on real ids,
     which interning does not preserve.
 
-    Returns (state, moves_dirty, scan_width): dirty is True when move
-    ownership must be recomputed (a move row arrived, or an insert landed
-    between rows owned by *different* moves — the reconciliation case of
-    block.rs:677-702); scan_width is the conflict-scan width sample for
-    this row (-1 when no scan was needed — the cheap path), feeding the
-    ISSUE-11 scan-width histogram.
+    Returns (state, moves_dirty, scan_width, scan_wide_trips): dirty is
+    True when move ownership must be recomputed (a move row arrived, or
+    an insert landed between rows owned by *different* moves — the
+    reconciliation case of block.rs:677-702); scan_width is the
+    conflict-scan width sample for this row (-1 when no scan was needed
+    — the no-scan path), feeding the ISSUE-11 scan-width histogram;
+    scan_wide_trips the ISSUE-12 wide-tier block-trip count (0 = the
+    cheap tier resolved it). `scan_plan` is the two-tier (cheap, unroll)
+    static — None reads `scan_tier_plan()` at trace time.
     """
     (
         r_client,
@@ -855,7 +984,7 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array):
         anchor0,
     )
     o0 = jnp.where(need_scan, o0, -1)
-    left_scanned, scan_w = _conflict_scan(
+    left_scanned, scan_w, wide_w = _conflict_scan(
         state,
         client_rank,
         r_client,
@@ -868,9 +997,11 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array):
         right_idx,
         o0,
         left_idx,
+        scan_plan=scan_plan,
     )
     left_idx = jnp.where(need_scan, left_scanned, left_idx)
     scan_width = jnp.where(need_scan, scan_w, I32(-1))
+    scan_wide_trips = jnp.where(need_scan, wide_w, I32(0))
 
     # --- link in (parity: block.rs:614-659) ---
     j = state.n_blocks
@@ -964,7 +1095,7 @@ def _integrate_row(state: DocStateBatch, row, client_rank: jax.Array):
         n_blocks=state.n_blocks + do.astype(I32),
         error=error,
     )
-    return out, moves_dirty, scan_width
+    return out, moves_dirty, scan_width, scan_wide_trips
 
 
 def _apply_delete_range(state: DocStateBatch, client, start, end, valid):
@@ -1199,13 +1330,18 @@ def _recompute_moves(
 
 
 def _apply_update_one_doc(
-    state: DocStateBatch, batch: UpdateBatch, client_rank: jax.Array
+    state: DocStateBatch,
+    batch: UpdateBatch,
+    client_rank: jax.Array,
+    scan_plan: Optional[tuple] = None,
 ):
     """Returns ``(state, scan_hist)`` — scan_hist is the per-doc
-    conflict-scan-width record ``[SCAN_WIDTH_BUCKETS + 1]`` i32 (pow2
-    bucket counts + max width, ISSUE-11) accumulated over this batch's
-    rows; callers that only want the state drop it (XLA DCEs the
-    counter when the output is unused)."""
+    conflict-scan record ``[SCAN_REC_WORDS]`` i32 (pow2 bucket counts,
+    max width, ISSUE-12 tier occupancy + trip accounting) accumulated
+    over this batch's rows; callers that only want the state drop it
+    (XLA DCEs the counter when the output is unused)."""
+    if scan_plan is None:
+        scan_plan = scan_tier_plan()
     U = batch.client.shape[-1]
     R = batch.del_client.shape[-1]
 
@@ -1238,15 +1374,15 @@ def _apply_update_one_doc(
         )
         # padding rows skip all work; with a broadcast (unbatched) update the
         # predicate is scalar, so XLA executes only one branch
-        st, d, w = jax.lax.cond(
+        st, d, w, wt = jax.lax.cond(
             batch.valid[i],
-            lambda s: _integrate_row(s, row, client_rank),
-            lambda s: (s, jnp.array(False), I32(-1)),
+            lambda s: _integrate_row(s, row, client_rank, scan_plan),
+            lambda s: (s, jnp.array(False), I32(-1), I32(0)),
             st,
         )
-        return st, dirty | d, _fold_scan_width(hist, w)
+        return st, dirty | d, _fold_scan_width(hist, w, wt, scan_plan[0])
 
-    hist0 = jnp.zeros((SCAN_WIDTH_BUCKETS + 1,), I32)
+    hist0 = jnp.zeros((SCAN_REC_WORDS,), I32)
     state, moves_dirty, scan_hist = jax.lax.fori_loop(
         0, U, blk_body, (state, jnp.array(False), hist0)
     )
@@ -1290,7 +1426,10 @@ def apply_update_batch(
 
 
 def _apply_update_stream_hist_body(
-    state: DocStateBatch, stream: UpdateBatch, client_rank: jax.Array
+    state: DocStateBatch,
+    stream: UpdateBatch,
+    client_rank: jax.Array,
+    scan_plan: Optional[tuple] = None,
 ):
     """Integrate a whole stream of updates per doc in one compiled program.
 
@@ -1300,38 +1439,35 @@ def _apply_update_stream_hist_body(
     wall-clock per step is pure device time.
 
     Returns ``(state, scan_hist)``: scan_hist is the per-doc
-    ``[D, SCAN_WIDTH_BUCKETS + 1]`` conflict-scan-width record (bucket
-    counts summed over the stream + per-doc max, ISSUE-11). The public
-    wrapper discards it; the replay chunk programs fold it into the meta
-    tile so it rides the lazy readout.
+    ``[D, SCAN_REC_WORDS]`` conflict-scan record (bucket counts, tier
+    occupancy and trip words summed over the stream; per-doc max width —
+    ISSUE-11/12). The public wrapper discards it; the replay chunk
+    programs fold it into the meta tile so it rides the lazy readout.
+    `scan_plan` is the two-tier static (None = `scan_tier_plan()` at
+    trace time; the chunk programs thread their own static through).
     """
     D = state.start.shape[0]
+    if scan_plan is None:
+        scan_plan = scan_tier_plan()
 
     def step(carry, batch):
         st, hist = carry
-        st, h = jax.vmap(_apply_update_one_doc, in_axes=(0, None, None))(
-            st, batch, client_rank
-        )
-        hist = jnp.concatenate(
-            [
-                hist[:, :SCAN_WIDTH_BUCKETS] + h[:, :SCAN_WIDTH_BUCKETS],
-                jnp.maximum(
-                    hist[:, SCAN_WIDTH_BUCKETS:], h[:, SCAN_WIDTH_BUCKETS:]
-                ),
-            ],
-            axis=1,
-        )
-        return (st, hist), None
+        st, h = jax.vmap(
+            _apply_update_one_doc, in_axes=(0, None, None, None)
+        )(st, batch, client_rank, scan_plan)
+        return (st, merge_scan_records(hist, h)), None
 
-    hist0 = jnp.zeros((D, SCAN_WIDTH_BUCKETS + 1), I32)
+    hist0 = jnp.zeros((D, SCAN_REC_WORDS), I32)
     (state, scan_hist), _ = jax.lax.scan(step, (state, hist0), stream)
     return state, scan_hist
 
 
 # the tuple-returning jit: its ONLY callers trace through it inside the
 # chunk programs (`xla_chunk_step`, `replay_chunk_program*`), so no
-# standalone executable compiles for it in practice
-apply_update_stream = partial(jax.jit, donate_argnums=0)(
+# standalone executable compiles for it in practice. `scan_plan` is a
+# STATIC argument (a changed tier plan must recompile, same discipline
+# as YTPU_FUSED_VMEM_MB).
+apply_update_stream = partial(jax.jit, donate_argnums=0, static_argnums=3)(
     _apply_update_stream_hist_body
 )
 apply_update_stream.__doc__ = _apply_update_stream_hist_body.__doc__

@@ -250,6 +250,15 @@ class ReplayStats:
     scan_max: int = 0
     scan_p50: int = 0
     scan_p99: int = 0
+    # two-tier scan occupancy (ISSUE-12), same readout origin: scans the
+    # cheap tier resolved vs scans that escalated to the vectorized wide
+    # tier, and the exact dispatch-trip accounting — serial-equivalent
+    # trips (Σ width, what the single-tier loop would have dispatched)
+    # vs the trips the two-tier dispatch actually paid
+    scan_tier_cheap: int = 0
+    scan_tier_wide: int = 0
+    scan_trips_serial: int = 0
+    scan_trips_two_tier: int = 0
 
 
 @dataclass
@@ -941,6 +950,10 @@ class FusedReplay:
             self.stats.scan_max = d.scan_max
             self.stats.scan_p50 = d.scan_p50
             self.stats.scan_p99 = d.scan_p99
+            self.stats.scan_tier_cheap = d.scan_tier_cheap
+            self.stats.scan_tier_wide = d.scan_tier_wide
+            self.stats.scan_trips_serial = d.scan_trips_serial
+            self.stats.scan_trips_two_tier = d.scan_trips_two_tier
         self._hi = d.final_blocks
 
     # ------------------------------------------- fault recovery (ISSUE-6)

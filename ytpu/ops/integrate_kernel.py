@@ -41,10 +41,19 @@ from ytpu.core.content import (
     CONTENT_MOVE,
 )
 from ytpu.models.batch_doc import (
+    SCAN_REC_CHEAP,
+    SCAN_REC_CHEAP_TRIPS,
+    SCAN_REC_MAX,
+    SCAN_REC_WIDE,
+    SCAN_REC_WIDE_TRIPS,
+    SCAN_REC_WIDTH_SUM,
+    SCAN_REC_WORDS,
     SCAN_WIDTH_BUCKETS,
     BlockCols,
     DocStateBatch,
     UpdateBatch,
+    merge_scan_records,
+    scan_tier_plan,
     scan_width_bucket,
     scan_width_quantile,
 )
@@ -109,22 +118,30 @@ I32 = jnp.int32
 ) = range(26)
 NC = 26
 
-# meta columns in the packed [D, 16] array (padded to a TPU-friendly lane dim)
+# meta columns in the packed [D, 32] array (padded to a TPU-friendly lane dim)
 # M_MDIRTY: move ownership must be recomputed for this doc at step end (a
 # move row arrived, an insert straddled differently-owned neighbors, or a
 # delete tombstoned a live move — the moves_dirty of batch_doc)
 M_START, M_NBLOCKS, M_ERROR, M_MDIRTY = 0, 1, 2, 3
-# conflict-scan-width attribution (ISSUE-11): per-doc pow2 bucket counts
-# + max width ride the meta tile, accumulated INSIDE the integrate scan
-# (both lanes) so the totals survive chunking/compaction/growth for free
-# and surface only through the existing lazy readout — never a new sync.
+# conflict-scan attribution (ISSUE-11/12): per-doc pow2 bucket counts,
+# max width, tier-occupancy and trip-accounting words ride the meta
+# tile, accumulated INSIDE the integrate scan (both lanes) so the totals
+# survive chunking/compaction/growth for free and surface only through
+# the existing lazy readout — never a new sync. Layout mirrors the
+# batch_doc.SCAN_REC_* record word-for-word at offset M_HIST0.
 M_HIST0 = 4
-M_SCANW_MAX = M_HIST0 + SCAN_WIDTH_BUCKETS  # 12
-M_PAD = 16
+M_SCANW_MAX = M_HIST0 + SCAN_REC_MAX  # 12: observed max scan width
+M_TIER_CHEAP = M_HIST0 + SCAN_REC_CHEAP  # 13: scans resolved cheap-tier
+M_TIER_WIDE = M_HIST0 + SCAN_REC_WIDE  # 14: scans escalated to wide tier
+M_CHEAP_TRIPS = M_HIST0 + SCAN_REC_CHEAP_TRIPS  # 15: Σ min(width, cheap)
+M_WIDE_TRIPS = M_HIST0 + SCAN_REC_WIDE_TRIPS  # 16: Σ wide block trips
+M_WIDTH_SUM = M_HIST0 + SCAN_REC_WIDTH_SUM  # 17: Σ width (serial-equiv trips)
+M_SCAN_END = M_HIST0 + SCAN_REC_WORDS  # 18 (exclusive)
+M_PAD = 32  # the ISSUE-12 trip words outgrew the 16-wide tile (was 8 pre-PR-11)
 
 #: words in the per-chunk lazy readout: the original [3] occupancy/error
-#: words + the scan-width bucket totals + the max-width word
-N_READOUT = 3 + SCAN_WIDTH_BUCKETS + 1
+#: words + the full scan record (buckets, max, tiers, trips)
+N_READOUT = 3 + SCAN_REC_WORDS
 
 ERR_CAPACITY = 1
 ERR_MISSING_DEP = 2
@@ -264,12 +281,13 @@ def _kernel(
     *,
     phases: int = 3,
     row_phase: int = 4,
+    scan_plan: Tuple[int, int] = (32, 8),
 ):
     """One doc tile: integrate the whole stream in VMEM.
 
     cols_ref: [NC, DB, C] out-ref aliased to the input (holds the state),
-    meta_ref: [DB, M_PAD=16] aliased (cols 0-3 start/n_blocks/error/
-    mdirty; cols M_HIST0..M_SCANW_MAX the scan-width record); rows_ref:
+    meta_ref: [DB, M_PAD=32] aliased (cols 0-3 start/n_blocks/error/
+    mdirty; cols M_HIST0..M_SCAN_END the scan record); rows_ref:
     [S, U, 23], dels_ref: [S, R, 4], rank_ref: [1, K].
 
     `phases` / `row_phase` are HARDWARE-BISECT hooks (trace-time static,
@@ -278,6 +296,13 @@ def _kernel(
     (row_phase) so a Mosaic miscompile or device fault can be localized.
     Production callers leave the defaults (full kernel); partial values
     corrupt state by design and must never ship.
+
+    `scan_plan = (cheap_bound, wide_unroll)` is the ISSUE-12 two-tier
+    conflict-scan static: the cheap tier keeps the original one-
+    candidate-per-trip loop up to `cheap_bound` trips, the wide tier
+    unrolls `wide_unroll` masked candidate steps per while trip for the
+    deep-conflict tail. A changed plan recompiles (the public entries
+    re-read the env per call, like YTPU_FUSED_VMEM_MB).
     """
     S, U, _ = rows_ref.shape
     R = dels_ref.shape[1]
@@ -573,12 +598,15 @@ def _kernel(
         def origins_equal(ha, ca, ka, hb, cb, kb):
             return (~ha & ~hb) | (ha & hb & (ca == cb) & (ka == kb))
 
-        def scan_cond(carry):
-            o, left, conflicting, before, brk, width = carry
-            active = (o >= 0) & (o != right_idx) & (brk == 0)
-            return jnp.any(active)
+        cheap_bound, wide_unroll = scan_plan
 
-        def scan_body(carry):
+        def scan_step(carry):
+            """One candidate step, fully masked by `active` (a resolved
+            doc no-ops through it) — composes both as a whole cheap-tier
+            trip and as one sub-step of a wide-tier unrolled block.
+            Every carry element is a (DB,)- or (DB, C)-shaped VECTOR:
+            the rung-3/5 scalar-fori-carry miscompile family
+            (docs/known_backend_issues.md) is never entered."""
             o, left, conflicting, before, brk, width = carry
             active = (o >= 0) & (o != right_idx) & (brk == 0)
             width = width + active.astype(I32)
@@ -619,12 +647,47 @@ def _kernel(
             o = jnp.where(active & (brk == 0), o_next, o)
             return (o, left, conflicting, before, brk, width)
 
+        # --- two-tier dispatch (ISSUE-12) ---
+        # CHEAP tier: the original one-candidate-per-trip loop, bounded.
+        # All active docs advance in lockstep, so `width` doubles as the
+        # tier's trip counter (uniform across active docs) — the bound
+        # compare folds into the cond instead of a new carry element.
+        def cheap_cond(carry):
+            o, left, conflicting, before, brk, width = carry
+            active = (o >= 0) & (o != right_idx) & (brk == 0)
+            return jnp.any(active & (width < cheap_bound))
+
         zeros = jnp.zeros((DB, C), I32)
-        _, left_scanned, _, _, _, scan_width = jax.lax.while_loop(
-            scan_cond,
-            scan_body,
+        carry = jax.lax.while_loop(
+            cheap_cond,
+            scan_step,
             (o0, left_idx, zeros, zeros, jnp.zeros((DB,), I32),
              jnp.zeros((DB,), I32)),
+        )
+
+        # WIDE tier: still-unresolved (deep-conflict) docs continue with
+        # `wide_unroll` masked candidate steps per while trip — whole-
+        # block membership/origin tests per dispatch instead of one
+        # element per trip. `wtrips` counts per-doc block trips (the
+        # tier-occupancy sample); a (DB,) vector like every other carry.
+        def wide_cond(carry):
+            inner, wtrips = carry
+            o, left, conflicting, before, brk, width = inner
+            return jnp.any((o >= 0) & (o != right_idx) & (brk == 0))
+
+        def wide_body(carry):
+            inner, wtrips = carry
+            o, left, conflicting, before, brk, width = inner
+            entered = (o >= 0) & (o != right_idx) & (brk == 0)
+            wtrips = wtrips + entered.astype(I32)
+            for _ in range(wide_unroll):
+                inner = scan_step(inner)
+            return inner, wtrips
+
+        (_, left_scanned, _, _, _, scan_width), wide_trips = (
+            jax.lax.while_loop(
+                wide_cond, wide_body, (carry, jnp.zeros((DB,), I32))
+            )
         )
         left_idx = jnp.where(need_scan, left_scanned, left_idx)
         # conflict-tail attribution (ISSUE-11): fold this row's per-doc
@@ -642,6 +705,27 @@ def _kernel(
             ).astype(I32)
         meta_ref[:, M_SCANW_MAX] = jnp.maximum(
             meta_ref[:, M_SCANW_MAX], jnp.where(need_scan, wb, 0)
+        )
+        # tier occupancy + trip accounting (ISSUE-12): identical word
+        # semantics to the packed-XLA lane's _fold_scan_width, so the
+        # readout record is lane-agnostic (cheap trips use the SAME
+        # min(width, bound) accounting — per-doc attribution of the
+        # lockstep tile loop matches the vmapped XLA lane exactly)
+        wide_used = need_scan & (wide_trips > 0)
+        meta_ref[:, M_TIER_CHEAP] = meta_ref[:, M_TIER_CHEAP] + (
+            need_scan & ~wide_used
+        ).astype(I32)
+        meta_ref[:, M_TIER_WIDE] = (
+            meta_ref[:, M_TIER_WIDE] + wide_used.astype(I32)
+        )
+        meta_ref[:, M_CHEAP_TRIPS] = meta_ref[:, M_CHEAP_TRIPS] + jnp.where(
+            need_scan, jnp.minimum(wb, cheap_bound), 0
+        )
+        meta_ref[:, M_WIDE_TRIPS] = meta_ref[:, M_WIDE_TRIPS] + jnp.where(
+            need_scan, wide_trips, 0
+        )
+        meta_ref[:, M_WIDTH_SUM] = meta_ref[:, M_WIDTH_SUM] + jnp.where(
+            need_scan, wb, 0
         )
         if row_phase < 4:
             return
@@ -952,13 +1036,18 @@ def _kernel(
 def _run_body(
     cols, meta, packed, d_block: int, interpret: bool,
     phases: int = 3, row_phase: int = 4, vmem_limit_mb: int = 64,
+    scan_plan: Optional[Tuple[int, int]] = None,
 ):
+    if scan_plan is None:
+        scan_plan = scan_tier_plan()
     rows, dels, rank = packed
     NC_, D, C = cols.shape
     grid = (D // d_block,)
     rank = rank.reshape(1, -1)
     out = pl.pallas_call(
-        partial(_kernel, phases=phases, row_phase=row_phase),
+        partial(
+            _kernel, phases=phases, row_phase=row_phase, scan_plan=scan_plan
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec(rows.shape, lambda d: (0, 0, 0)),
@@ -983,7 +1072,13 @@ def _run_body(
         # C=2048 tile is ~27MB + scan temporaries; the pre-move measured
         # sweet spot (d_block=128 at ~56MB total under NC=17) now lands
         # near the 64MB limit, so re-measure on hardware — d_block<=96 is
-        # the safe default at C=2048 if allocation fails
+        # the safe default at C=2048 if allocation fails. The ISSUE-12
+        # wide-tier unroll does NOT multiply the resident scan
+        # temporaries (the before/conflicting sets and the per-step
+        # gathers are reused across the unrolled sub-steps — program
+        # text grows ~unroll×, live VMEM does not), but a raised
+        # YTPU_SCAN_WIDE_UNROLL inflates compile time and instruction
+        # footprint: re-bisect d_block if allocation regresses.
         compiler_params=None
         if interpret
         else pltpu.CompilerParams(
@@ -1004,10 +1099,11 @@ def _run_body(
 
 # the standalone jitted entry (donated state); the async chunk program
 # composes `_run_body` directly inside its own jit instead, so donation
-# applies to the OUTER program's state operands
-_run = partial(jax.jit, static_argnums=(3, 4, 5, 6, 7), donate_argnums=(0, 1))(
-    _run_body
-)
+# applies to the OUTER program's state operands. scan_plan rides as a
+# STATIC (position 8) so a changed tier plan recompiles.
+_run = partial(
+    jax.jit, static_argnums=(3, 4, 5, 6, 7, 8), donate_argnums=(0, 1)
+)(_run_body)
 
 
 def apply_update_stream_fused(
@@ -1063,6 +1159,9 @@ def apply_update_stream_fused(
         raise ValueError(f"n_docs {D} must be a multiple of d_block {d_block}")
     rows, dels = pack_stream(stream)
     vmem_mb = int(os.environ.get("YTPU_FUSED_VMEM_MB", "64"))
+    # two-tier scan plan: re-read per call and threaded as a static, so
+    # a changed knob retraces instead of silently reusing the old unroll
+    scan_plan = scan_tier_plan()
     if _phases.enabled:
         _phases.transfer(
             "integrate.fused",
@@ -1072,14 +1171,14 @@ def apply_update_stream_fused(
         span = _phases.span(
             "integrate.fused",
             (cols.shape, rows.shape, dels.shape, d_block, interpret,
-             _debug_phases, _debug_row_phase, vmem_mb),
+             _debug_phases, _debug_row_phase, vmem_mb, scan_plan),
         )
     else:
         span = NULL_SPAN
     with span:
         cols, meta = _run(
             cols, meta, (rows, dels, client_rank), d_block, interpret,
-            _debug_phases, _debug_row_phase, vmem_mb,
+            _debug_phases, _debug_row_phase, vmem_mb, scan_plan,
         )
     out = unpack_state(cols, meta, state)
     if not refresh_cache:
@@ -1110,15 +1209,19 @@ def apply_update_stream_fused(
 _XLA_CHUNK_STEP = None
 
 
-def xla_chunk_step(cols, meta, stream, rank):
+def xla_chunk_step(cols, meta, stream, rank, scan_plan=None):
     """One chunk of stream steps through the un-fused XLA integrate path,
     on the packed kernel state (unpack → apply_update_stream → repack, all
     inside one jit so XLA fuses the repacks away). The jitted step is a
     module singleton shared by every chunked driver instance — a per-call
     closure would retrace every chunk, and two singletons (this one and
     replay.py's old private copy) would hold duplicate unevictable
-    executables."""
+    executables. `scan_plan` (the ISSUE-12 two-tier static; None = the
+    env-resolved `scan_tier_plan()`) rides as a static argnum so a
+    changed tier plan recompiles the step."""
     global _XLA_CHUNK_STEP
+    if scan_plan is None:
+        scan_plan = scan_tier_plan()
     if _XLA_CHUNK_STEP is None:
         # the RAW body, not the instrumented wrapper: tracing through the
         # wrapper recorded a phantom `integrate.xla_stream` compile_s
@@ -1126,41 +1229,45 @@ def xla_chunk_step(cols, meta, stream, rank):
         # is this chunk step, already attributed to `replay.chunk_xla`
         from ytpu.models.batch_doc import apply_update_stream_raw
 
-        def step(cols, meta, stream, rank):
+        def step(cols, meta, stream, rank, scan_plan):
             # pack_state zeroes the meta padding, so the carried
-            # scan-width record (ISSUE-11) is read out first and folded
+            # scan record (ISSUE-11/12) is read out first and folded
             # back in with this chunk's contribution
-            carried = meta[:, M_HIST0 : M_SCANW_MAX + 1]
+            carried = meta[:, M_HIST0:M_SCAN_END]
             state = unpack_state(cols, meta, None)
-            state, dhist = apply_update_stream_raw(state, stream, rank)
+            state, dhist = apply_update_stream_raw(
+                state, stream, rank, scan_plan
+            )
             cols, meta = pack_state(state)
             meta = _fold_scan_meta(meta, carried, dhist)
             return cols, meta
 
         # donate like the fused _run: the packed state updates in place
         # instead of holding two full copies at grown capacity
-        _XLA_CHUNK_STEP = jax.jit(step, donate_argnums=(0, 1))
-    return _XLA_CHUNK_STEP(cols, meta, stream, rank)
+        _XLA_CHUNK_STEP = jax.jit(
+            step, donate_argnums=(0, 1), static_argnums=(4,)
+        )
+    return _XLA_CHUNK_STEP(cols, meta, stream, rank, scan_plan)
 
 
 def _fold_scan_meta(meta, carried, dhist):
-    """Fold an XLA-lane chunk's scan-width record (``dhist``
-    ``[D, SCAN_WIDTH_BUCKETS + 1]``) plus the pre-chunk carried meta
-    columns back into a freshly packed meta (whose padding pack_state
-    zeroed): bucket counts add, the max word maxes."""
-    buckets = (
-        carried[:, :SCAN_WIDTH_BUCKETS] + dhist[:, :SCAN_WIDTH_BUCKETS]
+    """Fold an XLA-lane chunk's scan record (``dhist``
+    ``[D, SCAN_REC_WORDS]``) plus the pre-chunk carried meta columns
+    back into a freshly packed meta (whose padding pack_state zeroed):
+    every word adds except the max, which maxes (`merge_scan_records`,
+    the one shared combine rule)."""
+    return meta.at[:, M_HIST0:M_SCAN_END].set(
+        merge_scan_records(carried, dhist)
     )
-    mx = jnp.maximum(carried[:, SCAN_WIDTH_BUCKETS], dhist[:, SCAN_WIDTH_BUCKETS])
-    meta = meta.at[:, M_HIST0:M_SCANW_MAX].set(buckets)
-    return meta.at[:, M_SCANW_MAX].set(mx)
 
 
 def _readout_words(meta, err):
     """``[N_READOUT]`` i32: (max n_blocks, max sticky integrate error,
     sticky decode flags, scan-width bucket totals summed over docs, max
-    scan width) — everything the host learns per drain, one future."""
+    scan width, then the ISSUE-12 tier/trip totals summed over docs) —
+    everything the host learns per drain, one future."""
     hist = jnp.sum(meta[:, M_HIST0:M_SCANW_MAX], axis=0)
+    tiers = jnp.sum(meta[:, M_TIER_CHEAP:M_SCAN_END], axis=0)
     return jnp.concatenate(
         [
             jnp.stack(
@@ -1168,6 +1275,7 @@ def _readout_words(meta, err):
             ),
             hist,
             jnp.max(meta[:, M_SCANW_MAX])[None],
+            tiers,
         ]
     )
 
@@ -1205,12 +1313,14 @@ def _chunk_core(
     d_block: int,
     interpret: bool,
     vmem_mb: int,
+    scan_plan: Tuple[int, int],
 ):
     """Traceable body shared by `replay_chunk_program` (host-packed
     ``[S, L]`` lanes) and `replay_chunk_program_raw` (device-gathered
     lanes): device decode (`decode_updates_v1` body) → global unit-ref
     rebase (`refs`, -1 = keep the decoded in-chunk ref) → integrate
-    (fused Pallas tile or the packed-XLA scan) → `[3]` readout."""
+    (fused Pallas tile or the packed-XLA scan, both under the ISSUE-12
+    two-tier `scan_plan` static) → `[N_READOUT]` readout."""
     from ytpu.ops.decode_kernel import FLAG_ERRORS, _decode_updates_v1_impl
 
     stream, flags = _decode_updates_v1_impl(
@@ -1230,14 +1340,15 @@ def _chunk_core(
     if lane == "fused":
         rows, dels = pack_stream(stream)
         cols, meta = _run_body(
-            cols, meta, (rows, dels, rank), d_block, interpret, 3, 4, vmem_mb
+            cols, meta, (rows, dels, rank), d_block, interpret, 3, 4,
+            vmem_mb, scan_plan,
         )
     else:
         from ytpu.models.batch_doc import apply_update_stream_raw
 
-        carried = meta[:, M_HIST0 : M_SCANW_MAX + 1]
+        carried = meta[:, M_HIST0:M_SCAN_END]
         state = unpack_state(cols, meta, None)
-        state, dhist = apply_update_stream_raw(state, stream, rank)
+        state, dhist = apply_update_stream_raw(state, stream, rank, scan_plan)
         cols, meta = pack_state(state)
         meta = _fold_scan_meta(meta, carried, dhist)
     readout = _readout_words(meta, err)
@@ -1255,6 +1366,7 @@ def _chunk_core(
         "d_block",
         "interpret",
         "vmem_mb",
+        "scan_plan",
     ),
     donate_argnums=(0, 1, 2),
 )
@@ -1275,6 +1387,7 @@ def replay_chunk_program(
     d_block: int,
     interpret: bool,
     vmem_mb: int,
+    scan_plan: Tuple[int, int],
 ):
     """One replay chunk straight from padded wire bytes, as ONE compiled
     dispatch: device decode (`decode_updates_v1` body) → global unit-ref
@@ -1311,6 +1424,7 @@ def replay_chunk_program(
         d_block=d_block,
         interpret=interpret,
         vmem_mb=vmem_mb,
+        scan_plan=scan_plan,
     )
 
 
@@ -1326,6 +1440,7 @@ def replay_chunk_program(
         "d_block",
         "interpret",
         "vmem_mb",
+        "scan_plan",
     ),
     donate_argnums=(0, 1, 2),
 )
@@ -1348,6 +1463,7 @@ def replay_chunk_program_raw(
     d_block: int,
     interpret: bool,
     vmem_mb: int,
+    scan_plan: Tuple[int, int],
 ):
     """One replay chunk straight from RAW CONCATENATED wire bytes plus a
     tiny per-update offsets table (ISSUE-7 tentpole): the device gathers
@@ -1383,6 +1499,7 @@ def replay_chunk_program_raw(
         d_block=d_block,
         interpret=interpret,
         vmem_mb=vmem_mb,
+        scan_plan=scan_plan,
     )
 
 
@@ -1425,6 +1542,16 @@ class ReplayChunkStats:
     scan_max: int = 0
     scan_p50: int = 0
     scan_p99: int = 0
+    # two-tier scan occupancy (ISSUE-12), same freshest-readout origin:
+    # scans resolved entirely in the cheap tier vs escalated to the
+    # vectorized wide tier, plus the exact dispatch-trip accounting —
+    # `scan_trips_serial` is what the pre-ISSUE-12 one-candidate-per-trip
+    # loop would have paid (Σ width), `scan_trips_two_tier` what the
+    # tiered dispatch actually paid (Σ min(width, cheap) + wide blocks)
+    scan_tier_cheap: int = 0
+    scan_tier_wide: int = 0
+    scan_trips_serial: int = 0
+    scan_trips_two_tier: int = 0
 
 
 # --- lane-health ladder + typed replay faults (ISSUE-6 tentpole) -------------
@@ -1655,7 +1782,7 @@ class PackedReplayDriver:
                 )
                 _phases.transfer(
                     "integrate.scan_hist",
-                    4 * (SCAN_WIDTH_BUCKETS + 1) * len(self._pending),
+                    4 * SCAN_REC_WORDS * len(self._pending),
                     "d2h",
                 )
             sticky_derr = 0
@@ -1687,6 +1814,7 @@ class PackedReplayDriver:
                     self._record_scan_width(
                         vals[3 : 3 + SCAN_WIDTH_BUCKETS],
                         int(vals[3 + SCAN_WIDTH_BUCKETS]),
+                        vals[3 + SCAN_WIDTH_BUCKETS + 1 : 3 + SCAN_REC_WORDS],
                     )
                 self.stats.peak_blocks = max(self.stats.peak_blocks, occ)
                 if derr != 0:
@@ -1710,13 +1838,13 @@ class PackedReplayDriver:
                 self._err = jnp.zeros((), I32)
         return hi
 
-    def _record_scan_width(self, buckets, observed_max: int) -> None:
-        """Fold one materialized readout's scan-width words into the
-        driver stats and the `integrate.scan_width_*` phase gauges
-        (ISSUE-11). Called only from drains — the record arrives on the
-        readout future the host was already blocking on, so this adds
-        ZERO device syncs. Gauges land twice: the base key and a
-        `.{lane}`-suffixed key, so fused- and packed-XLA-lane
+    def _record_scan_width(self, buckets, observed_max: int, tiers=()) -> None:
+        """Fold one materialized readout's scan words into the driver
+        stats and the `integrate.scan_width_*` / `integrate.scan_tier_*`
+        phase gauges (ISSUE-11/12). Called only from drains — the record
+        arrives on the readout future the host was already blocking on,
+        so this adds ZERO device syncs. Gauges land twice: the base key
+        and a `.{lane}`-suffixed key, so fused- and packed-XLA-lane
         distributions stay separately regressable."""
         from ytpu.utils.phases import phases as _phases
 
@@ -1727,15 +1855,26 @@ class PackedReplayDriver:
         st.scan_max = mx
         st.scan_p50 = scan_width_quantile(counts, 0.50, mx)
         st.scan_p99 = scan_width_quantile(counts, 0.99, mx)
+        tiers = [int(t) for t in tiers]
+        if len(tiers) == SCAN_REC_WORDS - SCAN_WIDTH_BUCKETS - 1:
+            cheap, wide, cheap_trips, wide_trips, width_sum = tiers
+            st.scan_tier_cheap = cheap
+            st.scan_tier_wide = wide
+            st.scan_trips_serial = width_sum
+            st.scan_trips_two_tier = cheap_trips + wide_trips
         if _phases.enabled and sum(counts):
             for name, v in (
-                ("p50", st.scan_p50),
-                ("p99", st.scan_p99),
-                ("max", st.scan_max),
+                ("width_p50", st.scan_p50),
+                ("width_p99", st.scan_p99),
+                ("width_max", st.scan_max),
+                ("tier_cheap", st.scan_tier_cheap),
+                ("tier_wide", st.scan_tier_wide),
+                ("trips_serial", st.scan_trips_serial),
+                ("trips_two_tier", st.scan_trips_two_tier),
             ):
-                _phases.set_value(f"integrate.scan_width_{name}", v)
+                _phases.set_value(f"integrate.scan_{name}", v)
                 _phases.set_value(
-                    f"integrate.scan_width_{name}.{self.lane}", v
+                    f"integrate.scan_{name}.{self.lane}", v
                 )
 
     def _raise_device_error(self):
@@ -1891,6 +2030,10 @@ class PackedReplayDriver:
             margin = int(stream_worst_case_adds(stream).sum()) + 8
         self.ensure_room(margin)
 
+        # two-tier scan plan: env re-read per chunk, static through both
+        # lanes' programs so a changed knob retraces (ADVICE r5 #2 shape)
+        scan_plan = scan_tier_plan()
+
         def dispatch(lane):
             if lane == "fused":
                 rows, dels = pack_stream(stream)
@@ -1908,7 +2051,7 @@ class PackedReplayDriver:
                     span = _phases.span(
                         "replay.chunk_fused",
                         (self.cols.shape, rows.shape, dels.shape,
-                         self.d_block),
+                         self.d_block, scan_plan),
                     )
                 else:
                     span = NULL_SPAN
@@ -1922,18 +2065,19 @@ class PackedReplayDriver:
                         3,
                         4,
                         vmem_mb,
+                        scan_plan,
                     )
             span = (
                 _phases.span(
                     "replay.chunk_xla",
-                    (self.cols.shape, stream.client.shape),
+                    (self.cols.shape, stream.client.shape, scan_plan),
                 )
                 if _phases.enabled
                 else NULL_SPAN
             )
             with span:
                 return xla_chunk_step(
-                    self.cols, self.meta, stream, self.rank
+                    self.cols, self.meta, stream, self.rank, scan_plan
                 )
 
         self.cols, self.meta = self._dispatch(dispatch)
@@ -1962,6 +2106,9 @@ class PackedReplayDriver:
         progbudget.tick()
         self.ensure_room(margin)
         vmem_mb = int(os.environ.get("YTPU_FUSED_VMEM_MB", "64"))
+        # two-tier scan plan: env re-read per chunk, threaded as a static
+        # of the one-dispatch programs — a changed knob retraces
+        scan_plan = scan_tier_plan()
         if _transfer_aliases_host():
             host_arrays = tuple(a.copy() for a in host_arrays)
         dev = tuple(jnp.asarray(a) for a in host_arrays)
@@ -1977,7 +2124,7 @@ class PackedReplayDriver:
                 _phases.span(
                     stage,
                     (self.cols.shape, *span_tail, lane, self.d_block,
-                     vmem_mb),
+                     vmem_mb, scan_plan),
                 )
                 if _phases.enabled
                 else NULL_SPAN
@@ -1993,6 +2140,7 @@ class PackedReplayDriver:
                     d_block=self.d_block,
                     interpret=self.interpret,
                     vmem_mb=vmem_mb,
+                    scan_plan=scan_plan,
                     **program_kw,
                 )
 

@@ -328,7 +328,7 @@ def _integrate_row_sp(state: DocStateBatch, row, client_rank: jax.Array):
     # shared YATA scan; a candidate's non-local origin resolves to -1
     # there, which reads as "origin precedes the scanned region" — exactly
     # right for an origin living in an earlier segment
-    left_scanned, _scan_w = _conflict_scan(
+    left_scanned, _scan_w, _scan_wide = _conflict_scan(
         state,
         client_rank,
         r_client,
