@@ -111,6 +111,7 @@ TUNNEL_QUEUE = [
     "soak_slo_pr9",
     "config5_diff_pipeline_pr10",
     "scan_two_tier_pr12",
+    "federation_soak_pr13",
 ]
 
 
@@ -1266,6 +1267,114 @@ def scan_tiers_dry_run() -> dict:
     return mod.dry_run()
 
 
+def federation_dry_run() -> dict:
+    """CPU rehearsal of the multi-replica federation (ISSUE-13): the
+    acceptance surface for scale-OUT, asserted end to end —
+
+    - **oracle parity under chaos**: a 3-replica `ReplicaMesh` of
+      device-backed servers drives the PR-9 scenario through one
+      partition/heal cycle AND one forced replica failover (drain →
+      kill → sessions reconnect to a survivor → ownership hands off,
+      `net.sessions_dropped{reason="failover"}`), and every surviving
+      replica must land the clean single-server run's `state_digest`;
+    - **O(1) anti-entropy**: convergence is verified by exchanging
+      incremental per-tenant commitments (`replica.anti_entropy_bytes`
+      counts the whole round cost — commit probes + pulled diffs);
+    - **divergence detection**: a second 2-replica run arms
+      ``commit.corrupt`` — the poisoned commitment must be CAUGHT as a
+      typed `DivergenceFault` after sync converges (tenant quarantined,
+      `replica.divergences`), then recovered (`replica.recoveries`)
+      with the final digest still equal to the oracle.
+
+    Headline keys: `federation_converge_rounds` (epilogue rounds to
+    byte agreement) and `federation_anti_entropy_bytes` — both regress
+    on RISE in benches/bench_compare.py."""
+    from ytpu.serving import (
+        FederatedSoakDriver,
+        Scenario,
+        ScenarioConfig,
+        SoakDriver,
+    )
+    from ytpu.sync.device_server import DeviceSyncServer
+    from ytpu.sync.replica import ReplicaMesh
+    from ytpu.utils.faults import faults
+
+    cfg = ScenarioConfig(
+        n_tenants=3,
+        n_sessions=8,
+        events_per_session=8,
+        seed=int(os.environ.get("YTPU_BENCH_SOAK_SEED", "5")),
+    )
+
+    def replica():
+        return DeviceSyncServer(n_docs=4, capacity=256)
+
+    # the PR-9 oracle: same scenario, clean single-server run (shares
+    # the (4, 256) compiled family with the soak rehearsal)
+    clean = SoakDriver(replica(), Scenario(cfg), flush_every=4).run()
+    chaos = FederatedSoakDriver(
+        ReplicaMesh([(f"r{i}", replica()) for i in range(3)]),
+        Scenario(cfg),
+        sync_every=6,
+        anti_entropy_every=12,
+        partition_at=0.3,
+        heal_at=0.55,
+        failover_at=0.8,
+        migrate_at=0.45,
+    ).run()
+    assert chaos["partitions"] >= 1 and chaos["heals"] >= 1, chaos
+    assert chaos["failovers"] == 1 and chaos["migrations"] >= 1, chaos
+    # _counts keys are merged only when bumped — .get() so a regression
+    # fires the assert with the report repr, not a bare KeyError
+    assert chaos.get("failover_sessions_dropped", 0) >= 1, chaos
+    assert chaos.get("failover_reconnects", 0) >= 1, chaos
+    assert chaos["converged"], chaos
+    assert chaos["state_digest"] == clean["state_digest"], (
+        "federated chaos soak diverged from the PR-9 oracle digest"
+    )
+    faults.clear()
+    spec = faults.arm("commit.corrupt")
+    try:
+        corrupt = FederatedSoakDriver(
+            ReplicaMesh([("a", replica()), ("b", replica())]),
+            Scenario(cfg),
+            sync_every=6,
+            anti_entropy_every=8,
+        ).run()
+    finally:
+        faults.clear()
+    assert spec.fired == 1, spec
+    assert corrupt["divergences_caught"] >= 1, corrupt
+    assert corrupt.get("divergence_recoveries", 0) >= 1, corrupt
+    assert corrupt["converged"], corrupt
+    assert corrupt["state_digest"] == clean["state_digest"], (
+        "post-recovery federated state diverged from the oracle"
+    )
+    return {
+        "replicas": chaos["replicas"],
+        "converged": True,
+        "converge_rounds": chaos["converge_rounds"],
+        "anti_entropy_bytes": chaos["anti_entropy_bytes"],
+        "commit_mismatches": chaos["commit_mismatches"],
+        "partitions": chaos["partitions"],
+        "heals": chaos["heals"],
+        "failovers": chaos["failovers"],
+        "migrations": chaos["migrations"],
+        "failover_sessions_dropped": chaos["failover_sessions_dropped"],
+        "failover_reconnects": chaos["failover_reconnects"],
+        "rerouted_sessions": chaos.get("rerouted_sessions", 0),
+        "updates_per_s": chaos["updates_per_s"],
+        "oracle_parity": True,
+        "divergence": {
+            "caught": corrupt["divergences_caught"],
+            "recovered": corrupt["divergence_recoveries"],
+            "converge_rounds": corrupt["converge_rounds"],
+            "oracle_parity": True,
+        },
+        "state_digest": chaos["state_digest"],
+    }
+
+
 def diff_overlap_dry_run(
     n_docs: int = 12, sub_batch: int = 4, depth: int = 2
 ) -> dict:
@@ -2071,6 +2180,19 @@ def main(dry_run: bool = False):
         with phases.span("host.scan_tiers_rehearsal"):
             out["scan_tiers"] = scan_tiers_dry_run()
         out["scan_trip_reduction"] = out["scan_tiers"]["scan_trip_reduction"]
+        # multi-replica federation rehearsal (ISSUE-13): a 3-replica
+        # in-proc chaos soak (partition/heal + forced failover) at byte
+        # parity with the PR-9 oracle, plus an injected commitment
+        # divergence caught + recovered; the convergence-cost and
+        # anti-entropy-bytes headlines regress on RISE in bench_compare
+        with phases.span("host.federation_rehearsal"):
+            out["federation"] = federation_dry_run()
+        out["federation_converge_rounds"] = out["federation"][
+            "converge_rounds"
+        ]
+        out["federation_anti_entropy_bytes"] = out["federation"][
+            "anti_entropy_bytes"
+        ]
         out["tunnel_queue"] = list(TUNNEL_QUEUE)
         out["phases"] = phases.snapshot()
         out["metrics"] = metrics.snapshot()

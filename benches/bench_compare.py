@@ -68,6 +68,13 @@ _DIRECTION_RULES: Tuple[Tuple[str, str], ...] = (
     # trips per integrate. (Tier OCCUPANCY `scan_tier_*` stays neutral:
     # the cheap/wide split is workload shape, not better/worse.)
     ("scan_trips", "down"),
+    # federation (ISSUE-13): rounds-to-byte-agreement and anti-entropy
+    # traffic are costs — a rise on the same scenario is a regression
+    # (more rounds / more bytes to reach the same converged state).
+    # Occupancy-style counts (partitions, heals, mismatches) stay
+    # neutral: they are the scripted chaos schedule, not better/worse.
+    ("converge_rounds", "down"),
+    ("anti_entropy_bytes", "down"),
     ("p50_ms", "down"),
     ("p99_ms", "down"),
     ("p999_ms", "down"),

@@ -119,6 +119,15 @@ def test_direction_classification_rules():
     assert bc.classify("scan_trip_reduction") == "up"
     assert bc.classify("scan_tier_cheap") == "neutral"
     assert bc.classify("scan_tier_wide") == "neutral"
+    # federation (ISSUE-13): convergence cost and anti-entropy traffic
+    # regress when they RISE; the scripted chaos schedule stays neutral
+    assert bc.classify("federation_converge_rounds") == "down"
+    assert bc.classify("federation_anti_entropy_bytes") == "down"
+    assert bc.classify("federation.converge_rounds") == "down"
+    assert bc.classify("federation.anti_entropy_bytes") == "down"
+    assert bc.classify("federation.partitions") == "neutral"
+    assert bc.classify("federation.commit_mismatches") == "neutral"
+    assert bc.classify("federation.updates_per_s") == "up"
     assert bc.classify("phases.replay.stage.execute_s") == "neutral"
     assert bc.classify("chunks") == "neutral"
 

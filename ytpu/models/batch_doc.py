@@ -664,6 +664,53 @@ def merge_scan_records(a, b):
     )
 
 
+# --- incremental state commitment (ISSUE-13) ---------------------------------
+# A homomorphic per-doc digest of the op lattice the federation layer's
+# anti-entropy compares in O(1) per tenant per round (ytpu/sync/
+# commitment.py holds the 64-bit host mirror and the full rationale).
+# The device word is a vectorized reduction over the packed block
+# columns, materialized ONLY as one extra word on the existing lazy
+# readout (integrate_kernel._readout_words) — zero new device syncs.
+
+
+def _commit_mix_u32(x):
+    """32-bit integer finalizer over uint32 arrays — bit-identical to
+    ``ytpu.sync.commitment.mix32`` (its pure-Python oracle)."""
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+def commit_fold_blocks(client, clock, length, valid):
+    """Per-doc state-commitment fold over block rows: ``[..., B]`` i32
+    (client, clock, length) columns + a ``valid`` mask → ``[...]``
+    uint32 (last axis reduced, mod 2^32 wrapping throughout).
+
+    Each row contributes ``A(c)·(Σ_{j∈[s,s+l)} j) + B(c)·l`` with
+    ``A/B = mix32(2c+1/2c+2)`` — additive over disjoint clock ranges, so
+    the fold is invariant under block splits, merges and GC conversion
+    (they preserve ``(client, clock, len)`` lattice coverage), and a
+    state whose rows tile each client's ``[0, n_c)`` folds to exactly
+    ``Σ_c A(c)·T(n_c) + B(c)·n_c`` (`commitment.device_commit_of_clocks`).
+
+    The triangular term ``l(l-1)/2`` is computed division-free —
+    ``(l/2)·(l-1)`` or ``l·((l-1)/2)`` by parity — because halving a
+    *wrapped* product is not well defined mod 2^32."""
+    c = client.astype(jnp.uint32)
+    a = _commit_mix_u32(jnp.uint32(2) * c + jnp.uint32(1))
+    b = _commit_mix_u32(jnp.uint32(2) * c + jnp.uint32(2))
+    s = clock.astype(jnp.uint32)
+    l = length.astype(jnp.uint32)
+    tri = jnp.where(
+        l % 2 == 0, (l >> 1) * (l - jnp.uint32(1)),
+        l * ((l - jnp.uint32(1)) >> 1),
+    )
+    contrib = a * (s * l + tri) + b * l
+    return jnp.sum(
+        jnp.where(valid, contrib, jnp.uint32(0)), axis=-1, dtype=jnp.uint32
+    )
+
+
 def scan_width_quantile(counts, q: float, observed_max: int) -> int:
     """Host-side quantile over materialized bucket counts: the inclusive
     upper bound of the bucket holding the q-th sample (the unbounded last
@@ -2418,18 +2465,23 @@ class DiffPipeline:
                 else:
                     out[lo + j] = payload
 
-        idx_host = np.empty(sub, dtype=np.int32)  # the ONE reusable slot
-
         def produce():
             for k in range(n_sub):
                 lo = k * sub
                 hi = min(lo + sub, n_sel)
+                # fresh host buffer PER sub-batch, never written after the
+                # jnp conversion: the numpy->device read can happen as late
+                # as program execution (async dispatch; CPU zero-copy may
+                # even alias the buffer outright), so a reused slot races
+                # the in-flight dispatch — sub-batch k gathering k+1's docs
+                # under load.  The ONE reusable slot in the plan is the
+                # DEVICE-side donated idx buffer, not this staging array.
+                idx_host = np.empty(sub, dtype=np.int32)
                 idx_host[: hi - lo] = sel_np[lo:hi]
                 idx_host[hi - lo :] = sel_np[lo]  # pad repeats a SELECTED doc
-                # jnp.asarray copies → the host slot is reusable at once;
-                # the device copy is donated into the pack program
-                idx = jnp.asarray(idx_host)
-                arr = compact_finisher_rows(bl, ship_j, off_j, del_j, idx, R)
+                arr = compact_finisher_rows(
+                    bl, ship_j, off_j, del_j, jnp.asarray(idx_host), R
+                )
                 yield (lo, hi, arr)
 
         # stats-field ownership is per stage/thread (no locks needed):

@@ -259,6 +259,11 @@ class ReplayStats:
     scan_tier_wide: int = 0
     scan_trips_serial: int = 0
     scan_trips_two_tier: int = 0
+    # incremental state commitment (ISSUE-13): the batch-aggregate
+    # lattice-digest word from the driver's final readout drain (uint32;
+    # docs/serving.md §Federation — the device twin of the host-side
+    # per-tenant commitments the replica mesh exchanges)
+    commit_word: int = 0
 
 
 @dataclass
@@ -954,6 +959,7 @@ class FusedReplay:
             self.stats.scan_tier_wide = d.scan_tier_wide
             self.stats.scan_trips_serial = d.scan_trips_serial
             self.stats.scan_trips_two_tier = d.scan_trips_two_tier
+        self.stats.commit_word = d.commit_word
         self._hi = d.final_blocks
 
     # ------------------------------------------- fault recovery (ISSUE-6)

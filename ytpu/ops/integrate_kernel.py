@@ -52,6 +52,7 @@ from ytpu.models.batch_doc import (
     BlockCols,
     DocStateBatch,
     UpdateBatch,
+    commit_fold_blocks,
     merge_scan_records,
     scan_tier_plan,
     scan_width_bucket,
@@ -78,6 +79,7 @@ __all__ = [
     "lane_health",
     "is_device_fault",
     "N_READOUT",
+    "packed_commitments",
 ]
 
 I32 = jnp.int32
@@ -140,8 +142,10 @@ M_SCAN_END = M_HIST0 + SCAN_REC_WORDS  # 18 (exclusive)
 M_PAD = 32  # the ISSUE-12 trip words outgrew the 16-wide tile (was 8 pre-PR-11)
 
 #: words in the per-chunk lazy readout: the original [3] occupancy/error
-#: words + the full scan record (buckets, max, tiers, trips)
-N_READOUT = 3 + SCAN_REC_WORDS
+#: words + the full scan record (buckets, max, tiers, trips) + the
+#: ISSUE-13 state-commitment word (wrap-sum over docs of the per-doc
+#: homomorphic lattice digest, `batch_doc.commit_fold_blocks`)
+N_READOUT = 3 + SCAN_REC_WORDS + 1
 
 ERR_CAPACITY = 1
 ERR_MISSING_DEP = 2
@@ -1261,13 +1265,41 @@ def _fold_scan_meta(meta, carried, dhist):
     )
 
 
-def _readout_words(meta, err):
+def _packed_commit_fold(cols, meta):
+    """``[D]`` uint32 per-doc state commitments from the packed columns
+    (ISSUE-13): `commit_fold_blocks` over every live block row — the
+    same validity predicate `encode_diff_batch` uses.  Recomputed from
+    the CURRENT state at each readout (a ~D·C vectorized reduction, free
+    next to the integrate it rides), so compaction/GC/growth can never
+    leave a stale accumulator behind."""
+    B = cols.shape[-1]
+    slots = jnp.arange(B, dtype=I32)
+    valid = (slots[None, :] < meta[:, M_NBLOCKS][:, None]) & (cols[CL] >= 0)
+    return commit_fold_blocks(cols[CL], cols[CK], cols[LN], valid)
+
+
+@jax.jit
+def packed_commitments(cols, meta):
+    """Public on-demand pull of the ``[D]`` per-doc commitment words
+    (i32 bit pattern of the uint32 fold).  NOT a hot-path call — the
+    batch-aggregate word already rides the lazy readout; this exists
+    for per-doc verification (tests, a quarantine postmortem)."""
+    return jax.lax.bitcast_convert_type(
+        _packed_commit_fold(cols, meta), I32
+    )
+
+
+def _readout_words(cols, meta, err):
     """``[N_READOUT]`` i32: (max n_blocks, max sticky integrate error,
     sticky decode flags, scan-width bucket totals summed over docs, max
-    scan width, then the ISSUE-12 tier/trip totals summed over docs) —
-    everything the host learns per drain, one future."""
+    scan width, the ISSUE-12 tier/trip totals summed over docs, then the
+    ISSUE-13 commitment word — wrap-sum over docs of the per-doc lattice
+    digest) — everything the host learns per drain, one future."""
     hist = jnp.sum(meta[:, M_HIST0:M_SCANW_MAX], axis=0)
     tiers = jnp.sum(meta[:, M_TIER_CHEAP:M_SCAN_END], axis=0)
+    commit = jax.lax.bitcast_convert_type(
+        jnp.sum(_packed_commit_fold(cols, meta), dtype=jnp.uint32), I32
+    )
     return jnp.concatenate(
         [
             jnp.stack(
@@ -1276,12 +1308,13 @@ def _readout_words(meta, err):
             hist,
             jnp.max(meta[:, M_SCANW_MAX])[None],
             tiers,
+            commit[None],
         ]
     )
 
 
 @jax.jit
-def _chunk_readout(meta, err):
+def _chunk_readout(cols, meta, err):
     """[N_READOUT] i32 (max n_blocks, max sticky integrate error, sticky
     decode flags, + the scan-width histogram words) — the per-chunk
     occupancy/error readout. Dispatched after every chunk but NOT
@@ -1293,7 +1326,7 @@ def _chunk_readout(meta, err):
     per-chunk `np.asarray(flags)` block is gone too. The ISSUE-11
     scan-width words (bucket totals + max) ride the SAME future — zero
     additional materializations."""
-    return _readout_words(meta, err)
+    return _readout_words(cols, meta, err)
 
 
 def _chunk_core(
@@ -1351,7 +1384,7 @@ def _chunk_core(
         state, dhist = apply_update_stream_raw(state, stream, rank, scan_plan)
         cols, meta = pack_state(state)
         meta = _fold_scan_meta(meta, carried, dhist)
-    readout = _readout_words(meta, err)
+    readout = _readout_words(cols, meta, err)
     return cols, meta, err, readout
 
 
@@ -1510,7 +1543,13 @@ def _transfer_aliases_host() -> bool:
     staging-slot reuse gate assumes the h2d transfer made the input
     private; on an aliasing backend the bytes must be copied host-side
     first or a re-packed slot races the chunk program still reading it."""
-    probe = np.zeros(8, dtype=np.uint8)
+    # the probe buffer must be 64-byte aligned: the zero-copy path only
+    # engages on aligned host memory, so a small unaligned allocation
+    # here would report "copies" while the page-aligned staging buffers
+    # still alias — carve an aligned window out of a larger block
+    raw = np.zeros(128, dtype=np.uint8)
+    off = (-raw.ctypes.data) % 64
+    probe = raw[off : off + 64]
     dev = jnp.asarray(probe)
     dev.block_until_ready()
     probe[0] = 1
@@ -1552,6 +1591,10 @@ class ReplayChunkStats:
     scan_tier_wide: int = 0
     scan_trips_serial: int = 0
     scan_trips_two_tier: int = 0
+    # incremental state commitment (ISSUE-13): the batch-aggregate
+    # lattice-digest word as of the freshest materialized readout
+    # (uint32 value; per-doc words via `packed_commitments` on demand)
+    commit_word: int = 0
 
 
 # --- lane-health ladder + typed replay faults (ISSUE-6 tentpole) -------------
@@ -1785,6 +1828,12 @@ class PackedReplayDriver:
                     4 * SCAN_REC_WORDS * len(self._pending),
                     "d2h",
                 )
+                # the ISSUE-13 commitment word rides the same future:
+                # its 4 bytes attribute separately, `replay.readout`
+                # keeps its historical 12-byte accounting
+                _phases.transfer(
+                    "integrate.commit_word", 4 * len(self._pending), "d2h"
+                )
             sticky_derr = 0
             for fut in self._pending:
                 try:
@@ -1816,6 +1865,16 @@ class PackedReplayDriver:
                         int(vals[3 + SCAN_WIDTH_BUCKETS]),
                         vals[3 + SCAN_WIDTH_BUCKETS + 1 : 3 + SCAN_REC_WORDS],
                     )
+                    # ISSUE-13 commitment word: recomputed from the
+                    # state per readout, so the freshest one is THE
+                    # current value (uint32 bit pattern of an i32 word)
+                    self.stats.commit_word = (
+                        int(vals[3 + SCAN_REC_WORDS]) & 0xFFFFFFFF
+                    )
+                    if _phases.enabled:
+                        _phases.set_value(
+                            "integrate.commit_word", self.stats.commit_word
+                        )
                 self.stats.peak_blocks = max(self.stats.peak_blocks, occ)
                 if derr != 0:
                     if self.quarantine and self.on_quarantine is not None:
@@ -1967,7 +2026,7 @@ class PackedReplayDriver:
             self.cols, self.meta, self.unit_refs, self.gc_ranges
         )
         self.stats.compactions += 1
-        self._pending.append(_chunk_readout(self.meta, self._err))
+        self._pending.append(_chunk_readout(self.cols, self.meta, self._err))
         return self._drain_readouts()
 
     def ensure_room(self, margin: int) -> None:
@@ -2081,7 +2140,7 @@ class PackedReplayDriver:
                 )
 
         self.cols, self.meta = self._dispatch(dispatch)
-        self._pending.append(_chunk_readout(self.meta, self._err))
+        self._pending.append(_chunk_readout(self.cols, self.meta, self._err))
         self._hi_bound += margin
         self.stats.chunks += 1
         if self.sync_every_chunk:

@@ -10,11 +10,17 @@ from .admission import (
     TokenBucket,
 )
 from .scenario import Event, Scenario, ScenarioConfig
-from .soak import SoakDriver, run_soak_tcp
+from .soak import (
+    FederatedSoakDriver,
+    SoakDriver,
+    run_soak_tcp,
+    server_state_digest,
+)
 
 __all__ = [
     "AdmissionController",
     "Event",
+    "FederatedSoakDriver",
     "Overload",
     "QueueFull",
     "RateLimited",
@@ -23,4 +29,5 @@ __all__ = [
     "SoakDriver",
     "TokenBucket",
     "run_soak_tcp",
+    "server_state_digest",
 ]

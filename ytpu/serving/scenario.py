@@ -202,6 +202,16 @@ class Scenario:
     def __len__(self) -> int:
         return len(self._schedule)
 
+    def owner_shards(self, n_replicas: int) -> Dict[str, int]:
+        """Deterministic tenant → replica-shard assignment (ISSUE-13):
+        round-robin over the sorted tenant list, so the Zipf-hot
+        `tenant0` and its tail spread across the mesh the same way on
+        every host.  The federated soak maps shard ``k`` to its k-th
+        alive replica (hot-doc ownership sharding)."""
+        if n_replicas < 1:
+            raise ValueError("need at least one replica")
+        return {t: i % n_replicas for i, t in enumerate(self.tenants)}
+
     def with_round(self, round_: int) -> "Scenario":
         """The same grammar, fresh deterministic traffic (new client ids,
         new edits) — multi-round soaks call this per round."""

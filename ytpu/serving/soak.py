@@ -56,7 +56,40 @@ from ytpu.utils.slo import HistogramWindow, slo_report
 
 from .scenario import Scenario
 
-__all__ = ["SoakDriver", "run_soak_tcp"]
+__all__ = [
+    "FederatedSoakDriver",
+    "SoakDriver",
+    "run_soak_tcp",
+    "server_state_digest",
+]
+
+
+def server_state_digest(server, root: str) -> str:
+    """Canonical per-tenant state digest — tenant name, the rendered
+    root text (device-side when the tenant holds a slot), and the
+    sorted state vector, hashed.  Two servers that land byte-equal
+    digests hold byte-equal observable tenant states: the soak parity
+    surface, shared by `SoakDriver` and the federated soak (every mesh
+    replica must land the clean single-server run's digest)."""
+    flush = getattr(server, "flush_device", None)
+    if flush is not None:
+        flush()
+    h = hashlib.sha256()
+    for t in sorted(server.tenants):
+        h.update(t.encode())
+        h.update(_server_tenant_text(server, t, root).encode())
+        sv = server.tenant_state_vector(t)
+        h.update(repr(sorted(sv)).encode())
+    return h.hexdigest()
+
+
+def _server_tenant_text(server, tenant: str, root: str) -> str:
+    if hasattr(server, "device_text"):
+        try:
+            return server.device_text(tenant)
+        except KeyError:
+            pass  # host-resident tenant
+    return server.doc(tenant).get_text(root).get_string()
 
 def _admission_values() -> Dict[str, int]:
     """The admission module's OWN cached counter objects — the ones
@@ -460,31 +493,13 @@ class SoakDriver:
     # --- scoring surfaces ------------------------------------------------------
 
     def state_digest(self) -> str:
-        """Canonical per-tenant state digest: tenant name, the rendered
-        root text (device-side when the tenant holds a slot), and the
-        sorted state vector.  Two runs that land byte-equal digests hold
-        byte-equal observable tenant states — the soak parity surface."""
-        h = hashlib.sha256()
-        server = self.server
-        for t in sorted(server.tenants):
-            h.update(t.encode())
-            text = self._tenant_text(t)
-            h.update(text.encode())
-            sv = server.tenant_state_vector(t)
-            h.update(repr(sorted(sv)).encode())
-        return h.hexdigest()
+        """Canonical per-tenant state digest (`server_state_digest`) —
+        the soak parity surface."""
+        return server_state_digest(self.server, self.scenario.config.root)
 
     def _tenant_text(self, tenant: str) -> str:
-        server = self.server
-        if hasattr(server, "device_text"):
-            try:
-                return server.device_text(tenant)
-            except KeyError:
-                pass  # host-resident tenant
-        return (
-            server.doc(tenant)
-            .get_text(self.scenario.config.root)
-            .get_string()
+        return _server_tenant_text(
+            self.server, tenant, self.scenario.config.root
         )
 
     def _mirror_parity(self) -> Optional[bool]:
@@ -503,6 +518,272 @@ class SoakDriver:
             if server.device_text(t) != host:
                 return False
         return True
+
+
+class FederatedSoakDriver:
+    """2–3 replica federated soak (ISSUE-13): the PR-9 scenario driven
+    at a `ReplicaMesh` with tenant-sharded ownership, periodic sync +
+    commitment-verified anti-entropy rounds, and a scripted chaos
+    schedule — partition, heal, forced replica failover (sessions of
+    the dead replica reconnect to a survivor) and optional live tenant
+    migration — scored at BYTE PARITY against the same scenario's clean
+    single-server run: every surviving replica must land the PR-9
+    oracle `state_digest`.
+
+    Fractions (``partition_at`` etc.) index round-0's event schedule
+    like `SoakDriver.checkpoint_at`.  The driver routes each event to
+    its tenant's current owner (`mesh.route`), so ownership handoffs
+    re-route traffic live; a session whose replica died reconnects on
+    its next event (``failover_reconnects``).  When a divergence is
+    caught (e.g. an armed ``commit.corrupt``), the quarantined tenant
+    recovers in the convergence epilogue (``divergence_recoveries``)
+    unless ``recover_divergence=False``."""
+
+    def __init__(
+        self,
+        mesh,
+        scenario: Scenario,
+        flush_every: int = 8,
+        sync_every: int = 8,
+        anti_entropy_every: int = 24,
+        partition_at: Optional[float] = None,
+        partition_pair: Optional[tuple] = None,
+        heal_at: Optional[float] = None,
+        failover_at: Optional[float] = None,
+        failover_replica: Optional[str] = None,
+        migrate_at: Optional[float] = None,
+        migrate_to: Optional[str] = None,
+        recover_divergence: bool = True,
+        max_converge_rounds: int = 32,
+        max_busy_retries: int = 8,
+    ):
+        self.mesh = mesh
+        self.scenario = scenario
+        self.flush_every = max(1, flush_every)
+        self.sync_every = max(1, sync_every)
+        self.anti_entropy_every = max(1, anti_entropy_every)
+        self.partition_at = partition_at
+        self.partition_pair = partition_pair
+        self.heal_at = heal_at
+        self.failover_at = failover_at
+        self.failover_replica = failover_replica
+        self.migrate_at = migrate_at
+        self.migrate_to = migrate_to
+        self.recover_divergence = recover_divergence
+        self.max_converge_rounds = max(1, max_converge_rounds)
+        self.max_busy_retries = max(0, max_busy_retries)
+        self._sessions: Dict[int, tuple] = {}  # sid -> (replica_id, Session)
+        self._counts: Dict[str, int] = {}
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        self._counts[key] = self._counts.get(key, 0) + n
+
+    def _drain_all(self) -> None:
+        """Pull broadcast frames out of every soak client session's
+        outbox (the SoakDriver discipline): left undrained, a long soak
+        overflows the bounded outboxes and slow-consumer eviction sheds
+        the sessions, polluting the failover session-drop attribution."""
+        n = 0
+        for rid, sess in list(self._sessions.values()):
+            holder = self.mesh.replicas[rid]
+            if holder.alive and not sess.dead:
+                n += len(holder.server.drain(sess))
+        if n:
+            self._bump("broadcast_frames", n)
+
+    def _session(self, ev):
+        """The event's session on its tenant's CURRENT owner replica —
+        reconnecting across failovers (dead replica) and re-routing
+        across ownership handoffs (migration)."""
+        target = self.mesh.route(ev.tenant)
+        cur = self._sessions.get(ev.session)
+        if cur is not None:
+            rid, sess = cur
+            holder = self.mesh.replicas[rid]
+            if holder.alive and rid == target.id and not sess.dead:
+                return holder.server, sess
+            if not holder.alive:
+                self._bump("failover_reconnects")
+            elif rid != target.id:
+                self._bump("rerouted_sessions")
+                holder.server.disconnect(sess)
+        sess, _greeting = target.server.connect_frames(ev.tenant)
+        self._sessions[ev.session] = (target.id, sess)
+        return target.server, sess
+
+    def _handle(self, ev) -> None:
+        server, sess = self._session(ev)
+        if ev.kind == "apply":
+            frame = Message.sync(SyncMessage.update(ev.payload)).encode_v1()
+            for _ in range(self.max_busy_retries + 1):
+                replies = server.receive_frames(sess, frame)
+                if not any(
+                    m.kind == MSG_BUSY
+                    for r in replies
+                    for m in message_reader(r)
+                ):
+                    self._bump("applied")
+                    break
+                # an admission-deferred update must not be lost: drain
+                # the backpressure valve and retry the SAME frame (the
+                # SoakDriver backlog discipline, inline)
+                self._bump("busy_replies")
+                flush = getattr(server, "flush_device", None)
+                if flush is not None:
+                    flush()
+            else:
+                self._bump("dropped_updates")
+        elif ev.kind == "diff":
+            sv = StateVector.decode_v1(ev.payload)
+            frame = Message.sync(SyncMessage.step1(sv)).encode_v1()
+            server.receive_frames(sess, frame)
+            self._bump("diffs")
+        elif ev.kind == "awareness":
+            up = AwarenessUpdate.decode_v1(ev.payload)
+            server.receive_frames(sess, Message.awareness(up).encode_v1())
+            self._bump("awareness")
+        elif ev.kind == "reconnect":
+            server.disconnect(sess)
+            self._sessions.pop(ev.session, None)
+            self._bump("reconnects")
+
+    def _counter_deltas(self):
+        """The replica module's OWN cached counter objects — the ones
+        the mesh increments — not fresh registry lookups (a test-time
+        `metrics.reset()` orphans cached metrics; same rationale as
+        `_admission_values`).  The failover-drop child comes from a
+        mesh server's cached `_dropped` family for the same reason."""
+        from ytpu.sync import replica as _rep
+
+        vals = {
+            "replica.partitions": _rep._PARTITIONS.value,
+            "replica.heals": _rep._HEALS.value,
+            "replica.failovers": _rep._FAILOVERS.value,
+            "replica.migrations": _rep._MIGRATIONS.value,
+            "replica.commit_mismatches": _rep._MISMATCHES.value,
+            "replica.divergences": _rep._DIVERGENCES.value,
+            "replica.recoveries": _rep._RECOVERIES.value,
+            "replica.anti_entropy_bytes": _rep._AE_BYTES.value,
+        }
+        dropped = next(iter(self.mesh.replicas.values())).server._dropped
+        vals["net.sessions_dropped.failover"] = dropped.labels(
+            "failover"
+        ).value
+        return vals
+
+    def run(self) -> Dict:
+        mesh = self.mesh
+        scenario = self.scenario
+        root = scenario.config.root
+        before = self._counter_deltas()
+        self._counts = {}
+        # tenant-sharded hot-doc ownership: deterministic round-robin
+        # over the alive replicas (typed epoch-bumped handoffs)
+        ids = [r.id for r in mesh.alive()]
+        for tenant, shard in scenario.owner_shards(len(ids)).items():
+            mesh.assign_owner(tenant, ids[shard])
+        mesh.preregister_clients(s.client_id for s in scenario.sessions)
+        schedule = list(scenario.events())
+        total = len(schedule)
+
+        def idx(frac):
+            return int(total * frac) if frac is not None else None
+
+        partition_idx = idx(self.partition_at)
+        heal_idx = idx(self.heal_at)
+        failover_idx = idx(self.failover_at)
+        migrate_idx = idx(self.migrate_at)
+        t_start = time.perf_counter()
+        for i, ev in enumerate(schedule):
+            if partition_idx is not None and i == partition_idx:
+                alive_ids = [r.id for r in mesh.alive()]
+                if self.partition_pair or len(alive_ids) >= 2:
+                    a, b = self.partition_pair or (
+                        alive_ids[0], alive_ids[1],
+                    )
+                    mesh.partition(a, b)
+            if heal_idx is not None and i == heal_idx:
+                mesh.heal()
+            if failover_idx is not None and i == failover_idx:
+                victim = self.failover_replica or [
+                    r.id for r in mesh.alive()
+                ][-1]
+                dropped = mesh.kill_replica(victim, drain=True)
+                self._bump("failover_sessions_dropped", dropped)
+            if migrate_idx is not None and i == migrate_idx:
+                hot = scenario.tenants[0]
+                cur_owner = mesh.owner[hot][0]
+                others = [
+                    r.id for r in mesh.alive() if r.id != cur_owner
+                ]
+                dst = self.migrate_to or (others[-1] if others else None)
+                if dst is not None:
+                    mesh.migrate_tenant(hot, dst)
+            self._handle(ev)
+            self._bump("events")
+            if (i + 1) % self.flush_every == 0:
+                mesh.flush_devices()
+                self._drain_all()
+            if (i + 1) % self.sync_every == 0:
+                mesh.sync_round()
+            if (i + 1) % self.anti_entropy_every == 0:
+                mesh.anti_entropy_round()
+        # convergence epilogue: sync + anti-entropy (recovering any
+        # quarantined tenant) until every surviving replica's digest
+        # agrees — `converge_rounds` is the headline federation cost
+        converged = False
+        converge_rounds = 0
+        digests: Dict[str, str] = {}
+        while converge_rounds < self.max_converge_rounds:
+            converge_rounds += 1
+            mesh.sync_round(fire_faults=False)
+            mesh.anti_entropy_round()
+            if mesh.quarantined and self.recover_divergence:
+                for tenant in sorted(mesh.quarantined):
+                    if mesh.recover_tenant(tenant):
+                        self._bump("divergence_recoveries")
+            digests = {
+                r.id: server_state_digest(r.server, root)
+                for r in mesh.alive()
+            }
+            if len(set(digests.values())) == 1 and not mesh.quarantined:
+                converged = True
+                break
+        wall_s = time.perf_counter() - t_start
+        self._drain_all()
+        for rid, sess in self._sessions.values():
+            holder = self.mesh.replicas[rid]
+            if holder.alive:
+                holder.server.disconnect(sess)
+        self._sessions = {}
+        after = self._counter_deltas()
+        delta = {k: after[k] - before[k] for k in after}
+        applied = self._counts.get("applied", 0)
+        return {
+            "replicas": len(mesh.replicas),
+            "replicas_alive": len(mesh.alive()),
+            "sessions": len(scenario.sessions),
+            "scenario_digest": scenario.digest(),
+            "wall_s": round(wall_s, 4),
+            "updates_per_s": round(applied / max(wall_s, 1e-9), 1),
+            "converged": converged,
+            "converge_rounds": converge_rounds,
+            "state_digest": next(iter(digests.values()), ""),
+            "replica_digests": digests,
+            "quarantined": sorted(mesh.quarantined),
+            "partitions": delta["replica.partitions"],
+            "heals": delta["replica.heals"],
+            "failovers": delta["replica.failovers"],
+            "migrations": delta["replica.migrations"],
+            "commit_mismatches": delta["replica.commit_mismatches"],
+            "divergences_caught": delta["replica.divergences"],
+            "recoveries": delta["replica.recoveries"],
+            "anti_entropy_bytes": delta["replica.anti_entropy_bytes"],
+            "failover_sessions_dropped_metric": delta[
+                "net.sessions_dropped.failover"
+            ],
+            **{k: v for k, v in sorted(self._counts.items())},
+        }
 
 
 def run_soak_tcp(

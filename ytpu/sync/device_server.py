@@ -363,6 +363,21 @@ class DeviceSyncServer(SyncServer):
         slot = self._slot_of.get(tenant_name)
         return 0 if slot is None else len(self._queues[slot])
 
+    def release_tenant(self, tenant_name: str) -> None:
+        """Cross-replica migration support (ISSUE-13): free a tenant's
+        device slot after its hot-doc ownership moved to another mesh
+        replica.  The tenant stays fully servable — `_demote_to_host`
+        materializes the host doc from device state first — so existing
+        sessions keep their protocol endpoints while the device slot
+        follows ownership (`ReplicaMesh.migrate_tenant(...,
+        free_source_slot=True)`).  A no-op for tenants that are already
+        host-resident or never held a slot."""
+        if tenant_name in self._host_tenants:
+            return
+        if tenant_name not in self._slot_of:
+            return
+        self._demote_to_host(tenant_name)
+
     def rebalance_tenant(
         self, tenant_name: str, to_slot: Optional[int] = None
     ) -> int:

@@ -57,7 +57,9 @@ _RECONNECTS = metrics.counter("net.reconnects")
 # frames that failed to parse/apply, "timeout" for mid-frame stalls,
 # "disconnect" for abortive transport closes that sent no bad frame,
 # "shed" from admission/slow-consumer eviction in sync/server,
-# "update_drop" for policy=drop refusals that keep the session)
+# "update_drop" for policy=drop refusals that keep the session,
+# "failover" for sessions a killed replica dropped wholesale — they
+# reconnect to a mesh survivor, ISSUE-13)
 _SESSIONS_ACTIVE = metrics.gauge("net.sessions_active")
 _SESSIONS_DROPPED = metrics.counter(
     "net.sessions_dropped", labelnames=("reason",)
@@ -87,6 +89,7 @@ __all__ = [
     "serve",
     "SyncClient",
     "FrameTimeout",
+    "connect_with_backoff",
     "read_frame",
     "write_frame",
 ]
@@ -321,6 +324,35 @@ async def serve(
     return srv, bound
 
 
+async def connect_with_backoff(
+    host: str,
+    port: int,
+    retries: int = 4,
+    backoff: float = 0.05,
+    backoff_max: float = 2.0,
+) -> Tuple[asyncio.StreamReader, asyncio.StreamWriter]:
+    """`asyncio.open_connection` under the hardened-transport defaults
+    (ISSUE-6): a refused/unreachable connect retries up to `retries`
+    times with exponential backoff + full jitter (`backoff`·2^k capped
+    at `backoff_max`, each × U[0.5, 1.5)) so a thundering herd of
+    reconnecting peers spreads out.  Re-attempts count in
+    `net.connect_retries`.  Shared by `SyncClient.connect` and the
+    replica-mesh links (`ytpu.sync.replica`), so client and
+    server↔server dialing can never drift apart."""
+    delay = backoff
+    attempt = 0
+    while True:
+        try:
+            return await asyncio.open_connection(host, port)
+        except OSError:
+            if attempt >= retries:
+                raise
+            attempt += 1
+            _CONNECT_RETRIES.inc()
+            await asyncio.sleep(delay * (0.5 + random.random()))
+            delay = min(delay * 2, backoff_max)
+
+
 class SyncClient:
     """Minimal asyncio client: sync a local `Doc` with a served tenant.
 
@@ -354,21 +386,10 @@ class SyncClient:
         counts the re-attempts).  The SyncStep1 sent here carries the
         doc's CURRENT state vector, so the same call is the resync path:
         after a reconnect the server's SyncStep2 fills exactly the gap."""
-        delay = backoff
-        attempt = 0
-        while True:
-            try:
-                self.reader, self.writer = await asyncio.open_connection(
-                    host, port
-                )
-                break
-            except OSError:
-                if attempt >= retries:
-                    raise
-                attempt += 1
-                _CONNECT_RETRIES.inc()
-                await asyncio.sleep(delay * (0.5 + random.random()))
-                delay = min(delay * 2, backoff_max)
+        self.reader, self.writer = await connect_with_backoff(
+            host, port, retries=retries, backoff=backoff,
+            backoff_max=backoff_max,
+        )
         self._endpoint = (host, port, tenant)
         write_frame(self.writer, tenant.encode("utf-8"))
         write_frame(

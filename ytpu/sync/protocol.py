@@ -15,7 +15,7 @@ per-connection state machine with per-tenant queues feeding
 
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Tuple, Union
+from typing import Iterator, List, NamedTuple, Optional, Tuple, Union
 
 from ytpu.core import StateVector, Update
 from ytpu.encoding.lib0 import Cursor, Writer
@@ -28,8 +28,15 @@ __all__ = [
     "MSG_AUTH",
     "MSG_QUERY_AWARENESS",
     "MSG_BUSY",
+    "MSG_COMMIT",
+    "MSG_OWNERSHIP",
     "busy_message",
     "decode_busy",
+    "commit_message",
+    "decode_commit",
+    "OwnershipHandoff",
+    "ownership_message",
+    "decode_ownership",
     "MSG_SYNC_STEP_1",
     "MSG_SYNC_STEP_2",
     "MSG_SYNC_UPDATE",
@@ -52,6 +59,22 @@ MSG_QUERY_AWARENESS = 3
 # an unknown-tag Message they may ignore (SyncClient.pump skips non-sync
 # kinds by design).
 MSG_BUSY = 4
+# ytpu federation extensions (ISSUE-13, server↔server only — the replica
+# mesh intercepts these at the link layer; they never reach a tenant's
+# protocol handler):
+# - Commit: one tenant's incrementally-maintained state commitment
+#   (ytpu/sync/commitment.py), the O(1)-per-tenant anti-entropy probe a
+#   peer compares against its own before deciding whether to pull a
+#   diff. Body: lib0 [string tenant][var_uint lo32][var_uint hi32]
+#   [var_uint round].
+# - Ownership: a typed tenant-ownership handoff (live cross-replica
+#   migration / failover), epoch-guarded so a stale handoff replayed out
+#   of order can never regress the owner map. Body: lib0 [string tenant]
+#   [string owner replica id][var_uint epoch].
+# Both ride the generic custom-tag path, so pre-federation peers see an
+# unknown-tag Message they may ignore.
+MSG_COMMIT = 5
+MSG_OWNERSHIP = 6
 
 PERMISSION_DENIED = 0
 PERMISSION_GRANTED = 1
@@ -212,6 +235,53 @@ def decode_busy(body: bytes) -> Tuple[float, str]:
     cur = Cursor(body)
     retry_ms = cur.read_var_uint()
     return retry_ms / 1e3, cur.read_string()
+
+
+def commit_message(tenant: str, commitment: int, round_: int = 0) -> Message:
+    """Anti-entropy probe (ISSUE-13): one tenant's 64-bit state
+    commitment, split lo/hi so each var_uint stays within 32 bits."""
+    w = Writer()
+    w.write_string(tenant)
+    w.write_var_uint(commitment & 0xFFFFFFFF)
+    w.write_var_uint((commitment >> 32) & 0xFFFFFFFF)
+    w.write_var_uint(round_)
+    return Message.custom(MSG_COMMIT, w.to_bytes())
+
+
+def decode_commit(body: bytes) -> Tuple[str, int, int]:
+    """(tenant, commitment, round) from a Commit message body."""
+    cur = Cursor(body)
+    tenant = cur.read_string()
+    lo = cur.read_var_uint()
+    hi = cur.read_var_uint()
+    return tenant, (hi << 32) | lo, cur.read_var_uint()
+
+
+class OwnershipHandoff(NamedTuple):
+    """Typed cross-replica tenant-ownership transfer (ISSUE-13): the
+    wire record a live migration or a failover broadcasts.  ``epoch``
+    is a per-tenant monotonic counter — a receiver applies a handoff
+    only when its epoch EXCEEDS the known one, so replayed or
+    out-of-order handoffs can never regress ownership."""
+
+    tenant: str
+    owner: str  # replica id taking ownership
+    epoch: int
+
+
+def ownership_message(handoff: OwnershipHandoff) -> Message:
+    w = Writer()
+    w.write_string(handoff.tenant)
+    w.write_string(handoff.owner)
+    w.write_var_uint(handoff.epoch)
+    return Message.custom(MSG_OWNERSHIP, w.to_bytes())
+
+
+def decode_ownership(body: bytes) -> OwnershipHandoff:
+    cur = Cursor(body)
+    return OwnershipHandoff(
+        cur.read_string(), cur.read_string(), cur.read_var_uint()
+    )
 
 
 def message_reader(data: bytes) -> Iterator[Message]:

@@ -1,46 +1,152 @@
-"""Cross-server (pod-to-pod) replication over the y-sync protocol.
+"""Multi-replica federation: peer sync mesh, incremental commitments,
+partition/heal chaos (ISSUE-13 tentpole).
 
-Behavioral parity target: /root/reference/yrs/src/sync/protocol.rs — the
-handshake contract (:8-31) and default handlers (:42-135) are symmetric
-peer-to-peer; a "server" is just a peer that happens to fan updates out to
-its own sessions. This module applies that symmetry *between two server
-processes*: each pod holds authoritative tenant state (host docs or device
-batch slots) and a `ReplicaLink` makes one pod a session of the other.
+Everything before this layer was one server owning every tenant — a
+single process, single device, single failure domain.  The y-sync
+protocol is symmetric (reference: yrs sync/protocol.rs:8-31 — a "server"
+is just a peer that fans updates out to its own sessions), so scale-OUT
+is running N `SyncServer` / `DeviceSyncServer` replicas that peer with
+each other as clients: server↔server SyncStep1/2 over the same frames
+tenants speak.  Two layers live here:
 
-Design: the link bridges a local in-process `Session` (obtained from
-`SyncServer.connect_frames`, so the local server speaks its own greeting —
-SyncStep1(sv) + awareness snapshot) to the remote pod's TCP endpoint
-(`ytpu.sync.net.serve`). Frames flow both ways untouched:
+- **`ReplicaLink` / `Replicator`** (the original pod-to-pod bridge,
+  folded onto the PR-6 hardened transport): one asyncio link makes a
+  local server a session of a remote pod over TCP — connect retry with
+  exponential backoff + full jitter (`net.connect_retries`), the
+  whole-frame read deadline, and `reconnect()`-with-SV-resync
+  (`net.reconnects`).  This remains the CROSS-PROCESS transport; new
+  code composing several replicas in one process should use the mesh.
 
-- local greeting / replies / outbox broadcasts  → written to the socket;
-- remote frames → `server.receive_frames(session, frame)`; the local
-  server applies them with the link's session as origin, so its own
-  broadcast fan-out delivers to every *other* local session but never
-  echoes back over the link.
+- **`ReplicaMesh`** (ISSUE-13): the federation control plane.  It owns
+  one `_PeerLink` per (replica pair, tenant) — a bidirectional in-proc
+  link whose two ends are ordinary server `Session`s, pumped
+  deterministically (tier-1-testable; the wire-frame path, byte for
+  byte, minus the socket) — plus:
 
-Because only `connect_frames` / `receive_frames` / `drain` are used, the
-same link replicates a plain host `SyncServer` and a device-authoritative
-`DeviceSyncServer` (whose overrides answer SyncStep1 from device state and
-queue inbound updates straight to batch slots) without special cases.
+  * **tenant-sharded ownership** with typed, epoch-guarded
+    `OwnershipHandoff` frames (`protocol.MSG_OWNERSHIP`):
+    `assign_owner` shards tenants across replicas, `migrate_tenant`
+    promotes PR-9's `rebalance_tenant` into LIVE cross-replica
+    migration (drain → handoff broadcast → optional source device-slot
+    release via `DeviceSyncServer.release_tenant`), and `kill_replica`
+    is the forced failover — the dead replica's sessions drop with
+    `net.sessions_dropped{reason="failover"}` and its tenants' ownership
+    hands off to a survivor.
 
-One link per tenant per peer pair is fully bidirectional; duplicate
-delivery through redundant links is harmless (CRDT updates are idempotent,
-exactly the reference's at-least-once stance). Anti-entropy: `gossip()`
-re-sends SyncStep1 with the current local state vector so a peer that
-missed live updates (e.g. reconnect) ships the SV-diff — the
-reference's read-your-state handshake used as a repair round.
+  * **O(1) anti-entropy** (`anti_entropy_round`): replicas exchange
+    per-tenant incremental commitments (`ytpu.sync.commitment`,
+    `protocol.MSG_COMMIT` frames over the links) and pull an SV-diff
+    ONLY on mismatch.  A commitment that still disagrees after a
+    converged sync (equal state vectors) is a typed `DivergenceFault`:
+    the tenant quarantines, `replica.divergences` counts it, and a
+    telemetry `/healthz` probe sees ``status: "degraded"``
+    (`mesh.attach_health`).  `recover_tenant` rebuilds the trackers
+    from scratch and unquarantines when replicas agree again.
+
+  * **first-class chaos**: `partition`/`heal`/`lag` are mesh APIs AND
+    `YTPU_FAULTS=` sites (`replica.partition`, `replica.heal`,
+    `replica.lag`, `replica.kill`, plus `commit.corrupt` inside the
+    commitment fold) fired at `sync_round` entry, so a federated soak
+    scripts its whole failure schedule through the PR-6 grammar.
+
+Delivery semantics: links are at-least-once (CRDT updates are
+idempotent), and the mesh dedupes *delivered* update/step2 payloads per
+receiving replica — device-authoritative servers rebroadcast
+unconditionally (they never touch a host doc, so no no-op-apply
+suppression exists there), and without the dedup a ≥3-replica cycle
+would circulate one update forever.  Partitioned links DROP frames
+(that is the fault being modeled; `replica.frames_dropped`); healing
+queues an SV gossip both ways, and the next anti-entropy round pulls
+whatever the drop lost.  In-proc, all replicas share one ownership map;
+the handoff frames still cross the links so the epoch guard and codecs
+run exactly as a cross-process mesh would pump them.
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import List, Optional
+import hashlib
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
 
-from ytpu.sync.net import read_frame, write_frame
-from ytpu.sync.protocol import Message, SyncMessage
+from ytpu.sync.commitment import TenantCommitments
+from ytpu.sync.net import (
+    FRAME_DEADLINE,
+    _RECONNECTS,
+    connect_with_backoff,
+    read_frame,
+    write_frame,
+)
+from ytpu.sync.protocol import (
+    MSG_BUSY,
+    MSG_COMMIT,
+    MSG_OWNERSHIP,
+    Message,
+    OwnershipHandoff,
+    SyncMessage,
+    commit_message,
+    decode_commit,
+    decode_ownership,
+    message_reader,
+    ownership_message,
+)
 from ytpu.sync.server import Session, SyncServer
+from ytpu.utils import metrics
+from ytpu.utils.faults import faults
 
-__all__ = ["ReplicaLink", "Replicator"]
+__all__ = [
+    "DivergenceFault",
+    "MeshReplica",
+    "ReplicaLink",
+    "ReplicaMesh",
+    "Replicator",
+]
+
+# federation series (module-cached; docs/observability.md §Metric name
+# index). `replica.anti_entropy_bytes` is the scale headline: commitment
+# agreement makes a round cost O(tenants · links) tiny frames instead of
+# O(state) — bench_compare regresses it on RISE.
+_LINKS = metrics.gauge("replica.links")
+_SYNC_ROUNDS = metrics.counter("replica.sync_rounds")
+_AE_ROUNDS = metrics.counter("replica.anti_entropy_rounds")
+_AE_BYTES = metrics.counter("replica.anti_entropy_bytes")
+_MISMATCHES = metrics.counter("replica.commit_mismatches")
+_DIVERGENCES = metrics.counter("replica.divergences")
+_QUARANTINED = metrics.gauge("replica.quarantined_tenants")
+_RECOVERIES = metrics.counter("replica.recoveries")
+_PARTITIONS = metrics.counter("replica.partitions")
+_HEALS = metrics.counter("replica.heals")
+_LAGS = metrics.counter("replica.lags")
+_FAILOVERS = metrics.counter("replica.failovers")
+_MIGRATIONS = metrics.counter("replica.migrations")
+_FRAMES_DROPPED = metrics.counter(
+    "replica.frames_dropped", labelnames=("reason",)
+)
+_FRAMES_DEDUPED = metrics.counter("replica.frames_deduped")
+_LINK_RESYNCS = metrics.counter("replica.link_resyncs")
+
+
+class DivergenceFault(RuntimeError):
+    """Two replicas' commitments for one tenant disagree AFTER a sync
+    round converged their state vectors: the op lattices agree but a
+    commitment tracker (or the state behind it) silently diverged —
+    the failure mode the incremental commitment exists to catch
+    (`commit.corrupt` injects it deterministically).  The tenant is
+    quarantined on raise/record; `ReplicaMesh.recover_tenant` is the
+    operator path back."""
+
+    def __init__(
+        self, tenant: str, a: str, b: str, commit_a: int, commit_b: int
+    ):
+        super().__init__(
+            f"tenant {tenant!r} commitments diverge between replicas "
+            f"{a!r} ({commit_a:#018x}) and {b!r} ({commit_b:#018x}) "
+            "despite equal state vectors — tenant quarantined"
+        )
+        self.tenant = tenant
+        self.replica_a = a
+        self.replica_b = b
+        self.commit_a = commit_a
+        self.commit_b = commit_b
 
 
 def _step1_frame(server: SyncServer, tenant: str) -> bytes:
@@ -54,8 +160,729 @@ def _step1_frame(server: SyncServer, tenant: str) -> bytes:
     ).encode_v1()
 
 
+# --------------------------------------------------------------------------
+# the in-process federation mesh (ISSUE-13)
+# --------------------------------------------------------------------------
+
+
+class MeshReplica:
+    """One replica in a `ReplicaMesh`: an id, a server, liveness, its
+    per-tenant commitment trackers, and the delivered-payload dedup set
+    (the at-least-once mesh's cycle breaker — see module docstring)."""
+
+    __slots__ = ("id", "server", "alive", "commitments", "_seen")
+
+    #: dedup-set bound (FIFO eviction).  Rebroadcast cycles re-deliver a
+    #: payload within a handful of flow passes, so a recency window this
+    #: wide breaks every cycle while keeping steady-state memory flat; an
+    #: evicted key's payload recirculating later is an idempotent no-op.
+    SEEN_CAP = 65536
+
+    def __init__(self, rid: str, server: SyncServer):
+        self.id = rid
+        self.server = server
+        self.alive = True
+        self.commitments = TenantCommitments()
+        self._seen: Dict[bytes, None] = {}  # insertion-ordered set
+
+    @staticmethod
+    def payload_key(frame: bytes, tenant: str) -> Optional[bytes]:
+        """Dedup key of a SyncStep2/Update frame (same payload in either
+        wrapping keys identically — frame[2:] skips kind+tag) or an
+        Awareness frame (servers rebroadcast awareness unconditionally,
+        so a ≥3-replica cycle would otherwise circulate one snapshot
+        forever and `sync_round` could never quiesce; byte-identical
+        awareness payloads are idempotent no-ops, a bumped presence
+        clock changes the bytes and passes).  None for every other
+        frame kind.  The TENANT is part of the key: the same client
+        writing byte-identical first ops into two tenants is two
+        distinct deliveries, not a duplicate."""
+        if len(frame) < 2:
+            return None
+        salt = tenant.encode() + b"\x00"
+        if frame[0] == 0 and frame[1] in (1, 2):
+            return hashlib.blake2b(
+                salt + frame[2:], digest_size=8
+            ).digest()
+        if frame[0] == 1:  # Awareness
+            return hashlib.blake2b(salt + frame, digest_size=8).digest()
+        return None
+
+    def seen_payload(self, key: Optional[bytes]) -> bool:
+        """True when this replica already had the payload behind `key`
+        DELIVERED (marked via `mark_payload` only after a successful
+        apply).  Re-applying would be an idempotent no-op; the dedup
+        prevents device-authoritative rebroadcast cycles."""
+        if key is not None and key in self._seen:
+            _FRAMES_DEDUPED.inc()
+            return True
+        return False
+
+    def mark_payload(self, key: Optional[bytes]) -> None:
+        if key is None:
+            return
+        self._seen[key] = None
+        if len(self._seen) > self.SEEN_CAP:
+            del self._seen[next(iter(self._seen))]
+
+    def commitment(self, tenant: str) -> int:
+        """The replica's current commitment for `tenant` (incremental
+        fold of the authoritative state vector's delta)."""
+        return self.commitments.refresh(
+            tenant, self.server.tenant_state_vector(tenant)
+        )
+
+
+class _PeerLink:
+    """One tenant's bidirectional in-proc link between two mesh
+    replicas.  Each end is an ordinary `Session` on the OTHER replica's
+    server (exactly the `ReplicaLink` bridge shape, minus the socket):
+    frames queue toward a destination and `flow()` delivers one batch
+    each way, returning (frames, bytes) moved.  Partition drops,
+    lag defers, a slow-consumer-evicted end reopens with a fresh
+    greeting (SV resync)."""
+
+    __slots__ = (
+        "mesh", "a", "b", "tenant", "partitioned", "lag_rounds",
+        "sess_a", "sess_b", "_to_a", "_to_b",
+    )
+
+    def __init__(self, mesh: "ReplicaMesh", a: MeshReplica, b: MeshReplica,
+                 tenant: str):
+        self.mesh = mesh
+        self.a = a
+        self.b = b
+        self.tenant = tenant
+        self.partitioned = False
+        self.lag_rounds = 0
+        self._to_a: List[bytes] = []
+        self._to_b: List[bytes] = []
+        # each replica's greeting (SyncStep1 + awareness) crosses to the
+        # peer — both sides open with step1, per the protocol contract
+        self.sess_a, greet_a = a.server.connect_frames(tenant)
+        self.sess_b, greet_b = b.server.connect_frames(tenant)
+        self._to_b.extend(greet_a)
+        self._to_a.extend(greet_b)
+
+    def covers(self, rid: str) -> bool:
+        return rid in (self.a.id, self.b.id)
+
+    def post(self, frame: bytes, dst: MeshReplica) -> None:
+        (self._to_a if dst is self.a else self._to_b).append(frame)
+
+    def gossip(self) -> None:
+        """Queue an SV advertisement both ways — the repair round a heal
+        schedules (the peer answers with the SV-diff, protocol.rs:60-68
+        semantics)."""
+        self._to_b.append(_step1_frame(self.a.server, self.tenant))
+        self._to_a.append(_step1_frame(self.b.server, self.tenant))
+
+    def _resync(self, end: str) -> Session:
+        """Reopen one evicted end (outbox overflow marked it dead): a
+        fresh session whose greeting resyncs the peer via the
+        state-vector handshake — the PR-6 reconnect discipline."""
+        _LINK_RESYNCS.inc()
+        if end == "b":
+            self.b.server.disconnect(self.sess_b)
+            self.sess_b, greet = self.b.server.connect_frames(self.tenant)
+            self._to_a.extend(greet)
+            return self.sess_b
+        self.a.server.disconnect(self.sess_a)
+        self.sess_a, greet = self.a.server.connect_frames(self.tenant)
+        self._to_b.extend(greet)
+        return self.sess_a
+
+    def _deliver(
+        self, frames: List[bytes], src: MeshReplica, dst: MeshReplica,
+        end: str,
+    ) -> Tuple[int, int]:
+        n = nb = 0
+        back = self._to_a if end == "b" else self._to_b
+        sess = self.sess_b if end == "b" else self.sess_a
+        for frame in frames:
+            n += 1
+            nb += len(frame)
+            if self.mesh._handle_mesh_frame(frame, src, dst):
+                continue  # commit/ownership: the mesh's, not the server's
+            if frame and frame[0] == MSG_BUSY:
+                # a peer's admission refusal crossing back over the
+                # link: servers don't speak MSG_BUSY (only SyncClient
+                # does) — swallow it; the refused update was never
+                # marked delivered, so SV-resync gossip retransmits it
+                continue
+            key = dst.payload_key(frame, self.tenant)
+            if dst.seen_payload(key):
+                continue  # at-least-once dedup (idempotent anyway)
+            if sess.dead:
+                sess = self._resync(end)
+            # mark delivered only on SUCCESS: a refused apply must stay
+            # repairable by the SV-resync retransmission path — marking
+            # up front would blacklist the payload forever.  An update
+            # frame only counts as applied when the server's applied
+            # counter moved (catches Busy replies AND the silent
+            # admission policy="drop" refusal, which sends nothing);
+            # awareness frames have no admission gate.
+            is_update = key is not None and frame[0] == 0
+            before = dst.server._applied.value if is_update else 0
+            back.extend(dst.server.receive_frames(sess, frame))
+            if key is not None and not sess.dead:
+                if not is_update or dst.server._applied.value > before:
+                    dst.mark_payload(key)
+        return n, nb
+
+    def flow(self) -> Tuple[int, int]:
+        """Drain both ends' outboxes into the pending queues, then
+        deliver one batch each way.  Returns (frames, bytes) delivered
+        — 0 under partition (frames DROP), lag (frames defer), or a
+        dead replica (frames discard)."""
+        if not (self.a.alive and self.b.alive):
+            self._to_a.clear()
+            self._to_b.clear()
+            return 0, 0
+        self._to_b.extend(self.a.server.drain(self.sess_a))
+        self._to_a.extend(self.b.server.drain(self.sess_b))
+        if self.partitioned:
+            n = len(self._to_a) + len(self._to_b)
+            if n:
+                _FRAMES_DROPPED.labels("partition").inc(n)
+            self._to_a.clear()
+            self._to_b.clear()
+            return 0, 0
+        if self.lag_rounds > 0:
+            self.lag_rounds -= 1
+            return 0, 0
+        out_b, self._to_b = self._to_b, []
+        out_a, self._to_a = self._to_a, []
+        n1, b1 = self._deliver(out_b, self.a, self.b, "b")
+        n2, b2 = self._deliver(out_a, self.b, self.a, "a")
+        return n1 + n2, b1 + b2
+
+
+class ReplicaMesh:
+    """N replicas fully meshed per tenant, with sharded ownership,
+    commitment-verified anti-entropy, and scripted chaos (see module
+    docstring).  ``replicas`` is an iterable of ``(id, server)`` pairs;
+    tenants join via `ensure_tenant` / `assign_owner` (or lazily on
+    `route`)."""
+
+    def __init__(
+        self,
+        replicas: Iterable[Tuple[str, SyncServer]],
+        tenants: Iterable[str] = (),
+    ):
+        self.replicas: Dict[str, MeshReplica] = {}
+        for rid, server in replicas:
+            if rid in self.replicas:
+                raise ValueError(f"duplicate replica id {rid!r}")
+            self.replicas[rid] = MeshReplica(rid, server)
+        if len(self.replicas) < 2:
+            raise ValueError("a mesh needs at least two replicas")
+        self._links: Dict[Tuple[str, str, str], _PeerLink] = {}
+        #: tenant -> its links (maintained at link create/delete so the
+        #: per-event route() and per-tenant anti-entropy stay O(links of
+        #: that tenant), never a scan of the whole mesh)
+        self._links_by_tenant: Dict[str, List[_PeerLink]] = {}
+        #: tenant -> (owner replica id, ownership epoch)
+        self.owner: Dict[str, Tuple[str, int]] = {}
+        #: tenant -> the DivergenceFault that quarantined it
+        self.quarantined: Dict[str, DivergenceFault] = {}
+        #: every divergence ever caught (the chaos-soak assertion surface)
+        self.divergences: List[DivergenceFault] = []
+        #: (receiver, sender, tenant) -> (ae round, value): probes carry
+        #: the anti-entropy round they were sent in, so one deferred by
+        #: `replica.lag` and delivered rounds later can never alias as
+        #: the current round's answer
+        self._commit_inbox: Dict[Tuple[str, str, str], Tuple[int, int]] = {}
+        #: replica pairs currently partitioned — the fault is per PAIR,
+        #: not per existing link: a link lazily created between a
+        #: severed pair (ensure_tenant for a new tenant mid-partition)
+        #: must be born partitioned, or frames would cross the split
+        self._partitioned_pairs: Set[FrozenSet[str]] = set()
+        self._ae_seq = 0
+        for t in tenants:
+            self.ensure_tenant(t)
+
+    # ------------------------------------------------------------ topology
+
+    def alive(self) -> List[MeshReplica]:
+        return [r for r in self.replicas.values() if r.alive]
+
+    def ensure_tenant(self, tenant: str, owner: Optional[str] = None) -> None:
+        """Create the tenant's links between every alive replica pair
+        and register ownership (default: the first ALIVE replica — a
+        tenant created after a failover must not default to the dead
+        one, which no handoff would ever correct).  Known tenants
+        return in O(1) — replicas never join a live mesh, so a tenant's
+        link set only ever shrinks (deaths), never needs re-probing.
+        For a KNOWN tenant the ``owner`` argument is ignored —
+        `assign_owner` is the ownership-mutation API."""
+        if owner is not None and owner not in self.replicas:
+            raise KeyError(f"unknown replica {owner!r}")
+        if tenant in self.owner:
+            return
+        if owner is None:
+            alive = self.alive()
+            owner = alive[0].id if alive else next(iter(self.replicas))
+        self.owner[tenant] = (owner, 0)
+        ids = [r.id for r in self.alive()]
+        by_tenant = self._links_by_tenant.setdefault(tenant, [])
+        for i in range(len(ids)):
+            for j in range(i + 1, len(ids)):
+                key = (ids[i], ids[j], tenant)
+                if key not in self._links:
+                    link = _PeerLink(
+                        self, self.replicas[ids[i]], self.replicas[ids[j]],
+                        tenant,
+                    )
+                    if frozenset((ids[i], ids[j])) in self._partitioned_pairs:
+                        link.partitioned = True
+                    self._links[key] = link
+                    by_tenant.append(link)
+        _LINKS.set(len(self._links))
+
+    def _tenant_links(self, tenant: str) -> List[_PeerLink]:
+        return [
+            link
+            for link in self._links_by_tenant.get(tenant, ())
+            if link.a.alive and link.b.alive
+        ]
+
+    def route(self, tenant: str) -> MeshReplica:
+        """The replica that should serve `tenant` right now: its owner,
+        or — between a death and the failover handoff — any survivor."""
+        self.ensure_tenant(tenant)
+        rid, _ = self.owner[tenant]
+        rep = self.replicas[rid]
+        if rep.alive:
+            return rep
+        return self.alive()[0]
+
+    def flush_devices(self) -> None:
+        for rep in self.alive():
+            flush = getattr(rep.server, "flush_device", None)
+            if flush is not None:
+                flush()
+
+    def preregister_clients(self, client_ids: Iterable[int]) -> None:
+        """Intern expected writer ids on every device-backed replica up
+        front (the decode/integrate programs specialize on client-table
+        SIZE — same rationale as `SoakDriver._preregister_clients`)."""
+        ids = list(client_ids)
+        for rep in self.alive():
+            ing = getattr(rep.server, "ingestor", None)
+            if ing is not None:
+                for cid in ids:
+                    ing.enc.interner.intern(cid)
+
+    # --------------------------------------------------------- frame plane
+
+    def _handle_mesh_frame(
+        self, frame: bytes, src: MeshReplica, dst: MeshReplica
+    ) -> bool:
+        """Intercept mesh-level frames (commit probes, ownership
+        handoffs) at the link layer — they never reach a tenant's
+        protocol handler."""
+        if not frame or frame[0] not in (MSG_COMMIT, MSG_OWNERSHIP):
+            return False
+        msg = next(message_reader(frame))
+        if msg.kind == MSG_COMMIT:
+            tenant, value, rnd = decode_commit(msg.body)
+            self._commit_inbox[(dst.id, src.id, tenant)] = (rnd, value)
+            return True
+        if msg.kind == MSG_OWNERSHIP:
+            self._apply_handoff(decode_ownership(msg.body))
+            return True
+        return False
+
+    def _apply_handoff(self, h: OwnershipHandoff) -> bool:
+        """Epoch-guarded ownership application: stale (≤ known epoch)
+        handoffs are ignored, so replayed or reordered frames can never
+        regress the owner map."""
+        cur = self.owner.get(h.tenant)
+        if cur is not None and h.epoch <= cur[1]:
+            return False
+        self.owner[h.tenant] = (h.owner, h.epoch)
+        return True
+
+    def _handoff(self, h: OwnershipHandoff, broadcast: bool = True) -> None:
+        self._apply_handoff(h)
+        if broadcast:
+            frame = ownership_message(h).encode_v1()
+            for link in self._tenant_links(h.tenant):
+                link.post(frame, link.a)
+                link.post(frame, link.b)
+
+    def assign_owner(self, tenant: str, rid: str) -> int:
+        """Shard one tenant onto a replica (typed epoch-bumping handoff,
+        broadcast over its links); returns the new epoch."""
+        if rid not in self.replicas:
+            raise KeyError(f"unknown replica {rid!r}")
+        self.ensure_tenant(tenant)
+        cur, epoch = self.owner[tenant]
+        if cur == rid:
+            return epoch
+        h = OwnershipHandoff(tenant, rid, epoch + 1)
+        self._handoff(h)
+        return h.epoch
+
+    # -------------------------------------------------------- chaos faults
+
+    def _fire_fault_sites(self) -> None:
+        """The ISSUE-13 `YTPU_FAULTS` sites, fired once per (top-level)
+        sync round: `replica.partition` (args ``a=``/``b=``, default
+        the first alive pair), `replica.heal` (heal everything),
+        `replica.lag` (args ``a=``/``b=``/``rounds=``, default 2), and
+        `replica.kill` (args ``replica=``, default the LAST alive;
+        ``drain=0`` skips the pre-kill drain → the un-replicated tail is
+        lost, for loss-scenario tests)."""
+        if not faults.active:
+            return
+        ids = [r.id for r in self.alive()]
+        spec = faults.fire("replica.partition")
+        if spec is not None and len(ids) >= 2:
+            self.partition(
+                str(spec.args.get("a", ids[0])),
+                str(spec.args.get("b", ids[1])),
+            )
+        if faults.fire("replica.heal") is not None:
+            self.heal()
+        spec = faults.fire("replica.lag")
+        if spec is not None and len(ids) >= 2:
+            self.lag(
+                str(spec.args.get("a", ids[0])),
+                str(spec.args.get("b", ids[1])),
+                rounds=int(spec.args.get("rounds", 2)),
+            )
+        spec = faults.fire("replica.kill")
+        if spec is not None and len(ids) >= 2:
+            victim = str(spec.args.get("replica", ids[-1]))
+            self.kill_replica(victim, drain=bool(spec.args.get("drain", 1)))
+
+    def partition(self, a: str, b: str) -> int:
+        """Partition the `a`↔`b` replica pair: every existing link drops
+        frames until `heal`, and links created DURING the partition
+        (new tenants) are born partitioned too.  Returns the link count
+        partitioned."""
+        for rid in (a, b):
+            if rid not in self.replicas:
+                raise KeyError(f"unknown replica {rid!r}")
+        pair = frozenset((a, b))
+        newly = pair not in self._partitioned_pairs
+        self._partitioned_pairs.add(pair)
+        n = 0
+        for link in self._links.values():
+            if link.covers(a) and link.covers(b) and not link.partitioned:
+                link.partitioned = True
+                n += 1
+        if n or newly:
+            _PARTITIONS.inc()
+        return n
+
+    def heal(self, a: Optional[str] = None, b: Optional[str] = None) -> int:
+        """Heal partitioned links (all of them, or just the `a`↔`b`
+        pair), queueing an SV-resync gossip on each so the next sync
+        round repairs what the partition dropped."""
+        if a is None and b is not None:
+            a, b = b, None  # heal(b=x) means heal(x), not heal-everything
+        n = 0
+        for link in self._links.values():
+            if not link.partitioned:
+                continue
+            if a is not None and not (link.covers(a) and link.covers(b or a)):
+                continue
+            link.partitioned = False
+            link.gossip()
+            n += 1
+        if a is None:
+            cleared = len(self._partitioned_pairs)
+            self._partitioned_pairs.clear()
+        else:
+            cleared = 0
+            for pair in list(self._partitioned_pairs):
+                if a in pair and (b is None or b in pair):
+                    self._partitioned_pairs.discard(pair)
+                    cleared += 1
+        if n or cleared:
+            _HEALS.inc()
+        return n
+
+    def lag(self, a: str, b: str, rounds: int = 2) -> int:
+        """Defer delivery on the `a`↔`b` links for `rounds` flow passes
+        (frames queue, nothing is lost) — transit latency, not loss."""
+        for rid in (a, b):
+            if rid not in self.replicas:
+                raise KeyError(f"unknown replica {rid!r}")
+        n = 0
+        for link in self._links.values():
+            if link.covers(a) and link.covers(b):
+                link.lag_rounds = max(link.lag_rounds, int(rounds))
+                n += 1
+        if n:
+            _LAGS.inc()
+        return n
+
+    # ----------------------------------------------------------- sync plane
+
+    def sync_round(self, max_passes: int = 32, fire_faults: bool = True) -> Dict:
+        """Pump every link until quiescent (bounded by `max_passes`),
+        flushing device queues between passes so diffs reflect delivered
+        updates.  Top-level rounds fire the armed `replica.*` fault
+        sites first; internal rounds (drain-before-kill, migration)
+        pass ``fire_faults=False``."""
+        if fire_faults:
+            self._fire_fault_sites()
+        _SYNC_ROUNDS.inc()
+        self.flush_devices()
+        frames = nbytes = passes = 0
+        while passes < max_passes:
+            moved = mbytes = 0
+            for link in list(self._links.values()):
+                n, nb = link.flow()
+                moved += n
+                mbytes += nb
+            passes += 1
+            frames += moved
+            nbytes += mbytes
+            if moved == 0:
+                break
+            self.flush_devices()
+        return {"frames": frames, "bytes": nbytes, "passes": passes}
+
+    def _pump_link(self, link: _PeerLink, max_passes: int = 16) -> Tuple[int, int]:
+        frames = nbytes = 0
+        for _ in range(max_passes):
+            n, nb = link.flow()
+            if n == 0:
+                break
+            frames += n
+            nbytes += nb
+            self.flush_devices()
+        return frames, nbytes
+
+    # ---------------------------------------------------------- anti-entropy
+
+    def anti_entropy_round(self, strict: bool = False) -> Dict:
+        """One commitment-verified anti-entropy round: per healthy
+        (tenant, link), exchange `MSG_COMMIT` probes; on agreement the
+        round cost ends there (O(1) per tenant per link — no state is
+        flushed or rendered).  On mismatch, pull the SV-diff (gossip +
+        pump) and re-compare; a mismatch that SURVIVES equal state
+        vectors is a `DivergenceFault`: recorded in `self.divergences`,
+        the tenant quarantined (skipped by later rounds until
+        `recover_tenant`), surfaced via `health()` — and raised when
+        ``strict=True``."""
+        _AE_ROUNDS.inc()
+        self.flush_devices()
+        rep = {
+            "tenants": 0, "compared": 0, "mismatches": 0, "pulled": 0,
+            "divergences": 0, "unconverged": 0, "bytes": 0,
+        }
+        self._ae_seq += 1
+        rnd = self._ae_seq
+        for tenant in sorted(self.owner):
+            if tenant in self.quarantined:
+                continue
+            rep["tenants"] += 1
+            for link in self._tenant_links(tenant):
+                if link.partitioned:
+                    continue  # cannot anti-entropy across a partition
+                a, b = link.a, link.b
+                ca = a.commitment(tenant)
+                cb = b.commitment(tenant)
+                fa = commit_message(tenant, ca, round_=rnd).encode_v1()
+                fb = commit_message(tenant, cb, round_=rnd).encode_v1()
+                link.post(fa, b)
+                link.post(fb, a)
+                _, nb = self._pump_link(link)
+                rep["bytes"] += nb
+                got_b = self._commit_inbox.pop((b.id, a.id, tenant), None)
+                got_a = self._commit_inbox.pop((a.id, b.id, tenant), None)
+                if (
+                    got_b is None or got_b[0] != rnd
+                    or got_a is None or got_a[0] != rnd
+                ):
+                    # probe lost, or a STALE one surfaced (deferred by
+                    # replica.lag and delivered rounds late)
+                    rep["unconverged"] += 1
+                    continue
+                rep["compared"] += 1
+                if got_b[1] == cb and got_a[1] == ca:
+                    continue  # agreement: O(1), done
+                _MISMATCHES.inc()
+                rep["mismatches"] += 1
+                link.gossip()
+                _, nb = self._pump_link(link)
+                rep["bytes"] += nb
+                rep["pulled"] += 1
+                ca2 = a.commitment(tenant)
+                cb2 = b.commitment(tenant)
+                if ca2 == cb2:
+                    continue  # the pull repaired it
+                sva = sorted(a.server.tenant_state_vector(tenant))
+                svb = sorted(b.server.tenant_state_vector(tenant))
+                if sva != svb:
+                    rep["unconverged"] += 1  # sync gap, not divergence
+                    continue
+                fault = DivergenceFault(tenant, a.id, b.id, ca2, cb2)
+                self.quarantined[tenant] = fault
+                self.divergences.append(fault)
+                _DIVERGENCES.inc()
+                _QUARANTINED.set(len(self.quarantined))
+                rep["divergences"] += 1
+                if strict:
+                    raise fault
+                break  # tenant quarantined: skip its remaining links
+        _AE_BYTES.inc(rep["bytes"])
+        return rep
+
+    def recover_tenant(self, tenant: str) -> bool:
+        """Recovery for a quarantined tenant: authoritative commitment
+        rebuild on every alive replica (discarding poisoned incremental
+        state), one sync round, then unquarantine iff the rebuilt
+        commitments agree (`replica.recoveries`).  Returns success."""
+        fault = self.quarantined.pop(tenant, None)
+        _QUARANTINED.set(len(self.quarantined))
+        self.flush_devices()
+        for rep in self.alive():
+            rep.commitments.recompute(
+                tenant, rep.server.tenant_state_vector(tenant)
+            )
+        self.sync_round(fire_faults=False)
+        vals = {rep.commitment(tenant) for rep in self.alive()}
+        ok = len(vals) <= 1
+        if not ok:
+            if fault is not None:
+                self.quarantined[tenant] = fault
+                _QUARANTINED.set(len(self.quarantined))
+        elif fault is not None:
+            _RECOVERIES.inc()
+        return ok
+
+    # -------------------------------------------------- migration / failover
+
+    def migrate_tenant(
+        self, tenant: str, to_id: str, free_source_slot: bool = False
+    ) -> int:
+        """LIVE cross-replica tenant migration (`rebalance_tenant`
+        promoted across the mesh): drain so the destination is current,
+        broadcast a typed epoch-bumped `OwnershipHandoff`, and — with
+        ``free_source_slot=True`` on a device-backed source — release
+        the old owner's device slot (`DeviceSyncServer.release_tenant`;
+        the tenant stays servable there, host-resident).  Sessions are
+        re-routed by whoever routes them (`route`); returns the new
+        ownership epoch."""
+        dst = self.replicas[to_id]
+        if not dst.alive:
+            raise ValueError(f"cannot migrate {tenant!r} to dead replica {to_id!r}")
+        self.ensure_tenant(tenant)
+        src_id, epoch = self.owner[tenant]
+        if src_id == to_id:
+            return epoch
+        self.sync_round(fire_faults=False)
+        h = OwnershipHandoff(tenant, to_id, epoch + 1)
+        self._handoff(h)
+        self.sync_round(fire_faults=False)
+        if free_source_slot:
+            src = self.replicas[src_id]
+            release = getattr(src.server, "release_tenant", None)
+            if src.alive and release is not None:
+                release(tenant)
+        _MIGRATIONS.inc()
+        return h.epoch
+
+    def kill_replica(self, rid: str, drain: bool = True) -> int:
+        """Forced failover: (optionally) drain the mesh so the victim
+        holds nothing unique, mark it dead, drop its sessions with
+        `net.sessions_dropped{reason="failover"}`, hand its tenants'
+        ownership to the first survivor (typed, epoch-bumped), and close
+        the peers' ends of its links.  Returns the sessions dropped.
+        ``drain=False`` models an abrupt crash — updates the victim had
+        not yet replicated are LOST (CRDT convergence still holds among
+        survivors; the soak oracle will show the gap)."""
+        if rid not in self.replicas:
+            raise KeyError(f"unknown replica {rid!r}")
+        rep = self.replicas[rid]
+        if not rep.alive:
+            return 0
+        if len(self.alive()) <= 1:
+            raise ValueError(
+                f"cannot kill {rid!r}: it is the last alive replica"
+            )
+        if drain:
+            self.sync_round(fire_faults=False)
+        rep.alive = False
+        # close BOTH ends of the victim's links first — the victim-side
+        # sessions are mesh plumbing, not client sessions, so they must
+        # not count as failover drops (the metric's contract is "real
+        # sessions that must reconnect to a survivor"); the peer-side
+        # ends close so their outboxes don't grow until slow-consumer
+        # eviction
+        for key, link in list(self._links.items()):
+            if not link.covers(rid):
+                continue
+            if link.a.id == rid:
+                mine, other, osess = link.sess_a, link.b, link.sess_b
+            else:
+                mine, other, osess = link.sess_b, link.a, link.sess_a
+            rep.server.disconnect(mine)
+            other.server.disconnect(osess)
+            del self._links[key]
+            self._links_by_tenant[link.tenant].remove(link)
+        _LINKS.set(len(self._links))
+        dropped = 0
+        drop = getattr(rep.server, "drop_sessions", None)
+        if drop is not None:
+            dropped = drop("failover")
+        heirs = [r.id for r in self.alive()]
+        for tenant, (owner, epoch) in sorted(self.owner.items()):
+            if owner == rid and heirs:
+                self._handoff(OwnershipHandoff(tenant, heirs[0], epoch + 1))
+        _FAILOVERS.inc()
+        self.sync_round(fire_faults=False)
+        return dropped
+
+    # ----------------------------------------------------------- health plane
+
+    def health(self) -> Dict:
+        """`/healthz` section (ISSUE-13): quarantined tenants flip the
+        probe to degraded (`TelemetryServer.add_health_provider`)."""
+        return {
+            "replicas": {r.id: r.alive for r in self.replicas.values()},
+            "owners": {t: o for t, (o, _e) in sorted(self.owner.items())},
+            "quarantined_tenants": sorted(self.quarantined),
+            "degraded": bool(self.quarantined),
+        }
+
+    def attach_health(self, telemetry) -> None:
+        """Register this mesh on a `TelemetryServer`'s `/healthz` (and
+        `/snapshot`, same section name)."""
+        telemetry.add_health_provider("replica", self.health)
+        telemetry.add_provider("replica", self.health)
+
+
+# --------------------------------------------------------------------------
+# the original cross-process pod-to-pod bridge, on the hardened transport
+# --------------------------------------------------------------------------
+
+
 class ReplicaLink:
-    """Replicate one tenant between a local server and a remote pod."""
+    """Replicate one tenant between a local server and a remote pod over
+    TCP.  The link bridges a local in-process `Session` (obtained from
+    `SyncServer.connect_frames`, so the local server speaks its own
+    greeting — SyncStep1(sv) + awareness snapshot) to the remote pod's
+    endpoint (`ytpu.sync.net.serve`); frames flow both ways untouched.
+    Because only `connect_frames` / `receive_frames` / `drain` are used,
+    the same link replicates a plain host `SyncServer` and a
+    device-authoritative `DeviceSyncServer` without special cases.
+
+    Hardened-transport defaults (ISSUE-13 satellite — this path predated
+    the PR-6 net work): `connect()` dials with exponential backoff +
+    full jitter (`net.connect_retries`), every read runs under the
+    whole-frame deadline, and `reconnect()` redials the remembered
+    endpoint with a FRESH session whose greeting resyncs via the
+    state-vector handshake (`net.reconnects`).  For several replicas in
+    one process, prefer `ReplicaMesh` — it adds ownership, commitment
+    anti-entropy, and chaos scripting on top of the same frame flow."""
 
     def __init__(self, server: SyncServer, tenant: str):
         self.server = server
@@ -63,10 +890,23 @@ class ReplicaLink:
         self.session: Optional[Session] = None
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
+        self._endpoint: Optional[Tuple[str, int]] = None
 
-    async def connect(self, host: str, port: int) -> None:
-        """Dial the peer pod and run the symmetric greeting."""
-        self.reader, self.writer = await asyncio.open_connection(host, port)
+    async def connect(
+        self,
+        host: str,
+        port: int,
+        retries: int = 4,
+        backoff: float = 0.05,
+        backoff_max: float = 2.0,
+    ) -> None:
+        """Dial the peer pod (retry with backoff + jitter on refusal —
+        `net.connect_with_backoff`) and run the symmetric greeting."""
+        self.reader, self.writer = await connect_with_backoff(
+            host, port, retries=retries, backoff=backoff,
+            backoff_max=backoff_max,
+        )
+        self._endpoint = (host, port)
         write_frame(self.writer, self.tenant.encode("utf-8"))
         # local server's own greeting (SyncStep1 + awareness) goes first —
         # both sides open with step1, per the protocol.rs header contract
@@ -75,18 +915,46 @@ class ReplicaLink:
             write_frame(self.writer, frame)
         await self.writer.drain()
 
-    async def pump(self, max_frames: int = 64, timeout: float = 0.2) -> int:
+    async def reconnect(self, **connect_kw) -> None:
+        """Reconnect-with-resync after a dropped link (peer death,
+        eviction, `FrameTimeout`): tear down transport AND session, then
+        redial the remembered endpoint — the fresh greeting's SyncStep1
+        carries the local server's CURRENT state vector, so the peer's
+        SyncStep2 fills exactly the gap (`net.reconnects`)."""
+        if self._endpoint is None:
+            raise RuntimeError("reconnect before a successful connect")
+        host, port = self._endpoint
+        await self.close()
+        await self.connect(host, port, **connect_kw)
+        # net.py's cached child, NOT a fresh registry lookup: after a
+        # test-time metrics.reset() the two would be different objects
+        # and the reconnect series would tear across paths
+        _RECONNECTS.inc()
+
+    async def pump(
+        self,
+        max_frames: int = 64,
+        timeout: float = 0.2,
+        frame_timeout: Optional[float] = FRAME_DEADLINE,
+    ) -> int:
         """Process up to `max_frames` inbound frames, then flush outbox.
 
-        Returns the number of frames read. A `timeout` bounds the wait for
-        each frame's first byte, so a quiet peer never blocks the loop.
-        Raises ConnectionError when the peer closed (EOF) or when this
-        link's session was evicted as a slow consumer — a silent return
-        in either case would leave `run()` busy-spinning / the pods
-        silently diverging."""
+        Returns the number of frames read. `timeout` bounds the wait for
+        each frame's FIRST byte (a quiet peer never blocks the loop);
+        `frame_timeout` is the PR-6 whole-frame deadline — a peer that
+        stalls mid-frame raises `FrameTimeout` instead of hanging the
+        link (`reconnect()` is the recovery).  Raises ConnectionError
+        when the peer closed (EOF) or when this link's session was
+        evicted as a slow consumer — a silent return in either case
+        would leave `run()` busy-spinning / the pods silently
+        diverging."""
         n = 0
         while n < max_frames:
-            frame = await read_frame(self.reader, first_byte_timeout=timeout)
+            frame = await read_frame(
+                self.reader,
+                first_byte_timeout=timeout,
+                frame_timeout=frame_timeout,
+            )
             if frame is None:
                 if self.reader.at_eof():
                     raise ConnectionError("replica peer closed the link")

@@ -154,6 +154,24 @@ class SyncServer:
             t.sessions.remove(session)
             self._sessions_gauge.dec()
 
+    def drop_sessions(self, reason: str = "failover") -> int:
+        """Kill every live session at once (replica failover, shutdown):
+        each is marked dead, disconnected, and counted in
+        `net.sessions_dropped{reason=}` — the attribution a federated
+        soak needs to prove its sessions actually failed over rather
+        than idling (ISSUE-13).  Returns the number dropped; clients
+        recover by reconnecting (the state-vector handshake resyncs)."""
+        n = 0
+        dropped = self._dropped.labels(reason)
+        for t in list(self.tenants.values()):
+            for session in list(t.sessions):
+                session.dead = True
+                session.outbox = []
+                self.disconnect(session)
+                dropped.inc()
+                n += 1
+        return n
+
     # --- admission (ISSUE-9) ----------------------------------------------------
 
     def _tenant_queue_depth(self, tenant_name: str) -> int:

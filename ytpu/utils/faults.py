@@ -74,6 +74,27 @@ Standard sites (see docs/robustness.md for the full taxonomy):
 ``finisher.raise``    encode pipeline (ISSUE-10): raise in place of the
                       batched native finisher call for one sub-batch —
                       same serial per-doc demotion, byte output intact
+``replica.partition`` federation (ISSUE-13): partition one mesh link
+                      pair at the next sync round (args: ``a``/``b``
+                      replica ids, default the first alive pair) —
+                      frames DROP until a heal; anti-entropy skips the
+                      cut links
+``replica.heal``      federation (ISSUE-13): heal every partitioned
+                      link, queueing an SV-resync gossip both ways
+``replica.lag``       federation (ISSUE-13): defer one link pair's
+                      delivery (args: ``a``/``b``, ``rounds`` default
+                      2) — transit latency, nothing lost
+``replica.kill``      federation (ISSUE-13): kill a replica at the next
+                      sync round (args: ``replica`` id, default the
+                      last alive; ``drain=0`` skips the pre-kill drain
+                      so its unreplicated tail is LOST) — sessions drop
+                      with ``net.sessions_dropped{reason="failover"}``,
+                      ownership hands off to a survivor
+``commit.corrupt``    federation (ISSUE-13): XOR one tenant-commitment
+                      incremental fold (args: ``tenant`` restricts,
+                      ``xor`` overrides the mask) — simulated silent
+                      state divergence; the anti-entropy commitment
+                      check must catch it as a typed `DivergenceFault`
 ====================  =======================================================
 
 Every fired injection increments the ``faults.injected`` counter (plus a

@@ -140,6 +140,7 @@ class TelemetryServer:
         self._providers: Dict[str, Callable[[], object]] = dict(
             providers or {}
         )
+        self._health_providers: Dict[str, Callable[[], object]] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._t0 = time.time()
@@ -199,6 +200,16 @@ class TelemetryServer:
 
     def remove_provider(self, name: str) -> None:
         self._providers.pop(name, None)
+        self._health_providers.pop(name, None)
+
+    def add_health_provider(self, name: str, fn: Callable[[], object]) -> None:
+        """Register a named `/healthz` section (ISSUE-13): the section
+        merges into the healthz body, and a dict section carrying a
+        truthy ``"degraded"`` key flips the top-level ``status`` to
+        ``"degraded"`` — how the replica mesh surfaces quarantined
+        (divergent) tenants to a probe without the probe knowing the
+        mesh exists."""
+        self._health_providers[name] = fn
 
     def snapshot(self) -> Dict:
         """The `/snapshot` JSON body: metrics + phases + providers. A
@@ -247,4 +258,17 @@ class TelemetryServer:
             out["last_dispatch_age_s"] = round(
                 max(0.0, time.time() - last), 3
             )
+        for name, fn in list(self._health_providers.items()):
+            try:
+                section = fn()
+            except Exception as e:  # a provider bug must not kill the
+                # probe — but it must not mask a degraded signal either:
+                # a broken provider can no longer report, so degrade
+                section = {
+                    "error": f"{type(e).__name__}: {e}"[:200],
+                    "degraded": True,
+                }
+            out[name] = section
+            if isinstance(section, dict) and section.get("degraded"):
+                out["status"] = "degraded"
         return out
