@@ -112,6 +112,7 @@ TUNNEL_QUEUE = [
     "config5_diff_pipeline_pr10",
     "scan_two_tier_pr12",
     "federation_soak_pr13",
+    "fleet_canary_pr15",
 ]
 
 
@@ -1375,6 +1376,174 @@ def federation_dry_run() -> dict:
     }
 
 
+def fleet_dry_run() -> dict:
+    """CPU rehearsal of the fleet observability plane (ISSUE-15): the
+    acceptance surface for cross-replica tracing + aggregated mesh
+    telemetry + synthetic canary probing, asserted end to end —
+
+    - **cross-replica trace propagation**: a traced 3-replica federated
+      soak must leave a Chrome-trace dump in which at least one update's
+      trace id appears on spans from ≥2 DISTINCT replicas (the id rode
+      the wire trace-context extension across the peer links);
+    - **aggregated mesh telemetry**: a mid-run `/fleet` scrape (at 50%
+      of the schedule, while traffic is live) must carry all three
+      replicas' series under ``replica="rX"`` labels in one merged
+      exposition, and `/snapshot` must answer concurrently;
+    - **canary scoring**: the clean leg's per-replica availability must
+      be exactly 1.0 with a measured cross-replica read-your-writes lag;
+      a second leg arms ``replica.partition`` + ``replica.heal`` +
+      ``replica.kill`` (heal BEFORE kill via ``after=`` scheduling, so
+      survivors still converge) and availability must drop below 1.0
+      attributed to the killed replica — while every leg stays at byte
+      parity with the clean single-server oracle digest.
+
+    Headline keys: `canary_availability` (clean, must be 1.0) and
+    `canary_rw_lag_ms` (p99 read-your-writes propagation lag)."""
+    import urllib.request
+
+    from ytpu.serving import (
+        FederatedSoakDriver,
+        Scenario,
+        ScenarioConfig,
+        SoakDriver,
+    )
+    from ytpu.sync.device_server import DeviceSyncServer
+    from ytpu.sync.replica import ReplicaMesh
+    from ytpu.utils.faults import faults
+    from ytpu.utils.telemetry import TelemetryServer
+    from ytpu.utils.trace import tracer
+
+    cfg = ScenarioConfig(
+        n_tenants=3,
+        n_sessions=8,
+        events_per_session=8,
+        seed=int(os.environ.get("YTPU_BENCH_SOAK_SEED", "5")),
+    )
+
+    def replica():
+        return DeviceSyncServer(n_docs=4, capacity=256)
+
+    clean_oracle = SoakDriver(replica(), Scenario(cfg), flush_every=4).run()
+
+    # --- clean traced leg: propagation + /fleet merge + canary = 1.0 ---
+    mesh = ReplicaMesh([(f"r{i}", replica()) for i in range(3)])
+    telemetry = TelemetryServer(port=0)
+    mesh.attach_telemetry(telemetry)
+    telemetry.start()
+    scraped = {}
+
+    def probe():
+        base = f"http://127.0.0.1:{telemetry.port}"
+        scraped["fleet"] = (
+            urllib.request.urlopen(base + "/fleet", timeout=10)
+            .read()
+            .decode()
+        )
+        scraped["snapshot"] = json.loads(
+            urllib.request.urlopen(base + "/snapshot", timeout=10).read()
+        )
+
+    was_tracing = tracer.enabled
+    tracer.enabled = True
+    try:
+        tracer.clear()
+        rep = FederatedSoakDriver(
+            mesh,
+            Scenario(cfg),
+            sync_every=6,
+            anti_entropy_every=12,
+            canary_every=5,
+            probe_at=0.5,
+            probe=probe,
+        ).run()
+        trace_payload = json.loads(tracer.export_chrome_trace())
+    finally:
+        tracer.enabled = was_tracing
+        telemetry.stop()
+
+    # (a) one trace id must span ≥2 distinct replicas in the dump
+    by_trace: dict = {}
+    for ev in trace_payload["traceEvents"]:
+        args = ev.get("args") or {}
+        if args.get("trace"):
+            by_trace.setdefault(args["trace"], set()).add(
+                str(args.get("replica", ""))
+            )
+    multi_replica_traces = sum(
+        1
+        for reps in by_trace.values()
+        if len(reps - {"", "None"}) >= 2
+    )
+    assert multi_replica_traces >= 1, (
+        "no trace id crossed a replica boundary in the Chrome dump"
+    )
+    # (b) the mid-run /fleet merge carried every replica's series
+    assert "fleet" in scraped, "probe never fired"
+    for rid in ("r0", "r1", "r2"):
+        assert f'replica="{rid}"' in scraped["fleet"], scraped["fleet"]
+    assert "fleet_timeline" in scraped["snapshot"], scraped["snapshot"]
+    # (c) clean canary: perfect availability, measured rw lag, parity
+    canary = rep["canary"]
+    assert canary["availability_min"] == 1.0, canary
+    assert canary["rw_confirmed"] >= 1, canary
+    assert rep["converged"], rep
+    assert rep["state_digest"] == clean_oracle["state_digest"], (
+        "traced+canaried federated soak diverged from the PR-9 oracle"
+    )
+
+    # --- faulted leg: partition -> heal -> kill via the fault grammar ---
+    # `after=` staggers the sites across top-level sync rounds: the
+    # partition fires on round 1, the heal on round 2 (so the survivors
+    # re-converge), the kill on round 4 — late enough that remaining
+    # canary ticks keep probing the corpse and pull ITS gauge down
+    faults.clear()
+    faults.arm("replica.partition", n=1)
+    faults.arm("replica.heal", n=1, after=1)
+    faults.arm("replica.kill", n=1, after=3, replica="r2")
+    try:
+        faulted = FederatedSoakDriver(
+            ReplicaMesh([(f"r{i}", replica()) for i in range(3)]),
+            Scenario(cfg),
+            sync_every=6,
+            anti_entropy_every=12,
+            canary_every=4,
+        ).run()
+    finally:
+        faults.clear()
+    fc = faulted["canary"]
+    assert fc["availability"]["r2"] < 1.0, (
+        "killed replica's canary availability stayed 1.0 — no attribution"
+    )
+    assert fc["availability_min"] < 1.0, fc
+    assert faulted["converged"], faulted
+    assert faulted["state_digest"] == clean_oracle["state_digest"], (
+        "faulted canaried soak diverged from the PR-9 oracle digest"
+    )
+    return {
+        "replicas": rep["replicas"],
+        "multi_replica_traces": multi_replica_traces,
+        "trace_ids": len(by_trace),
+        "fleet_scrape_bytes": len(scraped["fleet"]),
+        "canary": {
+            "availability": canary["availability"],
+            "probes": canary["probes"],
+            "rw_confirmed": canary["rw_confirmed"],
+            "rw_p50_ms": canary["rw_p50_ms"],
+            "rw_p99_ms": canary["rw_p99_ms"],
+            "rw_lag_rounds_max": canary["rw_lag_rounds_max"],
+            "probe_p50_ms": canary["probe_p50_ms"],
+            "probe_p99_ms": canary["probe_p99_ms"],
+        },
+        "faulted_canary": {
+            "availability": fc["availability"],
+            "availability_min": fc["availability_min"],
+            "failures": fc["failures"],
+        },
+        "oracle_parity": True,
+        "state_digest": rep["state_digest"],
+    }
+
+
 def diff_overlap_dry_run(
     n_docs: int = 12, sub_batch: int = 4, depth: int = 2
 ) -> dict:
@@ -1951,6 +2120,60 @@ def _freshest_tpu_capture():
     }
 
 
+def _compare_baseline(out: dict, baseline: dict = None) -> dict:
+    """``--compare-baseline`` (ISSUE-15 satellite): diff THIS run's
+    one-line JSON against the freshest committed ``platform:"tpu"``
+    capture through `benches/bench_compare.py`'s directional semantics,
+    embedding the regressions/improvements summary and the tool's exit
+    status in the emitted JSON — a bench round carries its own "no worse
+    than last round" verdict instead of deferring it to eyeball work.
+    ``baseline`` overrides the capture lookup (tests).  Never raises:
+    a missing baseline or a tool error degrades to a status field."""
+    try:
+        if baseline is None:
+            freshest = _freshest_tpu_capture()
+            if freshest is None:
+                return {"status": "no_tpu_baseline", "exit_status": 0}
+            base_capture = freshest["capture"]
+            source = freshest["source"]
+        else:
+            base_capture = baseline
+            source = "<provided>"
+        benches_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benches"
+        )
+        if benches_dir not in sys.path:
+            sys.path.insert(0, benches_dir)
+        import bench_compare
+
+        # the bulky blobs diff as thousands of neutral leaves — compare
+        # the measurement surface, like the committed-capture lookup does
+        cand = {
+            k: v for k, v in out.items() if k not in ("phases", "metrics")
+        }
+        base = {
+            k: v
+            for k, v in base_capture.items()
+            if k not in ("phases", "metrics")
+        }
+        diff = bench_compare.compare(base, cand)
+        return {
+            "status": "compared",
+            "baseline_source": source,
+            "regressions": diff["regressions"],
+            "improvements_count": len(diff["improvements"]),
+            "changes_count": len(diff["changes"]),
+            "added_count": len(diff["added"]),
+            "removed_count": len(diff["removed"]),
+            "exit_status": 1 if diff["regressions"] else 0,
+        }
+    except Exception as e:  # the verdict must never sink the capture
+        return {
+            "status": f"error: {type(e).__name__}: {e}",
+            "exit_status": 2,
+        }
+
+
 # packed-state schema constants for the roofline model (kept host-side so
 # --roofline never imports jax): 26 i32 planes per block slot
 _ROOFLINE_NC = 26
@@ -2062,7 +2285,7 @@ def _lift_scan_width(out: dict) -> None:
             out[f"scan_{q}"] = st["value"]
 
 
-def main(dry_run: bool = False):
+def main(dry_run: bool = False, compare_baseline: bool = False):
     from ytpu.utils import metrics, phases
 
     phases.enable()
@@ -2193,10 +2416,21 @@ def main(dry_run: bool = False):
         out["federation_anti_entropy_bytes"] = out["federation"][
             "anti_entropy_bytes"
         ]
+        # fleet observability rehearsal (ISSUE-15): cross-replica trace
+        # propagation in the Chrome dump, the merged /fleet exposition
+        # scraped mid-run, and canary availability 1.0 clean / <1.0
+        # correctly attributed under an armed partition+heal+kill — all
+        # at byte parity with the clean oracle
+        with phases.span("host.fleet_rehearsal"):
+            out["fleet"] = fleet_dry_run()
+        out["canary_availability"] = out["fleet"]["canary"]["availability"]
+        out["canary_rw_lag_ms"] = out["fleet"]["canary"]["rw_p99_ms"]
         out["tunnel_queue"] = list(TUNNEL_QUEUE)
         out["phases"] = phases.snapshot()
         out["metrics"] = metrics.snapshot()
         _lift_scan_width(out)
+        if compare_baseline:
+            out["baseline_compare"] = _compare_baseline(out)
         print(json.dumps(out))
         return
 
@@ -2403,6 +2637,8 @@ def main(dry_run: bool = False):
         **metrics.snapshot(),
     }
     _lift_scan_width(out)
+    if compare_baseline:
+        out["baseline_compare"] = _compare_baseline(out)
     print(json.dumps(out))
 
 
@@ -2423,4 +2659,7 @@ if __name__ == "__main__":
         args = [a for a in sys.argv[1:] if a != "--roofline"]
         roofline_report(args[0] if args else None)
     else:
-        main(dry_run="--dry-run" in sys.argv[1:])
+        main(
+            dry_run="--dry-run" in sys.argv[1:],
+            compare_baseline="--compare-baseline" in sys.argv[1:],
+        )

@@ -11,6 +11,7 @@ compile for this file.
 import asyncio
 import json
 import os
+import time
 import urllib.request
 
 import pytest
@@ -418,3 +419,34 @@ def test_overlap_slots_carry_staged_update_ranges():
         assert 0 <= e["args"]["first"] <= e["args"]["last"] < len(log)
     covered = {(e["args"]["first"], e["args"]["last"]) for e in dispatches}
     assert covered == {(e["args"]["first"], e["args"]["last"]) for e in stages}
+
+
+def test_healthz_reports_never_before_first_dispatch():
+    """ISSUE-15 satellite regression: with BOTH last-dispatch gauges at
+    their 0.0 default (no dispatch ever happened), `/healthz` must say
+    ``last_dispatch: "never"`` and OMIT ``last_dispatch_age_s`` — an age
+    computed from epoch 0 reads ~56 years of false alarm.  The gauges
+    are saved/zeroed/restored in place (`metrics.reset()` would orphan
+    every cached metric object in the process)."""
+    sync_g = metrics.gauge("sync.last_dispatch_unix")
+    integ_g = metrics.gauge("integrate.last_dispatch_unix")
+    saved = (sync_g.value, integ_g.value)
+    try:
+        sync_g.set(0.0)
+        integ_g.set(0.0)
+        with TelemetryServer(port=0) as t:
+            status, body = _get(t.port, "/healthz")
+        assert status == 200
+        hz = json.loads(body)
+        assert hz["last_dispatch"] == "never", hz
+        assert "last_dispatch_age_s" not in hz, hz
+        # and once either gauge moves, the age replaces the marker
+        sync_g.set(time.time())
+        with TelemetryServer(port=0) as t:
+            _, body = _get(t.port, "/healthz")
+        hz = json.loads(body)
+        assert "last_dispatch" not in hz, hz
+        assert 0.0 <= hz["last_dispatch_age_s"] < 60.0, hz
+    finally:
+        sync_g.set(saved[0])
+        integ_g.set(saved[1])

@@ -9,8 +9,10 @@ from .admission import (
     RateLimited,
     TokenBucket,
 )
+from .canary import CanaryProber
 from .scenario import Event, Scenario, ScenarioConfig
 from .soak import (
+    CANARY_PREFIX,
     FederatedSoakDriver,
     SoakDriver,
     run_soak_tcp,
@@ -19,6 +21,8 @@ from .soak import (
 
 __all__ = [
     "AdmissionController",
+    "CANARY_PREFIX",
+    "CanaryProber",
     "Event",
     "FederatedSoakDriver",
     "Overload",

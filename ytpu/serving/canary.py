@@ -1,0 +1,304 @@
+"""Synthetic canary probing for a federated mesh (ISSUE-15 tentpole).
+
+Black-box monitoring closes the gap the white-box planes (metrics,
+traces, `/fleet`) cannot: a mesh whose every counter looks healthy can
+still be failing REAL requests.  `CanaryProber` runs one synthetic
+session against every replica and scripts the three protocol verbs a
+real client exercises — **apply** (a marker edit into the replica's own
+canary tenant), **diff** (a SyncStep1 carrying the empty state vector:
+the reply is the full diff, proving the read path answers) and
+**awareness** (an AwarenessQuery expecting the presence snapshot) — on a
+deterministic cadence, scoring:
+
+- **per-replica availability** (``canary.availability{replica=}``): the
+  fraction of probes that got the expected reply, 1.0 on a healthy
+  replica; a killed replica's probes fail and pull ITS gauge down —
+  attribution, not just detection;
+- **probe latency** (``canary.probe_latency`` histogram, windowed per
+  run for p50/p99);
+- **cross-replica read-your-writes lag**: every apply probe registers a
+  unique marker and `observe_round` watches for it on every OTHER alive
+  replica — the rounds (and wall seconds) until the last observer can
+  read the write is the mesh's end-to-end propagation lag
+  (``canary.rw_lag`` histogram + ``canary.rw_lag_rounds`` gauge).  A
+  marker unseen after ``rw_timeout_rounds`` is a FAILED probe charged to
+  the observer that couldn't read it.
+
+Canary tenants live under `CANARY_PREFIX` and are excluded from
+`server_state_digest` — synthetic traffic must never move the soak's
+byte-parity surface.  Each canary tenant is created owned by its
+replica and immediately `release_tenant`-ed everywhere (host-demoted),
+so canaries never compete with real tenants for device slots.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ytpu.core.doc import Doc
+from ytpu.core.state_vector import StateVector
+from ytpu.sync.protocol import Message, SyncMessage
+from ytpu.utils import metrics
+from ytpu.utils.slo import HistogramWindow, slo_report
+from ytpu.utils.trace import trace_context, tracer
+
+from .soak import CANARY_PREFIX, _server_tenant_text
+
+__all__ = ["CanaryProber"]
+
+#: canary writer ids sit far above any scenario client_base so a canary
+#: edit can never collide with scripted traffic in the client interner
+CLIENT_BASE = 900_000_000
+
+_PROBES = metrics.counter("canary.probes", labelnames=("replica",))
+_FAILURES = metrics.counter("canary.failures", labelnames=("replica",))
+_AVAILABILITY = metrics.gauge("canary.availability", labelnames=("replica",))
+_PROBE_HIST = metrics.histogram("canary.probe_latency")
+_RW_HIST = metrics.histogram("canary.rw_lag")
+_RW_ROUNDS = metrics.gauge("canary.rw_lag_rounds")
+_RW_TIMEOUTS = metrics.counter("canary.rw_timeouts")
+
+
+class CanaryProber:
+    """One synthetic session per mesh replica, probing apply/diff/
+    awareness on a deterministic cadence (see module docstring)."""
+
+    def __init__(self, mesh, root: str = "text", rw_timeout_rounds: int = 8):
+        self.mesh = mesh
+        self.root = root
+        self.rw_timeout_rounds = max(1, rw_timeout_rounds)
+        self.seq = 0
+        self.rounds = 0
+        self._probes: Dict[str, int] = {}
+        self._failures: Dict[str, int] = {}
+        self._pending: List[Dict] = []  # unconfirmed read-your-writes
+        self._rw_rounds: List[int] = []
+        self._rw_wall_s: List[float] = []
+        self._docs: Dict[str, Doc] = {}
+        self._sessions: Dict[str, object] = {}
+        # per-run windows over the (process-cumulative) canary histograms
+        self._probe_w = HistogramWindow(_PROBE_HIST)
+        self._rw_w = HistogramWindow(_RW_HIST)
+        # one canary tenant per replica, owned by it, host-demoted
+        # everywhere immediately: creating then releasing SEQUENTIALLY
+        # keeps at most one device slot in flight, so canaries fit even
+        # when the scenario tenants fill n_docs - 1 slots
+        for rid in sorted(mesh.replicas):
+            tenant = self.tenant_of(rid)
+            mesh.ensure_tenant(tenant, owner=rid)
+            for rep in mesh.alive():
+                release = getattr(rep.server, "release_tenant", None)
+                if release is not None:
+                    release(tenant)
+        for i, rid in enumerate(sorted(mesh.replicas)):
+            self._docs[rid] = Doc(client_id=CLIENT_BASE + i)
+            self._probes[rid] = 0
+            self._failures[rid] = 0
+            _AVAILABILITY.labels(rid).set(1.0)
+
+    @staticmethod
+    def tenant_of(rid: str) -> str:
+        return f"{CANARY_PREFIX}:{rid}"
+
+    # --- session plumbing ------------------------------------------------------
+
+    def _session(self, rep):
+        """The canary's session on `rep` (reconnecting when the replica
+        restarted or slow-consumer eviction killed it)."""
+        sess = self._sessions.get(rep.id)
+        if sess is None or sess.dead:
+            sess, _greet = rep.server.connect_frames(self.tenant_of(rep.id))
+            self._sessions[rep.id] = sess
+        return sess
+
+    def _fail(self, rid: str) -> None:
+        self._failures[rid] = self._failures.get(rid, 0) + 1
+        _FAILURES.labels(rid).inc()
+
+    def _score(self, rid: str) -> None:
+        probes = self._probes.get(rid, 0)
+        fails = self._failures.get(rid, 0)
+        avail = 1.0 - (fails / probes) if probes else 1.0
+        _AVAILABILITY.labels(rid).set(round(avail, 6))
+
+    # --- the probes ------------------------------------------------------------
+
+    def _marker(self, rid: str) -> str:
+        return f"[c{self.seq}:{rid}]"
+
+    def _probe_apply(self, rep) -> bool:
+        """Insert a unique marker into the replica's canary tenant and
+        register the read-your-writes watch on every other alive
+        replica.  The update is captured from a local writer doc (the
+        client idiom) and shipped as a wire update frame."""
+        doc = self._docs[rep.id]
+        marker = self._marker(rep.id)
+        captured: List[bytes] = []
+        unsub = doc.observe_update_v1(lambda p, o, t: captured.append(p))
+        try:
+            txt = doc.get_text(self.root)
+            with doc.transact() as txn:
+                txt.insert(txn, 0, marker)
+        finally:
+            unsub()
+        if not captured:
+            return False
+        frame = Message.sync(SyncMessage.update(captured[0])).encode_v1()
+        sess = self._session(rep)
+        rep.server.receive_frames(sess, frame)
+        observers = [r.id for r in self.mesh.alive() if r.id != rep.id]
+        if observers:
+            self._pending.append(
+                {
+                    "tenant": self.tenant_of(rep.id),
+                    "marker": marker,
+                    "owner": rep.id,
+                    "observers": observers,
+                    "round0": self.rounds,
+                    "t0": time.perf_counter(),
+                }
+            )
+        return True
+
+    def _probe_diff(self, rep) -> bool:
+        """SyncStep1 with the EMPTY state vector: the reply must carry
+        the full diff (step2), proving the encode/read path serves."""
+        frame = Message.sync(SyncMessage.step1(StateVector())).encode_v1()
+        sess = self._session(rep)
+        replies = rep.server.receive_frames(sess, frame)
+        return bool(replies)
+
+    def _probe_awareness(self, rep) -> bool:
+        frame = Message.awareness_query().encode_v1()
+        sess = self._session(rep)
+        replies = rep.server.receive_frames(sess, frame)
+        return bool(replies)
+
+    def tick(self) -> None:
+        """One probe pass: every replica gets the current verb (the verb
+        cycles apply → diff → awareness per tick, so a soak's cadence
+        exercises all three against all replicas).  A dead replica's
+        probe fails by definition — that IS the availability signal."""
+        self.seq += 1
+        kind = ("apply", "diff", "awareness")[self.seq % 3]
+        probe = {
+            "apply": self._probe_apply,
+            "diff": self._probe_diff,
+            "awareness": self._probe_awareness,
+        }[kind]
+        for rid in sorted(self.mesh.replicas):
+            rep = self.mesh.replicas[rid]
+            self._probes[rid] = self._probes.get(rid, 0) + 1
+            _PROBES.labels(rid).inc()
+            with trace_context(replica=rid, tenant=self.tenant_of(rid)), \
+                    tracer.span("canary.probe", replica=rid, kind=kind,
+                                seq=self.seq):
+                if not rep.alive:
+                    self._fail(rid)
+                    self._score(rid)
+                    continue
+                t0 = time.perf_counter()
+                try:
+                    ok = probe(rep)
+                except Exception:
+                    ok = False
+                _PROBE_HIST.observe(time.perf_counter() - t0)
+                if not ok:
+                    self._fail(rid)
+            self._score(rid)
+
+    # --- read-your-writes ------------------------------------------------------
+
+    def observe_round(self) -> None:
+        """Called after every mesh sync round: each pending marker is
+        read back on its observer replicas; the lag (rounds + wall
+        seconds) from write to the LAST observer's read is the mesh's
+        propagation cost.  Markers older than ``rw_timeout_rounds``
+        charge a failure to each observer that never saw them."""
+        self.rounds += 1
+        still: List[Dict] = []
+        for p in self._pending:
+            remaining = []
+            for rid in p["observers"]:
+                rep = self.mesh.replicas.get(rid)
+                if rep is None or not rep.alive:
+                    continue  # dead observers are scored by tick()
+                try:
+                    text = _server_tenant_text(
+                        rep.server, p["tenant"], self.root
+                    )
+                except KeyError:
+                    text = ""
+                if p["marker"] not in text:
+                    remaining.append(rid)
+            if not remaining:
+                lag_rounds = self.rounds - p["round0"]
+                lag_s = time.perf_counter() - p["t0"]
+                self._rw_rounds.append(lag_rounds)
+                self._rw_wall_s.append(lag_s)
+                _RW_HIST.observe(lag_s)
+                _RW_ROUNDS.set(lag_rounds)
+                continue
+            if self.rounds - p["round0"] > self.rw_timeout_rounds:
+                _RW_TIMEOUTS.inc()
+                for rid in remaining:
+                    self._fail(rid)
+                    self._score(rid)
+                continue
+            p["observers"] = remaining
+            still.append(p)
+        self._pending = still
+
+    # --- scoring / export ------------------------------------------------------
+
+    def availability(self) -> Dict[str, float]:
+        out = {}
+        for rid in sorted(self._probes):
+            probes = self._probes[rid]
+            fails = self._failures.get(rid, 0)
+            out[rid] = round(1.0 - fails / probes, 6) if probes else 1.0
+        return out
+
+    def report(self) -> Dict:
+        avail = self.availability()
+        rep: Dict = {
+            "probes": sum(self._probes.values()),
+            "failures": sum(self._failures.values()),
+            "availability": avail,
+            "availability_min": min(avail.values()) if avail else 1.0,
+            "rw_confirmed": len(self._rw_rounds),
+            "rw_pending": len(self._pending),
+            "rw_lag_rounds_max": max(self._rw_rounds, default=0),
+            "rw_lag_ms_max": round(
+                max(self._rw_wall_s, default=0.0) * 1e3, 3
+            ),
+            **slo_report(self._probe_w, 0.0, "probe_"),
+            **slo_report(self._rw_w, 0.0, "rw_"),
+        }
+        return rep
+
+    def health(self) -> Dict:
+        """`/healthz` provider section: degraded when any replica's
+        availability dropped below 1.0 or a read-your-writes watch
+        timed out."""
+        avail = self.availability()
+        degraded = sorted(r for r, a in avail.items() if a < 1.0)
+        return {
+            "degraded": bool(degraded),
+            "degraded_replicas": degraded,
+            "availability": avail,
+            "probes": sum(self._probes.values()),
+            "rw_pending": len(self._pending),
+        }
+
+    def attach(self, telemetry) -> None:
+        telemetry.add_provider("canary", self.health)
+
+    def close(self) -> None:
+        """Disconnect the canary sessions (alive replicas only)."""
+        for rid, sess in list(self._sessions.items()):
+            rep = self.mesh.replicas.get(rid)
+            if rep is not None and rep.alive and not sess.dead:
+                rep.server.disconnect(sess)
+        self._sessions = {}

@@ -52,16 +52,27 @@ from ytpu.sync.protocol import (
 )
 from ytpu.utils import metrics
 from ytpu.utils.faults import faults
-from ytpu.utils.slo import HistogramWindow, slo_report
+from ytpu.utils.slo import (
+    HistogramWindow,
+    slo_report,
+    window_prometheus_text,
+)
+from ytpu.utils.trace import trace_context, tracer
 
 from .scenario import Scenario
 
 __all__ = [
+    "CANARY_PREFIX",
     "FederatedSoakDriver",
     "SoakDriver",
     "run_soak_tcp",
     "server_state_digest",
 ]
+
+#: synthetic canary tenants (`ytpu.serving.canary.CanaryProber`) live
+#: under this prefix and are EXCLUDED from `server_state_digest` — probe
+#: traffic must never move the soak byte-parity surface
+CANARY_PREFIX = "__canary"
 
 
 def server_state_digest(server, root: str) -> str:
@@ -70,12 +81,16 @@ def server_state_digest(server, root: str) -> str:
     sorted state vector, hashed.  Two servers that land byte-equal
     digests hold byte-equal observable tenant states: the soak parity
     surface, shared by `SoakDriver` and the federated soak (every mesh
-    replica must land the clean single-server run's digest)."""
+    replica must land the clean single-server run's digest).  Canary
+    tenants (`CANARY_PREFIX`) are skipped: synthetic probe traffic is
+    per-replica by design and must stay off the parity surface."""
     flush = getattr(server, "flush_device", None)
     if flush is not None:
         flush()
     h = hashlib.sha256()
     for t in sorted(server.tenants):
+        if t.startswith(CANARY_PREFIX):
+            continue
         h.update(t.encode())
         h.update(_server_tenant_text(server, t, root).encode())
         sv = server.tenant_state_vector(t)
@@ -158,6 +173,13 @@ class SoakDriver:
 
             self.telemetry = TelemetryServer(port=telemetry_port)
             self.telemetry.add_provider("soak", self._live_slo)
+            # the run's SLO windows as REAL Prometheus histograms on
+            # `/metrics` (ISSUE-15 satellite): an external scraper
+            # computes its own windowed quantiles from the buckets
+            # instead of trusting the p50/p99 gauges
+            self.telemetry.add_exposition(
+                "soak_windows", self._window_exposition
+            )
             self.telemetry.start()
 
     def _live_slo(self) -> Dict:
@@ -180,6 +202,20 @@ class SoakDriver:
             **slo_report(e2e_w, floor_s, "apply_e2e_"),
             **slo_report(diff_w, floor_s, "diff_"),
         }
+
+    def _window_exposition(self) -> str:
+        """The current run's SLO windows rendered as Prometheus
+        histogram families (`window_prometheus_text`) for `/metrics`.
+        Empty before/after a run — the families exist only while their
+        windows do."""
+        if self._live is None:
+            return ""
+        apply_w, e2e_w, diff_w, _floor = self._live
+        return (
+            window_prometheus_text("soak_window_apply", apply_w)
+            + window_prometheus_text("soak_window_apply_e2e", e2e_w)
+            + window_prometheus_text("soak_window_diff", diff_w)
+        )
 
     # --- plumbing --------------------------------------------------------------
 
@@ -556,6 +592,9 @@ class FederatedSoakDriver:
         recover_divergence: bool = True,
         max_converge_rounds: int = 32,
         max_busy_retries: int = 8,
+        canary_every: Optional[int] = None,
+        probe_at: Optional[float] = None,
+        probe=None,
     ):
         self.mesh = mesh
         self.scenario = scenario
@@ -572,6 +611,17 @@ class FederatedSoakDriver:
         self.recover_divergence = recover_divergence
         self.max_converge_rounds = max(1, max_converge_rounds)
         self.max_busy_retries = max(0, max_busy_retries)
+        #: synthetic canary cadence (ISSUE-15): every ``canary_every``
+        #: events the `CanaryProber` runs one probe pass against every
+        #: replica; None disables probing entirely
+        self.canary_every = canary_every
+        #: mid-soak observation hook (the `SoakDriver.probe_at`
+        #: discipline): at fraction ``probe_at`` of the event schedule,
+        #: ``probe()`` is called — the fleet rehearsal scrapes the live
+        #: `/fleet` endpoint there, mid-run by construction
+        self.probe_at = probe_at
+        self.probe = probe
+        self.canary = None  # CanaryProber while run() is live
         self._sessions: Dict[int, tuple] = {}  # sid -> (replica_id, Session)
         self._counts: Dict[str, int] = {}
 
@@ -612,7 +662,23 @@ class FederatedSoakDriver:
         return target.server, sess
 
     def _handle(self, ev) -> None:
+        """Route + serve one event, under a fresh trace when the tracer
+        is live: the ambient trace id minted here rides the broadcast
+        trace frames across every peer link the update crosses, so one
+        client edit is followable replica-to-replica in the Chrome dump
+        (the ISSUE-15 cross-replica propagation surface)."""
         server, sess = self._session(ev)
+        if not tracer.enabled:
+            self._handle_inner(ev, server, sess)
+            return
+        rid = self._sessions.get(ev.session, (None,))[0]
+        with trace_context(tenant=ev.tenant, session=ev.session,
+                           replica=rid):
+            with tracer.span("soak.event", kind=ev.kind, tenant=ev.tenant,
+                             replica=rid):
+                self._handle_inner(ev, server, sess)
+
+    def _handle_inner(self, ev, server, sess) -> None:
         if ev.kind == "apply":
             frame = Message.sync(SyncMessage.update(ev.payload)).encode_v1()
             for _ in range(self.max_busy_retries + 1):
@@ -677,6 +743,14 @@ class FederatedSoakDriver:
         root = scenario.config.root
         before = self._counter_deltas()
         self._counts = {}
+        # the canary's tenants are created (and host-demoted) BEFORE the
+        # scenario tenants claim their device slots: create-then-release
+        # keeps at most one slot in flight, so probing never steals a
+        # slot a real tenant needs
+        if self.canary_every is not None:
+            from .canary import CanaryProber
+
+            self.canary = CanaryProber(mesh, root=root)
         # tenant-sharded hot-doc ownership: deterministic round-robin
         # over the alive replicas (typed epoch-bumped handoffs)
         ids = [r.id for r in mesh.alive()]
@@ -693,6 +767,7 @@ class FederatedSoakDriver:
         heal_idx = idx(self.heal_at)
         failover_idx = idx(self.failover_at)
         migrate_idx = idx(self.migrate_at)
+        probe_idx = idx(self.probe_at) if self.probe is not None else None
         t_start = time.perf_counter()
         for i, ev in enumerate(schedule):
             if partition_idx is not None and i == partition_idx:
@@ -719,13 +794,23 @@ class FederatedSoakDriver:
                 dst = self.migrate_to or (others[-1] if others else None)
                 if dst is not None:
                     mesh.migrate_tenant(hot, dst)
+            if probe_idx is not None and i == probe_idx:
+                self.probe()
             self._handle(ev)
             self._bump("events")
+            if (
+                self.canary is not None
+                and (i + 1) % self.canary_every == 0
+            ):
+                self.canary.tick()
+                self._bump("canary_ticks")
             if (i + 1) % self.flush_every == 0:
                 mesh.flush_devices()
                 self._drain_all()
             if (i + 1) % self.sync_every == 0:
                 mesh.sync_round()
+                if self.canary is not None:
+                    self.canary.observe_round()
             if (i + 1) % self.anti_entropy_every == 0:
                 mesh.anti_entropy_round()
         # convergence epilogue: sync + anti-entropy (recovering any
@@ -737,6 +822,10 @@ class FederatedSoakDriver:
         while converge_rounds < self.max_converge_rounds:
             converge_rounds += 1
             mesh.sync_round(fire_faults=False)
+            if self.canary is not None:
+                # pending read-your-writes watches must resolve (or time
+                # out, attributed) before the run is scored
+                self.canary.observe_round()
             mesh.anti_entropy_round()
             if mesh.quarantined and self.recover_divergence:
                 for tenant in sorted(mesh.quarantined):
@@ -759,7 +848,11 @@ class FederatedSoakDriver:
         after = self._counter_deltas()
         delta = {k: after[k] - before[k] for k in after}
         applied = self._counts.get("applied", 0)
-        return {
+        canary_report = None
+        if self.canary is not None:
+            canary_report = self.canary.report()
+            self.canary.close()
+        out = {
             "replicas": len(mesh.replicas),
             "replicas_alive": len(mesh.alive()),
             "sessions": len(scenario.sessions),
@@ -784,6 +877,9 @@ class FederatedSoakDriver:
             ],
             **{k: v for k, v in sorted(self._counts.items())},
         }
+        if canary_report is not None:
+            out["canary"] = canary_report
+        return out
 
 
 def run_soak_tcp(

@@ -276,14 +276,18 @@ class DeviceSyncServer(SyncServer):
                     self._enqueue(slot, sub.payload)
                     self._applied.inc()
                     t.applied.inc()
+                    self.applied_local += 1
                     # broadcast at-least-once (idempotent CRDT updates;
                     # the host path dedups via observer events, the device
                     # path trades that for never touching a host doc)
                     frame = Message.sync(
                         SyncMessage.update(sub.payload)
                     ).encode_v1()
+                    tframe = self._trace_frame()
                     for other in t.sessions:
                         if other is not session:
+                            if tframe is not None:
+                                other.push(tframe)
                             other.push(frame)
                 continue
             reply = self.protocol.handle_message(t.awareness, msg)

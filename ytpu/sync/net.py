@@ -30,15 +30,19 @@ from typing import Optional, Tuple
 
 from ytpu.encoding.lib0 import EncodingError, Writer
 from ytpu.sync.protocol import (
+    MSG_TRACE,
     Message,
     PermissionDenied,
     SyncMessage,
     UnsupportedMessage,
+    decode_trace,
     message_reader,
+    trace_message,
 )
 from ytpu.sync.server import DeviceBatchFull, SyncServer
 from ytpu.utils import metrics, trace_context, tracer
 from ytpu.utils.faults import faults
+from ytpu.utils.trace import current_trace, resume_trace
 
 # transport series (module-cached children: zero lookups per frame)
 _FRAMES_IN = metrics.counter("net.frames_in")
@@ -240,6 +244,7 @@ async def serve(
             for frame in greeting:
                 write_frame(writer, frame)
             await writer.drain()
+            pending_trace = None  # wire trace ctx riding ahead of one frame
             while True:
                 frame = await read_frame(
                     reader,
@@ -249,14 +254,36 @@ async def serve(
                 if frame is None:
                     if reader.at_eof():
                         break
+                elif frame and frame[0] == MSG_TRACE:
+                    # wire trace-context extension (ISSUE-15): consumed
+                    # at the transport, applies to the NEXT frame only —
+                    # the frame that follows re-enters the sender's
+                    # trace instead of minting a fresh id
+                    if tracer.enabled:
+                        try:
+                            _v, _tid, _torigin = decode_trace(
+                                next(message_reader(frame)).body
+                            )
+                            pending_trace = (_tid, _torigin)
+                        except Exception:
+                            pending_trace = None
                 else:
                     # end-to-end request tracing (ISSUE-11): ONE trace id
                     # per inbound frame, carried by the ambient context
                     # through admission → apply/queue → device dispatch →
                     # reply, so a YTPU_TRACE dump shows the frame's full
                     # host-side life. Disabled tracer = shared no-op
-                    # context, zero per-frame allocation.
-                    with trace_context(tenant=tenant, session=session.id):
+                    # context, zero per-frame allocation.  A wire trace
+                    # context that preceded this frame resumes the
+                    # SENDER's id (ISSUE-15 cross-replica propagation).
+                    tr, pending_trace = pending_trace, None
+                    if tr is not None and tracer.enabled:
+                        tctx = resume_trace(
+                            tr[0], tr[1], tenant=tenant, session=session.id
+                        )
+                    else:
+                        tctx = trace_context(tenant=tenant, session=session.id)
+                    with tctx:
                         try:
                             with tracer.span("net.frame", bytes=len(frame)):
                                 replies = server.receive_frames(
@@ -401,6 +428,19 @@ class SyncClient:
         def on_update(payload: bytes, origin, txn) -> None:
             if origin == "net":
                 return  # do not echo remote updates back
+            if tracer.enabled:
+                # ship the ambient trace id ahead of the update
+                # (ISSUE-15): the server resumes it around the apply,
+                # and every peer rebroadcast carries it onward
+                ctx = current_trace()
+                if ctx is not None:
+                    write_frame(
+                        self.writer,
+                        trace_message(
+                            str(ctx.get("trace", "")),
+                            str(ctx.get("replica", "") or ""),
+                        ).encode_v1(),
+                    )
             write_frame(
                 self.writer,
                 Message.sync(SyncMessage.update(payload)).encode_v1(),

@@ -30,6 +30,9 @@ __all__ = [
     "MSG_BUSY",
     "MSG_COMMIT",
     "MSG_OWNERSHIP",
+    "MSG_TRACE",
+    "PROTOCOL_VERSION",
+    "TRACE_WIRE_VERSION",
     "busy_message",
     "decode_busy",
     "commit_message",
@@ -37,6 +40,8 @@ __all__ = [
     "OwnershipHandoff",
     "ownership_message",
     "decode_ownership",
+    "trace_message",
+    "decode_trace",
     "MSG_SYNC_STEP_1",
     "MSG_SYNC_STEP_2",
     "MSG_SYNC_UPDATE",
@@ -75,6 +80,28 @@ MSG_BUSY = 4
 # unknown-tag Message they may ignore.
 MSG_COMMIT = 5
 MSG_OWNERSHIP = 6
+# ytpu fleet-observability extension (ISSUE-15): an optional trace-context
+# frame carrying the ambient trace id across replica links and real
+# sockets.  A trace frame stands alone and applies to the IMMEDIATELY
+# FOLLOWING frame only — transports that understand it re-enter the
+# originating `trace_context()` around that next frame, so one Chrome
+# trace shows a single update's id from the client frame through the
+# owner replica to every peer rebroadcast.  Body: lib0
+# [var_uint ext_version][string trace id][string origin replica id].
+# Backward compatible on both sides: emission is gated on the peer
+# protocol's `version` (old peers are never sent one), and
+# `Protocol.handle_message` ignores the tag unconditionally (a stray
+# trace frame reaching an old-style handler is dropped, never fatal).
+MSG_TRACE = 7
+
+#: current wire-protocol version of this build; `Protocol(version=1)`
+#: models a pre-fleet peer (tolerates trace frames, never emits them)
+PROTOCOL_VERSION = 2
+#: first protocol version whose peers may be sent MSG_TRACE frames
+TRACE_WIRE_VERSION = 2
+#: version field inside the trace-frame body (room for richer context —
+#: baggage, sampling flags — without a new message tag)
+TRACE_EXT_VERSION = 1
 
 PERMISSION_DENIED = 0
 PERMISSION_GRANTED = 1
@@ -284,6 +311,23 @@ def decode_ownership(body: bytes) -> OwnershipHandoff:
     )
 
 
+def trace_message(trace: str, origin: str = "") -> Message:
+    """Trace-context extension frame (ISSUE-15): the ambient trace id
+    plus the replica id it is crossing FROM.  Applies to the next frame
+    only; see the MSG_TRACE tag comment for the compatibility contract."""
+    w = Writer()
+    w.write_var_uint(TRACE_EXT_VERSION)
+    w.write_string(trace)
+    w.write_string(origin)
+    return Message.custom(MSG_TRACE, w.to_bytes())
+
+
+def decode_trace(body: bytes) -> Tuple[int, str, str]:
+    """(ext_version, trace id, origin replica id) from a trace body."""
+    cur = Cursor(body)
+    return cur.read_var_uint(), cur.read_string(), cur.read_string()
+
+
 def message_reader(data: bytes) -> Iterator[Message]:
     """Iterate over messages packed one after another (parity: MessageReader,
     protocol.rs:312-330)."""
@@ -295,7 +339,16 @@ def message_reader(data: bytes) -> Iterator[Message]:
 class Protocol:
     """Default y-sync handlers (parity: protocol.rs:42-135). Subclass to
     customize (e.g. auth); `handle_message` dispatches one incoming message
-    and returns an optional reply."""
+    and returns an optional reply.
+
+    ``version`` is the wire-protocol version this peer SPEAKS — it gates
+    what extensions other endpoints may send it (a ``version=1`` peer is
+    never sent MSG_TRACE frames).  Tolerance is not gated: every Protocol
+    ignores stray trace frames regardless of version, which is what lets
+    a trace-annotated stream round-trip through an old peer unharmed."""
+
+    def __init__(self, version: int = PROTOCOL_VERSION):
+        self.version = version
 
     def start(self, awareness: Awareness) -> bytes:
         """Connection opening: SyncStep1(local sv) + awareness snapshot."""
@@ -373,4 +426,9 @@ class Protocol:
             return self.handle_awareness_query(awareness)
         if msg.kind == MSG_AWARENESS:
             return self.handle_awareness_update(awareness, msg.body)
+        if msg.kind == MSG_TRACE:
+            # forward-compat contract: trace frames are advisory context,
+            # never content — any handler that sees one (transports
+            # normally intercept them first) drops it without reply
+            return None
         return self.missing_handle(awareness, msg.kind, msg.body)

@@ -66,6 +66,8 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import time
+from collections import deque
 from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
 
 from ytpu.sync.commitment import TenantCommitments
@@ -80,18 +82,21 @@ from ytpu.sync.protocol import (
     MSG_BUSY,
     MSG_COMMIT,
     MSG_OWNERSHIP,
+    MSG_TRACE,
     Message,
     OwnershipHandoff,
     SyncMessage,
     commit_message,
     decode_commit,
     decode_ownership,
+    decode_trace,
     message_reader,
     ownership_message,
 )
 from ytpu.sync.server import Session, SyncServer
 from ytpu.utils import metrics
 from ytpu.utils.faults import faults
+from ytpu.utils.trace import resume_trace, tracer
 
 __all__ = [
     "DivergenceFault",
@@ -123,6 +128,19 @@ _FRAMES_DROPPED = metrics.counter(
 )
 _FRAMES_DEDUPED = metrics.counter("replica.frames_deduped")
 _LINK_RESYNCS = metrics.counter("replica.link_resyncs")
+# fleet-observability round gauges (ISSUE-15): the most recent top-level
+# sync round's duration/volume, the most recent anti-entropy round's
+# mismatch count, and the per-tenant convergence lag (anti-entropy rounds
+# since the tenant's last CLEAN pass — 0 is steady state)
+_ROUND_MS = metrics.gauge("replica.round_ms")
+_ROUND_FRAMES = metrics.gauge("replica.round_frames")
+_ROUND_MISMATCHES = metrics.gauge("replica.round_mismatches")
+_CONV_LAG = metrics.gauge("replica.convergence_lag", labelnames=("tenant",))
+
+#: event-timeline ring capacity (`ReplicaMesh.timeline`): enough to hold
+#: every ownership/migration/quarantine event of a long chaos soak while
+#: keeping the `/snapshot` section bounded
+TIMELINE_CAP = 512
 
 
 class DivergenceFault(RuntimeError):
@@ -299,9 +317,26 @@ class _PeerLink:
         n = nb = 0
         back = self._to_a if end == "b" else self._to_b
         sess = self.sess_b if end == "b" else self.sess_a
+        pending = None  # decoded trace context riding ahead of one frame
         for frame in frames:
             n += 1
             nb += len(frame)
+            tr, pending = pending, None
+            if frame and frame[0] == MSG_TRACE:
+                # wire trace-context extension (ISSUE-15): applies to
+                # the IMMEDIATELY FOLLOWING frame only — consumed at the
+                # link layer, never forwarded to the server (and dropped
+                # when the next frame dedups away, so it can never leak
+                # onto an unrelated frame)
+                if tracer.enabled:
+                    try:
+                        _v, tid, origin = decode_trace(
+                            next(message_reader(frame)).body
+                        )
+                        pending = (tid, origin)
+                    except Exception:
+                        pass
+                continue
             if self.mesh._handle_mesh_frame(frame, src, dst):
                 continue  # commit/ownership: the mesh's, not the server's
             if frame and frame[0] == MSG_BUSY:
@@ -324,7 +359,19 @@ class _PeerLink:
             # awareness frames have no admission gate.
             is_update = key is not None and frame[0] == 0
             before = dst.server._applied.value if is_update else 0
-            back.extend(dst.server.receive_frames(sess, frame))
+            if tr is not None and tracer.enabled:
+                # re-enter the sender's trace around the delivery: the
+                # receiver's apply span AND any onward rebroadcast
+                # (which re-emits the trace frame with THIS replica as
+                # origin) join the id the client frame started
+                with resume_trace(
+                    tr[0], tr[1], replica=dst.id, tenant=self.tenant
+                ), tracer.span(
+                    "replica.deliver", replica=dst.id, peer=src.id
+                ):
+                    back.extend(dst.server.receive_frames(sess, frame))
+            else:
+                back.extend(dst.server.receive_frames(sess, frame))
             if key is not None and not sess.dead:
                 if not is_update or dst.server._applied.value > before:
                     dst.mark_payload(key)
@@ -399,10 +446,28 @@ class ReplicaMesh:
         #: must be born partitioned, or frames would cross the split
         self._partitioned_pairs: Set[FrozenSet[str]] = set()
         self._ae_seq = 0
+        #: bounded ownership/migration/quarantine event timeline
+        #: (ISSUE-15): each entry is {"seq", "t", "kind", "tenant"?, ...}
+        #: — the `/snapshot` section `fleet_timeline` and the operator's
+        #: "what happened to this tenant" answer
+        self.timeline: deque = deque(maxlen=TIMELINE_CAP)
+        self._timeline_seq = 0
+        #: tenant -> anti-entropy rounds since its last CLEAN pass
+        self._conv_lag: Dict[str, int] = {}
         for t in tenants:
             self.ensure_tenant(t)
 
     # ------------------------------------------------------------ topology
+
+    def _record_event(self, kind: str, tenant: Optional[str] = None,
+                      **detail) -> None:
+        """Append one control-plane event to the bounded timeline ring."""
+        self._timeline_seq += 1
+        ev: Dict = {"seq": self._timeline_seq, "t": time.time(), "kind": kind}
+        if tenant is not None:
+            ev["tenant"] = tenant
+        ev.update(detail)
+        self.timeline.append(ev)
 
     def alive(self) -> List[MeshReplica]:
         return [r for r in self.replicas.values() if r.alive]
@@ -502,15 +567,21 @@ class ReplicaMesh:
         if cur is not None and h.epoch <= cur[1]:
             return False
         self.owner[h.tenant] = (h.owner, h.epoch)
+        self._record_event(
+            "ownership", h.tenant, owner=h.owner, epoch=h.epoch
+        )
         return True
 
     def _handoff(self, h: OwnershipHandoff, broadcast: bool = True) -> None:
-        self._apply_handoff(h)
-        if broadcast:
-            frame = ownership_message(h).encode_v1()
-            for link in self._tenant_links(h.tenant):
-                link.post(frame, link.a)
-                link.post(frame, link.b)
+        with tracer.span(
+            "replica.handoff", tenant=h.tenant, owner=h.owner, epoch=h.epoch
+        ):
+            self._apply_handoff(h)
+            if broadcast:
+                frame = ownership_message(h).encode_v1()
+                for link in self._tenant_links(h.tenant):
+                    link.post(frame, link.a)
+                    link.post(frame, link.b)
 
     def assign_owner(self, tenant: str, rid: str) -> int:
         """Shard one tenant onto a replica (typed epoch-bumping handoff,
@@ -576,6 +647,7 @@ class ReplicaMesh:
                 n += 1
         if n or newly:
             _PARTITIONS.inc()
+            self._record_event("partition", a=a, b=b, links=n)
         return n
 
     def heal(self, a: Optional[str] = None, b: Optional[str] = None) -> int:
@@ -604,6 +676,7 @@ class ReplicaMesh:
                     cleared += 1
         if n or cleared:
             _HEALS.inc()
+            self._record_event("heal", a=a, b=b, links=n)
         return n
 
     def lag(self, a: str, b: str, rounds: int = 2) -> int:
@@ -632,20 +705,24 @@ class ReplicaMesh:
         if fire_faults:
             self._fire_fault_sites()
         _SYNC_ROUNDS.inc()
-        self.flush_devices()
+        t0 = time.perf_counter()
         frames = nbytes = passes = 0
-        while passes < max_passes:
-            moved = mbytes = 0
-            for link in list(self._links.values()):
-                n, nb = link.flow()
-                moved += n
-                mbytes += nb
-            passes += 1
-            frames += moved
-            nbytes += mbytes
-            if moved == 0:
-                break
+        with tracer.span("replica.sync_round", replicas=len(self.alive())):
             self.flush_devices()
+            while passes < max_passes:
+                moved = mbytes = 0
+                for link in list(self._links.values()):
+                    n, nb = link.flow()
+                    moved += n
+                    mbytes += nb
+                passes += 1
+                frames += moved
+                nbytes += mbytes
+                if moved == 0:
+                    break
+                self.flush_devices()
+        _ROUND_MS.set(round((time.perf_counter() - t0) * 1e3, 3))
+        _ROUND_FRAMES.set(frames)
         return {"frames": frames, "bytes": nbytes, "passes": passes}
 
     def _pump_link(self, link: _PeerLink, max_passes: int = 16) -> Tuple[int, int]:
@@ -681,59 +758,83 @@ class ReplicaMesh:
         rnd = self._ae_seq
         for tenant in sorted(self.owner):
             if tenant in self.quarantined:
+                # quarantined tenants are unverifiable by definition —
+                # their convergence lag keeps growing until recovery
+                self._bump_convergence_lag(tenant, clean=False)
                 continue
             rep["tenants"] += 1
+            clean = True
             for link in self._tenant_links(tenant):
                 if link.partitioned:
+                    clean = False
                     continue  # cannot anti-entropy across a partition
                 a, b = link.a, link.b
-                ca = a.commitment(tenant)
-                cb = b.commitment(tenant)
-                fa = commit_message(tenant, ca, round_=rnd).encode_v1()
-                fb = commit_message(tenant, cb, round_=rnd).encode_v1()
-                link.post(fa, b)
-                link.post(fb, a)
-                _, nb = self._pump_link(link)
-                rep["bytes"] += nb
-                got_b = self._commit_inbox.pop((b.id, a.id, tenant), None)
-                got_a = self._commit_inbox.pop((a.id, b.id, tenant), None)
-                if (
-                    got_b is None or got_b[0] != rnd
-                    or got_a is None or got_a[0] != rnd
+                with tracer.span(
+                    "replica.anti_entropy",
+                    tenant=tenant, a=a.id, b=b.id, round=rnd,
                 ):
-                    # probe lost, or a STALE one surfaced (deferred by
-                    # replica.lag and delivered rounds late)
-                    rep["unconverged"] += 1
-                    continue
-                rep["compared"] += 1
-                if got_b[1] == cb and got_a[1] == ca:
-                    continue  # agreement: O(1), done
-                _MISMATCHES.inc()
-                rep["mismatches"] += 1
-                link.gossip()
-                _, nb = self._pump_link(link)
-                rep["bytes"] += nb
-                rep["pulled"] += 1
-                ca2 = a.commitment(tenant)
-                cb2 = b.commitment(tenant)
-                if ca2 == cb2:
-                    continue  # the pull repaired it
-                sva = sorted(a.server.tenant_state_vector(tenant))
-                svb = sorted(b.server.tenant_state_vector(tenant))
-                if sva != svb:
-                    rep["unconverged"] += 1  # sync gap, not divergence
-                    continue
-                fault = DivergenceFault(tenant, a.id, b.id, ca2, cb2)
-                self.quarantined[tenant] = fault
-                self.divergences.append(fault)
-                _DIVERGENCES.inc()
-                _QUARANTINED.set(len(self.quarantined))
-                rep["divergences"] += 1
-                if strict:
-                    raise fault
-                break  # tenant quarantined: skip its remaining links
+                    ca = a.commitment(tenant)
+                    cb = b.commitment(tenant)
+                    fa = commit_message(tenant, ca, round_=rnd).encode_v1()
+                    fb = commit_message(tenant, cb, round_=rnd).encode_v1()
+                    link.post(fa, b)
+                    link.post(fb, a)
+                    _, nb = self._pump_link(link)
+                    rep["bytes"] += nb
+                    got_b = self._commit_inbox.pop((b.id, a.id, tenant), None)
+                    got_a = self._commit_inbox.pop((a.id, b.id, tenant), None)
+                    if (
+                        got_b is None or got_b[0] != rnd
+                        or got_a is None or got_a[0] != rnd
+                    ):
+                        # probe lost, or a STALE one surfaced (deferred by
+                        # replica.lag and delivered rounds late)
+                        rep["unconverged"] += 1
+                        clean = False
+                        continue
+                    rep["compared"] += 1
+                    if got_b[1] == cb and got_a[1] == ca:
+                        continue  # agreement: O(1), done
+                    _MISMATCHES.inc()
+                    rep["mismatches"] += 1
+                    clean = False
+                    link.gossip()
+                    _, nb = self._pump_link(link)
+                    rep["bytes"] += nb
+                    rep["pulled"] += 1
+                    ca2 = a.commitment(tenant)
+                    cb2 = b.commitment(tenant)
+                    if ca2 == cb2:
+                        continue  # the pull repaired it
+                    sva = sorted(a.server.tenant_state_vector(tenant))
+                    svb = sorted(b.server.tenant_state_vector(tenant))
+                    if sva != svb:
+                        rep["unconverged"] += 1  # sync gap, not divergence
+                        continue
+                    fault = DivergenceFault(tenant, a.id, b.id, ca2, cb2)
+                    self.quarantined[tenant] = fault
+                    self.divergences.append(fault)
+                    _DIVERGENCES.inc()
+                    _QUARANTINED.set(len(self.quarantined))
+                    self._record_event("quarantine", tenant, a=a.id, b=b.id)
+                    rep["divergences"] += 1
+                    if strict:
+                        raise fault
+                    break  # tenant quarantined: skip its remaining links
+            self._bump_convergence_lag(tenant, clean=clean)
+        _ROUND_MISMATCHES.set(rep["mismatches"])
         _AE_BYTES.inc(rep["bytes"])
         return rep
+
+    def _bump_convergence_lag(self, tenant: str, clean: bool) -> None:
+        """Track convergence-lag = anti-entropy rounds since the
+        tenant's last CLEAN pass (every healthy link compared and
+        agreed).  0 is steady state; a growing value is a tenant the
+        mesh cannot currently verify — partition, probe loss, or
+        quarantine (`replica.convergence_lag{tenant=}`)."""
+        lag = 0 if clean else self._conv_lag.get(tenant, 0) + 1
+        self._conv_lag[tenant] = lag
+        _CONV_LAG.labels(tenant).set(lag)
 
     def recover_tenant(self, tenant: str) -> bool:
         """Recovery for a quarantined tenant: authoritative commitment
@@ -756,6 +857,7 @@ class ReplicaMesh:
                 _QUARANTINED.set(len(self.quarantined))
         elif fault is not None:
             _RECOVERIES.inc()
+            self._record_event("recovery", tenant)
         return ok
 
     # -------------------------------------------------- migration / failover
@@ -778,16 +880,22 @@ class ReplicaMesh:
         src_id, epoch = self.owner[tenant]
         if src_id == to_id:
             return epoch
-        self.sync_round(fire_faults=False)
-        h = OwnershipHandoff(tenant, to_id, epoch + 1)
-        self._handoff(h)
-        self.sync_round(fire_faults=False)
-        if free_source_slot:
-            src = self.replicas[src_id]
-            release = getattr(src.server, "release_tenant", None)
-            if src.alive and release is not None:
-                release(tenant)
+        with tracer.span(
+            "replica.migrate", tenant=tenant, src=src_id, dst=to_id
+        ):
+            self.sync_round(fire_faults=False)
+            h = OwnershipHandoff(tenant, to_id, epoch + 1)
+            self._handoff(h)
+            self.sync_round(fire_faults=False)
+            if free_source_slot:
+                src = self.replicas[src_id]
+                release = getattr(src.server, "release_tenant", None)
+                if src.alive and release is not None:
+                    release(tenant)
         _MIGRATIONS.inc()
+        self._record_event(
+            "migration", tenant, src=src_id, dst=to_id, epoch=h.epoch
+        )
         return h.epoch
 
     def kill_replica(self, rid: str, drain: bool = True) -> int:
@@ -808,6 +916,11 @@ class ReplicaMesh:
             raise ValueError(
                 f"cannot kill {rid!r}: it is the last alive replica"
             )
+        with tracer.span("replica.failover", replica=rid, drain=int(drain)):
+            return self._kill_replica(rid, drain)
+
+    def _kill_replica(self, rid: str, drain: bool) -> int:
+        rep = self.replicas[rid]
         if drain:
             self.sync_round(fire_faults=False)
         rep.alive = False
@@ -838,6 +951,10 @@ class ReplicaMesh:
             if owner == rid and heirs:
                 self._handoff(OwnershipHandoff(tenant, heirs[0], epoch + 1))
         _FAILOVERS.inc()
+        self._record_event(
+            "failover", replica=rid, dropped=dropped,
+            heir=heirs[0] if heirs else None,
+        )
         self.sync_round(fire_faults=False)
         return dropped
 
@@ -858,6 +975,48 @@ class ReplicaMesh:
         `/snapshot`, same section name)."""
         telemetry.add_health_provider("replica", self.health)
         telemetry.add_provider("replica", self.health)
+
+    def timeline_events(self) -> List[Dict]:
+        """The ownership/migration/quarantine event timeline (bounded
+        ring, oldest first) — the `/snapshot` section `fleet_timeline`."""
+        return list(self.timeline)
+
+    def replica_snapshot(self, rid: str) -> Dict[str, float]:
+        """One replica's numeric state for the merged `/fleet`
+        exposition.  Registry counters are process-global (in-proc
+        replicas share them), so everything here reads SERVER-LOCAL
+        state — the per-instance tally `SyncServer.applied_local`, the
+        replica's own tenant/session maps, and the shared ownership map
+        filtered to this replica."""
+        rep = self.replicas[rid]
+        owned = [t for t, (o, _e) in self.owner.items() if o == rid]
+        return {
+            "replica.alive": 1.0 if rep.alive else 0.0,
+            "replica.tenants": float(len(rep.server.tenants)),
+            "replica.sessions": float(
+                sum(len(t.sessions) for t in rep.server.tenants.values())
+            ),
+            "replica.owned_tenants": float(len(owned)),
+            "replica.updates_applied": float(
+                getattr(rep.server, "applied_local", 0)
+            ),
+            "replica.quarantined_owned": float(
+                sum(1 for t in owned if t in self.quarantined)
+            ),
+        }
+
+    def attach_telemetry(self, telemetry) -> None:
+        """Full fleet-observability attach (ISSUE-15): `/healthz` +
+        `/snapshot` health sections (`attach_health`), the event
+        timeline as `/snapshot` section ``fleet_timeline``, and one
+        `/fleet` source per replica — the merged exposition labels every
+        family with ``replica="<id>"``."""
+        self.attach_health(telemetry)
+        telemetry.add_provider("fleet_timeline", self.timeline_events)
+        for rid in self.replicas:
+            telemetry.add_fleet_source(
+                rid, lambda rid=rid: self.replica_snapshot(rid)
+            )
 
 
 # --------------------------------------------------------------------------
@@ -949,6 +1108,7 @@ class ReplicaLink:
         would leave `run()` busy-spinning / the pods silently
         diverging."""
         n = 0
+        pending = None  # wire trace context riding ahead of one frame
         while n < max_frames:
             frame = await read_frame(
                 self.reader,
@@ -959,7 +1119,26 @@ class ReplicaLink:
                 if self.reader.at_eof():
                     raise ConnectionError("replica peer closed the link")
                 break
-            for reply in self.server.receive_frames(self.session, frame):
+            tr, pending = pending, None
+            if frame and frame[0] == MSG_TRACE:
+                # trace-context extension (ISSUE-15): consumed here,
+                # applies to the next frame only
+                if tracer.enabled:
+                    try:
+                        _v, tid, origin = decode_trace(
+                            next(message_reader(frame)).body
+                        )
+                        pending = (tid, origin)
+                    except Exception:
+                        pass
+                n += 1
+                continue
+            if tr is not None and tracer.enabled:
+                with resume_trace(tr[0], tr[1], tenant=self.tenant):
+                    replies = self.server.receive_frames(self.session, frame)
+            else:
+                replies = self.server.receive_frames(self.session, frame)
+            for reply in replies:
                 write_frame(self.writer, reply)
             n += 1
         if self.session is not None and self.session.dead:

@@ -19,9 +19,17 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ytpu.core import Doc
 from ytpu.utils import trace_span
+from ytpu.utils.trace import current_trace, tracer
 
 from .awareness import Awareness
-from .protocol import Message, Protocol, SyncMessage, message_reader
+from .protocol import (
+    TRACE_WIRE_VERSION,
+    Message,
+    Protocol,
+    SyncMessage,
+    message_reader,
+    trace_message,
+)
 
 __all__ = ["DeviceBatchFull", "SyncServer", "Session"]
 
@@ -100,6 +108,10 @@ class SyncServer:
             "net.sessions_dropped", labelnames=("reason",)
         )
         self._busy_replies = metrics.counter("sync.busy_replies")
+        #: per-INSTANCE applied count (the registry counters above are
+        #: process-global — N in-proc mesh replicas share them, so the
+        #: `/fleet` per-replica exposition needs a server-local tally)
+        self.applied_local = 0
         #: optional `ytpu.serving.AdmissionController` consulted per
         #: inbound update; None (default) admits everything — the
         #: pre-ISSUE-9 behavior, zero cost on the hot path
@@ -117,12 +129,31 @@ class SyncServer:
             # live update broadcast: one observer per tenant doc
             def broadcast(payload: bytes, origin, txn, _name=name):
                 frame = Message.sync(SyncMessage.update(payload)).encode_v1()
+                tframe = self._trace_frame()
                 for session in self.tenants[_name].sessions:
                     if origin is not session:
+                        if tframe is not None:
+                            session.push(tframe)
                         session.push(frame)
 
             doc.observe_update_v1(broadcast)
         return t
+
+    def _trace_frame(self) -> Optional[bytes]:
+        """The wire trace-context frame to push IMMEDIATELY BEFORE a
+        rebroadcast update (ISSUE-15), or None when tracing is off / no
+        request context is ambient / this server speaks a pre-trace
+        protocol version (emission is version-gated; tolerance is not)."""
+        if not tracer.enabled:
+            return None
+        if getattr(self.protocol, "version", 1) < TRACE_WIRE_VERSION:
+            return None
+        ctx = current_trace()
+        if ctx is None:
+            return None
+        return trace_message(
+            str(ctx.get("trace", "")), str(ctx.get("replica", "") or "")
+        ).encode_v1()
 
     def doc(self, name: str) -> Doc:
         return self.tenant(name).awareness.doc
@@ -249,6 +280,7 @@ class SyncServer:
                     )
                 applied.inc()
                 t.applied.inc()
+                self.applied_local += 1
                 continue
             if msg.kind == 1:  # Awareness: apply + broadcast to others
                 t.awareness.apply_update(msg.body)

@@ -22,9 +22,9 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-from ytpu.utils.metrics import Histogram
+from ytpu.utils.metrics import Histogram, _sanitize
 
-__all__ = ["HistogramWindow", "slo_report"]
+__all__ = ["HistogramWindow", "slo_report", "window_prometheus_text"]
 
 
 class HistogramWindow:
@@ -81,6 +81,32 @@ class HistogramWindow:
             return 0.0
         last = max((b for b, c in enumerate(counts) if c), default=0)
         return Histogram.bucket_upper_s(last)
+
+
+def window_prometheus_text(name: str, window: HistogramWindow) -> str:
+    """Render one `HistogramWindow` as a REAL Prometheus histogram
+    exposition (ISSUE-15 satellite): ``<name>_bucket{le=...}`` cumulative
+    counts over the window's delta, ``<name>_bucket{le="+Inf"}``,
+    ``<name>_sum`` (seconds) and ``<name>_count`` — the same bucket
+    bounds and line shapes `MetricsRegistry.prometheus_text` emits for
+    cumulative histograms, so an external scraper computes arbitrary
+    windowed quantiles instead of trusting the p50/p99 gauges.  The
+    name is sanitized like every registry family (dots → underscores).
+    An empty window still emits the +Inf/_sum/_count triplet (a scraper
+    must see the family exists)."""
+    counts, n, sum_us = window._delta()
+    sname = _sanitize(name)
+    lines = [f"# TYPE {sname} histogram"]
+    acc = 0
+    last = max((b for b, c in enumerate(counts) if c), default=-1)
+    for b in range(last + 1):
+        acc += counts[b]
+        le = Histogram.bucket_upper_s(b)
+        lines.append(f'{sname}_bucket{{le="{le:.9g}"}} {acc}')
+    lines.append(f'{sname}_bucket{{le="+Inf"}} {n}')
+    lines.append(f"{sname}_sum {sum_us / 1e6:.9g}")
+    lines.append(f"{sname}_count {n}")
+    return "\n".join(lines) + "\n"
 
 
 def slo_report(

@@ -6,11 +6,15 @@ phase timers only surfaced in `bench.py`'s one-line JSON after the run
 ended. `TelemetryServer` is the missing listener — a stdlib
 `http.server` on its OWN daemon thread, so a soak, a serving pod, or a
 long replay is watchable live while the main thread stays on the data
-path. Three endpoints:
+path. Four endpoints:
 
 - ``/metrics`` — Prometheus text exposition 0.0.4, straight from
   `MetricsRegistry.prometheus_text()` (so a real Prometheus scrape
-  works unmodified);
+  works unmodified), plus any registered extra exposition blocks
+  (`add_exposition` — e.g. the soak driver's windowed SLO histograms);
+- ``/fleet`` — every registered fleet source (`add_fleet_source`; one
+  per mesh replica via `ReplicaMesh.attach_telemetry`) merged into ONE
+  labeled exposition, ``replica="r0"`` per series (ISSUE-15);
 - ``/snapshot`` — one JSON object merging `metrics.snapshot()`,
   `phases.snapshot()` and any registered *providers* (e.g. the soak
   driver's live SLO windows, a device server's slot/queue view);
@@ -54,7 +58,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
-from .metrics import metrics
+from .metrics import _escape, _sanitize, metrics
 from .phases import phases
 
 __all__ = ["TelemetryServer"]
@@ -89,7 +93,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(
                     200,
                     "text/plain; version=0.0.4; charset=utf-8",
-                    metrics.prometheus_text().encode("utf-8"),
+                    self.telemetry.metrics_text().encode("utf-8"),
+                )
+            elif path == "/fleet":
+                _SCRAPES.labels("fleet").inc()
+                self._reply(
+                    200,
+                    "text/plain; version=0.0.4; charset=utf-8",
+                    self.telemetry.fleet_text().encode("utf-8"),
                 )
             elif path == "/snapshot":
                 _SCRAPES.labels("snapshot").inc()
@@ -141,6 +152,15 @@ class TelemetryServer:
             providers or {}
         )
         self._health_providers: Dict[str, Callable[[], object]] = {}
+        #: `/fleet` sources (ISSUE-15): replica name -> zero-arg callable
+        #: returning {metric name: value}; merged into one labeled
+        #: exposition (`replica="<name>"`) by `fleet_text`
+        self._fleet_sources: Dict[str, Callable[[], Dict[str, float]]] = {}
+        #: extra Prometheus text appended to `/metrics` (ISSUE-15
+        #: satellite): name -> zero-arg callable returning exposition
+        #: lines — how the soak driver publishes its windowed
+        #: `HistogramWindow` series as real histogram expositions
+        self._expositions: Dict[str, Callable[[], str]] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._t0 = time.time()
@@ -202,6 +222,23 @@ class TelemetryServer:
         self._providers.pop(name, None)
         self._health_providers.pop(name, None)
 
+    def add_fleet_source(
+        self, name: str, fn: Callable[[], Dict[str, float]]
+    ) -> None:
+        """Register (or replace) one replica's `/fleet` source: a
+        zero-arg callable returning ``{metric name: numeric value}``
+        (ISSUE-15; `ReplicaMesh.attach_telemetry` registers one per
+        replica)."""
+        self._fleet_sources[name] = fn
+
+    def remove_fleet_source(self, name: str) -> None:
+        self._fleet_sources.pop(name, None)
+
+    def add_exposition(self, name: str, fn: Callable[[], str]) -> None:
+        """Register (or replace) a named block of extra Prometheus text
+        appended to `/metrics` after the registry exposition."""
+        self._expositions[name] = fn
+
     def add_health_provider(self, name: str, fn: Callable[[], object]) -> None:
         """Register a named `/healthz` section (ISSUE-13): the section
         merges into the healthz body, and a dict section carrying a
@@ -210,6 +247,61 @@ class TelemetryServer:
         (divergent) tenants to a probe without the probe knowing the
         mesh exists."""
         self._health_providers[name] = fn
+
+    def metrics_text(self) -> str:
+        """The `/metrics` body: the registry exposition plus every
+        registered extra exposition block (a raising block is skipped —
+        the scrape must outlive its tenants' bugs)."""
+        body = metrics.prometheus_text()
+        for name in sorted(self._expositions):
+            fn = self._expositions.get(name)
+            if fn is None:
+                continue
+            try:
+                extra = fn()
+            except Exception:
+                continue
+            if extra:
+                body += extra if extra.endswith("\n") else extra + "\n"
+        return body
+
+    def fleet_text(self) -> str:
+        """The `/fleet` body (ISSUE-15): every fleet source's families
+        merged into ONE exposition, each series labeled with its
+        replica (``replica="r0"``).  Merge rules: families are unioned
+        across sources and emitted sorted, one ``# TYPE <family> gauge``
+        header per family with all replicas' series contiguous under it
+        (valid Prometheus text exposition); metric names are sanitized
+        exactly like the registry's (dots → underscores); a RAISING
+        source degrades to a ``fleet_source_error{replica=...}`` series
+        instead of failing the scrape."""
+        fams: Dict[str, list] = {}
+        errors = []
+        for name in sorted(self._fleet_sources):
+            fn = self._fleet_sources.get(name)
+            if fn is None:
+                continue
+            try:
+                vals = fn()
+            except Exception:
+                errors.append(name)
+                continue
+            for key in sorted(vals):
+                fams.setdefault(_sanitize(key), []).append(
+                    (name, float(vals[key]))
+                )
+        lines = []
+        for fam in sorted(fams):
+            lines.append(f"# TYPE {fam} gauge")
+            for rep, v in fams[fam]:
+                lines.append(f'{fam}{{replica="{_escape(rep)}"}} {v:.9g}')
+        if errors:
+            lines.append("# TYPE fleet_source_error gauge")
+            for name in errors:
+                lines.append(
+                    f'fleet_source_error{{replica="{_escape(name)}"}} 1'
+                )
+        return "\n".join(lines) + ("\n" if lines else "")
 
     def snapshot(self) -> Dict:
         """The `/snapshot` JSON body: metrics + phases + providers. A
@@ -258,6 +350,11 @@ class TelemetryServer:
             out["last_dispatch_age_s"] = round(
                 max(0.0, time.time() - last), 3
             )
+        else:
+            # the gauges default to 0 when NO dispatch ever happened —
+            # an age computed from that epoch would read ~56 years.  Say
+            # "never" explicitly and omit the age (ISSUE-15 satellite)
+            out["last_dispatch"] = "never"
         for name, fn in list(self._health_providers.items()):
             try:
                 section = fn()

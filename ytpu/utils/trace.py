@@ -49,6 +49,7 @@ __all__ = [
     "current_trace",
     "current_trace_id",
     "new_trace_id",
+    "resume_trace",
 ]
 
 DEFAULT_MAX_EVENTS = 65536
@@ -117,6 +118,18 @@ def trace_context(trace: Optional[str] = None, **fields):
     if trace is None:
         trace = new_trace_id()
     return _TraceContext({"trace": trace, **fields})
+
+
+def resume_trace(trace: str, origin: str = "", **fields):
+    """Re-enter a trace context that crossed a process/replica boundary
+    (ISSUE-15 fleet tracing): transports decoding a wire trace-context
+    extension call this with the carried id + originating replica id, so
+    every span the delivered frame's processing emits joins the SAME
+    Chrome-trace id the sender started.  ``origin`` (when non-empty)
+    rides the spans as an ``origin`` arg unless the caller overrides it."""
+    if origin:
+        fields.setdefault("origin", origin)
+    return trace_context(trace=trace, **fields)
 
 
 class _Span:
