@@ -113,6 +113,7 @@ TUNNEL_QUEUE = [
     "scan_two_tier_pr12",
     "federation_soak_pr13",
     "fleet_canary_pr15",
+    "autopilot_soak_pr16",
 ]
 
 
@@ -1544,6 +1545,148 @@ def fleet_dry_run() -> dict:
     }
 
 
+def autopilot_dry_run() -> dict:
+    """CPU rehearsal of the closed-loop fleet autopilot (ISSUE-16):
+    the same 3-replica chaos soak (partition + heal, tight admission,
+    a replica retired at 80% of the schedule) scored twice —
+
+    - **autopilot OFF**: the tight ``max_queue=1`` admission bound
+      Busy-storms the client path and the retirement is an ABRUPT
+      ``failover_at`` kill (sessions drop with ``reason="failover"``,
+      the canary charges the corpse);
+    - **autopilot ON**: the controller relaxes the queue bound when it
+      sees the sustained Busy-rate (adaptive admission) and replaces
+      the abrupt kill with a scripted maintenance drain
+      (``schedule_drain``: migrate every owned tenant away, decommission,
+      THEN kill — zero sessions dropped, no availability dent).
+
+    Acceptance: the ON leg must beat the OFF leg on BOTH the e2e
+    apply p99_adj and the min canary availability, both legs' surviving
+    replicas must hold byte parity with the clean single-server oracle,
+    the drained kill must drop zero sessions, and two same-seed ON runs
+    must produce byte-identical action journals (the determinism
+    contract — docs/serving.md §Autopilot).
+
+    Headline keys: `autopilot_actions` (neutral),
+    `autopilot_p99_adj_delta` (on − off ms, regresses on RISE) and
+    `autopilot_availability_delta` (on − off, regresses on DROP)."""
+    from ytpu.serving import (
+        AdmissionController,
+        FederatedSoakDriver,
+        FleetAutopilot,
+        Scenario,
+        ScenarioConfig,
+        SoakDriver,
+    )
+    from ytpu.sync.device_server import DeviceSyncServer
+    from ytpu.sync.replica import ReplicaMesh
+    from ytpu.utils.faults import faults
+
+    cfg = ScenarioConfig(
+        n_tenants=3,
+        n_sessions=8,
+        events_per_session=24,
+        seed=int(os.environ.get("YTPU_BENCH_SOAK_SEED", "5")),
+    )
+    total_events = cfg.n_sessions * cfg.events_per_session
+
+    def replica():
+        return DeviceSyncServer(n_docs=4, capacity=256)
+
+    oracle = SoakDriver(replica(), Scenario(cfg), flush_every=4).run()[
+        "state_digest"
+    ]
+
+    def leg(autopilot_on: bool):
+        faults.clear()
+        faults.arm("replica.partition", n=1)
+        faults.arm("replica.heal", n=1, after=1)
+        mesh = ReplicaMesh([(f"r{i}", replica()) for i in range(3)])
+        adm = AdmissionController(max_queue=1)
+        ap = None
+        kw = {}
+        if autopilot_on:
+            ap = FleetAutopilot(mesh, admission=adm, seed=7)
+            # retire r2 at the same 80% point the off leg kills it, but
+            # as a scripted drain (tick cadence = autopilot_every events)
+            ap.schedule_drain("r2", int(total_events * 0.8) // 4)
+        else:
+            kw = dict(failover_at=0.8, failover_replica="r2")
+        try:
+            rep = FederatedSoakDriver(
+                mesh,
+                Scenario(cfg),
+                flush_every=4,
+                sync_every=4,
+                anti_entropy_every=12,
+                canary_every=4,
+                admission=adm,
+                autopilot=ap,
+                autopilot_every=4,
+                **kw,
+            ).run()
+        finally:
+            faults.clear()
+        return rep, ap
+
+    off, _ = leg(False)
+    on, ap1 = leg(True)
+    on2, ap2 = leg(True)
+
+    for name, rep in (("off", off), ("on", on)):
+        assert rep["converged"], (name, rep)
+        assert rep["state_digest"] == oracle, (
+            f"autopilot {name} leg diverged from the clean oracle digest"
+        )
+    # the controller must WIN on both scored axes, not just act
+    p99_delta = round(
+        on["apply_e2e_p99_ms_adj"] - off["apply_e2e_p99_ms_adj"], 3
+    )
+    avail_delta = round(
+        on["canary"]["availability_min"]
+        - off["canary"]["availability_min"],
+        6,
+    )
+    assert p99_delta < 0, (
+        f"autopilot-on e2e p99_adj did not beat off: {p99_delta:+}ms"
+    )
+    assert avail_delta > 0, (
+        f"autopilot-on availability did not beat off: {avail_delta:+}"
+    )
+    assert on["canary"]["availability_min"] == 1.0, on["canary"]
+    # the drained kill dropped zero sessions (satellite: a planned
+    # maintenance kill is not a failure)
+    kills = [
+        e
+        for e in ap1.journal
+        if e["policy"] == "maintenance" and e["action"] == "kill"
+    ]
+    assert kills and kills[0]["outcome"]["sessions_dropped"] == 0, kills
+    # determinism: same seed + same scenario = byte-identical journal
+    assert ap1.journal_bytes() == ap2.journal_bytes(), (
+        "same-seed autopilot runs produced different action journals"
+    )
+    assert on2["state_digest"] == oracle
+    return {
+        "actions": ap1.report()["actions"],
+        "actions_by_policy": ap1.report()["actions_by_policy"],
+        "journal_digest": ap1.journal_digest(),
+        "p99_adj_delta_ms": p99_delta,
+        "availability_delta": avail_delta,
+        "off": {
+            "busy_replies": off.get("busy_replies", 0),
+            "p99_adj_ms": off["apply_e2e_p99_ms_adj"],
+            "availability_min": off["canary"]["availability_min"],
+        },
+        "on": {
+            "busy_replies": on.get("busy_replies", 0),
+            "p99_adj_ms": on["apply_e2e_p99_ms_adj"],
+            "availability_min": on["canary"]["availability_min"],
+        },
+        "oracle_parity": True,
+    }
+
+
 def diff_overlap_dry_run(
     n_docs: int = 12, sub_batch: int = 4, depth: int = 2
 ) -> dict:
@@ -2425,6 +2568,17 @@ def main(dry_run: bool = False, compare_baseline: bool = False):
             out["fleet"] = fleet_dry_run()
         out["canary_availability"] = out["fleet"]["canary"]["availability"]
         out["canary_rw_lag_ms"] = out["fleet"]["canary"]["rw_p99_ms"]
+        # closed-loop autopilot rehearsal (ISSUE-16): the same chaos
+        # soak scored autopilot-on vs autopilot-off — the controller
+        # must WIN on e2e p99_adj and canary availability at oracle
+        # parity, with a byte-identical same-seed action journal
+        with phases.span("host.autopilot_rehearsal"):
+            out["autopilot"] = autopilot_dry_run()
+        out["autopilot_actions"] = out["autopilot"]["actions"]
+        out["autopilot_p99_adj_delta"] = out["autopilot"]["p99_adj_delta_ms"]
+        out["autopilot_availability_delta"] = out["autopilot"][
+            "availability_delta"
+        ]
         out["tunnel_queue"] = list(TUNNEL_QUEUE)
         out["phases"] = phases.snapshot()
         out["metrics"] = metrics.snapshot()

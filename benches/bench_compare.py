@@ -75,6 +75,13 @@ _DIRECTION_RULES: Tuple[Tuple[str, str], ...] = (
     # neutral: they are the scripted chaos schedule, not better/worse.
     ("converge_rounds", "down"),
     ("anti_entropy_bytes", "down"),
+    # autopilot on-vs-off deltas (ISSUE-16): availability_delta = on −
+    # off (shrinking toward 0 means the controller stopped winning →
+    # regresses on DROP); p99_adj_delta = on − off ms (negative is the
+    # win; a RISE toward 0 is a regression). Raw action counts stay
+    # neutral: more actions is a policy choice, not better/worse.
+    ("availability_delta", "up"),
+    ("p99_adj_delta", "down"),
     ("p50_ms", "down"),
     ("p99_ms", "down"),
     ("p999_ms", "down"),

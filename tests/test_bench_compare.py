@@ -128,6 +128,14 @@ def test_direction_classification_rules():
     assert bc.classify("federation.partitions") == "neutral"
     assert bc.classify("federation.commit_mismatches") == "neutral"
     assert bc.classify("federation.updates_per_s") == "up"
+    # autopilot (ISSUE-16): on-vs-off deltas score the controller —
+    # availability regresses on DROP, the p99 delta on RISE; raw action
+    # counts are policy shape, reported-neutral
+    assert bc.classify("autopilot_availability_delta") == "up"
+    assert bc.classify("autopilot_p99_adj_delta") == "down"
+    assert bc.classify("autopilot.p99_adj_delta_ms") == "down"
+    assert bc.classify("autopilot_actions") == "neutral"
+    assert bc.classify("autopilot.actions_by_policy.maintenance") == "neutral"
     assert bc.classify("phases.replay.stage.execute_s") == "neutral"
     assert bc.classify("chunks") == "neutral"
 

@@ -9,6 +9,7 @@ from .admission import (
     RateLimited,
     TokenBucket,
 )
+from .autopilot import AutopilotConfig, FleetAutopilot, RecoveryExhausted
 from .canary import CanaryProber
 from .scenario import Event, Scenario, ScenarioConfig
 from .soak import (
@@ -21,13 +22,16 @@ from .soak import (
 
 __all__ = [
     "AdmissionController",
+    "AutopilotConfig",
     "CANARY_PREFIX",
     "CanaryProber",
     "Event",
     "FederatedSoakDriver",
+    "FleetAutopilot",
     "Overload",
     "QueueFull",
     "RateLimited",
+    "RecoveryExhausted",
     "Scenario",
     "ScenarioConfig",
     "SoakDriver",

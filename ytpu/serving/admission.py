@@ -36,6 +36,12 @@ replay's staging thread blocks instead of overrunning the device
 Fault site (docs/robustness.md): ``admission.reject`` forces the next
 admit() to raise `QueueFull` — soak chaos runs use it to exercise the
 Busy path without actually saturating a queue.
+
+Runtime retuning (ISSUE-16): `set_rate` / `set_queue_bound` (global) and
+`set_tenant_rate` / `set_tenant_queue_bound` (per-tenant overrides) are
+thread-safe and take effect on the NEXT admit/throttle call — the fleet
+autopilot's adaptive-admission actuator, also usable by an operator
+against a live server.  Every change bumps ``admission.policy_changes``.
 """
 
 from __future__ import annotations
@@ -59,6 +65,7 @@ _ADMITTED = metrics.counter("admission.admitted")
 _REJECTED = metrics.counter("admission.rejected", labelnames=("reason",))
 _THROTTLE_WAITS = metrics.counter("admission.throttle_waits")
 _THROTTLE_WAIT_HIST = metrics.histogram("admission.throttle_wait")
+_POLICY_CHANGES = metrics.counter("admission.policy_changes")
 
 
 class Overload(RuntimeError):
@@ -122,6 +129,20 @@ class TokenBucket:
                 return 0.0
             return (n - self._tokens) / self.rate
 
+    def set_rate(self, rate: float, burst: Optional[float] = None) -> None:
+        """Retune the bucket LIVE (ISSUE-16): refill at the old rate up
+        to now, then switch — tokens already earned are kept (clamped to
+        the new burst), so an in-flight throttler sees the new rate from
+        its next clock reading, deterministically under an injected
+        clock."""
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0, got {rate}")
+        with self._lock:
+            self._refill_locked()
+            self.rate = float(rate)
+            self.burst = float(burst if burst is not None else rate)
+            self._tokens = min(self._tokens, self.burst)
+
     def take_debt(self, n: float = 1.0) -> float:
         """Consume ``n`` unconditionally (tokens may go NEGATIVE — debt)
         and return the seconds the caller should sleep to amortize it.
@@ -159,7 +180,80 @@ class AdmissionController:
         self.bucket = (
             TokenBucket(rate, burst, clock) if rate is not None else None
         )
+        self._clock = clock
         self._sleep = sleep
+        # per-tenant overrides (ISSUE-16): tenant -> bucket / queue bound,
+        # consulted INSTEAD of the globals for that tenant.  Guarded by a
+        # lock so a controller retune from the autopilot (or an operator
+        # thread) is atomic against the server's accept loop.
+        self._lock = threading.Lock()
+        self._tenant_buckets: dict = {}
+        self._tenant_queue_bounds: dict = {}
+
+    # --- runtime retuning (ISSUE-16 satellite) --------------------------------
+
+    def set_rate(
+        self, rate: Optional[float], burst: Optional[float] = None
+    ) -> None:
+        """Retune the GLOBAL rate limit live; ``None`` removes it.  An
+        existing bucket is retuned in place (earned tokens kept) so
+        in-flight throttling sees the new rate without a reset."""
+        with self._lock:
+            if rate is None:
+                self.bucket = None
+            elif self.bucket is None:
+                self.bucket = TokenBucket(rate, burst, self._clock)
+            else:
+                self.bucket.set_rate(rate, burst)
+        _POLICY_CHANGES.inc()
+
+    def set_queue_bound(self, max_queue: Optional[int]) -> None:
+        """Retune the GLOBAL per-tenant queue bound live (None = unbounded)."""
+        with self._lock:
+            self.max_queue = max_queue
+        _POLICY_CHANGES.inc()
+
+    def set_tenant_rate(
+        self, tenant: str, rate: Optional[float], burst: Optional[float] = None
+    ) -> None:
+        """Per-tenant rate override (None clears it back to the global)."""
+        with self._lock:
+            if rate is None:
+                self._tenant_buckets.pop(tenant, None)
+            elif tenant in self._tenant_buckets:
+                self._tenant_buckets[tenant].set_rate(rate, burst)
+            else:
+                self._tenant_buckets[tenant] = TokenBucket(
+                    rate, burst, self._clock
+                )
+        _POLICY_CHANGES.inc()
+
+    def set_tenant_queue_bound(
+        self, tenant: str, max_queue: Optional[int]
+    ) -> None:
+        """Per-tenant queue-bound override (None clears it)."""
+        with self._lock:
+            if max_queue is None:
+                self._tenant_queue_bounds.pop(tenant, None)
+            else:
+                self._tenant_queue_bounds[tenant] = int(max_queue)
+        _POLICY_CHANGES.inc()
+
+    def policy_snapshot(self) -> dict:
+        """The live knob values (the autopilot journals these as action
+        inputs; also a handy `/snapshot` surface for operators)."""
+        with self._lock:
+            return {
+                "max_queue": self.max_queue,
+                "rate": None if self.bucket is None else self.bucket.rate,
+                "burst": None if self.bucket is None else self.bucket.burst,
+                "tenant_rates": {
+                    t: b.rate for t, b in sorted(self._tenant_buckets.items())
+                },
+                "tenant_queue_bounds": dict(
+                    sorted(self._tenant_queue_bounds.items())
+                ),
+            }
 
     # --- server-side admission (per inbound update) ---------------------------
 
@@ -181,19 +275,27 @@ class AdmissionController:
             ):
                 _REJECTED.labels("injected").inc()
                 raise QueueFull(tenant, "injected admission fault")
-            if self.max_queue is not None and queue_depth + n > self.max_queue:
+            # per-tenant overrides REPLACE the global knob for that
+            # tenant (ISSUE-16); read under the lock so a concurrent
+            # retune is atomic
+            with self._lock:
+                max_queue = self._tenant_queue_bounds.get(
+                    tenant, self.max_queue
+                )
+                bucket = self._tenant_buckets.get(tenant, self.bucket)
+            if max_queue is not None and queue_depth + n > max_queue:
                 _REJECTED.labels("queue_full").inc()
                 raise QueueFull(
                     tenant,
-                    f"queue depth {queue_depth} at bound {self.max_queue}",
+                    f"queue depth {queue_depth} at bound {max_queue}",
                 )
-            if self.bucket is not None:
-                wait = self.bucket.deficit(n)
+            if bucket is not None:
+                wait = bucket.deficit(n)
                 if wait > 0.0:
                     _REJECTED.labels("rate_limited").inc()
                     raise RateLimited(
                         tenant,
-                        f"over rate {self.bucket.rate}/s",
+                        f"over rate {bucket.rate}/s",
                         retry_after_s=wait,
                     )
             _ADMITTED.inc(n)

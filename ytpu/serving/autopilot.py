@@ -1,0 +1,696 @@
+"""Closed-loop fleet autopilot (ISSUE-16 tentpole).
+
+Every prior PR grew either the *read* surface (per-tenant queue depths,
+admission Busy rates, `replica.convergence_lag{tenant=}`, canary
+availability and rw-lag, the ownership/migration timeline) or an
+*actuator* (`ReplicaMesh.migrate_tenant` / `kill_replica` /
+`recover_tenant`, the admission knobs) — but nothing connected them.
+`FleetAutopilot` is that control loop: on a fixed tick it assembles a
+structured **fleet snapshot** from existing registries and mesh state
+(no new device syncs — decisions are O(snapshot)) and acts through the
+existing actuators:
+
+1. **Hot-tenant migration** — per-tenant load scores (device-queue
+   depth + windowed applied-update deltas, with the global apply-p99
+   window folded in as a quantized *pressure* level) move Zipf-hot
+   tenants off overloaded replicas via `migrate_tenant`.  Replica
+   overload uses **hysteresis** (enter at ``load_high``, exit at
+   ``load_low``) and every migrated tenant starts a **cooldown**
+   (``migrate_cooldown_ticks``), so an oscillating load signal provably
+   cannot flap the same tenant back and forth (the damping test bounds
+   the action count).
+2. **Adaptive admission** — Busy-rate + queue-depth windows retune the
+   attached `AdmissionController` live (the ISSUE-16 runtime setters):
+   a high Busy rate over *shallow* queues means the knob, not the
+   device, is the bottleneck → relax the queue bound / rate toward
+   their maxima; a high Busy rate over *deep* queues is genuine
+   overload → clamp the hottest tenant with a per-tenant override so
+   the other tenants keep their budget.
+3. **Quarantine recovery** — `DivergenceFault` quarantines are driven
+   through `recover_tenant` with bounded exponential backoff
+   (``recovery_backoff_base * mult^attempts`` ticks, capped), giving up
+   into the typed terminal state `RecoveryExhausted` after
+   ``max_recoveries`` failed attempts.
+4. **Scripted maintenance drain** — `drain_replica(rid)` migrates every
+   owned tenant away, then decommissions the replica
+   (`ReplicaMesh.decommission`: remaining sessions close with
+   ``reason="drain"``, the canary stops scoring it), so the scheduled
+   `kill_replica` that follows drops **zero** sessions and never dents
+   `canary.availability` (ISSUE-16 satellite).  `schedule_drain(rid,
+   at_tick)` scripts the whole sequence onto the tick clock.
+
+Every decision appends to a bounded, seq-numbered **action journal**
+(policy, action, reason, a trimmed inputs snapshot, outcome) exposed
+via the `/snapshot` section ``autopilot`` and the ``autopilot.*``
+metric families.  The journal is the replayability contract: every
+value in it is derived from deterministic state (tick numbers, queue
+depths, counter deltas, the seeded RNG) — never wall-clock readings —
+so the same seed + the same scenario produce a **byte-identical**
+journal (`journal_bytes` / `journal_digest`).  The injected clock is
+used only for non-journaled telemetry.  The one caveat is the latency
+*pressure* term: the apply-p99 window is quantized into coarse pressure
+levels (``p99_pressure_s`` bands), so determinism holds whenever the
+p99 stays within one band — in-process soaks sit far below band 1.
+
+Fault sites (docs/robustness.md): ``autopilot.stall`` skips whole
+ticks (the mesh must degrade gracefully back to manual behavior, never
+corrupt) and ``autopilot.misfire`` injects one wrong-but-legal action —
+a seeded-random migration — which byte parity must survive, because
+every actuator the autopilot is allowed to call is parity-safe.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import time
+import zlib
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from ytpu.utils import metrics
+from ytpu.utils.faults import faults
+from ytpu.utils.slo import HistogramWindow
+from ytpu.utils.trace import tracer
+
+from .soak import CANARY_PREFIX
+
+__all__ = ["AutopilotConfig", "FleetAutopilot", "RecoveryExhausted"]
+
+_TICKS = metrics.counter("autopilot.ticks")
+_ACTIONS = metrics.counter("autopilot.actions", labelnames=("policy",))
+_STALLS = metrics.counter("autopilot.stalls")
+_JOURNAL_SEQ = metrics.gauge("autopilot.journal_seq")
+_RECOVERY_EXHAUSTED = metrics.gauge("autopilot.recovery_exhausted")
+_DRAINED = metrics.gauge("autopilot.drained_replicas")
+
+
+class RecoveryExhausted:
+    """Typed terminal state for a quarantined tenant the autopilot gave
+    up on: ``max_recoveries`` attempts failed, backoff is abandoned and
+    the tenant stays quarantined for the operator.  Kept (not raised) in
+    `FleetAutopilot.terminal` — giving up is a *state*, not an error the
+    control loop should die on."""
+
+    __slots__ = ("tenant", "attempts", "tick")
+
+    def __init__(self, tenant: str, attempts: int, tick: int):
+        self.tenant = tenant
+        self.attempts = attempts
+        self.tick = tick
+
+    def __repr__(self):
+        return (
+            f"RecoveryExhausted({self.tenant!r}, attempts={self.attempts}, "
+            f"tick={self.tick})"
+        )
+
+
+class AutopilotConfig:
+    """Knobs for every policy (see module docstring).  Plain attributes
+    so a test or bench leg overrides exactly what it needs."""
+
+    def __init__(self, **kw):
+        # --- hot-tenant migration ---
+        self.load_high = 16.0        # replica load: enter overloaded
+        self.load_low = 6.0          # replica load: exit overloaded
+        self.migrate_cooldown_ticks = 8
+        # --- adaptive admission ---
+        self.busy_high = 0.05        # Busy-rate that triggers action
+        self.queue_relax_depth = 8   # shallow queues => knob-bound: relax
+        self.queue_high = 32         # deep queues => overload: clamp
+        self.queue_bound_mult = 8
+        self.queue_bound_max = 4096
+        self.rate_mult = 4.0
+        self.rate_max = 1e6
+        self.tenant_queue_clamp = 8
+        self.admission_cooldown_ticks = 2
+        # --- quarantine recovery ---
+        self.max_recoveries = 4
+        self.recovery_backoff_base = 1
+        self.recovery_backoff_mult = 2
+        self.recovery_backoff_cap = 16
+        # --- latency pressure quantization ---
+        self.p99_pressure_s = 0.25   # band width; in-proc p99 sits in band 0
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise TypeError(f"unknown autopilot knob {k!r}")
+            setattr(self, k, v)
+
+
+class FleetAutopilot:
+    """The deterministic control loop (see module docstring).
+
+    ``mesh`` is duck-typed to the `ReplicaMesh` surface the policies
+    read and actuate (``replicas`` / ``owner`` / ``quarantined`` /
+    ``migrate_tenant`` / ``recover_tenant`` / ``kill_replica`` /
+    ``decommission``), so damping/backoff unit tests drive the decision
+    logic against a stub fleet.  ``snapshot_fn`` (tests only) replaces
+    the whole snapshot assembly with a synthetic signal generator —
+    the decision path underneath runs unchanged."""
+
+    def __init__(
+        self,
+        mesh,
+        admission=None,
+        config: Optional[AutopilotConfig] = None,
+        seed: int = 0,
+        clock: Callable[[], float] = time.monotonic,
+        journal_cap: int = 256,
+        snapshot_fn: Optional[Callable[[], Dict]] = None,
+    ):
+        self.mesh = mesh
+        self.admission = admission
+        self.cfg = config or AutopilotConfig()
+        self.seed = int(seed)
+        self._clock = clock
+        self._snapshot_fn = snapshot_fn
+        # seeded like every deterministic component (FaultSpec, Scenario):
+        # crc32 of "<seed>:autopilot" — used ONLY for the misfire payload
+        self._rng = random.Random(
+            zlib.crc32(f"{self.seed}:autopilot".encode()) & 0xFFFFFFFF
+        )
+        self.tick_no = 0
+        self.journal: deque = deque(maxlen=max(1, journal_cap))
+        self._seq = 0
+        self.last_tick_at: Optional[float] = None  # telemetry only
+        # migration state
+        self._overloaded: set = set()
+        self._cooldown: Dict[str, int] = {}  # tenant -> blocked until tick
+        # admission state
+        self._adm_cooldown_until = 0
+        # recovery state
+        self._recovery: Dict[str, Dict[str, int]] = {}
+        self.terminal: Dict[str, RecoveryExhausted] = {}
+        # maintenance state
+        self._maintenance: Dict[int, tuple] = {}  # tick -> (rid, kill)
+        self.drained: set = set()
+        # windowed inputs: counter baselines are taken at construction so
+        # the first tick scores only THIS run's traffic.  Cached objects,
+        # not fresh registry lookups at read time (metrics.reset()
+        # orphaning — the `_admission_values` discipline).
+        self._applied_family = metrics.counter(
+            "sync.tenant_updates_applied", labelnames=("tenant",)
+        )
+        self._applied_base: Dict[str, int] = {}
+        from . import admission as _adm
+
+        self._rejected = _adm._REJECTED
+        self._admitted = _adm._ADMITTED
+        self._busy_base = self._read_busy()
+        self._admitted_base = self._admitted.value
+        self._apply_w = HistogramWindow(metrics.histogram("sync.apply_update"))
+
+    # ------------------------------------------------------------- inputs
+
+    def _read_busy(self) -> int:
+        """Admission refusals (the Busy-reply sources), from the
+        admission module's own cached counter children."""
+        return int(
+            self._rejected.labels("queue_full").value
+            + self._rejected.labels("rate_limited").value
+        )
+
+    def _pressure(self) -> int:
+        """The apply-p99 window quantized into coarse pressure bands —
+        the only wall-derived input, deliberately so coarse that every
+        run of one scenario lands the same band (journal determinism)."""
+        return int(self._apply_w.quantile(0.99) / self.cfg.p99_pressure_s)
+
+    def _fleet_snapshot(self) -> Dict:
+        """One structured, deterministic view of the fleet: per-tenant
+        load scores (queue depth + applied delta), per-replica load sums
+        and states, quarantines, and the Busy window.  Assembled from
+        state the mesh/registries already hold — no device syncs."""
+        mesh = self.mesh
+        tenants: Dict[str, Dict] = {}
+        for t in sorted(mesh.owner):
+            if t.startswith(CANARY_PREFIX):
+                continue  # probe traffic is not load
+            rid = mesh.owner[t][0]
+            rep = mesh.replicas[rid]
+            depth = 0
+            if rep.alive:
+                depth = int(rep.server._tenant_queue_depth(t))
+            applied = int(self._applied_family.labels(t).value)
+            # first sight of a tenant baselines at its CURRENT value:
+            # the registry counter is process-cumulative, and a delta
+            # against an earlier run's tally would make the first
+            # window's load depend on process history — breaking the
+            # byte-identical-journal contract across back-to-back runs
+            base = self._applied_base.get(t)
+            delta = 0 if base is None else applied - base
+            self._applied_base[t] = applied
+            tenants[t] = {
+                "owner": rid,
+                "depth": depth,
+                "applied": delta,
+                "load": depth + delta,
+            }
+        replicas: Dict[str, Dict] = {}
+        for rid in sorted(mesh.replicas):
+            rep = mesh.replicas[rid]
+            owned = [t for t, v in tenants.items() if v["owner"] == rid]
+            replicas[rid] = {
+                "alive": bool(rep.alive),
+                "decommissioned": rid in getattr(
+                    mesh, "decommissioned", ()
+                ),
+                "owned": owned,
+                "load": sum(tenants[t]["load"] for t in owned),
+            }
+        busy = self._read_busy()
+        admitted = int(self._admitted.value)
+        busy_d = busy - self._busy_base
+        admitted_d = admitted - self._admitted_base
+        self._busy_base, self._admitted_base = busy, admitted
+        denom = busy_d + admitted_d
+        return {
+            "tick": self.tick_no,
+            "tenants": tenants,
+            "replicas": replicas,
+            "quarantined": sorted(
+                t for t in mesh.quarantined if t not in self.terminal
+            ),
+            "busy": busy_d,
+            "admitted": admitted_d,
+            "busy_rate": round(busy_d / denom, 4) if denom else 0.0,
+            "pressure": self._pressure(),
+        }
+
+    # ------------------------------------------------------------ journal
+
+    def _journal(
+        self,
+        policy: str,
+        action: str,
+        reason: str,
+        inputs: Dict,
+        outcome,
+        count_action: bool = True,
+    ) -> Dict:
+        self._seq += 1
+        entry = {
+            "seq": self._seq,
+            "tick": self.tick_no,
+            "policy": policy,
+            "action": action,
+            "reason": reason,
+            "inputs": inputs,
+            "outcome": outcome,
+        }
+        self.journal.append(entry)
+        _JOURNAL_SEQ.set(self._seq)
+        if count_action:
+            _ACTIONS.labels(policy).inc()
+        return entry
+
+    def journal_bytes(self) -> bytes:
+        """The (bounded) journal in canonical JSON-lines form — the
+        byte-identity surface: same seed + same scenario ⇒ identical
+        bytes."""
+        return "\n".join(
+            json.dumps(e, sort_keys=True, separators=(",", ":"))
+            for e in self.journal
+        ).encode()
+
+    def journal_digest(self) -> str:
+        return hashlib.sha256(self.journal_bytes()).hexdigest()
+
+    # --------------------------------------------------------------- tick
+
+    def tick(self) -> List[Dict]:
+        """One control-loop pass: snapshot, then every policy in a fixed
+        order (maintenance → recovery → migration → admission — drains
+        first so nothing migrates TOWARD a replica about to leave).
+        Returns the journal entries appended this tick."""
+        self.tick_no += 1
+        _TICKS.inc()
+        self.last_tick_at = self._clock()
+        with tracer.span("autopilot.tick", tick=self.tick_no):
+            if faults.active and faults.fire("autopilot.stall") is not None:
+                _STALLS.inc()
+                return [
+                    self._journal(
+                        "fault", "stall",
+                        "injected autopilot.stall: tick skipped",
+                        {}, "skipped", count_action=False,
+                    )
+                ]
+            snap = (
+                self._snapshot_fn()
+                if self._snapshot_fn is not None
+                else self._fleet_snapshot()
+            )
+            snap.setdefault("tick", self.tick_no)
+            out: List[Dict] = []
+            out += self._maintenance_policy()
+            out += self._recovery_policy(snap)
+            out += self._migration_policy(snap)
+            out += self._admission_policy(snap)
+            if faults.active:
+                spec = faults.fire("autopilot.misfire")
+                if spec is not None:
+                    out += self._misfire(snap)
+            return out
+
+    # ------------------------------------------------- policy: maintenance
+
+    def schedule_drain(self, rid: str, at_tick: int, kill: bool = True):
+        """Script a maintenance drain of ``rid`` at ``at_tick`` (and the
+        drained `kill_replica` right after it, unless ``kill=False``)."""
+        self._maintenance[int(at_tick)] = (rid, bool(kill))
+
+    def drain_replica(self, rid: str) -> int:
+        """Migrate every tenant ``rid`` owns to the least-loaded other
+        replica, then decommission it (remaining sessions close with
+        ``reason="drain"``; the canary stops scoring it) — after this a
+        `kill_replica(rid, drain=True)` drops zero sessions.  Returns
+        the tenants moved."""
+        mesh = self.mesh
+        targets = [
+            r for r in sorted(mesh.replicas)
+            if r != rid
+            and mesh.replicas[r].alive
+            and r not in getattr(mesh, "decommissioned", ())
+        ]
+        if not targets:
+            raise ValueError(f"cannot drain {rid!r}: no live target replica")
+        moved = 0
+        owned = sorted(
+            t for t, (o, _e) in mesh.owner.items()
+            if o == rid and not t.startswith(CANARY_PREFIX)
+        )
+        for i, t in enumerate(owned):
+            dst = targets[i % len(targets)]
+            epoch = mesh.migrate_tenant(t, dst)
+            moved += 1
+            self._journal(
+                "maintenance", "drain_migrate",
+                f"drain {rid}: move {t} to {dst}",
+                {"replica": rid, "tenant": t, "dst": dst},
+                {"epoch": epoch},
+            )
+        decommission = getattr(mesh, "decommission", None)
+        closed = decommission(rid) if decommission is not None else 0
+        self.drained.add(rid)
+        _DRAINED.set(len(self.drained))
+        self._journal(
+            "maintenance", "decommission",
+            f"drain {rid}: decommissioned ({moved} tenants moved)",
+            {"replica": rid, "moved": moved},
+            {"sessions_closed": closed},
+        )
+        return moved
+
+    def _maintenance_policy(self) -> List[Dict]:
+        out: List[Dict] = []
+        for at_tick in sorted(self._maintenance):
+            if at_tick > self.tick_no:
+                continue
+            rid, kill = self._maintenance.pop(at_tick)
+            rep = self.mesh.replicas.get(rid)
+            if rep is None or not rep.alive:
+                continue
+            seq_before = self._seq
+            self.drain_replica(rid)
+            out.extend(e for e in self.journal if e["seq"] > seq_before)
+            if kill:
+                dropped = self.mesh.kill_replica(rid, drain=True)
+                out.append(
+                    self._journal(
+                        "maintenance", "kill",
+                        f"scheduled maintenance kill of drained {rid}",
+                        {"replica": rid, "scheduled_tick": at_tick},
+                        {"sessions_dropped": dropped},
+                    )
+                )
+        return out
+
+    # --------------------------------------------------- policy: recovery
+
+    def _recovery_policy(self, snap: Dict) -> List[Dict]:
+        out: List[Dict] = []
+        cfg = self.cfg
+        for t in snap.get("quarantined", ()):
+            st = self._recovery.setdefault(
+                t, {"attempts": 0, "next": self.tick_no}
+            )
+            if self.tick_no < st["next"]:
+                continue
+            ok = bool(self.mesh.recover_tenant(t))
+            if ok:
+                out.append(
+                    self._journal(
+                        "recovery", "recover",
+                        f"quarantined {t}: recovery succeeded",
+                        {"tenant": t, "attempts": st["attempts"] + 1},
+                        "recovered",
+                    )
+                )
+                self._recovery.pop(t, None)
+                continue
+            st["attempts"] += 1
+            if st["attempts"] >= cfg.max_recoveries:
+                self.terminal[t] = RecoveryExhausted(
+                    t, st["attempts"], self.tick_no
+                )
+                _RECOVERY_EXHAUSTED.set(len(self.terminal))
+                self._recovery.pop(t, None)
+                out.append(
+                    self._journal(
+                        "recovery", "give_up",
+                        f"quarantined {t}: {st['attempts']} attempts failed, "
+                        "abandoning to RecoveryExhausted",
+                        {"tenant": t, "attempts": st["attempts"]},
+                        "exhausted",
+                    )
+                )
+                continue
+            backoff = min(
+                cfg.recovery_backoff_base
+                * cfg.recovery_backoff_mult ** st["attempts"],
+                cfg.recovery_backoff_cap,
+            )
+            st["next"] = self.tick_no + backoff
+            out.append(
+                self._journal(
+                    "recovery", "backoff",
+                    f"quarantined {t}: attempt {st['attempts']} failed, "
+                    f"retry in {backoff} ticks",
+                    {"tenant": t, "attempts": st["attempts"]},
+                    {"retry_tick": st["next"]},
+                )
+            )
+        return out
+
+    # -------------------------------------------------- policy: migration
+
+    def _migration_policy(self, snap: Dict) -> List[Dict]:
+        out: List[Dict] = []
+        cfg = self.cfg
+        replicas = snap.get("replicas", {})
+        tenants = snap.get("tenants", {})
+        live = {
+            rid: r for rid, r in replicas.items()
+            if r.get("alive") and not r.get("decommissioned")
+        }
+        if len(live) < 2:
+            return out
+        # hysteresis: enter the overloaded set at load_high, leave at
+        # load_low — a load hovering between the watermarks changes
+        # nothing, which is the anti-flap half the cooldown can't cover
+        for rid in sorted(live):
+            load = live[rid]["load"]
+            if rid in self._overloaded and load <= cfg.load_low:
+                self._overloaded.discard(rid)
+            elif rid not in self._overloaded and load >= cfg.load_high:
+                self._overloaded.add(rid)
+        self._overloaded &= set(live)
+        for rid in sorted(self._overloaded):
+            cands = [
+                t for t in live[rid]["owned"]
+                if self._cooldown.get(t, 0) <= self.tick_no
+                and t not in snap.get("quarantined", ())
+            ]
+            if not cands:
+                continue
+            hot = max(cands, key=lambda t: (tenants[t]["load"], t))
+            dst = min(
+                (r for r in sorted(live) if r != rid),
+                key=lambda r: (live[r]["load"], r),
+            )
+            epoch = self.mesh.migrate_tenant(hot, dst)
+            self._cooldown[hot] = self.tick_no + cfg.migrate_cooldown_ticks
+            out.append(
+                self._journal(
+                    "migration", "migrate",
+                    f"{rid} overloaded (load {live[rid]['load']} >= "
+                    f"{cfg.load_high:g}): move hottest tenant {hot} to {dst}",
+                    {
+                        "tenant": hot,
+                        "src": rid,
+                        "dst": dst,
+                        "replica_load": live[rid]["load"],
+                        "tenant_load": tenants[hot]["load"],
+                        "dst_load": live[dst]["load"],
+                        "pressure": snap.get("pressure", 0),
+                    },
+                    {
+                        "epoch": epoch,
+                        "cooldown_until": self._cooldown[hot],
+                    },
+                )
+            )
+        return out
+
+    # -------------------------------------------------- policy: admission
+
+    def _admission_policy(self, snap: Dict) -> List[Dict]:
+        out: List[Dict] = []
+        adm = self.admission
+        cfg = self.cfg
+        if adm is None or self.tick_no < self._adm_cooldown_until:
+            return out
+        busy_rate = snap.get("busy_rate", 0.0)
+        if snap.get("busy", 0) == 0 or busy_rate < cfg.busy_high:
+            return out
+        tenants = snap.get("tenants", {})
+        max_depth = max(
+            (v["depth"] for v in tenants.values()), default=0
+        )
+        inputs = {
+            "busy": snap.get("busy", 0),
+            "admitted": snap.get("admitted", 0),
+            "busy_rate": busy_rate,
+            "max_depth": max_depth,
+        }
+        if max_depth <= cfg.queue_relax_depth:
+            # Busy storm over shallow queues: the admission knob is the
+            # bottleneck, not the device — relax toward the maxima
+            if (
+                adm.max_queue is not None
+                and adm.max_queue < cfg.queue_bound_max
+            ):
+                new_bound = min(
+                    int(adm.max_queue * cfg.queue_bound_mult) + 1,
+                    cfg.queue_bound_max,
+                )
+                old = adm.max_queue
+                adm.set_queue_bound(new_bound)
+                out.append(
+                    self._journal(
+                        "admission", "relax_queue_bound",
+                        f"busy_rate {busy_rate:g} over shallow queues "
+                        f"(depth {max_depth}): bound {old} -> {new_bound}",
+                        inputs, {"max_queue": new_bound},
+                    )
+                )
+            if adm.bucket is not None and adm.bucket.rate < cfg.rate_max:
+                old_rate = adm.bucket.rate
+                new_rate = min(old_rate * cfg.rate_mult, cfg.rate_max)
+                adm.set_rate(new_rate)
+                out.append(
+                    self._journal(
+                        "admission", "relax_rate",
+                        f"busy_rate {busy_rate:g} over shallow queues: "
+                        f"rate {old_rate:g} -> {new_rate:g}",
+                        inputs, {"rate": new_rate},
+                    )
+                )
+        elif max_depth >= cfg.queue_high and tenants:
+            # genuine overload: clamp the hottest tenant's queue with a
+            # per-tenant override so the others keep their budget
+            hot = max(
+                sorted(tenants), key=lambda t: (tenants[t]["load"], t)
+            )
+            adm.set_tenant_queue_bound(hot, cfg.tenant_queue_clamp)
+            out.append(
+                self._journal(
+                    "admission", "clamp_tenant",
+                    f"busy_rate {busy_rate:g} over deep queues (depth "
+                    f"{max_depth}): clamp {hot} to {cfg.tenant_queue_clamp}",
+                    {**inputs, "tenant": hot},
+                    {"tenant_queue_bound": cfg.tenant_queue_clamp},
+                )
+            )
+        if out:
+            self._adm_cooldown_until = (
+                self.tick_no + cfg.admission_cooldown_ticks
+            )
+        return out
+
+    # ---------------------------------------------------- policy: misfire
+
+    def _misfire(self, snap: Dict) -> List[Dict]:
+        """`autopilot.misfire`: one wrong-but-legal action — a seeded-
+        random migration.  Legal because `migrate_tenant` is parity-safe
+        by construction; wrong because no load signal asked for it."""
+        mesh = self.mesh
+        live = [
+            rid for rid in sorted(mesh.replicas)
+            if mesh.replicas[rid].alive
+            and rid not in getattr(mesh, "decommissioned", ())
+        ]
+        cands = sorted(
+            t for t in mesh.owner
+            if not t.startswith(CANARY_PREFIX)
+            and t not in mesh.quarantined
+            and mesh.owner[t][0] in live
+        )
+        if not cands or len(live) < 2:
+            return []
+        tenant = self._rng.choice(cands)
+        src = mesh.owner[tenant][0]
+        dst = self._rng.choice([r for r in live if r != src])
+        epoch = mesh.migrate_tenant(tenant, dst)
+        return [
+            self._journal(
+                "misfire", "migrate",
+                f"injected autopilot.misfire: pointless {tenant} "
+                f"{src} -> {dst}",
+                {"tenant": tenant, "src": src, "dst": dst},
+                {"epoch": epoch},
+            )
+        ]
+
+    # ------------------------------------------------------------- export
+
+    def snapshot(self) -> Dict:
+        """`/snapshot` section ``autopilot``: the journal (bounded) plus
+        the controller's live state — what an operator reads to answer
+        "what did the autopilot just do, and why"."""
+        return {
+            "tick": self.tick_no,
+            "seed": self.seed,
+            "journal_seq": self._seq,
+            "journal_digest": self.journal_digest(),
+            "journal": list(self.journal),
+            "overloaded": sorted(self._overloaded),
+            "cooldowns": dict(sorted(self._cooldown.items())),
+            "drained": sorted(self.drained),
+            "terminal": {
+                t: {"attempts": s.attempts, "tick": s.tick}
+                for t, s in sorted(self.terminal.items())
+            },
+        }
+
+    def attach(self, telemetry) -> None:
+        telemetry.add_provider("autopilot", self.snapshot)
+
+    def report(self) -> Dict:
+        """Scored summary for soak/bench reports (counts only — the full
+        journal lives in `snapshot`)."""
+        by_policy: Dict[str, int] = {}
+        for e in self.journal:
+            if e["policy"] != "fault":
+                by_policy[e["policy"]] = by_policy.get(e["policy"], 0) + 1
+        return {
+            "ticks": self.tick_no,
+            "actions": self._seq,
+            "actions_by_policy": dict(sorted(by_policy.items())),
+            "journal_digest": self.journal_digest(),
+            "drained": sorted(self.drained),
+            "terminal": sorted(self.terminal),
+        }

@@ -179,7 +179,9 @@ class CanaryProber:
         """One probe pass: every replica gets the current verb (the verb
         cycles apply → diff → awareness per tick, so a soak's cadence
         exercises all three against all replicas).  A dead replica's
-        probe fails by definition — that IS the availability signal."""
+        probe fails by definition — that IS the availability signal —
+        unless it was decommissioned first (a planned maintenance drain
+        is not an availability event; see `ReplicaMesh.decommission`)."""
         self.seq += 1
         kind = ("apply", "diff", "awareness")[self.seq % 3]
         probe = {
@@ -187,8 +189,16 @@ class CanaryProber:
             "diff": self._probe_diff,
             "awareness": self._probe_awareness,
         }[kind]
+        decommissioned = getattr(self.mesh, "decommissioned", ())
         for rid in sorted(self.mesh.replicas):
             rep = self.mesh.replicas[rid]
+            if rid in decommissioned:
+                # cleanly drained for maintenance (ISSUE-16): it serves
+                # no tenants and its kill is planned, so probing it is
+                # neither a success nor a failure — it simply leaves the
+                # availability surface (a drained kill must not dent
+                # `canary.availability`)
+                continue
             self._probes[rid] = self._probes.get(rid, 0) + 1
             _PROBES.labels(rid).inc()
             with trace_context(replica=rid, tenant=self.tenant_of(rid)), \
@@ -217,13 +227,15 @@ class CanaryProber:
         propagation cost.  Markers older than ``rw_timeout_rounds``
         charge a failure to each observer that never saw them."""
         self.rounds += 1
+        decommissioned = getattr(self.mesh, "decommissioned", ())
         still: List[Dict] = []
         for p in self._pending:
             remaining = []
             for rid in p["observers"]:
                 rep = self.mesh.replicas.get(rid)
-                if rep is None or not rep.alive:
-                    continue  # dead observers are scored by tick()
+                if rep is None or not rep.alive or rid in decommissioned:
+                    continue  # dead observers are scored by tick();
+                    # decommissioned ones left the scoring surface
                 try:
                     text = _server_tenant_text(
                         rep.server, p["tenant"], self.root

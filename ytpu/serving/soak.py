@@ -595,6 +595,10 @@ class FederatedSoakDriver:
         canary_every: Optional[int] = None,
         probe_at: Optional[float] = None,
         probe=None,
+        admission=None,
+        autopilot=None,
+        autopilot_every: Optional[int] = None,
+        rtt_probes: int = 16,
     ):
         self.mesh = mesh
         self.scenario = scenario
@@ -621,9 +625,21 @@ class FederatedSoakDriver:
         #: `/fleet` endpoint there, mid-run by construction
         self.probe_at = probe_at
         self.probe = probe
+        #: one `AdmissionController` shared by every replica server for
+        #: the run (ISSUE-16): queue depths stay per-server/per-tenant,
+        #: so a shared controller means shared *policy*, not a shared
+        #: queue — and the autopilot retunes one object for the fleet
+        self.admission = admission
+        #: `FleetAutopilot` ticked every ``autopilot_every`` events
+        #: (default: with every periodic sync round) — ISSUE-16: the
+        #: scored on-vs-off experiment runs the same schedule either way
+        self.autopilot = autopilot
+        self.autopilot_every = max(1, autopilot_every or sync_every)
+        self.rtt_probes = rtt_probes
         self.canary = None  # CanaryProber while run() is live
         self._sessions: Dict[int, tuple] = {}  # sid -> (replica_id, Session)
         self._counts: Dict[str, int] = {}
+        self._e2e_hist = metrics.histogram("soak.apply_e2e")
 
     def _bump(self, key: str, n: int = 1) -> None:
         self._counts[key] = self._counts.get(key, 0) + n
@@ -681,24 +697,29 @@ class FederatedSoakDriver:
     def _handle_inner(self, ev, server, sess) -> None:
         if ev.kind == "apply":
             frame = Message.sync(SyncMessage.update(ev.payload)).encode_v1()
-            for _ in range(self.max_busy_retries + 1):
-                replies = server.receive_frames(sess, frame)
-                if not any(
-                    m.kind == MSG_BUSY
-                    for r in replies
-                    for m in message_reader(r)
-                ):
-                    self._bump("applied")
-                    break
-                # an admission-deferred update must not be lost: drain
-                # the backpressure valve and retry the SAME frame (the
-                # SoakDriver backlog discipline, inline)
-                self._bump("busy_replies")
-                flush = getattr(server, "flush_device", None)
-                if flush is not None:
-                    flush()
-            else:
-                self._bump("dropped_updates")
+            # e2e timing covers the WHOLE retry loop (ISSUE-16): a Busy
+            # deferral's flush+retry cost is latency the client saw, so
+            # the federated p99 scores admission behavior, not just the
+            # raw apply — the autopilot on-vs-off comparison surface
+            with self._e2e_hist.time():
+                for _ in range(self.max_busy_retries + 1):
+                    replies = server.receive_frames(sess, frame)
+                    if not any(
+                        m.kind == MSG_BUSY
+                        for r in replies
+                        for m in message_reader(r)
+                    ):
+                        self._bump("applied")
+                        break
+                    # an admission-deferred update must not be lost:
+                    # drain the backpressure valve and retry the SAME
+                    # frame (the SoakDriver backlog discipline, inline)
+                    self._bump("busy_replies")
+                    flush = getattr(server, "flush_device", None)
+                    if flush is not None:
+                        flush()
+                else:
+                    self._bump("dropped_updates")
         elif ev.kind == "diff":
             sv = StateVector.decode_v1(ev.payload)
             frame = Message.sync(SyncMessage.step1(sv)).encode_v1()
@@ -737,12 +758,36 @@ class FederatedSoakDriver:
         ).value
         return vals
 
+    def _measure_rtt_floor(self, scenario: Scenario) -> float:
+        """Idle-echo floor against the first tenant's owner (the
+        `SoakDriver` discipline): SyncStep1 carrying the server's OWN
+        state vector round-trips pure protocol + encode, so the
+        ``_adj`` SLO twins report mesh-attributable latency."""
+        tenant = scenario.tenants[0]
+        rep = self.mesh.route(tenant)
+        sess, _ = rep.server.connect_frames(tenant)
+        best = None
+        for _ in range(max(1, self.rtt_probes)):
+            sv = rep.server.tenant_state_vector(tenant)
+            frame = Message.sync(SyncMessage.step1(sv)).encode_v1()
+            t0 = time.perf_counter()
+            rep.server.receive_frames(sess, frame)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        rep.server.drain(sess)
+        rep.server.disconnect(sess)
+        return best or 0.0
+
     def run(self) -> Dict:
         mesh = self.mesh
         scenario = self.scenario
         root = scenario.config.root
         before = self._counter_deltas()
         self._counts = {}
+        if self.admission is not None:
+            for rep in mesh.replicas.values():
+                rep.server.admission = self.admission
+        e2e_w = HistogramWindow(self._e2e_hist)
         # the canary's tenants are created (and host-demoted) BEFORE the
         # scenario tenants claim their device slots: create-then-release
         # keeps at most one slot in flight, so probing never steals a
@@ -757,6 +802,7 @@ class FederatedSoakDriver:
         for tenant, shard in scenario.owner_shards(len(ids)).items():
             mesh.assign_owner(tenant, ids[shard])
         mesh.preregister_clients(s.client_id for s in scenario.sessions)
+        floor_s = self._measure_rtt_floor(scenario)
         schedule = list(scenario.events())
         total = len(schedule)
 
@@ -811,6 +857,11 @@ class FederatedSoakDriver:
                 mesh.sync_round()
                 if self.canary is not None:
                     self.canary.observe_round()
+            if (
+                self.autopilot is not None
+                and (i + 1) % self.autopilot_every == 0
+            ):
+                self.autopilot.tick()
             if (i + 1) % self.anti_entropy_every == 0:
                 mesh.anti_entropy_round()
         # convergence epilogue: sync + anti-entropy (recovering any
@@ -875,10 +926,14 @@ class FederatedSoakDriver:
             "failover_sessions_dropped_metric": delta[
                 "net.sessions_dropped.failover"
             ],
+            "rtt_floor_ms": round(floor_s * 1e3, 3),
+            **slo_report(e2e_w, floor_s, "apply_e2e_"),
             **{k: v for k, v in sorted(self._counts.items())},
         }
         if canary_report is not None:
             out["canary"] = canary_report
+        if self.autopilot is not None:
+            out["autopilot"] = self.autopilot.report()
         return out
 
 

@@ -279,6 +279,9 @@ class _PeerLink:
         # peer — both sides open with step1, per the protocol contract
         self.sess_a, greet_a = a.server.connect_frames(tenant)
         self.sess_b, greet_b = b.server.connect_frames(tenant)
+        # peer replication is mesh-internal: admission must not refuse it
+        self.sess_a.mesh_link = True
+        self.sess_b.mesh_link = True
         self._to_b.extend(greet_a)
         self._to_a.extend(greet_b)
 
@@ -303,10 +306,12 @@ class _PeerLink:
         if end == "b":
             self.b.server.disconnect(self.sess_b)
             self.sess_b, greet = self.b.server.connect_frames(self.tenant)
+            self.sess_b.mesh_link = True
             self._to_a.extend(greet)
             return self.sess_b
         self.a.server.disconnect(self.sess_a)
         self.sess_a, greet = self.a.server.connect_frames(self.tenant)
+        self.sess_a.mesh_link = True
         self._to_b.extend(greet)
         return self.sess_a
 
@@ -454,6 +459,11 @@ class ReplicaMesh:
         self._timeline_seq = 0
         #: tenant -> anti-entropy rounds since its last CLEAN pass
         self._conv_lag: Dict[str, int] = {}
+        #: replicas cleanly drained for maintenance (ISSUE-16): their
+        #: remaining sessions closed with ``reason="drain"`` and the
+        #: canary stops scoring them — a subsequent kill is planned
+        #: decommissioning, not a failure
+        self.decommissioned: Set[str] = set()
         for t in tenants:
             self.ensure_tenant(t)
 
@@ -897,6 +907,28 @@ class ReplicaMesh:
             "migration", tenant, src=src_id, dst=to_id, epoch=h.epoch
         )
         return h.epoch
+
+    def decommission(self, rid: str) -> int:
+        """Mark ``rid`` as cleanly drained for maintenance (ISSUE-16):
+        one final drain sync round ships its tail, any remaining client
+        sessions close with ``net.sessions_dropped{reason="drain"}``
+        (clients reconnect to the tenants' new owners — every owned
+        tenant should already have been migrated away), and the canary
+        prober stops probing it.  After this, `kill_replica` finds zero
+        sessions to drop — a drained kill must never count as a
+        failover failure.  Returns the sessions closed."""
+        if rid not in self.replicas:
+            raise KeyError(f"unknown replica {rid!r}")
+        rep = self.replicas[rid]
+        self.decommissioned.add(rid)
+        closed = 0
+        if rep.alive:
+            self.sync_round(fire_faults=False)
+            drop = getattr(rep.server, "drop_sessions", None)
+            if drop is not None:
+                closed = drop("drain")
+        self._record_event("decommission", replica=rid, closed=closed)
+        return closed
 
     def kill_replica(self, rid: str, drain: bool = True) -> int:
         """Forced failover: (optionally) drain the mesh so the victim

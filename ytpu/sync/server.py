@@ -39,7 +39,10 @@ class DeviceBatchFull(RuntimeError):
 
 
 class Session:
-    __slots__ = ("id", "tenant", "server", "outbox", "dead", "_depth_gauge")
+    __slots__ = (
+        "id", "tenant", "server", "outbox", "dead", "mesh_link",
+        "_depth_gauge",
+    )
 
     #: broadcast frames a session may hold undelivered before it is
     #: declared a slow consumer and evicted (its transport handler sees
@@ -53,6 +56,10 @@ class Session:
         self.server = server
         self.outbox: List[bytes] = []
         self.dead = False
+        # mesh-internal sessions (peer replication links) are not client
+        # traffic: admission must never Busy-refuse them, or replication
+        # under a tight client bound silently diverges (ISSUE-16)
+        self.mesh_link = False
         # cached gauge child: the push hot path updates a high-water mark
         # with one O(1) call, no name lookups (SURVEY §5.5)
         self._depth_gauge = server._outbox_depth
@@ -220,7 +227,9 @@ class SyncServer:
         marked dead and disconnected, `net.sessions_dropped{reason=
         "shed"}`)."""
         adm = self.admission
-        if adm is None:
+        if adm is None or session.mesh_link:
+            # peer replication bypasses the client valve: a refused peer
+            # update is not load shedding, it is data loss in flight
             return True, None
         from ytpu.serving.admission import Overload
 

@@ -95,6 +95,17 @@ Standard sites (see docs/robustness.md for the full taxonomy):
                       ``xor`` overrides the mask) — simulated silent
                       state divergence; the anti-entropy commitment
                       check must catch it as a typed `DivergenceFault`
+``autopilot.stall``   autopilot (ISSUE-16): skip the controller's next
+                      ``n`` ticks entirely (the control loop wedged) —
+                      the mesh must keep serving and converging without
+                      remediation, merely degraded; each skipped tick
+                      journals a ``fault/stall`` entry and increments
+                      ``autopilot.stalls``
+``autopilot.misfire`` autopilot (ISSUE-16): after the policy pass, take
+                      one WRONG but legal action (a seeded-RNG tenant
+                      migration to a seeded-RNG live replica) — byte
+                      parity must survive a misdirected controller,
+                      since migration only moves ownership, never state
 ====================  =======================================================
 
 Every fired injection increments the ``faults.injected`` counter (plus a
