@@ -9,6 +9,14 @@ is that check::
     python benches/bench_compare.py BENCH_r04.json BENCH_r05.json
     python benches/bench_compare.py old.json new.json --tol value=0.25
     python benches/bench_compare.py a.json b.json --default-tol 0.15
+    python benches/bench_compare.py --trend candidate.json
+
+``--trend`` (ISSUE-17) drops the explicit baseline: the candidate is
+diffed against a synthetic **best-ever** capture folded from every
+committed ``BENCH_r*.json`` with the candidate's platform tag (max over
+history for higher-is-better keys, min for lower-is-better) — a round
+that merely beats LAST round but falls short of the repo's best is
+still called out.
 
 Semantics:
 
@@ -43,7 +51,17 @@ import json
 import sys
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["flatten", "classify", "compare", "load_capture", "main"]
+__all__ = [
+    "flatten",
+    "classify",
+    "compare",
+    "load_capture",
+    "capture_surface",
+    "capture_platform",
+    "repo_captures",
+    "trend_baseline",
+    "main",
+]
 
 #: default relative tolerance for numeric fields (|b-a| / max(|a|,eps))
 DEFAULT_REL_TOL = 0.10
@@ -52,7 +70,23 @@ DEFAULT_REL_TOL = 0.10
 #: more specific fragments come first. "up" = higher is better, "down" =
 #: lower is better. Everything else is neutral: reported, never failing.
 _DIRECTION_RULES: Tuple[Tuple[str, str], ...] = (
+    # unified wall-time attribution (ISSUE-17): the profile_* fractions
+    # are a COMPOSITION of the wall budget, not better/worse — device
+    # fraction legitimately falls when staging gets faster. Pinned
+    # neutral FIRST so `profile_stall_fraction` never hits the
+    # directional stall_fraction rule below.
+    ("profile_", "neutral"),
+    ("fractions_sum", "neutral"),
     ("stall_fraction", "down"),
+    # compile/retrace sentinel (ISSUE-17): on the same warmed workload,
+    # more retraces or more cumulative trace seconds is a regression —
+    # a shape/static-plan leak re-entered the jit boundary. (Leaf
+    # "retraces" also catches the compile_retraces headline.)
+    ("retraces", "down"),
+    # scan_iterations_total is workload shape (its leaf would otherwise
+    # substring-match "s_total"); cumulative TRACE seconds regress on rise
+    ("scan_iterations_total", "neutral"),
+    ("s_total", "down"),
     ("_per_s", "up"),
     ("_per_sec", "up"),
     ("updates_per_s", "up"),
@@ -192,6 +226,79 @@ def compare(
     }
 
 
+def capture_surface(d: Dict) -> Dict:
+    """The measurement surface of a committed artifact: end-of-round
+    ``BENCH_r*.json`` wrap the bench one-line JSON under ``parsed``;
+    midsession captures ARE the surface. The bulky phases/metrics blobs
+    are stripped — trend verdicts regress headlines, not trace dumps."""
+    cap = d.get("parsed") if isinstance(d.get("parsed"), dict) else d
+    return {k: v for k, v in cap.items() if k not in ("phases", "metrics")}
+
+
+def capture_platform(d: Dict) -> str:
+    """First word of the capture's platform tag (``"cpu (1 vCPU)"`` →
+    ``"cpu"``), defaulting to ``host`` — the series key the trajectory
+    ledger uses, so trend baselines never mix hardware with host runs."""
+    return str(capture_surface(d).get("platform") or "host").split()[0]
+
+
+def repo_captures(directory: Optional[str] = None) -> List[Tuple[Tuple, Dict]]:
+    """Every loadable committed ``BENCH_r*.json`` as (rank, raw dict),
+    oldest round first. Rank mirrors `bench._capture_rank`: round number
+    from the filename, then the in-capture timestamp (mtime is useless —
+    a git checkout stamps every artifact at once)."""
+    import glob
+    import os
+    import re
+
+    if directory is None:
+        directory = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+    out = []
+    for path in glob.glob(os.path.join(directory, "BENCH_r*.json")):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+        rank = (
+            int(m.group(1)) if m else -1,
+            str(d.get("captured_at") or ""),
+        )
+        out.append((rank, d))
+    return sorted(out, key=lambda t: t[0])
+
+
+def trend_baseline(captures: List[Dict]) -> Dict[str, object]:
+    """Synthetic FLATTENED baseline for ``--trend`` (ISSUE-17): for every
+    directional numeric leaf across the captures, the BEST value ever
+    recorded (max for "up" keys, min for "down"); neutral and
+    non-numeric keys keep the newest capture's value. Comparing a
+    candidate against this regresses it against the repo's best-ever
+    trajectory point, not just whatever round happened to land last."""
+    base: Dict[str, object] = {}
+    for cap in captures:  # oldest → newest, so "newest wins" is last-write
+        for k, v in flatten(cap).items():
+            numeric = isinstance(v, (int, float)) and not isinstance(v, bool)
+            prior = base.get(k)
+            prior_numeric = isinstance(prior, (int, float)) and not isinstance(
+                prior, bool
+            )
+            if not (numeric and prior_numeric):
+                base[k] = v
+                continue
+            d = classify(k)
+            if d == "up":
+                base[k] = max(prior, v)
+            elif d == "down":
+                base[k] = min(prior, v)
+            else:
+                base[k] = v
+    return base
+
+
 def load_capture(path: str) -> Dict:
     """One JSON object from `path` — a `BENCH_*.json` capture or any log
     whose LAST non-empty line is the bench one-line JSON."""
@@ -235,8 +342,29 @@ def _render(diff: Dict, a_name: str, b_name: str) -> str:
 
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("a", help="baseline capture (JSON file)")
-    p.add_argument("b", help="candidate capture (JSON file)")
+    p.add_argument(
+        "a",
+        help="baseline capture (JSON file); with --trend, the CANDIDATE",
+    )
+    p.add_argument(
+        "b",
+        nargs="?",
+        default=None,
+        help="candidate capture (JSON file); omitted with --trend",
+    )
+    p.add_argument(
+        "--trend",
+        action="store_true",
+        help="regress the candidate against the best-ever committed "
+        "BENCH_r*.json values for its platform tag instead of one "
+        "explicit baseline",
+    )
+    p.add_argument(
+        "--captures-dir",
+        default=None,
+        metavar="DIR",
+        help="where --trend looks for BENCH_r*.json (default: repo root)",
+    )
     p.add_argument(
         "--tol",
         action="append",
@@ -267,17 +395,46 @@ def main(argv: Optional[List[str]] = None) -> int:
         except ValueError:
             print(f"bad --tol fraction {v!r}", file=sys.stderr)
             return 2
-    try:
-        a = load_capture(args.a)
-        b = load_capture(args.b)
-    except (OSError, json.JSONDecodeError) as e:
-        print(f"load error: {e}", file=sys.stderr)
+    if args.trend:
+        cand_path = args.b or args.a
+        try:
+            cand_raw = load_capture(cand_path)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"load error: {e}", file=sys.stderr)
+            return 2
+        cand = capture_surface(cand_raw)
+        platform = capture_platform(cand_raw)
+        history = [
+            capture_surface(d)
+            for _, d in repo_captures(args.captures_dir)
+            if capture_platform(d) == platform
+        ]
+        history = [h for h in history if h]
+        if not history:
+            print(
+                f"--trend: no committed BENCH_r*.json with platform "
+                f"{platform!r} to fold a baseline from",
+                file=sys.stderr,
+            )
+            return 2
+        a, b = trend_baseline(history), cand
+        a_name = f"<best-ever:{platform}:{len(history)} captures>"
+    elif args.b is None:
+        print("candidate capture missing (or use --trend)", file=sys.stderr)
         return 2
+    else:
+        try:
+            a = load_capture(args.a)
+            b = load_capture(args.b)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"load error: {e}", file=sys.stderr)
+            return 2
+        a_name = args.a
     diff = compare(a, b, tolerances, args.default_tol)
     if args.json:
         print(json.dumps(diff))
     else:
-        print(_render(diff, args.a, args.b))
+        print(_render(diff, a_name, args.b or args.a))
     return 1 if diff["regressions"] else 0
 
 

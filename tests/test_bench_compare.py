@@ -138,6 +138,115 @@ def test_direction_classification_rules():
     assert bc.classify("autopilot.actions_by_policy.maintenance") == "neutral"
     assert bc.classify("phases.replay.stage.execute_s") == "neutral"
     assert bc.classify("chunks") == "neutral"
+    # performance observatory (ISSUE-17): retrace counts and cumulative
+    # trace seconds regress when they RISE on the same warmed workload —
+    # a shape/static-plan leak re-entered the jit boundary
+    assert bc.classify("compile_retraces") == "down"
+    assert bc.classify("metrics.compile.retraces") == "down"
+    assert bc.classify("observatory.clean.retraces") == "down"
+    assert bc.classify("metrics.compile.s_total") == "down"
+    # ...while the wall-time attribution fractions are a COMPOSITION of
+    # the budget, not better/worse — pinned neutral, including the one
+    # whose leaf would otherwise substring-match stall_fraction
+    assert bc.classify("profile_device_fraction") == "neutral"
+    assert bc.classify("profile_stall_fraction") == "neutral"
+    assert bc.classify("profile_idle_fraction") == "neutral"
+    assert bc.classify("observatory.profile.profile_net_fraction") == "neutral"
+    assert bc.classify("profile.fractions_sum") == "neutral"
+    # plain stall_fraction (ISSUE-7 staging gauge) keeps its direction
+    assert bc.classify("stall_fraction") == "down"
+    assert bc.classify("ingest_raw.stall_fraction") == "down"
+    # workload-shape counter whose leaf contains "s_total" stays neutral
+    assert bc.classify("metrics.integrate.scan_iterations_total") == "neutral"
+
+
+def test_observatory_families_regress_on_rise():
+    """ISSUE-17 satellite: a retrace-count or trace-seconds rise is a
+    REGRESSION; profile fraction drift is reported-neutral."""
+    a = {
+        "compile_retraces": 0,
+        "metrics": {"compile.s_total": 2.0},
+        "profile_device_fraction": 0.4,
+        "profile_stall_fraction": 0.05,
+    }
+    b = {
+        "compile_retraces": 3,  # warmed run started retracing: regression
+        "metrics": {"compile.s_total": 9.0},  # tracing cost blew up
+        "profile_device_fraction": 0.2,  # composition shift: neutral
+        "profile_stall_fraction": 0.2,  # neutral (NOT the staging gauge)
+    }
+    diff = bc.compare(a, b)
+    keys = {e["key"] for e in diff["regressions"]}
+    assert keys == {"compile_retraces", "metrics.compile.s_total"}, diff
+    assert {e["key"] for e in diff["changes"]} == {
+        "profile_device_fraction",
+        "profile_stall_fraction",
+    }
+    # the inverse direction is an improvement, never a failure
+    diff = bc.compare(b, a)
+    assert not diff["regressions"], diff
+
+
+def test_trend_baseline_folds_best_ever():
+    """ISSUE-17: the --trend baseline takes the BEST value per
+    directional key across history (max for up, min for down), newest
+    value for neutral/non-numeric keys."""
+    history = [
+        {"value": 100.0, "soak": {"apply_p99_ms": 8.0}, "note": "old"},
+        {"value": 300.0, "soak": {"apply_p99_ms": 2.0}, "note": "peak"},
+        {"value": 200.0, "soak": {"apply_p99_ms": 5.0}, "note": "new"},
+    ]
+    base = bc.trend_baseline(history)
+    assert base["value"] == 300.0  # best-ever, not last
+    assert base["soak.apply_p99_ms"] == 2.0  # best-ever latency floor
+    assert base["note"] == "new"  # neutral: newest wins
+    # a candidate that beats LAST round but not the best still regresses
+    cand = {"value": 250.0, "soak": {"apply_p99_ms": 2.1}, "note": "cand"}
+    diff = bc.compare(base, bc.flatten(cand))
+    assert {e["key"] for e in diff["regressions"]} == {"value"}, diff
+
+
+def test_trend_cli_against_synthetic_captures(tmp_path):
+    """--trend end to end: committed-round folding is platform-keyed,
+    end-of-round artifacts unwrap their `parsed` surface, and the exit
+    code carries the verdict."""
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps(
+            {"rc": 0, "parsed": {"platform": "tpu", "value": 100.0}}
+        )
+    )
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps({"platform": "tpu", "value": 500.0})
+    )
+    # a different platform's round must NOT leak into the tpu baseline
+    (tmp_path / "BENCH_r03.json").write_text(
+        json.dumps({"platform": "cpu", "value": 9999.0})
+    )
+    tool = os.path.join(ROOT, "benches", "bench_compare.py")
+
+    def run_trend(cand):
+        p = tmp_path / "cand.json"
+        p.write_text(json.dumps(cand))
+        return subprocess.run(
+            [
+                sys.executable,
+                tool,
+                "--trend",
+                str(p),
+                "--captures-dir",
+                str(tmp_path),
+            ],
+            capture_output=True,
+            text=True,
+        )
+
+    res = run_trend({"platform": "tpu", "value": 200.0})
+    assert res.returncode == 1, res.stdout + res.stderr  # < best-ever 500
+    assert "REGRESSION" in res.stdout
+    res = run_trend({"platform": "tpu", "value": 510.0})
+    assert res.returncode == 0, res.stdout + res.stderr  # new best
+    res = run_trend({"platform": "gpu", "value": 1.0})
+    assert res.returncode == 2, res.stdout + res.stderr  # no history
 
 
 def test_cli_exit_codes_and_last_line_loading(tmp_path):
@@ -187,7 +296,7 @@ def test_dry_run_self_compare_through_cli(tmp_path):
         [sys.executable, os.path.join(ROOT, "bench.py"), "--dry-run"],
         capture_output=True,
         text=True,
-        timeout=420,
+        timeout=600,  # the ISSUE-17 observatory leg adds a real ~15s retrace
         cwd=ROOT,
         env=env,
     )

@@ -1458,17 +1458,24 @@ def _apply_update_one_doc(
     return _recompute_moves(state, moves_dirty, client_rank), scan_hist
 
 
-@jax.jit
+@partial(jax.jit, static_argnums=3)
 def apply_update_batch(
-    state: DocStateBatch, batch: UpdateBatch, client_rank: jax.Array
+    state: DocStateBatch,
+    batch: UpdateBatch,
+    client_rank: jax.Array,
+    scan_plan: Optional[tuple] = None,
 ) -> DocStateBatch:
     """Integrate one decoded update per doc — the north-star entry point.
 
     `client_rank` is the [C] interned-client rank table (shared by all docs).
+    `scan_plan` is the two-tier static (None = `scan_tier_plan()` read at
+    trace time — the public wrapper re-reads per call and threads it, so
+    a changed knob retraces instead of silently reusing the old plan).
     """
-    state, _hist = jax.vmap(_apply_update_one_doc, in_axes=(0, 0, None))(
-        state, batch, client_rank
-    )
+    state, _hist = jax.vmap(
+        lambda s, b, cr: _apply_update_one_doc(s, b, cr, scan_plan),
+        in_axes=(0, 0, None),
+    )(state, batch, client_rank)
     return state
 
 
@@ -1548,6 +1555,30 @@ def encode_diff_batch(state: DocStateBatch, remote_sv: jax.Array, n_clients: int
     offsets = jnp.clip(remote_clock - bl.clock, 0, None) * ship
     local_sv = sv_from_blocks(bl.client, bl.clock, bl.length, n_clients)
     return ship, offsets, local_sv, bl.deleted & valid
+
+
+_encode_diff_batch_jit = encode_diff_batch
+
+
+def encode_diff_batch(
+    state: DocStateBatch, remote_sv: jax.Array, n_clients: int
+):
+    from ytpu.utils.phases import NULL_SPAN, phases
+
+    span = (
+        phases.span(
+            "encode.diff_batch",
+            (state.blocks.client.shape, remote_sv.shape, n_clients),
+            axes=("state", "remote_sv", "n_clients"),
+        )
+        if phases.enabled
+        else NULL_SPAN
+    )
+    with span:
+        return _encode_diff_batch_jit(state, remote_sv, n_clients)
+
+
+encode_diff_batch.__doc__ = _encode_diff_batch_jit.__doc__
 
 
 def finish_encode_diff(
@@ -1902,9 +1933,24 @@ def _donation_usable() -> bool:
 
 def compact_finisher_rows(bl, ship, offsets, deleted, idx, R):
     """Dispatch `_compact_finisher_rows_impl`, donating `idx` on device
-    backends (it is never read again after the dispatch consumes it)."""
+    backends (it is never read again after the dispatch consumes it).
+    The `encode.pack` span keys the compiled pack family — `(sub, R)`
+    via idx.shape/R plus the state width — so the retrace sentinel sees
+    a family explosion the moment pow2 discipline slips (ISSUE-17)."""
+    from ytpu.utils.phases import NULL_SPAN, phases
+
     fn = _compact_rows_donated if _donation_usable() else _compact_rows_plain
-    return fn(bl, ship, offsets, deleted, idx, R)
+    span = (
+        phases.span(
+            "encode.pack",
+            (bl.client.shape, idx.shape, R),
+            axes=("state", "idx", "R"),
+        )
+        if phases.enabled
+        else NULL_SPAN
+    )
+    with span:
+        return fn(bl, ship, offsets, deleted, idx, R)
 
 
 def _compact_rows_cache_size() -> int:
@@ -3380,10 +3426,12 @@ _apply_update_stream_jit = apply_update_stream
 # carry on the classic stream lane — a standalone caller pays nothing
 # for the attribution it isn't reading (the chunk programs, which DO
 # read it, trace through the tuple body instead)
-_apply_update_stream_state_jit = partial(jax.jit, donate_argnums=0)(
-    lambda state, stream, client_rank: _apply_update_stream_hist_body(
-        state, stream, client_rank
-    )[0]
+_apply_update_stream_state_jit = partial(
+    jax.jit, donate_argnums=0, static_argnums=3
+)(
+    lambda state, stream, client_rank, scan_plan=None: (
+        _apply_update_stream_hist_body(state, stream, client_rank, scan_plan)[0]
+    )
 )
 
 
@@ -3399,16 +3447,22 @@ def apply_update_batch(
     # Under jit tracing (tracer args) the id lookup misses — correct, the
     # traced program's operands are maintained by the XLA lane itself.
     state = ensure_origin_slot(state)
+    # two-tier scan plan: env re-read per CALL and threaded as a static
+    # (same discipline as the chunk programs) — a changed knob retraces
+    # instead of silently reusing the old unroll, and the span key
+    # carries the plan so the sentinel attributes the retrace to it
+    scan_plan = scan_tier_plan()
     span = (
         phases.span(
             "integrate.xla_batch",
-            (state.blocks.client.shape, batch.client.shape),
+            (state.blocks.client.shape, batch.client.shape, scan_plan),
+            axes=("state", "batch", "scan_plan"),
         )
         if phases.enabled
         else NULL_SPAN
     )
     with span:
-        return _apply_update_batch_jit(state, batch, client_rank)
+        return _apply_update_batch_jit(state, batch, client_rank, scan_plan)
 
 
 def apply_update_stream(
@@ -3419,10 +3473,13 @@ def apply_update_stream(
 
     tick()
     state = ensure_origin_slot(state)
+    # two-tier scan plan as a per-call static (see apply_update_batch)
+    scan_plan = scan_tier_plan()
     span = (
         phases.span(
             "integrate.xla_stream",
-            (state.blocks.client.shape, stream.client.shape),
+            (state.blocks.client.shape, stream.client.shape, scan_plan),
+            axes=("state", "stream", "scan_plan"),
         )
         if phases.enabled
         else NULL_SPAN
@@ -3431,7 +3488,9 @@ def apply_update_stream(
         # state-only compiled variant: the scan-width record (ISSUE-11)
         # is dropped in-jit and DCE'd — the chunk programs are the
         # consumers that fold the histogram into the lazy readout
-        return _apply_update_stream_state_jit(state, stream, client_rank)
+        return _apply_update_stream_state_jit(
+            state, stream, client_rank, scan_plan
+        )
 
 
 apply_update_batch.__doc__ = _apply_update_batch_jit.__doc__
@@ -3457,7 +3516,9 @@ def _register_programs():
     progbudget.register(
         "apply_update_stream_state", _apply_update_stream_state_jit
     )
-    progbudget.register("encode_diff_batch", encode_diff_batch)
+    # the raw jit, not the instrumented wrapper — progbudget tracks
+    # compiled-executable caches, and the wrapper has none of its own
+    progbudget.register("encode_diff_batch", _encode_diff_batch_jit)
     progbudget.register("finish_pack", _finish_pack)
     progbudget.register("finish_counts", _finish_counts)
     progbudget.register("state_vectors", state_vectors)

@@ -522,7 +522,9 @@ def compact_state(state: DocStateBatch) -> DocStateBatch:
     # or the unrefreshed cache would launder into a "clean" wrong one
     stale = origin_slot_is_stale(state)
     span = (
-        phases.span("compact.state", (state.blocks.client.shape,))
+        phases.span(
+            "compact.state", (state.blocks.client.shape,), axes=("state",)
+        )
         if phases.enabled
         else NULL_SPAN
     )
@@ -537,7 +539,11 @@ def compact_packed(cols, meta, unit_refs: bool = False, gc_ranges: bool = False)
     from ytpu.utils.phases import NULL_SPAN, phases
 
     span = (
-        phases.span("compact.packed", (cols.shape, unit_refs, gc_ranges))
+        phases.span(
+            "compact.packed",
+            (cols.shape, unit_refs, gc_ranges),
+            axes=("cols", "unit_refs", "gc_ranges"),
+        )
         if phases.enabled
         else NULL_SPAN
     )

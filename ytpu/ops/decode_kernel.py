@@ -1577,6 +1577,9 @@ def decode_updates_v1(
             (buf.shape, max_rows, max_dels, n_steps, max_sections,
              client_table is not None, key_table is not None,
              client_hash_table is not None, primary_root_hash is not None),
+            axes=("buf", "max_rows", "max_dels", "n_steps",
+                  "max_sections", "client_table", "key_table",
+                  "client_hash_table", "primary_root_hash"),
         )
     else:
         span = NULL_SPAN

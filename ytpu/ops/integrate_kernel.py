@@ -1176,6 +1176,9 @@ def apply_update_stream_fused(
             "integrate.fused",
             (cols.shape, rows.shape, dels.shape, d_block, interpret,
              _debug_phases, _debug_row_phase, vmem_mb, scan_plan),
+            axes=("state", "rows", "dels", "d_block", "interpret",
+                  "debug_phases", "debug_row_phase", "vmem_mb",
+                  "scan_plan"),
         )
     else:
         span = NULL_SPAN
@@ -2111,6 +2114,8 @@ class PackedReplayDriver:
                         "replay.chunk_fused",
                         (self.cols.shape, rows.shape, dels.shape,
                          self.d_block, scan_plan),
+                        axes=("state", "rows", "dels", "d_block",
+                              "scan_plan"),
                     )
                 else:
                     span = NULL_SPAN
@@ -2130,6 +2135,7 @@ class PackedReplayDriver:
                 _phases.span(
                     "replay.chunk_xla",
                     (self.cols.shape, stream.client.shape, scan_plan),
+                    axes=("state", "stream", "scan_plan"),
                 )
                 if _phases.enabled
                 else NULL_SPAN
@@ -2147,7 +2153,7 @@ class PackedReplayDriver:
             self._drain_readouts()
 
     def _step_one_dispatch(self, stage, host_arrays, margin, span_tail,
-                           program, **program_kw):
+                           program, span_axes=(), **program_kw):
         """Shared mechanics of the one-dispatch byte lanes (`step_bytes`
         / `step_raw`): progbudget tick, pre-chunk room check, the
         zero-copy-backend host copy, h2d accounting, the lane-laddered
@@ -2184,6 +2190,8 @@ class PackedReplayDriver:
                     stage,
                     (self.cols.shape, *span_tail, lane, self.d_block,
                      vmem_mb, scan_plan),
+                    axes=("state", *span_axes, "lane", "d_block",
+                          "vmem_mb", "scan_plan"),
                 )
                 if _phases.enabled
                 else NULL_SPAN
@@ -2236,6 +2244,7 @@ class PackedReplayDriver:
             margin,
             (buf.shape, refs.shape, tuple(dims)),
             replay_chunk_program,
+            span_axes=("buf", "refs", "dims"),
             max_rows=max_rows,
             max_dels=max_dels,
             n_steps=n_steps,
@@ -2259,6 +2268,7 @@ class PackedReplayDriver:
             margin,
             (raw.shape, refs.shape, tuple(dims), width),
             replay_chunk_program_raw,
+            span_axes=("raw", "refs", "dims", "width"),
             width=width,
             max_rows=max_rows,
             max_dels=max_dels,

@@ -52,6 +52,8 @@ from ytpu.sync.protocol import (
 )
 from ytpu.utils import metrics
 from ytpu.utils.faults import faults
+from ytpu.utils.phases import compile_storm_provider, phases
+from ytpu.utils.profile import ProfileWindow
 from ytpu.utils.slo import (
     HistogramWindow,
     slo_report,
@@ -140,6 +142,7 @@ class SoakDriver:
         telemetry_port: Optional[int] = None,
         probe_at: Optional[float] = None,
         probe=None,
+        retrace_budget: Optional[int] = None,
     ):
         self.server = server
         self.scenario = scenario
@@ -152,6 +155,12 @@ class SoakDriver:
         self.ckpt_dir = ckpt_dir
         self.rtt_probes = rtt_probes
         self.max_busy_retries = max_busy_retries
+        #: compile sentinel budget (ISSUE-17): max retraces this run may
+        #: score before the report flags it and the `compile` health
+        #: provider degrades `/healthz`; None = report-only (a cold run
+        #: legitimately retraces as shapes appear — only a WARMED run
+        #: should pin the budget)
+        self.retrace_budget = retrace_budget
         #: mid-soak observation hook: at fraction ``probe_at`` of round
         #: 0's schedule, ``probe()`` is called — the telemetry rehearsal
         #: scrapes the live HTTP endpoints there, mid-run by construction
@@ -405,6 +414,21 @@ class SoakDriver:
         scenario = self.scenario
         self._preregister_clients(scenario)
         rtt_floor_s = self._measure_rtt_floor(scenario)
+        # compile/retrace sentinel window (ISSUE-17): everything above
+        # (client preregistration, RTT pings) is warmup — compile events
+        # past this marker belong to THIS run, and retraces among them
+        # score against `retrace_budget`. The profile window baselines
+        # the wall-time attribution over the same span.
+        compile_marker = phases.compile_marker()
+        profile_window = ProfileWindow()
+        if self.telemetry is not None:
+            self.telemetry.add_health_provider(
+                "compile",
+                compile_storm_provider(
+                    budget=self.retrace_budget, marker=compile_marker
+                ),
+            )
+            self.telemetry.set_profile_source(profile_window.report)
         # fresh delta windows per run(): back-to-back soak runs (or
         # rounds driven as separate runs) must never blend percentiles —
         # the windows below this line see ONLY this run's samples
@@ -521,6 +545,17 @@ class SoakDriver:
         report["encode_demotions"] = (
             metrics.counter("encode.demotions").value - enc_demotions_before
         )
+        # sentinel + attribution sections (ISSUE-17): retraces since the
+        # post-warmup marker (journal names the changed axis) and the
+        # top-down wall budget over the same window
+        compile_rep = phases.compile_report(since=compile_marker)
+        compile_rep["budget"] = self.retrace_budget
+        compile_rep["within_budget"] = (
+            self.retrace_budget is None
+            or compile_rep["retraces"] <= self.retrace_budget
+        )
+        report["compile"] = compile_rep
+        report["profile"] = profile_window.report(wall_s=wall_s)
         mirror = self._mirror_parity()
         if mirror is not None:
             report["mirror_parity"] = mirror
@@ -599,6 +634,7 @@ class FederatedSoakDriver:
         autopilot=None,
         autopilot_every: Optional[int] = None,
         rtt_probes: int = 16,
+        retrace_budget: Optional[int] = None,
     ):
         self.mesh = mesh
         self.scenario = scenario
@@ -636,6 +672,9 @@ class FederatedSoakDriver:
         self.autopilot = autopilot
         self.autopilot_every = max(1, autopilot_every or sync_every)
         self.rtt_probes = rtt_probes
+        #: compile sentinel budget (ISSUE-17; `SoakDriver.retrace_budget`
+        #: semantics: None = report-only)
+        self.retrace_budget = retrace_budget
         self.canary = None  # CanaryProber while run() is live
         self._sessions: Dict[int, tuple] = {}  # sid -> (replica_id, Session)
         self._counts: Dict[str, int] = {}
@@ -803,6 +842,10 @@ class FederatedSoakDriver:
             mesh.assign_owner(tenant, ids[shard])
         mesh.preregister_clients(s.client_id for s in scenario.sessions)
         floor_s = self._measure_rtt_floor(scenario)
+        # sentinel + attribution windows (ISSUE-17): the SoakDriver
+        # discipline — preregistration/RTT pings are warmup
+        compile_marker = phases.compile_marker()
+        profile_window = ProfileWindow()
         schedule = list(scenario.events())
         total = len(schedule)
 
@@ -934,6 +977,14 @@ class FederatedSoakDriver:
             out["canary"] = canary_report
         if self.autopilot is not None:
             out["autopilot"] = self.autopilot.report()
+        compile_rep = phases.compile_report(since=compile_marker)
+        compile_rep["budget"] = self.retrace_budget
+        compile_rep["within_budget"] = (
+            self.retrace_budget is None
+            or compile_rep["retraces"] <= self.retrace_budget
+        )
+        out["compile"] = compile_rep
+        out["profile"] = profile_window.report(wall_s=wall_s)
         return out
 
 

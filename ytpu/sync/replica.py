@@ -1020,9 +1020,11 @@ class ReplicaMesh:
         state — the per-instance tally `SyncServer.applied_local`, the
         replica's own tenant/session maps, and the shared ownership map
         filtered to this replica."""
+        from ytpu.utils.profile import profile_fractions
+
         rep = self.replicas[rid]
         owned = [t for t, (o, _e) in self.owner.items() if o == rid]
-        return {
+        out = {
             "replica.alive": 1.0 if rep.alive else 0.0,
             "replica.tenants": float(len(rep.server.tenants)),
             "replica.sessions": float(
@@ -1036,6 +1038,13 @@ class ReplicaMesh:
                 sum(1 for t in owned if t in self.quarantined)
             ),
         }
+        # unified wall-time budget per replica (ISSUE-17): in-proc
+        # replicas share the process recorder, so the fractions are the
+        # process-lifetime window — still the right scrape shape for the
+        # merged exposition (one `profile_*_fraction{replica=}` series
+        # per bucket), and a cross-process pod reports its own
+        out.update(profile_fractions())
+        return out
 
     def attach_telemetry(self, telemetry) -> None:
         """Full fleet-observability attach (ISSUE-15): `/healthz` +

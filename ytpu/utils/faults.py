@@ -106,6 +106,12 @@ Standard sites (see docs/robustness.md for the full taxonomy):
                       migration to a seeded-RNG live replica) — byte
                       parity must survive a misdirected controller,
                       since migration only moves ownership, never state
+``compile.retrace``   observability (ISSUE-17): perturb the next
+                      instrumented jit boundary's shape signature with
+                      a nonce (args: ``program`` restricts to one
+                      phases stage) — forces an attributable retrace
+                      event so chaos can prove the compile sentinel and
+                      its budget scoring fire end to end
 ====================  =======================================================
 
 Every fired injection increments the ``faults.injected`` counter (plus a

@@ -26,6 +26,7 @@ Exporters:
 from __future__ import annotations
 
 import math
+import os
 import re
 import threading
 import time
@@ -59,6 +60,23 @@ def _sanitize(name: str) -> str:
     return s or "_"
 
 
+#: reserved label value every over-cap label-set folds into (ISSUE-17):
+#: per-tenant labels are unbounded in production, and an unbounded child
+#: dict tears `/metrics` (scrape size, lock hold time) long before it
+#: ooms — past the cap a family aggregates the tail under `other`
+_OVERFLOW_LABEL = "other"
+
+
+def _max_labelsets() -> int:
+    """Per-family distinct label-set cap (env-tunable, read per miss —
+    the miss path is already the slow path, and a test must be able to
+    lower it without re-importing)."""
+    try:
+        return int(os.environ.get("YTPU_METRICS_MAX_LABELSETS", "512"))
+    except ValueError:
+        return 512
+
+
 class _Family:
     """Shared label plumbing: a family keyed by label-value tuples.
 
@@ -89,12 +107,28 @@ class _Family:
             return self
         key = tuple(str(v) for v in values)
         child = self._children.get(key)
+        dropped = False
         if child is None:
             with self._lock:
                 child = self._children.get(key)
                 if child is None:
-                    child = self._make_child(key)
-                    self._children[key] = child
+                    # label-cardinality guard (ISSUE-17): past the cap,
+                    # NEW label-sets fold into one reserved `other`
+                    # child — established children keep their series
+                    if len(self._children) >= _max_labelsets():
+                        key = tuple(
+                            _OVERFLOW_LABEL for _ in self.labelnames
+                        )
+                        child = self._children.get(key)
+                        dropped = True
+                    if child is None:
+                        child = self._make_child(key)
+                        self._children[key] = child
+        if dropped:
+            # outside the family lock: the counter lives in the global
+            # registry (registry lock), and exporters take registry →
+            # family — taking family → registry here would invert it
+            metrics.counter("metrics.cardinality_dropped").inc()
         return child
 
     def _make_child(self, key: Tuple[str, ...]):
@@ -392,3 +426,7 @@ class MetricsRegistry:
 
 
 metrics = MetricsRegistry()
+
+#: the cardinality guard's drop signal, registered eagerly so a scrape
+#: sees the series (at 0) before the first fold ever happens
+metrics.counter("metrics.cardinality_dropped")

@@ -18,6 +18,23 @@ host-only stage with no compile phase. Because JAX dispatch is async,
 does) — the recorder itself NEVER adds a device sync, so it is safe on
 the hot path.
 
+Compile/retrace sentinel (ISSUE-17): beyond charging the time, every
+first sighting is journaled as a *compile event* carrying the full
+shape signature. A program's SECOND-or-later distinct signature is a
+**retrace** — real recompilation on a warmed program, the silent tax
+the PR-9 first-seen-client bug paid. Call sites may name the key's
+positions via ``axes=("state", "rows", ..., "scan_plan")`` so the
+journal's signature DELTA says *which axis changed* (an unnamed
+position reports as ``argN``). Events surface three registry families
+(looked up fresh on the rare compile path, so a test-time
+``metrics.reset()`` can't orphan them): ``compile.events{program=}``,
+``compile.retraces`` and ``compile.s_total``. Runs score retraces
+against a budget via ``compile_marker()`` / ``compile_report(since=)``,
+and ``compile_storm_provider`` turns a blown budget into a degraded
+``/healthz`` (the ``compile.storm`` signal). A ``compile.retrace``
+fault site perturbs the signature on demand so chaos can prove the
+detector fires end to end.
+
 Disabled-path contract (the default): one attribute check, zero
 allocation — call sites guard with ``if phases.enabled:`` before
 building keys, and ``span()`` hands back a shared no-op context
@@ -36,9 +53,18 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
-__all__ = ["PhaseRecorder", "phases", "NULL_SPAN"]
+__all__ = [
+    "PhaseRecorder",
+    "phases",
+    "NULL_SPAN",
+    "compile_storm_provider",
+]
+
+#: journal ring bound — a run that compiles more programs than this is
+#: itself a compile storm; the TAIL is what the sentinel reports on
+_MAX_COMPILE_EVENTS = 4096
 
 
 class _NullSpan:
@@ -75,13 +101,36 @@ class _Stage:
         self.value = None  # scalar gauge (overlap_ratio, in-flight depth)
 
 
-class _PhaseSpan:
-    __slots__ = ("_rec", "_stage", "_key", "_start")
+def _sig_delta(prev, new, axes) -> List[Dict[str, str]]:
+    """Element-wise diff of two signatures with axis-name attribution.
+    Non-tuple keys compare as one-element tuples; a length change shows
+    as an axis appearing/disappearing against ``<absent>``."""
+    prev_t = prev if isinstance(prev, tuple) else (prev,)
+    new_t = new if isinstance(new, tuple) else (new,)
+    axes = tuple(axes or ())
+    delta: List[Dict[str, str]] = []
+    for i in range(max(len(prev_t), len(new_t))):
+        a = prev_t[i] if i < len(prev_t) else "<absent>"
+        b = new_t[i] if i < len(new_t) else "<absent>"
+        if a != b:
+            delta.append(
+                {
+                    "axis": axes[i] if i < len(axes) else f"arg{i}",
+                    "prev": repr(a),
+                    "new": repr(b),
+                }
+            )
+    return delta
 
-    def __init__(self, rec: "PhaseRecorder", stage: str, key):
+
+class _PhaseSpan:
+    __slots__ = ("_rec", "_stage", "_key", "_axes", "_start")
+
+    def __init__(self, rec: "PhaseRecorder", stage: str, key, axes=None):
         self._rec = rec
         self._stage = stage
         self._key = key
+        self._axes = axes
 
     def __enter__(self):
         self._start = time.perf_counter()
@@ -90,6 +139,7 @@ class _PhaseSpan:
     def __exit__(self, *exc):
         dt = time.perf_counter() - self._start
         rec = self._rec
+        event = None
         with rec._lock:
             st = rec._stages.get(self._stage)
             if st is None:
@@ -101,8 +151,13 @@ class _PhaseSpan:
                 rec._seen.add((self._stage, self._key))
                 st.compile_calls += 1
                 st.compile_s += dt
+                event = rec._record_compile_locked(
+                    self._stage, self._key, self._axes, dt
+                )
             else:
                 st.execute_s += dt
+        if event is not None:
+            rec._emit_compile_metrics(event)
         return False
 
 
@@ -112,6 +167,14 @@ class PhaseRecorder:
         self._stages: Dict[str, _Stage] = {}
         self._seen: set = set()
         self._lock = threading.Lock()
+        # ---- compile/retrace sentinel state (ISSUE-17) ----
+        #: per program (stage): signatures in first-sighting order
+        self._signatures: Dict[str, List] = {}
+        #: per program: last axes names supplied by its call site
+        self._axes: Dict[str, Tuple[str, ...]] = {}
+        #: compile-event journal (bounded ring; see compile_events)
+        self._events: List[Dict] = []
+        self._event_seq = 0
 
     def enable(self) -> None:
         self.enabled = True
@@ -123,13 +186,118 @@ class PhaseRecorder:
         with self._lock:
             self._stages.clear()
             self._seen.clear()
+            self._signatures.clear()
+            self._axes.clear()
+            self._events.clear()
+            self._event_seq = 0
 
-    def span(self, stage: str, key=None):
+    # --- compile/retrace sentinel (ISSUE-17) ---------------------------------
+
+    def _record_compile_locked(self, stage: str, key, axes, dt: float):
+        """Journal one first-sighting (caller holds the lock). The
+        SECOND-or-later signature for a program is a retrace; its delta
+        names the axis that changed vs the previous signature."""
+        sigs = self._signatures.setdefault(stage, [])
+        if axes:
+            self._axes[stage] = tuple(axes)
+        retrace = bool(sigs)
+        delta = (
+            _sig_delta(sigs[-1], key, self._axes.get(stage))
+            if retrace
+            else []
+        )
+        sigs.append(key)
+        self._event_seq += 1
+        event = {
+            "seq": self._event_seq,
+            "program": stage,
+            "compile_s": round(dt, 6),
+            "signature": repr(key),
+            "retrace": retrace,
+            "delta": delta,
+        }
+        self._events.append(event)
+        if len(self._events) > _MAX_COMPILE_EVENTS:
+            del self._events[: len(self._events) - _MAX_COMPILE_EVENTS]
+        return event
+
+    @staticmethod
+    def _emit_compile_metrics(event: Dict) -> None:
+        """Registry families for the sentinel — looked up fresh (the
+        compile path is rare, and cached family objects would be
+        orphaned by a test-time ``metrics.reset()``)."""
+        try:
+            from ytpu.utils.metrics import metrics
+        except Exception:  # pragma: no cover - import cycles in teardown
+            return
+        metrics.counter("compile.events", labelnames=("program",)).labels(
+            event["program"]
+        ).inc()
+        metrics.gauge("compile.s_total").inc(event["compile_s"])
+        if event["retrace"]:
+            metrics.counter("compile.retraces").inc()
+
+    def _fault_key(self, stage: str, key):
+        """``compile.retrace`` fault site: a firing spec perturbs the
+        signature with a nonce, forcing an attributable retrace — how
+        chaos proves the sentinel catches real recompiles."""
+        try:
+            from ytpu.utils.faults import faults
+        except Exception:  # pragma: no cover
+            return key
+        if not faults.active:
+            return key
+        spec = faults.fire("compile.retrace", program=stage)
+        if spec is None:
+            return key
+        nonce = ("__fault__", spec.fired)
+        return key + (nonce,) if isinstance(key, tuple) else (key, nonce)
+
+    def compile_marker(self) -> int:
+        """Opaque high-water mark for ``compile_report(since=...)`` —
+        take one after warmup; events at or before it are 'expected
+        cold compiles', anything after is scored."""
+        with self._lock:
+            return self._event_seq
+
+    def compile_events(self, since: int = 0) -> List[Dict]:
+        """Journal entries with seq > ``since`` (copies)."""
+        with self._lock:
+            return [dict(e) for e in self._events if e["seq"] > since]
+
+    def compile_report(self, since: int = 0) -> Dict:
+        """Sentinel rollup since a marker: total events, retrace count,
+        compile seconds, per-program event counts, and the retrace
+        journal (each entry's ``delta`` names the changed axes)."""
+        evs = self.compile_events(since)
+        programs: Dict[str, int] = {}
+        retraces = 0
+        s_total = 0.0
+        for e in evs:
+            programs[e["program"]] = programs.get(e["program"], 0) + 1
+            s_total += e["compile_s"]
+            if e["retrace"]:
+                retraces += 1
+        return {
+            "events": len(evs),
+            "retraces": retraces,
+            "s_total": round(s_total, 6),
+            "programs": programs,
+            "journal": [e for e in evs if e["retrace"]],
+        }
+
+    # --- timers --------------------------------------------------------------
+
+    def span(self, stage: str, key=None, axes=None):
         """Time one call of `stage`. `key` identifies the compiled
-        program (first sighting = compile); None = host-only stage."""
+        program (first sighting = compile); None = host-only stage.
+        ``axes`` optionally names the key's positions for retrace
+        attribution (e.g. ``("state", "rows", "scan_plan")``)."""
         if not self.enabled:
             return NULL_SPAN
-        return _PhaseSpan(self, stage, key)
+        if key is not None:
+            key = self._fault_key(stage, key)
+        return _PhaseSpan(self, stage, key, axes)
 
     def transfer(
         self, stage: str, nbytes: int, direction: str = "h2d"
@@ -217,6 +385,42 @@ class PhaseRecorder:
                 if st.value is not None:
                     out[name]["value"] = round(st.value, 6)
         return out
+
+
+def compile_storm_provider(
+    budget: Optional[int] = 0,
+    marker: int = 0,
+    recorder: Optional[PhaseRecorder] = None,
+):
+    """Health-provider factory for ``TelemetryServer.add_health_provider``
+    (register under the name ``"compile"``): reports retraces since
+    ``marker`` and flips ``degraded``/``storm`` once they exceed
+    ``budget`` (None = report-only, never degrades). The section also
+    carries the LAST retrace's signature delta so a probe sees *which
+    axis changed* without walking the journal."""
+
+    def provider() -> Dict:
+        rec = recorder if recorder is not None else phases
+        rep = rec.compile_report(since=marker)
+        storm = budget is not None and rep["retraces"] > budget
+        last = rep["journal"][-1] if rep["journal"] else None
+        return {
+            "retraces": rep["retraces"],
+            "budget": budget,
+            "compile_s": rep["s_total"],
+            "storm": storm,
+            "degraded": storm,
+            "last_retrace": (
+                {
+                    "program": last["program"],
+                    "delta": last["delta"],
+                }
+                if last
+                else None
+            ),
+        }
+
+    return provider
 
 
 phases = PhaseRecorder(enabled=bool(os.environ.get("YTPU_PHASES")))

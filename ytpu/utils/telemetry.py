@@ -6,7 +6,7 @@ phase timers only surfaced in `bench.py`'s one-line JSON after the run
 ended. `TelemetryServer` is the missing listener — a stdlib
 `http.server` on its OWN daemon thread, so a soak, a serving pod, or a
 long replay is watchable live while the main thread stays on the data
-path. Four endpoints:
+path. Five endpoints:
 
 - ``/metrics`` — Prometheus text exposition 0.0.4, straight from
   `MetricsRegistry.prometheus_text()` (so a real Prometheus scrape
@@ -18,6 +18,11 @@ path. Four endpoints:
 - ``/snapshot`` — one JSON object merging `metrics.snapshot()`,
   `phases.snapshot()` and any registered *providers* (e.g. the soak
   driver's live SLO windows, a device server's slot/queue view);
+- ``/profile`` — the unified wall-time budget (ISSUE-17): one JSON
+  report attributing the run's wall top-down (compile / device /
+  staging / drain / finisher / net / host / idle fractions summing to
+  1) from `ytpu.utils.profile`, or whatever windowed source the
+  current run installed via `set_profile_source`;
 - ``/healthz`` — liveness + the degradation surface: the sticky
   lane-demotion ladder (`integrate_kernel.lane_health()`) and the age
   of the last device dispatch. A wedged device shows as a growing
@@ -109,6 +114,13 @@ class _Handler(BaseHTTPRequestHandler):
                     "application/json",
                     json.dumps(self.telemetry.snapshot()).encode("utf-8"),
                 )
+            elif path == "/profile":
+                _SCRAPES.labels("profile").inc()
+                self._reply(
+                    200,
+                    "application/json",
+                    json.dumps(self.telemetry.profile()).encode("utf-8"),
+                )
             elif path in ("/healthz", "/health"):
                 _SCRAPES.labels("healthz").inc()
                 self._reply(
@@ -161,6 +173,10 @@ class TelemetryServer:
         #: lines — how the soak driver publishes its windowed
         #: `HistogramWindow` series as real histogram expositions
         self._expositions: Dict[str, Callable[[], str]] = {}
+        #: `/profile` source (ISSUE-17): zero-arg callable returning the
+        #: unified wall-time budget; defaults to the process-lifetime
+        #: `profile_report()` window until a run installs its own
+        self._profile_source: Optional[Callable[[], Dict]] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._t0 = time.time()
@@ -238,6 +254,25 @@ class TelemetryServer:
         """Register (or replace) a named block of extra Prometheus text
         appended to `/metrics` after the registry exposition."""
         self._expositions[name] = fn
+
+    def set_profile_source(
+        self, fn: Optional[Callable[[], Dict]]
+    ) -> None:
+        """Install (or, with None, clear) the `/profile` body source —
+        a soak installs its windowed `ProfileWindow.report` so the
+        endpoint attributes THIS run's wall, not process lifetime."""
+        self._profile_source = fn
+
+    def profile(self) -> Dict:
+        """The `/profile` JSON body (ISSUE-17): the unified wall-time
+        budget from the installed source, defaulting to the
+        process-lifetime window of `ytpu.utils.profile`."""
+        src = self._profile_source
+        if src is not None:
+            return src()
+        from ytpu.utils.profile import profile_report
+
+        return profile_report()
 
     def add_health_provider(self, name: str, fn: Callable[[], object]) -> None:
         """Register a named `/healthz` section (ISSUE-13): the section
