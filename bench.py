@@ -114,6 +114,7 @@ TUNNEL_QUEUE = [
     "federation_soak_pr13",
     "fleet_canary_pr15",
     "autopilot_soak_pr16",
+    "doc_ceiling_pr18",
 ]
 
 # Which measurement surface pays each owed entry off (ISSUE-17
@@ -139,6 +140,10 @@ _TUNNEL_SATISFIERS = {
     "federation_soak_pr13": lambda c: "federation_converge_rounds" in c,
     "fleet_canary_pr15": lambda c: "canary_availability" in c,
     "autopilot_soak_pr16": lambda c: "autopilot_actions" in c,
+    # ISSUE-18: paid off by a hardware round that records the doc-axis
+    # memory ceiling (the CPU sweep is compile-only; the TPU run's
+    # memory_analysis numbers are the real HBM curve)
+    "doc_ceiling_pr18": lambda c: "doc_ceiling" in c,
 }
 
 
@@ -975,17 +980,66 @@ def chaos_smoke() -> dict:
     assert r.stats.recoveries >= 1, r.stats
     classes["stage.raise"] = {"recoveries": r.stats.recoveries}
 
-    # class: grow_packed OOM — capacity 16 cannot hold even one chunk's
-    # worst-case adds, so the very first ensure_room must grow (and the
-    # armed spec turns that growth into a simulated device OOM)
+    # class: grow_packed OOM — an incompressible head-insert log (every
+    # block left-origins the previous one, so compaction coalesces
+    # nothing) fills capacity 32 occupancy-first, forcing a mid-replay
+    # grow that the armed spec turns into a simulated device OOM. The
+    # /capacity forecaster rides along (ISSUE-18): its budget sits just
+    # under the 32→64 grow cost, so the occupancy-ledger observations
+    # the drain was already feeding it must flip `degraded` BEFORE the
+    # typed GrowOomError moves `memory.grow_denied` — forecast first,
+    # fault second, proven against the counter, not the clock
     ik.reset_lane_health()
     faults.clear()
+    from ytpu.utils.capacity import HeadroomForecaster
+
+    oom_ops = [("i", 0, "abcdef"[i % 6]) for i in range(120)]
+    oom_log, oom_expect = build_updates(oom_ops)
+    oom_plan = plan_replay(oom_log)
+
+    def oom_replay(**kw):
+        r = FusedReplay(
+            n_docs=2, plan=oom_plan, d_block=2, chunk=4, lane="xla", **kw
+        )
+        r.run(oom_log)
+        return r
+
+    assert oom_replay(capacity=256, max_capacity=256).get_string(0) == (
+        oom_expect
+    ), "chaos grow.oom clean-run parity"
     faults.arm("grow.oom")
-    r = replay(capacity=16, max_capacity=1024)
+    denied0 = metrics.counter("memory.grow_denied").value
+    fc = HeadroomForecaster(
+        budget_bytes=ik.packed_state_bytes(2, 48), watermark=0.5
+    )
+    flagged_pre_denial = []
+    _observe = fc.observe
+
+    def scored_observe(**kw):
+        _observe(**kw)
+        if fc.report()["degraded"]:
+            flagged_pre_denial.append(
+                metrics.counter("memory.grow_denied").value == denied0
+            )
+
+    fc.observe = scored_observe
+    r = oom_replay(capacity=32, max_capacity=1024, forecaster=fc)
     assert r.stats.growths >= 1, r.stats
-    assert r.get_string(0) == clean_text, "grow.oom parity"
+    assert r.get_string(0) == oom_expect, "grow.oom parity"
     assert r.stats.recoveries >= 1, r.stats
-    classes["grow.oom"] = {"recoveries": r.stats.recoveries}
+    grow_denied = metrics.counter("memory.grow_denied").value - denied0
+    assert grow_denied >= 1, "typed GrowOomError never counted a denial"
+    assert flagged_pre_denial and flagged_pre_denial[0], (
+        "forecaster must flag degraded BEFORE grow.oom fires",
+        flagged_pre_denial,
+    )
+    fc_report = fc.report()
+    classes["grow.oom"] = {
+        "recoveries": r.stats.recoveries,
+        "grow_denied": grow_denied,
+        "forecast_flagged_first": bool(flagged_pre_denial[0]),
+        "headroom_fraction": fc_report["headroom_fraction"],
+    }
 
     # class: poison update (corrupt wire bytes → quarantine, not abort);
     # the LAST update is the poison target so no healthy update depends
@@ -2397,6 +2451,45 @@ def observatory_dry_run() -> dict:
     }
 
 
+def doc_ceiling_dry_run() -> dict:
+    """Doc-axis ceiling rehearsal (ISSUE-18): the compile-only pow2
+    64→2048 sweep from `benches/doc_ceiling.py` under a PINNED budget
+    (the 768-doc grow transient at capacity 512), asserted end to end —
+
+    - the measured per-shape memory curve is monotone in docs;
+    - the forecaster's fitted model tracks every MEASURED
+      ``memory_analysis()`` point within 5% (and the analytic
+      `packed_state_bytes` formula does too — the `/capacity` headroom
+      math is scored against XLA's own numbers, not against itself);
+    - the ceiling lands exactly where the ROADMAP says the hardware
+      does: the 1024-doc family is the first to bust the budget, so
+      ``doc_ceiling`` = 512 and ``first_failing_family`` = 1024x8."""
+    benches_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benches"
+    )
+    if benches_dir not in sys.path:
+        sys.path.insert(0, benches_dir)
+    import doc_ceiling
+
+    from ytpu.ops.integrate_kernel import packed_state_bytes
+
+    budget = 3 * packed_state_bytes(768, 512)
+    sweep = doc_ceiling.doc_ceiling_sweep(capacity=512, budget_bytes=budget)
+    assert sweep["memory_curve_monotone"], [
+        p["grow_resident_bytes"] for p in sweep["points"]
+    ]
+    assert sweep["model_max_rel_err"] <= 0.05, sweep["model_max_rel_err"]
+    for p in sweep["points"]:
+        rel = abs(p["grow_resident_bytes"] - p["analytic_bytes"]) / max(
+            p["analytic_bytes"], 1
+        )
+        assert rel <= 0.05, ("analytic model off by >5%", p)
+    assert sweep["first_failing_family"] == "1024x8", sweep
+    assert sweep["doc_ceiling"] == 512, sweep["doc_ceiling"]
+    assert sweep["capacity_headroom_fraction"] > 0, sweep
+    return sweep
+
+
 def _capture_rank(path: str, d: dict):
     """Freshness key for a committed BENCH_r*.json: the ROUND NUMBER from
     the filename, then the in-capture timestamp. File mtime is useless —
@@ -2621,6 +2714,9 @@ _TRAJECTORY_KEYS = (
     "autopilot_p99_adj_delta",
     "compile_retraces",
     "profile_device_fraction",
+    "memory_peak_bytes",
+    "capacity_headroom_fraction",
+    "doc_ceiling",
 )
 
 
@@ -2883,6 +2979,23 @@ def main(dry_run: bool = False, compare_baseline: bool = False):
         out["compile_retraces"] = out["observatory"]["clean"]["retraces"]
         for k, v in out["observatory"]["profile"].items():
             out[k] = v  # profile_*_fraction headline keys
+        # capacity observatory rehearsal (ISSUE-18): the compile-only
+        # doc-axis ceiling sweep under a pinned budget — monotone
+        # memory curve, forecaster-vs-measured within 5%, and the
+        # 1024-doc family named as the first to bust the budget; the
+        # headline keys ride the one-line JSON (doc_ceiling and
+        # headroom regress on DROP, memory_peak_bytes on RISE)
+        with phases.span("host.doc_ceiling_rehearsal"):
+            out["doc_ceiling_sweep"] = doc_ceiling_dry_run()
+        out["doc_ceiling"] = out["doc_ceiling_sweep"]["doc_ceiling"]
+        out["capacity_headroom_fraction"] = out["doc_ceiling_sweep"][
+            "capacity_headroom_fraction"
+        ]
+        mem_report = phases.memory_report()
+        out["memory_peak_bytes"] = mem_report.get("peak_bytes", 0) or max(
+            p["grow_resident_bytes"]
+            for p in out["doc_ceiling_sweep"]["points"]
+        )
         owed, burned = _burn_tunnel_queue()
         out["tunnel_queue"] = owed
         out["tunnel_burned"] = burned

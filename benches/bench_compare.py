@@ -116,6 +116,20 @@ _DIRECTION_RULES: Tuple[Tuple[str, str], ...] = (
     # neutral: more actions is a policy choice, not better/worse.
     ("availability_delta", "up"),
     ("p99_adj_delta", "down"),
+    # capacity observatory (ISSUE-18): device-memory use regresses on
+    # RISE (memory_peak_bytes, memory.program_bytes leaves), while the
+    # forecaster's headroom and the doc-axis ceiling regress on DROP —
+    # a smaller survivable doc axis or thinner headroom is the ceiling
+    # closing in. The configured budget is an input, not a measurement,
+    # so it pins neutral BEFORE the broad memory_ rule; occupancy /
+    # fragmentation gauges (dead_rows, live_rows, dead_fraction,
+    # reclaimed_rows, compact_gap_chunks) stay neutral by default —
+    # they are workload shape, like the scan-tier occupancy split.
+    ("headroom_fraction", "up"),
+    ("doc_ceiling", "up"),
+    ("memory_budget", "neutral"),
+    ("memory_", "down"),
+    ("peak_bytes", "down"),
     ("p50_ms", "down"),
     ("p99_ms", "down"),
     ("p999_ms", "down"),

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Doc-axis ceiling probe (ISSUE-18): where does the doc axis hit the
+memory budget?
+
+ROADMAP item 1 is a MEMORY story — the 1024-doc integrate shapes kill
+the TPU worker — but until now the repo had no instrument that maps the
+doc axis to device bytes. This sweep is that instrument, and it is
+**compile-only**: every point AOT-lowers the capacity programs against
+`jax.ShapeDtypeStruct` specs and reads `compiled.memory_analysis()`, so
+a pow2 64→2048 doc sweep runs on a CPU dry-run without materializing a
+single giant array.
+
+Per point (docs = 64, 128, ..., 2048 at a fixed slot capacity):
+
+- **grow transient** — `grow_packed` lowered at ``capacity → 2 *
+  capacity``: arguments (old state) + outputs (new state) + temps, the
+  exact allocation `PackedReplayDriver.ensure_room` asks the device for
+  when the watermark trips, and the denial the typed `GrowOomError`
+  reports. This is the curve the ceiling is read from.
+- **compact program** — `compact_packed` at the same shape: the
+  temp-heavy steady-state program that must also fit.
+- **analytic model** — `packed_state_bytes(D, C) +
+  packed_state_bytes(D, 2C)`: the formula `ytpu.utils.capacity` scores
+  headroom with. The sweep feeds every MEASURED grow transient into a
+  `HeadroomForecaster` and reports the model's worst relative error —
+  forecaster math vs `memory_analysis()` truth stays an assertable
+  delta, not vibes.
+- **lane ladder** — the sticky `lane_health` floor for the point's
+  shape family. On hosts without Mosaic the fused lane is reported as
+  not probed (``fused_probed: false``), never silently "healthy".
+
+The **ceiling** is the first docs whose grow transient exceeds the
+budget (``YTPU_DOC_CEILING_BUDGET_BYTES``, else the observatory's
+`memory_budget_bytes()`); ``doc_ceiling`` is the last surviving docs
+count. The committed artifact (`doc_ceiling_pr18.json`) pins a 768-doc
+-equivalent budget so the curve crosses inside the swept range and the
+artifact NAMES the first failing family — the 1024-doc shapes, matching
+the ROADMAP's observed TPU ceiling.
+
+Standalone::
+
+    JAX_PLATFORMS=cpu python benches/doc_ceiling.py [out.json]
+
+`bench.py --dry-run` runs the same sweep as its ``doc_ceiling`` leg and
+lifts ``doc_ceiling`` / ``memory_peak_bytes`` /
+``capacity_headroom_fraction`` into the one-line JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+__all__ = ["doc_ceiling_sweep", "main"]
+
+#: the swept doc axis: pow2 64 → 2048 (the flagship 2048-doc config4
+#: shape is the top rung; 1024 is ROADMAP item 1's observed killer)
+DOCS_AXIS = (64, 128, 256, 512, 1024, 2048)
+
+#: slot capacity every point sweeps at — deliberately fixed so the doc
+#: axis is the only variable in the curve
+DEFAULT_CAPACITY = 512
+
+#: kernel tiling for the lane-family key (matches the flagship d_block)
+DEFAULT_D_BLOCK = 8
+
+
+def _resident(kinds: dict) -> int:
+    """The observatory's resident-bytes convention: arguments + outputs
+    − donated alias overlap + temps (generated code reported separately)."""
+    return (
+        kinds["argument_bytes"]
+        + kinds["output_bytes"]
+        - kinds["alias_bytes"]
+        + kinds["temp_bytes"]
+    )
+
+
+def doc_ceiling_sweep(
+    docs_axis=DOCS_AXIS,
+    capacity: int | None = None,
+    budget_bytes: int | None = None,
+    d_block: int = DEFAULT_D_BLOCK,
+) -> dict:
+    """Run the compile-only sweep; returns the artifact dict."""
+    import jax
+    import jax.numpy as jnp
+
+    from ytpu.ops.compaction import _compact_packed_jit, grow_packed
+    from ytpu.ops.integrate_kernel import (
+        M_PAD,
+        NC,
+        effective_lane,
+        lane_family,
+        lane_health,
+        packed_state_bytes,
+    )
+    from ytpu.utils.capacity import HeadroomForecaster, memory_budget_bytes
+    from ytpu.utils.phases import program_memory
+
+    capacity = int(
+        capacity
+        if capacity is not None
+        else os.environ.get("YTPU_DOC_CEILING_CAPACITY", DEFAULT_CAPACITY)
+    )
+    if budget_bytes is None:
+        env = os.environ.get("YTPU_DOC_CEILING_BUDGET_BYTES")
+        budget_bytes = int(env) if env else memory_budget_bytes()
+    budget_bytes = int(budget_bytes)
+
+    # the fused Pallas lane needs Mosaic — on a host backend the sweep
+    # reports it unprobed rather than pretending the rung is healthy
+    fused_probed = jax.default_backend() not in ("cpu",)
+
+    grow_jit = jax.jit(grow_packed, static_argnums=(2,))
+    fc = HeadroomForecaster(budget_bytes=budget_bytes)
+    points = []
+    first_failing = None
+    prev_resident = -1
+    monotone = True
+    for docs in docs_axis:
+        cols = jax.ShapeDtypeStruct((NC, int(docs), capacity), jnp.int32)
+        meta = jax.ShapeDtypeStruct((int(docs), M_PAD), jnp.int32)
+        t0 = time.perf_counter()
+        grow_kinds = program_memory(grow_jit, cols, meta, 2 * capacity)()
+        compact_kinds = program_memory(
+            _compact_packed_jit, cols, meta, False, False
+        )()
+        compile_s = time.perf_counter() - t0
+        grow_resident = _resident(grow_kinds)
+        analytic = packed_state_bytes(docs, capacity) + packed_state_bytes(
+            docs, 2 * capacity
+        )
+        # feed the MEASURED transient so the forecaster models reality
+        fc.observe(
+            n_docs=docs,
+            capacity=capacity,
+            occupied_rows=0,
+            resident_bytes=grow_resident,
+        )
+        fam = lane_family(docs, d_block)
+        ok = grow_resident <= budget_bytes
+        if not ok and first_failing is None:
+            first_failing = f"{docs}x{d_block}"
+        if grow_resident < prev_resident:
+            monotone = False
+        prev_resident = grow_resident
+        points.append(
+            {
+                "docs": int(docs),
+                "capacity": capacity,
+                "family": f"{docs}x{d_block}",
+                "grow_resident_bytes": int(grow_resident),
+                "grow_kinds": grow_kinds,
+                "compact_resident_bytes": int(_resident(compact_kinds)),
+                "analytic_bytes": int(analytic),
+                "within_budget": bool(ok),
+                "lane": effective_lane(fam, "fused" if fused_probed else "xla"),
+                "compile_s": round(compile_s, 3),
+            }
+        )
+
+    # forecaster-vs-measured: worst relative error of the fitted model
+    # across the swept points (the analytic formula is exact up to XLA's
+    # small fixed overhead, so this should be well under 5%)
+    model_err = 0.0
+    for p in points:
+        est = fc.model_bytes(p["docs"], capacity)
+        err = abs(est - p["grow_resident_bytes"]) / max(
+            p["grow_resident_bytes"], 1
+        )
+        model_err = max(model_err, err)
+
+    surviving = [p["docs"] for p in points if p["within_budget"]]
+    ceiling = max(surviving) if surviving else 0
+    # headroom at the highest surviving rung: the budget fraction its
+    # grow transient leaves unspent — shrinks toward 0 as the doc axis
+    # approaches the ceiling (bench_compare regresses it on DROP)
+    headroom = None
+    for p in points:
+        if p["docs"] == ceiling:
+            headroom = round(
+                1.0 - p["grow_resident_bytes"] / float(budget_bytes), 6
+            )
+    return {
+        "metric": "doc_axis_memory_ceiling",
+        "unit": "docs surviving the grow-transient budget (compile-only)",
+        "platform": jax.default_backend(),
+        "capacity": capacity,
+        "d_block": d_block,
+        "budget_bytes": budget_bytes,
+        "points": points,
+        "memory_curve_monotone": monotone,
+        "model_max_rel_err": round(model_err, 6),
+        "doc_ceiling": int(ceiling),
+        "first_failing_family": first_failing,
+        "capacity_headroom_fraction": headroom,
+        "fused_probed": fused_probed,
+        "lane_health": lane_health(),
+    }
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    out_path = argv[0] if argv else None
+    here = os.path.dirname(os.path.abspath(__file__))
+    for p in (here, os.path.dirname(here)):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    from _env import repin_jax_platforms
+
+    repin_jax_platforms()
+    sweep = doc_ceiling_sweep()
+    line = json.dumps(sweep)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(json.dumps(sweep, indent=1, sort_keys=True) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

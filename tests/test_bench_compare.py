@@ -158,6 +158,23 @@ def test_direction_classification_rules():
     assert bc.classify("ingest_raw.stall_fraction") == "down"
     # workload-shape counter whose leaf contains "s_total" stays neutral
     assert bc.classify("metrics.integrate.scan_iterations_total") == "neutral"
+    # capacity observatory (ISSUE-18): device-memory footprints regress
+    # when they RISE; the forecaster's headroom and the doc-axis ceiling
+    # regress when they DROP (the ceiling closing in); the configured
+    # budget is an input, not an outcome, and the occupancy/fragmentation
+    # gauges are workload shape — both reported-neutral
+    assert bc.classify("memory_peak_bytes") == "down"
+    assert bc.classify("observatory.memory.peak_bytes") == "down"
+    assert bc.classify("memory_program_bytes") == "down"
+    assert bc.classify("capacity_headroom_fraction") == "up"
+    assert bc.classify("capacity.headroom_fraction") == "up"
+    assert bc.classify("doc_ceiling") == "up"
+    assert bc.classify("doc_ceiling_sweep.doc_ceiling") == "up"
+    assert bc.classify("doc_ceiling_sweep.memory_budget_bytes") == "neutral"
+    assert bc.classify("capacity.live_rows") == "neutral"
+    assert bc.classify("capacity.dead_rows") == "neutral"
+    assert bc.classify("capacity.dead_fraction") == "neutral"
+    assert bc.classify("capacity.occupancy_fraction") == "neutral"
 
 
 def test_observatory_families_regress_on_rise():

@@ -1563,13 +1563,16 @@ _encode_diff_batch_jit = encode_diff_batch
 def encode_diff_batch(
     state: DocStateBatch, remote_sv: jax.Array, n_clients: int
 ):
-    from ytpu.utils.phases import NULL_SPAN, phases
+    from ytpu.utils.phases import NULL_SPAN, phases, program_memory
 
     span = (
         phases.span(
             "encode.diff_batch",
             (state.blocks.client.shape, remote_sv.shape, n_clients),
             axes=("state", "remote_sv", "n_clients"),
+            memory=program_memory(
+                _encode_diff_batch_jit, state, remote_sv, n_clients
+            ),
         )
         if phases.enabled
         else NULL_SPAN
@@ -1579,6 +1582,25 @@ def encode_diff_batch(
 
 
 encode_diff_batch.__doc__ = _encode_diff_batch_jit.__doc__
+
+
+@jax.jit
+def state_capacity_ledger(state: DocStateBatch):
+    """Per-doc ``([D] live, [D] dead)`` block-row counts (ISSUE-18):
+    live rows are allocations inside the ``n_blocks`` prefix that are
+    not tombstoned; dead rows the tombstoned (GC-able) remainder —
+    the same validity predicate `encode_diff_batch` ships by. Free
+    rows per doc are ``capacity - live - dead``, so the per-tenant
+    occupancy gauges always sum to the slot capacity. NOT a hot-path
+    call: scrape-time `/snapshot` sections and tests materialize it on
+    demand (the batch replay lane gets the same words for free on the
+    lazy readout — `integrate_kernel._readout_words`)."""
+    bl = state.blocks
+    B = bl.client.shape[-1]
+    slots = jnp.arange(B, dtype=jnp.int32)
+    valid = (slots[None, :] < state.n_blocks[:, None]) & (bl.client >= 0)
+    dead = jnp.sum((valid & (bl.deleted != 0)).astype(jnp.int32), axis=1)
+    return state.n_blocks.astype(jnp.int32) - dead, dead
 
 
 def finish_encode_diff(
@@ -1937,7 +1959,7 @@ def compact_finisher_rows(bl, ship, offsets, deleted, idx, R):
     The `encode.pack` span keys the compiled pack family — `(sub, R)`
     via idx.shape/R plus the state width — so the retrace sentinel sees
     a family explosion the moment pow2 discipline slips (ISSUE-17)."""
-    from ytpu.utils.phases import NULL_SPAN, phases
+    from ytpu.utils.phases import NULL_SPAN, phases, program_memory
 
     fn = _compact_rows_donated if _donation_usable() else _compact_rows_plain
     span = (
@@ -1945,6 +1967,7 @@ def compact_finisher_rows(bl, ship, offsets, deleted, idx, R):
             "encode.pack",
             (bl.client.shape, idx.shape, R),
             axes=("state", "idx", "R"),
+            memory=program_memory(fn, bl, ship, offsets, deleted, idx, R),
         )
         if phases.enabled
         else NULL_SPAN
@@ -3452,11 +3475,17 @@ def apply_update_batch(
     # instead of silently reusing the old unroll, and the span key
     # carries the plan so the sentinel attributes the retrace to it
     scan_plan = scan_tier_plan()
+    from ytpu.utils.phases import program_memory
+
     span = (
         phases.span(
             "integrate.xla_batch",
             (state.blocks.client.shape, batch.client.shape, scan_plan),
             axes=("state", "batch", "scan_plan"),
+            memory=program_memory(
+                _apply_update_batch_jit, state, batch, client_rank,
+                scan_plan,
+            ),
         )
         if phases.enabled
         else NULL_SPAN
@@ -3475,11 +3504,17 @@ def apply_update_stream(
     state = ensure_origin_slot(state)
     # two-tier scan plan as a per-call static (see apply_update_batch)
     scan_plan = scan_tier_plan()
+    from ytpu.utils.phases import program_memory
+
     span = (
         phases.span(
             "integrate.xla_stream",
             (state.blocks.client.shape, stream.client.shape, scan_plan),
             axes=("state", "stream", "scan_plan"),
+            memory=program_memory(
+                _apply_update_stream_state_jit, state, stream,
+                client_rank, scan_plan,
+            ),
         )
         if phases.enabled
         else NULL_SPAN

@@ -157,6 +157,22 @@ class BatchIngestor:
         ds = self._pending_ds[doc]
         return None if ds.is_empty() else ds
 
+    def capacity_ledger(self):
+        """Per-slot occupancy/fragmentation view (ISSUE-18): numpy
+        ``(live, dead, free)`` row counts, each ``[n_docs]``, summing
+        to the slot capacity per doc. One scrape-time device pull
+        (`state_capacity_ledger`) — never called from the ingest hot
+        path."""
+        import numpy as np
+
+        from ytpu.models.batch_doc import state_capacity_ledger
+
+        live, dead = state_capacity_ledger(self.state)
+        live = np.asarray(live)
+        dead = np.asarray(dead)
+        cap = int(self.state.blocks.client.shape[-1])
+        return live, dead, cap - live - dead
+
     # --- ingestion -------------------------------------------------------------
 
     def _merge_with_stash(self, doc: int, incoming: Optional[Update]) -> Update:

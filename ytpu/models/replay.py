@@ -264,6 +264,15 @@ class ReplayStats:
     # docs/serving.md §Federation — the device twin of the host-side
     # per-tenant commitments the replica mesh exchanges)
     commit_word: int = 0
+    # capacity observatory (ISSUE-18): occupancy/fragmentation ledger
+    # from the driver's final readout drain plus cumulative compaction
+    # efficacy — see integrate_kernel.ReplayChunkStats for the word
+    # origins (all ride the lazy readout, zero new syncs)
+    occupied_rows: int = 0
+    dead_rows: int = 0
+    dead_max: int = 0
+    reclaimed_rows: int = 0
+    compact_gap_chunks: int = 0
 
 
 @dataclass
@@ -727,6 +736,7 @@ class FusedReplay:
         checkpoint_every: int = 0,
         quarantine: bool = False,
         max_recoveries: int = 3,
+        forecaster=None,
     ):
         import jax.numpy as jnp
 
@@ -771,6 +781,10 @@ class FusedReplay:
         self.checkpoint_every = checkpoint_every
         self.quarantine = quarantine
         self.max_recoveries = max_recoveries
+        # capacity observatory (ISSUE-18): an optional HeadroomForecaster
+        # fed at every materialized ledger readout by the driver(s) this
+        # replay creates — None keeps the hot path untouched
+        self.forecaster = forecaster
         self.capacity0 = capacity
         self.cols, self.meta = pack_state(init_state(n_docs, capacity))
         self.stats = ReplayStats(capacity=capacity)
@@ -797,7 +811,7 @@ class FusedReplay:
     def _make_driver(self, rank):
         from ytpu.ops.integrate_kernel import PackedReplayDriver
 
-        return PackedReplayDriver(
+        driver = PackedReplayDriver(
             self.cols,
             self.meta,
             rank,
@@ -813,6 +827,8 @@ class FusedReplay:
             initial_occupancy=self._hi,
             quarantine=self.quarantine,
         )
+        driver.forecaster = self.forecaster
+        return driver
 
     def _resolve_rank(self, client_rank):
         from ytpu.ops.decode_kernel import identity_rank
@@ -960,6 +976,13 @@ class FusedReplay:
             self.stats.scan_trips_serial = d.scan_trips_serial
             self.stats.scan_trips_two_tier = d.scan_trips_two_tier
         self.stats.commit_word = d.commit_word
+        # capacity ledger (ISSUE-18): freshest readout supersedes,
+        # reclaimed rows accumulate across driver incarnations
+        self.stats.occupied_rows = d.occupied_rows
+        self.stats.dead_rows = d.dead_rows
+        self.stats.dead_max = d.dead_max
+        self.stats.reclaimed_rows += d.reclaimed_rows
+        self.stats.compact_gap_chunks = d.compact_gap_chunks
         self._hi = d.final_blocks
 
     # ------------------------------------------- fault recovery (ISSUE-6)

@@ -515,7 +515,7 @@ def compact_state(state: DocStateBatch) -> DocStateBatch:
         mark_origin_slot_stale,
         origin_slot_is_stale,
     )
-    from ytpu.utils.phases import NULL_SPAN, phases
+    from ytpu.utils.phases import NULL_SPAN, phases, program_memory
 
     # staleness is identity-keyed on the cache array; the defragment
     # remap builds a NEW array, so a stale input must re-mark its output
@@ -523,7 +523,8 @@ def compact_state(state: DocStateBatch) -> DocStateBatch:
     stale = origin_slot_is_stale(state)
     span = (
         phases.span(
-            "compact.state", (state.blocks.client.shape,), axes=("state",)
+            "compact.state", (state.blocks.client.shape,), axes=("state",),
+            memory=program_memory(_compact_state_jit, state),
         )
         if phases.enabled
         else NULL_SPAN
@@ -536,13 +537,16 @@ def compact_state(state: DocStateBatch) -> DocStateBatch:
 
 
 def compact_packed(cols, meta, unit_refs: bool = False, gc_ranges: bool = False):
-    from ytpu.utils.phases import NULL_SPAN, phases
+    from ytpu.utils.phases import NULL_SPAN, phases, program_memory
 
     span = (
         phases.span(
             "compact.packed",
             (cols.shape, unit_refs, gc_ranges),
             axes=("cols", "unit_refs", "gc_ranges"),
+            memory=program_memory(
+                _compact_packed_jit, cols, meta, unit_refs, gc_ranges
+            ),
         )
         if phases.enabled
         else NULL_SPAN

@@ -1557,7 +1557,7 @@ def decode_updates_v1(
     client_hash_table=None,
     primary_root_hash=None,
 ):
-    from ytpu.utils.phases import NULL_SPAN, phases
+    from ytpu.utils.phases import NULL_SPAN, phases, program_memory
     from ytpu.utils.progbudget import tick
 
     tick()
@@ -1580,6 +1580,19 @@ def decode_updates_v1(
             axes=("buf", "max_rows", "max_dels", "n_steps",
                   "max_sections", "client_table", "key_table",
                   "client_hash_table", "primary_root_hash"),
+            memory=program_memory(
+                _decode_updates_v1_jit,
+                buf,
+                lens,
+                max_rows=max_rows,
+                max_dels=max_dels,
+                n_steps=n_steps,
+                client_table=client_table,
+                max_sections=max_sections,
+                key_table=key_table,
+                client_hash_table=client_hash_table,
+                primary_root_hash=primary_root_hash,
+            ),
         )
     else:
         span = NULL_SPAN

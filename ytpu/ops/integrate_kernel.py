@@ -144,8 +144,14 @@ M_PAD = 32  # the ISSUE-12 trip words outgrew the 16-wide tile (was 8 pre-PR-11)
 #: words in the per-chunk lazy readout: the original [3] occupancy/error
 #: words + the full scan record (buckets, max, tiers, trips) + the
 #: ISSUE-13 state-commitment word (wrap-sum over docs of the per-doc
-#: homomorphic lattice digest, `batch_doc.commit_fold_blocks`)
-N_READOUT = 3 + SCAN_REC_WORDS + 1
+#: homomorphic lattice digest, `batch_doc.commit_fold_blocks`) + the
+#: ISSUE-18 capacity-ledger words (see LEDGER_WORDS)
+#: capacity-ledger words (ISSUE-18): Σ occupied rows over docs,
+#: Σ dead (tombstoned, GC-able) rows, and the max per-doc dead count —
+#: the occupancy/fragmentation gauges ride the SAME lazy readout
+#: future, so the zero-sync invariant (`test_async_overlap`) holds
+LEDGER_WORDS = 3
+N_READOUT = 3 + SCAN_REC_WORDS + 1 + LEDGER_WORDS
 
 ERR_CAPACITY = 1
 ERR_MISSING_DEP = 2
@@ -1154,7 +1160,11 @@ def apply_update_stream_fused(
     # possible compile, not just on the periodic tick (the r5 no-crutch
     # suite segfaulted compiling exactly this program at ~73%)
     from ytpu.utils import progbudget
-    from ytpu.utils.phases import NULL_SPAN, phases as _phases
+    from ytpu.utils.phases import (
+        NULL_SPAN,
+        phases as _phases,
+        program_memory as _program_memory,
+    )
 
     progbudget.enforce()
     cols, meta = pack_state(state)
@@ -1179,6 +1189,11 @@ def apply_update_stream_fused(
             axes=("state", "rows", "dels", "d_block", "interpret",
                   "debug_phases", "debug_row_phase", "vmem_mb",
                   "scan_plan"),
+            memory=_program_memory(
+                _run, cols, meta, (rows, dels, client_rank), d_block,
+                interpret, _debug_phases, _debug_row_phase, vmem_mb,
+                scan_plan,
+            ),
         )
     else:
         span = NULL_SPAN
@@ -1292,16 +1307,52 @@ def packed_commitments(cols, meta):
     )
 
 
+@jax.jit
+def packed_capacity_ledger(cols, meta):
+    """Per-doc ``([D] occupied, [D] dead)`` i32 rows from the packed
+    columns (ISSUE-18). NOT a hot-path call — the batch aggregates
+    already ride the lazy readout; this is the per-tenant pull serving
+    scrapes (`DeviceSyncServer` `/snapshot`) and tests materialize on
+    demand. Free rows per doc are ``capacity - occupied - dead`` under
+    the ledger convention (occupied counts LIVE rows, dead the
+    tombstoned ones), so the three per-tenant gauges always sum to the
+    column capacity."""
+    occ = meta[:, M_NBLOCKS].astype(I32)
+    dead = _packed_dead_rows(cols, meta)
+    return occ - dead, dead
+
+
+def _packed_dead_rows(cols, meta):
+    """``[D]`` i32 per-doc dead-row counts: rows inside the occupied
+    prefix (`n_blocks`) that are live allocations (`client >= 0`) but
+    tombstoned (`DL > 0`) — the GC-able fragmentation `compact_packed`
+    reclaims. Same validity predicate as `_packed_commit_fold`."""
+    B = cols.shape[-1]
+    slots = jnp.arange(B, dtype=I32)
+    valid = (slots[None, :] < meta[:, M_NBLOCKS][:, None]) & (cols[CL] >= 0)
+    return jnp.sum((valid & (cols[DL] > 0)).astype(I32), axis=1)
+
+
 def _readout_words(cols, meta, err):
     """``[N_READOUT]`` i32: (max n_blocks, max sticky integrate error,
     sticky decode flags, scan-width bucket totals summed over docs, max
-    scan width, the ISSUE-12 tier/trip totals summed over docs, then the
+    scan width, the ISSUE-12 tier/trip totals summed over docs, the
     ISSUE-13 commitment word — wrap-sum over docs of the per-doc lattice
-    digest) — everything the host learns per drain, one future."""
+    digest — then the ISSUE-18 capacity-ledger words: Σ occupied rows,
+    Σ dead rows, max per-doc dead) — everything the host learns per
+    drain, one future."""
     hist = jnp.sum(meta[:, M_HIST0:M_SCANW_MAX], axis=0)
     tiers = jnp.sum(meta[:, M_TIER_CHEAP:M_SCAN_END], axis=0)
     commit = jax.lax.bitcast_convert_type(
         jnp.sum(_packed_commit_fold(cols, meta), dtype=jnp.uint32), I32
+    )
+    dead = _packed_dead_rows(cols, meta)
+    ledger = jnp.stack(
+        [
+            jnp.sum(meta[:, M_NBLOCKS]),
+            jnp.sum(dead),
+            jnp.max(dead),
+        ]
     )
     return jnp.concatenate(
         [
@@ -1312,6 +1363,7 @@ def _readout_words(cols, meta, err):
             jnp.max(meta[:, M_SCANW_MAX])[None],
             tiers,
             commit[None],
+            ledger,
         ]
     )
 
@@ -1598,6 +1650,18 @@ class ReplayChunkStats:
     # lattice-digest word as of the freshest materialized readout
     # (uint32 value; per-doc words via `packed_commitments` on demand)
     commit_word: int = 0
+    # capacity observatory (ISSUE-18): occupancy/fragmentation ledger as
+    # of the freshest materialized readout — Σ occupied rows over docs
+    # (the n_blocks prefix, live + dead), Σ dead (tombstoned, GC-able)
+    # rows inside it, and the worst per-doc dead count; plus compaction
+    # efficacy — total rows reclaimed by `compact_packed` calls and the
+    # chunk gap between the last two compactions (time-to-watermark).
+    # All ride the SAME lazy readout future — zero new device syncs.
+    occupied_rows: int = 0
+    dead_rows: int = 0
+    dead_max: int = 0
+    reclaimed_rows: int = 0
+    compact_gap_chunks: int = 0
 
 
 # --- lane-health ladder + typed replay faults (ISSUE-6 tentpole) -------------
@@ -1619,6 +1683,19 @@ _DEMOTIONS_BY = _metrics.counter(
 )
 _RECOVERIES = _metrics.counter("replay.recoveries")
 _QUARANTINED = _metrics.counter("replay.quarantined")
+#: `grow.oom` denials (ISSUE-18): every typed GrowOomError raised at the
+#: fault site — the chaos-side truth the `/capacity` forecaster is
+#: scored against (forecast flagged BEFORE this counter moved?)
+_GROW_DENIED = _metrics.counter("memory.grow_denied")
+
+
+def packed_state_bytes(n_docs: int, capacity: int) -> int:
+    """Analytic resident bytes of ONE packed state at a given capacity:
+    the ``[NC, D, C]`` i32 column planes plus the ``[D, M_PAD]`` meta
+    tile. The capacity observatory's model term — `grow_packed` doubles
+    `capacity`, so the next grow attempt costs exactly this much at
+    ``capacity * 2`` (plus the transient old+new overlap)."""
+    return 4 * (NC * n_docs * capacity + n_docs * M_PAD)
 
 # shape family -> lowest healthy rung (absent = full health)
 _lane_floor: dict = {}
@@ -1690,6 +1767,41 @@ class ReplayFault(RuntimeError):
         self.chunk = chunk
         self.lane = lane
         self.cause = cause
+
+
+class GrowOomError(FaultError):
+    """The ``grow.oom`` fault site, typed (ISSUE-18): a denied
+    `grow_packed` now reports WHAT it attempted against WHAT was
+    available — attempted resident bytes at the doubled capacity vs
+    the device budget — so chaos runs can score the `/capacity`
+    forecaster against reality. Still a `FaultError` subclass: the
+    lane ladder's `is_device_fault` and FusedReplay's checkpoint-resume
+    recovery treat it exactly like the bare fault it replaces."""
+
+    def __init__(
+        self,
+        spec,
+        *,
+        capacity: int,
+        new_capacity: int,
+        n_docs: int,
+        attempted_bytes: int,
+        available_bytes: int,
+    ):
+        RuntimeError.__init__(
+            self,
+            f"injected fault at site 'grow.oom': grow {capacity} -> "
+            f"{new_capacity} slots for {n_docs} docs needs "
+            f"~{attempted_bytes} resident bytes, budget "
+            f"{available_bytes}",
+        )
+        self.site = "grow.oom"
+        self.spec = spec
+        self.capacity = int(capacity)
+        self.new_capacity = int(new_capacity)
+        self.n_docs = int(n_docs)
+        self.attempted_bytes = int(attempted_bytes)
+        self.available_bytes = int(available_bytes)
 
 
 def is_device_fault(e: BaseException) -> bool:
@@ -1804,6 +1916,12 @@ class PackedReplayDriver:
         # indices host-side and returns the newly recorded ones.
         self.quarantine = quarantine
         self.on_quarantine = None
+        # capacity observatory (ISSUE-18): optional HeadroomForecaster
+        # fed at every materialized ledger readout (set by FusedReplay /
+        # tests; None keeps the hot path untouched), plus the chunk
+        # index of the latest compaction for the time-to-watermark gap
+        self.forecaster = None
+        self._last_compact_chunk = -1
 
     @property
     def capacity(self) -> int:
@@ -1836,6 +1954,14 @@ class PackedReplayDriver:
                 # keeps its historical 12-byte accounting
                 _phases.transfer(
                     "integrate.commit_word", 4 * len(self._pending), "d2h"
+                )
+                # the ISSUE-18 capacity-ledger words ride it too: their
+                # bytes attribute under their own stage so every pinned
+                # historical accounting above stays exact
+                _phases.transfer(
+                    "capacity.ledger",
+                    4 * LEDGER_WORDS * len(self._pending),
+                    "d2h",
                 )
             sticky_derr = 0
             for fut in self._pending:
@@ -1878,6 +2004,15 @@ class PackedReplayDriver:
                         _phases.set_value(
                             "integrate.commit_word", self.stats.commit_word
                         )
+                    # ISSUE-18 capacity ledger: same freshest-supersedes
+                    # semantics — the words are recomputed from the
+                    # CURRENT state at each readout
+                    base = 4 + SCAN_REC_WORDS
+                    self._record_capacity_ledger(
+                        int(vals[base]),
+                        int(vals[base + 1]),
+                        int(vals[base + 2]),
+                    )
                 self.stats.peak_blocks = max(self.stats.peak_blocks, occ)
                 if derr != 0:
                     if self.quarantine and self.on_quarantine is not None:
@@ -1938,6 +2073,48 @@ class PackedReplayDriver:
                 _phases.set_value(
                     f"integrate.scan_{name}.{self.lane}", v
                 )
+
+    def _record_capacity_ledger(
+        self, occupied: int, dead: int, dead_max: int
+    ) -> None:
+        """Fold one materialized readout's capacity-ledger words into
+        the driver stats, the `capacity.*` phase gauges, and (when set)
+        the headroom forecaster (ISSUE-18). Called only from drains —
+        the words arrive on the readout future the host was already
+        blocking on, so this adds ZERO device syncs."""
+        from ytpu.utils.phases import phases as _phases
+
+        st = self.stats
+        st.occupied_rows = int(occupied)
+        st.dead_rows = int(dead)
+        st.dead_max = int(dead_max)
+        D = self.cols.shape[1]
+        total = D * self.capacity
+        if self.forecaster is not None:
+            self.forecaster.observe(
+                n_docs=D,
+                capacity=self.capacity,
+                occupied_rows=st.occupied_rows,
+                dead_rows=st.dead_rows,
+                chunks=st.chunks,
+                max_capacity=self.max_capacity,
+            )
+        if _phases.enabled:
+            for name, v in (
+                ("occupied_rows", st.occupied_rows),
+                ("dead_rows", st.dead_rows),
+                ("dead_max", st.dead_max),
+                ("free_rows", total - st.occupied_rows),
+                (
+                    "dead_fraction",
+                    st.dead_rows / max(st.occupied_rows, 1),
+                ),
+                (
+                    "occupancy_fraction",
+                    st.occupied_rows / max(total, 1),
+                ),
+            ):
+                _phases.set_value(f"capacity.{name}", v)
 
     def _raise_device_error(self):
         meta_np = np.asarray(self.meta)
@@ -2022,15 +2199,34 @@ class PackedReplayDriver:
 
     def compact(self) -> int:
         """Force a commit-style on-device compaction of the packed state;
-        returns the actual high-water block count afterwards."""
+        returns the actual high-water block count afterwards. Efficacy
+        accounting (ISSUE-18): rows reclaimed vs the freshest
+        pre-compaction ledger, and the chunk gap since the previous
+        compaction (time-to-watermark) — both from readouts the call
+        was already draining, zero new syncs."""
         from ytpu.ops.compaction import compact_packed
+        from ytpu.utils.phases import phases as _phases
 
+        occ_before = self.stats.occupied_rows
         self.cols, self.meta = compact_packed(
             self.cols, self.meta, self.unit_refs, self.gc_ranges
         )
         self.stats.compactions += 1
+        if self._last_compact_chunk >= 0:
+            self.stats.compact_gap_chunks = (
+                self.stats.chunks - self._last_compact_chunk
+            )
+        self._last_compact_chunk = self.stats.chunks
         self._pending.append(_chunk_readout(self.cols, self.meta, self._err))
-        return self._drain_readouts()
+        hi = self._drain_readouts()
+        reclaimed = max(0, occ_before - self.stats.occupied_rows)
+        self.stats.reclaimed_rows += reclaimed
+        if _phases.enabled:
+            _phases.add_value("capacity.reclaimed_rows", reclaimed)
+            _phases.set_value(
+                "capacity.compact_gap_chunks", self.stats.compact_gap_chunks
+            )
+        return hi
 
     def ensure_room(self, margin: int) -> None:
         """Compact (and grow, when allowed) BEFORE a chunk whose worst-case
@@ -2058,7 +2254,27 @@ class PackedReplayDriver:
             from ytpu.ops.compaction import grow_packed
 
             try:
-                faults.maybe_raise("grow.oom")
+                spec = faults.fire("grow.oom")
+                if spec is not None:
+                    # typed denial (ISSUE-18): report attempted vs
+                    # available bytes so chaos can score the /capacity
+                    # forecaster against reality, and count it
+                    from ytpu.utils.capacity import memory_budget_bytes
+
+                    _GROW_DENIED.inc()
+                    D = self.cols.shape[1]
+                    raise GrowOomError(
+                        spec,
+                        capacity=self.capacity,
+                        new_capacity=new_cap,
+                        n_docs=D,
+                        attempted_bytes=packed_state_bytes(D, new_cap),
+                        available_bytes=int(
+                            spec.args.get(
+                                "budget", memory_budget_bytes()
+                            )
+                        ),
+                    )
                 self.cols, self.meta = grow_packed(
                     self.cols, self.meta, new_cap
                 )
@@ -2086,7 +2302,11 @@ class PackedReplayDriver:
         case slot growth; pass it when known host-side (e.g. from
         `ReplayPlan.adds`) to avoid touching the stream's valid masks."""
         from ytpu.models.batch_doc import stream_worst_case_adds
-        from ytpu.utils.phases import NULL_SPAN, phases as _phases
+        from ytpu.utils.phases import (
+            NULL_SPAN,
+            phases as _phases,
+            program_memory as _program_memory,
+        )
 
         if margin is None:
             margin = int(stream_worst_case_adds(stream).sum()) + 8
@@ -2116,6 +2336,11 @@ class PackedReplayDriver:
                          self.d_block, scan_plan),
                         axes=("state", "rows", "dels", "d_block",
                               "scan_plan"),
+                        memory=_program_memory(
+                            _run, self.cols, self.meta,
+                            (rows, dels, self.rank), self.d_block,
+                            self.interpret, 3, 4, vmem_mb, scan_plan,
+                        ),
                     )
                 else:
                     span = NULL_SPAN
@@ -2136,6 +2361,13 @@ class PackedReplayDriver:
                     "replay.chunk_xla",
                     (self.cols.shape, stream.client.shape, scan_plan),
                     axes=("state", "stream", "scan_plan"),
+                    # the jitted step is a lazily-built module singleton:
+                    # resolve it at thunk-invoke time (the span body
+                    # constructs it on the very first call)
+                    memory=_program_memory(
+                        lambda: _XLA_CHUNK_STEP, self.cols, self.meta,
+                        stream, self.rank, scan_plan,
+                    ),
                 )
                 if _phases.enabled
                 else NULL_SPAN
@@ -2166,7 +2398,11 @@ class PackedReplayDriver:
         shape statics. Returns the device input arrays (the caller's
         slot-reuse gate)."""
         from ytpu.utils import progbudget
-        from ytpu.utils.phases import NULL_SPAN, phases as _phases
+        from ytpu.utils.phases import (
+            NULL_SPAN,
+            phases as _phases,
+            program_memory as _program_memory,
+        )
 
         progbudget.tick()
         self.ensure_room(margin)
@@ -2192,6 +2428,12 @@ class PackedReplayDriver:
                      vmem_mb, scan_plan),
                     axes=("state", *span_axes, "lane", "d_block",
                           "vmem_mb", "scan_plan"),
+                    memory=_program_memory(
+                        program, self.cols, self.meta, self._err, *dev,
+                        self.rank, lane=lane, d_block=self.d_block,
+                        interpret=self.interpret, vmem_mb=vmem_mb,
+                        scan_plan=scan_plan, **program_kw,
+                    ),
                 )
                 if _phases.enabled
                 else NULL_SPAN

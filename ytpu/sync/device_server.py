@@ -129,13 +129,58 @@ class DeviceSyncServer(SyncServer):
 
     def _telemetry_provider(self) -> Dict:
         """`/snapshot` extras: the serving-side state a scraper wants
-        next to the raw metrics (JSON-safe, lock-free reads)."""
-        return {
+        next to the raw metrics (JSON-safe, lock-free reads), plus the
+        per-tenant occupancy/fragmentation ledger (ISSUE-18) — one
+        scrape-time device pull per snapshot, never on the serve path."""
+        out = {
             "tenants": len(self.tenants),
             "slots_assigned": len(self._slot_of),
             "n_docs": self.ingestor.n_docs,
             "queued_updates": self.pending_device_updates(),
             "device_authoritative": self.device_authoritative,
+        }
+        try:
+            out["capacity"] = self.capacity_snapshot()
+        except Exception as e:  # scrape must not take the server down
+            out["capacity"] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def capacity_snapshot(self) -> Dict:
+        """Per-tenant slot-occupancy ledger: live / dead (tombstoned,
+        GC-able) / free rows per assigned tenant slot, summing to the
+        slot capacity, plus batch-wide totals. Backs the ``capacity``
+        section of `/snapshot` and the per-tenant
+        ``capacity.tenant_*_rows`` gauges."""
+        from ytpu.utils import metrics
+
+        live, dead, free = self.ingestor.capacity_ledger()
+        slot_cap = int(live[0] + dead[0] + free[0]) if len(live) else 0
+        tenants: Dict[str, Dict] = {}
+        live_g = metrics.gauge("capacity.tenant_live_rows", labelnames=("tenant",))
+        dead_g = metrics.gauge("capacity.tenant_dead_rows", labelnames=("tenant",))
+        free_g = metrics.gauge("capacity.tenant_free_rows", labelnames=("tenant",))
+        for name, slot in sorted(self._slot_of.items()):
+            row = {
+                "slot": slot,
+                "live_rows": int(live[slot]),
+                "dead_rows": int(dead[slot]),
+                "free_rows": int(free[slot]),
+                "dead_fraction": round(
+                    int(dead[slot])
+                    / float(max(int(live[slot]) + int(dead[slot]), 1)),
+                    6,
+                ),
+            }
+            tenants[name] = row
+            live_g.labels(tenant=name).set(row["live_rows"])
+            dead_g.labels(tenant=name).set(row["dead_rows"])
+            free_g.labels(tenant=name).set(row["free_rows"])
+        return {
+            "slot_capacity": slot_cap,
+            "live_rows": int(sum(int(x) for x in live)),
+            "dead_rows": int(sum(int(x) for x in dead)),
+            "free_rows": int(sum(int(x) for x in free)),
+            "tenants": tenants,
         }
 
     def _enqueue(self, slot: int, payload: bytes) -> None:

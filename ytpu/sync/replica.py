@@ -1044,6 +1044,19 @@ class ReplicaMesh:
         # merged exposition (one `profile_*_fraction{replica=}` series
         # per bucket), and a cross-process pod reports its own
         out.update(profile_fractions())
+        # occupancy/fragmentation aggregates (ISSUE-18): device-backed
+        # replicas fold their slot ledger into `/fleet` so one merged
+        # scrape ranks replicas by fragmentation; host-only replicas
+        # (no ingestor) skip the section rather than report zeros
+        ing = getattr(rep.server, "ingestor", None)
+        if ing is not None:
+            try:
+                live, dead, free = ing.capacity_ledger()
+                out["capacity.live_rows"] = float(sum(int(x) for x in live))
+                out["capacity.dead_rows"] = float(sum(int(x) for x in dead))
+                out["capacity.free_rows"] = float(sum(int(x) for x in free))
+            except Exception:
+                pass  # a mid-teardown device pull must not kill the scrape
         return out
 
     def attach_telemetry(self, telemetry) -> None:

@@ -54,7 +54,11 @@ Standard sites (see docs/robustness.md for the full taxonomy):
                       ``prefix`` = OverlapPipeline stage_prefix; covers
                       the raw memcpy staging and the packed staging alike
                       — the site lives in the shared engine's worker)
-``grow.oom``          raise in place of `grow_packed` (device OOM)
+``grow.oom``          deny the next capacity grow as a device OOM — the
+                      driver raises the typed `GrowOomError` (ISSUE-18)
+                      naming attempted vs available bytes and counting
+                      ``memory.grow_denied`` (args: ``budget`` caps the
+                      reported available bytes)
 ``net.drop``          swallow one outbound frame
 ``net.truncate``      write a frame header + half the payload (stalls the
                       reader mid-frame)

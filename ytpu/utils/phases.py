@@ -35,6 +35,23 @@ and ``compile_storm_provider`` turns a blown budget into a degraded
 fault site perturbs the signature on demand so chaos can prove the
 detector fires end to end.
 
+Device-memory attribution (ISSUE-18): the same first-sighting path
+that journals a compile event can also capture XLA's compiled memory
+analysis. A call site passes ``memory=`` a zero-arg thunk (built with
+``program_memory(fn, *args, **kwargs)``) that AOT-lowers the jitted
+program against ShapeDtypeStruct snapshots and reads
+``compiled.memory_analysis()`` — a compile-cache HIT on the
+first-sighting path (the traced call just compiled the same program),
+so the capture costs ~1ms, never a second compile. The kind split
+(temp / argument / output / generated_code / alias bytes) is journaled
+INTO the compile event (``event["memory"]``), surfaced as
+``memory.program_bytes{program=,kind=}`` gauges plus a
+``memory.program_peak_bytes{program=}`` per-program ratchet, and
+rolled up by ``memory_report()`` (per-program peaks + the peak
+program — the resident-bytes axis the PR-4 roofline lacked).
+Snapshots are taken EAGERLY at thunk-build time because donated
+arguments (`donate_argnums`) are deleted by the time the span exits.
+
 Disabled-path contract (the default): one attribute check, zero
 allocation — call sites guard with ``if phases.enabled:`` before
 building keys, and ``span()`` hands back a shared no-op context
@@ -60,6 +77,7 @@ __all__ = [
     "phases",
     "NULL_SPAN",
     "compile_storm_provider",
+    "program_memory",
 ]
 
 #: journal ring bound — a run that compiles more programs than this is
@@ -123,14 +141,65 @@ def _sig_delta(prev, new, axes) -> List[Dict[str, str]]:
     return delta
 
 
-class _PhaseSpan:
-    __slots__ = ("_rec", "_stage", "_key", "_axes", "_start")
+def program_memory(fn, *args, **kwargs):
+    """Build a zero-arg memory-capture thunk for ``span(memory=...)``.
 
-    def __init__(self, rec: "PhaseRecorder", stage: str, key, axes=None):
+    Snapshots every array-like argument (has ``.shape`` and ``.dtype``)
+    into a ``jax.ShapeDtypeStruct`` EAGERLY — the instrumented programs
+    donate their state operands (`donate_argnums`), so by span exit the
+    real buffers are deleted; specs survive. Non-array arguments pass
+    through verbatim (they are the program's static args). ``fn`` is
+    the jitted callable, or a zero-arg resolver returning one (for
+    lazily-built module globals the span body itself constructs).
+
+    The thunk AOT-lowers and compiles against the specs — a
+    compile-cache hit when invoked on the first-sighting path, since
+    the traced call that just ran compiled the identical program — and
+    returns the ``memory_analysis()`` kind split in bytes, or raises
+    (the recorder treats any raise as "no capture")."""
+    import jax
+
+    def _spec(a):
+        if hasattr(a, "shape") and hasattr(a, "dtype"):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+        if isinstance(a, tuple) and hasattr(a, "_fields"):  # NamedTuple
+            return type(a)(*(_spec(x) for x in a))
+        if isinstance(a, (tuple, list)):
+            return type(a)(_spec(x) for x in a)
+        return a
+
+    specs = tuple(_spec(a) for a in args)
+    kwspecs = {k: _spec(v) for k, v in kwargs.items()}
+
+    def thunk():
+        f = fn if hasattr(fn, "lower") else fn()
+        stats = f.lower(*specs, **kwspecs).compile().memory_analysis()
+        return {
+            "temp_bytes": int(getattr(stats, "temp_size_in_bytes", 0)),
+            "argument_bytes": int(
+                getattr(stats, "argument_size_in_bytes", 0)
+            ),
+            "output_bytes": int(getattr(stats, "output_size_in_bytes", 0)),
+            "alias_bytes": int(getattr(stats, "alias_size_in_bytes", 0)),
+            "generated_code_bytes": int(
+                getattr(stats, "generated_code_size_in_bytes", 0)
+            ),
+        }
+
+    return thunk
+
+
+class _PhaseSpan:
+    __slots__ = ("_rec", "_stage", "_key", "_axes", "_start", "_memory")
+
+    def __init__(
+        self, rec: "PhaseRecorder", stage: str, key, axes=None, memory=None
+    ):
         self._rec = rec
         self._stage = stage
         self._key = key
         self._axes = axes
+        self._memory = memory
 
     def __enter__(self):
         self._start = time.perf_counter()
@@ -158,6 +227,8 @@ class _PhaseSpan:
                 st.execute_s += dt
         if event is not None:
             rec._emit_compile_metrics(event)
+            if self._memory is not None:
+                rec._record_memory(self._stage, event, self._memory)
         return False
 
 
@@ -175,6 +246,9 @@ class PhaseRecorder:
         #: compile-event journal (bounded ring; see compile_events)
         self._events: List[Dict] = []
         self._event_seq = 0
+        # ---- device-memory attribution (ISSUE-18) ----
+        #: per program: peak resident bytes + the signature that set it
+        self._memory_peaks: Dict[str, Dict] = {}
 
     def enable(self) -> None:
         self.enabled = True
@@ -190,6 +264,7 @@ class PhaseRecorder:
             self._axes.clear()
             self._events.clear()
             self._event_seq = 0
+            self._memory_peaks.clear()
 
     # --- compile/retrace sentinel (ISSUE-17) ---------------------------------
 
@@ -253,6 +328,90 @@ class PhaseRecorder:
         nonce = ("__fault__", spec.fired)
         return key + (nonce,) if isinstance(key, tuple) else (key, nonce)
 
+    # --- device-memory attribution (ISSUE-18) --------------------------------
+
+    def _record_memory(self, stage: str, event: Dict, thunk) -> None:
+        """Capture one program's memory analysis on its first-sighting
+        path (caller just emitted the compile event — the lock is NOT
+        held). A thunk that raises means the backend can't report
+        (interpret mode, host fallbacks): skip silently, the time
+        attribution already happened.
+
+        ``resident_bytes`` is the device footprint while the program
+        runs: arguments + outputs − aliased (donated buffers overlap
+        both) + temps. Generated code is charged separately — it is
+        real device memory on TPU but not per-invocation."""
+        try:
+            kinds = thunk()
+        except Exception:
+            return
+        if not kinds:
+            return
+        kinds = dict(kinds)
+        resident = (
+            kinds.get("argument_bytes", 0)
+            + kinds.get("output_bytes", 0)
+            - kinds.get("alias_bytes", 0)
+            + kinds.get("temp_bytes", 0)
+        )
+        kinds["resident_bytes"] = int(resident)
+        peak = 0
+        with self._lock:
+            event["memory"] = kinds
+            rec = self._memory_peaks.get(stage)
+            if rec is None or resident > rec["peak_bytes"]:
+                rec = self._memory_peaks[stage] = {
+                    "peak_bytes": int(resident),
+                    "signature": event["signature"],
+                    "kinds": kinds,
+                }
+            peak = rec["peak_bytes"]
+        self._emit_memory_metrics(stage, kinds, peak)
+
+    @staticmethod
+    def _emit_memory_metrics(stage: str, kinds: Dict, peak: int) -> None:
+        """Registry families for memory attribution — fresh lookups for
+        the same reset-safety reason as ``_emit_compile_metrics``."""
+        try:
+            from ytpu.utils.metrics import metrics
+        except Exception:  # pragma: no cover - import cycles in teardown
+            return
+        fam = metrics.gauge(
+            "memory.program_bytes", labelnames=("program", "kind")
+        )
+        for kind, v in kinds.items():
+            fam.labels(stage, kind).set(float(v))
+        metrics.gauge(
+            "memory.program_peak_bytes", labelnames=("program",)
+        ).labels(stage).set(float(peak))
+
+    def memory_report(self) -> Dict:
+        """Per-program peak-resident ledger + the overall peak program:
+        ``{"programs": {stage: {peak_bytes, signature, kinds}},
+        "peak_bytes": int, "peak_program": str|None}``. Peaks are
+        keyed by shape family — the signature names which shape set
+        the high-water mark."""
+        with self._lock:
+            programs = {
+                k: {
+                    "peak_bytes": v["peak_bytes"],
+                    "signature": v["signature"],
+                    "kinds": dict(v["kinds"]),
+                }
+                for k, v in self._memory_peaks.items()
+            }
+        peak_program = None
+        peak_bytes = 0
+        for name, rec in programs.items():
+            if rec["peak_bytes"] > peak_bytes:
+                peak_bytes = rec["peak_bytes"]
+                peak_program = name
+        return {
+            "programs": programs,
+            "peak_bytes": peak_bytes,
+            "peak_program": peak_program,
+        }
+
     def compile_marker(self) -> int:
         """Opaque high-water mark for ``compile_report(since=...)`` —
         take one after warmup; events at or before it are 'expected
@@ -288,16 +447,19 @@ class PhaseRecorder:
 
     # --- timers --------------------------------------------------------------
 
-    def span(self, stage: str, key=None, axes=None):
+    def span(self, stage: str, key=None, axes=None, memory=None):
         """Time one call of `stage`. `key` identifies the compiled
         program (first sighting = compile); None = host-only stage.
         ``axes`` optionally names the key's positions for retrace
-        attribution (e.g. ``("state", "rows", "scan_plan")``)."""
+        attribution (e.g. ``("state", "rows", "scan_plan")``).
+        ``memory`` optionally passes a ``program_memory(...)`` thunk,
+        invoked ONLY on the first-sighting path (compile-cache hit) to
+        journal the program's device-memory kind split."""
         if not self.enabled:
             return NULL_SPAN
         if key is not None:
             key = self._fault_key(stage, key)
-        return _PhaseSpan(self, stage, key, axes)
+        return _PhaseSpan(self, stage, key, axes, memory)
 
     def transfer(
         self, stage: str, nbytes: int, direction: str = "h2d"

@@ -28,7 +28,14 @@ path. Five endpoints:
   of the last device dispatch. A wedged device shows as a growing
   ``last_dispatch_age_s`` while this endpoint keeps answering (its
   thread never touches the data path), which is exactly what a probe
-  wants to distinguish "slow" from "dead".
+  wants to distinguish "slow" from "dead";
+- ``/capacity`` — the capacity observatory (ISSUE-18): the phase
+  recorder's per-program device-memory peak ledger
+  (`phases.memory_report()`) plus every registered capacity provider
+  (`add_capacity_provider` — e.g. a `HeadroomForecaster.report`, whose
+  ``degraded`` flag also rides `/healthz` when registered as a health
+  provider), so "how close is the next grow to the budget" is one
+  scrape away.
 
 Design constraints honored:
 
@@ -121,6 +128,13 @@ class _Handler(BaseHTTPRequestHandler):
                     "application/json",
                     json.dumps(self.telemetry.profile()).encode("utf-8"),
                 )
+            elif path == "/capacity":
+                _SCRAPES.labels("capacity").inc()
+                self._reply(
+                    200,
+                    "application/json",
+                    json.dumps(self.telemetry.capacity()).encode("utf-8"),
+                )
             elif path in ("/healthz", "/health"):
                 _SCRAPES.labels("healthz").inc()
                 self._reply(
@@ -173,6 +187,10 @@ class TelemetryServer:
         #: lines — how the soak driver publishes its windowed
         #: `HistogramWindow` series as real histogram expositions
         self._expositions: Dict[str, Callable[[], str]] = {}
+        #: `/capacity` sections (ISSUE-18): name -> zero-arg callable
+        #: (e.g. a HeadroomForecaster.report) merged into the capacity
+        #: body next to the per-program memory ledger
+        self._capacity_providers: Dict[str, Callable[[], object]] = {}
         #: `/profile` source (ISSUE-17): zero-arg callable returning the
         #: unified wall-time budget; defaults to the process-lifetime
         #: `profile_report()` window until a run installs its own
@@ -273,6 +291,32 @@ class TelemetryServer:
         from ytpu.utils.profile import profile_report
 
         return profile_report()
+
+    def add_capacity_provider(
+        self, name: str, fn: Callable[[], object]
+    ) -> None:
+        """Register (or replace) a named `/capacity` section (ISSUE-18)
+        — typically a ``HeadroomForecaster.report``. Register the same
+        callable with ``add_health_provider`` when its ``degraded``
+        flag should also flip `/healthz`."""
+        self._capacity_providers[name] = fn
+
+    def capacity(self) -> Dict:
+        """The `/capacity` JSON body (ISSUE-18): the per-program
+        device-memory peak ledger (empty until a first sighting under
+        ``YTPU_PHASES``) plus every registered capacity provider. A
+        raising provider degrades to an error section — same contract
+        as `/snapshot`."""
+        out: Dict = {
+            "time_unix": time.time(),
+            "memory": phases.memory_report(),
+        }
+        for name, fn in list(self._capacity_providers.items()):
+            try:
+                out[name] = fn()
+            except Exception as e:
+                out[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        return out
 
     def add_health_provider(self, name: str, fn: Callable[[], object]) -> None:
         """Register a named `/healthz` section (ISSUE-13): the section
