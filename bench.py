@@ -115,6 +115,7 @@ TUNNEL_QUEUE = [
     "fleet_canary_pr15",
     "autopilot_soak_pr16",
     "doc_ceiling_pr18",
+    "doc_axis_shard_pr20",
 ]
 
 # Which measurement surface pays each owed entry off (ISSUE-17
@@ -144,6 +145,11 @@ _TUNNEL_SATISFIERS = {
     # memory ceiling (the CPU sweep is compile-only; the TPU run's
     # memory_analysis numbers are the real HBM curve)
     "doc_ceiling_pr18": lambda c: "doc_ceiling" in c,
+    # ISSUE-20: paid off by a hardware round that measures sub-batched
+    # dispatch — throughput vs n_sub on a real device mesh (the CPU
+    # scaling leg only shows the single-device overhead floor)
+    "doc_axis_shard_pr20": lambda c: "sub_batch_scaling" in c
+    or "subbatch_width" in c,
 }
 
 
@@ -2474,7 +2480,15 @@ def doc_ceiling_dry_run() -> dict:
     from ytpu.ops.integrate_kernel import packed_state_bytes
 
     budget = 3 * packed_state_bytes(768, 512)
-    sweep = doc_ceiling.doc_ceiling_sweep(capacity=512, budget_bytes=budget)
+    # the dry-run leg stops at 2048: its asserts pin the 1024x8 bust,
+    # and AOT-lowering the 4096/8192 monoliths (ISSUE-20 extended the
+    # default axis) costs minutes of pure tracing the CI gate doesn't
+    # need — the committed --sub-batch artifact covers the full axis
+    sweep = doc_ceiling.doc_ceiling_sweep(
+        docs_axis=(64, 128, 256, 512, 1024, 2048),
+        capacity=512,
+        budget_bytes=budget,
+    )
     assert sweep["memory_curve_monotone"], [
         p["grow_resident_bytes"] for p in sweep["points"]
     ]
@@ -2488,6 +2502,133 @@ def doc_ceiling_dry_run() -> dict:
     assert sweep["doc_ceiling"] == 512, sweep["doc_ceiling"]
     assert sweep["capacity_headroom_fraction"] > 0, sweep
     return sweep
+
+
+def doc_shard_dry_run() -> dict:
+    """Doc-axis sub-batch/sharding rehearsal (ISSUE-20): the whole
+    sharded-dispatch path on CPU with real jax, asserted end to end —
+
+    - `plan_subbatches` under the PINNED PR-18 budget picks width 512
+      at 1024 docs (the monolith that used to bust the budget) and
+      keeps it through 8192 docs: the compile-only ceiling is gone;
+    - single-device sharding fallback is byte-clean: no batch mesh, no
+      device placement, `shard_docs_put` is the identity;
+    - monolithic vs sub-batched replay is BYTE-identical (packed cols +
+      meta + the ISSUE-13 commitment word) with the same 1-sync drain
+      count — the zero-sync readout invariant survives the fold;
+    - forecaster-driven narrowing fires under an armed ``grow.oom``:
+      the width demotes (counted `capacity.subbatch_narrowed`), the
+      grow retries and succeeds, and the chunk is never killed (zero
+      recoveries) — the satellite fix, proven in the gate."""
+    import numpy as np
+
+    from ytpu.models.replay import FusedReplay, plan_replay, plan_subbatches
+    from ytpu.ops.integrate_kernel import packed_state_bytes
+    from ytpu.parallel import mesh as pmesh
+    from ytpu.utils import metrics
+    from ytpu.utils.capacity import HeadroomForecaster
+    from ytpu.utils.faults import faults
+
+    # 1. plan math under the pinned PR-18 budget (host arithmetic)
+    budget = 3 * packed_state_bytes(768, 512)
+    plan = plan_subbatches(1024, 512, d_block=8, budget_bytes=budget)
+    assert plan.width == 512 and plan.n_sub == 2, plan
+    assert plan.feasible and not plan.monolithic, plan
+    assert plan.transient_bytes <= budget < plan.monolithic_bytes, plan
+    wide = plan_subbatches(8192, 512, d_block=8, budget_bytes=budget)
+    assert wide.width == 512 and wide.n_sub == 16, wide
+    assert wide.feasible, wide
+
+    # 2. single-device sharding fallback (the dry-run host has one CPU
+    # device): every mesh helper degrades to a no-op
+    import jax
+
+    single = len(jax.devices()) == 1
+    if single:
+        assert pmesh.batch_mesh() is None
+        assert pmesh.subbatch_devices(4) is None
+        probe = np.arange(8)
+        assert pmesh.shard_docs_put(probe) is probe
+
+    # 3. byte parity monolithic vs sub-batched + zero-sync invariant
+    ops = []
+    for k in range(14):
+        ops.append(("i", 0, f"shard{k:02d}-" + "x" * 20))
+        ops.append(("d", 5, 3))
+    log, expect = build_updates(ops)
+    rplan = plan_replay(log)
+    N, CAP = 4, 256
+
+    def replay(**kw):
+        r = FusedReplay(
+            N, rplan, capacity=CAP, max_capacity=4 * CAP, d_block=2,
+            chunk=16, lane="xla", overlap=True, ingest="raw",
+            sync_per_chunk=False, **kw,
+        )
+        r.run(log)
+        return r
+
+    mono = replay()
+    w2_budget = packed_state_bytes(2, CAP) + packed_state_bytes(2, 2 * CAP)
+    sub = replay(
+        shard_docs=True,
+        forecaster=HeadroomForecaster(budget_bytes=w2_budget),
+    )
+    assert sub.stats.subbatch_width == 2, sub.stats
+    parity = bool(
+        np.array_equal(np.asarray(mono.cols), np.asarray(sub.cols))
+        and np.array_equal(np.asarray(mono.meta), np.asarray(sub.meta))
+    )
+    assert parity, "sub-batched replay diverged from monolithic"
+    assert mono.stats.commit_word == sub.stats.commit_word
+    assert mono.stats.syncs == sub.stats.syncs == 1, (
+        mono.stats.syncs,
+        sub.stats.syncs,
+    )
+    assert sub.get_string(0) == expect == sub.get_string(N - 1)
+
+    # 4. forecaster-driven narrowing under an armed grow.oom: demote
+    # the width instead of killing the chunk
+    grow_ops = [("i", 0, "abcdefgh") for _ in range(40)]
+    grow_log, grow_expect = build_updates(grow_ops)
+    grow_plan = plan_replay(grow_log)
+    narrowed0 = metrics.counter("capacity.subbatch_narrowed").value
+    faults.clear()
+    faults.arm("grow.oom")
+    try:
+        oom = FusedReplay(
+            4, grow_plan, capacity=32, max_capacity=1024, d_block=2,
+            chunk=8, lane="xla", overlap=True, ingest="raw",
+            sync_per_chunk=False, shard_docs=True,
+            forecaster=HeadroomForecaster(budget_bytes=1 << 30),
+        )
+        oom.run(grow_log)
+    finally:
+        faults.clear()
+    narrowed = metrics.counter("capacity.subbatch_narrowed").value - narrowed0
+    assert narrowed >= 1, "armed grow.oom never narrowed the sub-batch"
+    assert oom.stats.subbatch_narrowed == narrowed, oom.stats
+    assert oom.stats.growths >= 1, oom.stats
+    assert oom.stats.recoveries == 0, (
+        "narrowing must absorb the denial in place",
+        oom.stats,
+    )
+    assert oom.get_string(0) == grow_expect
+
+    return {
+        "plan_1024": {
+            "width": plan.width,
+            "n_sub": plan.n_sub,
+            "transient_bytes": plan.transient_bytes,
+            "monolithic_bytes": plan.monolithic_bytes,
+        },
+        "single_device_fallback": single,
+        "parity": parity,
+        "zero_sync_syncs": sub.stats.syncs,
+        "subbatch_width": sub.stats.subbatch_width,
+        "subbatch_narrowed": narrowed,
+        "narrow_journal_growths": oom.stats.growths,
+    }
 
 
 def _capture_rank(path: str, d: dict):
@@ -2717,6 +2858,8 @@ _TRAJECTORY_KEYS = (
     "memory_peak_bytes",
     "capacity_headroom_fraction",
     "doc_ceiling",
+    "sub_batch_scaling",
+    "subbatch_width",
 )
 
 
@@ -2996,6 +3139,18 @@ def main(dry_run: bool = False, compare_baseline: bool = False):
             p["grow_resident_bytes"]
             for p in out["doc_ceiling_sweep"]["points"]
         )
+        # doc-axis sub-batch/sharding rehearsal (ISSUE-20): plan math
+        # under the pinned budget, single-device fallback, byte parity
+        # monolithic-vs-sub-batched with the zero-sync invariant, and
+        # forecaster-driven narrowing under an armed grow.oom — the
+        # whole sharded path exercised without silicon; `subbatch_width`
+        # rides the one-line JSON (neutral in bench_compare;
+        # `sub_batch_scaling` comes from the doc_ceiling --sub-batch
+        # artifact, not the dry run — its widths compile their own
+        # raw-staging families, too slow for the CI rehearsal)
+        with phases.span("host.doc_shard_rehearsal"):
+            out["doc_shard"] = doc_shard_dry_run()
+        out["subbatch_width"] = out["doc_shard"]["subbatch_width"]
         owed, burned = _burn_tunnel_queue()
         out["tunnel_queue"] = owed
         out["tunnel_burned"] = burned

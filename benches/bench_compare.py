@@ -127,6 +127,16 @@ _DIRECTION_RULES: Tuple[Tuple[str, str], ...] = (
     # they are workload shape, like the scan-tier occupancy split.
     ("headroom_fraction", "up"),
     ("doc_ceiling", "up"),
+    # doc-axis sub-batching (ISSUE-20): a narrowed sub-batch width is
+    # the memory budget closing in mid-replay — `subbatch_narrowed`
+    # regresses on RISE. The width itself and the scaling ratio are
+    # configuration/workload shape, not better/worse (the single-device
+    # CPU ratio is an overhead floor, the mesh path the speedup axis):
+    # both pin neutral, with the narrowed rule FIRST so its leaf never
+    # falls through to the neutral `subbatch_` catch-all.
+    ("subbatch_narrowed", "down"),
+    ("sub_batch_scaling", "neutral"),
+    ("subbatch_", "neutral"),
     ("memory_budget", "neutral"),
     ("memory_", "down"),
     ("peak_bytes", "down"),

@@ -37,9 +37,18 @@ count. The committed artifact (`doc_ceiling_pr18.json`) pins a 768-doc
 artifact NAMES the first failing family — the 1024-doc shapes, matching
 the ROADMAP's observed TPU ceiling.
 
+The ``--sub-batch`` leg (ISSUE-20) reruns the sweep with each point's
+grow/compact programs lowered at the `plan_subbatches` width instead of
+the full doc axis — the per-dispatch transient the sub-batched
+`PackedReplayDriver` actually allocates. Under the same pinned PR-18
+budget the curve then clears 1024/2048 (and the whole extended axis):
+the committed `doc_ceiling_pr20.json` artifact pins that push. The leg
+also measures throughput vs ``n_sub`` (`sub_batch_scaling`) on a real
+CPU replay, so the doc-axis sharding path has a trendable speedup axis.
+
 Standalone::
 
-    JAX_PLATFORMS=cpu python benches/doc_ceiling.py [out.json]
+    JAX_PLATFORMS=cpu python benches/doc_ceiling.py [--sub-batch] [out.json]
 
 `bench.py --dry-run` runs the same sweep as its ``doc_ceiling`` leg and
 lifts ``doc_ceiling`` / ``memory_peak_bytes`` /
@@ -53,11 +62,12 @@ import os
 import sys
 import time
 
-__all__ = ["doc_ceiling_sweep", "main"]
+__all__ = ["doc_ceiling_sweep", "sub_batch_scaling", "main"]
 
-#: the swept doc axis: pow2 64 → 2048 (the flagship 2048-doc config4
-#: shape is the top rung; 1024 is ROADMAP item 1's observed killer)
-DOCS_AXIS = (64, 128, 256, 512, 1024, 2048)
+#: the swept doc axis: pow2 64 → 8192 (ISSUE-20 extended it past the
+#: flagship 2048-doc config4 shape into the 10k north-star's
+#: neighborhood; 1024 is ROADMAP item 1's observed killer)
+DOCS_AXIS = (64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
 #: slot capacity every point sweeps at — deliberately fixed so the doc
 #: axis is the only variable in the curve
@@ -83,11 +93,19 @@ def doc_ceiling_sweep(
     capacity: int | None = None,
     budget_bytes: int | None = None,
     d_block: int = DEFAULT_D_BLOCK,
+    sub_batch: bool = False,
 ) -> dict:
-    """Run the compile-only sweep; returns the artifact dict."""
+    """Run the compile-only sweep; returns the artifact dict.
+
+    ``sub_batch=True`` (ISSUE-20) lowers each point's grow/compact
+    programs at its `plan_subbatches` width instead of the full doc
+    axis — the transient ONE sub-batched dispatch actually allocates —
+    so the curve measures what the sharded driver pays per slice while
+    the doc axis keeps growing."""
     import jax
     import jax.numpy as jnp
 
+    from ytpu.models.replay import plan_subbatches
     from ytpu.ops.compaction import _compact_packed_jit, grow_packed
     from ytpu.ops.integrate_kernel import (
         M_PAD,
@@ -121,8 +139,19 @@ def doc_ceiling_sweep(
     prev_resident = -1
     monotone = True
     for docs in docs_axis:
-        cols = jax.ShapeDtypeStruct((NC, int(docs), capacity), jnp.int32)
-        meta = jax.ShapeDtypeStruct((int(docs), M_PAD), jnp.int32)
+        # sub-batch leg (ISSUE-20): the programs lower at the planned
+        # pow2 slice width — the per-dispatch working set — while the
+        # point still reports the full doc axis
+        if sub_batch:
+            plan = plan_subbatches(
+                int(docs), capacity, d_block=d_block,
+                budget_bytes=budget_bytes,
+            )
+            model_docs = plan.width
+        else:
+            model_docs = int(docs)
+        cols = jax.ShapeDtypeStruct((NC, model_docs, capacity), jnp.int32)
+        meta = jax.ShapeDtypeStruct((model_docs, M_PAD), jnp.int32)
         t0 = time.perf_counter()
         grow_kinds = program_memory(grow_jit, cols, meta, 2 * capacity)()
         compact_kinds = program_memory(
@@ -130,12 +159,12 @@ def doc_ceiling_sweep(
         )()
         compile_s = time.perf_counter() - t0
         grow_resident = _resident(grow_kinds)
-        analytic = packed_state_bytes(docs, capacity) + packed_state_bytes(
-            docs, 2 * capacity
-        )
+        analytic = packed_state_bytes(
+            model_docs, capacity
+        ) + packed_state_bytes(model_docs, 2 * capacity)
         # feed the MEASURED transient so the forecaster models reality
         fc.observe(
-            n_docs=docs,
+            n_docs=model_docs,
             capacity=capacity,
             occupied_rows=0,
             resident_bytes=grow_resident,
@@ -147,27 +176,31 @@ def doc_ceiling_sweep(
         if grow_resident < prev_resident:
             monotone = False
         prev_resident = grow_resident
-        points.append(
-            {
-                "docs": int(docs),
-                "capacity": capacity,
-                "family": f"{docs}x{d_block}",
-                "grow_resident_bytes": int(grow_resident),
-                "grow_kinds": grow_kinds,
-                "compact_resident_bytes": int(_resident(compact_kinds)),
-                "analytic_bytes": int(analytic),
-                "within_budget": bool(ok),
-                "lane": effective_lane(fam, "fused" if fused_probed else "xla"),
-                "compile_s": round(compile_s, 3),
-            }
-        )
+        point = {
+            "docs": int(docs),
+            "capacity": capacity,
+            "family": f"{docs}x{d_block}",
+            "grow_resident_bytes": int(grow_resident),
+            "grow_kinds": grow_kinds,
+            "compact_resident_bytes": int(_resident(compact_kinds)),
+            "analytic_bytes": int(analytic),
+            "within_budget": bool(ok),
+            "lane": effective_lane(fam, "fused" if fused_probed else "xla"),
+            "compile_s": round(compile_s, 3),
+            "model_docs": model_docs,
+        }
+        if sub_batch:
+            point["subbatch_width"] = int(plan.width)
+            point["n_sub"] = int(plan.n_sub)
+            point["monolithic_bytes"] = int(plan.monolithic_bytes)
+        points.append(point)
 
     # forecaster-vs-measured: worst relative error of the fitted model
     # across the swept points (the analytic formula is exact up to XLA's
     # small fixed overhead, so this should be well under 5%)
     model_err = 0.0
     for p in points:
-        est = fc.model_bytes(p["docs"], capacity)
+        est = fc.model_bytes(p["model_docs"], capacity)
         err = abs(est - p["grow_resident_bytes"]) / max(
             p["grow_resident_bytes"], 1
         )
@@ -184,7 +217,7 @@ def doc_ceiling_sweep(
             headroom = round(
                 1.0 - p["grow_resident_bytes"] / float(budget_bytes), 6
             )
-    return {
+    out = {
         "metric": "doc_axis_memory_ceiling",
         "unit": "docs surviving the grow-transient budget (compile-only)",
         "platform": jax.default_backend(),
@@ -199,11 +232,132 @@ def doc_ceiling_sweep(
         "capacity_headroom_fraction": headroom,
         "fused_probed": fused_probed,
         "lane_health": lane_health(),
+        "sub_batch": bool(sub_batch),
+    }
+    if sub_batch:
+        # the monolithic cross-reference, from the analytic transient
+        # (no extra AOT compiles): the first family whose ONE-dispatch
+        # grow would bust the same budget — what the artifact's pushed
+        # ceiling is measured against
+        mono_failing = None
+        for docs in docs_axis:
+            mono = packed_state_bytes(
+                int(docs), capacity
+            ) + packed_state_bytes(int(docs), 2 * capacity)
+            if mono > budget_bytes:
+                mono_failing = f"{docs}x{d_block}"
+                break
+        out["monolithic_first_failing_family"] = mono_failing
+    return out
+
+
+def _build_typing_workload(n_ops: int = 60):
+    """Wire updates of a small repetitive typing+erase session (host
+    CRDT, one client) — every doc slot integrates the same stream, so
+    throughput scales with the doc axis."""
+    from ytpu.core import Doc
+
+    doc = Doc(client_id=1)
+    log = []
+    doc.observe_update_v1(lambda p, o, t: log.append(p))
+    txt = doc.get_text("text")
+    for k in range(n_ops):
+        with doc.transact() as txn:
+            if k % 4 == 3:
+                txt.remove_range(txn, 2, 3)
+            else:
+                txt.insert(txn, 0, f"w{k:03d}-abcdef")
+    return log
+
+
+def sub_batch_scaling(
+    n_docs: int = 8,
+    capacity: int = 256,
+    n_ops: int = 60,
+    chunk: int = 16,
+) -> dict:
+    """Throughput vs ``n_sub`` on a REAL replay (ISSUE-20): the same
+    workload integrates at every pow2 sub-batch width from monolithic
+    down to 2 docs/slice, each width forced through a budget that
+    admits exactly it. On a single CPU device narrower widths pay the
+    re-dispatch overhead (ratio ≤ 1); on a batch mesh the slices spread
+    across devices — this leg is the trendable axis for that speedup
+    (VERDICT Weak #5's sp-axis promise)."""
+    import jax
+
+    from ytpu.models.replay import FusedReplay, plan_replay
+    from ytpu.ops.integrate_kernel import packed_state_bytes
+    from ytpu.utils.capacity import HeadroomForecaster
+
+    log = _build_typing_workload(n_ops)
+    plan = plan_replay(log)
+
+    def run_at(width: int | None) -> dict:
+        kw = {}
+        if width is not None:
+            budget = packed_state_bytes(width, capacity) + packed_state_bytes(
+                width, 2 * capacity
+            )
+            kw = dict(
+                shard_docs=True,
+                forecaster=HeadroomForecaster(budget_bytes=budget),
+            )
+        r = FusedReplay(
+            n_docs,
+            plan,
+            capacity=capacity,
+            max_capacity=4 * capacity,
+            d_block=2,
+            chunk=chunk,
+            lane="xla",
+            overlap=True,
+            ingest="raw",
+            sync_per_chunk=False,
+            **kw,
+        )
+        t0 = time.perf_counter()
+        r.run(log)
+        wall = time.perf_counter() - t0
+        applied = len(log) * n_docs
+        return {
+            "width": int(width if width is not None else n_docs),
+            "n_sub": int(1 if width is None else (n_docs + width - 1) // width),
+            "updates_per_s": round(applied / max(wall, 1e-9), 1),
+            "wall_s": round(wall, 4),
+            "subbatch_width": int(r.stats.subbatch_width),
+            "syncs": int(r.stats.syncs),
+        }
+
+    widths: list = [None]
+    w = n_docs // 2
+    while w >= 2:
+        widths.append(w)
+        w //= 2
+    # warm every width's compile caches off the clock (each slice width
+    # is its own chunk-program shape family)
+    for w in widths:
+        run_at(w)
+    points = [run_at(w) for w in widths]
+    base = points[0]["updates_per_s"]
+    best_sub = max((p["updates_per_s"] for p in points[1:]), default=base)
+    return {
+        "metric": "sub_batch_scaling",
+        "platform": jax.default_backend(),
+        "n_docs": int(n_docs),
+        "capacity": int(capacity),
+        "n_updates": len(log),
+        "points": points,
+        # best sub-batched throughput vs monolithic on THIS host —
+        # neutral in bench_compare (single-device overhead is expected;
+        # the mesh path is where the ratio exceeds 1)
+        "sub_batch_scaling": round(best_sub / max(base, 1e-9), 4),
     }
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    sub_batch = "--sub-batch" in argv
+    argv = [a for a in argv if a != "--sub-batch"]
     out_path = argv[0] if argv else None
     here = os.path.dirname(os.path.abspath(__file__))
     for p in (here, os.path.dirname(here)):
@@ -212,7 +366,9 @@ def main(argv=None) -> int:
     from _env import repin_jax_platforms
 
     repin_jax_platforms()
-    sweep = doc_ceiling_sweep()
+    sweep = doc_ceiling_sweep(sub_batch=sub_batch)
+    if sub_batch:
+        sweep["sub_batch_scaling"] = sub_batch_scaling()
     line = json.dumps(sweep)
     print(line)
     if out_path:

@@ -175,6 +175,42 @@ def test_direction_classification_rules():
     assert bc.classify("capacity.dead_rows") == "neutral"
     assert bc.classify("capacity.dead_fraction") == "neutral"
     assert bc.classify("capacity.occupancy_fraction") == "neutral"
+    # doc-axis sub-batching (ISSUE-20): a narrowed width is the budget
+    # closing in mid-replay — regresses on RISE; the width and the
+    # scaling ratio are configuration/workload shape, pinned neutral
+    # (doc_ceiling keeps its ISSUE-18 up direction on the sub-batch leg)
+    assert bc.classify("capacity.subbatch_narrowed") == "down"
+    assert bc.classify("metrics.capacity.subbatch_narrowed") == "down"
+    assert bc.classify("doc_shard.subbatch_narrowed") == "down"
+    assert bc.classify("subbatch_width") == "neutral"
+    assert bc.classify("doc_shard.subbatch_width") == "neutral"
+    assert bc.classify("phases.subbatch.width.value") == "neutral"
+    assert bc.classify("sub_batch_scaling") == "neutral"
+    assert bc.classify("doc_shard.sub_batch_scaling.sub_batch_scaling") == "neutral"
+    assert bc.classify("doc_ceiling_pr20.doc_ceiling") == "up"
+
+
+def test_subbatch_families_regress_on_rise():
+    """ISSUE-20 satellite: a `capacity.subbatch_narrowed` rise on the
+    same workload is a REGRESSION (the budget forced a narrower width);
+    subbatch_width / sub_batch_scaling drift is reported-neutral."""
+    a = {
+        "metrics": {"capacity.subbatch_narrowed": 0},
+        "subbatch_width": 512,
+        "sub_batch_scaling": 0.9,
+    }
+    b = {
+        "metrics": {"capacity.subbatch_narrowed": 3},  # budget closing in
+        "subbatch_width": 128,  # configuration shift: neutral
+        "sub_batch_scaling": 0.5,  # overhead floor drift: neutral
+    }
+    diff = bc.compare(a, b)
+    keys = {e["key"] for e in diff["regressions"]}
+    assert keys == {"metrics.capacity.subbatch_narrowed"}, diff
+    assert {e["key"] for e in diff["changes"]} == {
+        "subbatch_width",
+        "sub_batch_scaling",
+    }, diff
 
 
 def test_observatory_families_regress_on_rise():

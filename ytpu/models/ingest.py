@@ -77,11 +77,16 @@ class BatchIngestor:
         capacity: int,
         enc: Optional[BatchEncoder] = None,
         ingest: str = "raw",
+        shard_docs: bool = False,
     ):
         if ingest not in ("raw", "packed"):
             raise ValueError(f"ingest must be 'raw' or 'packed', got {ingest!r}")
         self.enc = enc or BatchEncoder()
         self.n_docs = n_docs
+        #: doc-axis sharding (ISSUE-20): place the batched state so its
+        #: doc axis spans the batch mesh (`ytpu.parallel.mesh`); a no-op
+        #: on single-device hosts, so CPU behavior is byte-identical
+        self.shard_docs = bool(shard_docs)
         #: fast-lane wire shipping (ISSUE-9 satellite, ROADMAP item 2):
         #: ``"raw"`` (default) ships the eligible docs' updates as ONE
         #: flat concatenated byte arena + a tiny offsets table and
@@ -94,6 +99,16 @@ class BatchIngestor:
         #: (tests/test_serving_soak.py asserts it end to end).
         self.ingest = ingest
         self.state: DocStateBatch = init_state(n_docs, capacity)
+        if self.shard_docs:
+            import jax
+
+            from ytpu.parallel.mesh import batch_mesh, shard_docs_put
+
+            mesh = batch_mesh()
+            if mesh is not None:
+                self.state = jax.tree.map(
+                    lambda a: shard_docs_put(a, mesh), self.state
+                )
         self.svs: List[StateVector] = [StateVector() for _ in range(n_docs)]
         # per-doc stash: carriers waiting for dependencies + deferred deletes
         self._pending: List[Dict[int, list]] = [{} for _ in range(n_docs)]

@@ -87,6 +87,7 @@ class UpdatePipeline:
         policy=None,
         max_capacity: Optional[int] = None,
         admission=None,
+        shard_docs: bool = False,
     ):
         if lane not in ("xla", "fused", "packed_xla"):
             raise ValueError(
@@ -112,6 +113,10 @@ class UpdatePipeline:
         #: backpressure at the source, the same valve the sync servers
         #: apply per inbound update
         self.admission = admission
+        #: doc-axis sub-batching (ISSUE-20), threaded to the packed
+        #: drivers this pipeline constructs — each integrate dispatch
+        #: then runs per pow2 doc-width slice under the memory budget
+        self.shard_docs = shard_docs
 
     def _chunks(self, payloads: Iterable[bytes]):
         """Decode + build padded micro-chunks (runs on the worker thread).
@@ -286,6 +291,7 @@ class UpdatePipeline:
             policy=self.policy,
             max_capacity=self.max_capacity,
             initial_occupancy=int(np.asarray(state.n_blocks).max()),
+            shard_docs=self.shard_docs,
         )
 
     def _finish_driver(

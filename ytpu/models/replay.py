@@ -49,6 +49,8 @@ __all__ = [
     "FusedReplay",
     "ChunkPlan",
     "plan_chunks",
+    "SubBatchPlan",
+    "plan_subbatches",
     "OverlapPipeline",
     "OverlapStats",
     "OverlapPlan",
@@ -273,6 +275,10 @@ class ReplayStats:
     dead_max: int = 0
     reclaimed_rows: int = 0
     compact_gap_chunks: int = 0
+    # doc-axis sub-batching (ISSUE-20): the driver's active pow2 slice
+    # width (0 = monolithic dispatch) and cumulative width demotions
+    subbatch_width: int = 0
+    subbatch_narrowed: int = 0
 
 
 @dataclass
@@ -348,6 +354,106 @@ def plan_chunks(adds, capacity: int, max_chunk: int = 8192, policy=None) -> Chun
         budget=budget,
         capacity=capacity,
         needs_compaction=int(adds.sum()) > capacity,
+    )
+
+
+@dataclass(frozen=True)
+class SubBatchPlan:
+    """Host-side doc-axis sub-batch plan for one integrate dispatch
+    (ISSUE-20, the doc-axis dual of `ChunkPlan`).
+
+    `width` is the fixed pow2 doc count per sub-batch — one compiled
+    chunk-program family per `(width, capacity)` pair serves every
+    slice; `transient_bytes` the worst per-dispatch allocation the plan
+    admits (`packed_state_bytes(width, C) + packed_state_bytes(width,
+    2C)`: a slice plus the grow transient its `ensure_room` may ask
+    for); `monolithic_bytes` the same transient at the full doc axis
+    (what the plan avoids allocating)."""
+
+    width: int
+    n_sub: int
+    n_docs: int
+    capacity: int
+    budget_bytes: int
+    transient_bytes: int
+    monolithic_bytes: int
+
+    @property
+    def monolithic(self) -> bool:
+        """True when the whole doc axis fits one dispatch — the
+        sub-batch loop then degenerates to the PR-5 single-dispatch
+        path, byte-identically."""
+        return self.width >= self.n_docs
+
+    @property
+    def feasible(self) -> bool:
+        """The per-dispatch transient fits the budget at this width."""
+        return self.transient_bytes <= self.budget_bytes
+
+
+def plan_subbatches(
+    n_docs: int,
+    capacity: int,
+    *,
+    d_block: int = 1,
+    budget_bytes: Optional[int] = None,
+    forecaster=None,
+    max_width: Optional[int] = None,
+) -> SubBatchPlan:
+    """Size the pow2 doc-width sub-batch so one dispatch's grow
+    transient fits the memory budget — the `plan_chunks` pow2
+    round-down, applied to the doc axis instead of the step axis.
+
+    Starts at the largest pow2 ≤ `n_docs` that divides it (every slice
+    then shares ONE shape family — the retrace bound the PR-17 sentinel
+    pins) and halves while `packed_state_bytes(w, C) +
+    packed_state_bytes(w, 2C)` busts the budget, flooring at `d_block`
+    (the fused lane can't tile below its block) or 1. The budget comes
+    from, in order: the explicit arg, the forecaster's pinned
+    `budget_bytes`, the observatory's `memory_budget_bytes()`; when the
+    forecaster has fitted samples its `model_bytes` replaces the
+    analytic formula so the plan tracks measured reality."""
+    from ytpu.ops.integrate_kernel import packed_state_bytes
+    from ytpu.utils.capacity import memory_budget_bytes
+
+    n_docs = int(n_docs)
+    capacity = int(capacity)
+    if budget_bytes is None:
+        budget_bytes = (
+            forecaster.budget_bytes
+            if forecaster is not None
+            else memory_budget_bytes()
+        )
+    budget_bytes = int(budget_bytes)
+    floor = max(int(d_block), 1)
+
+    model = (
+        forecaster.model_bytes
+        if forecaster is not None
+        else packed_state_bytes
+    )
+
+    def transient(w: int) -> int:
+        return int(model(w, capacity)) + int(model(w, 2 * capacity))
+
+    # largest pow2 ≤ n_docs that divides it (pow2 halving preserves
+    # divisibility, so the loop below never has to re-check)
+    width = 1 << max(0, n_docs.bit_length() - 1)
+    while width > 1 and n_docs % width:
+        width //= 2
+    if max_width is not None:
+        while width > max(int(max_width), 1):
+            width //= 2
+    while width > floor and transient(width) > budget_bytes:
+        width //= 2
+    return SubBatchPlan(
+        width=width,
+        n_sub=(n_docs + width - 1) // width,
+        n_docs=n_docs,
+        capacity=capacity,
+        budget_bytes=budget_bytes,
+        transient_bytes=transient(width),
+        monolithic_bytes=transient(n_docs),
     )
 
 
@@ -737,6 +843,7 @@ class FusedReplay:
         quarantine: bool = False,
         max_recoveries: int = 3,
         forecaster=None,
+        shard_docs: bool = False,
     ):
         import jax.numpy as jnp
 
@@ -785,6 +892,11 @@ class FusedReplay:
         # fed at every materialized ledger readout by the driver(s) this
         # replay creates — None keeps the hot path untouched
         self.forecaster = forecaster
+        # doc-axis sub-batching (ISSUE-20): split each integrate dispatch
+        # into pow2 doc-width slices sized by `plan_subbatches` against
+        # the forecaster's budget, so the 1024-doc monolith never
+        # allocates. False keeps the PR-5 single-dispatch path.
+        self.shard_docs = shard_docs
         self.capacity0 = capacity
         self.cols, self.meta = pack_state(init_state(n_docs, capacity))
         self.stats = ReplayStats(capacity=capacity)
@@ -826,6 +938,7 @@ class FusedReplay:
             sync_every_chunk=self.sync_per_chunk and not self.overlap,
             initial_occupancy=self._hi,
             quarantine=self.quarantine,
+            shard_docs=self.shard_docs,
         )
         driver.forecaster = self.forecaster
         return driver
@@ -983,6 +1096,8 @@ class FusedReplay:
         self.stats.dead_max = d.dead_max
         self.stats.reclaimed_rows += d.reclaimed_rows
         self.stats.compact_gap_chunks = d.compact_gap_chunks
+        self.stats.subbatch_width = d.subbatch_width
+        self.stats.subbatch_narrowed += d.subbatch_narrowed
         self._hi = d.final_blocks
 
     # ------------------------------------------- fault recovery (ISSUE-6)

@@ -1384,6 +1384,42 @@ def _chunk_readout(cols, meta, err):
     return _readout_words(cols, meta, err)
 
 
+@jax.jit
+def _fold_subbatch_readouts(stacked):
+    """Fold ``[n_sub, N_READOUT]`` per-sub-batch readouts into the ONE
+    ``[N_READOUT]`` surface the drain already parses (ISSUE-20): each
+    word folds with the same reduction `_readout_words` used to produce
+    it over docs — max for the occupancy/error/scan-max words, sum for
+    the histogram/tier/ledger totals, bitwise-OR for the sticky decode
+    flags (threaded slice→slice, so the fold is also just the last
+    word), uint32 wrap-sum for the ISSUE-13 commitment, and max for the
+    per-doc dead peak. The result is byte-identical to the monolithic
+    readout, so `_drain_readouts` (and the zero-sync invariant) never
+    learns sub-batching happened."""
+    mx = jnp.max(stacked, axis=0)
+    sm = jnp.sum(stacked, axis=0)
+    err = jax.lax.associative_scan(jnp.bitwise_or, stacked[:, 2])[-1]
+    commit = jax.lax.bitcast_convert_type(
+        jnp.sum(
+            jax.lax.bitcast_convert_type(
+                stacked[:, 3 + SCAN_REC_WORDS], jnp.uint32
+            )
+        ),
+        I32,
+    )
+    base = 4 + SCAN_REC_WORDS  # first capacity-ledger word
+    return jnp.concatenate(
+        [
+            jnp.stack([mx[0], mx[1], err]),
+            sm[3 : 3 + SCAN_REC_MAX],  # scan-width bucket totals
+            mx[3 + SCAN_REC_MAX][None],  # observed max scan width
+            sm[3 + SCAN_REC_CHEAP : 3 + SCAN_REC_WORDS],  # tier/trip sums
+            commit[None],
+            jnp.stack([sm[base], sm[base + 1], mx[base + 2]]),
+        ]
+    )
+
+
 def _chunk_core(
     cols,
     meta,
@@ -1662,6 +1698,11 @@ class ReplayChunkStats:
     dead_max: int = 0
     reclaimed_rows: int = 0
     compact_gap_chunks: int = 0
+    # doc-axis sub-batching (ISSUE-20): the active pow2 sub-batch width
+    # (0 = monolithic dispatch) and how many times the driver narrowed
+    # it — forecaster-driven or on a typed GrowOomError
+    subbatch_width: int = 0
+    subbatch_narrowed: int = 0
 
 
 # --- lane-health ladder + typed replay faults (ISSUE-6 tentpole) -------------
@@ -1687,6 +1728,11 @@ _QUARANTINED = _metrics.counter("replay.quarantined")
 #: fault site — the chaos-side truth the `/capacity` forecaster is
 #: scored against (forecast flagged BEFORE this counter moved?)
 _GROW_DENIED = _metrics.counter("memory.grow_denied")
+#: sub-batch width demotions (ISSUE-20): every halving of the doc-axis
+#: sub-batch width — forecaster-driven (BEFORE a grow attempt) or in
+#: response to a typed GrowOomError (instead of killing the chunk).
+#: bench_compare regresses this on RISE: a healthy budget never narrows.
+_SUBBATCH_NARROWED = _metrics.counter("capacity.subbatch_narrowed")
 
 
 def packed_state_bytes(n_docs: int, capacity: int) -> int:
@@ -1869,6 +1915,7 @@ class PackedReplayDriver:
         sync_every_chunk: bool = False,
         initial_occupancy: int = 0,
         quarantine: bool = False,
+        shard_docs: bool = False,
     ):
         from ytpu.models.batch_doc import DEFAULT_COMPACTION_POLICY
 
@@ -1922,10 +1969,221 @@ class PackedReplayDriver:
         # index of the latest compaction for the time-to-watermark gap
         self.forecaster = None
         self._last_compact_chunk = -1
+        # doc-axis sub-batching (ISSUE-20): when enabled, every
+        # one-dispatch chunk program (and compact/grow) runs per
+        # pow2-width doc slice sized by `plan_subbatches` against the
+        # forecaster's budget — the packed state never allocates (or
+        # dispatches) as one monolith. `_sub_width` is the sticky active
+        # width: planned lazily per capacity, only ever narrowed
+        # (forecast or GrowOomError), never re-widened mid-replay.
+        self.shard_docs = bool(shard_docs)
+        self._sub_width: Optional[int] = None
+        self._sub_cap = -1
+        self.subbatch_journal: list = []
 
     @property
     def capacity(self) -> int:
         return self.cols.shape[2]
+
+    # ------------------------------------------------------- sub-batching
+
+    def _active_sub_width(self) -> Optional[int]:
+        """The pow2 doc width each dispatch slices at, or None for the
+        monolithic path (shard_docs off, or the whole doc axis fits one
+        dispatch under the budget). Planned lazily per capacity via
+        `plan_subbatches`; a sticky narrowing survives replanning (the
+        min below) so a width demoted by `grow.oom` never re-widens."""
+        if not self.shard_docs:
+            return None
+        D = self.cols.shape[1]
+        if self._sub_cap != self.capacity:
+            from ytpu.models.replay import plan_subbatches
+
+            plan = plan_subbatches(
+                D,
+                self.capacity,
+                d_block=self.d_block if self.lane == "fused" else 1,
+                forecaster=self.forecaster,
+            )
+            width = plan.width
+            if self._sub_width is not None:
+                width = min(width, self._sub_width)
+            self._sub_width = width
+            self._sub_cap = self.capacity
+            self.stats.subbatch_width = width if width < D else 0
+        return self._sub_width if (self._sub_width or D) < D else None
+
+    def _narrow_subbatch(self, reason: str) -> bool:
+        """Demote the sub-batch width one pow2 rung (journaled + counted
+        `capacity.subbatch_narrowed`); False at the floor (`d_block` on
+        the fused lane, 1 otherwise) — the caller then surfaces the
+        original failure instead of looping."""
+        from ytpu.utils.phases import phases as _phases
+
+        D = self.cols.shape[1]
+        cur = self._sub_width if self._sub_width is not None else D
+        floor = self.d_block if self.lane == "fused" else 1
+        nxt = cur // 2
+        if nxt < max(floor, 1):
+            return False
+        self._sub_width = nxt
+        self._sub_cap = self.capacity
+        self.stats.subbatch_width = nxt
+        self.stats.subbatch_narrowed += 1
+        _SUBBATCH_NARROWED.inc()
+        self.subbatch_journal.append(
+            {
+                "chunk": self.stats.chunks,
+                "capacity": self.capacity,
+                "from_width": cur,
+                "to_width": nxt,
+                "reason": reason,
+            }
+        )
+        if _phases.enabled:
+            _phases.set_value("subbatch.width", nxt)
+            _phases.add_value("capacity.subbatch_narrowed", 1)
+        return True
+
+    def _forecast_narrow(self, new_cap: int) -> None:
+        """Satellite fix (ISSUE-20): consult the HeadroomForecaster
+        BEFORE attempting `grow_packed` — while the MODELED grow
+        transient at the active width busts the budget, narrow the
+        width instead of letting the device (or the chaos site) deny
+        the allocation."""
+        if self.forecaster is None:
+            return
+        D = self.cols.shape[1]
+        budget = self.forecaster.budget_bytes
+        while True:
+            w = self._active_sub_width() or D
+            transient = self.forecaster.model_bytes(
+                w, self.capacity
+            ) + self.forecaster.model_bytes(w, new_cap)
+            if transient <= budget or not self._narrow_subbatch("forecast"):
+                return
+
+    def _map_subbatches(self, fn, width: int):
+        """Apply ``fn(cols_slice, meta_slice) -> (cols, meta)`` per
+        doc-axis sub-batch and reassemble. Only one slice's transient
+        (donated old + new buffers) is live at a time — the bounded
+        working set that lets compact/grow clear shapes whose
+        monolithic transient busts the budget."""
+        D = self.cols.shape[1]
+        outs_c, outs_m = [], []
+        for lo in range(0, D, width):
+            hi = min(lo + width, D)
+            c = jax.lax.slice_in_dim(self.cols, lo, hi, axis=1)
+            m = jax.lax.slice_in_dim(self.meta, lo, hi, axis=0)
+            c, m = fn(c, m)
+            outs_c.append(c)
+            outs_m.append(m)
+        if len(outs_c) == 1:
+            return outs_c[0], outs_m[0]
+        return (
+            jnp.concatenate(outs_c, axis=1),
+            jnp.concatenate(outs_m, axis=0),
+        )
+
+    def _dispatch_subbatched(
+        self, lane, width, stage, span_tail, dev, vmem_mb, scan_plan,
+        program, program_kw,
+    ):
+        """Run one chunk program per doc-axis sub-batch slice and
+        reassemble (ISSUE-20 tentpole). Invariants:
+
+        - every slice shares ONE `(width, capacity)` shape family, so
+          the loop costs exactly one compile under the PR-17 sentinel
+          (the per-slice span key carries no slice index);
+        - slices are fresh `slice_in_dim` arrays, so the programs'
+          donation frees only slice transients — `self.cols/meta/_err`
+          stay alive and the PR-6 lane-ladder retry-in-place works
+          unchanged;
+        - the sticky decode-error scalar threads slice→slice (a copy of
+          `self._err` seeds slice 0 — the original is never donated);
+        - per-slice readouts fold on device into the ONE `[N_READOUT]`
+          future the drain already parses: zero new syncs (PR-5);
+        - on a multi-device host, slices round-robin across the batch
+          mesh (`ytpu.parallel.mesh.subbatch_devices`); single-device
+          placement is a no-op, keeping CPU dispatch byte-identical.
+        """
+        from ytpu.parallel.mesh import subbatch_devices
+        from ytpu.utils.phases import (
+            NULL_SPAN,
+            phases as _phases,
+            program_memory as _program_memory,
+        )
+
+        D = self.cols.shape[1]
+        n_sub = (D + width - 1) // width
+        placements = subbatch_devices(n_sub)
+        err = jnp.bitwise_or(self._err, jnp.zeros((), I32))
+        outs_c, outs_m, readouts = [], [], []
+        for i, lo in enumerate(range(0, D, width)):
+            hi = min(lo + width, D)
+            sub_cols = jax.lax.slice_in_dim(self.cols, lo, hi, axis=1)
+            sub_meta = jax.lax.slice_in_dim(self.meta, lo, hi, axis=0)
+            dev_i = dev
+            if placements is not None:
+                tgt = placements[i]
+                sub_cols = jax.device_put(sub_cols, tgt)
+                sub_meta = jax.device_put(sub_meta, tgt)
+                err = jax.device_put(err, tgt)
+                dev_i = tuple(jax.device_put(a, tgt) for a in dev)
+            span = (
+                _phases.span(
+                    "replay.subbatch",
+                    (sub_cols.shape, stage, span_tail, lane,
+                     self.d_block, vmem_mb, scan_plan),
+                    axes=("state", "stage", "tail", "lane", "d_block",
+                          "vmem_mb", "scan_plan"),
+                    memory=_program_memory(
+                        program, sub_cols, sub_meta, err, *dev_i,
+                        self.rank, lane=lane, d_block=self.d_block,
+                        interpret=self.interpret, vmem_mb=vmem_mb,
+                        scan_plan=scan_plan, **program_kw,
+                    ),
+                )
+                if _phases.enabled
+                else NULL_SPAN
+            )
+            with span:
+                sub_cols, sub_meta, err, ro = program(
+                    sub_cols,
+                    sub_meta,
+                    err,
+                    *dev_i,
+                    self.rank,
+                    lane=lane,
+                    d_block=self.d_block,
+                    interpret=self.interpret,
+                    vmem_mb=vmem_mb,
+                    scan_plan=scan_plan,
+                    **program_kw,
+                )
+            outs_c.append(sub_cols)
+            outs_m.append(sub_meta)
+            readouts.append(ro)
+        if placements is not None:
+            # gather outputs onto one device before reassembly (the
+            # follow-up NamedSharding-resident layout stays ROADMAP work)
+            home = placements[0]
+            outs_c = [jax.device_put(a, home) for a in outs_c]
+            outs_m = [jax.device_put(a, home) for a in outs_m]
+            readouts = [jax.device_put(a, home) for a in readouts]
+            err = jax.device_put(err, home)
+        cols = jnp.concatenate(outs_c, axis=1) if n_sub > 1 else outs_c[0]
+        meta = jnp.concatenate(outs_m, axis=0) if n_sub > 1 else outs_m[0]
+        readout = (
+            _fold_subbatch_readouts(jnp.stack(readouts))
+            if n_sub > 1
+            else readouts[0]
+        )
+        self.stats.subbatch_width = width
+        if _phases.enabled:
+            _phases.set_value("subbatch.width", width)
+            _phases.set_value("subbatch.n_sub", n_sub)
+        return cols, meta, err, readout
 
     # ----------------------------------------------------------- readouts
 
@@ -2208,9 +2466,21 @@ class PackedReplayDriver:
         from ytpu.utils.phases import phases as _phases
 
         occ_before = self.stats.occupied_rows
-        self.cols, self.meta = compact_packed(
-            self.cols, self.meta, self.unit_refs, self.gc_ranges
-        )
+        sub_w = self._active_sub_width()
+        if sub_w is None:
+            self.cols, self.meta = compact_packed(
+                self.cols, self.meta, self.unit_refs, self.gc_ranges
+            )
+        else:
+            # compact_packed vmaps per doc, so per-slice compaction is
+            # byte-identical — but its temp-heavy transient now peaks at
+            # the sub width, not the monolith (ISSUE-20)
+            self.cols, self.meta = self._map_subbatches(
+                lambda c, m: compact_packed(
+                    c, m, self.unit_refs, self.gc_ranges
+                ),
+                sub_w,
+            )
         self.stats.compactions += 1
         if self._last_compact_chunk >= 0:
             self.stats.compact_gap_chunks = (
@@ -2253,6 +2523,11 @@ class PackedReplayDriver:
                 )
             from ytpu.ops.compaction import grow_packed
 
+            # ISSUE-20 satellite: the forecaster is consulted BEFORE the
+            # grow attempt — a modeled transient that busts the budget
+            # narrows the sub-batch width instead of provoking the OOM
+            if self.shard_docs:
+                self._forecast_narrow(new_cap)
             try:
                 spec = faults.fire("grow.oom")
                 if spec is not None:
@@ -2275,10 +2550,26 @@ class PackedReplayDriver:
                             )
                         ),
                     )
-                self.cols, self.meta = grow_packed(
-                    self.cols, self.meta, new_cap
-                )
+                sub_w = self._active_sub_width()
+                if sub_w is None:
+                    self.cols, self.meta = grow_packed(
+                        self.cols, self.meta, new_cap
+                    )
+                else:
+                    self.cols, self.meta = self._map_subbatches(
+                        lambda c, m: grow_packed(c, m, new_cap), sub_w
+                    )
             except Exception as e:
+                if (
+                    isinstance(e, GrowOomError)
+                    and self.shard_docs
+                    and self._narrow_subbatch("grow.oom")
+                ):
+                    # ISSUE-20: a denied grow demotes to a narrower
+                    # sub-batch width and retries the SAME capacity step
+                    # instead of killing the chunk (the armed fault was
+                    # consumed firing, so the retry proceeds)
+                    continue
                 if not is_device_fault(e):
                     raise
                 # a failed growth (device OOM) leaves the pre-grow state
@@ -2419,8 +2710,14 @@ class PackedReplayDriver:
                 sum(a.size * a.dtype.itemsize for a in dev),
                 "h2d",
             )
+        sub_w = self._active_sub_width()
 
         def dispatch(lane):
+            if sub_w is not None:
+                return self._dispatch_subbatched(
+                    lane, sub_w, stage, span_tail, dev, vmem_mb,
+                    scan_plan, program, program_kw,
+                )
             span = (
                 _phases.span(
                     stage,
@@ -2541,6 +2838,8 @@ def replay_stream_fused(
     policy=None,
     max_capacity: Optional[int] = None,
     refresh_cache: bool = False,
+    shard_docs: bool = False,
+    forecaster=None,
 ) -> Tuple[DocStateBatch, ReplayChunkStats]:
     """Chunked fused replay of a stacked [S, ...] update stream with
     between-chunk device compaction — `apply_update_stream_fused` for
@@ -2560,7 +2859,15 @@ def replay_stream_fused(
     opts into the eager O(D·B²) rebuild); the XLA lane maintains the
     cache in-kernel, so the input is `ensure_origin_slot`'d up front and
     the output stays fresh — compaction's defrag remap preserves the
-    containment contract either way."""
+    containment contract either way.
+
+    ``shard_docs=True`` (ISSUE-20) enables the driver's doc-axis
+    sub-batch plan for this stream replay: the per-step integrate
+    dispatch stays monolithic (the stacked-stream path carries no
+    per-slice readout fold), but between-chunk `compact_packed` /
+    `grow_packed` run per pow2-width doc slice under the budget
+    (``forecaster`` optionally pins it) — the mixed-content twin of the
+    byte-stream path's fully sliced dispatch."""
     from ytpu.models.batch_doc import stream_worst_case_adds
 
     if lane == "xla":
@@ -2583,7 +2890,9 @@ def replay_stream_fused(
         policy=policy,
         max_capacity=max_capacity,
         initial_occupancy=initial,
+        shard_docs=shard_docs,
     )
+    driver.forecaster = forecaster
     for s in range(0, S, chunk_steps):
         e = min(S, s + chunk_steps)
         chunk = jax.tree_util.tree_map(lambda a: a[s:e], stream)

@@ -22,10 +22,26 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["make_mesh", "doc_sharding", "sv_sharding", "shard_state", "AXIS_DP", "AXIS_TP"]
+__all__ = [
+    "make_mesh",
+    "doc_sharding",
+    "sv_sharding",
+    "shard_state",
+    "batch_mesh",
+    "batch_sharding",
+    "subbatch_devices",
+    "shard_docs_put",
+    "AXIS_DP",
+    "AXIS_TP",
+    "AXIS_BATCH",
+]
 
 AXIS_DP = "dp"
 AXIS_TP = "tp"
+#: doc-batch axis for sub-batched integrate dispatch (ISSUE-20): the
+#: packed [NC, D, C] state splits into pow2 doc-width sub-batches and
+#: each sub-batch lands on one mesh slot
+AXIS_BATCH = "batch"
 
 
 def make_mesh(
@@ -67,3 +83,56 @@ def shard_state(state, mesh: Mesh):
 def shard_batch(batch, mesh: Mesh):
     sh = doc_sharding(mesh)
     return jax.tree.map(lambda a: jax.device_put(a, sh), batch)
+
+
+# --------------------------------------------------------------------------
+# Doc-axis (batch) sharding for sub-batched integrate dispatch (ISSUE-20).
+# All helpers degrade to a single-device no-op: `batch_mesh()` returns
+# None when one device is visible, and every consumer treats None as
+# "skip placement entirely", so the CPU tier-1 path stays byte-identical
+# to the monolithic dispatch.
+
+
+def batch_mesh(n_devices: Optional[int] = None) -> Optional[Mesh]:
+    """1-D ``Mesh(('batch',))`` over the visible devices, or None on a
+    single-device host (the fallback ISSUE-20 pins: no mesh, no
+    device_put, byte-identical dispatch)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    if len(devices) <= 1:
+        return None
+    return Mesh(np.array(devices), (AXIS_BATCH,))
+
+
+def batch_sharding(mesh: Mesh, doc_axis: int = 0, ndim: int = 1) -> NamedSharding:
+    """``NamedSharding(P('batch'))`` with the doc axis at ``doc_axis``
+    of an ``ndim``-rank array (packed cols carry docs at axis 1)."""
+    spec = [None] * max(int(ndim), doc_axis + 1)
+    spec[doc_axis] = AXIS_BATCH
+    return NamedSharding(mesh, P(*spec))
+
+
+def subbatch_devices(n_sub: int, mesh: Optional[Mesh] = None):
+    """Round-robin device placement for ``n_sub`` integrate sub-batches;
+    None on a single-device host so the dispatch loop skips device_put."""
+    if mesh is None:
+        mesh = batch_mesh()
+    if mesh is None:
+        return None
+    devs = list(mesh.devices.flat)
+    return [devs[i % len(devs)] for i in range(int(n_sub))]
+
+
+def shard_docs_put(arr, mesh: Optional[Mesh] = None, doc_axis: int = 0):
+    """Place one array so its doc axis spans the batch mesh. Identity on
+    a single-device host or when the doc axis doesn't divide the mesh
+    (NamedSharding requires even splits; an uneven tail stays local)."""
+    if mesh is None:
+        mesh = batch_mesh()
+    if mesh is None:
+        return arr
+    n = int(mesh.devices.size)
+    if arr.ndim <= doc_axis or arr.shape[doc_axis] % n != 0:
+        return arr
+    return jax.device_put(arr, batch_sharding(mesh, doc_axis, arr.ndim))

@@ -66,15 +66,20 @@ class DeviceSyncServer(SyncServer):
         diff_sub_batch: int = 512,
         diff_depth: int = 2,
         telemetry_port: Optional[int] = None,
+        shard_docs: bool = False,
         **kwargs,
     ):
         super().__init__(**kwargs)
         if ingestor is None:
             if n_docs is None:
                 raise ValueError("pass n_docs or an ingestor")
-            ingestor = BatchIngestor(n_docs, capacity)
+            ingestor = BatchIngestor(n_docs, capacity, shard_docs=shard_docs)
         # the ingestor is the single source of truth for the slot count
         self.ingestor = ingestor
+        # doc-axis sub-batching / sharding knob (ISSUE-20): surfaced in
+        # telemetry and threaded into the default ingestor above (an
+        # explicitly-passed ingestor keeps its own setting)
+        self.shard_docs = bool(getattr(ingestor, "shard_docs", shard_docs))
         self.device_authoritative = device_authoritative
         from ytpu.utils import metrics
 
@@ -138,6 +143,7 @@ class DeviceSyncServer(SyncServer):
             "n_docs": self.ingestor.n_docs,
             "queued_updates": self.pending_device_updates(),
             "device_authoritative": self.device_authoritative,
+            "shard_docs": self.shard_docs,
         }
         try:
             out["capacity"] = self.capacity_snapshot()
